@@ -1,0 +1,34 @@
+"""cfk_tpu — a TPU-native collaborative-filtering framework.
+
+A from-scratch re-design of the capabilities of the Kafka-Streams ALS reference
+(trinh-hoang-hiep/Collaborative-Filtering-Kafka): block-partitioned ALS-WR
+matrix factorization on Netflix-Prize-format data — expressed TPU-first:
+
+- the rating matrix is sharded over a ``jax.sharding.Mesh`` (the analog of the
+  reference's mod-N Kafka partitioning, ``producers/PureModPartitioner.java:17``),
+- each half-iteration is a bulk-synchronous SPMD step under ``shard_map``:
+  exchange fixed-side factors (``all_gather`` over ICI, or a ``ppermute`` ring —
+  the block-to-block join analog), then batched normal-equation solves on the
+  MXU (the analog of ``processors/MFeatureCalculator.java:85-99``),
+- the EOF-barrier protocol of the reference (``processors/URatings2BlocksProcessor.java:56-63``)
+  survives in the pluggable ingest/transport layer, and the per-iteration Kafka
+  topics become an explicit checkpoint API.
+"""
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.netflix import parse_netflix
+from cfk_tpu.data.blocks import IdMap, RatingsCOO, build_padded_blocks
+from cfk_tpu.models.als import ALSModel, train_als
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ALSConfig",
+    "parse_netflix",
+    "IdMap",
+    "RatingsCOO",
+    "build_padded_blocks",
+    "ALSModel",
+    "train_als",
+    "__version__",
+]

@@ -1,0 +1,58 @@
+"""Typed configuration for the framework.
+
+The reference scatters its configuration across seven positional CLI args
+copied into global mutable statics (``apps/ALSApp.java:17-22,41-48``) that are
+read from processors and even the wire deserializer
+(``serdes/FeatureMessage/FeatureMessageDeserializer.java:33``), plus a separate
+shell script with its own copy of the partition count (``setup.sh``).  Here the
+whole configuration is one frozen dataclass threaded explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    """Hyper-parameters + execution layout for a block-partitioned ALS run.
+
+    Mirrors the reference CLI surface (``apps/ALSAppRunner.java:16-28``):
+    NUM_PARTITIONS → ``num_shards``, NUM_FEATURES → ``rank``, LAMBDA → ``lam``,
+    NUM_ITERATIONS → ``num_iterations``; NUM_MOVIES/NUM_USERS are derived from
+    the data (the reference made users pass them by hand).
+    """
+
+    rank: int = 5
+    lam: float = 0.05
+    num_iterations: int = 7
+    num_shards: int = 1
+    seed: int = 42
+
+    # Execution knobs (no analog in the reference — TPU-specific).
+    dtype: Literal["float32", "bfloat16"] = "float32"
+    # How fixed-side factors travel between shards each half-iteration:
+    #   "all_gather" — one all_gather over ICI, every shard sees full factors
+    #                  (the all-to-all-join analog; OutBlock dedup comes free).
+    #   "ring"       — ppermute ring, shards accumulate partial Gram matrices
+    #                  block by block (the block-to-block-join analog; never
+    #                  materializes the full fixed-side matrix per device).
+    exchange: Literal["all_gather", "ring"] = "all_gather"
+    # Entities-per-solve chunk; bounds the [chunk, max_nnz, rank] gather that
+    # feeds the MXU. None = solve a whole shard at once.
+    solve_chunk: int | None = None
+    # Pad ragged neighbor lists up to a multiple of this (MXU-friendly tiling).
+    pad_multiple: int = 8
+
+    def __post_init__(self) -> None:
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        if self.num_iterations < 1:
+            raise ValueError(f"num_iterations must be >= 1, got {self.num_iterations}")
+        if self.num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {self.num_shards}")
+        if self.lam < 0:
+            raise ValueError(f"lam must be >= 0, got {self.lam}")
+        if self.exchange not in ("all_gather", "ring"):
+            raise ValueError(f"unknown exchange {self.exchange!r}")

@@ -1,0 +1,4 @@
+from cfk_tpu.data.netflix import parse_netflix
+from cfk_tpu.data.blocks import IdMap, RatingsCOO, PaddedBlocks, build_padded_blocks
+
+__all__ = ["parse_netflix", "IdMap", "RatingsCOO", "PaddedBlocks", "build_padded_blocks"]

@@ -1,0 +1,191 @@
+"""Rating blocks: the TPU-native analog of the reference's InBlocks/OutBlocks.
+
+The reference materializes, per Kafka partition, three state stores per side —
+neighbor-id lists, rating lists, and the set of partitions that need each
+factor vector (``processors/MRatings2BlocksProcessor.java:46-69`` and the
+user-side mirror).  On TPU the same information becomes dense arrays:
+
+- ``IdMap``           — sparse external ids ↔ dense ascending indices (the
+                        reference keeps raw ids as Kafka keys throughout and
+                        only sorts at the final collector's TreeMap,
+                        ``processors/FeatureCollector.java:64-70``; we sort
+                        once up front so factor row i ↔ i-th smallest raw id).
+- ``PaddedBlocks``    — per-entity ragged neighbor lists padded to a rectangle
+                        [num_entities_padded, max_nnz_padded]: neighbor dense
+                        indices, ratings, and a validity mask.  This is the
+                        InBlock, laid out for one big MXU-friendly gather +
+                        batched matmul instead of per-entity HashMap
+                        accumulation (``processors/MFeatureCalculator.java:56-74``).
+- OutBlocks have no explicit analog: with ``all_gather`` every shard sees all
+  fixed-side factors (dedup-per-partition comes free, SURVEY.md §2.6), and the
+  ring exchange passes whole factor shards, so "who needs my vector" is never
+  tracked per entity.
+
+Entity-count padding rows (mask all zero, count 0) make every shard the same
+size; their normal equations are made non-singular by clamping the ALS-WR
+regularizer ``λ·n`` to a floor of 1 for n == 0 rows (real rows always have
+n ≥ 1 so their math is untouched — exact reference semantics,
+``processors/MFeatureCalculator.java:91-95``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RatingsCOO:
+    """All ratings as parallel COO arrays (raw external ids)."""
+
+    movie_raw: np.ndarray  # int64 [nnz]
+    user_raw: np.ndarray  # int64 [nnz]
+    rating: np.ndarray  # float32 [nnz]
+
+    @property
+    def num_ratings(self) -> int:
+        return int(self.rating.shape[0])
+
+
+@dataclasses.dataclass(frozen=True)
+class IdMap:
+    """Sorted unique raw ids; dense index i ↔ ``raw_ids[i]`` (ascending).
+
+    Only *rated* entities are included, matching the reference's counting
+    (SURVEY.md §6: NUM_MOVIES/NUM_USERS count rated entities; prediction
+    matrix rows/cols are ascending-id over those).
+    """
+
+    raw_ids: np.ndarray  # int64 [num_entities], sorted ascending
+
+    @classmethod
+    def from_raw(cls, raw: np.ndarray) -> "IdMap":
+        return cls(raw_ids=np.unique(raw))
+
+    @property
+    def num_entities(self) -> int:
+        return int(self.raw_ids.shape[0])
+
+    def to_dense(self, raw: np.ndarray) -> np.ndarray:
+        """Map raw ids → dense indices. Raises if any raw id is unknown."""
+        idx = np.searchsorted(self.raw_ids, raw)
+        if np.any(idx >= self.num_entities) or np.any(self.raw_ids[idx] != raw):
+            bad = raw[(idx >= self.num_entities) | (self.raw_ids[np.minimum(idx, self.num_entities - 1)] != raw)]
+            raise KeyError(f"unknown raw ids, e.g. {bad[:5]}")
+        return idx.astype(np.int32)
+
+
+def _round_up(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+@dataclasses.dataclass(frozen=True)
+class PaddedBlocks:
+    """Rectangular InBlocks for one solve side.
+
+    Row e (< ``num_entities``) holds entity e's neighbors; rows beyond are
+    all-padding so the entity axis divides ``num_shards`` evenly.
+    """
+
+    neighbor_idx: np.ndarray  # int32 [E_pad, P] dense idx into the fixed side (0 where masked)
+    rating: np.ndarray  # float32 [E_pad, P] (0 where masked)
+    mask: np.ndarray  # float32 [E_pad, P] 1.0 = real rating
+    count: np.ndarray  # int32 [E_pad] real nnz per entity (0 for pad rows)
+    num_entities: int  # real (un-padded) entity count
+
+    @property
+    def padded_entities(self) -> int:
+        return int(self.neighbor_idx.shape[0])
+
+    @property
+    def max_nnz(self) -> int:
+        return int(self.neighbor_idx.shape[1])
+
+
+def build_padded_blocks(
+    solve_dense: np.ndarray,
+    fixed_dense: np.ndarray,
+    rating: np.ndarray,
+    num_solve_entities: int,
+    *,
+    num_shards: int = 1,
+    pad_multiple: int = 8,
+) -> PaddedBlocks:
+    """Group ratings by the solve-side entity into a padded rectangle.
+
+    ``solve_dense``/``fixed_dense`` are dense indices (from ``IdMap.to_dense``)
+    of the side being solved / held fixed.  Fully vectorized (no Python loop
+    over entities); the reference does the equivalent incrementally per record
+    in ``MRatings2BlocksProcessor``/``URatings2BlocksProcessor``.
+    """
+    nnz = solve_dense.shape[0]
+    order = np.argsort(solve_dense, kind="stable")
+    s_sorted = solve_dense[order]
+    f_sorted = fixed_dense[order].astype(np.int32)
+    r_sorted = rating[order].astype(np.float32)
+
+    count = np.bincount(s_sorted, minlength=num_solve_entities).astype(np.int32)
+    max_nnz = _round_up(max(int(count.max()), 1), pad_multiple)
+    e_pad = _round_up(num_solve_entities, num_shards)
+
+    # Position of each rating within its entity's group.
+    group_start = np.zeros(num_solve_entities, dtype=np.int64)
+    np.cumsum(count[:-1], out=group_start[1:])
+    pos = np.arange(nnz, dtype=np.int64) - group_start[s_sorted]
+
+    neighbor = np.zeros((e_pad, max_nnz), dtype=np.int32)
+    rmat = np.zeros((e_pad, max_nnz), dtype=np.float32)
+    mask = np.zeros((e_pad, max_nnz), dtype=np.float32)
+    neighbor[s_sorted, pos] = f_sorted
+    rmat[s_sorted, pos] = r_sorted
+    mask[s_sorted, pos] = 1.0
+
+    count_pad = np.zeros(e_pad, dtype=np.int32)
+    count_pad[:num_solve_entities] = count
+    return PaddedBlocks(
+        neighbor_idx=neighbor,
+        rating=rmat,
+        mask=mask,
+        count=count_pad,
+        num_entities=num_solve_entities,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Dataset:
+    """A fully indexed rating dataset: id maps + both solve-side block sets."""
+
+    movie_map: IdMap
+    user_map: IdMap
+    movie_blocks: PaddedBlocks  # solve movies, neighbors are users
+    user_blocks: PaddedBlocks  # solve users, neighbors are movies
+    coo_dense: RatingsCOO  # dense-index COO (movie_raw/user_raw hold dense idx)
+
+    @classmethod
+    def from_coo(
+        cls, coo: RatingsCOO, *, num_shards: int = 1, pad_multiple: int = 8
+    ) -> "Dataset":
+        movie_map = IdMap.from_raw(coo.movie_raw)
+        user_map = IdMap.from_raw(coo.user_raw)
+        m_dense = movie_map.to_dense(coo.movie_raw)
+        u_dense = user_map.to_dense(coo.user_raw)
+        movie_blocks = build_padded_blocks(
+            m_dense, u_dense, coo.rating, movie_map.num_entities,
+            num_shards=num_shards, pad_multiple=pad_multiple,
+        )
+        user_blocks = build_padded_blocks(
+            u_dense, m_dense, coo.rating, user_map.num_entities,
+            num_shards=num_shards, pad_multiple=pad_multiple,
+        )
+        return cls(
+            movie_map=movie_map,
+            user_map=user_map,
+            movie_blocks=movie_blocks,
+            user_blocks=user_blocks,
+            coo_dense=RatingsCOO(
+                movie_raw=m_dense.astype(np.int64),
+                user_raw=u_dense.astype(np.int64),
+                rating=coo.rating.astype(np.float32),
+            ),
+        )

@@ -1,0 +1,71 @@
+"""Netflix-Prize-format ingest.
+
+Grammar (matching ``producers/NetflixDataFormatProducer.java:44-50``):
+
+    <movieId>:            — header line, sets the current movie
+    <userId>,<rating>,<date>   — one rating row; the date field is ignored
+                                 (reference ignores it too, :48-50)
+
+Movies with zero rating rows exist in the files (e.g. tiny has 1,000 headers
+but only 426 rated movies) and are dropped — NUM_MOVIES/NUM_USERS in the
+reference count *rated* entities only (see SURVEY.md §6 footnote).
+
+A native C++ parser (``native/``) is used when its shared library has been
+built; this pure-Python path is the always-available fallback and the
+reference implementation for tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cfk_tpu.data.blocks import RatingsCOO
+
+
+def parse_netflix_python(path: str) -> RatingsCOO:
+    """Pure-Python Netflix-format parser (fallback / reference)."""
+    movie_ids: list[int] = []
+    user_ids: list[int] = []
+    ratings: list[int] = []
+    current_movie = -1
+    with open(path, "r") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                if line.endswith(":"):
+                    current_movie = int(line[:-1])
+                    continue
+                # userId,rating,date — date ignored
+                user_s, rating_s, _ = line.split(",", 2)
+                user_id, rating = int(user_s), int(rating_s)
+            except ValueError as e:
+                raise ValueError(f"{path}:{lineno}: malformed line {line!r}") from e
+            if current_movie < 0:
+                raise ValueError(
+                    f"{path}:{lineno}: rating row before any 'movieId:' header"
+                )
+            movie_ids.append(current_movie)
+            user_ids.append(user_id)
+            ratings.append(rating)
+    return RatingsCOO(
+        movie_raw=np.asarray(movie_ids, dtype=np.int64),
+        user_raw=np.asarray(user_ids, dtype=np.int64),
+        rating=np.asarray(ratings, dtype=np.float32),
+    )
+
+
+def parse_netflix(path: str) -> RatingsCOO:
+    """Parse a Netflix-format ratings file into COO arrays.
+
+    Uses the native C++ parser when available, else pure Python.
+    """
+    try:
+        from cfk_tpu.data import _native
+
+        if _native.available():
+            return _native.parse_netflix(path)
+    except ImportError:
+        pass
+    return parse_netflix_python(path)

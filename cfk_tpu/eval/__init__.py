@@ -1,0 +1,9 @@
+from cfk_tpu.eval.metrics import mse_rmse, mse_rmse_from_blocks
+from cfk_tpu.eval.predict import save_prediction_csv, load_prediction_csv
+
+__all__ = [
+    "mse_rmse",
+    "mse_rmse_from_blocks",
+    "save_prediction_csv",
+    "load_prediction_csv",
+]

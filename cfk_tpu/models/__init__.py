@@ -1,0 +1,3 @@
+from cfk_tpu.models.als import ALSModel, train_als
+
+__all__ = ["ALSModel", "train_als"]

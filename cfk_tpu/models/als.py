@@ -1,0 +1,118 @@
+"""Explicit-feedback ALS-WR — the flagship model.
+
+Single-device training loop with exact reference semantics
+(``apps/ALSApp.java:115-151`` unrolled topology, re-expressed as a jitted
+``lax.fori_loop``):
+
+  - init user factors: avg-rating + U(0,1) (``processors/UFeatureInitializer.java:50-56``)
+  - per iteration i: solve movies from users (``MFeatureCalculator-i``), then
+    users from movies (``UFeatureCalculator-i``)
+  - prediction P = U·Mᵀ (``processors/FeatureCollector.java:91-92``), rows =
+    users ascending id, cols = movies ascending id.
+
+The multi-device SPMD path lives in ``cfk_tpu.parallel``; this module is the
+1-shard special case and the semantic reference for its equivalence tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import Dataset, PaddedBlocks
+from cfk_tpu.ops.solve import als_half_step, init_factors
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSModel:
+    """Trained factor matrices (rows = ascending external id order)."""
+
+    user_factors: jax.Array  # [num_users, k]  (includes pad rows at the end)
+    movie_factors: jax.Array  # [num_movies, k]
+    num_users: int
+    num_movies: int
+
+    def predict_dense(self) -> np.ndarray:
+        """Dense prediction matrix P = U·Mᵀ, [num_users, num_movies]."""
+        p = self.user_factors[: self.num_users] @ self.movie_factors[: self.num_movies].T
+        return np.asarray(p)
+
+
+def _blocks_to_device(blocks: PaddedBlocks) -> dict[str, jax.Array]:
+    return {
+        "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
+        "rating": jnp.asarray(blocks.rating),
+        "mask": jnp.asarray(blocks.mask),
+        "count": jnp.asarray(blocks.count),
+    }
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rank", "num_iterations", "lam", "solve_chunk")
+)
+def _train_loop(
+    key: jax.Array,
+    movie_blocks: dict[str, jax.Array],
+    user_blocks: dict[str, jax.Array],
+    *,
+    rank: int,
+    num_iterations: int,
+    lam: float,
+    solve_chunk: int | None,
+) -> tuple[jax.Array, jax.Array]:
+    u = init_factors(
+        key, user_blocks["rating"], user_blocks["mask"], user_blocks["count"], rank
+    )
+    m0 = jnp.zeros((movie_blocks["rating"].shape[0], rank), dtype=jnp.float32)
+
+    def one_iteration(_, carry):
+        u, _ = carry
+        m = als_half_step(
+            u,
+            movie_blocks["neighbor_idx"],
+            movie_blocks["rating"],
+            movie_blocks["mask"],
+            movie_blocks["count"],
+            lam,
+            solve_chunk=solve_chunk,
+        )
+        u_new = als_half_step(
+            m,
+            user_blocks["neighbor_idx"],
+            user_blocks["rating"],
+            user_blocks["mask"],
+            user_blocks["count"],
+            lam,
+            solve_chunk=solve_chunk,
+        )
+        return (u_new, m)
+
+    u_final, m_final = jax.lax.fori_loop(
+        0, num_iterations, one_iteration, (u, m0)
+    )
+    return u_final, m_final
+
+
+def train_als(dataset: Dataset, config: ALSConfig) -> ALSModel:
+    """Train ALS-WR on one device. Returns factors in ascending-id order."""
+    key = jax.random.PRNGKey(config.seed)
+    u, m = _train_loop(
+        key,
+        _blocks_to_device(dataset.movie_blocks),
+        _blocks_to_device(dataset.user_blocks),
+        rank=config.rank,
+        num_iterations=config.num_iterations,
+        lam=config.lam,
+        solve_chunk=config.solve_chunk,
+    )
+    return ALSModel(
+        user_factors=u,
+        movie_factors=m,
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+    )

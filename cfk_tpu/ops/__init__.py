@@ -1,0 +1,8 @@
+from cfk_tpu.ops.solve import (
+    gather_gram,
+    batched_spd_solve,
+    als_half_step,
+    init_factors,
+)
+
+__all__ = ["gather_gram", "batched_spd_solve", "als_half_step", "init_factors"]

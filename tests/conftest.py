@@ -1,0 +1,47 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+Multi-chip sharding is validated on a virtual CPU mesh (the TPU test double),
+so every sharding/collective path compiles and runs in CI without TPU
+hardware.  Must run before the first ``import jax`` anywhere in the test
+process.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize force-registers the TPU platform and
+# overrides JAX_PLATFORMS, so the CPU override must go through jax.config
+# before any backend is initialized.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+TINY = "/root/reference/data/data_sample_tiny.txt"
+SMALL = "/root/reference/data/data_sample_small.txt"
+MEDIUM = "/root/reference/data/data_sample_medium.txt"
+
+
+@pytest.fixture(scope="session")
+def tiny_coo():
+    from cfk_tpu.data.netflix import parse_netflix_python
+
+    return parse_netflix_python(TINY)
+
+
+@pytest.fixture(scope="session")
+def tiny_dataset(tiny_coo):
+    from cfk_tpu.data.blocks import Dataset
+
+    return Dataset.from_coo(tiny_coo)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,85 @@
+"""Block-builder property tests: the padded rectangles must encode exactly the
+same (entity, neighbor, rating) triples as the input COO — the invariant the
+reference maintains incrementally in its *Ratings2BlocksProcessors."""
+
+import numpy as np
+import pytest
+
+from cfk_tpu.data.blocks import Dataset, IdMap, RatingsCOO, build_padded_blocks
+
+
+def random_coo(rng, n_movies=37, n_users=23, nnz=400):
+    # Sparse raw ids with gaps, duplicate (movie,user) pairs avoided.
+    movies = rng.choice(np.arange(1, 1000, 3), size=n_movies, replace=False)
+    users = rng.choice(np.arange(2, 2000, 5), size=n_users, replace=False)
+    pairs = rng.choice(n_movies * n_users, size=nnz, replace=False)
+    m = movies[pairs // n_users]
+    u = users[pairs % n_users]
+    r = rng.integers(1, 6, size=nnz).astype(np.float32)
+    return RatingsCOO(movie_raw=m.astype(np.int64), user_raw=u.astype(np.int64), rating=r)
+
+
+def blocks_to_triples(blocks, fixed_ids):
+    """Recover (entity_dense, neighbor_dense, rating) triples from padding."""
+    e_idx, p_idx = np.nonzero(blocks.mask)
+    return set(
+        zip(
+            e_idx.tolist(),
+            blocks.neighbor_idx[e_idx, p_idx].tolist(),
+            blocks.rating[e_idx, p_idx].tolist(),
+        )
+    )
+
+
+def test_idmap_roundtrip(rng):
+    raw = rng.choice(10_000, size=200, replace=False).astype(np.int64)
+    m = IdMap.from_raw(raw)
+    assert np.all(np.diff(m.raw_ids) > 0)  # ascending
+    dense = m.to_dense(raw)
+    np.testing.assert_array_equal(m.raw_ids[dense], raw)
+
+
+def test_idmap_unknown_raises(rng):
+    m = IdMap.from_raw(np.array([3, 7, 11], dtype=np.int64))
+    with pytest.raises(KeyError):
+        m.to_dense(np.array([3, 8], dtype=np.int64))
+
+
+@pytest.mark.parametrize("num_shards", [1, 4])
+def test_blocks_encode_exact_triples(rng, num_shards):
+    coo = random_coo(rng)
+    ds = Dataset.from_coo(coo, num_shards=num_shards)
+
+    m_dense = ds.movie_map.to_dense(coo.movie_raw)
+    u_dense = ds.user_map.to_dense(coo.user_raw)
+
+    want_movie_side = set(zip(m_dense.tolist(), u_dense.tolist(), coo.rating.tolist()))
+    assert blocks_to_triples(ds.movie_blocks, ds.user_map) == want_movie_side
+
+    want_user_side = set(zip(u_dense.tolist(), m_dense.tolist(), coo.rating.tolist()))
+    assert blocks_to_triples(ds.user_blocks, ds.movie_map) == want_user_side
+
+
+@pytest.mark.parametrize("num_shards", [1, 3, 8])
+def test_padding_divisible(rng, num_shards):
+    coo = random_coo(rng)
+    ds = Dataset.from_coo(coo, num_shards=num_shards)
+    assert ds.movie_blocks.padded_entities % num_shards == 0
+    assert ds.user_blocks.padded_entities % num_shards == 0
+    # Pad rows are fully masked with zero counts.
+    mb = ds.movie_blocks
+    assert np.all(mb.mask[mb.num_entities :] == 0)
+    assert np.all(mb.count[mb.num_entities :] == 0)
+
+
+def test_counts_match_bincount(rng):
+    coo = random_coo(rng)
+    ds = Dataset.from_coo(coo)
+    m_dense = ds.movie_map.to_dense(coo.movie_raw)
+    np.testing.assert_array_equal(
+        ds.movie_blocks.count[: ds.movie_blocks.num_entities],
+        np.bincount(m_dense, minlength=ds.movie_map.num_entities),
+    )
+    np.testing.assert_array_equal(
+        ds.movie_blocks.count.sum() , coo.num_ratings
+    )

@@ -1,0 +1,41 @@
+"""Netflix-format parser tests against measured properties of the bundled data
+(SURVEY.md §2.5: tiny = 426 rated movies, 302 users, 3,415 ratings)."""
+
+import numpy as np
+
+from cfk_tpu.data.blocks import IdMap
+from cfk_tpu.data.netflix import parse_netflix_python
+
+
+def test_tiny_counts(tiny_coo):
+    assert tiny_coo.num_ratings == 3415
+    assert np.unique(tiny_coo.movie_raw).size == 426
+    assert np.unique(tiny_coo.user_raw).size == 302
+
+
+def test_tiny_id_ranges(tiny_coo):
+    # Raw ids are sparse: larger than the rated-entity counts.
+    assert tiny_coo.movie_raw.max() <= 1000
+    assert tiny_coo.user_raw.max() <= 2000
+    assert tiny_coo.rating.min() >= 1.0
+    assert tiny_coo.rating.max() <= 5.0
+
+
+def test_parse_inline(tmp_path):
+    p = tmp_path / "mini.txt"
+    p.write_text("7:\n1,5,2005-01-01\n2,3,2005-01-02\n9:\n2,1,2005-01-03\n")
+    coo = parse_netflix_python(str(p))
+    assert coo.num_ratings == 3
+    np.testing.assert_array_equal(coo.movie_raw, [7, 7, 9])
+    np.testing.assert_array_equal(coo.user_raw, [1, 2, 2])
+    np.testing.assert_array_equal(coo.rating, [5.0, 3.0, 1.0])
+
+
+def test_empty_movies_dropped(tmp_path):
+    # Headers with no rating rows must not become entities (SURVEY.md §6 note).
+    p = tmp_path / "mini.txt"
+    p.write_text("1:\n2:\n5,4,2005-01-01\n3:\n")
+    coo = parse_netflix_python(str(p))
+    m = IdMap.from_raw(coo.movie_raw)
+    assert m.num_entities == 1
+    assert m.raw_ids[0] == 2

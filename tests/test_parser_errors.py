@@ -1,0 +1,32 @@
+"""Parser error-path tests (found by probing; the reference would silently
+emit movieId=-1 — its own EOF sentinel — for a rating row before a header)."""
+
+import pytest
+
+from cfk_tpu.data.netflix import parse_netflix_python
+
+
+def write(tmp_path, content):
+    p = tmp_path / "data.txt"
+    p.write_text(content)
+    return str(p)
+
+
+def test_rating_before_header_rejected(tmp_path):
+    with pytest.raises(ValueError, match="before any"):
+        parse_netflix_python(write(tmp_path, "1,5,2005-01-01\n"))
+
+
+def test_garbage_line_has_location(tmp_path):
+    with pytest.raises(ValueError, match=":2: malformed"):
+        parse_netflix_python(write(tmp_path, "1:\ngarbage\n"))
+
+
+def test_non_numeric_rating_has_location(tmp_path):
+    with pytest.raises(ValueError, match="malformed"):
+        parse_netflix_python(write(tmp_path, "1:\n2,notanumber,2005-01-01\n"))
+
+
+def test_empty_file_ok(tmp_path):
+    coo = parse_netflix_python(write(tmp_path, ""))
+    assert coo.num_ratings == 0
