@@ -31,6 +31,8 @@ class ALSConfig:
     seed: int = 42
 
     # Execution knobs (no analog in the reference — TPU-specific).
+    # Storage/exchange dtype of the factor matrices: bfloat16 halves HBM and
+    # ICI bytes; Gram accumulation and solves always run float32 internally.
     dtype: Literal["float32", "bfloat16"] = "float32"
     # How fixed-side factors travel between shards each half-iteration:
     #   "all_gather" — one all_gather over ICI, every shard sees full factors
@@ -43,6 +45,9 @@ class ALSConfig:
     # feeds the MXU. None = solve a whole shard at once.
     solve_chunk: int | None = None
     # Pad ragged neighbor lists up to a multiple of this (MXU-friendly tiling).
+    # Consumed wherever blocks are built from this config (ring-block builds,
+    # CLI/bench dataset construction); pass it to Dataset.from_coo when
+    # building datasets by hand.
     pad_multiple: int = 8
 
     def __post_init__(self) -> None:

@@ -39,8 +39,9 @@ class ALSModel:
 
     def predict_dense(self) -> np.ndarray:
         """Dense prediction matrix P = U·Mᵀ, [num_users, num_movies]."""
-        p = self.user_factors[: self.num_users] @ self.movie_factors[: self.num_movies].T
-        return np.asarray(p)
+        u = np.asarray(self.user_factors[: self.num_users], dtype=np.float32)
+        m = np.asarray(self.movie_factors[: self.num_movies], dtype=np.float32)
+        return u @ m.T
 
 
 def _blocks_to_device(blocks: PaddedBlocks) -> dict[str, jax.Array]:
@@ -53,7 +54,7 @@ def _blocks_to_device(blocks: PaddedBlocks) -> dict[str, jax.Array]:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("rank", "num_iterations", "lam", "solve_chunk")
+    jax.jit, static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype")
 )
 def _train_loop(
     key: jax.Array,
@@ -64,14 +65,18 @@ def _train_loop(
     num_iterations: int,
     lam: float,
     solve_chunk: int | None,
+    dtype: str = "float32",
 ) -> tuple[jax.Array, jax.Array]:
+    dt = jnp.dtype(dtype)
     u = init_factors(
         key, user_blocks["rating"], user_blocks["mask"], user_blocks["count"], rank
-    )
-    m0 = jnp.zeros((movie_blocks["rating"].shape[0], rank), dtype=jnp.float32)
+    ).astype(dt)
+    m0 = jnp.zeros((movie_blocks["rating"].shape[0], rank), dtype=dt)
 
     def one_iteration(_, carry):
         u, _ = carry
+        # Factors are stored in `dtype` (bfloat16 halves HBM traffic); the
+        # Gram accumulation upcasts to float32 inside gather_gram.
         m = als_half_step(
             u,
             movie_blocks["neighbor_idx"],
@@ -80,7 +85,7 @@ def _train_loop(
             movie_blocks["count"],
             lam,
             solve_chunk=solve_chunk,
-        )
+        ).astype(dt)
         u_new = als_half_step(
             m,
             user_blocks["neighbor_idx"],
@@ -89,7 +94,7 @@ def _train_loop(
             user_blocks["count"],
             lam,
             solve_chunk=solve_chunk,
-        )
+        ).astype(dt)
         return (u_new, m)
 
     u_final, m_final = jax.lax.fori_loop(
@@ -109,6 +114,7 @@ def train_als(dataset: Dataset, config: ALSConfig) -> ALSModel:
         num_iterations=config.num_iterations,
         lam=config.lam,
         solve_chunk=config.solve_chunk,
+        dtype=config.dtype,
     )
     return ALSModel(
         user_factors=u,

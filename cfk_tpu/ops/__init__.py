@@ -1,8 +1,15 @@
 from cfk_tpu.ops.solve import (
     gather_gram,
     batched_spd_solve,
+    regularized_solve,
     als_half_step,
     init_factors,
 )
 
-__all__ = ["gather_gram", "batched_spd_solve", "als_half_step", "init_factors"]
+__all__ = [
+    "gather_gram",
+    "batched_spd_solve",
+    "regularized_solve",
+    "als_half_step",
+    "init_factors",
+]

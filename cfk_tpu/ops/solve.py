@@ -39,7 +39,7 @@ def gather_gram(
     tiles onto the MXU; padding rows contribute zero via the mask.
     """
     gathered = fixed_factors[neighbor_idx]  # [E, P, k]
-    gm = gathered * mask[..., None]
+    gm = gathered.astype(jnp.float32) * mask[..., None]
     # precision="highest": full-float32 MXU passes. The default bf16 passes
     # perturb the normal equations by ~1e-2 relative, which breaks parity
     # with the reference's float32 EJML math.
@@ -69,6 +69,21 @@ def batched_spd_solve(a: jax.Array, b: jax.Array) -> jax.Array:
     return x[..., 0]
 
 
+def regularized_solve(
+    a: jax.Array, b: jax.Array, count: jax.Array, lam: float
+) -> jax.Array:
+    """Apply ALS-WR regularization λ·n_ratings·I and solve.
+
+    The n floor at 1 keeps all-padding rows (n = 0) SPD; real rows always have
+    n ≥ 1 so their math is exact reference semantics
+    (``processors/MFeatureCalculator.java:91-95``).
+    """
+    k = a.shape[-1]
+    reg = lam * jnp.maximum(count.astype(jnp.float32), 1.0)
+    a = a + reg[:, None, None] * jnp.eye(k, dtype=a.dtype)
+    return batched_spd_solve(a, b)
+
+
 def _solve_chunk(
     fixed_factors: jax.Array,
     lam: float,
@@ -78,11 +93,7 @@ def _solve_chunk(
     count: jax.Array,
 ) -> jax.Array:
     a, b = gather_gram(fixed_factors, neighbor_idx, rating, mask)
-    k = fixed_factors.shape[-1]
-    # λ·n_ratings·I (ALS-WR); floor n at 1 so all-padding rows stay SPD.
-    reg = lam * jnp.maximum(count.astype(jnp.float32), 1.0)
-    a = a + reg[:, None, None] * jnp.eye(k, dtype=a.dtype)
-    return batched_spd_solve(a, b)
+    return regularized_solve(a, b, count, lam)
 
 
 def als_half_step(

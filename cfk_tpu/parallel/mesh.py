@@ -1,0 +1,45 @@
+"""Device mesh construction + sharding helpers.
+
+The reference's distribution fabric is N Kafka partitions with deterministic
+mod-N partitioners keeping state-store locality aligned with topic partitions
+(``producers/PureModPartitioner.java:17``, SURVEY.md §2.6).  Here the fabric
+is a 1-D ``jax.sharding.Mesh`` over the ``"shard"`` axis: entity rows are
+contiguously block-sharded over devices, and all cross-device traffic is XLA
+collectives over ICI (all_gather / ppermute), not message passing.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "shard"
+
+
+def make_mesh(num_shards: int, devices: list | None = None) -> Mesh:
+    """A 1-D mesh of ``num_shards`` devices on the ``"shard"`` axis."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < num_shards:
+        raise ValueError(
+            f"need {num_shards} devices, have {len(devices)} "
+            f"({[d.platform for d in devices[:3]]}...)"
+        )
+    return Mesh(np.array(devices[:num_shards]), (AXIS,))
+
+
+def shard_rows(mesh: Mesh, tree):
+    """Place a pytree of arrays with axis 0 sharded over the mesh."""
+    def put(x):
+        spec = P(AXIS, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, tree)
+
+
+def replicated(mesh: Mesh, tree):
+    """Place a pytree fully replicated over the mesh."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree
+    )

@@ -1,0 +1,253 @@
+"""SPMD sharded ALS: the distributed half-iteration as explicit collectives.
+
+The reference's per-iteration feature-exchange Kafka topics
+(``apps/ALSApp.java:115-151``) become one collective per half-iteration:
+
+- ``all_gather`` exchange — every shard receives the full fixed-side factor
+  matrix over ICI, then solves its local entities.  This is the all-to-all
+  join (reference's ``all-to-all-join`` branch, README.md:172) done right:
+  the OutBlock send-once-per-partition dedup
+  (``processors/MRatings2BlocksProcessor.java:63-65``) is exactly what
+  all_gather gives for free.
+
+- ``ring`` exchange — fixed-side factor *blocks* rotate around the shard ring
+  via ``ppermute``; each shard accumulates the partial Gram matrix of the
+  block it currently holds.  This is the block-to-block join
+  (README.md:152-157) as a systolic ring — the ring-attention-style pattern:
+  per-device memory stays O(F/S·k) instead of O(F·k), at the cost of S
+  pipeline steps whose compute hides the permute latency.
+
+The EOF barrier protocol of the reference (``processors/URatings2BlocksProcessor.java:56-63``)
+has no runtime analog here: bulk-synchronous SPMD steps *are* the barrier
+(SURVEY.md §2.6); the ingest-side protocol lives in ``cfk_tpu.transport``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import Dataset, PaddedBlocks, RingBlocks, build_ring_blocks
+from cfk_tpu.models.als import ALSModel
+from cfk_tpu.ops.solve import (
+    als_half_step,
+    gather_gram,
+    init_factors,
+    regularized_solve,
+)
+from cfk_tpu.parallel.mesh import AXIS
+
+
+def half_step_allgather(fixed_local, nb, rt, mk, cnt, *, lam, solve_chunk=None):
+    """Per-shard half-iteration with all_gather'd fixed factors.
+
+    Runs inside shard_map: all args are local shards (entity axis 0).
+    """
+    fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+    return als_half_step(fixed_full, nb, rt, mk, cnt, lam, solve_chunk=solve_chunk)
+
+
+def _gram_chunked(blk, nb_t, rt_t, mk_t, solve_chunk):
+    """gather_gram over entity chunks: bounds the [chunk, P_ring, k] gather."""
+    if solve_chunk is None or solve_chunk >= nb_t.shape[0]:
+        return gather_gram(blk, nb_t, rt_t, mk_t)
+    e = nb_t.shape[0]
+    if e % solve_chunk != 0:
+        raise ValueError(
+            f"local entity count {e} not divisible by solve_chunk {solve_chunk}"
+        )
+    n_chunks = e // solve_chunk
+    reshape = lambda x: x.reshape((n_chunks, solve_chunk) + x.shape[1:])
+    a, b = lax.map(
+        lambda c: gather_gram(blk, *c), (reshape(nb_t), reshape(rt_t), reshape(mk_t))
+    )
+    k = blk.shape[-1]
+    return a.reshape(e, k, k), b.reshape(e, k)
+
+
+def half_step_ring(fixed_local, nb, rt, mk, cnt, *, lam, num_shards, solve_chunk=None):
+    """Per-shard half-iteration accumulating Gram blocks around a ppermute ring.
+
+    ``nb/rt/mk`` are RingBlocks locals: [E_local, S, P_ring] with neighbor
+    indices local to the fixed shard that owns them.  At ring step r this
+    shard holds the factor block of fixed shard (my_index − r) mod S; the
+    final step's block is consumed without a trailing ppermute (S−1 transfers
+    per half-iteration, not S).
+    """
+    my = lax.axis_index(AXIS)
+    e = nb.shape[0]
+    k = fixed_local.shape[-1]
+    perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+
+    def gram_at(blk, r):
+        t = (my - r) % num_shards
+        return _gram_chunked(
+            blk,
+            jnp.take(nb, t, axis=1),
+            jnp.take(rt, t, axis=1),
+            jnp.take(mk, t, axis=1),
+            solve_chunk,
+        )
+
+    def body(r, carry):
+        a, b, blk = carry
+        ap, bp = gram_at(blk, r)
+        blk = lax.ppermute(blk, AXIS, perm)
+        return (a + ap, b + bp, blk)
+
+    # pvary: mark the zero accumulators device-varying so the fori_loop carry
+    # type matches the (varying) per-shard partial Gram sums.
+    a0 = lax.pvary(jnp.zeros((e, k, k), jnp.float32), AXIS)
+    b0 = lax.pvary(jnp.zeros((e, k), jnp.float32), AXIS)
+    a, b, blk = lax.fori_loop(0, num_shards - 1, body, (a0, b0, fixed_local))
+    ap, bp = gram_at(blk, num_shards - 1)
+    return regularized_solve(a + ap, b + bp, cnt, lam)
+
+
+# Both exchange layouts expose the same tree keys; "neighbor" holds dense
+# global indices for all_gather blocks, shard-local indices for ring blocks.
+def _padded_to_tree(blocks: PaddedBlocks) -> dict[str, np.ndarray]:
+    return {
+        "neighbor": blocks.neighbor_idx,
+        "rating": blocks.rating,
+        "mask": blocks.mask,
+        "count": blocks.count,
+    }
+
+
+def _ring_to_tree(blocks: RingBlocks) -> dict[str, np.ndarray]:
+    return {
+        "neighbor": blocks.neighbor_local,
+        "rating": blocks.rating,
+        "mask": blocks.mask,
+        "count": blocks.count,
+    }
+
+
+def _tree_specs(tree: dict[str, np.ndarray]) -> dict[str, P]:
+    return {
+        k: P(AXIS, *([None] * (v.ndim - 1))) for k, v in tree.items()
+    }
+
+
+def make_training_step(mesh: Mesh, config: ALSConfig, specs: dict[str, P]):
+    """Build the jittable one-full-iteration SPMD step (solve M, then U).
+
+    Returned ``step(u, m, mblocks, ublocks) -> (u, m)`` operates on
+    row-sharded global arrays; collectives are explicit inside shard_map.
+    """
+    if config.exchange == "all_gather":
+        half = functools.partial(
+            half_step_allgather, lam=config.lam, solve_chunk=config.solve_chunk
+        )
+    else:
+        half = functools.partial(
+            half_step_ring,
+            lam=config.lam,
+            num_shards=config.num_shards,
+            solve_chunk=config.solve_chunk,
+        )
+    dtype = jnp.dtype(config.dtype)
+
+    def iteration(u, m_unused, mblk, ublk):
+        del m_unused
+        m = half(u, mblk["neighbor"], mblk["rating"], mblk["mask"], mblk["count"])
+        # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI
+        # bytes and HBM); the Gram math upcasts to float32 internally.
+        m = m.astype(dtype)
+        u_new = half(m, ublk["neighbor"], ublk["rating"], ublk["mask"], ublk["count"])
+        return u_new.astype(dtype), m
+
+    return _shard_map(
+        iteration,
+        mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None), specs, specs),
+        out_specs=(P(AXIS, None), P(AXIS, None)),
+    )
+
+
+def train_als_sharded(dataset: Dataset, config: ALSConfig, mesh: Mesh) -> ALSModel:
+    """Multi-device ALS-WR over a 1-D mesh; semantics match ``train_als``."""
+    s = config.num_shards
+    if mesh.devices.size != s:
+        raise ValueError(f"mesh has {mesh.devices.size} devices, config.num_shards={s}")
+    for name, blocks in (("movie", dataset.movie_blocks), ("user", dataset.user_blocks)):
+        if blocks.padded_entities % s != 0:
+            raise ValueError(
+                f"{name}_blocks padded to {blocks.padded_entities} entities, not "
+                f"divisible by num_shards={s}; rebuild the Dataset with "
+                f"Dataset.from_coo(..., num_shards={s})"
+            )
+
+    if config.exchange == "all_gather":
+        mtree = _padded_to_tree(dataset.movie_blocks)
+        utree = _padded_to_tree(dataset.user_blocks)
+    else:
+        coo = dataset.coo_dense
+        mtree = _ring_to_tree(
+            build_ring_blocks(
+                coo.movie_raw, coo.user_raw, coo.rating,
+                dataset.movie_map.num_entities, dataset.user_map.num_entities,
+                num_shards=s, pad_multiple=config.pad_multiple,
+            )
+        )
+        utree = _ring_to_tree(
+            build_ring_blocks(
+                coo.user_raw, coo.movie_raw, coo.rating,
+                dataset.user_map.num_entities, dataset.movie_map.num_entities,
+                num_shards=s, pad_multiple=config.pad_multiple,
+            )
+        )
+
+    def put(tree):
+        return {
+            k: jax.device_put(
+                v,
+                NamedSharding(mesh, P(AXIS, *([None] * (v.ndim - 1)))),
+            )
+            for k, v in tree.items()
+        }
+
+    mtree = put(mtree)
+    utree = put(utree)
+
+    # Init outside shard_map: threefry values per row are independent of the
+    # padded row count, so 1-way and N-way runs start identically.
+    key = jax.random.PRNGKey(config.seed)
+    u_rating = jnp.asarray(dataset.user_blocks.rating)
+    u_mask = jnp.asarray(dataset.user_blocks.mask)
+    u_count = jnp.asarray(dataset.user_blocks.count)
+    dtype = jnp.dtype(config.dtype)
+    u0 = jax.jit(init_factors, static_argnames="rank")(
+        key, u_rating, u_mask, u_count, rank=config.rank
+    ).astype(dtype)
+    u0 = jax.device_put(u0, NamedSharding(mesh, P(AXIS, None)))
+    m0 = jax.device_put(
+        np.zeros((dataset.movie_blocks.padded_entities, config.rank), dtype),
+        NamedSharding(mesh, P(AXIS, None)),
+    )
+
+    step = jax.jit(
+        make_training_step(mesh, config, _tree_specs(mtree)), donate_argnums=(0, 1)
+    )
+    u, m = u0, m0
+    for _ in range(config.num_iterations):
+        u, m = step(u, m, mtree, utree)
+
+    return ALSModel(
+        user_factors=u,
+        movie_factors=m,
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+    )

@@ -5,7 +5,13 @@ reference maintains incrementally in its *Ratings2BlocksProcessors."""
 import numpy as np
 import pytest
 
-from cfk_tpu.data.blocks import Dataset, IdMap, RatingsCOO, build_padded_blocks
+from cfk_tpu.data.blocks import (
+    Dataset,
+    IdMap,
+    RatingsCOO,
+    build_padded_blocks,
+    build_ring_blocks,
+)
 
 
 def random_coo(rng, n_movies=37, n_users=23, nnz=400):
@@ -70,6 +76,26 @@ def test_padding_divisible(rng, num_shards):
     mb = ds.movie_blocks
     assert np.all(mb.mask[mb.num_entities :] == 0)
     assert np.all(mb.count[mb.num_entities :] == 0)
+
+
+def test_ring_blocks_cover_all_ratings(rng):
+    """Every rating appears exactly once across the ring rectangles, with its
+    global neighbor id recoverable as local + shard·Fs (pure numpy)."""
+    coo = random_coo(rng)
+    ds = Dataset.from_coo(coo, num_shards=4)
+    dcoo = ds.coo_dense
+    rb = build_ring_blocks(
+        dcoo.movie_raw, dcoo.user_raw, dcoo.rating,
+        ds.movie_map.num_entities, ds.user_map.num_entities, num_shards=4,
+    )
+    assert rb.mask.sum() == dcoo.num_ratings
+    e_idx, t_idx, p_idx = np.nonzero(rb.mask)
+    global_ids = rb.neighbor_local[e_idx, t_idx, p_idx] + t_idx * rb.fixed_shard_size
+    got = set(zip(e_idx.tolist(), global_ids.tolist(),
+                  rb.rating[e_idx, t_idx, p_idx].tolist()))
+    want = set(zip(dcoo.movie_raw.tolist(), dcoo.user_raw.tolist(),
+                   dcoo.rating.tolist()))
+    assert got == want
 
 
 def test_counts_match_bincount(rng):
