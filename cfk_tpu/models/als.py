@@ -75,27 +75,9 @@ def _train_loop(
 
     def one_iteration(_, carry):
         u, _ = carry
-        # Factors are stored in `dtype` (bfloat16 halves HBM traffic); the
-        # Gram accumulation upcasts to float32 inside gather_gram.
-        m = als_half_step(
-            u,
-            movie_blocks["neighbor_idx"],
-            movie_blocks["rating"],
-            movie_blocks["mask"],
-            movie_blocks["count"],
-            lam,
-            solve_chunk=solve_chunk,
-        ).astype(dt)
-        u_new = als_half_step(
-            m,
-            user_blocks["neighbor_idx"],
-            user_blocks["rating"],
-            user_blocks["mask"],
-            user_blocks["count"],
-            lam,
-            solve_chunk=solve_chunk,
-        ).astype(dt)
-        return (u_new, m)
+        return _iteration_body(
+            u, movie_blocks, user_blocks, lam=lam, solve_chunk=solve_chunk, dt=dt
+        )
 
     u_final, m_final = jax.lax.fori_loop(
         0, num_iterations, one_iteration, (u, m0)
@@ -103,19 +85,109 @@ def _train_loop(
     return u_final, m_final
 
 
-def train_als(dataset: Dataset, config: ALSConfig) -> ALSModel:
-    """Train ALS-WR on one device. Returns factors in ascending-id order."""
-    key = jax.random.PRNGKey(config.seed)
-    u, m = _train_loop(
-        key,
-        _blocks_to_device(dataset.movie_blocks),
-        _blocks_to_device(dataset.user_blocks),
-        rank=config.rank,
-        num_iterations=config.num_iterations,
-        lam=config.lam,
-        solve_chunk=config.solve_chunk,
-        dtype=config.dtype,
+def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt):
+    """One full iteration (solve M from U, then U from M) — the single source
+    of the per-iteration math for both the fused-loop and checkpointed paths.
+
+    Factors are stored in ``dt`` (bfloat16 halves HBM traffic); the Gram
+    accumulation upcasts to float32 inside gather_gram.
+    """
+    m = als_half_step(
+        u,
+        movie_blocks["neighbor_idx"],
+        movie_blocks["rating"],
+        movie_blocks["mask"],
+        movie_blocks["count"],
+        lam,
+        solve_chunk=solve_chunk,
+    ).astype(dt)
+    u_new = als_half_step(
+        m,
+        user_blocks["neighbor_idx"],
+        user_blocks["rating"],
+        user_blocks["mask"],
+        user_blocks["count"],
+        lam,
+        solve_chunk=solve_chunk,
+    ).astype(dt)
+    return u_new, m
+
+
+@functools.partial(
+    jax.jit, static_argnames=("lam", "solve_chunk", "dtype"), donate_argnums=(0,)
+)
+def _one_iteration(
+    u: jax.Array,
+    movie_blocks: dict[str, jax.Array],
+    user_blocks: dict[str, jax.Array],
+    *,
+    lam: float,
+    solve_chunk: int | None,
+    dtype: str,
+) -> tuple[jax.Array, jax.Array]:
+    return _iteration_body(
+        u, movie_blocks, user_blocks,
+        lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype),
     )
+
+
+def train_als(
+    dataset: Dataset,
+    config: ALSConfig,
+    *,
+    checkpoint_manager=None,
+    checkpoint_every: int = 1,
+) -> ALSModel:
+    """Train ALS-WR on one device. Returns factors in ascending-id order.
+
+    Without a checkpoint manager the whole loop runs as one fused
+    ``fori_loop`` program; with one, iterations are stepped from Python so
+    factors can be saved every ``checkpoint_every`` iterations and training
+    resumes from the latest step.
+    """
+    key = jax.random.PRNGKey(config.seed)
+    mblocks = _blocks_to_device(dataset.movie_blocks)
+    ublocks = _blocks_to_device(dataset.user_blocks)
+    if checkpoint_manager is None:
+        u, m = _train_loop(
+            key,
+            mblocks,
+            ublocks,
+            rank=config.rank,
+            num_iterations=config.num_iterations,
+            lam=config.lam,
+            solve_chunk=config.solve_chunk,
+            dtype=config.dtype,
+        )
+    else:
+        dt = jnp.dtype(config.dtype)
+        start_iter = 0
+        if checkpoint_manager.latest_iteration() is not None:
+            state = checkpoint_manager.restore()
+            if state.user_factors.shape[-1] != config.rank:
+                raise ValueError(
+                    f"checkpoint at iteration {state.iteration} has rank "
+                    f"{state.user_factors.shape[-1]}, config.rank={config.rank}; "
+                    "use a fresh checkpoint directory to change rank"
+                )
+            start_iter = state.iteration
+            u = jnp.asarray(state.user_factors, dtype=dt)
+            m = jnp.asarray(state.movie_factors, dtype=dt)
+        else:
+            u = init_factors(
+                key, ublocks["rating"], ublocks["mask"], ublocks["count"], config.rank
+            ).astype(dt)
+            m = jnp.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
+        for i in range(start_iter, config.num_iterations):
+            u, m = _one_iteration(
+                u, mblocks, ublocks,
+                lam=config.lam, solve_chunk=config.solve_chunk, dtype=config.dtype,
+            )
+            done = i + 1
+            if done % checkpoint_every == 0 or done == config.num_iterations:
+                checkpoint_manager.save(
+                    done, np.asarray(u), np.asarray(m), meta={"rank": config.rank}
+                )
     return ALSModel(
         user_factors=u,
         movie_factors=m,

@@ -1,0 +1,45 @@
+from cfk_tpu.transport.broker import InMemoryBroker, Record, Transport, mod_partition
+from cfk_tpu.transport.checkpoint import CheckpointManager, CheckpointState
+from cfk_tpu.transport.ingest import (
+    RATINGS_TOPIC,
+    IncompleteIngestError,
+    collect_ratings,
+    produce_ratings_file,
+)
+from cfk_tpu.transport.serdes import (
+    EOF_ID,
+    FeatureRecord,
+    IdRatingPair,
+    decode_feature,
+    decode_float_array,
+    decode_id_rating,
+    decode_int_list,
+    encode_feature,
+    encode_float_array,
+    encode_id_rating,
+    encode_int_list,
+)
+
+__all__ = [
+    "InMemoryBroker",
+    "Record",
+    "Transport",
+    "mod_partition",
+    "CheckpointManager",
+    "CheckpointState",
+    "RATINGS_TOPIC",
+    "IncompleteIngestError",
+    "collect_ratings",
+    "produce_ratings_file",
+    "EOF_ID",
+    "FeatureRecord",
+    "IdRatingPair",
+    "decode_feature",
+    "decode_float_array",
+    "decode_id_rating",
+    "decode_int_list",
+    "encode_feature",
+    "encode_float_array",
+    "encode_id_rating",
+    "encode_int_list",
+]

@@ -1,0 +1,124 @@
+"""Per-iteration factor checkpointing + resume.
+
+The reference's per-iteration Kafka topics (``user-features-i`` /
+``movie-features-i``, provisioned by ``setup.sh:18-21``) are *incidentally* a
+durable journal of every iteration's factors, but nothing ever reads them
+back; any crash restarts from scratch (``streams.cleanUp()``,
+``apps/BaseKafkaApp.java:36``; SURVEY.md §5).  This module makes that journal
+an explicit API: factor matrices are written per iteration with an atomic
+rename, and training resumes from the latest complete step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+_MANIFEST = "manifest.json"
+_STEP_PREFIX = "step_"
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointState:
+    iteration: int  # iterations fully completed
+    user_factors: np.ndarray
+    movie_factors: np.ndarray
+    meta: dict
+
+
+class CheckpointManager:
+    """Directory-of-steps checkpoint store with atomic per-step commits.
+
+    Layout: ``<dir>/step_0000007/{manifest.json,user.npy,movie.npy}``.
+    A step directory appears atomically (written to a temp dir, then renamed),
+    so a crash mid-write can never yield a half checkpoint — the property the
+    reference's in-memory, changelog-disabled stores lack (``apps/ALSApp.java:53-83``).
+    """
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _step_dir(self, iteration: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{iteration:07d}")
+
+    def save(
+        self,
+        iteration: int,
+        user_factors,
+        movie_factors,
+        meta: dict | None = None,
+    ) -> str:
+        u = np.asarray(user_factors)
+        m = np.asarray(movie_factors)
+        stored_dtype = str(u.dtype)
+        # npy can't round-trip ml_dtypes (bfloat16 loads back as raw void
+        # bytes) — store float32 on disk and re-cast at restore.
+        if u.dtype not in (np.float32, np.float64):
+            u = u.astype(np.float32)
+            m = m.astype(np.float32)
+        tmp = tempfile.mkdtemp(dir=self.directory, prefix=".tmp_")
+        try:
+            np.save(os.path.join(tmp, "user.npy"), u)
+            np.save(os.path.join(tmp, "movie.npy"), m)
+            manifest = {
+                "iteration": iteration,
+                "user_shape": list(u.shape),
+                "movie_shape": list(m.shape),
+                "dtype": stored_dtype,
+                **(meta or {}),
+            }
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump(manifest, f)
+            final = self._step_dir(iteration)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            return final
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    def iterations(self) -> list[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            if not name.startswith(_STEP_PREFIX):
+                continue
+            full = os.path.join(self.directory, name, _MANIFEST)
+            if os.path.exists(full):  # only complete (renamed) steps
+                steps.append(int(name[len(_STEP_PREFIX):]))
+        return sorted(steps)
+
+    def latest_iteration(self) -> int | None:
+        steps = self.iterations()
+        return steps[-1] if steps else None
+
+    def restore(self, iteration: int | None = None) -> CheckpointState:
+        if iteration is None:
+            iteration = self.latest_iteration()
+            if iteration is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        step = self._step_dir(iteration)
+        with open(os.path.join(step, _MANIFEST)) as f:
+            manifest = json.load(f)
+        u = np.load(os.path.join(step, "user.npy"))
+        m = np.load(os.path.join(step, "movie.npy"))
+        want_dtype = manifest.get("dtype", "float32")
+        if str(u.dtype) != want_dtype:
+            import ml_dtypes  # ships with jax
+
+            u = u.astype(np.dtype(getattr(ml_dtypes, want_dtype, want_dtype)))
+            m = m.astype(u.dtype)
+        meta = {
+            k: v
+            for k, v in manifest.items()
+            if k not in ("iteration", "user_shape", "movie_shape", "dtype")
+        }
+        return CheckpointState(
+            iteration=manifest["iteration"], user_factors=u, movie_factors=m, meta=meta
+        )

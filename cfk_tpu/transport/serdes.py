@@ -1,0 +1,124 @@
+"""Wire-format codecs, byte-compatible with the reference's hand-rolled serdes.
+
+The reference frames everything big-endian via ``DataOutputStream``
+(SURVEY.md §2.3) with no schema registry:
+
+- ``IdRatingPairMessage``: int32 id + int16 rating — 6 bytes
+  (``serdes/IdRatingPairMessage/IdRatingPairMessageSerializer.java:23-32``).
+  ``id == -1`` is the EOF control message and ``rating`` then carries the
+  sender's partition id (``processors/MRatings2BlocksProcessor.java:41``).
+- ``FeatureMessage``: int32 id ‖ int32 count + int32 dependentIds ‖
+  int32 len + float32 features
+  (``serdes/FeatureMessage/FeatureMessageSerializer.java:27-37``).
+- float[] : int32 length + float32s (``serdes/FloatArray/FloatArraySerializer.java:14-25``).
+- List<Integer>: int32 size + int32s (``serdes/List/ListSerializer.java``).
+
+Unlike the reference's deserializer — which derives the dependentIds length
+from a global NUM_FEATURES static
+(``serdes/FeatureMessage/FeatureMessageDeserializer.java:32-49``) — these
+codecs trust the embedded counts, so they decode any rank without globals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+EOF_ID = -1
+
+_ID_RATING = struct.Struct(">ih")  # int32 id, int16 rating
+_I32 = struct.Struct(">i")
+
+
+@dataclasses.dataclass(frozen=True)
+class IdRatingPair:
+    """A (id, rating) record; ``id == EOF_ID`` marks the EOF control message,
+    with ``rating`` carrying the sending partition index."""
+
+    id: int
+    rating: int
+
+    @property
+    def is_eof(self) -> bool:
+        return self.id == EOF_ID
+
+
+def encode_id_rating(msg: IdRatingPair) -> bytes:
+    return _ID_RATING.pack(msg.id, msg.rating)
+
+
+def decode_id_rating(data: bytes) -> IdRatingPair:
+    if len(data) != _ID_RATING.size:
+        raise ValueError(f"IdRatingPair frame must be 6 bytes, got {len(data)}")
+    id_, rating = _ID_RATING.unpack(data)
+    return IdRatingPair(id=id_, rating=rating)
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureRecord:
+    """A factor vector in flight, tagged with destination-side dependent rows
+    (the analog of ``messages/FeatureMessage.java:6-24`` — immutable here;
+    the reference mutates + re-forwards one object per target partition)."""
+
+    id: int
+    dependent_ids: tuple[int, ...]
+    features: np.ndarray  # float32 [k]
+
+
+def encode_feature(msg: FeatureRecord) -> bytes:
+    feats = np.ascontiguousarray(msg.features, dtype=">f4")
+    out = bytearray()
+    out += _I32.pack(msg.id)
+    out += _I32.pack(len(msg.dependent_ids))
+    out += np.asarray(msg.dependent_ids, dtype=">i4").tobytes()
+    out += _I32.pack(feats.shape[0])
+    out += feats.tobytes()
+    return bytes(out)
+
+
+def decode_feature(data: bytes) -> FeatureRecord:
+    off = 0
+    (id_,) = _I32.unpack_from(data, off)
+    off += 4
+    (ndep,) = _I32.unpack_from(data, off)
+    off += 4
+    if ndep < 0 or off + 4 * ndep > len(data):
+        raise ValueError(f"corrupt FeatureRecord: dependent count {ndep}")
+    dep = np.frombuffer(data, dtype=">i4", count=ndep, offset=off)
+    off += 4 * ndep
+    (nfeat,) = _I32.unpack_from(data, off)
+    off += 4
+    if nfeat < 0 or off + 4 * nfeat != len(data):
+        raise ValueError(f"corrupt FeatureRecord: feature count {nfeat}")
+    feats = np.frombuffer(data, dtype=">f4", count=nfeat, offset=off)
+    return FeatureRecord(
+        id=id_,
+        dependent_ids=tuple(int(x) for x in dep),
+        features=feats.astype(np.float32),
+    )
+
+
+def encode_float_array(arr: np.ndarray) -> bytes:
+    a = np.ascontiguousarray(arr, dtype=">f4")
+    return _I32.pack(a.shape[0]) + a.tobytes()
+
+
+def decode_float_array(data: bytes) -> np.ndarray:
+    (n,) = _I32.unpack_from(data, 0)
+    if n < 0 or 4 + 4 * n != len(data):
+        raise ValueError(f"corrupt float array frame: count {n}, {len(data)} bytes")
+    return np.frombuffer(data, dtype=">f4", count=n, offset=4).astype(np.float32)
+
+
+def encode_int_list(values) -> bytes:
+    a = np.asarray(list(values), dtype=">i4")
+    return _I32.pack(a.shape[0]) + a.tobytes()
+
+
+def decode_int_list(data: bytes) -> list[int]:
+    (n,) = _I32.unpack_from(data, 0)
+    if n < 0 or 4 + 4 * n != len(data):
+        raise ValueError(f"corrupt int list frame: count {n}, {len(data)} bytes")
+    return [int(x) for x in np.frombuffer(data, dtype=">i4", count=n, offset=4)]
