@@ -17,18 +17,25 @@ matrix factorization on Netflix-Prize-format data — expressed TPU-first:
 
 from cfk_tpu.config import ALSConfig
 from cfk_tpu.data.netflix import parse_netflix
-from cfk_tpu.data.blocks import IdMap, RatingsCOO, build_padded_blocks
+from cfk_tpu.data.movielens import parse_movielens_csv
+from cfk_tpu.data.blocks import Dataset, IdMap, RatingsCOO, build_padded_blocks
 from cfk_tpu.models.als import ALSModel, train_als
+from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
 
 __version__ = "0.1.0"
 
 __all__ = [
     "ALSConfig",
+    "IALSConfig",
     "parse_netflix",
+    "parse_movielens_csv",
+    "Dataset",
     "IdMap",
     "RatingsCOO",
     "build_padded_blocks",
     "ALSModel",
     "train_als",
+    "train_ials",
+    "train_ials_sharded",
     "__version__",
 ]

@@ -1,0 +1,44 @@
+"""MovieLens CSV ingest (ml-25m ``ratings.csv`` format).
+
+Grammar: optional header ``userId,movieId,rating,timestamp``, then rows
+``userId,movieId,rating,timestamp``.  Timestamps are ignored (like the
+reference ignores Netflix dates).  For the implicit-feedback pipeline the
+rating column is treated as interaction strength; ``min_rating`` lets the
+caller binarize/threshold (a common MovieLens-implicit protocol).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cfk_tpu.data.blocks import RatingsCOO
+
+
+def parse_movielens_csv(path: str, *, min_rating: float = 0.0) -> RatingsCOO:
+    users: list[int] = []
+    movies: list[int] = []
+    ratings: list[float] = []
+    with open(path, "r") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            if lineno == 1 and line.lower().startswith("userid"):
+                continue  # header
+            parts = line.split(",")
+            if len(parts) < 3:
+                raise ValueError(f"{path}:{lineno}: malformed line {line!r}")
+            try:
+                user, movie, rating = int(parts[0]), int(parts[1]), float(parts[2])
+            except ValueError as e:
+                raise ValueError(f"{path}:{lineno}: malformed line {line!r}") from e
+            if rating < min_rating:
+                continue
+            users.append(user)
+            movies.append(movie)
+            ratings.append(rating)
+    return RatingsCOO(
+        movie_raw=np.asarray(movies, dtype=np.int64),
+        user_raw=np.asarray(users, dtype=np.int64),
+        rating=np.asarray(ratings, dtype=np.float32),
+    )

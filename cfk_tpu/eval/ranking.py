@@ -1,0 +1,110 @@
+"""Ranking evaluation for the implicit model: leave-one-out Recall@K / MPR.
+
+The reference's only metric is observed-cell MSE (``scripts/calculate_mse.py``);
+implicit feedback needs ranking metrics instead — each held-out item is
+ranked among all items the user has NOT interacted with in training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cfk_tpu.data.blocks import RatingsCOO
+
+
+@dataclasses.dataclass(frozen=True)
+class Heldout:
+    user_dense: np.ndarray  # [n] dense user index
+    movie_dense: np.ndarray  # [n] dense movie index of the held-out item
+
+
+def leave_one_out_split(
+    movie_dense: np.ndarray,
+    user_dense: np.ndarray,
+    rating: np.ndarray,
+    *,
+    seed: int = 0,
+) -> tuple[RatingsCOO, Heldout]:
+    """Hold out one random interaction per user with ≥ 2 interactions.
+
+    Inputs are dense-index COO arrays; returns (train COO in dense indices,
+    heldout).  Users with a single interaction keep it in train, and an
+    interaction is only held out while its movie retains ≥ 2 interactions —
+    so every entity stays covered in train and the dense index space of a
+    Dataset built from ``train`` coincides with the full dataset's (holding
+    out a movie's last interaction would silently shift all later movie
+    indices and mis-align ranking evaluation).
+    """
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(user_dense.shape[0])
+    held_mask = np.zeros(user_dense.shape[0], dtype=bool)
+    user_counts = np.bincount(user_dense)
+    movie_counts = np.bincount(movie_dense)
+    seen: set[int] = set()
+    for idx in order:
+        u = int(user_dense[idx])
+        mv = int(movie_dense[idx])
+        if u not in seen and user_counts[u] >= 2 and movie_counts[mv] >= 2:
+            held_mask[idx] = True
+            seen.add(u)
+            movie_counts[mv] -= 1
+    train = RatingsCOO(
+        movie_raw=movie_dense[~held_mask].astype(np.int64),
+        user_raw=user_dense[~held_mask].astype(np.int64),
+        rating=rating[~held_mask].astype(np.float32),
+    )
+    heldout = Heldout(
+        user_dense=user_dense[held_mask].astype(np.int64),
+        movie_dense=movie_dense[held_mask].astype(np.int64),
+    )
+    return train, heldout
+
+
+def _ranks(
+    scores: np.ndarray,  # [num_users, num_movies]
+    train: RatingsCOO,  # dense-index COO of training interactions
+    heldout: Heldout,
+) -> np.ndarray:
+    """0-based rank of each held-out item among that user's non-train items."""
+    if train.user_raw.max(initial=-1) >= scores.shape[0] or train.movie_raw.max(
+        initial=-1
+    ) >= scores.shape[1]:
+        raise ValueError(
+            f"train indices exceed score matrix {scores.shape} — the model was "
+            "trained on a dataset with a different dense index space than the "
+            "split; build the split with leave_one_out_split so every entity "
+            "stays covered in train"
+        )
+    s = scores.copy()
+    s[train.user_raw, train.movie_raw] = -np.inf  # exclude seen items
+    held_scores = s[heldout.user_dense, heldout.movie_dense]
+    cand = s[heldout.user_dense]
+    better = (cand > held_scores[:, None]).sum(axis=1)
+    # Ties count half (excluding the held item's own cell) — otherwise a
+    # degenerate constant-score model would score a perfect ranking.
+    ties = (cand == held_scores[:, None]).sum(axis=1) - 1
+    return better + 0.5 * ties
+
+
+def recall_at_k(
+    scores: np.ndarray, train: RatingsCOO, heldout: Heldout, k: int = 10
+) -> float:
+    """Fraction of held-out items ranked in the user's top-K unseen items."""
+    if heldout.user_dense.size == 0:
+        raise ValueError("empty heldout set")
+    return float((_ranks(scores, train, heldout) < k).mean())
+
+
+def mean_percentile_rank(
+    scores: np.ndarray, train: RatingsCOO, heldout: Heldout
+) -> float:
+    """Hu et al.'s MPR ∈ [0, 1]; 0.5 = random, lower is better."""
+    if heldout.user_dense.size == 0:
+        raise ValueError("empty heldout set")
+    num_candidates = scores.shape[1] - np.bincount(
+        train.user_raw, minlength=scores.shape[0]
+    )[heldout.user_dense]
+    ranks = _ranks(scores, train, heldout)
+    return float((ranks / np.maximum(num_candidates - 1, 1)).mean())

@@ -1,3 +1,4 @@
 from cfk_tpu.models.als import ALSModel, train_als
+from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
 
-__all__ = ["ALSModel", "train_als"]
+__all__ = ["ALSModel", "train_als", "IALSConfig", "train_ials", "train_ials_sharded"]

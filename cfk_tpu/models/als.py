@@ -160,20 +160,21 @@ def train_als(
             dtype=config.dtype,
         )
     else:
+        from cfk_tpu.transport.checkpoint import resume_state, should_save
+
         dt = jnp.dtype(config.dtype)
-        start_iter = 0
-        if checkpoint_manager.latest_iteration() is not None:
-            state = checkpoint_manager.restore()
-            if state.user_factors.shape[-1] != config.rank:
-                raise ValueError(
-                    f"checkpoint at iteration {state.iteration} has rank "
-                    f"{state.user_factors.shape[-1]}, config.rank={config.rank}; "
-                    "use a fresh checkpoint directory to change rank"
-                )
+        state = resume_state(
+            checkpoint_manager,
+            rank=config.rank,
+            model="als",
+            num_iterations=config.num_iterations,
+        )
+        if state is not None:
             start_iter = state.iteration
             u = jnp.asarray(state.user_factors, dtype=dt)
             m = jnp.asarray(state.movie_factors, dtype=dt)
         else:
+            start_iter = 0
             u = init_factors(
                 key, ublocks["rating"], ublocks["mask"], ublocks["count"], config.rank
             ).astype(dt)
@@ -184,9 +185,10 @@ def train_als(
                 lam=config.lam, solve_chunk=config.solve_chunk, dtype=config.dtype,
             )
             done = i + 1
-            if done % checkpoint_every == 0 or done == config.num_iterations:
+            if should_save(done, checkpoint_every, config.num_iterations):
                 checkpoint_manager.save(
-                    done, np.asarray(u), np.asarray(m), meta={"rank": config.rank}
+                    done, np.asarray(u), np.asarray(m),
+                    meta={"rank": config.rank, "model": "als"},
                 )
     return ALSModel(
         user_factors=u,

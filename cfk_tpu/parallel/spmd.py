@@ -177,6 +177,22 @@ def make_training_step(mesh: Mesh, config: ALSConfig, specs: dict[str, P]):
     )
 
 
+def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) -> None:
+    """Catch layout mistakes with actionable errors before XLA sees them."""
+    s = config.num_shards
+    if mesh.devices.size != s:
+        raise ValueError(
+            f"mesh has {mesh.devices.size} devices, config.num_shards={s}"
+        )
+    for name, blocks in (("movie", dataset.movie_blocks), ("user", dataset.user_blocks)):
+        if blocks.padded_entities % s != 0:
+            raise ValueError(
+                f"{name}_blocks padded to {blocks.padded_entities} entities, not "
+                f"divisible by num_shards={s}; rebuild the Dataset with "
+                f"Dataset.from_coo(..., num_shards={s})"
+            )
+
+
 def train_als_sharded(
     dataset: Dataset,
     config: ALSConfig,
@@ -193,15 +209,7 @@ def train_als_sharded(
     journal — SURVEY.md §5 checkpoint/resume).
     """
     s = config.num_shards
-    if mesh.devices.size != s:
-        raise ValueError(f"mesh has {mesh.devices.size} devices, config.num_shards={s}")
-    for name, blocks in (("movie", dataset.movie_blocks), ("user", dataset.user_blocks)):
-        if blocks.padded_entities % s != 0:
-            raise ValueError(
-                f"{name}_blocks padded to {blocks.padded_entities} entities, not "
-                f"divisible by num_shards={s}; rebuild the Dataset with "
-                f"Dataset.from_coo(..., num_shards={s})"
-            )
+    validate_sharded_dataset(dataset, config, mesh)
 
     if config.exchange == "all_gather":
         mtree = _padded_to_tree(dataset.movie_blocks)
@@ -226,35 +234,36 @@ def train_als_sharded(
     mtree = shard_rows(mesh, mtree)
     utree = shard_rows(mesh, utree)
 
-    # Init outside shard_map: threefry values per row are independent of the
-    # padded row count, so 1-way and N-way runs start identically.
-    key = jax.random.PRNGKey(config.seed)
-    u_rating = jnp.asarray(dataset.user_blocks.rating)
-    u_mask = jnp.asarray(dataset.user_blocks.mask)
-    u_count = jnp.asarray(dataset.user_blocks.count)
-    dtype = jnp.dtype(config.dtype)
-    u0 = jax.jit(init_factors, static_argnames="rank")(
-        key, u_rating, u_mask, u_count, rank=config.rank
-    ).astype(dtype)
-    u0 = jax.device_put(u0, NamedSharding(mesh, P(AXIS, None)))
-    m0 = jax.device_put(
-        np.zeros((dataset.movie_blocks.padded_entities, config.rank), dtype),
-        NamedSharding(mesh, P(AXIS, None)),
-    )
+    from cfk_tpu.transport.checkpoint import resume_state, should_save
 
-    start_iter = 0
-    u, m = u0, m0
-    if checkpoint_manager is not None and checkpoint_manager.latest_iteration() is not None:
-        state = checkpoint_manager.restore()
-        if state.user_factors.shape[-1] != config.rank:
-            raise ValueError(
-                f"checkpoint at iteration {state.iteration} has rank "
-                f"{state.user_factors.shape[-1]}, config.rank={config.rank}; "
-                "use a fresh checkpoint directory to change rank"
-            )
+    dtype = jnp.dtype(config.dtype)
+    state = resume_state(
+        checkpoint_manager,
+        rank=config.rank,
+        model="als",
+        num_iterations=config.num_iterations,
+    )
+    if state is not None:
         start_iter = state.iteration
         u = shard_rows(mesh, state.user_factors.astype(dtype))
         m = shard_rows(mesh, state.movie_factors.astype(dtype))
+    else:
+        start_iter = 0
+        # Init outside shard_map: threefry values per row are independent of
+        # the padded row count, so 1-way and N-way runs start identically.
+        key = jax.random.PRNGKey(config.seed)
+        u = jax.jit(init_factors, static_argnames="rank")(
+            key,
+            jnp.asarray(dataset.user_blocks.rating),
+            jnp.asarray(dataset.user_blocks.mask),
+            jnp.asarray(dataset.user_blocks.count),
+            rank=config.rank,
+        ).astype(dtype)
+        u = jax.device_put(u, NamedSharding(mesh, P(AXIS, None)))
+        m = jax.device_put(
+            np.zeros((dataset.movie_blocks.padded_entities, config.rank), dtype),
+            NamedSharding(mesh, P(AXIS, None)),
+        )
 
     step = jax.jit(
         make_training_step(mesh, config, _tree_specs(mtree)), donate_argnums=(0, 1)
@@ -262,14 +271,18 @@ def train_als_sharded(
     for i in range(start_iter, config.num_iterations):
         u, m = step(u, m, mtree, utree)
         done = i + 1
-        if checkpoint_manager is not None and (
-            done % checkpoint_every == 0 or done == config.num_iterations
+        if checkpoint_manager is not None and should_save(
+            done, checkpoint_every, config.num_iterations
         ):
             checkpoint_manager.save(
                 done,
                 np.asarray(u),
                 np.asarray(m),
-                meta={"rank": config.rank, "exchange": config.exchange},
+                meta={
+                    "rank": config.rank,
+                    "exchange": config.exchange,
+                    "model": "als",
+                },
             )
 
     return ALSModel(

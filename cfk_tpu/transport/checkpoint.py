@@ -23,6 +23,51 @@ _MANIFEST = "manifest.json"
 _STEP_PREFIX = "step_"
 
 
+def resume_state(
+    manager: "CheckpointManager | None",
+    *,
+    rank: int,
+    model: str,
+    num_iterations: int,
+) -> "CheckpointState | None":
+    """Shared resume validation for every trainer.
+
+    Returns the latest state, or None when there is nothing to resume.
+    Rejects checkpoints whose rank or model family differs from the config,
+    and runs already past ``num_iterations`` (silently returning over-trained
+    factors as an N-iteration model would corrupt experiments).
+    """
+    if manager is None or manager.latest_iteration() is None:
+        return None
+    state = manager.restore()
+    if state.user_factors.shape[-1] != rank:
+        raise ValueError(
+            f"checkpoint at iteration {state.iteration} has rank "
+            f"{state.user_factors.shape[-1]}, config rank={rank}; "
+            "use a fresh checkpoint directory to change rank"
+        )
+    saved_model = state.meta.get("model", "als")
+    if saved_model != model:
+        raise ValueError(
+            f"checkpoint was written by model family {saved_model!r}, "
+            f"resuming as {model!r}; use a fresh checkpoint directory"
+        )
+    if state.iteration > num_iterations:
+        raise ValueError(
+            f"checkpoint is at iteration {state.iteration}, past the requested "
+            f"num_iterations={num_iterations}; restore() an earlier step "
+            "explicitly or use a fresh checkpoint directory"
+        )
+    return state
+
+
+def should_save(done: int, every: int, total: int) -> bool:
+    """Save cadence: every ``every`` completed iterations, and always at the end."""
+    if every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {every}")
+    return done % every == 0 or done == total
+
+
 @dataclasses.dataclass(frozen=True)
 class CheckpointState:
     iteration: int  # iterations fully completed

@@ -78,17 +78,25 @@ def encode_feature(msg: FeatureRecord) -> bytes:
     return bytes(out)
 
 
+def _read_i32(data: bytes, off: int, what: str) -> int:
+    """int32 read with a ValueError (not struct.error) on truncation, keeping
+    the module's corrupt-frame → ValueError contract for all decoders."""
+    if off + 4 > len(data):
+        raise ValueError(f"corrupt {what}: truncated at byte {off} of {len(data)}")
+    return _I32.unpack_from(data, off)[0]
+
+
 def decode_feature(data: bytes) -> FeatureRecord:
     off = 0
-    (id_,) = _I32.unpack_from(data, off)
+    id_ = _read_i32(data, off, "FeatureRecord")
     off += 4
-    (ndep,) = _I32.unpack_from(data, off)
+    ndep = _read_i32(data, off, "FeatureRecord")
     off += 4
     if ndep < 0 or off + 4 * ndep > len(data):
         raise ValueError(f"corrupt FeatureRecord: dependent count {ndep}")
     dep = np.frombuffer(data, dtype=">i4", count=ndep, offset=off)
     off += 4 * ndep
-    (nfeat,) = _I32.unpack_from(data, off)
+    nfeat = _read_i32(data, off, "FeatureRecord")
     off += 4
     if nfeat < 0 or off + 4 * nfeat != len(data):
         raise ValueError(f"corrupt FeatureRecord: feature count {nfeat}")
@@ -106,7 +114,7 @@ def encode_float_array(arr: np.ndarray) -> bytes:
 
 
 def decode_float_array(data: bytes) -> np.ndarray:
-    (n,) = _I32.unpack_from(data, 0)
+    n = _read_i32(data, 0, "float array frame")
     if n < 0 or 4 + 4 * n != len(data):
         raise ValueError(f"corrupt float array frame: count {n}, {len(data)} bytes")
     return np.frombuffer(data, dtype=">f4", count=n, offset=4).astype(np.float32)
@@ -118,7 +126,7 @@ def encode_int_list(values) -> bytes:
 
 
 def decode_int_list(data: bytes) -> list[int]:
-    (n,) = _I32.unpack_from(data, 0)
+    n = _read_i32(data, 0, "int list frame")
     if n < 0 or 4 + 4 * n != len(data):
         raise ValueError(f"corrupt int list frame: count {n}, {len(data)} bytes")
     return [int(x) for x in np.frombuffer(data, dtype=">i4", count=n, offset=4)]

@@ -204,6 +204,51 @@ def test_resume_matches_uninterrupted(tiny_dataset, tmp_path):
     np.testing.assert_allclose(resumed, straight, rtol=1e-5, atol=1e-5)
 
 
+def test_truncated_frames_raise_valueerror():
+    from cfk_tpu.transport import decode_feature as df
+
+    for data in (b"", b"\x00\x00", b"\x00\x00\x00\x01\x00"):
+        with pytest.raises(ValueError):  # never struct.error
+            df(data)
+    with pytest.raises(ValueError):
+        decode_float_array(b"")
+    with pytest.raises(ValueError):
+        decode_int_list(b"\x00")
+
+
+def test_over_trained_checkpoint_rejected(tiny_dataset, tmp_path):
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.models.als import train_als
+
+    mgr = CheckpointManager(str(tmp_path))
+    train_als(
+        tiny_dataset,
+        ALSConfig(rank=3, lam=0.05, num_iterations=5, seed=5),
+        checkpoint_manager=mgr,
+    )
+    with pytest.raises(ValueError, match="past the requested"):
+        train_als(
+            tiny_dataset,
+            ALSConfig(rank=3, lam=0.05, num_iterations=3, seed=5),
+            checkpoint_manager=mgr,
+        )
+
+
+def test_model_family_mismatch_rejected(tiny_dataset, tmp_path):
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.transport.checkpoint import resume_state
+
+    mgr = CheckpointManager(str(tmp_path))
+    train_als(
+        tiny_dataset,
+        ALSConfig(rank=3, lam=0.05, num_iterations=1, seed=5),
+        checkpoint_manager=mgr,
+    )
+    with pytest.raises(ValueError, match="model family"):
+        resume_state(mgr, rank=3, model="ials", num_iterations=5)
+
+
 def test_negative_key_requires_explicit_partition():
     with pytest.raises(ValueError, match="non-negative"):
         mod_partition(-2, 4)
