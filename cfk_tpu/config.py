@@ -44,6 +44,9 @@ class ALSConfig:
     # Entities-per-solve chunk; bounds the [chunk, max_nnz, rank] gather that
     # feeds the MXU. None = solve a whole shard at once.
     solve_chunk: int | None = None
+    # Batched k×k SPD solve backend: "cholesky" = XLA custom calls;
+    # "pallas" = lane-vectorized Gauss-Jordan TPU kernel (cfk_tpu.ops.pallas).
+    solver: Literal["cholesky", "pallas"] = "cholesky"
     # Pad ragged neighbor lists up to a multiple of this (MXU-friendly tiling).
     # Consumed wherever blocks are built from this config (ring-block builds,
     # CLI/bench dataset construction); pass it to Dataset.from_coo when
@@ -61,3 +64,5 @@ class ALSConfig:
             raise ValueError(f"lam must be >= 0, got {self.lam}")
         if self.exchange not in ("all_gather", "ring"):
             raise ValueError(f"unknown exchange {self.exchange!r}")
+        if self.solver not in ("cholesky", "pallas"):
+            raise ValueError(f"unknown solver {self.solver!r}")

@@ -54,7 +54,7 @@ def _blocks_to_device(blocks: PaddedBlocks) -> dict[str, jax.Array]:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype")
+    jax.jit, static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype", "solver")
 )
 def _train_loop(
     key: jax.Array,
@@ -66,6 +66,7 @@ def _train_loop(
     lam: float,
     solve_chunk: int | None,
     dtype: str = "float32",
+    solver: str = "cholesky",
 ) -> tuple[jax.Array, jax.Array]:
     dt = jnp.dtype(dtype)
     u = init_factors(
@@ -76,7 +77,8 @@ def _train_loop(
     def one_iteration(_, carry):
         u, _ = carry
         return _iteration_body(
-            u, movie_blocks, user_blocks, lam=lam, solve_chunk=solve_chunk, dt=dt
+            u, movie_blocks, user_blocks,
+            lam=lam, solve_chunk=solve_chunk, dt=dt, solver=solver,
         )
 
     u_final, m_final = jax.lax.fori_loop(
@@ -85,7 +87,8 @@ def _train_loop(
     return u_final, m_final
 
 
-def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt):
+def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
+                    solver="cholesky"):
     """One full iteration (solve M from U, then U from M) — the single source
     of the per-iteration math for both the fused-loop and checkpointed paths.
 
@@ -100,6 +103,7 @@ def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt):
         movie_blocks["count"],
         lam,
         solve_chunk=solve_chunk,
+        solver=solver,
     ).astype(dt)
     u_new = als_half_step(
         m,
@@ -109,12 +113,13 @@ def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt):
         user_blocks["count"],
         lam,
         solve_chunk=solve_chunk,
+        solver=solver,
     ).astype(dt)
     return u_new, m
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lam", "solve_chunk", "dtype"), donate_argnums=(0,)
+    jax.jit, static_argnames=("lam", "solve_chunk", "dtype", "solver"), donate_argnums=(0,)
 )
 def _one_iteration(
     u: jax.Array,
@@ -124,10 +129,11 @@ def _one_iteration(
     lam: float,
     solve_chunk: int | None,
     dtype: str,
+    solver: str = "cholesky",
 ) -> tuple[jax.Array, jax.Array]:
     return _iteration_body(
         u, movie_blocks, user_blocks,
-        lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype),
+        lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype), solver=solver,
     )
 
 
@@ -158,6 +164,7 @@ def train_als(
             lam=config.lam,
             solve_chunk=config.solve_chunk,
             dtype=config.dtype,
+            solver=config.solver,
         )
     else:
         from cfk_tpu.transport.checkpoint import resume_state, should_save
@@ -182,7 +189,8 @@ def train_als(
         for i in range(start_iter, config.num_iterations):
             u, m = _one_iteration(
                 u, mblocks, ublocks,
-                lam=config.lam, solve_chunk=config.solve_chunk, dtype=config.dtype,
+                lam=config.lam, solve_chunk=config.solve_chunk,
+                dtype=config.dtype, solver=config.solver,
             )
             done = i + 1
             if should_save(done, checkpoint_every, config.num_iterations):

@@ -53,10 +53,11 @@ class IALSConfig(ALSConfig):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("rank", "num_iterations", "lam", "alpha", "dtype")
+    jax.jit, static_argnames=("rank", "num_iterations", "lam", "alpha", "dtype", "solver")
 )
 def _train_loop(
-    key, movie_blocks, user_blocks, *, rank, num_iterations, lam, alpha, dtype
+    key, movie_blocks, user_blocks, *, rank, num_iterations, lam, alpha, dtype,
+    solver="cholesky",
 ):
     dt = jnp.dtype(dtype)
     u = init_factors(
@@ -68,11 +69,11 @@ def _train_loop(
         u, _ = carry
         m = ials_half_step(
             u, movie_blocks["neighbor_idx"], movie_blocks["rating"],
-            movie_blocks["mask"], lam, alpha,
+            movie_blocks["mask"], lam, alpha, solver=solver,
         ).astype(dt)
         u_new = ials_half_step(
             m, user_blocks["neighbor_idx"], user_blocks["rating"],
-            user_blocks["mask"], lam, alpha,
+            user_blocks["mask"], lam, alpha, solver=solver,
         ).astype(dt)
         return (u_new, m)
 
@@ -92,6 +93,7 @@ def train_ials(dataset: Dataset, config: IALSConfig) -> ALSModel:
         lam=config.lam,
         alpha=config.alpha,
         dtype=config.dtype,
+        solver=config.solver,
     )
     return ALSModel(
         user_factors=u,
@@ -114,7 +116,7 @@ def make_ials_training_step(mesh: Mesh, config: IALSConfig):
         fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
         return ials_half_step(
             fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
-            config.lam, config.alpha, gram=gram,
+            config.lam, config.alpha, gram=gram, solver=config.solver,
         ).astype(dt)
 
     def iteration(u, m_unused, mblk, ublk):
@@ -134,6 +136,9 @@ def make_ials_training_step(mesh: Mesh, config: IALSConfig):
         mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS, None), spec, spec),
         out_specs=(P(AXIS, None), P(AXIS, None)),
+        # vma checking must be off for interpret-mode pallas kernels (CPU
+        # tests); keep it on for the default cholesky path.
+        check_vma=config.solver != "pallas",
     )
 
 

@@ -1,0 +1,3 @@
+from cfk_tpu.ops.pallas.solve_kernel import PALLAS_MAX_RANK, gauss_solve_pallas
+
+__all__ = ["PALLAS_MAX_RANK", "gauss_solve_pallas"]

@@ -114,6 +114,7 @@ def ials_half_step(
     alpha: float,
     *,
     gram: jax.Array | None = None,  # precomputed YᵀY (pass psum'd under SPMD)
+    solver: str = "cholesky",
 ) -> jax.Array:
     """Solve all entities of one side for implicit feedback.
 
@@ -125,11 +126,34 @@ def ials_half_step(
         gram = global_gram(fixed_factors)
     a_obs, b = gather_gram_implicit(fixed_factors, neighbor_idx, alpha * rating, mask)
     a = gram[None] + a_obs + lam * jnp.eye(k, dtype=jnp.float32)[None]
-    return batched_spd_solve(a, b)
+    return dispatch_spd_solve(a, b, solver)
+
+
+def dispatch_spd_solve(a: jax.Array, b: jax.Array, solver: str) -> jax.Array:
+    """Solve batched SPD systems with the selected backend.
+
+    ``"cholesky"`` — XLA's cholesky + triangular solves.
+    ``"pallas"``   — lane-vectorized Gauss-Jordan TPU kernel
+                     (``cfk_tpu.ops.pallas``); interpret-mode off TPU.
+
+    The pallas path pays an explicit [E,k,k] → [k,k,E] transpose to put the
+    batch in the lane dimension; ranks above the kernel's VMEM budget (k > 64)
+    fall back to cholesky.
+    """
+    if solver == "cholesky":
+        return batched_spd_solve(a, b)
+    if solver == "pallas":
+        from cfk_tpu.ops.pallas import PALLAS_MAX_RANK, gauss_solve_pallas
+
+        if a.shape[-1] > PALLAS_MAX_RANK:
+            return batched_spd_solve(a, b)
+        x = gauss_solve_pallas(jnp.transpose(a, (1, 2, 0)), b.T)
+        return x.T
+    raise ValueError(f"unknown solver {solver!r}")
 
 
 def regularized_solve(
-    a: jax.Array, b: jax.Array, count: jax.Array, lam: float
+    a: jax.Array, b: jax.Array, count: jax.Array, lam: float, solver: str = "cholesky"
 ) -> jax.Array:
     """Apply ALS-WR regularization λ·n_ratings·I and solve.
 
@@ -140,7 +164,7 @@ def regularized_solve(
     k = a.shape[-1]
     reg = lam * jnp.maximum(count.astype(jnp.float32), 1.0)
     a = a + reg[:, None, None] * jnp.eye(k, dtype=a.dtype)
-    return batched_spd_solve(a, b)
+    return dispatch_spd_solve(a, b, solver)
 
 
 def _solve_chunk(
@@ -150,9 +174,10 @@ def _solve_chunk(
     rating: jax.Array,
     mask: jax.Array,
     count: jax.Array,
+    solver: str = "cholesky",
 ) -> jax.Array:
     a, b = gather_gram(fixed_factors, neighbor_idx, rating, mask)
-    return regularized_solve(a, b, count, lam)
+    return regularized_solve(a, b, count, lam, solver)
 
 
 def als_half_step(
@@ -164,6 +189,7 @@ def als_half_step(
     lam: float,
     *,
     solve_chunk: Optional[int] = None,
+    solver: str = "cholesky",
 ) -> jax.Array:
     """One ALS half-iteration: solve all [E] entities against fixed factors.
 
@@ -171,7 +197,9 @@ def als_half_step(
     scanning over entity chunks (E must divide evenly; callers pad).
     """
     if solve_chunk is None or solve_chunk >= neighbor_idx.shape[0]:
-        return _solve_chunk(fixed_factors, lam, neighbor_idx, rating, mask, count)
+        return _solve_chunk(
+            fixed_factors, lam, neighbor_idx, rating, mask, count, solver
+        )
 
     e = neighbor_idx.shape[0]
     if e % solve_chunk != 0:
@@ -180,7 +208,7 @@ def als_half_step(
 
     def body(_, chunk):
         ni, r, m, c = chunk
-        return None, _solve_chunk(fixed_factors, lam, ni, r, m, c)
+        return None, _solve_chunk(fixed_factors, lam, ni, r, m, c, solver)
 
     reshape = lambda x: x.reshape((n_chunks, solve_chunk) + x.shape[1:])
     _, out = lax.scan(
