@@ -1,0 +1,162 @@
+"""ctypes bindings to the native ingest/codec library (``native/``).
+
+The shared library is optional: ``available()`` is False until
+``make -C native`` has produced ``libcfk_native.so`` (or ``build()`` is
+called), and every caller falls back to the pure-Python implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+from cfk_tpu.data.blocks import RatingsCOO
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libcfk_native.so"))
+_IO_ERROR = -0x7FFFFFFF
+
+_lib: ctypes.CDLL | None = None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_longlong
+    lib.cfk_parse_netflix.restype = i64
+    lib.cfk_parse_netflix.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_float),
+        i64,
+    ]
+    lib.cfk_parse_movielens.restype = i64
+    lib.cfk_parse_movielens.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_float),
+        i64,
+        ctypes.c_float,
+    ]
+    lib.cfk_encode_id_rating_batch.restype = None
+    lib.cfk_encode_id_rating_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int16),
+        i64,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.cfk_decode_id_rating_batch.restype = i64
+    lib.cfk_decode_id_rating_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        i64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int16),
+    ]
+    lib.cfk_native_abi_version.restype = ctypes.c_int
+    lib.cfk_native_abi_version.argtypes = []
+    return lib
+
+
+def _try_load() -> None:
+    global _lib
+    if _lib is not None or not os.path.exists(_LIB_PATH):
+        return
+    try:
+        lib = _bind(ctypes.CDLL(_LIB_PATH))
+        if lib.cfk_native_abi_version() == 1:
+            _lib = lib
+    except (OSError, AttributeError):
+        # AttributeError = stale .so missing a symbol; fall back to Python.
+        _lib = None
+
+
+_try_load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the shared library with make; returns availability."""
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            check=True,
+            capture_output=quiet,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    _try_load()
+    return available()
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _parse(fn, path: str, *extra) -> RatingsCOO:
+    assert _lib is not None
+    null64 = ctypes.POINTER(ctypes.c_longlong)()
+    nullf = ctypes.POINTER(ctypes.c_float)()
+    n = fn(path.encode(), null64, null64, nullf, 0, *extra)
+    if n == _IO_ERROR:
+        raise OSError(f"cannot read {path}")
+    if n < 0:
+        raise ValueError(f"{path}:{-n}: malformed line")
+    movie = np.empty(n, dtype=np.int64)
+    user = np.empty(n, dtype=np.int64)
+    rating = np.empty(n, dtype=np.float32)
+    n2 = fn(
+        path.encode(),
+        _ptr(movie, ctypes.c_longlong),
+        _ptr(user, ctypes.c_longlong),
+        _ptr(rating, ctypes.c_float),
+        n,
+        *extra,
+    )
+    if n2 != n:
+        raise RuntimeError(f"{path}: changed during parse ({n} vs {n2} records)")
+    return RatingsCOO(movie_raw=movie, user_raw=user, rating=rating)
+
+
+def parse_netflix(path: str) -> RatingsCOO:
+    return _parse(_lib.cfk_parse_netflix, path)
+
+
+def parse_movielens(path: str, min_rating: float = 0.0) -> RatingsCOO:
+    return _parse(_lib.cfk_parse_movielens, path, ctypes.c_float(min_rating))
+
+
+def encode_id_rating_batch(ids: np.ndarray, ratings: np.ndarray) -> bytes:
+    """Encode n (id, rating) pairs into n 6-byte big-endian wire frames."""
+    assert _lib is not None
+    ids32 = np.ascontiguousarray(ids, dtype=np.int32)
+    r16 = np.ascontiguousarray(ratings, dtype=np.int16)
+    out = np.empty(ids32.shape[0] * 6, dtype=np.uint8)
+    _lib.cfk_encode_id_rating_batch(
+        _ptr(ids32, ctypes.c_int32), _ptr(r16, ctypes.c_int16),
+        ids32.shape[0], _ptr(out, ctypes.c_uint8),
+    )
+    return out.tobytes()
+
+
+def decode_id_rating_batch(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Decode concatenated 6-byte frames → (ids int32, ratings int16)."""
+    assert _lib is not None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if buf.shape[0] % 6 != 0:
+        raise ValueError(f"frame stream length {buf.shape[0]} not a multiple of 6")
+    n = buf.shape[0] // 6
+    ids = np.empty(n, dtype=np.int32)
+    ratings = np.empty(n, dtype=np.int16)
+    got = _lib.cfk_decode_id_rating_batch(
+        _ptr(buf, ctypes.c_uint8), buf.shape[0],
+        _ptr(ids, ctypes.c_int32), _ptr(ratings, ctypes.c_int16),
+    )
+    if got != n:
+        raise ValueError("corrupt frame stream")
+    return ids, ratings

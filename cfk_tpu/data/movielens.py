@@ -15,6 +15,17 @@ from cfk_tpu.data.blocks import RatingsCOO
 
 
 def parse_movielens_csv(path: str, *, min_rating: float = 0.0) -> RatingsCOO:
+    try:
+        from cfk_tpu.data import _native
+
+        if _native.available():
+            return _native.parse_movielens(path, min_rating)
+    except ImportError:
+        pass
+    return parse_movielens_csv_python(path, min_rating=min_rating)
+
+
+def parse_movielens_csv_python(path: str, *, min_rating: float = 0.0) -> RatingsCOO:
     users: list[int] = []
     movies: list[int] = []
     ratings: list[float] = []

@@ -32,6 +32,7 @@ from cfk_tpu.data.blocks import Dataset
 from cfk_tpu.models.als import ALSModel, _blocks_to_device
 from cfk_tpu.ops.solve import global_gram, ials_half_step, init_factors
 from cfk_tpu.parallel.mesh import AXIS, shard_rows
+from cfk_tpu.parallel.spmd import use_check_vma
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,9 +137,7 @@ def make_ials_training_step(mesh: Mesh, config: IALSConfig):
         mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS, None), spec, spec),
         out_specs=(P(AXIS, None), P(AXIS, None)),
-        # vma checking must be off for interpret-mode pallas kernels (CPU
-        # tests); keep it on for the default cholesky path.
-        check_vma=config.solver != "pallas",
+        check_vma=use_check_vma(config),
     )
 
 

@@ -148,6 +148,15 @@ def _tree_specs(tree: dict[str, np.ndarray]) -> dict[str, P]:
     }
 
 
+def use_check_vma(config: ALSConfig) -> bool:
+    """shard_map's varying-mesh-axes checker guards collective placement
+    (e.g. the ring path's pvary), so keep it on whenever possible.  The one
+    case it must be off: interpret-mode pallas kernels (CPU tests), whose
+    interpreted jaxprs mix invariant constants with varying operands.  On
+    real TPU the compiled kernel carries an explicit vma tag and passes."""
+    return config.solver != "pallas" or jax.default_backend() == "tpu"
+
+
 def make_training_step(mesh: Mesh, config: ALSConfig, specs: dict[str, P]):
     """Build the jittable one-full-iteration SPMD step (solve M, then U).
 
@@ -185,11 +194,7 @@ def make_training_step(mesh: Mesh, config: ALSConfig, specs: dict[str, P]):
         mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS, None), specs, specs),
         out_specs=(P(AXIS, None), P(AXIS, None)),
-        # Interpret-mode pallas kernels (CPU tests) mix invariant constants
-        # with device-varying operands, which the vma checker rejects — so it
-        # is off only for solver="pallas"; the cholesky default keeps the
-        # checker (it guards the ring path's pvary placement).
-        check_vma=config.solver != "pallas",
+        check_vma=use_check_vma(config),
     )
 
 

@@ -1,0 +1,228 @@
+// Native ingest + wire-codec library for cfk_tpu.
+//
+// The reference has no native components (SURVEY.md §2: pure Java + one
+// Python script); this library is the framework's runtime-side native layer:
+// a single-pass Netflix-format parser (the role of
+// producers/NetflixDataFormatProducer.java's per-line Java loop), a MovieLens
+// CSV parser, and batch big-endian codecs for the 6-byte id+rating wire
+// frames (serdes layout of serdes/IdRatingPairMessage/*.java).
+//
+// C ABI only — loaded from Python via ctypes (no pybind11 in the image).
+// Error convention: functions returning long return >= 0 on success and
+// -lineno on a malformed input line (mirrors the Python parser's
+// "path:lineno" ValueError).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  ~FileBuf() { std::free(data); }
+  bool read(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (n < 0) {
+      std::fclose(f);
+      return false;
+    }
+    data = static_cast<char*>(std::malloc(n + 1));
+    if (!data) {
+      std::fclose(f);
+      return false;
+    }
+    size = std::fread(data, 1, n, f);
+    data[size] = '\0';
+    std::fclose(f);
+    return true;
+  }
+};
+
+// Parse a non-negative decimal integer; advances *p. Returns false if no
+// digits were consumed.
+inline bool parse_uint(const char*& p, const char* end, long long* out) {
+  const char* start = p;
+  long long v = 0;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10 + (*p - '0');
+    ++p;
+  }
+  if (p == start) return false;
+  *out = v;
+  return true;
+}
+
+// Parse a non-negative decimal float (digits[.digits]) bounded by `end` —
+// never reads past the line like strtod would. Advances *p.
+inline bool parse_ufloat(const char*& p, const char* end, double* out) {
+  long long ip = 0;
+  const char* start = p;
+  while (p < end && *p >= '0' && *p <= '9') {
+    ip = ip * 10 + (*p - '0');
+    ++p;
+  }
+  bool any = p != start;
+  double v = static_cast<double>(ip);
+  if (p < end && *p == '.') {
+    ++p;
+    double scale = 0.1;
+    const char* fstart = p;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v += (*p - '0') * scale;
+      scale *= 0.1;
+      ++p;
+    }
+    any = any || p != fstart;
+  }
+  if (!any) return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Netflix format: "movieId:" header lines, "userId,rating,date" rows.
+// Pass movie/user/rating == nullptr (cap 0) to count; otherwise fills up to
+// cap entries. Returns number of ratings, or -lineno on malformed input
+// (including a rating row before any header), or -0x7fffffff on I/O error.
+long long cfk_parse_netflix(const char* path, long long* movie, long long* user,
+                            float* rating, long long cap) {
+  FileBuf buf;
+  if (!buf.read(path)) return -0x7fffffffLL;
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  long long current_movie = -1;
+  long long count = 0;
+  long long lineno = 0;
+  while (p < end) {
+    ++lineno;
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    const char* q = p;
+    const char* qe = line_end;
+    while (qe > q && (qe[-1] == '\r' || qe[-1] == ' ' || qe[-1] == '\t')) --qe;
+    while (q < qe && (*q == ' ' || *q == '\t')) ++q;
+    if (q == qe) {  // blank line
+      p = line_end + 1;
+      continue;
+    }
+    long long v;
+    const char* r = q;
+    if (!parse_uint(r, qe, &v)) return -lineno;
+    if (r < qe && *r == ':') {
+      if (r + 1 != qe) return -lineno;
+      current_movie = v;
+    } else {
+      if (current_movie < 0) return -lineno;  // rating row before header
+      if (r >= qe || *r != ',') return -lineno;
+      ++r;
+      long long rat;
+      if (!parse_uint(r, qe, &rat)) return -lineno;
+      if (r >= qe || *r != ',') return -lineno;  // date must be present
+      if (count < cap && movie && user && rating) {
+        movie[count] = current_movie;
+        user[count] = v;
+        rating[count] = static_cast<float>(rat);
+      }
+      ++count;
+    }
+    p = line_end + 1;
+  }
+  return count;
+}
+
+// MovieLens CSV: optional "userId,..." header, rows userId,movieId,rating,ts.
+// min_rating filters; same count/fill + -lineno conventions.
+long long cfk_parse_movielens(const char* path, long long* movie,
+                              long long* user, float* rating, long long cap,
+                              float min_rating) {
+  FileBuf buf;
+  if (!buf.read(path)) return -0x7fffffffLL;
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  long long count = 0;
+  long long lineno = 0;
+  while (p < end) {
+    ++lineno;
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    const char* q = p;
+    const char* qe = line_end;
+    while (qe > q && (qe[-1] == '\r' || qe[-1] == ' ')) --qe;
+    while (q < qe && *q == ' ') ++q;
+    if (q == qe) {
+      p = line_end + 1;
+      continue;
+    }
+    if (lineno == 1 && (*q == 'u' || *q == 'U')) {  // header
+      p = line_end + 1;
+      continue;
+    }
+    long long uid, mid;
+    const char* r = q;
+    if (!parse_uint(r, qe, &uid) || r >= qe || *r != ',') return -lineno;
+    ++r;
+    if (!parse_uint(r, qe, &mid) || r >= qe || *r != ',') return -lineno;
+    ++r;
+    double rat;
+    if (!parse_ufloat(r, qe, &rat)) return -lineno;
+    // Rating must be followed by the timestamp separator or end the line —
+    // trailing garbage ("3.5abc") is malformed, like the Python parser says.
+    if (r != qe && *r != ',') return -lineno;
+    if (rat >= min_rating) {
+      if (count < cap && movie && user && rating) {
+        movie[count] = mid;
+        user[count] = uid;
+        rating[count] = static_cast<float>(rat);
+      }
+      ++count;
+    }
+    p = line_end + 1;
+  }
+  return count;
+}
+
+// Batch-encode n (id, rating) pairs as 6-byte big-endian frames.
+void cfk_encode_id_rating_batch(const int32_t* ids, const int16_t* ratings,
+                                long long n, uint8_t* out) {
+  for (long long i = 0; i < n; ++i) {
+    uint32_t id = static_cast<uint32_t>(ids[i]);
+    uint16_t rt = static_cast<uint16_t>(ratings[i]);
+    uint8_t* o = out + i * 6;
+    o[0] = id >> 24;
+    o[1] = id >> 16;
+    o[2] = id >> 8;
+    o[3] = id;
+    o[4] = rt >> 8;
+    o[5] = rt;
+  }
+}
+
+// Batch-decode n 6-byte frames. Returns n, or -1 if nbytes != 6*n.
+long long cfk_decode_id_rating_batch(const uint8_t* in, long long nbytes,
+                                     int32_t* ids, int16_t* ratings) {
+  if (nbytes % 6 != 0) return -1;
+  long long n = nbytes / 6;
+  for (long long i = 0; i < n; ++i) {
+    const uint8_t* o = in + i * 6;
+    ids[i] = static_cast<int32_t>((uint32_t(o[0]) << 24) | (uint32_t(o[1]) << 16) |
+                                  (uint32_t(o[2]) << 8) | uint32_t(o[3]));
+    ratings[i] = static_cast<int16_t>((uint16_t(o[4]) << 8) | uint16_t(o[5]));
+  }
+  return n;
+}
+
+int cfk_native_abi_version() { return 1; }
+
+}  // extern "C"

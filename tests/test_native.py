@@ -1,0 +1,88 @@
+"""Native C++ library tests: build it, then require exact parity with the
+pure-Python parsers and serdes (same arrays, same bytes, same errors)."""
+
+import numpy as np
+import pytest
+
+from cfk_tpu.data import _native
+from cfk_tpu.data.movielens import parse_movielens_csv_python
+from cfk_tpu.data.netflix import parse_netflix_python
+from cfk_tpu.transport.serdes import IdRatingPair, encode_id_rating
+
+
+@pytest.fixture(scope="session", autouse=True)
+def native_lib():
+    if not _native.available() and not _native.build():
+        pytest.skip("native library unavailable (no g++/make)")
+
+
+TINY = "/root/reference/data/data_sample_tiny.txt"
+
+
+def test_netflix_parity():
+    py = parse_netflix_python(TINY)
+    nat = _native.parse_netflix(TINY)
+    np.testing.assert_array_equal(py.movie_raw, nat.movie_raw)
+    np.testing.assert_array_equal(py.user_raw, nat.user_raw)
+    np.testing.assert_array_equal(py.rating, nat.rating)
+
+
+def test_netflix_errors(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("1,5,2005-01-01\n")  # rating before header
+    with pytest.raises(ValueError, match=":1"):
+        _native.parse_netflix(str(p))
+    p.write_text("1:\ngarbage\n")
+    with pytest.raises(ValueError, match=":2"):
+        _native.parse_netflix(str(p))
+    with pytest.raises(OSError):
+        _native.parse_netflix(str(tmp_path / "missing.txt"))
+
+
+def test_movielens_parity(tmp_path):
+    p = tmp_path / "ratings.csv"
+    p.write_text(
+        "userId,movieId,rating,timestamp\n"
+        "1,10,4.0,100\n1,20,2.5,101\n2,10,5.0,102\n"
+    )
+    for thresh in (0.0, 3.0):
+        py = parse_movielens_csv_python(str(p), min_rating=thresh)
+        nat = _native.parse_movielens(str(p), thresh)
+        np.testing.assert_array_equal(py.movie_raw, nat.movie_raw)
+        np.testing.assert_array_equal(py.user_raw, nat.user_raw)
+        np.testing.assert_allclose(py.rating, nat.rating)
+
+
+def test_movielens_malformed_rows_rejected(tmp_path):
+    """The bounded float parser must reject what Python rejects — no strtod
+    reading past the line end."""
+    for bad in ("1,2,\n", "1,2,3.5abc,100\n", "1,,4.0,100\n"):
+        p = tmp_path / "bad.csv"
+        p.write_text("userId,movieId,rating,timestamp\n" + bad)
+        with pytest.raises(ValueError, match=":2"):
+            _native.parse_movielens(str(p), 0.0)
+
+
+def test_batch_codec_byte_parity(rng):
+    ids = rng.integers(-1, 2**31 - 1, size=200).astype(np.int32)
+    rts = rng.integers(-1, 6, size=200).astype(np.int16)
+    blob = _native.encode_id_rating_batch(ids, rts)
+    want = b"".join(
+        encode_id_rating(IdRatingPair(int(i), int(r))) for i, r in zip(ids, rts)
+    )
+    assert blob == want
+    di, dr = _native.decode_id_rating_batch(blob)
+    np.testing.assert_array_equal(di, ids)
+    np.testing.assert_array_equal(dr, rts)
+
+
+def test_batch_decode_rejects_ragged():
+    with pytest.raises(ValueError, match="multiple of 6"):
+        _native.decode_id_rating_batch(b"\x00" * 7)
+
+
+def test_dispatchers_use_native():
+    from cfk_tpu.data.netflix import parse_netflix
+
+    nat = parse_netflix(TINY)  # goes through the native path when available
+    assert nat.num_ratings == 3415
