@@ -1,0 +1,271 @@
+"""Command-line interface.
+
+Three subcommands:
+
+- ``run`` — reference-compatible positional form, mirroring
+  ``apps/ALSAppRunner.java:16-28`` / README.md:35 of the reference:
+  ``NUM_PARTITIONS NUM_FEATURES LAMBDA NUM_ITERATIONS PATH NUM_MOVIES
+  NUM_USERS``.  Entity counts are *derived from the data* here; the passed
+  NUM_MOVIES/NUM_USERS are cross-checked and warned about on mismatch
+  (the reference trusts them blindly and mis-sizes its collector if wrong).
+- ``train`` — full-flag form: explicit or implicit model, sharding,
+  exchange strategy, solver backend, checkpointing, profiling.
+- ``evaluate`` — offline MSE/RMSE of a prediction CSV against a ratings
+  file: the (fixed) replacement for ``scripts/calculate_mse.py`` (which
+  reads uninitialized ``np.empty`` memory and can print nan).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _eprint(*args) -> None:
+    print(*args, file=sys.stderr)
+
+
+def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple):
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.movielens import parse_movielens_csv
+    from cfk_tpu.data.netflix import parse_netflix
+
+    if fmt == "netflix":
+        coo = parse_netflix(path)
+    else:
+        coo = parse_movielens_csv(path, min_rating=min_rating)
+    return coo, Dataset.from_coo(coo, num_shards=num_shards, pad_multiple=pad_multiple)
+
+
+def _train(args) -> int:
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.eval.predict import save_prediction_csv
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+    from cfk_tpu.utils.metrics import Metrics, maybe_profile
+
+    metrics = Metrics()
+    with metrics.phase("ingest"):
+        coo, ds = _load_dataset(
+            args.data, args.format, args.min_rating, args.shards, args.pad_multiple
+        )
+    common = dict(
+        rank=args.rank,
+        lam=args.lam,
+        num_iterations=args.iterations,
+        seed=args.seed,
+        num_shards=args.shards,
+        exchange=args.exchange,
+        dtype=args.dtype,
+        solver=args.solver,
+        solve_chunk=args.solve_chunk,
+        pad_multiple=args.pad_multiple,
+    )
+    manager = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
+    ck = dict(checkpoint_manager=manager, checkpoint_every=args.checkpoint_every)
+
+    with maybe_profile(args.profile_dir):
+        if args.implicit:
+            config = IALSConfig(alpha=args.alpha, **common)
+            if args.shards > 1:
+                from cfk_tpu.parallel.mesh import make_mesh
+
+                model = train_ials_sharded(
+                    ds, config, make_mesh(args.shards), metrics=metrics, **ck
+                )
+            else:
+                if manager is not None:
+                    _eprint("note: --checkpoint-dir ignored for single-shard iALS")
+                model = train_ials(ds, config, metrics=metrics)
+        else:
+            config = ALSConfig(**common)
+            if args.shards > 1:
+                from cfk_tpu.parallel.mesh import make_mesh
+                from cfk_tpu.parallel.spmd import train_als_sharded
+
+                model = train_als_sharded(
+                    ds, config, make_mesh(args.shards), metrics=metrics, **ck
+                )
+            else:
+                model = train_als(ds, config, metrics=metrics, **ck)
+
+    with metrics.phase("predict"):
+        preds = model.predict_dense()
+    if not args.implicit:
+        mse, rmse = mse_rmse_from_blocks(preds, ds)
+        metrics.gauge("mse", round(mse, 6))
+        metrics.gauge("rmse", round(rmse, 6))
+        _eprint(f"train MSE={mse:.4f} RMSE={rmse:.4f}")
+    if args.output != "none":
+        with metrics.phase("dump_csv"):
+            path = save_prediction_csv(
+                preds, None if args.output == "auto" else args.output
+            )
+        _eprint(f"predictions written to {path}")
+    print(metrics.json_line() if args.metrics == "json" else metrics.logfmt())
+    return 0
+
+
+def _run_reference_form(args) -> int:
+    """The reference's 7-positional-arg invocation."""
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.netflix import parse_netflix
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.eval.predict import save_prediction_csv
+    from cfk_tpu.models.als import train_als
+
+    _eprint(f"app started: {time.strftime('%Y-%m-%d %H:%M:%S')}")
+    coo = parse_netflix(args.path)
+    _eprint(f"producer finished: {time.strftime('%Y-%m-%d %H:%M:%S')}")
+    # NUM_PARTITIONS maps to device shards when that many devices exist;
+    # otherwise fall back to one shard with a warning (the reference's
+    # partitions are Kafka-internal and have no single-device meaning).
+    num_shards = args.num_partitions
+    mesh = None
+    if num_shards > 1:
+        try:
+            from cfk_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(num_shards)
+        except ValueError as e:
+            _eprint(f"warning: NUM_PARTITIONS={num_shards} ignored ({e})")
+            num_shards = 1
+    ds = Dataset.from_coo(coo, num_shards=num_shards)
+    if ds.movie_map.num_entities != args.num_movies:
+        _eprint(
+            f"warning: NUM_MOVIES={args.num_movies} but data has "
+            f"{ds.movie_map.num_entities} rated movies (using the data)"
+        )
+    if ds.user_map.num_entities != args.num_users:
+        _eprint(
+            f"warning: NUM_USERS={args.num_users} but data has "
+            f"{ds.user_map.num_entities} rated users (using the data)"
+        )
+    config = ALSConfig(
+        rank=args.num_features,
+        lam=args.lam,
+        num_iterations=args.num_iterations,
+        num_shards=num_shards,
+    )
+    if mesh is not None:
+        from cfk_tpu.parallel.spmd import train_als_sharded
+
+        model = train_als_sharded(ds, config, mesh)
+    else:
+        model = train_als(ds, config)
+    preds = model.predict_dense()
+    mse, rmse = mse_rmse_from_blocks(preds, ds)
+    path = save_prediction_csv(preds)
+    _eprint(f"prediction matrix written: {time.strftime('%Y-%m-%d %H:%M:%S')}")
+    print(f"MSE: {mse}")
+    print(f"RMSE: {rmse}")
+    print(path)
+    return 0
+
+
+def _evaluate(args) -> int:
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.netflix import parse_netflix
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.eval.predict import load_prediction_csv
+
+    coo = parse_netflix(args.ratings_file)
+    ds = Dataset.from_coo(coo)
+    preds = load_prediction_csv(args.prediction_csv)
+    want = (ds.user_map.num_entities, ds.movie_map.num_entities)
+    if preds.shape != want:
+        _eprint(
+            f"error: prediction matrix is {preds.shape}, ratings imply {want} "
+            "(rows = users ascending id, cols = movies ascending id)"
+        )
+        return 2
+    print(f"#users in ratings_matrix:  {want[0]}")
+    print(f"#movies in ratings_matrix:  {want[1]}")
+    mse, rmse = mse_rmse_from_blocks(preds, ds)
+    print(f"MSE: {mse}")
+    print(f"RMSE: {rmse}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cfk_tpu", description=__doc__)
+    p.add_argument(
+        "--platform",
+        choices=["default", "cpu", "tpu"],
+        default="default",
+        help="force the JAX platform (overrides environment registration)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    r = sub.add_parser("run", help="reference-compatible positional form")
+    r.add_argument("num_partitions", type=int)
+    r.add_argument("num_features", type=int)
+    r.add_argument("lam", type=float)
+    r.add_argument("num_iterations", type=int)
+    r.add_argument("path")
+    r.add_argument("num_movies", type=int)
+    r.add_argument("num_users", type=int)
+    r.set_defaults(fn=_run_reference_form)
+
+    t = sub.add_parser("train", help="full-flag training")
+    t.add_argument("--data", required=True)
+    t.add_argument("--format", choices=["netflix", "movielens"], default="netflix")
+    t.add_argument("--implicit", action="store_true", help="confidence-weighted iALS")
+    t.add_argument("--min-rating", type=float, default=0.0)
+    t.add_argument("--rank", type=int, default=5)
+    t.add_argument("--lam", type=float, default=0.05)
+    t.add_argument("--alpha", type=float, default=40.0, help="iALS confidence weight")
+    t.add_argument("--iterations", type=int, default=7)
+    t.add_argument("--seed", type=int, default=42)
+    t.add_argument("--shards", type=int, default=1)
+    t.add_argument("--exchange", choices=["all_gather", "ring"], default="all_gather")
+    t.add_argument("--solver", choices=["cholesky", "pallas"], default="cholesky")
+    t.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    t.add_argument("--solve-chunk", type=int, default=None)
+    t.add_argument("--pad-multiple", type=int, default=8)
+    t.add_argument("--checkpoint-dir", default=None)
+    t.add_argument("--checkpoint-every", type=int, default=1)
+    t.add_argument("--profile-dir", default=None, help="write a jax.profiler trace")
+    t.add_argument(
+        "--output", default="auto",
+        help="'auto' = predictions/prediction_matrix_<ts>, 'none', or a path",
+    )
+    t.add_argument("--metrics", choices=["json", "logfmt"], default="logfmt")
+    t.set_defaults(fn=_train)
+
+    e = sub.add_parser("evaluate", help="offline MSE/RMSE of a prediction CSV")
+    e.add_argument("ratings_file")
+    e.add_argument("prediction_csv")
+    e.set_defaults(fn=_evaluate)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform != "default":
+        # Must go through jax.config (some environments force-register a
+        # platform and override the JAX_PLATFORMS env var).
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    try:
+        return args.fn(args)
+    except (ValueError, OSError, KeyError) as e:
+        # User-input errors get one clean line; CFK_TPU_TRACEBACK=1 re-raises
+        # for debugging.
+        import os
+
+        if os.environ.get("CFK_TPU_TRACEBACK"):
+            raise
+        _eprint(f"error: {e}")
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

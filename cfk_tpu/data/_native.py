@@ -18,6 +18,9 @@ from cfk_tpu.data.blocks import RatingsCOO
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libcfk_native.so"))
 _IO_ERROR = -0x7FFFFFFF
+# Must match cfk_native_abi_version() in native/cfk_native.cpp; a stale .so
+# with a different version is treated as unavailable (Python fallback).
+_ABI_VERSION = 2
 
 _lib: ctypes.CDLL | None = None
 
@@ -66,7 +69,7 @@ def _try_load() -> None:
         return
     try:
         lib = _bind(ctypes.CDLL(_LIB_PATH))
-        if lib.cfk_native_abi_version() == 1:
+        if lib.cfk_native_abi_version() == _ABI_VERSION:
             _lib = lib
     except (OSError, AttributeError):
         # AttributeError = stale .so missing a symbol; fall back to Python.

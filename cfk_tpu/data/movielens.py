@@ -9,7 +9,15 @@ caller binarize/threshold (a common MovieLens-implicit protocol).
 
 from __future__ import annotations
 
+import re
+
 import numpy as np
+
+# Plain non-negative decimal (digits, optional .digits) — what the native
+# parser's bounded float reader accepts; no sign or scientific notation.
+_RATING_RE = re.compile(r"\d+(\.\d*)?|\.\d+")
+
+_INT64_MAX = 2**63 - 1
 
 from cfk_tpu.data.blocks import RatingsCOO
 
@@ -40,7 +48,16 @@ def parse_movielens_csv_python(path: str, *, min_rating: float = 0.0) -> Ratings
             if len(parts) < 3:
                 raise ValueError(f"{path}:{lineno}: malformed line {line!r}")
             try:
+                # Strict non-negative ids (no sign/underscores) to match the
+                # native parser exactly; ids feed mod-N partitioning, where a
+                # negative id would collide with control-record conventions.
+                if not (parts[0].isdigit() and parts[1].isdigit()):
+                    raise ValueError("non-numeric id")
+                if not _RATING_RE.fullmatch(parts[2]):
+                    raise ValueError("malformed rating")
                 user, movie, rating = int(parts[0]), int(parts[1]), float(parts[2])
+                if user > _INT64_MAX or movie > _INT64_MAX:
+                    raise ValueError("id exceeds int64")
             except ValueError as e:
                 raise ValueError(f"{path}:{lineno}: malformed line {line!r}") from e
             if rating < min_rating:

@@ -21,6 +21,8 @@ import numpy as np
 
 from cfk_tpu.data.blocks import RatingsCOO
 
+_INT64_MAX = 2**63 - 1
+
 
 def parse_netflix_python(path: str) -> RatingsCOO:
     """Pure-Python Netflix-format parser (fallback / reference)."""
@@ -35,11 +37,21 @@ def parse_netflix_python(path: str) -> RatingsCOO:
                 continue
             try:
                 if line.endswith(":"):
+                    # Strict digits (no sign/underscores) within int64,
+                    # matching the native parser exactly.
+                    if not line[:-1].isdigit():
+                        raise ValueError("non-numeric movie id")
                     current_movie = int(line[:-1])
+                    if current_movie > _INT64_MAX:
+                        raise ValueError("movie id exceeds int64")
                     continue
                 # userId,rating,date — date ignored
                 user_s, rating_s, _ = line.split(",", 2)
+                if not (user_s.isdigit() and rating_s.isdigit()):
+                    raise ValueError("non-numeric field")
                 user_id, rating = int(user_s), int(rating_s)
+                if user_id > _INT64_MAX or rating > _INT64_MAX:
+                    raise ValueError("field exceeds int64")
             except ValueError as e:
                 raise ValueError(f"{path}:{lineno}: malformed line {line!r}") from e
             if current_movie < 0:

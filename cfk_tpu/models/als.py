@@ -143,29 +143,41 @@ def train_als(
     *,
     checkpoint_manager=None,
     checkpoint_every: int = 1,
+    metrics=None,
 ) -> ALSModel:
     """Train ALS-WR on one device. Returns factors in ascending-id order.
 
     Without a checkpoint manager the whole loop runs as one fused
     ``fori_loop`` program; with one, iterations are stepped from Python so
     factors can be saved every ``checkpoint_every`` iterations and training
-    resumes from the latest step.
+    resumes from the latest step.  ``metrics`` (a ``cfk_tpu.utils.metrics.
+    Metrics``) records phase timings and iteration counters when provided.
     """
+    from cfk_tpu.utils.metrics import Metrics
+
+    metrics = metrics if metrics is not None else Metrics()
+    metrics.gauge("num_users", dataset.user_map.num_entities)
+    metrics.gauge("num_movies", dataset.movie_map.num_entities)
+    metrics.gauge("num_ratings", int(dataset.movie_blocks.count.sum()))
     key = jax.random.PRNGKey(config.seed)
-    mblocks = _blocks_to_device(dataset.movie_blocks)
-    ublocks = _blocks_to_device(dataset.user_blocks)
+    with metrics.phase("blocks_to_device"):
+        mblocks = _blocks_to_device(dataset.movie_blocks)
+        ublocks = _blocks_to_device(dataset.user_blocks)
     if checkpoint_manager is None:
-        u, m = _train_loop(
-            key,
-            mblocks,
-            ublocks,
-            rank=config.rank,
-            num_iterations=config.num_iterations,
-            lam=config.lam,
-            solve_chunk=config.solve_chunk,
-            dtype=config.dtype,
-            solver=config.solver,
-        )
+        with metrics.phase("train"):
+            u, m = _train_loop(
+                key,
+                mblocks,
+                ublocks,
+                rank=config.rank,
+                num_iterations=config.num_iterations,
+                lam=config.lam,
+                solve_chunk=config.solve_chunk,
+                dtype=config.dtype,
+                solver=config.solver,
+            )
+            u.block_until_ready()
+        metrics.incr("iterations", config.num_iterations)
     else:
         from cfk_tpu.transport.checkpoint import resume_state, should_save
 
@@ -187,17 +199,22 @@ def train_als(
             ).astype(dt)
             m = jnp.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
         for i in range(start_iter, config.num_iterations):
-            u, m = _one_iteration(
-                u, mblocks, ublocks,
-                lam=config.lam, solve_chunk=config.solve_chunk,
-                dtype=config.dtype, solver=config.solver,
-            )
+            with metrics.phase("train"):
+                u, m = _one_iteration(
+                    u, mblocks, ublocks,
+                    lam=config.lam, solve_chunk=config.solve_chunk,
+                    dtype=config.dtype, solver=config.solver,
+                )
+                u.block_until_ready()
+            metrics.incr("iterations")
             done = i + 1
             if should_save(done, checkpoint_every, config.num_iterations):
-                checkpoint_manager.save(
-                    done, np.asarray(u), np.asarray(m),
-                    meta={"rank": config.rank, "model": "als"},
-                )
+                with metrics.phase("checkpoint"):
+                    checkpoint_manager.save(
+                        done, np.asarray(u), np.asarray(m),
+                        meta={"rank": config.rank, "model": "als"},
+                    )
+                metrics.incr("checkpoints")
     return ALSModel(
         user_factors=u,
         movie_factors=m,

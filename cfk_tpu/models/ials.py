@@ -81,21 +81,27 @@ def _train_loop(
     return lax.fori_loop(0, num_iterations, one_iteration, (u, m0))
 
 
-def train_ials(dataset: Dataset, config: IALSConfig) -> ALSModel:
+def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSModel:
     """Single-device implicit ALS. Ratings in the dataset are interaction
     strengths (counts, play-time, explicit stars — anything ≥ 0)."""
+    from cfk_tpu.utils.metrics import Metrics
+
+    metrics = metrics if metrics is not None else Metrics()
     key = jax.random.PRNGKey(config.seed)
-    u, m = _train_loop(
-        key,
-        _blocks_to_device(dataset.movie_blocks),
-        _blocks_to_device(dataset.user_blocks),
-        rank=config.rank,
-        num_iterations=config.num_iterations,
-        lam=config.lam,
-        alpha=config.alpha,
-        dtype=config.dtype,
-        solver=config.solver,
-    )
+    with metrics.phase("train"):
+        u, m = _train_loop(
+            key,
+            _blocks_to_device(dataset.movie_blocks),
+            _blocks_to_device(dataset.user_blocks),
+            rank=config.rank,
+            num_iterations=config.num_iterations,
+            lam=config.lam,
+            alpha=config.alpha,
+            dtype=config.dtype,
+            solver=config.solver,
+        )
+        u.block_until_ready()
+    metrics.incr("iterations", config.num_iterations)
     return ALSModel(
         user_factors=u,
         movie_factors=m,
@@ -148,8 +154,12 @@ def train_ials_sharded(
     *,
     checkpoint_manager=None,
     checkpoint_every: int = 1,
+    metrics=None,
 ) -> ALSModel:
     """Multi-device iALS over a 1-D mesh, with optional checkpoint/resume."""
+    from cfk_tpu.utils.metrics import Metrics
+
+    metrics = metrics if metrics is not None else Metrics()
     from cfk_tpu.parallel.spmd import validate_sharded_dataset
     from cfk_tpu.transport.checkpoint import resume_state, should_save
 
@@ -194,15 +204,20 @@ def train_ials_sharded(
 
     step = jax.jit(make_ials_training_step(mesh, config), donate_argnums=(0, 1))
     for i in range(start_iter, config.num_iterations):
-        u, m = step(u, m, mtree, utree)
+        with metrics.phase("train"):
+            u, m = step(u, m, mtree, utree)
+            u.block_until_ready()
+        metrics.incr("iterations")
         done = i + 1
         if checkpoint_manager is not None and should_save(
             done, checkpoint_every, config.num_iterations
         ):
-            checkpoint_manager.save(
-                done, np.asarray(u), np.asarray(m),
-                meta={"rank": config.rank, "model": "ials"},
-            )
+            with metrics.phase("checkpoint"):
+                checkpoint_manager.save(
+                    done, np.asarray(u), np.asarray(m),
+                    meta={"rank": config.rank, "model": "ials"},
+                )
+            metrics.incr("checkpoints")
 
     return ALSModel(
         user_factors=u,

@@ -221,6 +221,7 @@ def train_als_sharded(
     *,
     checkpoint_manager=None,
     checkpoint_every: int = 1,
+    metrics=None,
 ) -> ALSModel:
     """Multi-device ALS-WR over a 1-D mesh; semantics match ``train_als``.
 
@@ -286,25 +287,33 @@ def train_als_sharded(
             NamedSharding(mesh, P(AXIS, None)),
         )
 
+    from cfk_tpu.utils.metrics import Metrics
+
+    metrics = metrics if metrics is not None else Metrics()
     step = jax.jit(
         make_training_step(mesh, config, _tree_specs(mtree)), donate_argnums=(0, 1)
     )
     for i in range(start_iter, config.num_iterations):
-        u, m = step(u, m, mtree, utree)
+        with metrics.phase("train"):
+            u, m = step(u, m, mtree, utree)
+            u.block_until_ready()
+        metrics.incr("iterations")
         done = i + 1
         if checkpoint_manager is not None and should_save(
             done, checkpoint_every, config.num_iterations
         ):
-            checkpoint_manager.save(
-                done,
-                np.asarray(u),
-                np.asarray(m),
-                meta={
-                    "rank": config.rank,
-                    "exchange": config.exchange,
-                    "model": "als",
-                },
-            )
+            with metrics.phase("checkpoint"):
+                checkpoint_manager.save(
+                    done,
+                    np.asarray(u),
+                    np.asarray(m),
+                    meta={
+                        "rank": config.rank,
+                        "exchange": config.exchange,
+                        "model": "als",
+                    },
+                )
+            metrics.incr("checkpoints")
 
     return ALSModel(
         user_factors=u,

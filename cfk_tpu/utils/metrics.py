@@ -1,0 +1,73 @@
+"""Structured metrics + phase timing.
+
+Replaces the reference's observability story — raw ``System.out.println``
+wall-clock stamps at phase edges (``apps/ALSAppRunner.java:25,32``,
+``processors/FeatureCollector.java:47,94``) and a per-partition solve-time
+accumulator printed by a 60 s punctuator
+(``processors/MFeatureCalculator.java:40-45,135``) — with a typed registry:
+counters, gauges, and phase timers, dumped as one JSON line or logfmt.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+
+
+class Metrics:
+    """Process-local metrics registry: counters, gauges, phase timers."""
+
+    def __init__(self) -> None:
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self.phases: dict[str, float] = defaultdict(float)
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        self.counters[name] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Accumulate wall seconds spent inside the block under ``name``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] += time.perf_counter() - t0
+
+    def to_dict(self) -> dict:
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "phase_seconds": {k: round(v, 6) for k, v in self.phases.items()},
+        }
+
+    def json_line(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def logfmt(self) -> str:
+        parts = []
+        for k, v in sorted(self.counters.items()):
+            parts.append(f"ctr.{k}={v:g}")
+        for k, v in sorted(self.gauges.items()):
+            parts.append(f"g.{k}={v:g}")
+        for k, v in sorted(self.phases.items()):
+            parts.append(f"t.{k}={v:.3f}s")
+        return " ".join(parts)
+
+
+@contextlib.contextmanager
+def maybe_profile(profile_dir: str | None):
+    """jax.profiler trace hook: writes a TensorBoard-loadable trace when a
+    directory is given, otherwise a no-op."""
+    if profile_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield

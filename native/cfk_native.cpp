@@ -17,6 +17,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <strings.h>  // strncasecmp
+#include <sys/stat.h>
 #include <vector>
 
 namespace {
@@ -26,24 +28,21 @@ struct FileBuf {
   size_t size = 0;
   ~FileBuf() { std::free(data); }
   bool read(const char* path) {
+    struct stat st;
+    if (::stat(path, &st) != 0 || !S_ISREG(st.st_mode)) return false;
     FILE* f = std::fopen(path, "rb");
     if (!f) return false;
-    std::fseek(f, 0, SEEK_END);
-    long n = std::ftell(f);
-    std::fseek(f, 0, SEEK_SET);
-    if (n < 0) {
-      std::fclose(f);
-      return false;
-    }
+    long n = static_cast<long>(st.st_size);
     data = static_cast<char*>(std::malloc(n + 1));
     if (!data) {
       std::fclose(f);
       return false;
     }
     size = std::fread(data, 1, n, f);
+    bool ok = size == static_cast<size_t>(n) && !std::ferror(f);
     data[size] = '\0';
     std::fclose(f);
-    return true;
+    return ok;
   }
 };
 
@@ -53,6 +52,7 @@ inline bool parse_uint(const char*& p, const char* end, long long* out) {
   const char* start = p;
   long long v = 0;
   while (p < end && *p >= '0' && *p <= '9') {
+    if (v > 922337203685477579LL) return false;  // would overflow int64
     v = v * 10 + (*p - '0');
     ++p;
   }
@@ -119,10 +119,13 @@ long long cfk_parse_netflix(const char* path, long long* movie, long long* user,
     }
     long long v;
     const char* r = q;
-    if (!parse_uint(r, qe, &v)) return -lineno;
-    if (r < qe && *r == ':') {
-      if (r + 1 != qe) return -lineno;
+    // Header branch first (mirrors the Python parser's endswith(':')):
+    // any line ending in ':' must be "<digits>:", else it is malformed.
+    if (qe[-1] == ':') {
+      if (!parse_uint(r, qe, &v) || r + 1 != qe) return -lineno;
       current_movie = v;
+    } else if (!parse_uint(r, qe, &v)) {
+      return -lineno;
     } else {
       if (current_movie < 0) return -lineno;  // rating row before header
       if (r >= qe || *r != ',') return -lineno;
@@ -165,7 +168,8 @@ long long cfk_parse_movielens(const char* path, long long* movie,
       p = line_end + 1;
       continue;
     }
-    if (lineno == 1 && (*q == 'u' || *q == 'U')) {  // header
+    if (lineno == 1 && qe - q >= 6 &&
+        (strncasecmp(q, "userid", 6) == 0)) {  // header row
       p = line_end + 1;
       continue;
     }
@@ -223,6 +227,8 @@ long long cfk_decode_id_rating_batch(const uint8_t* in, long long nbytes,
   return n;
 }
 
-int cfk_native_abi_version() { return 1; }
+// Bump when parser semantics or signatures change: a stale .so must be
+// treated as unavailable (Python fallback), never silently divergent.
+int cfk_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -1,0 +1,83 @@
+"""CLI tests: reference-compatible run form, flag form, and the evaluator."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cfk_tpu.cli import main
+
+TINY = "/root/reference/data/data_sample_tiny.txt"
+
+
+def test_run_reference_form(capsys, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # predictions/ lands under tmp
+    rc = main(["run", "4", "5", "0.05", "7", TINY, "426", "302"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "MSE:" in out and "RMSE:" in out
+    mse = float(out.split("MSE:")[1].split()[0])
+    assert mse <= 0.30
+
+
+def test_run_warns_on_wrong_counts(capsys, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["run", "4", "3", "0.05", "1", TINY, "9999", "1"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "warning: NUM_MOVIES=9999" in err
+    assert "warning: NUM_USERS=1" in err
+
+
+def test_train_and_evaluate_roundtrip(capsys, tmp_path):
+    pred = str(tmp_path / "pred.csv")
+    rc = main([
+        "train", "--data", TINY, "--rank", "5", "--lam", "0.05",
+        "--iterations", "7", "--seed", "0", "--output", pred,
+        "--metrics", "json",
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    metrics = json.loads(captured.out.strip().splitlines()[-1])
+    assert metrics["gauges"]["mse"] <= 0.27
+    assert metrics["counters"]["iterations"] == 7
+    assert metrics["phase_seconds"]["train"] > 0
+
+    rc = main(["evaluate", TINY, pred])
+    assert rc == 0
+    out = capsys.readouterr().out
+    mse = float(out.split("MSE:")[1].split()[0])
+    assert mse <= 0.27
+
+
+def test_evaluate_shape_mismatch(capsys, tmp_path):
+    bad = tmp_path / "bad.csv"
+    bad.write_text("2 3 real\n1 2 3\n4 5 6\n")
+    rc = main(["evaluate", TINY, str(bad)])
+    assert rc == 2
+    assert "prediction matrix is" in capsys.readouterr().err
+
+
+def test_train_implicit(capsys, tmp_path):
+    rc = main([
+        "train", "--data", TINY, "--implicit", "--rank", "4",
+        "--lam", "0.1", "--alpha", "5", "--iterations", "2",
+        "--output", "none",
+    ])
+    assert rc == 0
+
+
+def test_train_with_checkpointing(capsys, tmp_path):
+    ck = str(tmp_path / "ck")
+    args = [
+        "train", "--data", TINY, "--rank", "3", "--iterations", "3",
+        "--seed", "1", "--checkpoint-dir", ck, "--output", "none",
+        "--metrics", "json",
+    ]
+    assert main(args) == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["counters"]["checkpoints"] == 3
+    # Re-run: resumes at 3, no new iterations.
+    assert main(args) == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["counters"].get("iterations", 0) == 0

@@ -53,6 +53,44 @@ def test_movielens_parity(tmp_path):
         np.testing.assert_allclose(py.rating, nat.rating)
 
 
+def test_edge_case_parity_both_reject(tmp_path):
+    """Rows that are malformed must be rejected by BOTH parsers (the parity
+    contract) — these inputs previously diverged."""
+    netflix_bad = [
+        "1,5,2005:\n",  # ends ':' but is not a pure-digit header
+        "1:\n-3,5,2005-01-01\n",  # signed user id
+        "99999999999999999999999:\n",  # int64 overflow movie id
+    ]
+    for content in netflix_bad:
+        p = tmp_path / "n.txt"
+        p.write_text(content)
+        with pytest.raises(ValueError):
+            parse_netflix_python(str(p))
+        with pytest.raises((ValueError, OverflowError)):
+            _native.parse_netflix(str(p))
+
+    ml_bad = [
+        "u,1,2\n",  # first line starts with 'u' but is not a header
+        "userId,movieId,rating,timestamp\n-1,2,3.0,0\n",  # signed id
+        "userId,movieId,rating,timestamp\n1,2,-3.0,0\n",  # signed rating
+        "userId,movieId,rating,timestamp\n1,2,3e1,0\n",  # scientific notation
+    ]
+    from cfk_tpu.data.movielens import parse_movielens_csv_python
+
+    for content in ml_bad:
+        p = tmp_path / "m.csv"
+        p.write_text(content)
+        with pytest.raises(ValueError):
+            parse_movielens_csv_python(str(p))
+        with pytest.raises(ValueError):
+            _native.parse_movielens(str(p), 0.0)
+
+
+def test_directory_rejected(tmp_path):
+    with pytest.raises(OSError):
+        _native.parse_netflix(str(tmp_path))
+
+
 def test_movielens_malformed_rows_rejected(tmp_path):
     """The bounded float parser must reject what Python rejects — no strtod
     reading past the line end."""
