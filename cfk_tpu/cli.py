@@ -28,7 +28,7 @@ def _eprint(*args) -> None:
     print(*args, file=sys.stderr)
 
 
-def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple):
+def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded"):
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.movielens import parse_movielens_csv
     from cfk_tpu.data.netflix import parse_netflix
@@ -37,7 +37,9 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple):
         coo = parse_netflix(path)
     else:
         coo = parse_movielens_csv(path, min_rating=min_rating)
-    return coo, Dataset.from_coo(coo, num_shards=num_shards, pad_multiple=pad_multiple)
+    return coo, Dataset.from_coo(
+        coo, num_shards=num_shards, pad_multiple=pad_multiple, layout=layout
+    )
 
 
 def _train(args) -> int:
@@ -52,9 +54,11 @@ def _train(args) -> int:
     metrics = Metrics()
     with metrics.phase("ingest"):
         coo, ds = _load_dataset(
-            args.data, args.format, args.min_rating, args.shards, args.pad_multiple
+            args.data, args.format, args.min_rating, args.shards,
+            args.pad_multiple, args.layout,
         )
     common = dict(
+        layout=args.layout,
         rank=args.rank,
         lam=args.lam,
         num_iterations=args.iterations,
@@ -229,6 +233,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
     t.add_argument("--solve-chunk", type=int, default=None)
     t.add_argument("--pad-multiple", type=int, default=8)
+    t.add_argument(
+        "--layout", choices=["padded", "bucketed"], default="padded",
+        help="InBlock layout: one rectangle, or power-of-two width buckets "
+        "(needed at full-Netflix scale)",
+    )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)
     t.add_argument("--profile-dir", default=None, help="write a jax.profiler trace")

@@ -52,6 +52,17 @@ class ALSConfig:
     # CLI/bench dataset construction); pass it to Dataset.from_coo when
     # building datasets by hand.
     pad_multiple: int = 8
+    # InBlock memory layout:
+    #   "padded"   — one [E, max_nnz] rectangle per side. Simple and fastest
+    #                up to medium scale, but pads every entity to the global
+    #                max degree — quadratic waste on power-law data.
+    #   "bucketed" — power-of-two width classes (the ALX layout); total
+    #                padded cells stay within ~2× nnz, required at full
+    #                Netflix-Prize scale. all_gather exchange only.
+    layout: Literal["padded", "bucketed"] = "padded"
+    # Bucketed layout: max rows·width per solve chunk — bounds the transient
+    # [chunk, width, rank] neighbor-factor gather in HBM.
+    bucket_chunk_elems: int = 1 << 20
 
     def __post_init__(self) -> None:
         if self.rank < 1:
@@ -66,3 +77,9 @@ class ALSConfig:
             raise ValueError(f"unknown exchange {self.exchange!r}")
         if self.solver not in ("cholesky", "pallas"):
             raise ValueError(f"unknown solver {self.solver!r}")
+        if self.layout not in ("padded", "bucketed"):
+            raise ValueError(f"unknown layout {self.layout!r}")
+        if self.layout == "bucketed" and self.exchange == "ring":
+            raise ValueError(
+                "layout='bucketed' supports exchange='all_gather' only"
+            )

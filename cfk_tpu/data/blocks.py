@@ -31,6 +31,7 @@ n ≥ 1 so their math is untouched — exact reference semantics,
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import numpy as np
 
@@ -153,6 +154,181 @@ def build_padded_blocks(
 
 
 @dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One width class of a ``BucketedBlocks``: entities whose nnz fits ``width``.
+
+    Rows are shard-major: shard s owns rows [s·B, (s+1)·B) where
+    B = rows/num_shards, so a ``P("shard", None)`` sharding hands each device
+    exactly its own entities.  ``entity_local`` maps each row to the entity's
+    index *within its shard's factor slice*; padding rows point at the trash
+    slot ``local_entities`` (one past the real rows).
+    """
+
+    neighbor_idx: np.ndarray  # int32 [rows, width] dense idx into the fixed side
+    rating: np.ndarray  # float32 [rows, width]
+    mask: np.ndarray  # float32 [rows, width]
+    count: np.ndarray  # int32 [rows]
+    entity_local: np.ndarray  # int32 [rows]
+    chunk_rows: int | None  # static per-shard chunking hint (divides rows/S)
+
+    @property
+    def width(self) -> int:
+        return int(self.neighbor_idx.shape[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketedBlocks:
+    """InBlocks grouped into power-of-two width classes (the ALX layout).
+
+    A single ``PaddedBlocks`` rectangle pads every entity to the global max
+    nnz — quadratic waste under the power-law degree distributions of real
+    rating data (one 200k-rating movie would force a [17k, 200k] rectangle).
+    Here entities are binned by nnz into buckets of width pad_multiple·2^j;
+    each bucket is its own small rectangle, so total padded cells stay within
+    2× of nnz.  Entities with zero ratings get no row at all: their solve is
+    identically zero (the reference's HashMap likewise only ever holds rated
+    entities, ``processors/MFeatureCalculator.java:56-65``).
+    """
+
+    buckets: tuple[Bucket, ...]
+    count: np.ndarray  # int32 [E_pad] dense per-entity nnz (0 for pad rows)
+    rating_sum: np.ndarray  # float32 [E_pad] per-entity rating sum (for init)
+    num_entities: int
+    num_shards: int
+
+    @property
+    def padded_entities(self) -> int:
+        return int(self.count.shape[0])
+
+    @property
+    def local_entities(self) -> int:
+        return self.padded_entities // self.num_shards
+
+    @property
+    def padded_cells(self) -> int:
+        return sum(b.neighbor_idx.size for b in self.buckets)
+
+    def to_tree(self):
+        """(tuple-of-dicts pytree of bucket arrays, static chunk hints).
+
+        The single source of the bucket-dict field list — device placement
+        and sharding specs are derived from this shape.
+        """
+        trees = tuple(
+            {
+                "neighbor": b.neighbor_idx,
+                "rating": b.rating,
+                "mask": b.mask,
+                "count": b.count,
+                "entity_local": b.entity_local,
+            }
+            for b in self.buckets
+        )
+        return trees, tuple(b.chunk_rows for b in self.buckets)
+
+
+def build_bucketed_blocks(
+    solve_dense: np.ndarray,
+    fixed_dense: np.ndarray,
+    rating: np.ndarray,
+    num_solve_entities: int,
+    *,
+    num_shards: int = 1,
+    pad_multiple: int = 8,
+    chunk_elems: int | None = 1 << 20,
+) -> BucketedBlocks:
+    """Bin entities into power-of-two width buckets, shard-major rows.
+
+    ``chunk_elems`` bounds rows·width per solve chunk: buckets whose per-shard
+    row count exceeds ``chunk_elems // width`` get a static ``chunk_rows``
+    hint (and rows padded to a multiple of it) so the device-side gather is
+    streamed through HBM in bounded pieces.
+    """
+    e_pad = _round_up(num_solve_entities, num_shards)
+    e_local = e_pad // num_shards
+    count = np.bincount(solve_dense, minlength=num_solve_entities).astype(np.int32)
+
+    order = np.argsort(solve_dense, kind="stable")
+    s_sorted = solve_dense[order]
+    f_sorted = fixed_dense[order].astype(np.int32)
+    r_sorted = rating[order].astype(np.float32)
+    group_start = np.zeros(num_solve_entities, dtype=np.int64)
+    np.cumsum(count[:-1], out=group_start[1:])
+    pos = np.arange(s_sorted.shape[0], dtype=np.int64) - group_start[s_sorted]
+
+    max_nnz = max(int(count.max()), 1)
+    widths = [pad_multiple]
+    while widths[-1] < max_nnz:
+        widths.append(widths[-1] * 2)
+
+    bucket_of = np.searchsorted(widths, count)  # smallest j with width_j >= nnz
+    shard_of = np.arange(num_solve_entities, dtype=np.int64) // e_local
+    rated = count > 0
+    row_of_entity = np.full(num_solve_entities, -1, dtype=np.int64)
+
+    buckets = []
+    for j, width in enumerate(widths):
+        sel = rated & (bucket_of == j)
+        ents = np.flatnonzero(sel)
+        if ents.size == 0:
+            continue
+        sh = shard_of[ents]
+        per_shard = np.bincount(sh, minlength=num_shards)
+        b = int(per_shard.max())
+        chunk = None
+        if chunk_elems is not None:
+            cap = max(1, chunk_elems // width)
+            if b > cap:
+                chunk = cap
+                b = _round_up(b, cap)
+        rows = num_shards * b
+        # ents ascend in dense-id order, so they ascend in shard order too;
+        # position within each shard's run = index − first index of that run.
+        idx_in_shard = np.arange(ents.size) - np.searchsorted(sh, sh)
+        rows_idx = sh * b + idx_in_shard
+        row_of_entity[ents] = rows_idx
+
+        neighbor = np.zeros((rows, width), dtype=np.int32)
+        rmat = np.zeros((rows, width), dtype=np.float32)
+        mask = np.zeros((rows, width), dtype=np.float32)
+        count_rows = np.zeros(rows, dtype=np.int32)
+        entity_local = np.full(rows, e_local, dtype=np.int32)
+        count_rows[rows_idx] = count[ents]
+        entity_local[rows_idx] = (ents % e_local).astype(np.int32)
+
+        mr = sel[s_sorted]
+        rr = row_of_entity[s_sorted[mr]]
+        cc = pos[mr]
+        neighbor[rr, cc] = f_sorted[mr]
+        rmat[rr, cc] = r_sorted[mr]
+        mask[rr, cc] = 1.0
+        buckets.append(
+            Bucket(
+                neighbor_idx=neighbor,
+                rating=rmat,
+                mask=mask,
+                count=count_rows,
+                entity_local=entity_local,
+                chunk_rows=chunk,
+            )
+        )
+
+    count_pad = np.zeros(e_pad, dtype=np.int32)
+    count_pad[:num_solve_entities] = count
+    rating_sum = np.zeros(e_pad, dtype=np.float32)
+    rating_sum[:num_solve_entities] = np.bincount(
+        solve_dense, weights=rating.astype(np.float64), minlength=num_solve_entities
+    ).astype(np.float32)
+    return BucketedBlocks(
+        buckets=tuple(buckets),
+        count=count_pad,
+        rating_sum=rating_sum,
+        num_entities=num_solve_entities,
+        num_shards=num_shards,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class RingBlocks:
     """Per-fixed-shard InBlocks for the ring (block-to-block join) exchange.
 
@@ -234,30 +410,49 @@ def build_ring_blocks(
 
 @dataclasses.dataclass(frozen=True)
 class Dataset:
-    """A fully indexed rating dataset: id maps + both solve-side block sets."""
+    """A fully indexed rating dataset: id maps + both solve-side block sets.
+
+    ``layout="padded"`` builds one rectangle per side (fine up to medium-scale
+    data); ``layout="bucketed"`` builds power-of-two width classes — required
+    at full-Netflix scale where the max-degree entity would blow up the single
+    rectangle.
+    """
 
     movie_map: IdMap
     user_map: IdMap
-    movie_blocks: PaddedBlocks  # solve movies, neighbors are users
-    user_blocks: PaddedBlocks  # solve users, neighbors are movies
+    movie_blocks: "PaddedBlocks | BucketedBlocks"  # solve movies, neighbors are users
+    user_blocks: "PaddedBlocks | BucketedBlocks"  # solve users, neighbors are movies
     coo_dense: RatingsCOO  # dense-index COO (movie_raw/user_raw hold dense idx)
 
     @classmethod
     def from_coo(
-        cls, coo: RatingsCOO, *, num_shards: int = 1, pad_multiple: int = 8
+        cls,
+        coo: RatingsCOO,
+        *,
+        num_shards: int = 1,
+        pad_multiple: int = 8,
+        layout: str = "padded",
+        chunk_elems: int | None = 1 << 20,
     ) -> "Dataset":
         movie_map = IdMap.from_raw(coo.movie_raw)
         user_map = IdMap.from_raw(coo.user_raw)
         m_dense = movie_map.to_dense(coo.movie_raw)
         u_dense = user_map.to_dense(coo.user_raw)
-        movie_blocks = build_padded_blocks(
-            m_dense, u_dense, coo.rating, movie_map.num_entities,
-            num_shards=num_shards, pad_multiple=pad_multiple,
-        )
-        user_blocks = build_padded_blocks(
-            u_dense, m_dense, coo.rating, user_map.num_entities,
-            num_shards=num_shards, pad_multiple=pad_multiple,
-        )
+        if layout == "bucketed":
+            build = functools.partial(
+                build_bucketed_blocks,
+                num_shards=num_shards,
+                pad_multiple=pad_multiple,
+                chunk_elems=chunk_elems,
+            )
+        elif layout == "padded":
+            build = functools.partial(
+                build_padded_blocks, num_shards=num_shards, pad_multiple=pad_multiple
+            )
+        else:
+            raise ValueError(f"unknown layout {layout!r}")
+        movie_blocks = build(m_dense, u_dense, coo.rating, movie_map.num_entities)
+        user_blocks = build(u_dense, m_dense, coo.rating, user_map.num_entities)
         return cls(
             movie_map=movie_map,
             user_map=user_map,

@@ -24,8 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from cfk_tpu.config import ALSConfig
-from cfk_tpu.data.blocks import Dataset, PaddedBlocks
-from cfk_tpu.ops.solve import als_half_step, init_factors
+from cfk_tpu.data.blocks import BucketedBlocks, Dataset, PaddedBlocks
+from cfk_tpu.ops.solve import (
+    als_half_step,
+    als_half_step_bucketed,
+    init_factors,
+    init_factors_stats,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,13 +58,70 @@ def _blocks_to_device(blocks: PaddedBlocks) -> dict[str, jax.Array]:
     }
 
 
+def _bucketed_to_device(blocks: BucketedBlocks):
+    """Device trees (pytree of per-bucket dicts) + static chunk hints."""
+    trees, chunks = blocks.to_tree()
+    return jax.tree.map(jnp.asarray, trees), chunks
+
+
+def _bucketed_device_setup(dataset: Dataset):
+    """Single-device bucketed setup shared by train_als / train_ials:
+    device block trees, user init stats, and the static layout kwargs."""
+    mb, ub = dataset.movie_blocks, dataset.user_blocks
+    if mb.num_shards != 1:
+        raise ValueError(
+            f"bucketed blocks were built for num_shards={mb.num_shards}; "
+            "Bucket.entity_local is shard-local, so the single-device trainer "
+            "needs num_shards=1 — use the sharded trainer, or rebuild with "
+            "Dataset.from_coo(..., num_shards=1)"
+        )
+    mblocks, m_chunks = _bucketed_to_device(mb)
+    ublocks, u_chunks = _bucketed_to_device(ub)
+    u_stats = {
+        "rating_sum": jnp.asarray(ub.rating_sum),
+        "count": jnp.asarray(ub.count),
+    }
+    layout_kw = dict(
+        m_chunks=m_chunks,
+        u_chunks=u_chunks,
+        m_entities=mb.padded_entities,
+        u_entities=ub.padded_entities,
+    )
+    return mblocks, ublocks, u_stats, layout_kw
+
+
+def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None):
+    """Solve one side against fixed factors; dispatches on the block layout
+    (dict = one padded rectangle, tuple = width buckets)."""
+    if isinstance(blk, tuple):
+        return als_half_step_bucketed(
+            fixed, blk, chunks, entities, lam, solver=solver
+        )
+    return als_half_step(
+        fixed,
+        blk["neighbor_idx"],
+        blk["rating"],
+        blk["mask"],
+        blk["count"],
+        lam,
+        solve_chunk=solve_chunk,
+        solver=solver,
+    )
+
+
+_LAYOUT_STATICS = ("m_chunks", "u_chunks", "m_entities", "u_entities")
+
+
 @functools.partial(
-    jax.jit, static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype", "solver")
+    jax.jit,
+    static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype", "solver")
+    + _LAYOUT_STATICS,
 )
 def _train_loop(
     key: jax.Array,
-    movie_blocks: dict[str, jax.Array],
-    user_blocks: dict[str, jax.Array],
+    movie_blocks,
+    user_blocks,
+    u_stats=None,
     *,
     rank: int,
     num_iterations: int,
@@ -67,18 +129,30 @@ def _train_loop(
     solve_chunk: int | None,
     dtype: str = "float32",
     solver: str = "cholesky",
+    m_chunks=None,
+    u_chunks=None,
+    m_entities=None,
+    u_entities=None,
 ) -> tuple[jax.Array, jax.Array]:
     dt = jnp.dtype(dtype)
-    u = init_factors(
-        key, user_blocks["rating"], user_blocks["mask"], user_blocks["count"], rank
-    ).astype(dt)
-    m0 = jnp.zeros((movie_blocks["rating"].shape[0], rank), dtype=dt)
+    if u_stats is not None:  # bucketed layout: init from per-entity stats
+        u = init_factors_stats(key, u_stats["rating_sum"], u_stats["count"], rank)
+        m_rows = m_entities
+    else:
+        u = init_factors(
+            key, user_blocks["rating"], user_blocks["mask"], user_blocks["count"], rank
+        )
+        m_rows = movie_blocks["rating"].shape[0]
+    u = u.astype(dt)
+    m0 = jnp.zeros((m_rows, rank), dtype=dt)
 
     def one_iteration(_, carry):
         u, _ = carry
         return _iteration_body(
             u, movie_blocks, user_blocks,
             lam=lam, solve_chunk=solve_chunk, dt=dt, solver=solver,
+            m_chunks=m_chunks, u_chunks=u_chunks,
+            m_entities=m_entities, u_entities=u_entities,
         )
 
     u_final, m_final = jax.lax.fori_loop(
@@ -88,52 +162,49 @@ def _train_loop(
 
 
 def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
-                    solver="cholesky"):
+                    solver="cholesky", m_chunks=None, u_chunks=None,
+                    m_entities=None, u_entities=None):
     """One full iteration (solve M from U, then U from M) — the single source
     of the per-iteration math for both the fused-loop and checkpointed paths.
 
     Factors are stored in ``dt`` (bfloat16 halves HBM traffic); the Gram
     accumulation upcasts to float32 inside gather_gram.
     """
-    m = als_half_step(
-        u,
-        movie_blocks["neighbor_idx"],
-        movie_blocks["rating"],
-        movie_blocks["mask"],
-        movie_blocks["count"],
-        lam,
-        solve_chunk=solve_chunk,
-        solver=solver,
+    m = _half(
+        u, movie_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
+        chunks=m_chunks, entities=m_entities,
     ).astype(dt)
-    u_new = als_half_step(
-        m,
-        user_blocks["neighbor_idx"],
-        user_blocks["rating"],
-        user_blocks["mask"],
-        user_blocks["count"],
-        lam,
-        solve_chunk=solve_chunk,
-        solver=solver,
+    u_new = _half(
+        m, user_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
+        chunks=u_chunks, entities=u_entities,
     ).astype(dt)
     return u_new, m
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lam", "solve_chunk", "dtype", "solver"), donate_argnums=(0,)
+    jax.jit,
+    static_argnames=("lam", "solve_chunk", "dtype", "solver") + _LAYOUT_STATICS,
+    donate_argnums=(0,),
 )
 def _one_iteration(
     u: jax.Array,
-    movie_blocks: dict[str, jax.Array],
-    user_blocks: dict[str, jax.Array],
+    movie_blocks,
+    user_blocks,
     *,
     lam: float,
     solve_chunk: int | None,
     dtype: str,
     solver: str = "cholesky",
+    m_chunks=None,
+    u_chunks=None,
+    m_entities=None,
+    u_entities=None,
 ) -> tuple[jax.Array, jax.Array]:
     return _iteration_body(
         u, movie_blocks, user_blocks,
         lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype), solver=solver,
+        m_chunks=m_chunks, u_chunks=u_chunks,
+        m_entities=m_entities, u_entities=u_entities,
     )
 
 
@@ -160,21 +231,29 @@ def train_als(
     metrics.gauge("num_movies", dataset.movie_map.num_entities)
     metrics.gauge("num_ratings", int(dataset.movie_blocks.count.sum()))
     key = jax.random.PRNGKey(config.seed)
+    bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
     with metrics.phase("blocks_to_device"):
-        mblocks = _blocks_to_device(dataset.movie_blocks)
-        ublocks = _blocks_to_device(dataset.user_blocks)
+        if bucketed:
+            mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)
+        else:
+            mblocks = _blocks_to_device(dataset.movie_blocks)
+            ublocks = _blocks_to_device(dataset.user_blocks)
+            u_stats = None
+            layout_kw = {}
     if checkpoint_manager is None:
         with metrics.phase("train"):
             u, m = _train_loop(
                 key,
                 mblocks,
                 ublocks,
+                u_stats,
                 rank=config.rank,
                 num_iterations=config.num_iterations,
                 lam=config.lam,
                 solve_chunk=config.solve_chunk,
                 dtype=config.dtype,
                 solver=config.solver,
+                **layout_kw,
             )
             u.block_until_ready()
         metrics.incr("iterations", config.num_iterations)
@@ -194,9 +273,15 @@ def train_als(
             m = jnp.asarray(state.movie_factors, dtype=dt)
         else:
             start_iter = 0
-            u = init_factors(
-                key, ublocks["rating"], ublocks["mask"], ublocks["count"], config.rank
-            ).astype(dt)
+            if bucketed:
+                u = init_factors_stats(
+                    key, u_stats["rating_sum"], u_stats["count"], config.rank
+                ).astype(dt)
+            else:
+                u = init_factors(
+                    key, ublocks["rating"], ublocks["mask"], ublocks["count"],
+                    config.rank,
+                ).astype(dt)
             m = jnp.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
         for i in range(start_iter, config.num_iterations):
             with metrics.phase("train"):
@@ -204,6 +289,7 @@ def train_als(
                     u, mblocks, ublocks,
                     lam=config.lam, solve_chunk=config.solve_chunk,
                     dtype=config.dtype, solver=config.solver,
+                    **layout_kw,
                 )
                 u.block_until_ready()
             metrics.incr("iterations")

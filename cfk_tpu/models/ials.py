@@ -28,9 +28,15 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from cfk_tpu.config import ALSConfig
-from cfk_tpu.data.blocks import Dataset
-from cfk_tpu.models.als import ALSModel, _blocks_to_device
-from cfk_tpu.ops.solve import global_gram, ials_half_step, init_factors
+from cfk_tpu.data.blocks import BucketedBlocks, Dataset
+from cfk_tpu.models.als import ALSModel, _blocks_to_device, _bucketed_device_setup
+from cfk_tpu.ops.solve import (
+    global_gram,
+    ials_half_step,
+    ials_half_step_bucketed,
+    init_factors,
+    init_factors_stats,
+)
 from cfk_tpu.parallel.mesh import AXIS, shard_rows
 from cfk_tpu.parallel.spmd import use_check_vma
 
@@ -53,28 +59,52 @@ class IALSConfig(ALSConfig):
             )
 
 
+def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
+               entities=None):
+    """Dispatch on block layout (dict = padded rectangle, tuple = buckets)."""
+    if isinstance(blk, tuple):
+        return ials_half_step_bucketed(
+            fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
+        )
+    return ials_half_step(
+        fixed, blk["neighbor_idx"], blk["rating"], blk["mask"], lam, alpha,
+        gram=gram, solver=solver,
+    )
+
+
 @functools.partial(
-    jax.jit, static_argnames=("rank", "num_iterations", "lam", "alpha", "dtype", "solver")
+    jax.jit,
+    static_argnames=(
+        "rank", "num_iterations", "lam", "alpha", "dtype", "solver",
+        "m_chunks", "u_chunks", "m_entities", "u_entities",
+    ),
 )
 def _train_loop(
-    key, movie_blocks, user_blocks, *, rank, num_iterations, lam, alpha, dtype,
-    solver="cholesky",
+    key, movie_blocks, user_blocks, u_stats=None, *, rank, num_iterations, lam,
+    alpha, dtype, solver="cholesky", m_chunks=None, u_chunks=None,
+    m_entities=None, u_entities=None,
 ):
     dt = jnp.dtype(dtype)
-    u = init_factors(
-        key, user_blocks["rating"], user_blocks["mask"], user_blocks["count"], rank
-    ).astype(dt)
-    m0 = jnp.zeros((movie_blocks["rating"].shape[0], rank), dtype=dt)
+    if u_stats is not None:  # bucketed layout
+        u = init_factors_stats(key, u_stats["rating_sum"], u_stats["count"], rank)
+        m_rows = m_entities
+    else:
+        u = init_factors(
+            key, user_blocks["rating"], user_blocks["mask"], user_blocks["count"], rank
+        )
+        m_rows = movie_blocks["rating"].shape[0]
+    u = u.astype(dt)
+    m0 = jnp.zeros((m_rows, rank), dtype=dt)
 
     def one_iteration(_, carry):
         u, _ = carry
-        m = ials_half_step(
-            u, movie_blocks["neighbor_idx"], movie_blocks["rating"],
-            movie_blocks["mask"], lam, alpha, solver=solver,
+        m = _ials_half(
+            u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
+            chunks=m_chunks, entities=m_entities,
         ).astype(dt)
-        u_new = ials_half_step(
-            m, user_blocks["neighbor_idx"], user_blocks["rating"],
-            user_blocks["mask"], lam, alpha, solver=solver,
+        u_new = _ials_half(
+            m, user_blocks, lam=lam, alpha=alpha, solver=solver,
+            chunks=u_chunks, entities=u_entities,
         ).astype(dt)
         return (u_new, m)
 
@@ -88,17 +118,26 @@ def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSMode
 
     metrics = metrics if metrics is not None else Metrics()
     key = jax.random.PRNGKey(config.seed)
+    if isinstance(dataset.movie_blocks, BucketedBlocks):
+        mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)
+    else:
+        mblocks = _blocks_to_device(dataset.movie_blocks)
+        ublocks = _blocks_to_device(dataset.user_blocks)
+        u_stats = None
+        layout_kw = {}
     with metrics.phase("train"):
         u, m = _train_loop(
             key,
-            _blocks_to_device(dataset.movie_blocks),
-            _blocks_to_device(dataset.user_blocks),
+            mblocks,
+            ublocks,
+            u_stats,
             rank=config.rank,
             num_iterations=config.num_iterations,
             lam=config.lam,
             alpha=config.alpha,
             dtype=config.dtype,
             solver=config.solver,
+            **layout_kw,
         )
         u.block_until_ready()
     metrics.incr("iterations", config.num_iterations)
@@ -110,13 +149,47 @@ def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSMode
     )
 
 
-def make_ials_training_step(mesh: Mesh, config: IALSConfig):
+def make_ials_training_step(
+    mesh: Mesh,
+    config: IALSConfig,
+    *,
+    m_chunks=None,
+    u_chunks=None,
+    m_local=None,
+    u_local=None,
+    mspecs=None,
+    uspecs=None,
+):
     """Jittable one-full-iteration SPMD step for iALS.
 
     Per half-iteration: psum the local [k,k] Grams, all_gather the fixed
-    factors, solve local entities.
+    factors, solve local entities (per width bucket when ``m_chunks`` given).
     """
     dt = jnp.dtype(config.dtype)
+
+    if m_chunks is not None:  # bucketed layout
+
+        def half_bucketed(fixed_local, blk, chunks, local):
+            gram = lax.psum(global_gram(fixed_local), AXIS)
+            fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+            return ials_half_step_bucketed(
+                fixed_full, blk, chunks, local, config.lam, config.alpha,
+                gram=gram, solver=config.solver,
+            ).astype(dt)
+
+        def iteration(u, m_unused, mblk, ublk):
+            del m_unused
+            m = half_bucketed(u, mblk, m_chunks, m_local)
+            u_new = half_bucketed(m, ublk, u_chunks, u_local)
+            return u_new, m
+
+        return _shard_map(
+            iteration,
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
+            out_specs=(P(AXIS, None), P(AXIS, None)),
+            check_vma=use_check_vma(config),
+        )
 
     def half(fixed_local, blk):
         gram = lax.psum(global_gram(fixed_local), AXIS)
@@ -173,8 +246,26 @@ def train_ials_sharded(
             "count": blocks.count,
         }
 
-    mtree = shard_rows(mesh, to_tree(dataset.movie_blocks))
-    utree = shard_rows(mesh, to_tree(dataset.user_blocks))
+    bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
+    step_kw = {}
+    if bucketed:
+        from cfk_tpu.parallel.spmd import _bucketed_to_tree, _tree_specs
+
+        mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
+        utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
+        step_kw = dict(
+            m_chunks=m_chunks,
+            u_chunks=u_chunks,
+            m_local=dataset.movie_blocks.local_entities,
+            u_local=dataset.user_blocks.local_entities,
+            mspecs=_tree_specs(mtree),
+            uspecs=_tree_specs(utree),
+        )
+        mtree = shard_rows(mesh, mtree)
+        utree = shard_rows(mesh, utree)
+    else:
+        mtree = shard_rows(mesh, to_tree(dataset.movie_blocks))
+        utree = shard_rows(mesh, to_tree(dataset.user_blocks))
 
     dt = jnp.dtype(config.dtype)
     state = resume_state(
@@ -190,19 +281,29 @@ def train_ials_sharded(
     else:
         start_iter = 0
         key = jax.random.PRNGKey(config.seed)
-        u = jax.jit(init_factors, static_argnames="rank")(
-            key,
-            jnp.asarray(dataset.user_blocks.rating),
-            jnp.asarray(dataset.user_blocks.mask),
-            jnp.asarray(dataset.user_blocks.count),
-            rank=config.rank,
-        ).astype(dt)
+        if bucketed:
+            u = jax.jit(init_factors_stats, static_argnames="rank")(
+                key,
+                jnp.asarray(dataset.user_blocks.rating_sum),
+                jnp.asarray(dataset.user_blocks.count),
+                rank=config.rank,
+            ).astype(dt)
+        else:
+            u = jax.jit(init_factors, static_argnames="rank")(
+                key,
+                jnp.asarray(dataset.user_blocks.rating),
+                jnp.asarray(dataset.user_blocks.mask),
+                jnp.asarray(dataset.user_blocks.count),
+                rank=config.rank,
+            ).astype(dt)
         u = shard_rows(mesh, u)
         m = shard_rows(
             mesh, np.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
         )
 
-    step = jax.jit(make_ials_training_step(mesh, config), donate_argnums=(0, 1))
+    step = jax.jit(
+        make_ials_training_step(mesh, config, **step_kw), donate_argnums=(0, 1)
+    )
     for i in range(start_iter, config.num_iterations):
         with metrics.phase("train"):
             u, m = step(u, m, mtree, utree)

@@ -129,6 +129,49 @@ def ials_half_step(
     return dispatch_spd_solve(a, b, solver)
 
 
+def ials_half_step_bucketed(
+    fixed_factors: jax.Array,  # [F, k]
+    buckets,  # sequence of dicts {neighbor, rating, mask, entity_local}
+    chunk_rows,  # same-length sequence of static ints / None
+    local_entities: int,
+    lam: float,
+    alpha: float,
+    *,
+    gram: jax.Array | None = None,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """Implicit-feedback half-iteration over width-bucketed InBlocks.
+
+    Same bucket walk as ``als_half_step_bucketed``; per entity the normal
+    matrix is YᵀY + Σ_obs (c−1)·f fᵀ + λI.  Zero-interaction rows stay 0,
+    identical to the padded path's (YᵀY + λI)x = 0 solve.
+    """
+    k = fixed_factors.shape[-1]
+    if gram is None:
+        gram = global_gram(fixed_factors)
+    reg = lam * jnp.eye(k, dtype=jnp.float32)
+
+    def solve_piece(ni, rt, mk):
+        a_obs, b = gather_gram_implicit(fixed_factors, ni, alpha * rt, mk)
+        return dispatch_spd_solve(gram[None] + a_obs + reg[None], b, solver)
+
+    out = jnp.zeros((local_entities + 1, k), jnp.float32)
+    for blk, chunk in zip(buckets, chunk_rows):
+        rows = blk["neighbor"].shape[0]
+        if chunk is None or chunk >= rows:
+            x = solve_piece(blk["neighbor"], blk["rating"], blk["mask"])
+        else:
+            if rows % chunk != 0:
+                raise ValueError(f"bucket rows {rows} not divisible by chunk {chunk}")
+            reshape = lambda a: a.reshape((rows // chunk, chunk) + a.shape[1:])
+            x = lax.map(
+                lambda c: solve_piece(c[0], c[1], c[2]),
+                (reshape(blk["neighbor"]), reshape(blk["rating"]), reshape(blk["mask"])),
+            ).reshape(rows, k)
+        out = out.at[blk["entity_local"]].set(x)
+    return out[:local_entities]
+
+
 def dispatch_spd_solve(a: jax.Array, b: jax.Array, solver: str) -> jax.Array:
     """Solve batched SPD systems with the selected backend.
 
@@ -228,11 +271,68 @@ def init_factors(
 
     f[0] = entity's average rating, f[1:] ~ U(0, 1).
     """
-    e = rating.shape[0]
-    avg = jnp.sum(rating * mask, axis=1) / jnp.maximum(count.astype(jnp.float32), 1.0)
+    return init_factors_stats(key, jnp.sum(rating * mask, axis=1), count, rank)
+
+
+def init_factors_stats(
+    key: jax.Array,
+    rating_sum: jax.Array,  # [E] per-entity rating sum
+    count: jax.Array,  # [E]
+    rank: int,
+) -> jax.Array:
+    """Zhou et al. init from per-entity stats (the bucketed-layout entry:
+    bucketed blocks never materialize an [E, P] rectangle to sum over)."""
+    e = rating_sum.shape[0]
+    avg = rating_sum / jnp.maximum(count.astype(jnp.float32), 1.0)
     rest = jax.random.uniform(key, (e, rank - 1), dtype=jnp.float32)
     f = jnp.concatenate([avg[:, None], rest], axis=1)
     # Zero all-padding rows (n = 0): nothing references them in explicit ALS,
     # but the implicit model's global Gram YᵀY sums *every* row, so garbage
     # init there would silently poison iALS.
     return f * (count > 0).astype(jnp.float32)[:, None]
+
+
+def als_half_step_bucketed(
+    fixed_factors: jax.Array,  # [F, k]
+    buckets,  # sequence of dicts {neighbor, rating, mask, count, entity_local}
+    chunk_rows,  # same-length sequence of static ints / None
+    local_entities: int,
+    lam: float,
+    *,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """One ALS half-iteration over width-bucketed InBlocks.
+
+    Each bucket is solved as its own gather + einsum + Cholesky batch (the
+    Python loop unrolls into one XLA program — bucket count is static and
+    O(log max_nnz)); results scatter into the entity-order factor matrix.
+    Rows absent from every bucket (zero ratings) stay exactly 0, matching the
+    padded path's λ·I-floor solve of an all-zero system.  ``chunk_rows``
+    streams oversized buckets through HBM in [chunk, width, k] pieces.
+    """
+    k = fixed_factors.shape[-1]
+    out = jnp.zeros((local_entities + 1, k), jnp.float32)
+    for blk, chunk in zip(buckets, chunk_rows):
+        rows = blk["neighbor"].shape[0]
+        if chunk is None or chunk >= rows:
+            x = _solve_chunk(
+                fixed_factors, lam, blk["neighbor"], blk["rating"], blk["mask"],
+                blk["count"], solver,
+            )
+        else:
+            if rows % chunk != 0:
+                raise ValueError(f"bucket rows {rows} not divisible by chunk {chunk}")
+            reshape = lambda a: a.reshape((rows // chunk, chunk) + a.shape[1:])
+            x = lax.map(
+                lambda c: _solve_chunk(fixed_factors, lam, c[0], c[1], c[2], c[3], solver),
+                (
+                    reshape(blk["neighbor"]),
+                    reshape(blk["rating"]),
+                    reshape(blk["mask"]),
+                    reshape(blk["count"]),
+                ),
+            ).reshape(rows, k)
+        # Padding rows target the trash slot local_entities; real rows are
+        # unique across buckets so .set never collides.
+        out = out.at[blk["entity_local"]].set(x)
+    return out[:local_entities]

@@ -38,15 +38,30 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from cfk_tpu.config import ALSConfig
-from cfk_tpu.data.blocks import Dataset, PaddedBlocks, RingBlocks, build_ring_blocks
+from cfk_tpu.data.blocks import (
+    BucketedBlocks,
+    Dataset,
+    PaddedBlocks,
+    RingBlocks,
+    build_ring_blocks,
+)
 from cfk_tpu.models.als import ALSModel
 from cfk_tpu.ops.solve import (
     als_half_step,
+    als_half_step_bucketed,
     gather_gram,
     init_factors,
+    init_factors_stats,
     regularized_solve,
 )
 from cfk_tpu.parallel.mesh import AXIS, shard_rows
+
+
+def _to_varying(x, axis):
+    """Mark x device-varying over ``axis`` (pcast on jax ≥ 0.9, pvary before)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis, to="varying")
+    return lax.pvary(x, axis)
 
 
 def half_step_allgather(
@@ -113,10 +128,10 @@ def half_step_ring(
         blk = lax.ppermute(blk, AXIS, perm)
         return (a + ap, b + bp, blk)
 
-    # pvary: mark the zero accumulators device-varying so the fori_loop carry
-    # type matches the (varying) per-shard partial Gram sums.
-    a0 = lax.pvary(jnp.zeros((e, k, k), jnp.float32), AXIS)
-    b0 = lax.pvary(jnp.zeros((e, k), jnp.float32), AXIS)
+    # Mark the zero accumulators device-varying so the fori_loop carry type
+    # matches the (varying) per-shard partial Gram sums.
+    a0 = _to_varying(jnp.zeros((e, k, k), jnp.float32), AXIS)
+    b0 = _to_varying(jnp.zeros((e, k), jnp.float32), AXIS)
     a, b, blk = lax.fori_loop(0, num_shards - 1, body, (a0, b0, fixed_local))
     ap, bp = gram_at(blk, num_shards - 1)
     return regularized_solve(a + ap, b + bp, cnt, lam, solver)
@@ -142,10 +157,16 @@ def _ring_to_tree(blocks: RingBlocks) -> dict[str, np.ndarray]:
     }
 
 
-def _tree_specs(tree: dict[str, np.ndarray]) -> dict[str, P]:
-    return {
-        k: P(AXIS, *([None] * (v.ndim - 1))) for k, v in tree.items()
-    }
+def _bucketed_to_tree(blocks: BucketedBlocks):
+    """Tuple-of-dicts pytree (shard-major rows, P(AXIS) shardable) + static
+    per-bucket chunk hints."""
+    return blocks.to_tree()
+
+
+def _tree_specs(tree):
+    return jax.tree.map(
+        lambda v: P(AXIS, *([None] * (v.ndim - 1))), tree
+    )
 
 
 def use_check_vma(config: ALSConfig) -> bool:
@@ -157,12 +178,50 @@ def use_check_vma(config: ALSConfig) -> bool:
     return config.solver != "pallas" or jax.default_backend() == "tpu"
 
 
-def make_training_step(mesh: Mesh, config: ALSConfig, specs: dict[str, P]):
+def make_training_step(
+    mesh: Mesh,
+    config: ALSConfig,
+    mspecs,
+    uspecs=None,
+    *,
+    m_chunks=None,
+    u_chunks=None,
+    m_local=None,
+    u_local=None,
+):
     """Build the jittable one-full-iteration SPMD step (solve M, then U).
 
     Returned ``step(u, m, mblocks, ublocks) -> (u, m)`` operates on
     row-sharded global arrays; collectives are explicit inside shard_map.
+    The bucketed layout (``m_chunks`` given) all_gathers the fixed side and
+    solves each width bucket of the local shard.
     """
+    dtype = jnp.dtype(config.dtype)
+    if uspecs is None:
+        uspecs = mspecs
+
+    if m_chunks is not None:  # bucketed layout, all_gather exchange
+
+        def half_bucketed(fixed_local, blk, chunks, local):
+            fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+            return als_half_step_bucketed(
+                fixed_full, blk, chunks, local, config.lam, solver=config.solver
+            )
+
+        def iteration(u, m_unused, mblk, ublk):
+            del m_unused
+            m = half_bucketed(u, mblk, m_chunks, m_local).astype(dtype)
+            u_new = half_bucketed(m, ublk, u_chunks, u_local).astype(dtype)
+            return u_new, m
+
+        return _shard_map(
+            iteration,
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
+            out_specs=(P(AXIS, None), P(AXIS, None)),
+            check_vma=use_check_vma(config),
+        )
+
     if config.exchange == "all_gather":
         half = functools.partial(
             half_step_allgather,
@@ -178,7 +237,6 @@ def make_training_step(mesh: Mesh, config: ALSConfig, specs: dict[str, P]):
             solve_chunk=config.solve_chunk,
             solver=config.solver,
         )
-    dtype = jnp.dtype(config.dtype)
 
     def iteration(u, m_unused, mblk, ublk):
         del m_unused
@@ -192,7 +250,7 @@ def make_training_step(mesh: Mesh, config: ALSConfig, specs: dict[str, P]):
     return _shard_map(
         iteration,
         mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS, None), specs, specs),
+        in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
         out_specs=(P(AXIS, None), P(AXIS, None)),
         check_vma=use_check_vma(config),
     )
@@ -211,6 +269,13 @@ def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) ->
                 f"{name}_blocks padded to {blocks.padded_entities} entities, not "
                 f"divisible by num_shards={s}; rebuild the Dataset with "
                 f"Dataset.from_coo(..., num_shards={s})"
+            )
+        if isinstance(blocks, BucketedBlocks) and blocks.num_shards != s:
+            raise ValueError(
+                f"{name}_blocks were bucketed for num_shards={blocks.num_shards} "
+                f"but config.num_shards={s}; Bucket.entity_local is shard-local, "
+                f"so rebuild with Dataset.from_coo(..., num_shards={s}, "
+                "layout='bucketed')"
             )
 
 
@@ -233,7 +298,24 @@ def train_als_sharded(
     s = config.num_shards
     validate_sharded_dataset(dataset, config, mesh)
 
-    if config.exchange == "all_gather":
+    bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
+    step_kw = {}
+    if bucketed:
+        if config.exchange != "all_gather":
+            raise ValueError(
+                "bucketed layout supports exchange='all_gather' only; the "
+                "ring exchange needs shard-local neighbor indices (use "
+                "layout='padded' or exchange='all_gather')"
+            )
+        mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
+        utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
+        step_kw = dict(
+            m_chunks=m_chunks,
+            u_chunks=u_chunks,
+            m_local=dataset.movie_blocks.local_entities,
+            u_local=dataset.user_blocks.local_entities,
+        )
+    elif config.exchange == "all_gather":
         mtree = _padded_to_tree(dataset.movie_blocks)
         utree = _padded_to_tree(dataset.user_blocks)
     else:
@@ -274,13 +356,21 @@ def train_als_sharded(
         # Init outside shard_map: threefry values per row are independent of
         # the padded row count, so 1-way and N-way runs start identically.
         key = jax.random.PRNGKey(config.seed)
-        u = jax.jit(init_factors, static_argnames="rank")(
-            key,
-            jnp.asarray(dataset.user_blocks.rating),
-            jnp.asarray(dataset.user_blocks.mask),
-            jnp.asarray(dataset.user_blocks.count),
-            rank=config.rank,
-        ).astype(dtype)
+        if bucketed:
+            u = jax.jit(init_factors_stats, static_argnames="rank")(
+                key,
+                jnp.asarray(dataset.user_blocks.rating_sum),
+                jnp.asarray(dataset.user_blocks.count),
+                rank=config.rank,
+            ).astype(dtype)
+        else:
+            u = jax.jit(init_factors, static_argnames="rank")(
+                key,
+                jnp.asarray(dataset.user_blocks.rating),
+                jnp.asarray(dataset.user_blocks.mask),
+                jnp.asarray(dataset.user_blocks.count),
+                rank=config.rank,
+            ).astype(dtype)
         u = jax.device_put(u, NamedSharding(mesh, P(AXIS, None)))
         m = jax.device_put(
             np.zeros((dataset.movie_blocks.padded_entities, config.rank), dtype),
@@ -291,7 +381,10 @@ def train_als_sharded(
 
     metrics = metrics if metrics is not None else Metrics()
     step = jax.jit(
-        make_training_step(mesh, config, _tree_specs(mtree)), donate_argnums=(0, 1)
+        make_training_step(
+            mesh, config, _tree_specs(mtree), _tree_specs(utree), **step_kw
+        ),
+        donate_argnums=(0, 1),
     )
     for i in range(start_iter, config.num_iterations):
         with metrics.phase("train"):

@@ -1,0 +1,226 @@
+"""Bucketed InBlock layout: structure, padded-path equivalence, SPMD, scale.
+
+The bucketed layout is the full-Netflix-scale path (SURVEY.md §7 hard part a):
+power-of-two width classes instead of one [E, max_nnz] rectangle, so padded
+cells stay ~2× nnz under power-law degree distributions.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import (
+    Dataset,
+    RatingsCOO,
+    build_bucketed_blocks,
+    build_padded_blocks,
+)
+
+
+def powerlaw_coo(n_movies=200, n_users=400, nnz=5000, seed=1, skew=1.2):
+    """Zipf-distributed entity popularity — the shape of real rating data."""
+    rng = np.random.default_rng(seed)
+    mp = (1.0 / np.arange(1, n_movies + 1)) ** skew
+    up = (1.0 / np.arange(1, n_users + 1)) ** skew
+    m = rng.choice(n_movies, size=nnz, p=mp / mp.sum())
+    u = rng.choice(n_users, size=nnz, p=up / up.sum())
+    return RatingsCOO(
+        movie_raw=(m + 1).astype(np.int64),
+        user_raw=(u + 1).astype(np.int64),
+        rating=rng.integers(1, 6, size=nnz).astype(np.float32),
+    )
+
+
+def reconstruct_triples(blocks):
+    """(entity_dense, neighbor_dense, rating) triples from bucket rectangles."""
+    e_local = blocks.local_entities
+    out = []
+    for b in blocks.buckets:
+        rows = b.neighbor_idx.shape[0]
+        per_shard = rows // blocks.num_shards
+        shard = np.arange(rows) // per_shard
+        entity = shard * e_local + b.entity_local
+        rr, cc = np.nonzero(b.mask)
+        out.append(
+            np.stack(
+                [entity[rr], b.neighbor_idx[rr, cc], b.rating[rr, cc]], axis=1
+            )
+        )
+    return np.concatenate(out, axis=0)
+
+
+def test_bucketed_structure_roundtrip():
+    coo = powerlaw_coo()
+    ds = Dataset.from_coo(coo)  # for dense ids
+    cd = ds.coo_dense
+    for shards in (1, 4):
+        blocks = build_bucketed_blocks(
+            cd.movie_raw, cd.user_raw, cd.rating,
+            ds.movie_map.num_entities, num_shards=shards,
+        )
+        got = reconstruct_triples(blocks)
+        want = np.stack([cd.movie_raw, cd.user_raw, cd.rating], axis=1)
+        got = got[np.lexsort(got.T[::-1])]
+        want = want[np.lexsort(want.T[::-1])]
+        np.testing.assert_array_equal(got, want)
+        # dense count matches
+        np.testing.assert_array_equal(
+            blocks.count[: ds.movie_map.num_entities],
+            np.bincount(cd.movie_raw, minlength=ds.movie_map.num_entities),
+        )
+        # every padding row points at the trash slot
+        for b in blocks.buckets:
+            pad_rows = b.count == 0
+            assert np.all(b.entity_local[pad_rows] == blocks.local_entities)
+            assert np.all(b.mask[pad_rows] == 0)
+
+
+def test_bucketed_beats_rectangle_on_powerlaw():
+    coo = powerlaw_coo(n_movies=500, n_users=2000, nnz=20000, skew=1.5)
+    ds = Dataset.from_coo(coo)
+    cd = ds.coo_dense
+    padded = build_padded_blocks(
+        cd.movie_raw, cd.user_raw, cd.rating, ds.movie_map.num_entities
+    )
+    bucketed = build_bucketed_blocks(
+        cd.movie_raw, cd.user_raw, cd.rating, ds.movie_map.num_entities
+    )
+    rect_cells = padded.neighbor_idx.size
+    assert bucketed.padded_cells < rect_cells / 4
+    # and within 2.5x of the information-theoretic floor
+    assert bucketed.padded_cells < 2.5 * coo.num_ratings
+
+
+def test_chunk_rows_bounds_and_divides():
+    coo = powerlaw_coo()
+    ds = Dataset.from_coo(coo)
+    cd = ds.coo_dense
+    blocks = build_bucketed_blocks(
+        cd.movie_raw, cd.user_raw, cd.rating, ds.movie_map.num_entities,
+        num_shards=4, chunk_elems=256,
+    )
+    for b in blocks.buckets:
+        per_shard = b.neighbor_idx.shape[0] // blocks.num_shards
+        if b.chunk_rows is not None:
+            assert per_shard % b.chunk_rows == 0
+            assert b.chunk_rows * b.width <= 256 or b.chunk_rows == 1
+
+
+def test_bucketed_als_matches_padded(tiny_coo):
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.models.als import train_als
+
+    config = ALSConfig(rank=5, lam=0.05, num_iterations=3, seed=0)
+    ds_p = Dataset.from_coo(tiny_coo, layout="padded")
+    ds_b = Dataset.from_coo(tiny_coo, layout="bucketed")
+    preds_p = train_als(ds_p, config).predict_dense()
+    preds_b = train_als(ds_b, config).predict_dense()
+    np.testing.assert_allclose(preds_b, preds_p, atol=2e-3, rtol=1e-3)
+    mse_p, _ = mse_rmse_from_blocks(preds_p, ds_p)
+    mse_b, _ = mse_rmse_from_blocks(preds_b, ds_b)
+    assert abs(mse_p - mse_b) < 1e-4
+
+
+def test_bucketed_chunked_matches_unchunked(tiny_coo):
+    from cfk_tpu.models.als import train_als
+
+    config = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0)
+    ds_one = Dataset.from_coo(tiny_coo, layout="bucketed", chunk_elems=None)
+    ds_chunked = Dataset.from_coo(tiny_coo, layout="bucketed", chunk_elems=512)
+    assert any(
+        b.chunk_rows is not None for b in ds_chunked.movie_blocks.buckets
+    ), "chunk_elems=512 should force chunking somewhere"
+    preds_one = train_als(ds_one, config).predict_dense()
+    preds_chunked = train_als(ds_chunked, config).predict_dense()
+    np.testing.assert_allclose(preds_chunked, preds_one, atol=1e-5, rtol=1e-5)
+
+
+def test_bucketed_spmd_matches_single_device():
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = powerlaw_coo(n_movies=96, n_users=160, nnz=3000)
+    config1 = ALSConfig(rank=6, lam=0.05, num_iterations=3, seed=3)
+    ds1 = Dataset.from_coo(coo, layout="bucketed")
+    single = train_als(ds1, config1).predict_dense()
+
+    config8 = ALSConfig(
+        rank=6, lam=0.05, num_iterations=3, seed=3, num_shards=8,
+        layout="bucketed",
+    )
+    ds8 = Dataset.from_coo(coo, num_shards=8, layout="bucketed")
+    mesh = make_mesh(8)
+    sharded = train_als_sharded(ds8, config8, mesh).predict_dense()
+    np.testing.assert_allclose(sharded, single, atol=2e-3, rtol=1e-3)
+
+
+def test_bucketed_ials_matches_padded():
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+
+    coo = powerlaw_coo(n_movies=80, n_users=120, nnz=2000)
+    config = IALSConfig(rank=6, lam=0.1, alpha=10.0, num_iterations=3, seed=0)
+    preds_p = train_ials(Dataset.from_coo(coo, layout="padded"), config).predict_dense()
+    preds_b = train_ials(Dataset.from_coo(coo, layout="bucketed"), config).predict_dense()
+    np.testing.assert_allclose(preds_b, preds_p, atol=2e-3, rtol=1e-3)
+
+
+def test_bucketed_ials_sharded_matches_single():
+    from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
+    from cfk_tpu.parallel.mesh import make_mesh
+
+    coo = powerlaw_coo(n_movies=64, n_users=96, nnz=1500)
+    config1 = IALSConfig(rank=5, lam=0.1, alpha=5.0, num_iterations=2, seed=1)
+    single = train_ials(
+        Dataset.from_coo(coo, layout="bucketed"), config1
+    ).predict_dense()
+    config8 = IALSConfig(
+        rank=5, lam=0.1, alpha=5.0, num_iterations=2, seed=1, num_shards=8,
+        layout="bucketed",
+    )
+    ds8 = Dataset.from_coo(coo, num_shards=8, layout="bucketed")
+    sharded = train_ials_sharded(ds8, config8, make_mesh(8)).predict_dense()
+    np.testing.assert_allclose(sharded, single, atol=2e-3, rtol=1e-3)
+
+
+def test_bucketed_golden_tiny(tiny_coo):
+    """Reference config on tiny must hit the published quality bar
+    (README.md:207-211: MSE 0.265) with the bucketed layout too."""
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.models.als import train_als
+
+    ds = Dataset.from_coo(tiny_coo, layout="bucketed")
+    config = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=42)
+    preds = train_als(ds, config).predict_dense()
+    mse, rmse = mse_rmse_from_blocks(preds, ds)
+    assert mse <= 0.30, f"tiny MSE {mse} above reference-quality bar"
+
+
+def test_config_rejects_bucketed_ring():
+    with pytest.raises(ValueError, match="all_gather"):
+        ALSConfig(layout="bucketed", exchange="ring")
+
+
+def test_single_device_rejects_sharded_buckets():
+    """entity_local is shard-local — silently mixing shard bases must raise."""
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+
+    coo = powerlaw_coo(n_movies=40, n_users=60, nnz=500)
+    ds = Dataset.from_coo(coo, num_shards=4, layout="bucketed")
+    with pytest.raises(ValueError, match="num_shards=4"):
+        train_als(ds, ALSConfig(rank=4, num_iterations=1))
+    with pytest.raises(ValueError, match="num_shards=4"):
+        train_ials(ds, IALSConfig(rank=4, num_iterations=1))
+
+
+def test_sharded_rejects_mismatched_buckets():
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = powerlaw_coo(n_movies=40, n_users=64, nnz=500)
+    ds = Dataset.from_coo(coo, num_shards=2, layout="bucketed")
+    config = ALSConfig(rank=4, num_iterations=1, num_shards=8, layout="bucketed")
+    with pytest.raises(ValueError, match="bucketed for num_shards=2"):
+        train_als_sharded(ds, config, make_mesh(8))
