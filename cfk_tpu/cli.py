@@ -28,7 +28,8 @@ def _eprint(*args) -> None:
     print(*args, file=sys.stderr)
 
 
-def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded"):
+def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded",
+                  chunk_elems=1 << 20):
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.movielens import parse_movielens_csv
     from cfk_tpu.data.netflix import parse_netflix
@@ -38,7 +39,8 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
     else:
         coo = parse_movielens_csv(path, min_rating=min_rating)
     return coo, Dataset.from_coo(
-        coo, num_shards=num_shards, pad_multiple=pad_multiple, layout=layout
+        coo, num_shards=num_shards, pad_multiple=pad_multiple, layout=layout,
+        chunk_elems=chunk_elems,
     )
 
 
@@ -55,7 +57,7 @@ def _train(args) -> int:
     with metrics.phase("ingest"):
         coo, ds = _load_dataset(
             args.data, args.format, args.min_rating, args.shards,
-            args.pad_multiple, args.layout,
+            args.pad_multiple, args.layout, args.chunk_elems,
         )
     common = dict(
         layout=args.layout,
@@ -69,6 +71,7 @@ def _train(args) -> int:
         solver=args.solver,
         solve_chunk=args.solve_chunk,
         pad_multiple=args.pad_multiple,
+        bucket_chunk_elems=args.chunk_elems,
     )
     manager = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     ck = dict(checkpoint_manager=manager, checkpoint_every=args.checkpoint_every)
@@ -234,9 +237,16 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--solve-chunk", type=int, default=None)
     t.add_argument("--pad-multiple", type=int, default=8)
     t.add_argument(
-        "--layout", choices=["padded", "bucketed"], default="padded",
-        help="InBlock layout: one rectangle, or power-of-two width buckets "
-        "(needed at full-Netflix scale)",
+        "--layout", choices=["padded", "bucketed", "segment"], default="padded",
+        help="InBlock layout: one rectangle, power-of-two width buckets "
+        "(needed at full-Netflix scale), or flat segment-sum runs "
+        "(exactly O(nnz) memory for arbitrarily skewed data)",
+    )
+    t.add_argument(
+        "--chunk-elems", type=int, default=1 << 20,
+        help="bucketed/segment layouts: HBM budget for the per-solve-chunk "
+        "neighbor-factor gather (rows·width cells; segment windows are "
+        "chunk_elems/64 entries)",
     )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)

@@ -59,9 +59,17 @@ class ALSConfig:
     #   "bucketed" — power-of-two width classes (the ALX layout); total
     #                padded cells stay within ~2× nnz, required at full
     #                Netflix-Prize scale. all_gather exchange only.
-    layout: Literal["padded", "bucketed"] = "padded"
-    # Bucketed layout: max rows·width per solve chunk — bounds the transient
-    # [chunk, width, rank] neighbor-factor gather in HBM.
+    #   "segment"  — flat CSR-style runs; Gram matrices accumulate by sorted
+    #                segment_sum over per-rating outer products. Exactly
+    #                O(nnz) memory for arbitrarily skewed degree
+    #                distributions. all_gather exchange only.
+    layout: Literal["padded", "bucketed", "segment"] = "padded"
+    # Bucketed/segment layouts: max gather cells per solve chunk — bounds the
+    # transient [chunk, width, rank] neighbor-factor gather (segment windows
+    # are chunk_elems/64 entries).  Consumed at dataset build time: pass it as
+    # Dataset.from_coo(..., chunk_elems=config.bucket_chunk_elems) — the CLI
+    # does (--chunk-elems); the chunk hints then live statically on the
+    # blocks, not in this config.
     bucket_chunk_elems: int = 1 << 20
 
     def __post_init__(self) -> None:
@@ -77,9 +85,16 @@ class ALSConfig:
             raise ValueError(f"unknown exchange {self.exchange!r}")
         if self.solver not in ("cholesky", "pallas"):
             raise ValueError(f"unknown solver {self.solver!r}")
-        if self.layout not in ("padded", "bucketed"):
+        if self.layout not in ("padded", "bucketed", "segment"):
             raise ValueError(f"unknown layout {self.layout!r}")
-        if self.layout == "bucketed" and self.exchange == "ring":
+        if self.layout != "padded" and self.exchange == "ring":
             raise ValueError(
-                "layout='bucketed' supports exchange='all_gather' only"
+                f"layout={self.layout!r} supports exchange='all_gather' only"
+            )
+        if self.layout != "padded" and self.solve_chunk is not None:
+            raise ValueError(
+                f"solve_chunk applies to layout='padded' only; with "
+                f"layout={self.layout!r} chunking is set at dataset build "
+                "time via Dataset.from_coo(..., chunk_elems=...) "
+                "(config.bucket_chunk_elems / --chunk-elems)"
             )

@@ -329,6 +329,122 @@ def build_bucketed_blocks(
 
 
 @dataclasses.dataclass(frozen=True)
+class SegmentBlocks:
+    """Flat CSR-style InBlocks: nnz-proportional memory, zero rectangle waste.
+
+    The third layout for the ragged-InBlock problem (SURVEY.md §5 long-context
+    analog): instead of padding entities into rectangles (``PaddedBlocks``) or
+    width classes (``BucketedBlocks``), ratings stay a flat sorted list and the
+    per-entity Gram matrices are accumulated with ``jax.ops.segment_sum`` over
+    per-rating outer products.  Memory is exactly O(nnz) regardless of the
+    degree distribution — the layout of choice when a power-law head entity
+    would dominate even the bucketed rectangles.
+
+    Rows are shard-major: shard s owns the flat slice [s·N, (s+1)·N) where
+    N = nnz_per_shard (max over shards, padded), so ``P("shard")`` sharding
+    hands each device its own ratings.  Within a shard, entries are sorted by
+    the owning entity's shard-local row; padding entries repeat the last real
+    segment id (keeping the sorted invariant) and are masked to zero.
+
+    Because the dense entity ids are *compact* (every id in an ``IdMap`` has
+    ≥ 1 rating), a sorted run of C entries spans < C distinct rows — the
+    invariant the windowed chunked accumulation in
+    ``cfk_tpu.ops.solve.als_half_step_segment`` relies on.
+    """
+
+    neighbor_idx: np.ndarray  # int32 [S·N] dense idx into the fixed side (0 at padding)
+    rating: np.ndarray  # float32 [S·N] (0 at padding)
+    mask: np.ndarray  # float32 [S·N] 1.0 = real rating
+    segment_local: np.ndarray  # int32 [S·N] owning entity's shard-local row, sorted per shard
+    count: np.ndarray  # int32 [E_pad] real nnz per entity (0 for pad rows)
+    rating_sum: np.ndarray  # float32 [E_pad] per-entity rating sum (for init)
+    num_entities: int
+    num_shards: int
+    chunk_nnz: int | None  # static hint: scan window size (divides N) or None
+
+    @property
+    def padded_entities(self) -> int:
+        return int(self.count.shape[0])
+
+    @property
+    def local_entities(self) -> int:
+        return self.padded_entities // self.num_shards
+
+    @property
+    def nnz_per_shard(self) -> int:
+        return int(self.neighbor_idx.shape[0]) // self.num_shards
+
+
+def build_segment_blocks(
+    solve_dense: np.ndarray,
+    fixed_dense: np.ndarray,
+    rating: np.ndarray,
+    num_solve_entities: int,
+    *,
+    num_shards: int = 1,
+    pad_multiple: int = 8,
+    chunk_nnz: int | None = None,
+) -> SegmentBlocks:
+    """Sort ratings by (shard, local entity row) into flat per-shard runs.
+
+    ``chunk_nnz`` (if the per-shard nnz exceeds it) becomes the static scan
+    window of the chunked accumulation; the per-shard length is padded to a
+    multiple of it so chunks reshape evenly.
+    """
+    e_pad = _round_up(num_solve_entities, num_shards)
+    e_local = e_pad // num_shards
+    count = np.bincount(solve_dense, minlength=num_solve_entities).astype(np.int32)
+
+    order = np.argsort(solve_dense, kind="stable")
+    s_sorted = solve_dense[order].astype(np.int64)
+    shard_of = s_sorted // e_local
+    per_shard = np.bincount(shard_of, minlength=num_shards)
+    n = _round_up(max(int(per_shard.max()), 1), pad_multiple)
+    if chunk_nnz is not None and n > chunk_nnz:
+        n = _round_up(n, chunk_nnz)
+    else:
+        chunk_nnz = None
+
+    shard_start = np.zeros(num_shards, dtype=np.int64)
+    np.cumsum(per_shard[:-1], out=shard_start[1:])
+    pos = np.arange(s_sorted.shape[0], dtype=np.int64) - shard_start[shard_of]
+    flat = shard_of * n + pos
+
+    neighbor = np.zeros(num_shards * n, dtype=np.int32)
+    rmat = np.zeros(num_shards * n, dtype=np.float32)
+    mask = np.zeros(num_shards * n, dtype=np.float32)
+    seg = np.zeros(num_shards * n, dtype=np.int32)
+    neighbor[flat] = fixed_dense[order].astype(np.int32)
+    rmat[flat] = rating[order].astype(np.float32)
+    mask[flat] = 1.0
+    seg[flat] = (s_sorted % e_local).astype(np.int32)
+    # Padding entries repeat the last real segment id of their shard so the
+    # per-shard sorted invariant holds (masked entries contribute zero).
+    for s in range(num_shards):
+        k = int(per_shard[s])
+        if 0 < k < n:
+            seg[s * n + k : (s + 1) * n] = seg[s * n + k - 1]
+
+    count_pad = np.zeros(e_pad, dtype=np.int32)
+    count_pad[:num_solve_entities] = count
+    rating_sum = np.zeros(e_pad, dtype=np.float32)
+    rating_sum[:num_solve_entities] = np.bincount(
+        solve_dense, weights=rating.astype(np.float64), minlength=num_solve_entities
+    ).astype(np.float32)
+    return SegmentBlocks(
+        neighbor_idx=neighbor,
+        rating=rmat,
+        mask=mask,
+        segment_local=seg,
+        count=count_pad,
+        rating_sum=rating_sum,
+        num_entities=num_solve_entities,
+        num_shards=num_shards,
+        chunk_nnz=chunk_nnz,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class RingBlocks:
     """Per-fixed-shard InBlocks for the ring (block-to-block join) exchange.
 
@@ -415,13 +531,15 @@ class Dataset:
     ``layout="padded"`` builds one rectangle per side (fine up to medium-scale
     data); ``layout="bucketed"`` builds power-of-two width classes — required
     at full-Netflix scale where the max-degree entity would blow up the single
-    rectangle.
+    rectangle; ``layout="segment"`` keeps ratings flat CSR-style and
+    accumulates Gram matrices by segment_sum — exactly O(nnz) memory for
+    arbitrarily skewed degree distributions.
     """
 
     movie_map: IdMap
     user_map: IdMap
-    movie_blocks: "PaddedBlocks | BucketedBlocks"  # solve movies, neighbors are users
-    user_blocks: "PaddedBlocks | BucketedBlocks"  # solve users, neighbors are movies
+    movie_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks"  # solve movies, neighbors are users
+    user_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks"  # solve users, neighbors are movies
     coo_dense: RatingsCOO  # dense-index COO (movie_raw/user_raw hold dense idx)
 
     @classmethod
@@ -444,6 +562,16 @@ class Dataset:
                 num_shards=num_shards,
                 pad_multiple=pad_multiple,
                 chunk_elems=chunk_elems,
+            )
+        elif layout == "segment":
+            # chunk_elems budgets peak gather cells·k for the rectangular
+            # layouts; the segment path's peak is the [C, k, k] outer-product
+            # window, so divide by a worst-case rank (k = 64) to match.
+            build = functools.partial(
+                build_segment_blocks,
+                num_shards=num_shards,
+                pad_multiple=pad_multiple,
+                chunk_nnz=None if chunk_elems is None else max(1, chunk_elems // 64),
             )
         elif layout == "padded":
             build = functools.partial(

@@ -24,10 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from cfk_tpu.config import ALSConfig
-from cfk_tpu.data.blocks import BucketedBlocks, Dataset, PaddedBlocks
+from cfk_tpu.data.blocks import BucketedBlocks, Dataset, PaddedBlocks, SegmentBlocks
 from cfk_tpu.ops.solve import (
     als_half_step,
     als_half_step_bucketed,
+    als_half_step_segment,
     init_factors,
     init_factors_stats,
 )
@@ -64,17 +65,31 @@ def _bucketed_to_device(blocks: BucketedBlocks):
     return jax.tree.map(jnp.asarray, trees), chunks
 
 
+def _segment_to_device(blocks: SegmentBlocks) -> dict[str, jax.Array]:
+    return {
+        "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
+        "rating": jnp.asarray(blocks.rating),
+        "mask": jnp.asarray(blocks.mask),
+        "segment_local": jnp.asarray(blocks.segment_local),
+        "count": jnp.asarray(blocks.count),
+    }
+
+
+def _stats_setup_guard(blocks, layout: str) -> None:
+    if blocks.num_shards != 1:
+        raise ValueError(
+            f"{layout} blocks were built for num_shards={blocks.num_shards}; "
+            "their row/segment indices are shard-local, so the single-device "
+            "trainer needs num_shards=1 — use the sharded trainer, or rebuild "
+            "with Dataset.from_coo(..., num_shards=1)"
+        )
+
+
 def _bucketed_device_setup(dataset: Dataset):
     """Single-device bucketed setup shared by train_als / train_ials:
     device block trees, user init stats, and the static layout kwargs."""
     mb, ub = dataset.movie_blocks, dataset.user_blocks
-    if mb.num_shards != 1:
-        raise ValueError(
-            f"bucketed blocks were built for num_shards={mb.num_shards}; "
-            "Bucket.entity_local is shard-local, so the single-device trainer "
-            "needs num_shards=1 — use the sharded trainer, or rebuild with "
-            "Dataset.from_coo(..., num_shards=1)"
-        )
+    _stats_setup_guard(mb, "bucketed")
     mblocks, m_chunks = _bucketed_to_device(mb)
     ublocks, u_chunks = _bucketed_to_device(ub)
     u_stats = {
@@ -90,12 +105,44 @@ def _bucketed_device_setup(dataset: Dataset):
     return mblocks, ublocks, u_stats, layout_kw
 
 
+def _segment_device_setup(dataset: Dataset):
+    """Single-device segment-layout setup: flat device arrays, init stats,
+    static local-entity counts + scan-window hints."""
+    mb, ub = dataset.movie_blocks, dataset.user_blocks
+    _stats_setup_guard(mb, "segment")
+    u_stats = {
+        "rating_sum": jnp.asarray(ub.rating_sum),
+        "count": jnp.asarray(ub.count),
+    }
+    layout_kw = dict(
+        m_chunks=mb.chunk_nnz,
+        u_chunks=ub.chunk_nnz,
+        m_entities=mb.padded_entities,
+        u_entities=ub.padded_entities,
+    )
+    return _segment_to_device(mb), _segment_to_device(ub), u_stats, layout_kw
+
+
 def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None):
     """Solve one side against fixed factors; dispatches on the block layout
-    (dict = one padded rectangle, tuple = width buckets)."""
+    (tuple = width buckets, dict with segment ids = flat segment run,
+    other dict = one padded rectangle)."""
     if isinstance(blk, tuple):
         return als_half_step_bucketed(
             fixed, blk, chunks, entities, lam, solver=solver
+        )
+    if "segment_local" in blk:
+        return als_half_step_segment(
+            fixed,
+            blk["neighbor_idx"],
+            blk["rating"],
+            blk["mask"],
+            blk["segment_local"],
+            blk["count"],
+            entities,
+            lam,
+            chunk_nnz=chunks,
+            solver=solver,
         )
     return als_half_step(
         fixed,
@@ -232,9 +279,12 @@ def train_als(
     metrics.gauge("num_ratings", int(dataset.movie_blocks.count.sum()))
     key = jax.random.PRNGKey(config.seed)
     bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
+    segment = isinstance(dataset.movie_blocks, SegmentBlocks)
     with metrics.phase("blocks_to_device"):
         if bucketed:
             mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)
+        elif segment:
+            mblocks, ublocks, u_stats, layout_kw = _segment_device_setup(dataset)
         else:
             mblocks = _blocks_to_device(dataset.movie_blocks)
             ublocks = _blocks_to_device(dataset.user_blocks)
@@ -273,7 +323,7 @@ def train_als(
             m = jnp.asarray(state.movie_factors, dtype=dt)
         else:
             start_iter = 0
-            if bucketed:
+            if u_stats is not None:
                 u = init_factors_stats(
                     key, u_stats["rating_sum"], u_stats["count"], config.rank
                 ).astype(dt)

@@ -22,23 +22,23 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
 from cfk_tpu.config import ALSConfig
-from cfk_tpu.data.blocks import BucketedBlocks, Dataset
-from cfk_tpu.models.als import ALSModel, _blocks_to_device, _bucketed_device_setup
+from cfk_tpu.data.blocks import BucketedBlocks, Dataset, SegmentBlocks
+from cfk_tpu.models.als import (
+    ALSModel,
+    _blocks_to_device,
+    _bucketed_device_setup,
+    _segment_device_setup,
+)
 from cfk_tpu.ops.solve import (
     global_gram,
     ials_half_step,
     ials_half_step_bucketed,
+    ials_half_step_segment,
     init_factors,
     init_factors_stats,
 )
 from cfk_tpu.parallel.mesh import AXIS, shard_rows
-from cfk_tpu.parallel.spmd import use_check_vma
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,10 +61,17 @@ class IALSConfig(ALSConfig):
 
 def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
                entities=None):
-    """Dispatch on block layout (dict = padded rectangle, tuple = buckets)."""
+    """Dispatch on block layout (tuple = buckets, dict with segment ids =
+    flat segment run, other dict = padded rectangle)."""
     if isinstance(blk, tuple):
         return ials_half_step_bucketed(
             fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
+        )
+    if "segment_local" in blk:
+        return ials_half_step_segment(
+            fixed, blk["neighbor_idx"], blk["rating"], blk["mask"],
+            blk["segment_local"], entities, lam, alpha,
+            gram=gram, chunk_nnz=chunks, solver=solver,
         )
     return ials_half_step(
         fixed, blk["neighbor_idx"], blk["rating"], blk["mask"], lam, alpha,
@@ -120,6 +127,8 @@ def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSMode
     key = jax.random.PRNGKey(config.seed)
     if isinstance(dataset.movie_blocks, BucketedBlocks):
         mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)
+    elif isinstance(dataset.movie_blocks, SegmentBlocks):
+        mblocks, ublocks, u_stats, layout_kw = _segment_device_setup(dataset)
     else:
         mblocks = _blocks_to_device(dataset.movie_blocks)
         ublocks = _blocks_to_device(dataset.user_blocks)
@@ -159,36 +168,53 @@ def make_ials_training_step(
     u_local=None,
     mspecs=None,
     uspecs=None,
+    segment=False,
 ):
     """Jittable one-full-iteration SPMD step for iALS.
 
     Per half-iteration: psum the local [k,k] Grams, all_gather the fixed
-    factors, solve local entities (per width bucket when ``m_chunks`` given).
+    factors, solve local entities (per width bucket when ``m_chunks`` given,
+    or by segment_sum over the flat local run when ``segment=True``).
     """
-    dt = jnp.dtype(config.dtype)
+    from cfk_tpu.parallel.spmd import wrap_step
+
+    if segment:  # flat segment layout
+
+        def half_segment(chunk_nnz, local):
+            def half(fixed_local, blk):
+                gram = lax.psum(global_gram(fixed_local), AXIS)
+                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+                return ials_half_step_segment(
+                    fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
+                    blk["segment"], local, config.lam, config.alpha,
+                    gram=gram, chunk_nnz=chunk_nnz, solver=config.solver,
+                )
+
+            return half
+
+        return wrap_step(
+            mesh, config,
+            half_segment(m_chunks, m_local), half_segment(u_chunks, u_local),
+            mspecs, uspecs,
+        )
 
     if m_chunks is not None:  # bucketed layout
 
-        def half_bucketed(fixed_local, blk, chunks, local):
-            gram = lax.psum(global_gram(fixed_local), AXIS)
-            fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
-            return ials_half_step_bucketed(
-                fixed_full, blk, chunks, local, config.lam, config.alpha,
-                gram=gram, solver=config.solver,
-            ).astype(dt)
+        def half_bucketed(chunks, local):
+            def half(fixed_local, blk):
+                gram = lax.psum(global_gram(fixed_local), AXIS)
+                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+                return ials_half_step_bucketed(
+                    fixed_full, blk, chunks, local, config.lam, config.alpha,
+                    gram=gram, solver=config.solver,
+                )
 
-        def iteration(u, m_unused, mblk, ublk):
-            del m_unused
-            m = half_bucketed(u, mblk, m_chunks, m_local)
-            u_new = half_bucketed(m, ublk, u_chunks, u_local)
-            return u_new, m
+            return half
 
-        return _shard_map(
-            iteration,
-            mesh=mesh,
-            in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
-            out_specs=(P(AXIS, None), P(AXIS, None)),
-            check_vma=use_check_vma(config),
+        return wrap_step(
+            mesh, config,
+            half_bucketed(m_chunks, m_local), half_bucketed(u_chunks, u_local),
+            mspecs, uspecs,
         )
 
     def half(fixed_local, blk):
@@ -197,13 +223,7 @@ def make_ials_training_step(
         return ials_half_step(
             fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
             config.lam, config.alpha, gram=gram, solver=config.solver,
-        ).astype(dt)
-
-    def iteration(u, m_unused, mblk, ublk):
-        del m_unused
-        m = half(u, mblk)
-        u_new = half(m, ublk)
-        return u_new, m
+        )
 
     spec = {
         "neighbor": P(AXIS, None),
@@ -211,13 +231,7 @@ def make_ials_training_step(
         "mask": P(AXIS, None),
         "count": P(AXIS),
     }
-    return _shard_map(
-        iteration,
-        mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS, None), spec, spec),
-        out_specs=(P(AXIS, None), P(AXIS, None)),
-        check_vma=use_check_vma(config),
-    )
+    return wrap_step(mesh, config, half, half, spec, spec)
 
 
 def train_ials_sharded(
@@ -246,21 +260,14 @@ def train_ials_sharded(
             "count": blocks.count,
         }
 
-    bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
-    step_kw = {}
-    if bucketed:
-        from cfk_tpu.parallel.spmd import _bucketed_to_tree, _tree_specs
+    from cfk_tpu.parallel.spmd import gathered_layout_trees, tree_specs
 
-        mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
-        utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
-        step_kw = dict(
-            m_chunks=m_chunks,
-            u_chunks=u_chunks,
-            m_local=dataset.movie_blocks.local_entities,
-            u_local=dataset.user_blocks.local_entities,
-            mspecs=_tree_specs(mtree),
-            uspecs=_tree_specs(utree),
-        )
+    gathered = gathered_layout_trees(dataset, config)
+    stats_init = gathered is not None  # bucketed/segment: init from stats
+    step_kw = {}
+    if gathered is not None:
+        mtree, utree, step_kw = gathered
+        step_kw.update(mspecs=tree_specs(mtree), uspecs=tree_specs(utree))
         mtree = shard_rows(mesh, mtree)
         utree = shard_rows(mesh, utree)
     else:
@@ -281,7 +288,7 @@ def train_ials_sharded(
     else:
         start_iter = 0
         key = jax.random.PRNGKey(config.seed)
-        if bucketed:
+        if stats_init:
             u = jax.jit(init_factors_stats, static_argnames="rank")(
                 key,
                 jnp.asarray(dataset.user_blocks.rating_sum),

@@ -260,6 +260,150 @@ def als_half_step(
     return out.reshape(e, fixed_factors.shape[-1])
 
 
+def _segment_gram_flat(fixed_factors, neighbor_idx, weight, rating, mask, num_segments, segment_ids):
+    """Gram/RHS contributions of a flat run of ratings via sorted segment_sum.
+
+    A[e] += Σ w·f fᵀ and b[e] += Σ r·f over the run's entries owned by e
+    (``weight`` is 1 for explicit ALS, the confidence excess c−1 for iALS;
+    ``rating`` is r for explicit, c·preference = c for iALS).  Padding entries
+    are masked to zero so their (repeated) segment ids contribute nothing.
+    """
+    f = fixed_factors[neighbor_idx].astype(jnp.float32) * mask[:, None]
+    fw = f * weight[:, None]
+    a = jax.ops.segment_sum(
+        fw[:, :, None] * f[:, None, :], segment_ids,
+        num_segments=num_segments, indices_are_sorted=True,
+    )
+    b = jax.ops.segment_sum(
+        rating[:, None] * f, segment_ids,
+        num_segments=num_segments, indices_are_sorted=True,
+    )
+    return a, b
+
+
+def segment_gram(
+    fixed_factors: jax.Array,  # [F, k]
+    neighbor_idx: jax.Array,  # [N] int32
+    weight: jax.Array,  # [N] per-entry Gram weight (1 for ALS, α·r for iALS)
+    rating: jax.Array,  # [N] per-entry RHS weight (r for ALS, c for iALS)
+    mask: jax.Array,  # [N] 1 = real entry
+    segment_ids: jax.Array,  # [N] sorted shard-local entity rows
+    local_entities: int,
+    *,
+    chunk_nnz: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-entity Gram matrices from a flat sorted rating run.
+
+    Returns (A [E, k, k], b [E, k]) for E = ``local_entities``.  With
+    ``chunk_nnz`` the run is scanned in windows of C entries; because dense
+    entity ids are compact (every id has ≥ 1 rating — ``IdMap`` invariant), a
+    sorted window spans < C rows, so each step's segment_sum and
+    accumulator update touch only a C-row window instead of re-writing the
+    whole [E, k, k] accumulator: HBM traffic stays O(nnz·k²) + O(E·k²)
+    rather than O(n_chunks·E·k²).
+    """
+    k = fixed_factors.shape[-1]
+    nnz = neighbor_idx.shape[0]
+    if chunk_nnz is None or chunk_nnz >= nnz:
+        return _segment_gram_flat(
+            fixed_factors, neighbor_idx, weight, rating, mask,
+            local_entities, segment_ids,
+        )
+    if nnz % chunk_nnz != 0:
+        raise ValueError(f"nnz {nnz} not divisible by chunk_nnz {chunk_nnz}")
+    w = chunk_nnz
+
+    def body(carry, chunk):
+        a, b = carry
+        nb_c, wt_c, rt_c, mk_c, seg_c = chunk
+        start = seg_c[0]
+        aw, bw = _segment_gram_flat(
+            fixed_factors, nb_c, wt_c, rt_c, mk_c, w, seg_c - start
+        )
+        a = lax.dynamic_update_slice(
+            a, lax.dynamic_slice(a, (start, 0, 0), (w, k, k)) + aw, (start, 0, 0)
+        )
+        b = lax.dynamic_update_slice(
+            b, lax.dynamic_slice(b, (start, 0), (w, k)) + bw, (start, 0)
+        )
+        return (a, b), None
+
+    # W overhang rows absorb windows starting near the last real row.  The
+    # accumulators borrow a zero from the (device-varying, under shard_map)
+    # inputs so the scan carry's varying-mesh-axes type matches the updates.
+    zero = (rating[0] * 0.0).astype(jnp.float32)
+    a0 = jnp.zeros((local_entities + w, k, k), jnp.float32) + zero
+    b0 = jnp.zeros((local_entities + w, k), jnp.float32) + zero
+    reshape = lambda x: x.reshape((nnz // w, w) + x.shape[1:])
+    (a, b), _ = lax.scan(
+        body,
+        (a0, b0),
+        (reshape(neighbor_idx), reshape(weight), reshape(rating),
+         reshape(mask), reshape(segment_ids)),
+    )
+    return a[:local_entities], b[:local_entities]
+
+
+def als_half_step_segment(
+    fixed_factors: jax.Array,  # [F, k]
+    neighbor_idx: jax.Array,  # [N]
+    rating: jax.Array,  # [N]
+    mask: jax.Array,  # [N]
+    segment_ids: jax.Array,  # [N]
+    count: jax.Array,  # [E] per-entity nnz (shard-local)
+    local_entities: int,
+    lam: float,
+    *,
+    chunk_nnz: int | None = None,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """One explicit ALS-WR half-iteration over the flat segment layout.
+
+    Semantics match ``als_half_step`` exactly (same normal equations, same
+    λ·n·I regularization); only the Gram accumulation differs — segment_sum
+    over sorted per-rating outer products instead of rectangular einsums.
+    Zero-rating rows (global entity-pad tail) never appear as a segment id,
+    so their A stays 0 and the λ-floored solve returns 0, matching the
+    rectangular paths.
+    """
+    a, b = segment_gram(
+        fixed_factors, neighbor_idx, jnp.ones_like(rating), rating, mask,
+        segment_ids, local_entities, chunk_nnz=chunk_nnz,
+    )
+    return regularized_solve(a, b, count, lam, solver)
+
+
+def ials_half_step_segment(
+    fixed_factors: jax.Array,  # [F, k]
+    neighbor_idx: jax.Array,  # [N]
+    rating: jax.Array,  # [N] raw counts/ratings; confidence c = 1 + α·r
+    mask: jax.Array,  # [N]
+    segment_ids: jax.Array,  # [N]
+    local_entities: int,
+    lam: float,
+    alpha: float,
+    *,
+    gram: jax.Array | None = None,  # precomputed YᵀY (pass psum'd under SPMD)
+    chunk_nnz: int | None = None,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """Implicit-feedback half-iteration over the flat segment layout.
+
+    Per entity A = YᵀY + Σ_obs (c−1)·f fᵀ + λI, b = Σ_obs c·f (Hu et al.
+    2008 with the global-Gram trick).  Zero-interaction rows solve
+    (YᵀY + λI)x = 0 → 0, identical to the rectangular paths.
+    """
+    k = fixed_factors.shape[-1]
+    if gram is None:
+        gram = global_gram(fixed_factors)
+    a_obs, b = segment_gram(
+        fixed_factors, neighbor_idx, alpha * rating, (1.0 + alpha * rating) * mask,
+        mask, segment_ids, local_entities, chunk_nnz=chunk_nnz,
+    )
+    a = gram[None] + a_obs + lam * jnp.eye(k, dtype=jnp.float32)[None]
+    return dispatch_spd_solve(a, b, solver)
+
+
 def init_factors(
     key: jax.Array,
     rating: jax.Array,  # [E, P]

@@ -43,12 +43,14 @@ from cfk_tpu.data.blocks import (
     Dataset,
     PaddedBlocks,
     RingBlocks,
+    SegmentBlocks,
     build_ring_blocks,
 )
 from cfk_tpu.models.als import ALSModel
 from cfk_tpu.ops.solve import (
     als_half_step,
     als_half_step_bucketed,
+    als_half_step_segment,
     gather_gram,
     init_factors,
     init_factors_stats,
@@ -137,6 +139,17 @@ def half_step_ring(
     return regularized_solve(a + ap, b + bp, cnt, lam, solver)
 
 
+def _segment_to_tree(blocks: SegmentBlocks) -> dict[str, np.ndarray]:
+    """Flat per-shard runs; every leaf rows-shards over P(AXIS)."""
+    return {
+        "neighbor": blocks.neighbor_idx,
+        "rating": blocks.rating,
+        "mask": blocks.mask,
+        "segment": blocks.segment_local,
+        "count": blocks.count,
+    }
+
+
 # Both exchange layouts expose the same tree keys; "neighbor" holds dense
 # global indices for all_gather blocks, shard-local indices for ring blocks.
 def _padded_to_tree(blocks: PaddedBlocks) -> dict[str, np.ndarray]:
@@ -163,10 +176,73 @@ def _bucketed_to_tree(blocks: BucketedBlocks):
     return blocks.to_tree()
 
 
-def _tree_specs(tree):
+def tree_specs(tree):
     return jax.tree.map(
         lambda v: P(AXIS, *([None] * (v.ndim - 1))), tree
     )
+
+
+_tree_specs = tree_specs  # back-compat alias
+
+
+def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs):
+    """The one shard_map scaffold every training step shares.
+
+    ``half_m``/``half_u`` map (fixed_local, local_block_tree) → new local
+    factors for one side; the wrapper sequences the two half-iterations,
+    casts factors to the storage/exchange dtype, and binds the row shardings.
+    """
+    dtype = jnp.dtype(config.dtype)
+
+    def iteration(u, m_unused, mblk, ublk):
+        del m_unused
+        m = half_m(u, mblk).astype(dtype)
+        u_new = half_u(m, ublk).astype(dtype)
+        return u_new, m
+
+    return _shard_map(
+        iteration,
+        mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
+        out_specs=(P(AXIS, None), P(AXIS, None)),
+        check_vma=use_check_vma(config),
+    )
+
+
+def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
+    """Block trees + step kwargs for the all_gather-only layouts.
+
+    Returns (mtree, utree, step_kw) for bucketed/segment datasets — the
+    setup shared by the explicit and implicit sharded trainers — or None
+    when the dataset uses padded rectangles (caller picks per-exchange).
+    """
+    bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
+    segment = isinstance(dataset.movie_blocks, SegmentBlocks)
+    if not (bucketed or segment):
+        return None
+    if config.exchange != "all_gather":
+        raise ValueError(
+            f"{'bucketed' if bucketed else 'segment'} layout supports "
+            "exchange='all_gather' only; the ring exchange needs "
+            "shard-local neighbor indices (use layout='padded' or "
+            "exchange='all_gather')"
+        )
+    if bucketed:
+        mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
+        utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
+    else:
+        mtree = _segment_to_tree(dataset.movie_blocks)
+        utree = _segment_to_tree(dataset.user_blocks)
+        m_chunks = dataset.movie_blocks.chunk_nnz
+        u_chunks = dataset.user_blocks.chunk_nnz
+    step_kw = dict(
+        m_chunks=m_chunks,
+        u_chunks=u_chunks,
+        m_local=dataset.movie_blocks.local_entities,
+        u_local=dataset.user_blocks.local_entities,
+        segment=segment,
+    )
+    return mtree, utree, step_kw
 
 
 def use_check_vma(config: ALSConfig) -> bool:
@@ -188,49 +264,74 @@ def make_training_step(
     u_chunks=None,
     m_local=None,
     u_local=None,
+    segment=False,
 ):
     """Build the jittable one-full-iteration SPMD step (solve M, then U).
 
     Returned ``step(u, m, mblocks, ublocks) -> (u, m)`` operates on
     row-sharded global arrays; collectives are explicit inside shard_map.
     The bucketed layout (``m_chunks`` given) all_gathers the fixed side and
-    solves each width bucket of the local shard.
+    solves each width bucket of the local shard; the segment layout
+    (``segment=True``; ``m_chunks`` is then the static scan-window hint)
+    all_gathers the fixed side and segment-sums the local flat rating run.
     """
     dtype = jnp.dtype(config.dtype)
     if uspecs is None:
         uspecs = mspecs
 
+    if segment:  # flat segment layout, all_gather exchange
+
+        def half_segment(chunk_nnz, local):
+            def half(fixed_local, blk):
+                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+                return als_half_step_segment(
+                    fixed_full,
+                    blk["neighbor"],
+                    blk["rating"],
+                    blk["mask"],
+                    blk["segment"],
+                    blk["count"],
+                    local,
+                    config.lam,
+                    chunk_nnz=chunk_nnz,
+                    solver=config.solver,
+                )
+
+            return half
+
+        return wrap_step(
+            mesh, config,
+            half_segment(m_chunks, m_local), half_segment(u_chunks, u_local),
+            mspecs, uspecs,
+        )
+
     if m_chunks is not None:  # bucketed layout, all_gather exchange
 
-        def half_bucketed(fixed_local, blk, chunks, local):
-            fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
-            return als_half_step_bucketed(
-                fixed_full, blk, chunks, local, config.lam, solver=config.solver
-            )
+        def half_bucketed(chunks, local):
+            def half(fixed_local, blk):
+                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+                return als_half_step_bucketed(
+                    fixed_full, blk, chunks, local, config.lam,
+                    solver=config.solver,
+                )
 
-        def iteration(u, m_unused, mblk, ublk):
-            del m_unused
-            m = half_bucketed(u, mblk, m_chunks, m_local).astype(dtype)
-            u_new = half_bucketed(m, ublk, u_chunks, u_local).astype(dtype)
-            return u_new, m
+            return half
 
-        return _shard_map(
-            iteration,
-            mesh=mesh,
-            in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
-            out_specs=(P(AXIS, None), P(AXIS, None)),
-            check_vma=use_check_vma(config),
+        return wrap_step(
+            mesh, config,
+            half_bucketed(m_chunks, m_local), half_bucketed(u_chunks, u_local),
+            mspecs, uspecs,
         )
 
     if config.exchange == "all_gather":
-        half = functools.partial(
+        half_rect = functools.partial(
             half_step_allgather,
             lam=config.lam,
             solve_chunk=config.solve_chunk,
             solver=config.solver,
         )
     else:
-        half = functools.partial(
+        half_rect = functools.partial(
             half_step_ring,
             lam=config.lam,
             num_shards=config.num_shards,
@@ -238,22 +339,14 @@ def make_training_step(
             solver=config.solver,
         )
 
-    def iteration(u, m_unused, mblk, ublk):
-        del m_unused
-        m = half(u, mblk["neighbor"], mblk["rating"], mblk["mask"], mblk["count"])
-        # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI
-        # bytes and HBM); the Gram math upcasts to float32 internally.
-        m = m.astype(dtype)
-        u_new = half(m, ublk["neighbor"], ublk["rating"], ublk["mask"], ublk["count"])
-        return u_new.astype(dtype), m
+    # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI bytes
+    # and HBM); the Gram math upcasts to float32 internally (wrap_step casts).
+    def half(fixed_local, blk):
+        return half_rect(
+            fixed_local, blk["neighbor"], blk["rating"], blk["mask"], blk["count"]
+        )
 
-    return _shard_map(
-        iteration,
-        mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
-        out_specs=(P(AXIS, None), P(AXIS, None)),
-        check_vma=use_check_vma(config),
-    )
+    return wrap_step(mesh, config, half, half, mspecs, uspecs)
 
 
 def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) -> None:
@@ -270,12 +363,13 @@ def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) ->
                 f"divisible by num_shards={s}; rebuild the Dataset with "
                 f"Dataset.from_coo(..., num_shards={s})"
             )
-        if isinstance(blocks, BucketedBlocks) and blocks.num_shards != s:
+        if isinstance(blocks, (BucketedBlocks, SegmentBlocks)) and blocks.num_shards != s:
+            layout = "bucketed" if isinstance(blocks, BucketedBlocks) else "segment"
             raise ValueError(
-                f"{name}_blocks were bucketed for num_shards={blocks.num_shards} "
-                f"but config.num_shards={s}; Bucket.entity_local is shard-local, "
-                f"so rebuild with Dataset.from_coo(..., num_shards={s}, "
-                "layout='bucketed')"
+                f"{name}_blocks were built for num_shards={blocks.num_shards} "
+                f"but config.num_shards={s}; their row/segment indices are "
+                f"shard-local, so rebuild with Dataset.from_coo(..., "
+                f"num_shards={s}, layout='{layout}')"
             )
 
 
@@ -298,23 +392,11 @@ def train_als_sharded(
     s = config.num_shards
     validate_sharded_dataset(dataset, config, mesh)
 
-    bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
+    gathered = gathered_layout_trees(dataset, config)
+    stats_init = gathered is not None  # bucketed/segment: init from stats
     step_kw = {}
-    if bucketed:
-        if config.exchange != "all_gather":
-            raise ValueError(
-                "bucketed layout supports exchange='all_gather' only; the "
-                "ring exchange needs shard-local neighbor indices (use "
-                "layout='padded' or exchange='all_gather')"
-            )
-        mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
-        utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
-        step_kw = dict(
-            m_chunks=m_chunks,
-            u_chunks=u_chunks,
-            m_local=dataset.movie_blocks.local_entities,
-            u_local=dataset.user_blocks.local_entities,
-        )
+    if gathered is not None:
+        mtree, utree, step_kw = gathered
     elif config.exchange == "all_gather":
         mtree = _padded_to_tree(dataset.movie_blocks)
         utree = _padded_to_tree(dataset.user_blocks)
@@ -356,7 +438,7 @@ def train_als_sharded(
         # Init outside shard_map: threefry values per row are independent of
         # the padded row count, so 1-way and N-way runs start identically.
         key = jax.random.PRNGKey(config.seed)
-        if bucketed:
+        if stats_init:
             u = jax.jit(init_factors_stats, static_argnames="rank")(
                 key,
                 jnp.asarray(dataset.user_blocks.rating_sum),

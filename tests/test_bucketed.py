@@ -222,5 +222,5 @@ def test_sharded_rejects_mismatched_buckets():
     coo = powerlaw_coo(n_movies=40, n_users=64, nnz=500)
     ds = Dataset.from_coo(coo, num_shards=2, layout="bucketed")
     config = ALSConfig(rank=4, num_iterations=1, num_shards=8, layout="bucketed")
-    with pytest.raises(ValueError, match="bucketed for num_shards=2"):
+    with pytest.raises(ValueError, match="built for num_shards=2"):
         train_als_sharded(ds, config, make_mesh(8))

@@ -1,0 +1,200 @@
+"""Segment-sum (flat CSR) InBlock layout: structure, equivalence, SPMD, scale.
+
+The segment layout is the third answer to ragged InBlocks (SURVEY.md §5
+long-context analog): ratings stay a flat sorted run and per-entity Gram
+matrices accumulate by sorted ``segment_sum`` — exactly O(nnz) memory for
+arbitrarily skewed degree distributions, where even the bucketed width
+classes would pad.
+"""
+
+import numpy as np
+import pytest
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import (
+    Dataset,
+    build_padded_blocks,
+    build_segment_blocks,
+)
+from tests.test_bucketed import powerlaw_coo
+
+
+def reconstruct_triples(blocks):
+    """(entity_dense, neighbor_dense, rating) triples from the flat runs."""
+    n = blocks.nnz_per_shard
+    e_local = blocks.local_entities
+    flat = np.flatnonzero(blocks.mask)
+    shard = flat // n
+    entity = shard * e_local + blocks.segment_local[flat]
+    return np.stack(
+        [entity, blocks.neighbor_idx[flat], blocks.rating[flat]], axis=1
+    )
+
+
+def test_segment_structure_roundtrip():
+    coo = powerlaw_coo()
+    ds = Dataset.from_coo(coo)
+    cd = ds.coo_dense
+    for shards in (1, 4):
+        blocks = build_segment_blocks(
+            cd.movie_raw, cd.user_raw, cd.rating,
+            ds.movie_map.num_entities, num_shards=shards,
+        )
+        got = reconstruct_triples(blocks)
+        want = np.stack([cd.movie_raw, cd.user_raw, cd.rating], axis=1)
+        got = got[np.lexsort(got.T[::-1])]
+        want = want[np.lexsort(want.T[::-1])]
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(
+            blocks.count[: ds.movie_map.num_entities],
+            np.bincount(cd.movie_raw, minlength=ds.movie_map.num_entities),
+        )
+        # per-shard runs are sorted (incl. repeated-tail padding ids)
+        seg = blocks.segment_local.reshape(shards, -1)
+        assert np.all(np.diff(seg, axis=1) >= 0)
+        # flat length is exactly S · round_up(max per-shard nnz): no
+        # rectangle waste, only cross-shard load skew + rounding
+        e_local = blocks.local_entities
+        per_shard = np.bincount(cd.movie_raw // e_local, minlength=shards)
+        want_n = -(-max(int(per_shard.max()), 1) // 8) * 8
+        assert blocks.nnz_per_shard == want_n
+
+
+def test_segment_memory_is_nnz_proportional():
+    """One degree-10k head entity blows up rectangles, not the flat run."""
+    rng = np.random.default_rng(0)
+    head_users = np.arange(1, 10001)
+    tail_m = rng.integers(2, 300, size=3000)
+    tail_u = rng.integers(1, 10001, size=3000)
+    movie = np.concatenate([np.ones(10000, np.int64), tail_m])
+    user = np.concatenate([head_users, tail_u]).astype(np.int64)
+    rating = rng.integers(1, 6, size=movie.size).astype(np.float32)
+
+    from cfk_tpu.data.blocks import IdMap
+
+    mmap = IdMap.from_raw(movie)
+    m_dense = mmap.to_dense(movie)
+    u_dense = IdMap.from_raw(user).to_dense(user)
+    padded = build_padded_blocks(m_dense, u_dense, rating, mmap.num_entities)
+    seg = build_segment_blocks(m_dense, u_dense, rating, mmap.num_entities)
+    assert padded.neighbor_idx.size > 20 * seg.neighbor_idx.size
+
+
+def test_segment_als_matches_padded(tiny_coo):
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.models.als import train_als
+
+    config = ALSConfig(rank=5, lam=0.05, num_iterations=3, seed=0)
+    ds_p = Dataset.from_coo(tiny_coo, layout="padded")
+    ds_s = Dataset.from_coo(tiny_coo, layout="segment")
+    preds_p = train_als(ds_p, config).predict_dense()
+    preds_s = train_als(ds_s, config).predict_dense()
+    np.testing.assert_allclose(preds_s, preds_p, atol=2e-3, rtol=1e-3)
+    mse_p, _ = mse_rmse_from_blocks(preds_p, ds_p)
+    mse_s, _ = mse_rmse_from_blocks(preds_s, ds_s)
+    assert abs(mse_p - mse_s) < 1e-4
+
+
+def test_segment_chunked_matches_unchunked(tiny_coo):
+    from cfk_tpu.models.als import train_als
+
+    config = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0)
+    ds_one = Dataset.from_coo(tiny_coo, layout="segment", chunk_elems=None)
+    # chunk_nnz = chunk_elems // 64 → windows of 8 entries
+    ds_chunked = Dataset.from_coo(tiny_coo, layout="segment", chunk_elems=512)
+    assert ds_chunked.movie_blocks.chunk_nnz == 8
+    assert ds_one.movie_blocks.chunk_nnz is None
+    preds_one = train_als(ds_one, config).predict_dense()
+    preds_chunked = train_als(ds_chunked, config).predict_dense()
+    np.testing.assert_allclose(preds_chunked, preds_one, atol=1e-4, rtol=1e-4)
+
+
+def test_segment_spmd_matches_single_device():
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = powerlaw_coo(n_movies=96, n_users=160, nnz=3000)
+    config1 = ALSConfig(rank=6, lam=0.05, num_iterations=3, seed=3)
+    single = train_als(Dataset.from_coo(coo, layout="segment"), config1).predict_dense()
+
+    config8 = ALSConfig(
+        rank=6, lam=0.05, num_iterations=3, seed=3, num_shards=8,
+        layout="segment",
+    )
+    ds8 = Dataset.from_coo(coo, num_shards=8, layout="segment")
+    sharded = train_als_sharded(ds8, config8, make_mesh(8)).predict_dense()
+    np.testing.assert_allclose(sharded, single, atol=2e-3, rtol=1e-3)
+
+
+def test_segment_spmd_chunked_matches_single_device():
+    """Sharded + windowed scan together (the full-Netflix configuration)."""
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = powerlaw_coo(n_movies=64, n_users=96, nnz=2000)
+    config1 = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=1)
+    single = train_als(Dataset.from_coo(coo, layout="segment"), config1).predict_dense()
+    config8 = ALSConfig(
+        rank=4, lam=0.05, num_iterations=2, seed=1, num_shards=8, layout="segment",
+    )
+    ds8 = Dataset.from_coo(coo, num_shards=8, layout="segment", chunk_elems=2048)
+    assert ds8.movie_blocks.chunk_nnz is not None
+    sharded = train_als_sharded(ds8, config8, make_mesh(8)).predict_dense()
+    np.testing.assert_allclose(sharded, single, atol=2e-3, rtol=1e-3)
+
+
+def test_segment_ials_matches_padded():
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+
+    coo = powerlaw_coo(n_movies=80, n_users=120, nnz=2000)
+    config = IALSConfig(rank=6, lam=0.1, alpha=10.0, num_iterations=3, seed=0)
+    preds_p = train_ials(Dataset.from_coo(coo, layout="padded"), config).predict_dense()
+    preds_s = train_ials(Dataset.from_coo(coo, layout="segment"), config).predict_dense()
+    np.testing.assert_allclose(preds_s, preds_p, atol=2e-3, rtol=1e-3)
+
+
+def test_segment_ials_sharded_matches_single():
+    from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
+    from cfk_tpu.parallel.mesh import make_mesh
+
+    coo = powerlaw_coo(n_movies=64, n_users=96, nnz=1500)
+    config1 = IALSConfig(rank=5, lam=0.1, alpha=5.0, num_iterations=2, seed=1)
+    single = train_ials(
+        Dataset.from_coo(coo, layout="segment"), config1
+    ).predict_dense()
+    config8 = IALSConfig(
+        rank=5, lam=0.1, alpha=5.0, num_iterations=2, seed=1, num_shards=8,
+        layout="segment",
+    )
+    ds8 = Dataset.from_coo(coo, num_shards=8, layout="segment")
+    sharded = train_ials_sharded(ds8, config8, make_mesh(8)).predict_dense()
+    np.testing.assert_allclose(sharded, single, atol=2e-3, rtol=1e-3)
+
+
+def test_segment_golden_tiny(tiny_coo):
+    """Reference config on tiny must hit the published quality bar
+    (README.md:207-211: MSE 0.265) with the segment layout too."""
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.models.als import train_als
+
+    ds = Dataset.from_coo(tiny_coo, layout="segment")
+    config = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=42)
+    preds = train_als(ds, config).predict_dense()
+    mse, rmse = mse_rmse_from_blocks(preds, ds)
+    assert mse <= 0.30, f"tiny MSE {mse} above reference-quality bar"
+
+
+def test_config_rejects_segment_ring():
+    with pytest.raises(ValueError, match="all_gather"):
+        ALSConfig(layout="segment", exchange="ring")
+
+
+def test_single_device_rejects_sharded_segments():
+    from cfk_tpu.models.als import train_als
+
+    coo = powerlaw_coo(n_movies=40, n_users=60, nnz=500)
+    ds = Dataset.from_coo(coo, num_shards=4, layout="segment")
+    with pytest.raises(ValueError, match="num_shards=4"):
+        train_als(ds, ALSConfig(rank=4, num_iterations=1))
