@@ -200,6 +200,48 @@ def _evaluate(args) -> int:
     return 0
 
 
+def _recommend(args) -> int:
+    """Serve top-K from checkpointed factors, printing raw ids."""
+    import numpy as np
+
+    from cfk_tpu.data.blocks import RatingsIndex
+    from cfk_tpu.data.movielens import parse_movielens_csv
+    from cfk_tpu.data.netflix import parse_netflix
+    from cfk_tpu.models.als import ALSModel
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    # Only the id maps + seen lists are needed — never build solve blocks
+    # (a padded rectangle at full-Netflix scale would dwarf serving memory).
+    if args.format == "netflix":
+        coo = parse_netflix(args.data)
+    else:
+        coo = parse_movielens_csv(args.data, min_rating=args.min_rating)
+    ds = RatingsIndex.from_coo(coo)
+    state = CheckpointManager(args.checkpoint_dir).restore()
+    model = ALSModel(
+        user_factors=state.user_factors,
+        movie_factors=state.movie_factors,
+        num_users=ds.user_map.num_entities,
+        num_movies=ds.movie_map.num_entities,
+    )
+    if args.users == "all":
+        rows = np.arange(ds.user_map.num_entities)
+    else:
+        raw = np.asarray([int(u) for u in args.users.split(",")], dtype=np.int64)
+        rows = ds.user_map.to_dense(raw).astype(np.int64)
+    scores, movie_rows = model.recommend_top_k(
+        rows, args.k, dataset=None if args.include_seen else ds
+    )
+    raw_movies = ds.movie_map.raw_ids[movie_rows]
+    raw_users = ds.user_map.raw_ids[rows]
+    for i, u in enumerate(raw_users):
+        pairs = ",".join(
+            f"{mid}:{s:.3f}" for mid, s in zip(raw_movies[i], scores[i])
+        )
+        print(f"{u}\t{pairs}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cfk_tpu", description=__doc__)
     p.add_argument(
@@ -262,6 +304,21 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("ratings_file")
     e.add_argument("prediction_csv")
     e.set_defaults(fn=_evaluate)
+
+    rc = sub.add_parser(
+        "recommend", help="top-K recommendations from checkpointed factors"
+    )
+    rc.add_argument("--checkpoint-dir", required=True)
+    rc.add_argument("--data", required=True,
+                    help="training data file (raw-id mapping + exclude-seen)")
+    rc.add_argument("--format", choices=["netflix", "movielens"], default="netflix")
+    rc.add_argument("--min-rating", type=float, default=0.0)
+    rc.add_argument("--users", required=True,
+                    help="comma-separated raw user ids, or 'all'")
+    rc.add_argument("-k", type=int, default=10)
+    rc.add_argument("--include-seen", action="store_true",
+                    help="do not exclude already-rated movies")
+    rc.set_defaults(fn=_recommend)
     return p
 
 

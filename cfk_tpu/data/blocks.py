@@ -525,6 +525,34 @@ def build_ring_blocks(
 
 
 @dataclasses.dataclass(frozen=True)
+class RatingsIndex:
+    """Id maps + dense-index COO without any solve-block build.
+
+    The cheap subset of ``Dataset`` that serving needs (raw↔dense id mapping
+    and exclude-seen lists): parsing + two sorts, no rectangles — so a
+    full-Netflix ``recommend`` never pays the training-layout memory.
+    """
+
+    movie_map: IdMap
+    user_map: IdMap
+    coo_dense: RatingsCOO
+
+    @classmethod
+    def from_coo(cls, coo: RatingsCOO) -> "RatingsIndex":
+        movie_map = IdMap.from_raw(coo.movie_raw)
+        user_map = IdMap.from_raw(coo.user_raw)
+        return cls(
+            movie_map=movie_map,
+            user_map=user_map,
+            coo_dense=RatingsCOO(
+                movie_raw=movie_map.to_dense(coo.movie_raw).astype(np.int64),
+                user_raw=user_map.to_dense(coo.user_raw).astype(np.int64),
+                rating=coo.rating.astype(np.float32),
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Dataset:
     """A fully indexed rating dataset: id maps + both solve-side block sets.
 

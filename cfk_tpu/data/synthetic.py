@@ -1,0 +1,48 @@
+"""Synthetic Netflix-Prize-shaped rating data for scale benchmarking.
+
+The environment has no network egress, so the full Netflix Prize /
+MovieLens-25M files of BASELINE.md cannot be downloaded; throughput at that
+scale is instead measured on synthetic data with the same statistical shape:
+Zipf-distributed entity popularity (the reference datasets' degree
+distributions are power-law — the property that stresses the block layouts)
+and uniform 1-5 star ratings.  Quality numbers are only meaningful on the
+real bundled samples (``/root/reference/data/``); this module is for
+wall-clock / throughput scaling only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cfk_tpu.data.blocks import RatingsCOO
+
+
+def zipf_probs(n: int, skew: float) -> np.ndarray:
+    p = (1.0 / np.arange(1, n + 1)) ** skew
+    return p / p.sum()
+
+
+def synthetic_netflix_coo(
+    num_users: int = 480_189,
+    num_movies: int = 17_770,
+    nnz: int = 100_480_507,
+    *,
+    seed: int = 0,
+    movie_skew: float = 0.9,
+    user_skew: float = 0.7,
+) -> RatingsCOO:
+    """Netflix-Prize-shaped COO (defaults are the real corpus dimensions).
+
+    Popularity is Zipf over a random permutation of ids (so popular entities
+    are scattered across the id space like the real data, not clustered at
+    low ids — this matters for contiguous-range sharding load balance).
+    Duplicate (movie, user) pairs may occur; ALS treats them as repeated
+    observations, which does not change the math's shape or cost.
+    """
+    rng = np.random.default_rng(seed)
+    m_ids = rng.permutation(num_movies).astype(np.int64) + 1
+    u_ids = rng.permutation(num_users).astype(np.int64) + 1
+    movie = m_ids[rng.choice(num_movies, size=nnz, p=zipf_probs(num_movies, movie_skew))]
+    user = u_ids[rng.choice(num_users, size=nnz, p=zipf_probs(num_users, user_skew))]
+    rating = rng.integers(1, 6, size=nnz).astype(np.float32)
+    return RatingsCOO(movie_raw=movie, user_raw=user, rating=rating)

@@ -1,0 +1,109 @@
+"""Top-K recommendation serving: the online-query analog of the batch dump.
+
+The reference's only serving artifact is the full dense prediction matrix
+written to CSV at the end of training (``processors/FeatureCollector.java:
+90-109``) — O(users × movies) disk for any query.  Here the same factors
+answer top-K queries directly: one [n, k]·[k, M] MXU matmul per user chunk +
+``lax.top_k``, with already-rated items excluded via a trash-column scatter
+(no O(U×M) materialization anywhere).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_chunk(u_rows, movie_factors, seen_idx, seen_mask, k):
+    """(values, movie_indices) of the top-k unseen movies per user row.
+
+    ``seen_idx`` [n, S] holds each row's already-rated movie columns, padded
+    with ``num_movies`` (a trash column appended before the scatter, dropped
+    after) so padding never masks a real movie.
+    """
+    n = u_rows.shape[0]
+    scores = jnp.einsum(
+        "nk,mk->nm",
+        u_rows.astype(jnp.float32),
+        movie_factors.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    scores = jnp.concatenate(
+        [scores, jnp.zeros((n, 1), scores.dtype)], axis=1
+    )
+    neg = jnp.where(seen_mask, -jnp.inf, 0.0)
+    scores = scores.at[jnp.arange(n)[:, None], seen_idx].add(neg)
+    return jax.lax.top_k(scores[:, :-1], k)
+
+
+def _seen_lists(user_rows: np.ndarray, dataset, num_movies: int):
+    """Padded [n, S] seen-movie columns (+mask) for the requested user rows."""
+    coo = dataset.coo_dense
+    uniq, inv = np.unique(user_rows, return_inverse=True)
+    n = uniq.shape[0]
+    row_of_user = np.full(int(coo.user_raw.max(initial=-1)) + 2, -1, dtype=np.int64)
+    row_of_user[uniq] = np.arange(n)
+    sel = np.flatnonzero(row_of_user[coo.user_raw] >= 0)
+    rows = row_of_user[coo.user_raw[sel]]
+    movies = coo.movie_raw[sel]
+    counts = np.bincount(rows, minlength=n)
+    # Power-of-two width: the seen-list rectangle shape feeds a jitted
+    # function, so a data-dependent exact width would recompile per chunk.
+    width = max(8, 1 << (max(int(counts.max(initial=0)), 1) - 1).bit_length())
+    seen_idx = np.full((n, width), num_movies, dtype=np.int32)  # trash column
+    seen_mask = np.zeros((n, width), dtype=np.float32)
+    order = np.argsort(rows, kind="stable")
+    pos = np.arange(sel.size) - np.concatenate(([0], np.cumsum(counts)))[rows[order]]
+    seen_idx[rows[order], pos] = movies[order].astype(np.int32)
+    seen_mask[rows[order], pos] = 1.0
+    return seen_idx[inv], seen_mask[inv]
+
+
+def recommend_top_k(
+    model,
+    user_rows,
+    k: int = 10,
+    *,
+    dataset=None,
+    chunk: int = 8192,
+):
+    """Top-K movie rows (dense ascending-id indices) for each user row.
+
+    ``dataset`` (anything with a dense-index ``.coo_dense`` — a training
+    ``Dataset`` or a cheap ``RatingsIndex``) enables exclude-seen: movies the
+    user already rated never appear in their recommendations.  Users are
+    scored in ``chunk``-sized batches so serving memory stays
+    O(chunk · num_movies).  Returns (scores [n, k], movie_rows [n, k]) as
+    numpy arrays.
+    """
+    user_rows = np.asarray(user_rows, dtype=np.int64)
+    if user_rows.ndim != 1:
+        raise ValueError(f"user_rows must be 1-D, got shape {user_rows.shape}")
+    if np.any((user_rows < 0) | (user_rows >= model.num_users)):
+        raise ValueError(
+            f"user rows out of range [0, {model.num_users}): "
+            f"{user_rows[(user_rows < 0) | (user_rows >= model.num_users)][:5]}"
+        )
+    if not 1 <= k <= model.num_movies:
+        raise ValueError(f"k must be in [1, {model.num_movies}], got {k}")
+    m = model.movie_factors[: model.num_movies]
+    out_scores = np.empty((user_rows.shape[0], k), dtype=np.float32)
+    out_movies = np.empty((user_rows.shape[0], k), dtype=np.int32)
+    for lo in range(0, user_rows.shape[0], chunk):
+        rows = user_rows[lo : lo + chunk]
+        u = model.user_factors[rows]  # numpy or jax factors both index fine
+        if dataset is not None:
+            seen_idx, seen_mask = _seen_lists(rows, dataset, model.num_movies)
+        else:
+            seen_idx = np.full((rows.shape[0], 1), model.num_movies, np.int32)
+            seen_mask = np.zeros((rows.shape[0], 1), np.float32)
+        values, idx = _topk_chunk(
+            u, m, jnp.asarray(seen_idx), jnp.asarray(seen_mask), k
+        )
+        out_scores[lo : lo + rows.shape[0]] = np.asarray(values)
+        out_movies[lo : lo + rows.shape[0]] = np.asarray(idx)
+    return out_scores, out_movies

@@ -49,6 +49,13 @@ class ALSModel:
         m = np.asarray(self.movie_factors[: self.num_movies], dtype=np.float32)
         return u @ m.T
 
+    def recommend_top_k(self, user_rows, k: int = 10, *, dataset=None,
+                        chunk: int = 8192):
+        """Top-K movie rows per user row; see ``cfk_tpu.eval.recommend``."""
+        from cfk_tpu.eval.recommend import recommend_top_k
+
+        return recommend_top_k(self, user_rows, k, dataset=dataset, chunk=chunk)
+
 
 def _blocks_to_device(blocks: PaddedBlocks) -> dict[str, jax.Array]:
     return {

@@ -31,7 +31,6 @@ from cfk_tpu.models.als import (
     _segment_device_setup,
 )
 from cfk_tpu.ops.solve import (
-    global_gram,
     ials_half_step,
     ials_half_step_bucketed,
     ials_half_step_segment,
@@ -176,50 +175,46 @@ def make_ials_training_step(
     factors, solve local entities (per width bucket when ``m_chunks`` given,
     or by segment_sum over the flat local run when ``segment=True``).
     """
-    from cfk_tpu.parallel.spmd import wrap_step
+    from cfk_tpu.parallel.spmd import gathered_half, wrap_step
 
     if segment:  # flat segment layout
 
-        def half_segment(chunk_nnz, local):
-            def half(fixed_local, blk):
-                gram = lax.psum(global_gram(fixed_local), AXIS)
-                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        def seg_solve(chunk_nnz, local):
+            def solve(fixed_full, blk, gram):
                 return ials_half_step_segment(
                     fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
                     blk["segment"], local, config.lam, config.alpha,
                     gram=gram, chunk_nnz=chunk_nnz, solver=config.solver,
                 )
 
-            return half
+            return solve
 
         return wrap_step(
             mesh, config,
-            half_segment(m_chunks, m_local), half_segment(u_chunks, u_local),
+            gathered_half(seg_solve(m_chunks, m_local), with_gram=True),
+            gathered_half(seg_solve(u_chunks, u_local), with_gram=True),
             mspecs, uspecs,
         )
 
     if m_chunks is not None:  # bucketed layout
 
-        def half_bucketed(chunks, local):
-            def half(fixed_local, blk):
-                gram = lax.psum(global_gram(fixed_local), AXIS)
-                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        def bkt_solve(chunks, local):
+            def solve(fixed_full, blk, gram):
                 return ials_half_step_bucketed(
                     fixed_full, blk, chunks, local, config.lam, config.alpha,
                     gram=gram, solver=config.solver,
                 )
 
-            return half
+            return solve
 
         return wrap_step(
             mesh, config,
-            half_bucketed(m_chunks, m_local), half_bucketed(u_chunks, u_local),
+            gathered_half(bkt_solve(m_chunks, m_local), with_gram=True),
+            gathered_half(bkt_solve(u_chunks, u_local), with_gram=True),
             mspecs, uspecs,
         )
 
-    def half(fixed_local, blk):
-        gram = lax.psum(global_gram(fixed_local), AXIS)
-        fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+    def padded_solve(fixed_full, blk, gram):
         return ials_half_step(
             fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
             config.lam, config.alpha, gram=gram, solver=config.solver,
@@ -231,6 +226,7 @@ def make_ials_training_step(
         "mask": P(AXIS, None),
         "count": P(AXIS),
     }
+    half = gathered_half(padded_solve, with_gram=True)
     return wrap_step(mesh, config, half, half, spec, spec)
 
 

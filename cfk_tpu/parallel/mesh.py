@@ -29,6 +29,93 @@ def make_mesh(num_shards: int, devices: list | None = None) -> Mesh:
     return Mesh(np.array(devices[:num_shards]), (AXIS,))
 
 
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Idempotent ``jax.distributed.initialize`` wrapper for multi-host runs.
+
+    The reference scales out by adding Kafka partitions consumed by more
+    stream threads/processes against one broker (``apps/BaseKafkaApp.java:51``
+    — never actually run multi-node, SURVEY.md §4).  Here multi-host is JAX's
+    single-program-multiple-controller model: every host runs this same
+    program, this call wires them into one runtime, and the ``"shard"`` axis
+    then spans all hosts' devices — collectives ride ICI within a host/slice
+    and DCN across.  Returns the number of processes.
+
+    MUST be the first JAX call of the program when ``coordinator_address`` is
+    given: ``jax.distributed.initialize`` refuses to run once any XLA backend
+    exists (even ``jax.devices()`` initializes one).  Calling again after a
+    successful multi-process init is a no-op; calling too late with a
+    mismatching topology raises.
+    """
+    if coordinator_address is None:
+        return jax.process_count()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # Backend already up (or initialize called twice).  Fine iff the
+        # runtime already has the topology the caller asked for.
+        if num_processes is not None and jax.process_count() != num_processes:
+            raise
+    return jax.process_count()
+
+
+def ring_order(devices):
+    """Order devices so contiguous ranges are intra-host (ICI-first).
+
+    Sorting key (process_index, device id): neighbor shards on the ring and
+    contiguous all_gather ranges then sit on the same host wherever possible,
+    so the ppermute ring crosses DCN only at host boundaries and XLA can
+    lower all_gather hierarchically (ICI within host, DCN across).
+    """
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def make_multihost_mesh(num_shards: int | None = None) -> Mesh:
+    """A 1-D ``"shard"`` mesh spanning every device of every process.
+
+    The 1-D entity axis is the whole parallelism of block ALS (factors and
+    blocks are row-sharded; there is no separate data/model axis to fold), so
+    multi-host just extends the axis across hosts in ``ring_order``.
+    """
+    devices = ring_order(jax.devices())
+    if num_shards is None:
+        num_shards = len(devices)
+    if len(devices) != num_shards:
+        raise ValueError(
+            f"num_shards={num_shards} must equal the global device count "
+            f"{len(devices)} for a multihost mesh (every device hosts one "
+            "entity shard); build the Dataset with this num_shards"
+        )
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def shard_rows_global(mesh: Mesh, tree):
+    """Multi-host-safe row sharding: assemble global arrays per-shard.
+
+    Unlike ``shard_rows`` (single-controller ``device_put``), this works under
+    multi-process JAX where each host may only address its local devices: each
+    process materializes only the row slices its devices own, via
+    ``jax.make_array_from_callback``.  The input tree holds the full global
+    (host/numpy) arrays on every process — fine for rating blocks, whose host
+    copy exists anyway.
+    """
+    def put(x):
+        spec = P(AXIS, *([None] * (np.ndim(x) - 1)))
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            np.shape(x), sharding, lambda idx: np.asarray(x)[idx]
+        )
+
+    return jax.tree.map(put, tree)
+
+
 def shard_rows(mesh: Mesh, tree):
     """Place a pytree of arrays with axis 0 sharded over the mesh."""
     def put(x):

@@ -52,6 +52,7 @@ from cfk_tpu.ops.solve import (
     als_half_step_bucketed,
     als_half_step_segment,
     gather_gram,
+    global_gram,
     init_factors,
     init_factors_stats,
     regularized_solve,
@@ -209,6 +210,24 @@ def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs):
     )
 
 
+def gathered_half(solve, *, with_gram=False):
+    """The all_gather exchange pattern every gathered layout shares.
+
+    ``solve(fixed_full, blk, gram) -> factors`` gets the full fixed-side
+    factor matrix (one all_gather over ICI per half-iteration) and, with
+    ``with_gram`` (iALS), the mesh-wide YᵀY (local Gram psum'd — a [k,k]
+    collective).  Used by both the explicit and implicit SPMD steps so the
+    exchange is written exactly once.
+    """
+
+    def half(fixed_local, blk):
+        gram = lax.psum(global_gram(fixed_local), AXIS) if with_gram else None
+        fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        return solve(fixed_full, blk, gram)
+
+    return half
+
+
 def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
     """Block trees + step kwargs for the all_gather-only layouts.
 
@@ -281,45 +300,38 @@ def make_training_step(
 
     if segment:  # flat segment layout, all_gather exchange
 
-        def half_segment(chunk_nnz, local):
-            def half(fixed_local, blk):
-                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        def seg_solve(chunk_nnz, local):
+            def solve(fixed_full, blk, _gram):
                 return als_half_step_segment(
-                    fixed_full,
-                    blk["neighbor"],
-                    blk["rating"],
-                    blk["mask"],
-                    blk["segment"],
-                    blk["count"],
-                    local,
-                    config.lam,
-                    chunk_nnz=chunk_nnz,
-                    solver=config.solver,
+                    fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
+                    blk["segment"], blk["count"], local, config.lam,
+                    chunk_nnz=chunk_nnz, solver=config.solver,
                 )
 
-            return half
+            return solve
 
         return wrap_step(
             mesh, config,
-            half_segment(m_chunks, m_local), half_segment(u_chunks, u_local),
+            gathered_half(seg_solve(m_chunks, m_local)),
+            gathered_half(seg_solve(u_chunks, u_local)),
             mspecs, uspecs,
         )
 
     if m_chunks is not None:  # bucketed layout, all_gather exchange
 
-        def half_bucketed(chunks, local):
-            def half(fixed_local, blk):
-                fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        def bkt_solve(chunks, local):
+            def solve(fixed_full, blk, _gram):
                 return als_half_step_bucketed(
                     fixed_full, blk, chunks, local, config.lam,
                     solver=config.solver,
                 )
 
-            return half
+            return solve
 
         return wrap_step(
             mesh, config,
-            half_bucketed(m_chunks, m_local), half_bucketed(u_chunks, u_local),
+            gathered_half(bkt_solve(m_chunks, m_local)),
+            gathered_half(bkt_solve(u_chunks, u_local)),
             mspecs, uspecs,
         )
 

@@ -1,5 +1,6 @@
 from cfk_tpu.transport.broker import InMemoryBroker, Record, Transport, mod_partition
 from cfk_tpu.transport.checkpoint import CheckpointManager, CheckpointState
+from cfk_tpu.transport.filelog import FileBroker
 from cfk_tpu.transport.ingest import (
     RATINGS_TOPIC,
     IncompleteIngestError,
@@ -21,6 +22,7 @@ from cfk_tpu.transport.serdes import (
 )
 
 __all__ = [
+    "FileBroker",
     "InMemoryBroker",
     "Record",
     "Transport",

@@ -1,0 +1,95 @@
+"""Mesh helpers: multi-host ordering, global row sharding, distributed init."""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from cfk_tpu.parallel import mesh as mesh_mod
+from cfk_tpu.parallel.mesh import (
+    initialize_distributed,
+    make_mesh,
+    make_multihost_mesh,
+    ring_order,
+    shard_rows,
+    shard_rows_global,
+)
+
+
+@dataclasses.dataclass
+class FakeDevice:
+    process_index: int
+    id: int
+
+
+def test_ring_order_groups_hosts_contiguously():
+    devs = [
+        FakeDevice(1, 5), FakeDevice(0, 2), FakeDevice(1, 4),
+        FakeDevice(0, 0), FakeDevice(2, 9), FakeDevice(0, 1),
+    ]
+    ordered = ring_order(devs)
+    assert [(d.process_index, d.id) for d in ordered] == [
+        (0, 0), (0, 1), (0, 2), (1, 4), (1, 5), (2, 9),
+    ]
+    # every host's devices are one contiguous run
+    procs = [d.process_index for d in ordered]
+    assert procs == sorted(procs)
+
+
+def test_multihost_mesh_matches_make_mesh_single_process():
+    m = make_multihost_mesh()
+    assert m.devices.size == len(jax.devices())
+    assert m.axis_names == (mesh_mod.AXIS,)
+    try:
+        make_multihost_mesh(3)
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised, "num_shards != device count must raise"
+
+
+def test_shard_rows_global_equals_shard_rows():
+    mesh = make_mesh(8)
+    tree = {
+        "a": np.arange(64, dtype=np.float32).reshape(16, 4),
+        "b": np.arange(16, dtype=np.int32),
+    }
+    via_put = shard_rows(mesh, tree)
+    via_cb = shard_rows_global(mesh, tree)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(via_put[k]), np.asarray(via_cb[k]))
+        assert via_cb[k].sharding == via_put[k].sharding
+
+
+def test_shard_rows_global_trains_identically():
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.parallel.spmd import train_als_sharded
+    from tests.test_bucketed import powerlaw_coo
+
+    coo = powerlaw_coo(n_movies=48, n_users=80, nnz=1000)
+    config1 = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0)
+    single = train_als(Dataset.from_coo(coo), config1).predict_dense()
+
+    config8 = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0, num_shards=8)
+    ds8 = Dataset.from_coo(coo, num_shards=8)
+    sharded = train_als_sharded(
+        ds8, config8, make_multihost_mesh()
+    ).predict_dense()
+    np.testing.assert_allclose(sharded, single, atol=2e-3, rtol=1e-3)
+
+
+def test_initialize_distributed_single_process_noop():
+    assert initialize_distributed() == 1
+
+
+def test_initialize_distributed_too_late_mismatch_raises():
+    """Once a backend exists, asking for a topology the runtime doesn't have
+    must raise (jax.distributed.initialize only works before first JAX use)."""
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        initialize_distributed(
+            "localhost:59999", num_processes=2, process_id=0
+        )

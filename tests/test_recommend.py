@@ -1,0 +1,100 @@
+"""Top-K recommendation serving: numpy cross-check, exclude-seen, CLI."""
+
+import numpy as np
+import pytest
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import Dataset
+from cfk_tpu.models.als import train_als
+
+
+@pytest.fixture(scope="module")
+def tiny_model(request):
+    coo = request.getfixturevalue("tiny_coo")
+    ds = Dataset.from_coo(coo)
+    model = train_als(ds, ALSConfig(rank=5, lam=0.05, num_iterations=3, seed=0))
+    return model, ds
+
+
+def test_topk_matches_numpy_argsort(tiny_model):
+    model, ds = tiny_model
+    rows = np.array([0, 5, 17, 301])
+    scores, movies = model.recommend_top_k(rows, k=7)
+    dense = model.predict_dense()
+    for i, r in enumerate(rows):
+        want = np.argsort(-dense[r], kind="stable")[:7]
+        np.testing.assert_array_equal(np.sort(movies[i]), np.sort(want))
+        np.testing.assert_allclose(
+            np.sort(scores[i]), np.sort(dense[r][want]), rtol=1e-5
+        )
+    # scores come back descending
+    assert np.all(np.diff(scores, axis=1) <= 1e-6)
+
+
+def test_exclude_seen_drops_rated_movies(tiny_model):
+    model, ds = tiny_model
+    rows = np.arange(50)
+    _, movies = model.recommend_top_k(rows, k=10, dataset=ds)
+    coo = ds.coo_dense
+    seen = {(int(u), int(m)) for u, m in zip(coo.user_raw, coo.movie_raw)}
+    for i, r in enumerate(rows):
+        for m in movies[i]:
+            assert (int(r), int(m)) not in seen, f"user {r} was recommended seen movie {m}"
+
+
+def test_exclude_seen_matches_masked_argsort(tiny_model):
+    model, ds = tiny_model
+    rows = np.array([3, 3, 8])  # duplicate rows must each get seen-masking
+    scores, movies = model.recommend_top_k(rows, k=5, dataset=ds)
+    dense = model.predict_dense()
+    coo = ds.coo_dense
+    for i, r in enumerate(rows):
+        masked = dense[r].copy()
+        masked[coo.movie_raw[coo.user_raw == r]] = -np.inf
+        want = np.argsort(-masked, kind="stable")[:5]
+        np.testing.assert_array_equal(np.sort(movies[i]), np.sort(want))
+    np.testing.assert_array_equal(movies[0], movies[1])
+
+
+def test_chunking_matches_unchunked(tiny_model):
+    model, ds = tiny_model
+    rows = np.arange(model.num_users)
+    s1, m1 = model.recommend_top_k(rows, k=3, dataset=ds, chunk=64)
+    s2, m2 = model.recommend_top_k(rows, k=3, dataset=ds)
+    np.testing.assert_array_equal(m1, m2)
+    np.testing.assert_allclose(s1, s2, rtol=1e-6)
+
+
+def test_input_validation(tiny_model):
+    model, ds = tiny_model
+    with pytest.raises(ValueError, match="out of range"):
+        model.recommend_top_k(np.array([model.num_users]), k=3)
+    with pytest.raises(ValueError, match="k must be"):
+        model.recommend_top_k(np.array([0]), k=0)
+    with pytest.raises(ValueError, match="1-D"):
+        model.recommend_top_k(np.array([[0]]), k=3)
+
+
+def test_cli_recommend_roundtrip(tmp_path, capsys):
+    from cfk_tpu.cli import main
+
+    ck = str(tmp_path / "ck")
+    rc = main([
+        "train", "--data", "/root/reference/data/data_sample_tiny.txt",
+        "--rank", "4", "--iterations", "2", "--checkpoint-dir", ck,
+        "--output", "none",
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main([
+        "recommend", "--checkpoint-dir", ck,
+        "--data", "/root/reference/data/data_sample_tiny.txt",
+        "--users", "7,79", "-k", "5",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    for line in out:
+        user, pairs = line.split("\t")
+        assert int(user) in (7, 79)
+        assert len(pairs.split(",")) == 5
