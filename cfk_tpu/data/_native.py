@@ -20,7 +20,7 @@ _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libcfk_native.so"))
 _IO_ERROR = -0x7FFFFFFF
 # Must match cfk_native_abi_version() in native/cfk_native.cpp; a stale .so
 # with a different version is treated as unavailable (Python fallback).
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lib: ctypes.CDLL | None = None
 
@@ -57,6 +57,23 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i64,
         ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int16),
+    ]
+    lib.cfk_group_by.restype = ctypes.c_int
+    lib.cfk_group_by.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        i64,
+        i64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.cfk_index_dense.restype = i64
+    lib.cfk_index_dense.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        i64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
     ]
     lib.cfk_native_abi_version.restype = ctypes.c_int
     lib.cfk_native_abi_version.argtypes = []
@@ -145,6 +162,64 @@ def encode_id_rating_batch(ids: np.ndarray, ratings: np.ndarray) -> bytes:
         ids32.shape[0], _ptr(out, ctypes.c_uint8),
     )
     return out.tobytes()
+
+
+def group_by(keys: np.ndarray, num_keys: int):
+    """Stable counting-sort group-by over dense int keys.
+
+    Returns (order int64[nnz], count int32[num_keys], start int64[num_keys])
+    with the same semantics as the numpy fallback in
+    ``cfk_tpu.data.blocks.group_by_dense``: ``order`` is the stable argsort
+    of ``keys``, ``start`` the exclusive prefix sum of ``count``.
+    """
+    assert _lib is not None
+    # Keys stay int64 end-to-end so the C-side [0, num_keys) range check
+    # actually fires for corrupt values (an int32 downcast would wrap them
+    # into range and group silently wrong).
+    k64 = np.ascontiguousarray(keys, dtype=np.int64)
+    order = np.empty(k64.shape[0], dtype=np.int64)
+    count = np.empty(num_keys, dtype=np.int32)
+    start = np.empty(num_keys, dtype=np.int64)
+    rc = _lib.cfk_group_by(
+        _ptr(k64, ctypes.c_int64), k64.shape[0], num_keys,
+        _ptr(order, ctypes.c_int64), _ptr(count, ctypes.c_int32),
+        _ptr(start, ctypes.c_int64),
+    )
+    if rc != 0:
+        raise ValueError(f"group_by: key outside [0, {num_keys})")
+    return order, count, start
+
+
+# Raw-id range above which the presence-table indexer would waste memory;
+# callers fall back to sort-based indexing (np.unique) past this.
+INDEX_DENSE_MAX_RAW = 1 << 28
+
+
+def index_dense(
+    raw: np.ndarray, max_raw: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """(sorted unique ids, dense rank per element) via a presence table.
+
+    O(n + max_raw); requires 0 <= raw <= INDEX_DENSE_MAX_RAW (the caller
+    checks and falls back to ``np.unique``-based indexing otherwise).  Pass
+    ``max_raw`` when already known to skip a redundant full pass.
+    """
+    assert _lib is not None
+    r64 = np.ascontiguousarray(raw, dtype=np.int64)
+    if r64.size == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int32)
+    if max_raw is None:
+        max_raw = int(r64.max())
+    cap = min(r64.shape[0], max_raw + 1)
+    unique = np.empty(cap, dtype=np.int64)
+    dense = np.empty(r64.shape[0], dtype=np.int32)
+    n = _lib.cfk_index_dense(
+        _ptr(r64, ctypes.c_int64), r64.shape[0], max_raw,
+        _ptr(unique, ctypes.c_int64), _ptr(dense, ctypes.c_int32),
+    )
+    if n < 0:
+        raise ValueError("index_dense: negative raw id")
+    return unique[:n].copy(), dense
 
 
 def decode_id_rating_batch(data: bytes) -> tuple[np.ndarray, np.ndarray]:

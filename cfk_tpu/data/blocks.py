@@ -81,6 +81,54 @@ def _round_up(x: int, multiple: int) -> int:
     return ((x + multiple - 1) // multiple) * multiple
 
 
+def group_by_dense(keys: np.ndarray, num_keys: int):
+    """(stable argsort order, per-key counts int32, exclusive-prefix starts).
+
+    The grouping step every block builder shares.  Dense keys admit an
+    O(n + k) counting sort — done in native code when the library is built
+    (``native/cfk_native.cpp`` ``cfk_group_by``); the numpy fallback is the
+    O(n log n) comparison argsort.
+    """
+    if 0 < num_keys < (1 << 31):
+        from cfk_tpu.data import _native
+
+        if _native.available():
+            return _native.group_by(keys, num_keys)
+    order = np.argsort(keys, kind="stable")
+    count = np.bincount(keys, minlength=num_keys).astype(np.int32)
+    start = np.zeros(num_keys, dtype=np.int64)
+    np.cumsum(count[:-1], out=start[1:])
+    return order, count, start
+
+
+def index_entities(raw: np.ndarray) -> tuple[IdMap, np.ndarray]:
+    """(IdMap of the distinct raw ids, dense index per element).
+
+    Native presence-table indexing (O(n + max_raw)) when ids are small
+    non-negative ints — true of every rating dataset here; sort-based
+    ``np.unique``/``searchsorted`` otherwise.  The table is gated on the id
+    range both absolutely and relative to nnz (a tiny file with huge sparse
+    ids would otherwise pay an O(max_raw) scan for nothing); negative ids
+    are caught by the C-side range check.
+    """
+    if raw.size:
+        from cfk_tpu.data import _native
+
+        if _native.available():
+            max_raw = int(raw.max())
+            if 0 <= max_raw <= min(
+                _native.INDEX_DENSE_MAX_RAW, 64 * raw.size + (1 << 16)
+            ):
+                try:
+                    unique, dense = _native.index_dense(raw, max_raw)
+                except ValueError:
+                    pass  # negative ids: fall through to the sort path
+                else:
+                    return IdMap(raw_ids=unique), dense
+    id_map = IdMap.from_raw(raw)
+    return id_map, id_map.to_dense(raw)
+
+
 @dataclasses.dataclass(frozen=True)
 class PaddedBlocks:
     """Rectangular InBlocks for one solve side.
@@ -121,18 +169,15 @@ def build_padded_blocks(
     in ``MRatings2BlocksProcessor``/``URatings2BlocksProcessor``.
     """
     nnz = solve_dense.shape[0]
-    order = np.argsort(solve_dense, kind="stable")
+    order, count, group_start = group_by_dense(solve_dense, num_solve_entities)
     s_sorted = solve_dense[order]
     f_sorted = fixed_dense[order].astype(np.int32)
     r_sorted = rating[order].astype(np.float32)
 
-    count = np.bincount(s_sorted, minlength=num_solve_entities).astype(np.int32)
     max_nnz = _round_up(max(int(count.max()), 1), pad_multiple)
     e_pad = _round_up(num_solve_entities, num_shards)
 
     # Position of each rating within its entity's group.
-    group_start = np.zeros(num_solve_entities, dtype=np.int64)
-    np.cumsum(count[:-1], out=group_start[1:])
     pos = np.arange(nnz, dtype=np.int64) - group_start[s_sorted]
 
     neighbor = np.zeros((e_pad, max_nnz), dtype=np.int32)
@@ -246,14 +291,10 @@ def build_bucketed_blocks(
     """
     e_pad = _round_up(num_solve_entities, num_shards)
     e_local = e_pad // num_shards
-    count = np.bincount(solve_dense, minlength=num_solve_entities).astype(np.int32)
-
-    order = np.argsort(solve_dense, kind="stable")
+    order, count, group_start = group_by_dense(solve_dense, num_solve_entities)
     s_sorted = solve_dense[order]
     f_sorted = fixed_dense[order].astype(np.int32)
     r_sorted = rating[order].astype(np.float32)
-    group_start = np.zeros(num_solve_entities, dtype=np.int64)
-    np.cumsum(count[:-1], out=group_start[1:])
     pos = np.arange(s_sorted.shape[0], dtype=np.int64) - group_start[s_sorted]
 
     max_nnz = max(int(count.max()), 1)
@@ -264,12 +305,17 @@ def build_bucketed_blocks(
     bucket_of = np.searchsorted(widths, count)  # smallest j with width_j >= nnz
     shard_of = np.arange(num_solve_entities, dtype=np.int64) // e_local
     rated = count > 0
-    row_of_entity = np.full(num_solve_entities, -1, dtype=np.int64)
 
-    buckets = []
+    # Per-bucket geometry first (O(E) work per bucket), then ONE flat-arena
+    # scatter for all ratings: per-bucket boolean scans over the nnz axis
+    # would cost O(buckets · nnz) — the builder's former hot spot at
+    # 100M-rating scale.
+    metas = []  # (bucket j, width, rows, chunk, ents, rows_idx, arena offset)
+    arena_cells = 0
+    # flat arena position of each entity's (row, col 0) cell
+    entity_base = np.full(num_solve_entities, -1, dtype=np.int64)
     for j, width in enumerate(widths):
-        sel = rated & (bucket_of == j)
-        ents = np.flatnonzero(sel)
+        ents = np.flatnonzero(rated & (bucket_of == j))
         if ents.size == 0:
             continue
         sh = shard_of[ents]
@@ -286,27 +332,30 @@ def build_bucketed_blocks(
         # position within each shard's run = index − first index of that run.
         idx_in_shard = np.arange(ents.size) - np.searchsorted(sh, sh)
         rows_idx = sh * b + idx_in_shard
-        row_of_entity[ents] = rows_idx
+        entity_base[ents] = arena_cells + rows_idx * width
+        metas.append((width, rows, chunk, ents, rows_idx, arena_cells))
+        arena_cells += rows * width
 
-        neighbor = np.zeros((rows, width), dtype=np.int32)
-        rmat = np.zeros((rows, width), dtype=np.float32)
-        mask = np.zeros((rows, width), dtype=np.float32)
+    neighbor_arena = np.zeros(arena_cells, dtype=np.int32)
+    rating_arena = np.zeros(arena_cells, dtype=np.float32)
+    mask_arena = np.zeros(arena_cells, dtype=np.float32)
+    target = entity_base[s_sorted] + pos
+    neighbor_arena[target] = f_sorted
+    rating_arena[target] = r_sorted
+    mask_arena[target] = 1.0
+
+    buckets = []
+    for width, rows, chunk, ents, rows_idx, off in metas:
         count_rows = np.zeros(rows, dtype=np.int32)
         entity_local = np.full(rows, e_local, dtype=np.int32)
         count_rows[rows_idx] = count[ents]
         entity_local[rows_idx] = (ents % e_local).astype(np.int32)
-
-        mr = sel[s_sorted]
-        rr = row_of_entity[s_sorted[mr]]
-        cc = pos[mr]
-        neighbor[rr, cc] = f_sorted[mr]
-        rmat[rr, cc] = r_sorted[mr]
-        mask[rr, cc] = 1.0
+        cells = slice(off, off + rows * width)
         buckets.append(
             Bucket(
-                neighbor_idx=neighbor,
-                rating=rmat,
-                mask=mask,
+                neighbor_idx=neighbor_arena[cells].reshape(rows, width),
+                rating=rating_arena[cells].reshape(rows, width),
+                mask=mask_arena[cells].reshape(rows, width),
                 count=count_rows,
                 entity_local=entity_local,
                 chunk_rows=chunk,
@@ -393,9 +442,7 @@ def build_segment_blocks(
     """
     e_pad = _round_up(num_solve_entities, num_shards)
     e_local = e_pad // num_shards
-    count = np.bincount(solve_dense, minlength=num_solve_entities).astype(np.int32)
-
-    order = np.argsort(solve_dense, kind="stable")
+    order, count, _ = group_by_dense(solve_dense, num_solve_entities)
     s_sorted = solve_dense[order].astype(np.int64)
     shard_of = s_sorted // e_local
     per_shard = np.bincount(shard_of, minlength=num_shards)
@@ -492,13 +539,11 @@ def build_ring_blocks(
     e_pad = _round_up(num_solve_entities, num_shards)
     # Group key = (solve entity, fixed shard); stable sort then position-in-group.
     key = solve_dense.astype(np.int64) * num_shards + shard_of
-    order = np.argsort(key, kind="stable")
+    order, pair_count, group_start = group_by_dense(
+        key, num_solve_entities * num_shards
+    )
     key_s = key[order]
-    pair_count = np.bincount(key_s, minlength=num_solve_entities * num_shards)
     p_ring = _round_up(max(int(pair_count.max()), 1), pad_multiple)
-
-    group_start = np.zeros(pair_count.shape[0], dtype=np.int64)
-    np.cumsum(pair_count[:-1], out=group_start[1:])
     pos = np.arange(key_s.shape[0], dtype=np.int64) - group_start[key_s]
 
     e_idx = key_s // num_shards
@@ -539,14 +584,14 @@ class RatingsIndex:
 
     @classmethod
     def from_coo(cls, coo: RatingsCOO) -> "RatingsIndex":
-        movie_map = IdMap.from_raw(coo.movie_raw)
-        user_map = IdMap.from_raw(coo.user_raw)
+        movie_map, m_dense = index_entities(coo.movie_raw)
+        user_map, u_dense = index_entities(coo.user_raw)
         return cls(
             movie_map=movie_map,
             user_map=user_map,
             coo_dense=RatingsCOO(
-                movie_raw=movie_map.to_dense(coo.movie_raw).astype(np.int64),
-                user_raw=user_map.to_dense(coo.user_raw).astype(np.int64),
+                movie_raw=m_dense.astype(np.int64),
+                user_raw=u_dense.astype(np.int64),
                 rating=coo.rating.astype(np.float32),
             ),
         )
@@ -580,10 +625,8 @@ class Dataset:
         layout: str = "padded",
         chunk_elems: int | None = 1 << 20,
     ) -> "Dataset":
-        movie_map = IdMap.from_raw(coo.movie_raw)
-        user_map = IdMap.from_raw(coo.user_raw)
-        m_dense = movie_map.to_dense(coo.movie_raw)
-        u_dense = user_map.to_dense(coo.user_raw)
+        movie_map, m_dense = index_entities(coo.movie_raw)
+        user_map, u_dense = index_entities(coo.user_raw)
         if layout == "bucketed":
             build = functools.partial(
                 build_bucketed_blocks,

@@ -227,8 +227,62 @@ long long cfk_decode_id_rating_batch(const uint8_t* in, long long nbytes,
   return n;
 }
 
+// Stable counting-sort group-by over dense keys: the block builders' hot
+// grouping step (the np.argsort in cfk_tpu/data/blocks.py builders is
+// O(n log n) comparison sort; dense entity keys admit O(n + k)).
+// order_out[j] = original index of the j-th entry in (key, original index)
+// order; count_out[k] = entries with key k; start_out[k] = exclusive prefix
+// sum of counts. Returns 0, or -1 if a key is outside [0, num_keys).
+int cfk_group_by(const int64_t* keys, long long nnz, long long num_keys,
+                 int64_t* order_out, int32_t* count_out, int64_t* start_out) {
+  std::memset(count_out, 0, sizeof(int32_t) * num_keys);
+  for (long long i = 0; i < nnz; ++i) {
+    int64_t k = keys[i];
+    if (k < 0 || k >= num_keys) return -1;
+    ++count_out[k];
+  }
+  int64_t acc = 0;
+  for (long long k = 0; k < num_keys; ++k) {
+    start_out[k] = acc;
+    acc += count_out[k];
+  }
+  std::vector<int64_t> cursor(start_out, start_out + num_keys);
+  for (long long i = 0; i < nnz; ++i) {
+    order_out[cursor[keys[i]]++] = i;  // ascending i per key = stable
+  }
+  return 0;
+}
+
+// Dense-index raw entity ids by rank among the distinct values present:
+// unique_out gets the sorted distinct ids, dense_out[i] the rank of raw[i].
+// O(n + max_raw) via a presence table — raw ids must lie in [0, max_raw]
+// (rating datasets' ids are small positive ints; the Python caller checks
+// the range and falls back to sort-based indexing otherwise).
+// Returns the number of distinct ids, or -1 on an out-of-range id.
+long long cfk_index_dense(const int64_t* raw, long long nnz, int64_t max_raw,
+                          int64_t* unique_out, int32_t* dense_out) {
+  std::vector<int32_t> rank(static_cast<size_t>(max_raw) + 1, -1);
+  for (long long i = 0; i < nnz; ++i) {
+    int64_t v = raw[i];
+    if (v < 0 || v > max_raw) return -1;
+    rank[v] = 1;
+  }
+  long long n_unique = 0;
+  for (int64_t v = 0; v <= max_raw; ++v) {
+    if (rank[v] >= 0) {
+      rank[v] = static_cast<int32_t>(n_unique);
+      if (unique_out) unique_out[n_unique] = v;
+      ++n_unique;
+    }
+  }
+  if (dense_out) {
+    for (long long i = 0; i < nnz; ++i) dense_out[i] = rank[raw[i]];
+  }
+  return n_unique;
+}
+
 // Bump when parser semantics or signatures change: a stale .so must be
 // treated as unavailable (Python fallback), never silently divergent.
-int cfk_native_abi_version() { return 2; }
+int cfk_native_abi_version() { return 3; }
 
 }  // extern "C"

@@ -124,3 +124,78 @@ def test_dispatchers_use_native():
 
     nat = parse_netflix(TINY)  # goes through the native path when available
     assert nat.num_ratings == 3415
+
+
+def test_group_by_matches_numpy(rng):
+    keys = rng.integers(0, 997, size=50000).astype(np.int64)
+    order, count, start = _native.group_by(keys, 997)
+    np.testing.assert_array_equal(order, np.argsort(keys, kind="stable"))
+    np.testing.assert_array_equal(count, np.bincount(keys, minlength=997))
+    want_start = np.zeros(997, dtype=np.int64)
+    np.cumsum(count[:-1], out=want_start[1:])
+    np.testing.assert_array_equal(start, want_start)
+
+
+def test_group_by_rejects_out_of_range(rng):
+    with pytest.raises(ValueError, match="outside"):
+        _native.group_by(np.array([0, 5], dtype=np.int64), 5)
+
+
+def test_index_dense_matches_numpy_unique(rng):
+    raw = rng.integers(1, 40000, size=100000)
+    unique, dense = _native.index_dense(raw)
+    want_u, want_d = np.unique(raw, return_inverse=True)
+    np.testing.assert_array_equal(unique, want_u)
+    np.testing.assert_array_equal(dense, want_d)
+    assert unique.dtype == np.int64 and dense.dtype == np.int32
+
+
+def test_index_dense_empty_and_single():
+    u, d = _native.index_dense(np.empty(0, dtype=np.int64))
+    assert u.size == 0 and d.size == 0
+    u, d = _native.index_dense(np.array([7, 7, 7], dtype=np.int64))
+    np.testing.assert_array_equal(u, [7])
+    np.testing.assert_array_equal(d, [0, 0, 0])
+
+
+def test_group_by_dense_dispatcher_fallback_parity(rng, monkeypatch):
+    """Native and numpy-fallback branches of group_by_dense agree exactly."""
+    from cfk_tpu.data import blocks
+
+    keys = rng.integers(0, 123, size=5000).astype(np.int64)
+    o1, c1, s1 = blocks.group_by_dense(keys, 123)  # native path (lib built)
+    monkeypatch.setattr(_native, "available", lambda: False)
+    o2, c2, s2 = blocks.group_by_dense(keys, 123)  # forced numpy fallback
+    np.testing.assert_array_equal(o1, o2)
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_index_entities_fallback_parity(rng, monkeypatch):
+    """Native and numpy-fallback branches of index_entities agree exactly."""
+    from cfk_tpu.data import blocks
+
+    raw = rng.integers(1, 4000, size=20000)
+    m1, d1 = blocks.index_entities(raw)
+    monkeypatch.setattr(_native, "available", lambda: False)
+    m2, d2 = blocks.index_entities(raw)
+    np.testing.assert_array_equal(m1.raw_ids, m2.raw_ids)
+    np.testing.assert_array_equal(d1, d2)
+
+
+def test_index_entities_sparse_huge_ids_skip_table(rng):
+    """Tiny nnz with huge ids must not take the O(max_raw) table path —
+    and must still produce the right mapping via the sort path."""
+    from cfk_tpu.data import blocks
+
+    raw = rng.integers(1, 1 << 27, size=100).astype(np.int64)
+    id_map, dense = blocks.index_entities(raw)
+    want_u, want_d = np.unique(raw, return_inverse=True)
+    np.testing.assert_array_equal(id_map.raw_ids, want_u)
+    np.testing.assert_array_equal(dense, want_d)
+
+
+def test_group_by_int64_keys_out_of_range_not_wrapped():
+    """A corrupt huge key must be rejected, not int32-wrapped into range."""
+    with pytest.raises(ValueError, match="outside"):
+        _native.group_by(np.array([0, (1 << 32) + 3], dtype=np.int64), 10)
