@@ -379,37 +379,45 @@ def build_bucketed_blocks(
 
 @dataclasses.dataclass(frozen=True)
 class SegmentBlocks:
-    """Flat CSR-style InBlocks: nnz-proportional memory, zero rectangle waste.
+    """Flat CSR-style InBlocks packed into entity-range chunks.
 
     The third layout for the ragged-InBlock problem (SURVEY.md §5 long-context
     analog): instead of padding entities into rectangles (``PaddedBlocks``) or
-    width classes (``BucketedBlocks``), ratings stay a flat sorted list and the
+    width classes (``BucketedBlocks``), ratings stay flat sorted runs and the
     per-entity Gram matrices are accumulated with ``jax.ops.segment_sum`` over
-    per-rating outer products.  Memory is exactly O(nnz) regardless of the
-    degree distribution — the layout of choice when a power-law head entity
-    would dominate even the bucketed rectangles.
+    per-rating outer products — O(nnz) memory regardless of the degree
+    distribution, and the fastest layout on TPU (one big coalesced gather +
+    a fused outer-product/scatter instead of many small bucketed matmuls).
 
-    Rows are shard-major: shard s owns the flat slice [s·N, (s+1)·N) where
-    N = nnz_per_shard (max over shards, padded), so ``P("shard")`` sharding
-    hands each device its own ratings.  Within a shard, entries are sorted by
-    the owning entity's shard-local row; padding entries repeat the last real
-    segment id (keeping the sorted invariant) and are masked to zero.
+    Each shard's run is cut at entity boundaries into ``num_chunks`` chunks
+    of ≤ ``chunk_cap`` ratings covering ≤ ``chunk_entities`` consecutive
+    entities (dense ids are compact — every ``IdMap`` id has ≥ 1 rating — so
+    an entity range IS a contiguous rating slice).  The solve maps over
+    chunks, so device memory for the Gram accumulator is
+    O(chunk_entities·k²), never O(E·k²): at full-Netflix scale the
+    unchunked user-side accumulator alone (480k·64² floats ≈ 8 GB, and
+    ~45 GB with scan double-buffering) exceeds single-chip HBM.  Entries are
+    shard-major ⇒ every array shards as ``P("shard")``.
 
-    Because the dense entity ids are *compact* (every id in an ``IdMap`` has
-    ≥ 1 rating), a sorted run of C entries spans < C distinct rows — the
-    invariant the windowed chunked accumulation in
-    ``cfk_tpu.ops.solve.als_half_step_segment`` relies on.
+    ``seg_rel`` holds each rating's entity index *relative to its chunk's
+    first entity* (padding entries use the ``chunk_entities`` trash row);
+    ``chunk_entity``/``chunk_count`` give each chunk row's shard-local
+    entity id (``local_entities`` = trash) and rating count.
     """
 
-    neighbor_idx: np.ndarray  # int32 [S·N] dense idx into the fixed side (0 at padding)
-    rating: np.ndarray  # float32 [S·N] (0 at padding)
-    mask: np.ndarray  # float32 [S·N] 1.0 = real rating
-    segment_local: np.ndarray  # int32 [S·N] owning entity's shard-local row, sorted per shard
+    neighbor_idx: np.ndarray  # int32 [S·NC·C] dense idx into the fixed side (0 at padding)
+    rating: np.ndarray  # float32 [S·NC·C] (0 at padding)
+    mask: np.ndarray  # float32 [S·NC·C] 1.0 = real rating
+    seg_rel: np.ndarray  # int32 [S·NC·C] chunk-relative entity row, sorted per chunk
+    chunk_entity: np.ndarray  # int32 [S·NC·Ec] shard-local entity row (e_local = trash)
+    chunk_count: np.ndarray  # int32 [S·NC·Ec] per-row rating count (0 = padding)
     count: np.ndarray  # int32 [E_pad] real nnz per entity (0 for pad rows)
     rating_sum: np.ndarray  # float32 [E_pad] per-entity rating sum (for init)
     num_entities: int
     num_shards: int
-    chunk_nnz: int | None  # static hint: scan window size (divides N) or None
+    num_chunks: int  # NC: chunks per shard
+    chunk_cap: int  # C: ratings per chunk (padded)
+    chunk_entities: int  # Ec: entity rows per chunk (padded)
 
     @property
     def padded_entities(self) -> int:
@@ -421,7 +429,13 @@ class SegmentBlocks:
 
     @property
     def nnz_per_shard(self) -> int:
-        return int(self.neighbor_idx.shape[0]) // self.num_shards
+        return self.num_chunks * self.chunk_cap
+
+    @property
+    def statics(self) -> tuple[int, int, int]:
+        """(num_chunks, chunk_cap, chunk_entities) — the jit-static shape
+        triple the segment solve kernels need."""
+        return (self.num_chunks, self.chunk_cap, self.chunk_entities)
 
 
 def build_segment_blocks(
@@ -434,46 +448,96 @@ def build_segment_blocks(
     pad_multiple: int = 8,
     chunk_nnz: int | None = None,
 ) -> SegmentBlocks:
-    """Sort ratings by (shard, local entity row) into flat per-shard runs.
+    """Sort ratings by (shard, local entity row) and pack into entity chunks.
 
-    ``chunk_nnz`` (if the per-shard nnz exceeds it) becomes the static scan
-    window of the chunked accumulation; the per-shard length is padded to a
-    multiple of it so chunks reshape evenly.
+    ``chunk_nnz`` is the target ratings-per-chunk capacity, bounding the
+    per-chunk gather; each chunk also covers at most ``chunk_nnz // 64``
+    entities, bounding the [Ec, k, k] Gram accumulator even on all-degree-1
+    runs.  ``None`` packs each shard into a single chunk (fine until the
+    per-shard entity count × k² outgrows HBM).
     """
     e_pad = _round_up(num_solve_entities, num_shards)
     e_local = e_pad // num_shards
     order, count, _ = group_by_dense(solve_dense, num_solve_entities)
     s_sorted = solve_dense[order].astype(np.int64)
-    shard_of = s_sorted // e_local
-    per_shard = np.bincount(shard_of, minlength=num_shards)
-    n = _round_up(max(int(per_shard.max()), 1), pad_multiple)
-    if chunk_nnz is not None and n > chunk_nnz:
-        n = _round_up(n, chunk_nnz)
-    else:
-        chunk_nnz = None
-
-    shard_start = np.zeros(num_shards, dtype=np.int64)
-    np.cumsum(per_shard[:-1], out=shard_start[1:])
-    pos = np.arange(s_sorted.shape[0], dtype=np.int64) - shard_start[shard_of]
-    flat = shard_of * n + pos
-
-    neighbor = np.zeros(num_shards * n, dtype=np.int32)
-    rmat = np.zeros(num_shards * n, dtype=np.float32)
-    mask = np.zeros(num_shards * n, dtype=np.float32)
-    seg = np.zeros(num_shards * n, dtype=np.int32)
-    neighbor[flat] = fixed_dense[order].astype(np.int32)
-    rmat[flat] = rating[order].astype(np.float32)
-    mask[flat] = 1.0
-    seg[flat] = (s_sorted % e_local).astype(np.int32)
-    # Padding entries repeat the last real segment id of their shard so the
-    # per-shard sorted invariant holds (masked entries contribute zero).
-    for s in range(num_shards):
-        k = int(per_shard[s])
-        if 0 < k < n:
-            seg[s * n + k : (s + 1) * n] = seg[s * n + k - 1]
+    f_sorted = fixed_dense[order].astype(np.int32)
+    r_sorted = rating[order].astype(np.float32)
+    local_sorted = (s_sorted % e_local).astype(np.int32)
 
     count_pad = np.zeros(e_pad, dtype=np.int32)
     count_pad[:num_solve_entities] = count
+    counts_local = count_pad.reshape(num_shards, e_local)
+    per_shard_nnz = counts_local.sum(axis=1, dtype=np.int64)
+    shard_start = np.zeros(num_shards, dtype=np.int64)
+    np.cumsum(per_shard_nnz[:-1], out=shard_start[1:])
+    # Rated local entities are consecutive from 0 (compact dense ids; only
+    # the global-pad tail of the last shard is unrated).
+    n_rated_local = (counts_local > 0).sum(axis=1)
+
+    cap = max(int(count.max()), 1, pad_multiple)
+    if chunk_nnz is not None:
+        cap = max(cap, int(chunk_nnz))
+    # Greedy entity-boundary packing per shard: each chunk covers a
+    # consecutive entity range whose total nnz fits the capacity.
+    cums = []
+    bounds: list[list[int]] = []
+    for s in range(num_shards):
+        cum = np.zeros(e_local + 1, dtype=np.int64)
+        np.cumsum(counts_local[s], out=cum[1:])
+        cums.append(cum)
+        b = [0]
+        if chunk_nnz is None:
+            b.append(int(n_rated_local[s]))
+        else:
+            # Entities-per-chunk cap: bounds the [Ec, k, k] Gram accumulator
+            # and the NC·Ec entity-array padding on low-degree runs.
+            e_cap = max(1, cap // 32)
+            while b[-1] < n_rated_local[s]:
+                nxt = int(np.searchsorted(cum, cum[b[-1]] + cap, side="right")) - 1
+                nxt = min(nxt, b[-1] + e_cap)
+                b.append(min(max(nxt, b[-1] + 1), int(n_rated_local[s])))
+        bounds.append(b)
+
+    num_chunks = max(max(len(b) - 1 for b in bounds), 1)
+    e_c = max(
+        max((b[i + 1] - b[i] for i in range(len(b) - 1)), default=1)
+        for b in bounds
+    )
+    e_c = max(e_c, 1)
+    if chunk_nnz is None:
+        cap = max(int(per_shard_nnz.max()), 1)
+    cap = _round_up(cap, pad_multiple)
+
+    neighbor = np.zeros(num_shards * num_chunks * cap, dtype=np.int32)
+    rmat = np.zeros(num_shards * num_chunks * cap, dtype=np.float32)
+    mask = np.zeros(num_shards * num_chunks * cap, dtype=np.float32)
+    seg = np.full(num_shards * num_chunks * cap, e_c, dtype=np.int32)  # trash
+    chunk_entity = np.full(num_shards * num_chunks * e_c, e_local, dtype=np.int32)
+    chunk_count = np.zeros(num_shards * num_chunks * e_c, dtype=np.int32)
+
+    for s in range(num_shards):
+        cum = cums[s]
+        b = bounds[s]
+        for c in range(len(b) - 1):
+            e0, e1 = b[c], b[c + 1]
+            src0 = shard_start[s] + cum[e0]
+            src1 = shard_start[s] + cum[e1]
+            n = int(src1 - src0)
+            if n > cap:
+                raise AssertionError(
+                    f"chunk nnz {n} exceeds capacity {cap} (packing bug)"
+                )
+            dst = (s * num_chunks + c) * cap
+            neighbor[dst : dst + n] = f_sorted[src0:src1]
+            rmat[dst : dst + n] = r_sorted[src0:src1]
+            mask[dst : dst + n] = 1.0
+            seg[dst : dst + n] = local_sorted[src0:src1] - e0
+            ebase = (s * num_chunks + c) * e_c
+            chunk_entity[ebase : ebase + (e1 - e0)] = np.arange(
+                e0, e1, dtype=np.int32
+            )
+            chunk_count[ebase : ebase + (e1 - e0)] = counts_local[s, e0:e1]
+
     rating_sum = np.zeros(e_pad, dtype=np.float32)
     rating_sum[:num_solve_entities] = np.bincount(
         solve_dense, weights=rating.astype(np.float64), minlength=num_solve_entities
@@ -482,12 +546,16 @@ def build_segment_blocks(
         neighbor_idx=neighbor,
         rating=rmat,
         mask=mask,
-        segment_local=seg,
+        seg_rel=seg,
+        chunk_entity=chunk_entity,
+        chunk_count=chunk_count,
         count=count_pad,
         rating_sum=rating_sum,
         num_entities=num_solve_entities,
         num_shards=num_shards,
-        chunk_nnz=chunk_nnz,
+        num_chunks=num_chunks,
+        chunk_cap=cap,
+        chunk_entities=e_c,
     )
 
 
@@ -635,14 +703,15 @@ class Dataset:
                 chunk_elems=chunk_elems,
             )
         elif layout == "segment":
-            # chunk_elems budgets peak gather cells·k for the rectangular
-            # layouts; the segment path's peak is the [C, k, k] outer-product
-            # window, so divide by a worst-case rank (k = 64) to match.
+            # chunk_elems budgets gather cells·k for the rectangular layouts;
+            # the segment path's peak is the [C, k, k] per-rating outer
+            # product (XLA materializes it — scatter operands don't fuse), so
+            # divide by a worst-case rank (k = 64) to match the budget.
             build = functools.partial(
                 build_segment_blocks,
                 num_shards=num_shards,
                 pad_multiple=pad_multiple,
-                chunk_nnz=None if chunk_elems is None else max(1, chunk_elems // 64),
+                chunk_nnz=None if chunk_elems is None else max(64, chunk_elems // 64),
             )
         elif layout == "padded":
             build = functools.partial(

@@ -77,8 +77,9 @@ def _segment_to_device(blocks: SegmentBlocks) -> dict[str, jax.Array]:
         "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
         "rating": jnp.asarray(blocks.rating),
         "mask": jnp.asarray(blocks.mask),
-        "segment_local": jnp.asarray(blocks.segment_local),
-        "count": jnp.asarray(blocks.count),
+        "seg_rel": jnp.asarray(blocks.seg_rel),
+        "chunk_entity": jnp.asarray(blocks.chunk_entity),
+        "chunk_count": jnp.asarray(blocks.chunk_count),
     }
 
 
@@ -122,8 +123,8 @@ def _segment_device_setup(dataset: Dataset):
         "count": jnp.asarray(ub.count),
     }
     layout_kw = dict(
-        m_chunks=mb.chunk_nnz,
-        u_chunks=ub.chunk_nnz,
+        m_chunks=mb.statics,
+        u_chunks=ub.statics,
         m_entities=mb.padded_entities,
         u_entities=ub.padded_entities,
     )
@@ -138,17 +139,18 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None):
         return als_half_step_bucketed(
             fixed, blk, chunks, entities, lam, solver=solver
         )
-    if "segment_local" in blk:
+    if "seg_rel" in blk:
         return als_half_step_segment(
             fixed,
             blk["neighbor_idx"],
             blk["rating"],
             blk["mask"],
-            blk["segment_local"],
-            blk["count"],
+            blk["seg_rel"],
+            blk["chunk_entity"],
+            blk["chunk_count"],
             entities,
             lam,
-            chunk_nnz=chunks,
+            statics=chunks,
             solver=solver,
         )
     return als_half_step(

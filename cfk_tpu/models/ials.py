@@ -66,11 +66,11 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
         return ials_half_step_bucketed(
             fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
         )
-    if "segment_local" in blk:
+    if "seg_rel" in blk:
         return ials_half_step_segment(
             fixed, blk["neighbor_idx"], blk["rating"], blk["mask"],
-            blk["segment_local"], entities, lam, alpha,
-            gram=gram, chunk_nnz=chunks, solver=solver,
+            blk["seg_rel"], blk["chunk_entity"], entities, lam, alpha,
+            gram=gram, statics=chunks, solver=solver,
         )
     return ials_half_step(
         fixed, blk["neighbor_idx"], blk["rating"], blk["mask"], lam, alpha,
@@ -179,12 +179,12 @@ def make_ials_training_step(
 
     if segment:  # flat segment layout
 
-        def seg_solve(chunk_nnz, local):
+        def seg_solve(statics, local):
             def solve(fixed_full, blk, gram):
                 return ials_half_step_segment(
                     fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
-                    blk["segment"], local, config.lam, config.alpha,
-                    gram=gram, chunk_nnz=chunk_nnz, solver=config.solver,
+                    blk["seg"], blk["entity"], local, config.lam, config.alpha,
+                    gram=gram, statics=statics, solver=config.solver,
                 )
 
             return solve

@@ -281,127 +281,120 @@ def _segment_gram_flat(fixed_factors, neighbor_idx, weight, rating, mask, num_se
     return a, b
 
 
-def segment_gram(
-    fixed_factors: jax.Array,  # [F, k]
-    neighbor_idx: jax.Array,  # [N] int32
-    weight: jax.Array,  # [N] per-entry Gram weight (1 for ALS, α·r for iALS)
-    rating: jax.Array,  # [N] per-entry RHS weight (r for ALS, c for iALS)
-    mask: jax.Array,  # [N] 1 = real entry
-    segment_ids: jax.Array,  # [N] sorted shard-local entity rows
-    local_entities: int,
-    *,
-    chunk_nnz: int | None = None,
-) -> tuple[jax.Array, jax.Array]:
-    """Per-entity Gram matrices from a flat sorted rating run.
+def _segment_chunk_views(statics, nnz_arrays, entity_arrays):
+    """Reshape flat shard-local segment arrays into per-chunk views.
 
-    Returns (A [E, k, k], b [E, k]) for E = ``local_entities``.  With
-    ``chunk_nnz`` the run is scanned in windows of C entries; because dense
-    entity ids are compact (every id has ≥ 1 rating — ``IdMap`` invariant), a
-    sorted window spans < C rows, so each step's segment_sum and
-    accumulator update touch only a C-row window instead of re-writing the
-    whole [E, k, k] accumulator: HBM traffic stays O(nnz·k²) + O(E·k²)
-    rather than O(n_chunks·E·k²).
+    ``statics`` = (num_chunks NC, chunk_cap C, chunk_entities Ec); nnz-side
+    arrays reshape to [NC, C], entity-side to [NC, Ec].
     """
-    k = fixed_factors.shape[-1]
-    nnz = neighbor_idx.shape[0]
-    if chunk_nnz is None or chunk_nnz >= nnz:
-        return _segment_gram_flat(
-            fixed_factors, neighbor_idx, weight, rating, mask,
-            local_entities, segment_ids,
-        )
-    if nnz % chunk_nnz != 0:
-        raise ValueError(f"nnz {nnz} not divisible by chunk_nnz {chunk_nnz}")
-    w = chunk_nnz
-
-    def body(carry, chunk):
-        a, b = carry
-        nb_c, wt_c, rt_c, mk_c, seg_c = chunk
-        start = seg_c[0]
-        aw, bw = _segment_gram_flat(
-            fixed_factors, nb_c, wt_c, rt_c, mk_c, w, seg_c - start
-        )
-        a = lax.dynamic_update_slice(
-            a, lax.dynamic_slice(a, (start, 0, 0), (w, k, k)) + aw, (start, 0, 0)
-        )
-        b = lax.dynamic_update_slice(
-            b, lax.dynamic_slice(b, (start, 0), (w, k)) + bw, (start, 0)
-        )
-        return (a, b), None
-
-    # W overhang rows absorb windows starting near the last real row.  The
-    # accumulators borrow a zero from the (device-varying, under shard_map)
-    # inputs so the scan carry's varying-mesh-axes type matches the updates.
-    zero = (rating[0] * 0.0).astype(jnp.float32)
-    a0 = jnp.zeros((local_entities + w, k, k), jnp.float32) + zero
-    b0 = jnp.zeros((local_entities + w, k), jnp.float32) + zero
-    reshape = lambda x: x.reshape((nnz // w, w) + x.shape[1:])
-    (a, b), _ = lax.scan(
-        body,
-        (a0, b0),
-        (reshape(neighbor_idx), reshape(weight), reshape(rating),
-         reshape(mask), reshape(segment_ids)),
+    nc, cap, e_c = statics
+    return (
+        tuple(x.reshape(nc, cap) for x in nnz_arrays),
+        tuple(x.reshape(nc, e_c) for x in entity_arrays),
     )
-    return a[:local_entities], b[:local_entities]
+
+
+def _maybe_map(per_chunk, chunks, num_chunks):
+    """lax.map over the chunk axis, skipping the scan for a single chunk."""
+    if num_chunks == 1:
+        return jax.tree.map(lambda x: x[None], per_chunk(
+            jax.tree.map(lambda x: x[0], chunks)
+        ))
+    return lax.map(per_chunk, chunks)
+
+
+def _scatter_chunk_rows(xs, chunk_entity, local_entities):
+    """[NC, Ec, k] chunk solutions → [E_local, k] via the trash-slot scatter.
+
+    Rows never in any chunk (zero-rating global-pad tail) stay exactly 0 —
+    matching the rectangular paths' λ-floored zero solve.
+    """
+    k = xs.shape[-1]
+    out = jnp.zeros((local_entities + 1, k), jnp.float32)
+    out = out.at[chunk_entity.reshape(-1)].set(xs.reshape(-1, k))
+    return out[:local_entities]
 
 
 def als_half_step_segment(
     fixed_factors: jax.Array,  # [F, k]
-    neighbor_idx: jax.Array,  # [N]
-    rating: jax.Array,  # [N]
-    mask: jax.Array,  # [N]
-    segment_ids: jax.Array,  # [N]
-    count: jax.Array,  # [E] per-entity nnz (shard-local)
+    neighbor_idx: jax.Array,  # [NC·C]
+    rating: jax.Array,  # [NC·C]
+    mask: jax.Array,  # [NC·C]
+    seg_rel: jax.Array,  # [NC·C] chunk-relative entity rows, sorted per chunk
+    chunk_entity: jax.Array,  # [NC·Ec] shard-local entity row (trash = E_local)
+    chunk_count: jax.Array,  # [NC·Ec]
     local_entities: int,
     lam: float,
     *,
-    chunk_nnz: int | None = None,
+    statics: tuple[int, int, int],
     solver: str = "cholesky",
 ) -> jax.Array:
-    """One explicit ALS-WR half-iteration over the flat segment layout.
+    """One explicit ALS-WR half-iteration over the packed segment layout.
 
     Semantics match ``als_half_step`` exactly (same normal equations, same
-    λ·n·I regularization); only the Gram accumulation differs — segment_sum
-    over sorted per-rating outer products instead of rectangular einsums.
-    Zero-rating rows (global entity-pad tail) never appear as a segment id,
-    so their A stays 0 and the λ-floored solve returns 0, matching the
-    rectangular paths.
+    λ·n·I regularization); only the Gram accumulation differs — sorted
+    segment_sum over per-rating outer products, mapped over entity-range
+    chunks so the accumulator stays [Ec, k, k] regardless of E.
     """
-    a, b = segment_gram(
-        fixed_factors, neighbor_idx, jnp.ones_like(rating), rating, mask,
-        segment_ids, local_entities, chunk_nnz=chunk_nnz,
+    nc, _, e_c = statics
+    (nb, rt, mk, seg), (ent, cnt) = _segment_chunk_views(
+        statics, (neighbor_idx, rating, mask, seg_rel),
+        (chunk_entity, chunk_count),
     )
-    return regularized_solve(a, b, count, lam, solver)
+
+    def per_chunk(c):
+        nb_c, rt_c, mk_c, seg_c, cnt_c = c
+        a, b = _segment_gram_flat(
+            fixed_factors, nb_c, jnp.ones_like(rt_c), rt_c, mk_c,
+            e_c + 1, seg_c,
+        )
+        return regularized_solve(a[:e_c], b[:e_c], cnt_c, lam, solver)
+
+    xs = _maybe_map(per_chunk, (nb, rt, mk, seg, cnt), nc)
+    return _scatter_chunk_rows(xs, chunk_entity, local_entities)
 
 
 def ials_half_step_segment(
     fixed_factors: jax.Array,  # [F, k]
-    neighbor_idx: jax.Array,  # [N]
-    rating: jax.Array,  # [N] raw counts/ratings; confidence c = 1 + α·r
-    mask: jax.Array,  # [N]
-    segment_ids: jax.Array,  # [N]
+    neighbor_idx: jax.Array,  # [NC·C]
+    rating: jax.Array,  # [NC·C] raw counts/ratings; confidence c = 1 + α·r
+    mask: jax.Array,  # [NC·C]
+    seg_rel: jax.Array,  # [NC·C]
+    chunk_entity: jax.Array,  # [NC·Ec]
     local_entities: int,
     lam: float,
     alpha: float,
     *,
+    statics: tuple[int, int, int],
     gram: jax.Array | None = None,  # precomputed YᵀY (pass psum'd under SPMD)
-    chunk_nnz: int | None = None,
     solver: str = "cholesky",
 ) -> jax.Array:
-    """Implicit-feedback half-iteration over the flat segment layout.
+    """Implicit-feedback half-iteration over the packed segment layout.
 
     Per entity A = YᵀY + Σ_obs (c−1)·f fᵀ + λI, b = Σ_obs c·f (Hu et al.
-    2008 with the global-Gram trick).  Zero-interaction rows solve
-    (YᵀY + λI)x = 0 → 0, identical to the rectangular paths.
+    2008 with the global-Gram trick).  Zero-interaction rows (chunk padding
+    and rows outside every chunk) end up exactly 0: padding rows solve
+    (YᵀY + λI)x = 0 inside the chunk and scatter to the trash slot anyway.
     """
+    nc, _, e_c = statics
     k = fixed_factors.shape[-1]
     if gram is None:
         gram = global_gram(fixed_factors)
-    a_obs, b = segment_gram(
-        fixed_factors, neighbor_idx, alpha * rating, (1.0 + alpha * rating) * mask,
-        mask, segment_ids, local_entities, chunk_nnz=chunk_nnz,
+    reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
+    (nb, rt, mk, seg), (ent,) = _segment_chunk_views(
+        statics, (neighbor_idx, rating, mask, seg_rel), (chunk_entity,)
     )
-    a = gram[None] + a_obs + lam * jnp.eye(k, dtype=jnp.float32)[None]
-    return dispatch_spd_solve(a, b, solver)
+
+    def per_chunk(c):
+        nb_c, rt_c, mk_c, seg_c = c
+        a_obs, b = _segment_gram_flat(
+            fixed_factors, nb_c, alpha * rt_c, (1.0 + alpha * rt_c) * mk_c,
+            mk_c, e_c + 1, seg_c,
+        )
+        return dispatch_spd_solve(reg[None] + a_obs[:e_c], b[:e_c], solver)
+
+    xs = _maybe_map(per_chunk, (nb, rt, mk, seg), nc)
+    return _scatter_chunk_rows(xs, chunk_entity, local_entities)
 
 
 def init_factors(

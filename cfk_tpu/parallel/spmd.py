@@ -141,13 +141,14 @@ def half_step_ring(
 
 
 def _segment_to_tree(blocks: SegmentBlocks) -> dict[str, np.ndarray]:
-    """Flat per-shard runs; every leaf rows-shards over P(AXIS)."""
+    """Flat per-shard packed chunks; every leaf rows-shards over P(AXIS)."""
     return {
         "neighbor": blocks.neighbor_idx,
         "rating": blocks.rating,
         "mask": blocks.mask,
-        "segment": blocks.segment_local,
-        "count": blocks.count,
+        "seg": blocks.seg_rel,
+        "entity": blocks.chunk_entity,
+        "ecount": blocks.chunk_count,
     }
 
 
@@ -252,8 +253,8 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
     else:
         mtree = _segment_to_tree(dataset.movie_blocks)
         utree = _segment_to_tree(dataset.user_blocks)
-        m_chunks = dataset.movie_blocks.chunk_nnz
-        u_chunks = dataset.user_blocks.chunk_nnz
+        m_chunks = dataset.movie_blocks.statics
+        u_chunks = dataset.user_blocks.statics
     step_kw = dict(
         m_chunks=m_chunks,
         u_chunks=u_chunks,
@@ -300,12 +301,12 @@ def make_training_step(
 
     if segment:  # flat segment layout, all_gather exchange
 
-        def seg_solve(chunk_nnz, local):
+        def seg_solve(statics, local):
             def solve(fixed_full, blk, _gram):
                 return als_half_step_segment(
                     fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
-                    blk["segment"], blk["count"], local, config.lam,
-                    chunk_nnz=chunk_nnz, solver=config.solver,
+                    blk["seg"], blk["entity"], blk["ecount"], local,
+                    config.lam, statics=statics, solver=config.solver,
                 )
 
             return solve

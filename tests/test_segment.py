@@ -1,10 +1,11 @@
-"""Segment-sum (flat CSR) InBlock layout: structure, equivalence, SPMD, scale.
+"""Packed segment-sum InBlock layout: structure, equivalence, SPMD, scale.
 
 The segment layout is the third answer to ragged InBlocks (SURVEY.md §5
-long-context analog): ratings stay a flat sorted run and per-entity Gram
-matrices accumulate by sorted ``segment_sum`` — exactly O(nnz) memory for
-arbitrarily skewed degree distributions, where even the bucketed width
-classes would pad.
+long-context analog): flat sorted rating runs packed into entity-range
+chunks; per-entity Gram matrices accumulate by sorted ``segment_sum`` —
+O(nnz) memory for arbitrarily skewed degree distributions, with the
+device-side accumulator bounded per chunk (full-Netflix user side would
+otherwise need a 45 GB accumulator).
 """
 
 import numpy as np
@@ -20,15 +21,27 @@ from tests.test_bucketed import powerlaw_coo
 
 
 def reconstruct_triples(blocks):
-    """(entity_dense, neighbor_dense, rating) triples from the flat runs."""
-    n = blocks.nnz_per_shard
+    """(entity_dense, neighbor_dense, rating) triples from packed chunks."""
+    nc, cap, e_c = blocks.statics
     e_local = blocks.local_entities
-    flat = np.flatnonzero(blocks.mask)
-    shard = flat // n
-    entity = shard * e_local + blocks.segment_local[flat]
-    return np.stack(
-        [entity, blocks.neighbor_idx[flat], blocks.rating[flat]], axis=1
-    )
+    out = []
+    for s in range(blocks.num_shards):
+        for c in range(nc):
+            base = (s * nc + c) * cap
+            ebase = (s * nc + c) * e_c
+            ent = blocks.chunk_entity[ebase : ebase + e_c]
+            real = ent[ent < e_local]
+            first = real[0] if real.size else 0
+            sl = slice(base, base + cap)
+            mk = blocks.mask[sl] > 0
+            entity = s * e_local + first + blocks.seg_rel[sl][mk]
+            out.append(
+                np.stack(
+                    [entity, blocks.neighbor_idx[sl][mk], blocks.rating[sl][mk]],
+                    axis=1,
+                )
+            )
+    return np.concatenate(out, axis=0)
 
 
 def test_segment_structure_roundtrip():
@@ -36,32 +49,39 @@ def test_segment_structure_roundtrip():
     ds = Dataset.from_coo(coo)
     cd = ds.coo_dense
     for shards in (1, 4):
-        blocks = build_segment_blocks(
-            cd.movie_raw, cd.user_raw, cd.rating,
-            ds.movie_map.num_entities, num_shards=shards,
-        )
-        got = reconstruct_triples(blocks)
-        want = np.stack([cd.movie_raw, cd.user_raw, cd.rating], axis=1)
-        got = got[np.lexsort(got.T[::-1])]
-        want = want[np.lexsort(want.T[::-1])]
-        np.testing.assert_array_equal(got, want)
-        np.testing.assert_array_equal(
-            blocks.count[: ds.movie_map.num_entities],
-            np.bincount(cd.movie_raw, minlength=ds.movie_map.num_entities),
-        )
-        # per-shard runs are sorted (incl. repeated-tail padding ids)
-        seg = blocks.segment_local.reshape(shards, -1)
-        assert np.all(np.diff(seg, axis=1) >= 0)
-        # flat length is exactly S · round_up(max per-shard nnz): no
-        # rectangle waste, only cross-shard load skew + rounding
-        e_local = blocks.local_entities
-        per_shard = np.bincount(cd.movie_raw // e_local, minlength=shards)
-        want_n = -(-max(int(per_shard.max()), 1) // 8) * 8
-        assert blocks.nnz_per_shard == want_n
+        for chunk_nnz in (None, 512):
+            blocks = build_segment_blocks(
+                cd.movie_raw, cd.user_raw, cd.rating,
+                ds.movie_map.num_entities, num_shards=shards,
+                chunk_nnz=chunk_nnz,
+            )
+            got = reconstruct_triples(blocks)
+            want = np.stack([cd.movie_raw, cd.user_raw, cd.rating], axis=1)
+            got = got[np.lexsort(got.T[::-1])]
+            want = want[np.lexsort(want.T[::-1])]
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_array_equal(
+                blocks.count[: ds.movie_map.num_entities],
+                np.bincount(cd.movie_raw, minlength=ds.movie_map.num_entities),
+            )
+            # per-chunk seg_rel sorted, real rel < chunk_entities, padding = trash
+            nc, cap, e_c = blocks.statics
+            seg = blocks.seg_rel.reshape(-1, cap)
+            assert np.all(np.diff(seg, axis=1) >= 0)
+            mk = blocks.mask.reshape(-1, cap) > 0
+            assert np.all(seg[mk] < e_c)
+            assert np.all(seg[~mk] == e_c)
+            # every chunk's nnz within capacity, entity rows within Ec
+            assert mk.sum(axis=1).max() <= cap
+            # each real entity appears in exactly one chunk row
+            ent = blocks.chunk_entity.reshape(blocks.num_shards, -1)
+            for s in range(shards):
+                real = ent[s][ent[s] < blocks.local_entities]
+                assert real.size == np.unique(real).size
 
 
 def test_segment_memory_is_nnz_proportional():
-    """One degree-10k head entity blows up rectangles, not the flat run."""
+    """One degree-10k head entity blows up rectangles, not the packed runs."""
     rng = np.random.default_rng(0)
     head_users = np.arange(1, 10001)
     tail_m = rng.integers(2, 300, size=3000)
@@ -76,7 +96,8 @@ def test_segment_memory_is_nnz_proportional():
     m_dense = mmap.to_dense(movie)
     u_dense = IdMap.from_raw(user).to_dense(user)
     padded = build_padded_blocks(m_dense, u_dense, rating, mmap.num_entities)
-    seg = build_segment_blocks(m_dense, u_dense, rating, mmap.num_entities)
+    seg = build_segment_blocks(m_dense, u_dense, rating, mmap.num_entities,
+                               chunk_nnz=1 << 14)
     assert padded.neighbor_idx.size > 20 * seg.neighbor_idx.size
 
 
@@ -100,10 +121,9 @@ def test_segment_chunked_matches_unchunked(tiny_coo):
 
     config = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0)
     ds_one = Dataset.from_coo(tiny_coo, layout="segment", chunk_elems=None)
-    # chunk_nnz = chunk_elems // 64 → windows of 8 entries
     ds_chunked = Dataset.from_coo(tiny_coo, layout="segment", chunk_elems=512)
-    assert ds_chunked.movie_blocks.chunk_nnz == 8
-    assert ds_one.movie_blocks.chunk_nnz is None
+    assert ds_one.movie_blocks.num_chunks == 1
+    assert ds_chunked.movie_blocks.num_chunks > 1
     preds_one = train_als(ds_one, config).predict_dense()
     preds_chunked = train_als(ds_chunked, config).predict_dense()
     np.testing.assert_allclose(preds_chunked, preds_one, atol=1e-4, rtol=1e-4)
@@ -128,7 +148,7 @@ def test_segment_spmd_matches_single_device():
 
 
 def test_segment_spmd_chunked_matches_single_device():
-    """Sharded + windowed scan together (the full-Netflix configuration)."""
+    """Sharded + packed chunks together (the full-Netflix configuration)."""
     from cfk_tpu.models.als import train_als
     from cfk_tpu.parallel.mesh import make_mesh
     from cfk_tpu.parallel.spmd import train_als_sharded
@@ -139,8 +159,8 @@ def test_segment_spmd_chunked_matches_single_device():
     config8 = ALSConfig(
         rank=4, lam=0.05, num_iterations=2, seed=1, num_shards=8, layout="segment",
     )
-    ds8 = Dataset.from_coo(coo, num_shards=8, layout="segment", chunk_elems=2048)
-    assert ds8.movie_blocks.chunk_nnz is not None
+    ds8 = Dataset.from_coo(coo, num_shards=8, layout="segment", chunk_elems=256)
+    assert ds8.movie_blocks.num_chunks > 1
     sharded = train_als_sharded(ds8, config8, make_mesh(8)).predict_dense()
     np.testing.assert_allclose(sharded, single, atol=2e-3, rtol=1e-3)
 
@@ -153,6 +173,18 @@ def test_segment_ials_matches_padded():
     preds_p = train_ials(Dataset.from_coo(coo, layout="padded"), config).predict_dense()
     preds_s = train_ials(Dataset.from_coo(coo, layout="segment"), config).predict_dense()
     np.testing.assert_allclose(preds_s, preds_p, atol=2e-3, rtol=1e-3)
+
+
+def test_segment_ials_chunked_matches_padded():
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+
+    coo = powerlaw_coo(n_movies=48, n_users=64, nnz=1200)
+    config = IALSConfig(rank=4, lam=0.1, alpha=5.0, num_iterations=2, seed=2)
+    preds_p = train_ials(Dataset.from_coo(coo, layout="padded"), config).predict_dense()
+    ds_c = Dataset.from_coo(coo, layout="segment", chunk_elems=256)
+    assert ds_c.movie_blocks.num_chunks > 1
+    preds_c = train_ials(ds_c, config).predict_dense()
+    np.testing.assert_allclose(preds_c, preds_p, atol=2e-3, rtol=1e-3)
 
 
 def test_segment_ials_sharded_matches_single():
