@@ -13,6 +13,11 @@ Three subcommands:
 - ``evaluate`` — offline MSE/RMSE of a prediction CSV against a ratings
   file: the (fixed) replacement for ``scripts/calculate_mse.py`` (which
   reads uninitialized ``np.empty`` memory and can print nan).
+- ``recommend`` — top-K serving from checkpointed factors.
+- ``broker`` / ``produce`` — run the native TCP log broker and stream a
+  ratings file into it; ``train --data tcp://HOST:PORT[/TOPIC]`` then
+  ingests from the broker (the reference's producer → Kafka → app split,
+  ``apps/ALSAppRunner.java:30-33``, as separate processes).
 """
 
 from __future__ import annotations
@@ -28,13 +33,43 @@ def _eprint(*args) -> None:
     print(*args, file=sys.stderr)
 
 
+def _parse_tcp_url(url: str) -> tuple[str, int, str]:
+    """``tcp://HOST:PORT[/TOPIC]`` → (host, port, topic)."""
+    from cfk_tpu.transport.ingest import RATINGS_TOPIC
+
+    if not url.startswith("tcp://"):
+        raise ValueError(
+            f"bad broker url {url!r}; expected tcp://HOST:PORT[/TOPIC]"
+        )
+    rest = url[len("tcp://"):]
+    addr, _, topic = rest.partition("/")
+    host, _, port_s = addr.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise ValueError(f"bad broker url {url!r}; expected tcp://HOST:PORT[/TOPIC]")
+    return host, int(port_s), topic or RATINGS_TOPIC
+
+
 def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded",
                   chunk_elems=1 << 20):
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.movielens import parse_movielens_csv
     from cfk_tpu.data.netflix import parse_netflix
 
-    if fmt == "netflix":
+    if path.startswith("tcp://"):
+        from cfk_tpu.transport.ingest import collect_ratings
+        from cfk_tpu.transport.tcp import TcpBrokerClient
+
+        if fmt != "netflix" or min_rating:
+            # Broker records are already-parsed (movieId, userId, rating)
+            # wire frames; file-parse flags have nothing to apply to.
+            _eprint(
+                "warning: --format/--min-rating are ignored for tcp:// "
+                "ingest (records on the broker are already parsed)"
+            )
+        host, port, topic = _parse_tcp_url(path)
+        with TcpBrokerClient(host, port) as client:
+            coo = collect_ratings(client, topic=topic)
+    elif fmt == "netflix":
         coo = parse_netflix(path)
     else:
         coo = parse_movielens_csv(path, min_rating=min_rating)
@@ -242,6 +277,61 @@ def _recommend(args) -> int:
     return 0
 
 
+def _broker(args) -> int:
+    """Run the native broker server in the foreground."""
+    import subprocess
+
+    from cfk_tpu.transport.tcp import _BROKER_BIN, build_broker
+
+    if not build_broker(quiet=False):
+        _eprint("error: cfk_broker binary unavailable (make -C native failed)")
+        return 1
+    argv = [_BROKER_BIN, str(args.port)]
+    if args.data_dir or args.bind != "127.0.0.1":
+        argv.append(args.data_dir or "")
+    if args.bind != "127.0.0.1":
+        argv.append(args.bind)
+    try:
+        return subprocess.run(argv).returncode
+    except KeyboardInterrupt:
+        return 0
+
+
+def _produce(args) -> int:
+    """Stream a Netflix-format ratings file into a broker topic.
+
+    The reference's producer-then-app sequencing (``apps/ALSAppRunner.java:30-33``)
+    as two processes: ``cfk_tpu produce`` here, ``cfk_tpu train --data
+    tcp://...`` there.
+    """
+    from cfk_tpu.transport.ingest import produce_ratings_file
+    from cfk_tpu.transport.tcp import TcpBrokerClient
+
+    host, port, topic = _parse_tcp_url(args.broker)
+    if args.partitions < 1:
+        _eprint(f"error: --partitions must be >= 1, got {args.partitions}")
+        return 1
+    with TcpBrokerClient(host, port) as client:
+        try:
+            client.create_topic(topic, args.partitions)
+        except ValueError as e:
+            if "already exists" not in str(e):
+                raise
+            if not args.append:
+                _eprint(
+                    f"error: topic {topic!r} already exists (use --append to "
+                    "add to a topic produced with --no-eof; a finalized "
+                    "topic's EOF records would fail the ingest barrier)"
+                )
+                return 1
+        n = produce_ratings_file(
+            client, args.data, topic=topic, send_eof=not args.no_eof
+        )
+    state = "open (no EOF yet)" if args.no_eof else "finalized"
+    _eprint(f"produced {n} ratings to {topic!r} on {host}:{port} [{state}]")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cfk_tpu", description=__doc__)
     p.add_argument(
@@ -319,6 +409,32 @@ def build_parser() -> argparse.ArgumentParser:
     rc.add_argument("--include-seen", action="store_true",
                     help="do not exclude already-rated movies")
     rc.set_defaults(fn=_recommend)
+
+    b = sub.add_parser(
+        "broker", help="run the native TCP log broker (native/cfk_broker)"
+    )
+    b.add_argument("--port", type=int, default=29092,
+                   help="0 picks an ephemeral port (printed on stdout)")
+    b.add_argument("--data-dir", default=None,
+                   help="persist logs here (FileBroker-compatible format); "
+                   "default is memory-only")
+    b.add_argument("--bind", default="127.0.0.1",
+                   help="listen address; 0.0.0.0 accepts cross-host clients")
+    b.set_defaults(fn=_broker)
+
+    pr = sub.add_parser(
+        "produce", help="stream a Netflix-format ratings file into a broker"
+    )
+    pr.add_argument("--broker", required=True, help="tcp://HOST:PORT[/TOPIC]")
+    pr.add_argument("--data", required=True)
+    pr.add_argument("--partitions", type=int, default=4)
+    pr.add_argument("--append", action="store_true",
+                    help="produce into an existing topic (only sound if every "
+                    "earlier produce used --no-eof; EOF means end-of-ingest)")
+    pr.add_argument("--no-eof", action="store_true",
+                    help="skip the EOF fan-out, leaving the topic open for "
+                    "more files; the final produce must omit this flag")
+    pr.set_defaults(fn=_produce)
     return p
 
 

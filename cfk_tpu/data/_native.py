@@ -103,8 +103,11 @@ def available() -> bool:
 def build(quiet: bool = True) -> bool:
     """Compile the shared library with make; returns availability."""
     try:
+        # Target the .so explicitly: a broken cfk_broker build (e.g. the
+        # sockets code on a non-Linux platform) must not disable the parser
+        # fast path too.
         subprocess.run(
-            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            ["make", "-C", os.path.abspath(_NATIVE_DIR), "libcfk_native.so"],
             check=True,
             capture_output=quiet,
         )

@@ -7,6 +7,7 @@ from cfk_tpu.transport.ingest import (
     collect_ratings,
     produce_ratings_file,
 )
+from cfk_tpu.transport.tcp import BrokerProcess, BrokerRequestError, TcpBrokerClient
 from cfk_tpu.transport.serdes import (
     EOF_ID,
     FeatureRecord,
@@ -22,6 +23,9 @@ from cfk_tpu.transport.serdes import (
 )
 
 __all__ = [
+    "BrokerProcess",
+    "BrokerRequestError",
+    "TcpBrokerClient",
     "FileBroker",
     "InMemoryBroker",
     "Record",

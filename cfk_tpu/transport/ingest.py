@@ -40,13 +40,18 @@ def produce_ratings_file(
     path: str,
     *,
     topic: str = RATINGS_TOPIC,
+    send_eof: bool = True,
     drop_eof_for: set[int] | None = None,
 ) -> int:
     """Stream a Netflix-format file into ``topic``, keyed by movieId.
 
-    Returns the number of rating records produced.  ``drop_eof_for`` is a
-    fault-injection hook: partitions listed there do NOT receive their EOF
-    record (simulating the reference's lost-message failure mode).
+    Returns the number of rating records produced.  ``send_eof=False`` skips
+    the EOF fan-out so further files can be appended to the topic; the LAST
+    produce must send EOF or ``collect_ratings`` refuses the topic (records
+    after an EOF also fail the barrier — EOF means *end*, exactly as in the
+    reference's protocol).  ``drop_eof_for`` is a fault-injection hook:
+    partitions listed there do NOT receive their EOF record (simulating the
+    reference's lost-message failure mode).
     """
     n = transport.num_partitions(topic)
     produced = 0
@@ -76,6 +81,8 @@ def produce_ratings_file(
                 value=encode_id_rating(IdRatingPair(id=user_id, rating=rating)),
             )
             produced += 1
+    if not send_eof:
+        return produced
     drop = drop_eof_for or set()
     for p in range(n):
         if p in drop:

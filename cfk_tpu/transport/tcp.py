@@ -1,0 +1,331 @@
+"""TCP Transport client for the native broker (``native/cfk_broker.cpp``).
+
+The reference's durable-log service is a Kafka broker reached over TCP
+(``apps/BaseKafkaApp.java:19`` hardcodes ``localhost:29092``); this is the
+framework's native equivalent — ``TcpBrokerClient`` implements the same
+``Transport`` protocol as ``InMemoryBroker``/``FileBroker``, so ingest's
+EOF-barrier protocol and the checkpoint journal run unchanged against a
+broker *process*, across process and host boundaries.
+
+Throughput comes from batching, the same lever as the reference's Kafka
+producer (async sends, unbounded ``buffer.memory``,
+``producers/NetflixDataFormatProducer.java:31-33``): ``produce`` buffers
+records client-side and ships one PRODUCE_BATCH frame per
+``batch_records``/``batch_bytes`` window.  Read-your-writes holds because
+every read operation (``consume``/``end_offset``) flushes the buffer first.
+
+Wire protocol: see the header comment of ``native/cfk_broker.cpp``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import time
+from typing import Iterator
+
+from cfk_tpu.transport.broker import Record
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native")
+)
+_BROKER_BIN = os.path.join(_NATIVE_DIR, "cfk_broker")
+
+_OP_CREATE_TOPIC = 1
+_OP_PRODUCE_BATCH = 2
+_OP_FETCH = 3
+_OP_NUM_PARTITIONS = 4
+_OP_END_OFFSET = 5
+_OP_DELETE_TOPIC = 6
+_OP_PING = 7
+_OP_LIST_TOPICS = 8
+
+
+class BrokerRequestError(RuntimeError):
+    """The broker rejected a request (unknown topic, bad partition, ...)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionError("broker closed the connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class TcpBrokerClient:
+    """Transport over one TCP connection to a cfk_broker server.
+
+    Not thread-safe (one in-flight request per connection); open one client
+    per thread/process, like one Kafka producer per thread.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        batch_records: int = 4096,
+        batch_bytes: int = 1 << 20,
+        fetch_records: int = 8192,
+        fetch_bytes: int = 4 << 20,
+    ) -> None:
+        self._sock = socket.create_connection((host, port))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._batch_records = batch_records
+        self._batch_bytes = batch_bytes
+        self._fetch_records = fetch_records
+        self._fetch_bytes = fetch_bytes
+        # Pending PRODUCE buffer: topic → (list of encoded records, bytes).
+        self._pending: dict[str, list[bytes]] = {}
+        self._pending_count = 0
+        self._pending_bytes = 0
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _request(self, body: bytes) -> bytes:
+        self._sock.sendall(struct.pack(">I", len(body)) + body)
+        (blen,) = struct.unpack(">I", _recv_exact(self._sock, 4))
+        resp = _recv_exact(self._sock, blen)
+        if resp[0] == 0:
+            return resp[1:]
+        (mlen,) = struct.unpack(">H", resp[1:3])
+        message = resp[3 : 3 + mlen].decode("utf-8", "replace")
+        if "unknown topic" in message:
+            # Same exception type as the in-process Transports, so callers'
+            # provision-before-run handling is implementation-agnostic.
+            raise KeyError(message)
+        raise BrokerRequestError(message)
+
+    @staticmethod
+    def _name(topic: str) -> bytes:
+        raw = topic.encode()
+        return struct.pack(">H", len(raw)) + raw
+
+    # -- Transport protocol -------------------------------------------------
+
+    def create_topic(self, name: str, num_partitions: int) -> None:
+        if num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        try:
+            self._request(
+                bytes([_OP_CREATE_TOPIC]) + self._name(name)
+                + struct.pack(">I", num_partitions)
+            )
+        except BrokerRequestError as e:
+            if "already exists" in str(e):
+                raise ValueError(str(e)) from None
+            raise
+
+    def delete_topic(self, name: str) -> None:
+        self._pending.pop(name, None)
+        self._request(bytes([_OP_DELETE_TOPIC]) + self._name(name))
+
+    def produce(
+        self, topic: str, key: int, value: bytes, partition: int | None = None
+    ) -> None:
+        if partition is None and key < 0:
+            # Fail on the client, matching mod_partition's contract; the
+            # server enforces the same rule.
+            raise ValueError(
+                f"negative key {key} requires an explicit partition="
+            )
+        rec = struct.pack(
+            ">iiI", -1 if partition is None else partition, key, len(value)
+        ) + value
+        self._pending.setdefault(topic, []).append(rec)
+        self._pending_count += 1
+        self._pending_bytes += len(rec)
+        if (
+            self._pending_count >= self._batch_records
+            or self._pending_bytes >= self._batch_bytes
+        ):
+            self.flush()
+
+    def flush(self) -> None:
+        """Ship all buffered records (one PRODUCE_BATCH per topic).
+
+        On a failed request the unsent topics' records are restored to the
+        buffer.  The failing topic's own batch is restored only for an
+        unknown-topic rejection (KeyError) — the server validates the whole
+        batch before appending anything, so "create the topic, flush again"
+        loses nothing.  Other rejections (bad partition, malformed record)
+        would fail identically on retry, so that batch is dropped with the
+        raised error as the caller's signal; a transport failure mid-request
+        (ConnectionError) leaves the batch in doubt.
+        """
+        pending, self._pending = self._pending, {}
+        self._pending_count = self._pending_bytes = 0
+
+        def restore(topic):
+            restored = self._pending.setdefault(topic, [])
+            restored[:0] = pending[topic]
+            self._pending_count += len(pending[topic])
+            self._pending_bytes += sum(len(r) for r in pending[topic])
+
+        topics = list(pending)
+        for i, topic in enumerate(topics):
+            recs = pending[topic]
+            try:
+                self._request(
+                    bytes([_OP_PRODUCE_BATCH]) + self._name(topic)
+                    + struct.pack(">I", len(recs)) + b"".join(recs)
+                )
+            except Exception as e:
+                if isinstance(e, KeyError):
+                    restore(topic)
+                for unsent in topics[i + 1:]:
+                    restore(unsent)
+                raise
+
+    def consume(
+        self, topic: str, partition: int, start_offset: int = 0
+    ) -> Iterator[Record]:
+        self.flush()
+        offset = start_offset
+        # Snapshot semantics like the other Transports: stop at the log end
+        # observed on the FIRST fetch — a concurrent producer must not turn
+        # this iterator into an endless tail.
+        snapshot_end: int | None = None
+        while True:
+            resp = self._request(
+                bytes([_OP_FETCH]) + self._name(topic)
+                + struct.pack(
+                    ">IQII", partition, offset,
+                    self._fetch_records, self._fetch_bytes,
+                )
+            )
+            log_end, count = struct.unpack(">QI", resp[:12])
+            if snapshot_end is None:
+                snapshot_end = log_end
+            pos = 12
+            for _ in range(count):
+                key, vlen = struct.unpack(">iI", resp[pos : pos + 8])
+                pos += 8
+                if offset >= snapshot_end:
+                    return
+                yield Record(key=key, value=resp[pos : pos + vlen], offset=offset)
+                pos += vlen
+                offset += 1
+            if count == 0 or offset >= snapshot_end:
+                return
+
+    def num_partitions(self, topic: str) -> int:
+        resp = self._request(bytes([_OP_NUM_PARTITIONS]) + self._name(topic))
+        return struct.unpack(">I", resp)[0]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        self.flush()
+        resp = self._request(
+            bytes([_OP_END_OFFSET]) + self._name(topic)
+            + struct.pack(">I", partition)
+        )
+        return struct.unpack(">Q", resp)[0]
+
+    # -- extras -------------------------------------------------------------
+
+    def ping(self) -> None:
+        self._request(bytes([_OP_PING]))
+
+    def topics(self) -> list[str]:
+        resp = self._request(bytes([_OP_LIST_TOPICS]))
+        (count,) = struct.unpack(">I", resp[:4])
+        names, pos = [], 4
+        for _ in range(count):
+            (nlen,) = struct.unpack(">H", resp[pos : pos + 2])
+            pos += 2
+            names.append(resp[pos : pos + nlen].decode())
+            pos += nlen
+        return names
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "TcpBrokerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def build_broker(quiet: bool = True) -> bool:
+    """Compile the broker binary with make; returns availability."""
+    if os.path.exists(_BROKER_BIN):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "cfk_broker"],
+            check=True, capture_output=quiet,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    return os.path.exists(_BROKER_BIN)
+
+
+class BrokerProcess:
+    """Spawn a cfk_broker server subprocess and wait until it listens.
+
+    ``port=0`` picks an ephemeral port (read back from the server's
+    ``CFK_BROKER LISTENING <port>`` line).  ``data_dir=None`` runs the broker
+    memory-only; with a directory, logs persist in the FileBroker on-disk
+    format and survive restarts.
+    """
+
+    def __init__(
+        self, port: int = 0, data_dir: str | None = None, *, timeout: float = 10.0
+    ) -> None:
+        if not build_broker():
+            raise RuntimeError(
+                "cfk_broker binary unavailable (make -C native failed)"
+            )
+        argv = [_BROKER_BIN, str(port)] + ([data_dir] if data_dir else [])
+        self.proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+        )
+        # select-based wait: readline() alone would block past the timeout
+        # if the server wedges before printing its LISTENING line.
+        import select
+
+        deadline = time.monotonic() + timeout
+        line = ""
+        while "LISTENING" not in line:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"cfk_broker exited with {self.proc.returncode}"
+                )
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.terminate()
+                raise TimeoutError("cfk_broker did not start listening in time")
+            ready, _, _ = select.select([self.proc.stdout], [], [], min(remaining, 0.5))
+            if ready:
+                line = self.proc.stdout.readline()
+                if not line:  # EOF: process died without the banner
+                    continue
+        self.port = int(line.strip().rsplit(" ", 1)[-1])
+
+    def connect(self, **kwargs) -> TcpBrokerClient:
+        return TcpBrokerClient("127.0.0.1", self.port, **kwargs)
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+    def __enter__(self) -> "BrokerProcess":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()
