@@ -146,7 +146,7 @@ if __name__ == "__main__":
     parser.add_argument("--iterations", type=int, default=3)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--layout", choices=["padded", "bucketed", "segment"],
-                        default="bucketed")
+                        default="segment")
     parser.add_argument("--dtype", choices=["float32", "bfloat16"],
                         default="float32")
     parser.add_argument("--chunk-elems", type=int, default=1 << 20)

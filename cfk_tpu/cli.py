@@ -370,15 +370,15 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--pad-multiple", type=int, default=8)
     t.add_argument(
         "--layout", choices=["padded", "bucketed", "segment"], default="padded",
-        help="InBlock layout: one rectangle, power-of-two width buckets "
-        "(needed at full-Netflix scale), or flat segment-sum runs "
-        "(exactly O(nnz) memory for arbitrarily skewed data)",
+        help="InBlock layout: one rectangle, power-of-two width buckets, or "
+        "flat segment runs with grouped ragged-matmul Grams (exactly O(nnz) "
+        "memory for arbitrarily skewed data; fastest at full-Netflix scale)",
     )
     t.add_argument(
         "--chunk-elems", type=int, default=1 << 20,
         help="bucketed/segment layouts: HBM budget for the per-solve-chunk "
-        "neighbor-factor gather (rows·width cells; segment windows are "
-        "chunk_elems/64 entries)",
+        "neighbor-factor gather (bucketed: rows·width cells; segment: "
+        "ratings per scan chunk)",
     )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)

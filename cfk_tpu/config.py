@@ -59,14 +59,16 @@ class ALSConfig:
     #   "bucketed" — power-of-two width classes (the ALX layout); total
     #                padded cells stay within ~2× nnz, required at full
     #                Netflix-Prize scale. all_gather exchange only.
-    #   "segment"  — flat CSR-style runs; Gram matrices accumulate by sorted
-    #                segment_sum over per-rating outer products. Exactly
-    #                O(nnz) memory for arbitrarily skewed degree
-    #                distributions. all_gather exchange only.
+    #   "segment"  — flat CSR-style runs scanned in fixed-size nnz chunks;
+    #                Gram matrices accumulate by grouped ragged matmul on the
+    #                MXU, and entities hotter than one chunk straddle chunks
+    #                via a carried partial Gram. Exactly O(nnz) memory for
+    #                arbitrarily skewed degree distributions, and the fastest
+    #                layout at full-Netflix scale. all_gather exchange only.
     layout: Literal["padded", "bucketed", "segment"] = "padded"
     # Bucketed/segment layouts: max gather cells per solve chunk — bounds the
-    # transient [chunk, width, rank] neighbor-factor gather (segment windows
-    # are chunk_elems/64 entries).  Consumed at dataset build time: pass it as
+    # transient [chunk, width, rank] neighbor-factor gather (segment chunks
+    # hold chunk_elems ratings).  Consumed at dataset build time: pass it as
     # Dataset.from_coo(..., chunk_elems=config.bucket_chunk_elems) — the CLI
     # does (--chunk-elems); the chunk hints then live statically on the
     # blocks, not in this config.

@@ -379,30 +379,38 @@ def build_bucketed_blocks(
 
 @dataclasses.dataclass(frozen=True)
 class SegmentBlocks:
-    """Flat CSR-style InBlocks packed into entity-range chunks.
+    """Flat CSR-style InBlocks packed into fixed-size chunks.
 
     The third layout for the ragged-InBlock problem (SURVEY.md §5 long-context
     analog): instead of padding entities into rectangles (``PaddedBlocks``) or
     width classes (``BucketedBlocks``), ratings stay flat sorted runs and the
-    per-entity Gram matrices are accumulated with ``jax.ops.segment_sum`` over
-    per-rating outer products — O(nnz) memory regardless of the degree
-    distribution, and the fastest layout on TPU (one big coalesced gather +
-    a fused outer-product/scatter instead of many small bucketed matmuls).
+    per-entity Gram matrices are accumulated by sorted grouped matmul
+    (``lax.ragged_dot_general`` on the MXU, ``segment_sum`` fallback) —
+    O(nnz) memory regardless of the degree distribution.
 
-    Each shard's run is cut at entity boundaries into ``num_chunks`` chunks
-    of ≤ ``chunk_cap`` ratings covering ≤ ``chunk_entities`` consecutive
-    entities (dense ids are compact — every ``IdMap`` id has ≥ 1 rating — so
-    an entity range IS a contiguous rating slice).  The solve maps over
+    Each shard's sorted run is cut into ``num_chunks`` chunks of ≤
+    ``chunk_cap`` ratings covering ≤ ``chunk_entities`` consecutive entities
+    (dense ids are compact — every ``IdMap`` id has ≥ 1 rating — so an
+    entity range IS a contiguous rating slice).  **Entities may straddle
+    chunk boundaries**: a hot entity with more ratings than ``chunk_cap``
+    spans several chunks, and the solve scan carries its partial Gram/RHS
+    across them (``carry_in`` flags the continuation; ``last_seg`` indexes
+    the straddling segment).  Chunk capacity is therefore independent of the
+    maximum degree — the property that makes the layout robust to
+    arbitrarily skewed data, where the old entity-boundary packing inflated
+    every chunk to the hottest entity's degree.  The solve scans over
     chunks, so device memory for the Gram accumulator is
     O(chunk_entities·k²), never O(E·k²): at full-Netflix scale the
-    unchunked user-side accumulator alone (480k·64² floats ≈ 8 GB, and
-    ~45 GB with scan double-buffering) exceeds single-chip HBM.  Entries are
-    shard-major ⇒ every array shards as ``P("shard")``.
+    unchunked user-side accumulator alone (480k·64² floats ≈ 8 GB) exceeds
+    single-chip HBM.  Entries are shard-major ⇒ every array shards as
+    ``P("shard")``.
 
     ``seg_rel`` holds each rating's entity index *relative to its chunk's
     first entity* (padding entries use the ``chunk_entities`` trash row);
     ``chunk_entity``/``chunk_count`` give each chunk row's shard-local
-    entity id (``local_entities`` = trash) and rating count.
+    entity id and rating count — ``local_entities`` (trash) for rows whose
+    entity is *not finalized* in that chunk (straddlers continuing into the
+    next chunk, and padding rows).
     """
 
     neighbor_idx: np.ndarray  # int32 [S·NC·C] dense idx into the fixed side (0 at padding)
@@ -410,7 +418,10 @@ class SegmentBlocks:
     mask: np.ndarray  # float32 [S·NC·C] 1.0 = real rating
     seg_rel: np.ndarray  # int32 [S·NC·C] chunk-relative entity row, sorted per chunk
     chunk_entity: np.ndarray  # int32 [S·NC·Ec] shard-local entity row (e_local = trash)
-    chunk_count: np.ndarray  # int32 [S·NC·Ec] per-row rating count (0 = padding)
+    chunk_count: np.ndarray  # int32 [S·NC·Ec] full rating count of finalized rows (0 else)
+    carry_in: np.ndarray  # float32 [S·NC] 1.0 = chunk's seg 0 continues the previous chunk
+    last_seg: np.ndarray  # int32 [S·NC] chunk-relative index of the last real segment
+    chunk_first: np.ndarray  # int32 [S·NC] shard-local entity id of each chunk's seg 0
     count: np.ndarray  # int32 [E_pad] real nnz per entity (0 for pad rows)
     rating_sum: np.ndarray  # float32 [E_pad] per-entity rating sum (for init)
     num_entities: int
@@ -447,14 +458,18 @@ def build_segment_blocks(
     num_shards: int = 1,
     pad_multiple: int = 8,
     chunk_nnz: int | None = None,
+    chunk_entity_cap: int | None = None,
 ) -> SegmentBlocks:
-    """Sort ratings by (shard, local entity row) and pack into entity chunks.
+    """Sort ratings by (shard, local entity row) and pack into nnz chunks.
 
-    ``chunk_nnz`` is the target ratings-per-chunk capacity, bounding the
-    per-chunk gather; each chunk also covers at most ``chunk_nnz // 64``
-    entities, bounding the [Ec, k, k] Gram accumulator even on all-degree-1
-    runs.  ``None`` packs each shard into a single chunk (fine until the
-    per-shard entity count × k² outgrows HBM).
+    ``chunk_nnz`` is the ratings-per-chunk capacity, bounding the per-chunk
+    gather; a chunk also covers at most ``chunk_entity_cap`` consecutive
+    entities (default ``min(chunk_nnz // 32, 16384)``), bounding the
+    [Ec, k, k] Gram accumulator even on all-degree-1 runs.  Entities whose
+    degree exceeds the capacity **straddle chunks** — the solve scan carries
+    their partial Gram across the boundary — so the capacity never inflates
+    with the degree distribution's head.  ``None`` packs each shard into a
+    single chunk (fine until the per-shard entity count × k² outgrows HBM).
     """
     e_pad = _round_up(num_solve_entities, num_shards)
     e_local = e_pad // num_shards
@@ -472,41 +487,45 @@ def build_segment_blocks(
     np.cumsum(per_shard_nnz[:-1], out=shard_start[1:])
     # Rated local entities are consecutive from 0 (compact dense ids; only
     # the global-pad tail of the last shard is unrated).
-    n_rated_local = (counts_local > 0).sum(axis=1)
 
-    cap = max(int(count.max()), 1, pad_multiple)
-    if chunk_nnz is not None:
-        cap = max(cap, int(chunk_nnz))
-    # Greedy entity-boundary packing per shard: each chunk covers a
-    # consecutive entity range whose total nnz fits the capacity.
-    cums = []
-    bounds: list[list[int]] = []
+    if chunk_nnz is None:
+        cap = max(int(per_shard_nnz.max()), 1, pad_multiple)
+        e_cap = max(e_local, 1)
+    else:
+        # Never pad a chunk beyond the largest shard's actual run.
+        cap = max(min(int(chunk_nnz), int(per_shard_nnz.max())), pad_multiple)
+        if chunk_entity_cap is not None:
+            e_cap = max(int(chunk_entity_cap), 1)
+        else:
+            e_cap = max(1, min(cap // 32, 1 << 14))
+    cap = _round_up(cap, pad_multiple)
+
+    # Greedy nnz packing per shard: cut the sorted run every ``cap`` entries
+    # (or sooner when the slice would span more than ``e_cap`` entities).
+    # Cuts may fall inside an entity's run — that entity straddles chunks.
+    shard_cuts: list[list[tuple[int, int]]] = []
     for s in range(num_shards):
+        lo = int(shard_start[s])
+        hi = lo + int(per_shard_nnz[s])
+        # cum[e] = shard-run position of entity e's first entry
         cum = np.zeros(e_local + 1, dtype=np.int64)
         np.cumsum(counts_local[s], out=cum[1:])
-        cums.append(cum)
-        b = [0]
-        if chunk_nnz is None:
-            b.append(int(n_rated_local[s]))
-        else:
-            # Entities-per-chunk cap: bounds the [Ec, k, k] Gram accumulator
-            # and the NC·Ec entity-array padding on low-degree runs.
-            e_cap = max(1, cap // 32)
-            while b[-1] < n_rated_local[s]:
-                nxt = int(np.searchsorted(cum, cum[b[-1]] + cap, side="right")) - 1
-                nxt = min(nxt, b[-1] + e_cap)
-                b.append(min(max(nxt, b[-1] + 1), int(n_rated_local[s])))
-        bounds.append(b)
+        cuts = []
+        pos = lo
+        while pos < hi:
+            end = min(pos + cap, hi)
+            first = int(local_sorted[pos])
+            if int(local_sorted[end - 1]) - first + 1 > e_cap:
+                end = lo + int(cum[first + e_cap])
+            cuts.append((pos, end))
+            pos = end
+        shard_cuts.append(cuts)
 
-    num_chunks = max(max(len(b) - 1 for b in bounds), 1)
-    e_c = max(
-        max((b[i + 1] - b[i] for i in range(len(b) - 1)), default=1)
-        for b in bounds
-    )
-    e_c = max(e_c, 1)
-    if chunk_nnz is None:
-        cap = max(int(per_shard_nnz.max()), 1)
-    cap = _round_up(cap, pad_multiple)
+    num_chunks = max(max((len(c) for c in shard_cuts), default=1), 1)
+    e_c = 1
+    for cuts in shard_cuts:
+        for p0, p1 in cuts:
+            e_c = max(e_c, int(local_sorted[p1 - 1]) - int(local_sorted[p0]) + 1)
 
     neighbor = np.zeros(num_shards * num_chunks * cap, dtype=np.int32)
     rmat = np.zeros(num_shards * num_chunks * cap, dtype=np.float32)
@@ -514,29 +533,38 @@ def build_segment_blocks(
     seg = np.full(num_shards * num_chunks * cap, e_c, dtype=np.int32)  # trash
     chunk_entity = np.full(num_shards * num_chunks * e_c, e_local, dtype=np.int32)
     chunk_count = np.zeros(num_shards * num_chunks * e_c, dtype=np.int32)
+    carry_in = np.zeros(num_shards * num_chunks, dtype=np.float32)
+    last_seg = np.zeros(num_shards * num_chunks, dtype=np.int32)
+    chunk_first = np.zeros(num_shards * num_chunks, dtype=np.int32)
 
     for s in range(num_shards):
-        cum = cums[s]
-        b = bounds[s]
-        for c in range(len(b) - 1):
-            e0, e1 = b[c], b[c + 1]
-            src0 = shard_start[s] + cum[e0]
-            src1 = shard_start[s] + cum[e1]
-            n = int(src1 - src0)
-            if n > cap:
-                raise AssertionError(
-                    f"chunk nnz {n} exceeds capacity {cap} (packing bug)"
-                )
-            dst = (s * num_chunks + c) * cap
-            neighbor[dst : dst + n] = f_sorted[src0:src1]
-            rmat[dst : dst + n] = r_sorted[src0:src1]
+        lo = int(shard_start[s])
+        hi = lo + int(per_shard_nnz[s])
+        for c, (p0, p1) in enumerate(shard_cuts[s]):
+            n = p1 - p0
+            ci = s * num_chunks + c
+            dst = ci * cap
+            first = int(local_sorted[p0])
+            last = int(local_sorted[p1 - 1])
+            neighbor[dst : dst + n] = f_sorted[p0:p1]
+            rmat[dst : dst + n] = r_sorted[p0:p1]
             mask[dst : dst + n] = 1.0
-            seg[dst : dst + n] = local_sorted[src0:src1] - e0
-            ebase = (s * num_chunks + c) * e_c
-            chunk_entity[ebase : ebase + (e1 - e0)] = np.arange(
-                e0, e1, dtype=np.int32
-            )
-            chunk_count[ebase : ebase + (e1 - e0)] = counts_local[s, e0:e1]
+            seg[dst : dst + n] = local_sorted[p0:p1] - first
+            carry_in[ci] = float(p0 > lo and int(local_sorted[p0 - 1]) == first)
+            last_seg[ci] = last - first
+            chunk_first[ci] = first
+            # Rows are finalized here unless the last entity continues into
+            # the next chunk; only the finalizing chunk writes the output row.
+            cont_out = p1 < hi and int(local_sorted[p1]) == last
+            n_final = (last - first + 1) - int(cont_out)
+            if n_final > 0:
+                ebase = ci * e_c
+                chunk_entity[ebase : ebase + n_final] = np.arange(
+                    first, first + n_final, dtype=np.int32
+                )
+                chunk_count[ebase : ebase + n_final] = counts_local[
+                    s, first : first + n_final
+                ]
 
     rating_sum = np.zeros(e_pad, dtype=np.float32)
     rating_sum[:num_solve_entities] = np.bincount(
@@ -549,6 +577,9 @@ def build_segment_blocks(
         seg_rel=seg,
         chunk_entity=chunk_entity,
         chunk_count=chunk_count,
+        carry_in=carry_in,
+        last_seg=last_seg,
+        chunk_first=chunk_first,
         count=count_pad,
         rating_sum=rating_sum,
         num_entities=num_solve_entities,
@@ -703,15 +734,22 @@ class Dataset:
                 chunk_elems=chunk_elems,
             )
         elif layout == "segment":
-            # chunk_elems budgets gather cells·k for the rectangular layouts;
-            # the segment path's peak is the [C, k, k] per-rating outer
-            # product (XLA materializes it — scatter operands don't fuse), so
-            # divide by a worst-case rank (k = 64) to match the budget.
+            # chunk_elems budgets gather cells·k, same as the rectangular
+            # layouts: the ragged-matmul Gram backend's peak per chunk is the
+            # [C, k] gather.  A JAX without ragged_dot_general falls back to
+            # segment_sum, whose peak is the [C, k, k] outer-product tensor —
+            # shrink the chunk by a worst-case rank so the same flag keeps
+            # meaning "HBM budget" there too.
+            from cfk_tpu.ops.solve import default_segment_backend
+
+            chunk_nnz = chunk_elems
+            if chunk_nnz is not None and default_segment_backend() == "segsum":
+                chunk_nnz = max(64, chunk_nnz // 64)
             build = functools.partial(
                 build_segment_blocks,
                 num_shards=num_shards,
                 pad_multiple=pad_multiple,
-                chunk_nnz=None if chunk_elems is None else max(64, chunk_elems // 64),
+                chunk_nnz=chunk_nnz,
             )
         elif layout == "padded":
             build = functools.partial(

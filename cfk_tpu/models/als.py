@@ -80,6 +80,8 @@ def _segment_to_device(blocks: SegmentBlocks) -> dict[str, jax.Array]:
         "seg_rel": jnp.asarray(blocks.seg_rel),
         "chunk_entity": jnp.asarray(blocks.chunk_entity),
         "chunk_count": jnp.asarray(blocks.chunk_count),
+        "carry_in": jnp.asarray(blocks.carry_in),
+        "last_seg": jnp.asarray(blocks.last_seg),
     }
 
 
@@ -148,6 +150,8 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None):
             blk["seg_rel"],
             blk["chunk_entity"],
             blk["chunk_count"],
+            blk["carry_in"],
+            blk["last_seg"],
             entities,
             lam,
             statics=chunks,

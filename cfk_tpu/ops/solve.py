@@ -260,20 +260,55 @@ def als_half_step(
     return out.reshape(e, fixed_factors.shape[-1])
 
 
-def _segment_gram_flat(fixed_factors, neighbor_idx, weight, rating, mask, num_segments, segment_ids):
-    """Gram/RHS contributions of a flat run of ratings via sorted segment_sum.
+def _ragged_gram_ddn():
+    """Dimension numbers for the grouped-Gram ragged matmul: contract the
+    (ragged, sorted-by-group) entry axis of both operands → [G, k, k]."""
+    return lax.RaggedDotDimensionNumbers(
+        dot_dimension_numbers=(((0,), (0,)), ((), ())),
+        lhs_ragged_dimensions=[0],
+        rhs_group_dimensions=[],
+    )
+
+
+def default_segment_backend() -> str:
+    """Gram backend for the segment layout: grouped ragged matmul (MXU; no
+    [C, k, k] intermediate) when this JAX has it, else sorted segment_sum."""
+    return "ragged" if hasattr(lax, "ragged_dot_general") else "segsum"
+
+
+def _segment_gram_flat(
+    fixed_factors, neighbor_idx, weight, rating, mask, num_segments,
+    segment_ids, backend,
+):
+    """Gram/RHS contributions of a flat sorted run of ratings.
 
     A[e] += Σ w·f fᵀ and b[e] += Σ r·f over the run's entries owned by e
     (``weight`` is 1 for explicit ALS, the confidence excess c−1 for iALS;
-    ``rating`` is r for explicit, c·preference = c for iALS).  Padding entries
-    are masked to zero so their (repeated) segment ids contribute nothing.
+    ``rating`` is r for explicit, c·preference = c for iALS).  Padding
+    entries are masked to zero so their (trash) segment contributes nothing.
+
+    ``backend="ragged"`` computes A as one grouped matmul on the MXU
+    (``lax.ragged_dot_general``) — peak memory is the [C, k] gather;
+    ``"segsum"`` materializes the [C, k, k] per-entry outer products.
     """
     f = fixed_factors[neighbor_idx].astype(jnp.float32) * mask[:, None]
     fw = f * weight[:, None]
-    a = jax.ops.segment_sum(
-        fw[:, :, None] * f[:, None, :], segment_ids,
-        num_segments=num_segments, indices_are_sorted=True,
-    )
+    if backend == "ragged":
+        sizes = jax.ops.segment_sum(
+            jnp.ones(segment_ids.shape, jnp.int32), segment_ids,
+            num_segments=num_segments, indices_are_sorted=True,
+        )
+        a = lax.ragged_dot_general(
+            fw, f, sizes, _ragged_gram_ddn(),
+            precision=lax.Precision.HIGHEST, preferred_element_type=jnp.float32,
+        )
+    elif backend == "segsum":
+        a = jax.ops.segment_sum(
+            fw[:, :, None] * f[:, None, :], segment_ids,
+            num_segments=num_segments, indices_are_sorted=True,
+        )
+    else:
+        raise ValueError(f"unknown segment gram backend {backend!r}")
     b = jax.ops.segment_sum(
         rating[:, None] * f, segment_ids,
         num_segments=num_segments, indices_are_sorted=True,
@@ -281,37 +316,68 @@ def _segment_gram_flat(fixed_factors, neighbor_idx, weight, rating, mask, num_se
     return a, b
 
 
-def _segment_chunk_views(statics, nnz_arrays, entity_arrays):
-    """Reshape flat shard-local segment arrays into per-chunk views.
+def _match_varying(z, ref):
+    """Give constant ``z`` the same device-varying axes as traced ``ref``.
 
-    ``statics`` = (num_chunks NC, chunk_cap C, chunk_entities Ec); nnz-side
-    arrays reshape to [NC, C], entity-side to [NC, Ec].
+    Inside ``shard_map`` (with vma checking) a scan carry initialized from
+    constants must be explicitly pcast/pvary'd to the mesh axes the body's
+    data is varying over; outside shard_map this is the identity.
+    """
+    try:
+        vma = jax.typeof(ref).vma
+    except (AttributeError, TypeError):
+        return z
+    if not vma:
+        return z
+    if hasattr(lax, "pcast"):
+        return lax.pcast(z, tuple(vma), to="varying")
+    return lax.pvary(z, tuple(vma))
+
+
+def _segment_scan(fixed_factors, per_chunk_gram, solve_rows, arrays, statics,
+                  local_entities):
+    """The chunk scan both segment half-steps share.
+
+    ``arrays`` = (nb, rt, mk, seg, ent, cnt, cin, lseg) flat shard-local
+    device arrays; ``per_chunk_gram(nb, rt, mk, seg) -> (A, b)`` builds one
+    chunk's raw Gram/RHS [Ec+1, k, k]/[Ec+1, k]; ``solve_rows(a, b, cnt) ->
+    x`` solves the chunk's Ec rows.  The scan carries (partial A, partial b)
+    of the entity straddling each chunk boundary — ``cin`` gates adding it
+    to segment 0, ``lseg`` extracts the next carry — plus the output matrix,
+    scattered per chunk (non-finalized rows target the trash slot).
     """
     nc, cap, e_c = statics
-    return (
-        tuple(x.reshape(nc, cap) for x in nnz_arrays),
-        tuple(x.reshape(nc, e_c) for x in entity_arrays),
+    k = fixed_factors.shape[-1]
+    nb, rt, mk, seg, ent, cnt, cin, lseg = arrays
+    chunks = (
+        nb.reshape(nc, cap), rt.reshape(nc, cap), mk.reshape(nc, cap),
+        seg.reshape(nc, cap), ent.reshape(nc, e_c), cnt.reshape(nc, e_c),
+        cin.reshape(nc), lseg.reshape(nc),
     )
 
+    def body(carry, chunk):
+        a0, b0, out = carry
+        nb_c, rt_c, mk_c, seg_c, ent_c, cnt_c, cin_c, lseg_c = chunk
+        a, b = per_chunk_gram(nb_c, rt_c, mk_c, seg_c)
+        a = a.at[0].add(cin_c * a0)
+        b = b.at[0].add(cin_c * b0)
+        x = solve_rows(a[:e_c], b[:e_c], cnt_c)
+        out = out.at[ent_c].set(x)
+        a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
+        b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
+        return (a1, b1, out), None
 
-def _maybe_map(per_chunk, chunks, num_chunks):
-    """lax.map over the chunk axis, skipping the scan for a single chunk."""
-    if num_chunks == 1:
-        return jax.tree.map(lambda x: x[None], per_chunk(
-            jax.tree.map(lambda x: x[0], chunks)
-        ))
-    return lax.map(per_chunk, chunks)
-
-
-def _scatter_chunk_rows(xs, chunk_entity, local_entities):
-    """[NC, Ec, k] chunk solutions → [E_local, k] via the trash-slot scatter.
-
-    Rows never in any chunk (zero-rating global-pad tail) stay exactly 0 —
-    matching the rectangular paths' λ-floored zero solve.
-    """
-    k = xs.shape[-1]
-    out = jnp.zeros((local_entities + 1, k), jnp.float32)
-    out = out.at[chunk_entity.reshape(-1)].set(xs.reshape(-1, k))
+    init = jax.tree.map(
+        lambda z: _match_varying(z, nb),
+        (
+            jnp.zeros((k, k), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+            jnp.zeros((local_entities + 1, k), jnp.float32),
+        ),
+    )
+    (_, _, out), _ = lax.scan(body, init, chunks)
+    # Rows never finalized by any chunk (zero-rating global-pad tail) stay
+    # exactly 0 — matching the rectangular paths' λ-floored zero solve.
     return out[:local_entities]
 
 
@@ -322,36 +388,42 @@ def als_half_step_segment(
     mask: jax.Array,  # [NC·C]
     seg_rel: jax.Array,  # [NC·C] chunk-relative entity rows, sorted per chunk
     chunk_entity: jax.Array,  # [NC·Ec] shard-local entity row (trash = E_local)
-    chunk_count: jax.Array,  # [NC·Ec]
+    chunk_count: jax.Array,  # [NC·Ec] full rating count of finalized rows
+    carry_in: jax.Array,  # [NC] 1.0 = seg 0 continues the previous chunk
+    last_seg: jax.Array,  # [NC] chunk-relative index of the last real segment
     local_entities: int,
     lam: float,
     *,
     statics: tuple[int, int, int],
     solver: str = "cholesky",
+    gram_backend: str | None = None,
 ) -> jax.Array:
     """One explicit ALS-WR half-iteration over the packed segment layout.
 
     Semantics match ``als_half_step`` exactly (same normal equations, same
-    λ·n·I regularization); only the Gram accumulation differs — sorted
-    segment_sum over per-rating outer products, mapped over entity-range
-    chunks so the accumulator stays [Ec, k, k] regardless of E.
+    λ·n·I regularization); only the Gram accumulation differs — a grouped
+    ragged matmul over the flat sorted run, scanned over nnz chunks with the
+    boundary-straddling entity's partial Gram carried across, so device
+    memory is O(chunk) regardless of E or the degree distribution's head.
     """
-    nc, _, e_c = statics
-    (nb, rt, mk, seg), (ent, cnt) = _segment_chunk_views(
-        statics, (neighbor_idx, rating, mask, seg_rel),
-        (chunk_entity, chunk_count),
-    )
+    backend = gram_backend or default_segment_backend()
+    e_c = statics[2]
 
-    def per_chunk(c):
-        nb_c, rt_c, mk_c, seg_c, cnt_c = c
-        a, b = _segment_gram_flat(
+    def chunk_gram(nb_c, rt_c, mk_c, seg_c):
+        return _segment_gram_flat(
             fixed_factors, nb_c, jnp.ones_like(rt_c), rt_c, mk_c,
-            e_c + 1, seg_c,
+            e_c + 1, seg_c, backend,
         )
-        return regularized_solve(a[:e_c], b[:e_c], cnt_c, lam, solver)
 
-    xs = _maybe_map(per_chunk, (nb, rt, mk, seg, cnt), nc)
-    return _scatter_chunk_rows(xs, chunk_entity, local_entities)
+    def solve_rows(a, b, cnt_c):
+        return regularized_solve(a, b, cnt_c, lam, solver)
+
+    return _segment_scan(
+        fixed_factors, chunk_gram, solve_rows,
+        (neighbor_idx, rating, mask, seg_rel, chunk_entity, chunk_count,
+         carry_in, last_seg),
+        statics, local_entities,
+    )
 
 
 def ials_half_step_segment(
@@ -361,6 +433,8 @@ def ials_half_step_segment(
     mask: jax.Array,  # [NC·C]
     seg_rel: jax.Array,  # [NC·C]
     chunk_entity: jax.Array,  # [NC·Ec]
+    carry_in: jax.Array,  # [NC]
+    last_seg: jax.Array,  # [NC]
     local_entities: int,
     lam: float,
     alpha: float,
@@ -368,33 +442,39 @@ def ials_half_step_segment(
     statics: tuple[int, int, int],
     gram: jax.Array | None = None,  # precomputed YᵀY (pass psum'd under SPMD)
     solver: str = "cholesky",
+    gram_backend: str | None = None,
 ) -> jax.Array:
     """Implicit-feedback half-iteration over the packed segment layout.
 
     Per entity A = YᵀY + Σ_obs (c−1)·f fᵀ + λI, b = Σ_obs c·f (Hu et al.
-    2008 with the global-Gram trick).  Zero-interaction rows (chunk padding
-    and rows outside every chunk) end up exactly 0: padding rows solve
-    (YᵀY + λI)x = 0 inside the chunk and scatter to the trash slot anyway.
+    2008 with the global-Gram trick).  The scan carries the raw observed
+    Gram of boundary-straddling entities; YᵀY + λI is added per chunk at
+    solve time only.  Zero-interaction rows (chunk padding and rows outside
+    every chunk) end up exactly 0: padding rows solve (YᵀY + λI)x = 0
+    inside the chunk and scatter to the trash slot anyway.
     """
-    nc, _, e_c = statics
     k = fixed_factors.shape[-1]
     if gram is None:
         gram = global_gram(fixed_factors)
     reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
-    (nb, rt, mk, seg), (ent,) = _segment_chunk_views(
-        statics, (neighbor_idx, rating, mask, seg_rel), (chunk_entity,)
-    )
+    backend = gram_backend or default_segment_backend()
+    e_c = statics[2]
 
-    def per_chunk(c):
-        nb_c, rt_c, mk_c, seg_c = c
-        a_obs, b = _segment_gram_flat(
+    def chunk_gram(nb_c, rt_c, mk_c, seg_c):
+        return _segment_gram_flat(
             fixed_factors, nb_c, alpha * rt_c, (1.0 + alpha * rt_c) * mk_c,
-            mk_c, e_c + 1, seg_c,
+            mk_c, e_c + 1, seg_c, backend,
         )
-        return dispatch_spd_solve(reg[None] + a_obs[:e_c], b[:e_c], solver)
 
-    xs = _maybe_map(per_chunk, (nb, rt, mk, seg), nc)
-    return _scatter_chunk_rows(xs, chunk_entity, local_entities)
+    def solve_rows(a_obs, b, _cnt):
+        return dispatch_spd_solve(reg[None] + a_obs, b, solver)
+
+    return _segment_scan(
+        fixed_factors, chunk_gram, solve_rows,
+        (neighbor_idx, rating, mask, seg_rel, chunk_entity,
+         jnp.zeros(chunk_entity.shape, jnp.int32), carry_in, last_seg),
+        statics, local_entities,
+    )
 
 
 def init_factors(

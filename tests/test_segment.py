@@ -1,11 +1,13 @@
-"""Packed segment-sum InBlock layout: structure, equivalence, SPMD, scale.
+"""Packed flat-segment InBlock layout: structure, equivalence, SPMD, scale.
 
 The segment layout is the third answer to ragged InBlocks (SURVEY.md §5
-long-context analog): flat sorted rating runs packed into entity-range
-chunks; per-entity Gram matrices accumulate by sorted ``segment_sum`` —
-O(nnz) memory for arbitrarily skewed degree distributions, with the
-device-side accumulator bounded per chunk (full-Netflix user side would
-otherwise need a 45 GB accumulator).
+long-context analog): flat sorted rating runs packed into fixed-size nnz
+chunks; per-entity Gram matrices accumulate by grouped ragged matmul
+(``lax.ragged_dot_general``, ``segment_sum`` fallback), with entities
+hotter than a chunk straddling chunks via a carried partial Gram — O(nnz)
+memory for arbitrarily skewed degree distributions, with the device-side
+accumulator bounded per chunk (full-Netflix user side would otherwise need
+an 8 GB accumulator).
 """
 
 import numpy as np
@@ -27,14 +29,11 @@ def reconstruct_triples(blocks):
     out = []
     for s in range(blocks.num_shards):
         for c in range(nc):
-            base = (s * nc + c) * cap
-            ebase = (s * nc + c) * e_c
-            ent = blocks.chunk_entity[ebase : ebase + e_c]
-            real = ent[ent < e_local]
-            first = real[0] if real.size else 0
+            ci = s * nc + c
+            base = ci * cap
             sl = slice(base, base + cap)
             mk = blocks.mask[sl] > 0
-            entity = s * e_local + first + blocks.seg_rel[sl][mk]
+            entity = s * e_local + blocks.chunk_first[ci] + blocks.seg_rel[sl][mk]
             out.append(
                 np.stack(
                     [entity, blocks.neighbor_idx[sl][mk], blocks.rating[sl][mk]],
@@ -73,11 +72,93 @@ def test_segment_structure_roundtrip():
             assert np.all(seg[~mk] == e_c)
             # every chunk's nnz within capacity, entity rows within Ec
             assert mk.sum(axis=1).max() <= cap
-            # each real entity appears in exactly one chunk row
+            # each real entity is finalized by exactly one chunk row
             ent = blocks.chunk_entity.reshape(blocks.num_shards, -1)
             for s in range(shards):
                 real = ent[s][ent[s] < blocks.local_entities]
                 assert real.size == np.unique(real).size
+            # finalized rows cover every rated entity exactly once
+            all_real = blocks.chunk_entity[blocks.chunk_entity < blocks.local_entities]
+            rated = (blocks.count.reshape(shards, -1) > 0).sum()
+            assert all_real.size == rated
+            # carry flags: a chunk with carry_in continues the previous
+            # chunk's last entity (same shard, seg 0 == prev last_seg entity)
+            cin = blocks.carry_in.reshape(shards, nc)
+            first = blocks.chunk_first.reshape(shards, nc)
+            lseg = blocks.last_seg.reshape(shards, nc)
+            assert np.all(cin[:, 0] == 0.0)
+            for s in range(shards):
+                for c in range(1, nc):
+                    if cin[s, c]:
+                        assert first[s, c] == first[s, c - 1] + lseg[s, c - 1]
+
+
+def test_segment_hot_entity_straddles_chunks():
+    """An entity hotter than the chunk capacity spans chunks via the Gram
+    carry instead of inflating every chunk to its degree."""
+    rng = np.random.default_rng(1)
+    hot_users = np.arange(1, 5001)
+    tail_m = rng.integers(2, 200, size=2000)
+    tail_u = rng.integers(1, 5001, size=2000)
+    movie = np.concatenate([np.ones(5000, np.int64), tail_m])
+    user = np.concatenate([hot_users, tail_u]).astype(np.int64)
+    rating = rng.integers(1, 6, size=movie.size).astype(np.float32)
+
+    from cfk_tpu.data.blocks import IdMap
+
+    mmap = IdMap.from_raw(movie)
+    m_dense = mmap.to_dense(movie)
+    u_dense = IdMap.from_raw(user).to_dense(user)
+    blocks = build_segment_blocks(
+        m_dense, u_dense, rating, mmap.num_entities, chunk_nnz=512
+    )
+    # capacity stays at the requested chunk size, not the hot degree
+    assert blocks.chunk_cap == 512
+    assert blocks.carry_in.sum() >= 9  # 5000-degree entity spans ≥ 10 chunks
+    got = reconstruct_triples(blocks)
+    want = np.stack([m_dense, u_dense, rating], axis=1)
+    got = got[np.lexsort(got.T[::-1])]
+    want = want[np.lexsort(want.T[::-1])]
+    np.testing.assert_array_equal(got, want)
+
+    # end-to-end: training through the straddled layout matches padded
+    from cfk_tpu.data.blocks import RatingsCOO
+    from cfk_tpu.models.als import train_als
+
+    coo = RatingsCOO(movie_raw=movie, user_raw=user, rating=rating)
+    config = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0)
+    preds_p = train_als(Dataset.from_coo(coo, layout="padded"), config).predict_dense()
+    preds_s = train_als(
+        Dataset.from_coo(coo, layout="segment", chunk_elems=512), config
+    ).predict_dense()
+    np.testing.assert_allclose(preds_s, preds_p, atol=2e-3, rtol=1e-3)
+
+
+def test_segment_gram_backends_agree(tiny_coo):
+    """The ragged grouped-matmul Gram and the segment_sum fallback compute
+    the same half-step."""
+    import jax.numpy as jnp
+
+    from cfk_tpu.ops.solve import als_half_step_segment
+
+    ds = Dataset.from_coo(tiny_coo, layout="segment", chunk_elems=512)
+    mb = ds.movie_blocks
+    rng = np.random.default_rng(0)
+    fixed = jnp.asarray(
+        rng.standard_normal((ds.user_blocks.padded_entities, 6)).astype(np.float32)
+    )
+    args = (
+        fixed, jnp.asarray(mb.neighbor_idx), jnp.asarray(mb.rating),
+        jnp.asarray(mb.mask), jnp.asarray(mb.seg_rel),
+        jnp.asarray(mb.chunk_entity), jnp.asarray(mb.chunk_count),
+        jnp.asarray(mb.carry_in), jnp.asarray(mb.last_seg),
+        mb.local_entities, 0.05,
+    )
+    x_ragged = als_half_step_segment(*args, statics=mb.statics, gram_backend="ragged")
+    x_segsum = als_half_step_segment(*args, statics=mb.statics, gram_backend="segsum")
+    np.testing.assert_allclose(
+        np.asarray(x_ragged), np.asarray(x_segsum), atol=5e-4, rtol=5e-4
+    )
 
 
 def test_segment_memory_is_nnz_proportional():
