@@ -42,6 +42,11 @@ _OP_DELETE_TOPIC = 6
 _OP_PING = 7
 _OP_LIST_TOPICS = 8
 
+# Keep every request body under the server's 64 MiB frame cap (cfk_broker's
+# kMaxBodyLen) with headroom for the op/name/count framing; the server closes
+# the connection on an oversized frame rather than answering with an error.
+_MAX_BATCH_BYTES = (64 << 20) - 4096
+
 
 class BrokerRequestError(RuntimeError):
     """The broker rejected a request (unknown topic, bad partition, ...)."""
@@ -105,6 +110,9 @@ class TcpBrokerClient:
     @staticmethod
     def _name(topic: str) -> bytes:
         raw = topic.encode()
+        if len(raw) > 249:  # Kafka's own topic-name limit; also keeps the
+            # name framing inside _MAX_BATCH_BYTES's request-frame headroom.
+            raise ValueError(f"topic name too long ({len(raw)} bytes, max 249)")
         return struct.pack(">H", len(raw)) + raw
 
     # -- Transport protocol -------------------------------------------------
@@ -123,7 +131,9 @@ class TcpBrokerClient:
             raise
 
     def delete_topic(self, name: str) -> None:
-        self._pending.pop(name, None)
+        dropped = self._pending.pop(name, [])
+        self._pending_count -= len(dropped)
+        self._pending_bytes -= sum(len(r) for r in dropped)
         self._request(bytes([_OP_DELETE_TOPIC]) + self._name(name))
 
     def produce(
@@ -134,6 +144,13 @@ class TcpBrokerClient:
             # server enforces the same rule.
             raise ValueError(
                 f"negative key {key} requires an explicit partition="
+            )
+        if len(value) > _MAX_BATCH_BYTES:
+            # The server closes the connection on an oversized frame with no
+            # error response — fail loudly here instead.
+            raise ValueError(
+                f"record of {len(value)} bytes exceeds the broker's "
+                f"{_MAX_BATCH_BYTES}-byte frame budget"
             )
         rec = struct.pack(
             ">iiI", -1 if partition is None else partition, key, len(value)
@@ -148,40 +165,53 @@ class TcpBrokerClient:
             self.flush()
 
     def flush(self) -> None:
-        """Ship all buffered records (one PRODUCE_BATCH per topic).
+        """Ship all buffered records (PRODUCE_BATCH requests per topic,
+        split into sub-batches that fit the server's request frame cap).
 
-        On a failed request the unsent topics' records are restored to the
-        buffer.  The failing topic's own batch is restored only for an
-        unknown-topic rejection (KeyError) — the server validates the whole
-        batch before appending anything, so "create the topic, flush again"
-        loses nothing.  Other rejections (bad partition, malformed record)
-        would fail identically on retry, so that batch is dropped with the
+        On a failed request the unsent records are restored to the buffer.
+        The failing sub-batch itself is restored only for an unknown-topic
+        rejection (KeyError) — the server validates the whole batch before
+        appending anything, so "create the topic, flush again" loses
+        nothing.  Other rejections (bad partition, malformed record) would
+        fail identically on retry, so that sub-batch is dropped with the
         raised error as the caller's signal; a transport failure mid-request
-        (ConnectionError) leaves the batch in doubt.
+        (ConnectionError) leaves it in doubt.
         """
         pending, self._pending = self._pending, {}
         self._pending_count = self._pending_bytes = 0
 
-        def restore(topic):
+        def restore(topic, recs):
+            if not recs:
+                return
             restored = self._pending.setdefault(topic, [])
-            restored[:0] = pending[topic]
-            self._pending_count += len(pending[topic])
-            self._pending_bytes += sum(len(r) for r in pending[topic])
+            restored[:0] = recs
+            self._pending_count += len(recs)
+            self._pending_bytes += sum(len(r) for r in recs)
 
         topics = list(pending)
         for i, topic in enumerate(topics):
             recs = pending[topic]
-            try:
-                self._request(
-                    bytes([_OP_PRODUCE_BATCH]) + self._name(topic)
-                    + struct.pack(">I", len(recs)) + b"".join(recs)
-                )
-            except Exception as e:
-                if isinstance(e, KeyError):
-                    restore(topic)
-                for unsent in topics[i + 1:]:
-                    restore(unsent)
-                raise
+            done = 0
+            while done < len(recs):
+                end, size = done, 0
+                while end < len(recs) and (
+                    end == done or size + len(recs[end]) <= _MAX_BATCH_BYTES
+                ):
+                    size += len(recs[end])
+                    end += 1
+                chunk = recs[done:end]
+                try:
+                    self._request(
+                        bytes([_OP_PRODUCE_BATCH]) + self._name(topic)
+                        + struct.pack(">I", len(chunk)) + b"".join(chunk)
+                    )
+                except Exception as e:
+                    tail = done if isinstance(e, KeyError) else end
+                    restore(topic, recs[tail:])
+                    for unsent in topics[i + 1:]:
+                        restore(unsent, pending[unsent])
+                    raise
+                done = end
 
     def consume(
         self, topic: str, partition: int, start_offset: int = 0
@@ -243,30 +273,32 @@ class TcpBrokerClient:
             pos += nlen
         return names
 
-    def close(self) -> None:
+    def close(self, *, flush: bool = True) -> None:
         try:
-            self.flush()
+            if flush:
+                self.flush()
         finally:
             self._sock.close()
 
     def __enter__(self) -> "TcpBrokerClient":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def __exit__(self, exc_type, *exc) -> None:
+        # Don't let a failing exit-time flush replace the body's exception.
+        self.close(flush=exc_type is None)
 
 
 def build_broker(quiet: bool = True) -> bool:
-    """Compile the broker binary with make; returns availability."""
-    if os.path.exists(_BROKER_BIN):
-        return True
+    """Compile the broker binary with make (incremental — make itself skips
+    an up-to-date binary, so source edits always rebuild); returns
+    availability."""
     try:
         subprocess.run(
             ["make", "-C", _NATIVE_DIR, "cfk_broker"],
             check=True, capture_output=quiet,
         )
     except (subprocess.CalledProcessError, FileNotFoundError):
-        return False
+        return os.path.exists(_BROKER_BIN)
     return os.path.exists(_BROKER_BIN)
 
 
@@ -288,15 +320,25 @@ class BrokerProcess:
             )
         argv = [_BROKER_BIN, str(port)] + ([data_dir] if data_dir else [])
         self.proc = subprocess.Popen(
-            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL
         )
-        # select-based wait: readline() alone would block past the timeout
-        # if the server wedges before printing its LISTENING line.
+        # Raw nonblocking reads under a select deadline: buffered readline()
+        # would block past the timeout on a partial line (a wedged server),
+        # and select() cannot see data already inside a stdio buffer.
         import select
 
         deadline = time.monotonic() + timeout
-        line = ""
-        while "LISTENING" not in line:
+        fd = self.proc.stdout.fileno()
+        os.set_blocking(fd, False)
+        buf = b""
+        while True:
+            nl = buf.find(b"\n")
+            if nl >= 0:
+                line, buf = buf[:nl], buf[nl + 1:]
+                if b"LISTENING" in line:
+                    self.port = int(line.strip().rsplit(b" ", 1)[-1])
+                    break
+                continue
             if self.proc.poll() is not None:
                 raise RuntimeError(
                     f"cfk_broker exited with {self.proc.returncode}"
@@ -305,12 +347,18 @@ class BrokerProcess:
             if remaining <= 0:
                 self.terminate()
                 raise TimeoutError("cfk_broker did not start listening in time")
-            ready, _, _ = select.select([self.proc.stdout], [], [], min(remaining, 0.5))
+            ready, _, _ = select.select([fd], [], [], min(remaining, 0.5))
             if ready:
-                line = self.proc.stdout.readline()
-                if not line:  # EOF: process died without the banner
-                    continue
-        self.port = int(line.strip().rsplit(" ", 1)[-1])
+                try:
+                    chunk = os.read(fd, 4096)
+                except BlockingIOError:
+                    chunk = b""
+                if chunk:
+                    buf += chunk
+                else:
+                    # EOF while still alive: don't spin on the always-ready
+                    # fd; the poll() check above reports the exit.
+                    time.sleep(0.05)
 
     def connect(self, **kwargs) -> TcpBrokerClient:
         return TcpBrokerClient("127.0.0.1", self.port, **kwargs)

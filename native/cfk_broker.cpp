@@ -205,34 +205,49 @@ class Broker {
           throw BrokerError("partition out of range");
       }
     }
+    // Snapshot every partition's committed extent so a mid-batch append
+    // failure (disk full) can roll the whole batch back — the client treats
+    // a rejected batch as not-appended and re-buffers it, so a committed
+    // prefix would be served twice after a retry.
+    std::vector<std::pair<size_t, uint64_t>> before(t.num_partitions);
+    for (uint32_t p = 0; p < t.num_partitions; ++p) {
+      PartitionLog& log = t.parts[p];
+      before[p] = {log.positions.size(),
+                   log.file ? log.file_len : log.bytes.size()};
+    }
     uint64_t last_end = 0;
-    for (uint32_t i = 0; i < n; ++i) {
-      int32_t partition = r.i32();
-      int32_t key = r.i32();
-      uint32_t vlen = r.u32();
-      const char* value = reinterpret_cast<const char*>(r.p);
-      r.p += vlen;
-      if (partition < 0) partition = int32_t(uint32_t(key) % t.num_partitions);
-      PartitionLog& log = t.parts[partition];
-      std::string frame;
-      frame.reserve(kFrameHeader + vlen);
-      put_i32(frame, key);
-      put_u32(frame, vlen);
-      frame.append(value, vlen);
-      if (log.file) {
-        // Index the record only after a complete append: a failed/partial
-        // fwrite must stay invisible (it is exactly the torn tail that
-        // restart recovery truncates), not an offset serving garbage.
-        if (std::fwrite(frame.data(), 1, frame.size(), log.file) !=
-            frame.size())
-          throw BrokerError("append failed (disk full?)");
-        log.positions.push_back(log.file_len);
-        log.file_len += frame.size();
-      } else {
-        log.positions.push_back(log.bytes.size());
-        log.bytes.append(frame);
+    try {
+      for (uint32_t i = 0; i < n; ++i) {
+        int32_t partition = r.i32();
+        int32_t key = r.i32();
+        uint32_t vlen = r.u32();
+        const char* value = reinterpret_cast<const char*>(r.p);
+        r.p += vlen;
+        if (partition < 0)
+          partition = int32_t(uint32_t(key) % t.num_partitions);
+        PartitionLog& log = t.parts[partition];
+        std::string frame;
+        frame.reserve(kFrameHeader + vlen);
+        put_i32(frame, key);
+        put_u32(frame, vlen);
+        frame.append(value, vlen);
+        if (log.file) {
+          if (std::fwrite(frame.data(), 1, frame.size(), log.file) !=
+              frame.size())
+            throw BrokerError("append failed (disk full?)");
+          log.positions.push_back(log.file_len);
+          log.file_len += frame.size();
+        } else if (data_dir_.empty()) {
+          log.positions.push_back(log.bytes.size());
+          log.bytes.append(frame);
+        } else {
+          throw BrokerError("partition segment unavailable");
+        }
+        last_end = log.positions.size();
       }
-      last_end = log.positions.size();
+    } catch (...) {
+      rollback(t, name, before);
+      throw;
     }
     // One flush per batch, not per record (the durability contract is the
     // same page-cache one as FileBroker(fsync=False); torn tails recover).
@@ -339,6 +354,30 @@ class Broker {
     PartitionLog& log = t.parts[p];
     log.file = std::fopen(path.c_str(), "ab");
     if (!log.file) throw BrokerError("cannot open segment: " + path);
+  }
+
+  // Restore every partition of `t` to its pre-batch extent after a failed
+  // produce.  Durable partitions close + truncate + reopen the segment so
+  // bytes stranded in the stdio buffer by a short fwrite are discarded with
+  // the torn tail instead of landing after later appends; a partition whose
+  // segment cannot be reopened keeps file == nullptr, which the append path
+  // rejects loudly (never silently falling back to the memory log).
+  void rollback(Topic& t, const std::string& name,
+                const std::vector<std::pair<size_t, uint64_t>>& before) {
+    for (uint32_t p = 0; p < t.num_partitions; ++p) {
+      PartitionLog& log = t.parts[p];
+      log.positions.resize(before[p].first);
+      if (log.file) {
+        std::fclose(log.file);
+        log.file = nullptr;
+        std::string path = log_path(data_dir_ + "/" + name, p);
+        ::truncate(path.c_str(), off_t(before[p].second));
+        log.file_len = before[p].second;
+        log.file = std::fopen(path.c_str(), "ab");
+      } else if (data_dir_.empty()) {
+        log.bytes.resize(before[p].second);
+      }
+    }
   }
 
   // mkdir -p: create every missing component of `path`.

@@ -304,3 +304,61 @@ def test_end_to_end_train_from_tcp_ingest(server):
     model = train_als(ds, ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0))
     preds = model.predict_dense()
     assert np.all(np.isfinite(preds))
+
+
+def test_delete_topic_releases_pending_counters(server):
+    # Dropping a topic's buffered records must also drop their byte/record
+    # counts, or the next produce flushes a near-empty batch immediately.
+    with server.connect(batch_records=50) as c:
+        c.create_topic("counters-a", 2)
+        c.create_topic("counters-b", 2)
+        for i in range(40):
+            c.produce("counters-a", i, b"v")
+        c.delete_topic("counters-a")
+        assert c._pending_count == 0 and c._pending_bytes == 0
+        for i in range(40):  # under batch_records: must stay buffered
+            c.produce("counters-b", i, b"w")
+        assert c._pending_count == 40
+        c.delete_topic("counters-b")
+
+
+def test_oversized_record_rejected_on_client(server):
+    # The server closes the connection on an over-cap frame with no error
+    # response; the client must refuse the record up front instead.
+    from cfk_tpu.transport.tcp import _MAX_BATCH_BYTES
+
+    with server.connect() as c:
+        c.create_topic("oversize", 1)
+        with pytest.raises(ValueError, match="frame budget"):
+            c.produce("oversize", 0, b"x" * (_MAX_BATCH_BYTES + 1))
+        c.delete_topic("oversize")
+
+
+def test_flush_splits_batches_under_frame_cap(server, monkeypatch):
+    # A buffered batch larger than the server's request cap ships as several
+    # PRODUCE_BATCH requests, none over the cap.
+    import cfk_tpu.transport.tcp as tcp_mod
+
+    monkeypatch.setattr(tcp_mod, "_MAX_BATCH_BYTES", 4096)
+    with server.connect(batch_records=10_000, batch_bytes=1 << 30) as c:
+        c.create_topic("split", 2)
+        payload = b"p" * 1500
+        for i in range(20):  # ~30 KiB pending >> patched 4 KiB cap
+            c.produce("split", i, payload)
+        c.flush()
+        got = sum(1 for _ in c.consume("split", 0))
+        got += sum(1 for _ in c.consume("split", 1))
+        assert got == 20
+        c.delete_topic("split")
+
+
+def test_exit_does_not_mask_body_exception(server):
+    # close() on the exception path must not flush (a failing exit-time
+    # request would replace the body's error).
+    with pytest.raises(RuntimeError, match="the real error"):
+        with server.connect() as c:
+            c.create_topic("mask", 1)
+            c.produce("nonexistent-topic", 0, b"v")  # would KeyError on flush
+            raise RuntimeError("the real error")
+    with server.connect() as c:
+        c.delete_topic("mask")
