@@ -27,6 +27,19 @@ MEDIUM = "/root/reference/data/data_sample_medium.txt"
 REF_RMSE_MEDIUM = 0.759
 
 
+def sync(x) -> None:
+    """Force device completion by fetching one scalar to the host.
+
+    Under the axon remote-TPU tunnel ``block_until_ready()`` returns before
+    the device work has drained, so wall-clock timings bracketed with it
+    under-report; a scalar device→host fetch is a true barrier (costs one
+    tunnel round-trip, ~70 ms — noise at multi-second scales).
+    """
+    import numpy as _np
+
+    _np.asarray(x[:1, :1])
+
+
 def main() -> None:
     from cfk_tpu.config import ALSConfig
     from cfk_tpu.data.blocks import Dataset
@@ -43,12 +56,12 @@ def main() -> None:
     # Warmup run: trigger compile (first TPU compile is slow, then cached).
     t0 = time.time()
     model = train_als(ds, config)
-    model.user_factors.block_until_ready()
+    sync(model.user_factors)
     warm = time.time() - t0
 
     t0 = time.time()
     model = train_als(ds, config)
-    model.user_factors.block_until_ready()
+    sync(model.user_factors)
     train_s = time.time() - t0
 
     preds = model.predict_dense()
@@ -97,11 +110,11 @@ def scale_main(args) -> None:
     )
     t0 = time.time()
     model = train_als(ds, config)
-    model.user_factors.block_until_ready()
+    sync(model.user_factors)
     warm = time.time() - t0
     t0 = time.time()
     model = train_als(ds, config)
-    model.user_factors.block_until_ready()
+    sync(model.user_factors)
     train_s = time.time() - t0
 
     s_per_iter = train_s / config.num_iterations

@@ -364,7 +364,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--seed", type=int, default=42)
     t.add_argument("--shards", type=int, default=1)
     t.add_argument("--exchange", choices=["all_gather", "ring"], default="all_gather")
-    t.add_argument("--solver", choices=["cholesky", "pallas"], default="cholesky")
+    t.add_argument(
+        "--solver", choices=["auto", "cholesky", "pallas"], default="auto",
+        help="batched k-by-k solve backend: auto = pallas Gauss-Jordan "
+        "kernel on TPU (rank <= 64), XLA cholesky elsewhere",
+    )
     t.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
     t.add_argument("--solve-chunk", type=int, default=None)
     t.add_argument("--pad-multiple", type=int, default=8)

@@ -50,17 +50,23 @@ def _gauss_kernel(a_ref, b_ref, x_ref, *, k: int):
     """Solve T systems at once: a_ref [k,k,T], b_ref [k,T] → x_ref [k,T]."""
     a = a_ref[:]
     b = b_ref[:]
+    # Row-index planes for the pivot-row selects below (in-kernel iota:
+    # pallas kernels cannot capture array constants, and Mosaic needs
+    # multi-dim iota).
+    rows3 = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0)
+    rows2 = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)
     for j in range(k):  # k is static → fully unrolled
         inv = 1.0 / a[j, j, :]  # [T]
         row = a[j] * inv[None, :]  # [k,T] normalized pivot row
         bj = b[j] * inv  # [T]
         col = a[:, j, :]  # [k,T]
-        # Eliminate column j from every row (row j zeroes itself: col[j]=pivot),
-        # then restore the normalized pivot row.
-        a = a - col[:, None, :] * row[None, :, :]
-        b = b - col * bj[None, :]
-        a = a.at[j].set(row)
-        b = b.at[j].set(bj)
+        # Eliminate column j from every row, keeping the normalized pivot
+        # row via a select (Mosaic has no scatter, so no .at[j].set; the
+        # select is also exact where subtract-then-restore would leave an
+        # epsilon residue on row j).
+        a = jnp.where(rows3 == j, row[None, :, :],
+                      a - col[:, None, :] * row[None, :, :])
+        b = jnp.where(rows2 == j, bj[None, :], b - col * bj[None, :])
     x_ref[:] = b
 
 

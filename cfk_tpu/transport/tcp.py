@@ -152,6 +152,9 @@ class TcpBrokerClient:
                 f"record of {len(value)} bytes exceeds the broker's "
                 f"{_MAX_BATCH_BYTES}-byte frame budget"
             )
+        # Validate the name before buffering: raising at flush time would
+        # surface far from the faulty call and drop the sub-batch.
+        self._name(topic)
         rec = struct.pack(
             ">iiI", -1 if partition is None else partition, key, len(value)
         ) + value

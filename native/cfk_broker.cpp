@@ -251,8 +251,16 @@ class Broker {
     }
     // One flush per batch, not per record (the durability contract is the
     // same page-cache one as FileBroker(fsync=False); torn tails recover).
+    // A failed flush means indexed bytes never reached the file — roll the
+    // batch back and reject it rather than ack records a FETCH or restart
+    // recovery would not see.
+    bool flush_ok = true;
     for (auto& part : t.parts)
-      if (part.file) std::fflush(part.file);
+      if (part.file && std::fflush(part.file) != 0) flush_ok = false;
+    if (!flush_ok) {
+      rollback(t, name, before);
+      throw BrokerError("flush failed (disk full?)");
+    }
     return last_end;
   }
 
@@ -366,6 +374,12 @@ class Broker {
                 const std::vector<std::pair<size_t, uint64_t>>& before) {
     for (uint32_t p = 0; p < t.num_partitions; ++p) {
       PartitionLog& log = t.parts[p];
+      // Leave partitions the batch never touched alone — no reason to risk
+      // a close/reopen on a healthy segment.
+      uint64_t extent = log.file ? log.file_len : log.bytes.size();
+      if (log.positions.size() == before[p].first &&
+          extent == before[p].second)
+        continue;
       log.positions.resize(before[p].first);
       if (log.file) {
         std::fclose(log.file);
