@@ -92,7 +92,15 @@ def scale_main(args) -> None:
     from cfk_tpu.data.synthetic import synthetic_netflix_coo
     from cfk_tpu.models.als import train_als
 
-    if args.full:
+    if args.ials:
+        # MovieLens-25M shape (BASELINE.md implicit-feedback target);
+        # ratings act as interaction strengths.
+        from cfk_tpu.models.ials import IALSConfig, train_ials
+
+        users, movies, nnz = 162_541, 59_047, 25_000_095
+        if args.rank == 64:  # the target config is rank 128
+            args.rank = 128
+    elif args.full:
         users, movies, nnz = 480_189, 17_770, 100_480_507
     else:
         users, movies, nnz = args.users, args.movies, args.nnz
@@ -104,16 +112,25 @@ def scale_main(args) -> None:
     ds = Dataset.from_coo(coo, layout=args.layout, chunk_elems=args.chunk_elems)
     build_s = time.time() - t0
 
-    config = ALSConfig(
-        rank=args.rank, lam=0.05, num_iterations=args.iterations,
-        seed=0, layout=args.layout, dtype=args.dtype,
-    )
+    if args.ials:
+        config = IALSConfig(
+            rank=args.rank, lam=0.1, alpha=40.0,
+            num_iterations=args.iterations, seed=0, layout=args.layout,
+            dtype=args.dtype,
+        )
+        trainer = train_ials
+    else:
+        config = ALSConfig(
+            rank=args.rank, lam=0.05, num_iterations=args.iterations,
+            seed=0, layout=args.layout, dtype=args.dtype,
+        )
+        trainer = train_als
     t0 = time.time()
-    model = train_als(ds, config)
+    model = trainer(ds, config)
     sync(model.user_factors)
     warm = time.time() - t0
     t0 = time.time()
-    model = train_als(ds, config)
+    model = trainer(ds, config)
     sync(model.user_factors)
     train_s = time.time() - t0
 
@@ -121,7 +138,10 @@ def scale_main(args) -> None:
     print(
         json.dumps(
             {
-                "metric": "synthetic_netflix_scale_s_per_iteration",
+                "metric": (
+                    "synthetic_ml25m_ials_s_per_iteration" if args.ials
+                    else "synthetic_netflix_scale_s_per_iteration"
+                ),
                 "value": round(s_per_iter, 4),
                 "unit": "s/iteration",
                 # BASELINE.json bar: < 60 s/iteration at full Netflix scale.
@@ -152,6 +172,9 @@ if __name__ == "__main__":
                         help="synthetic Netflix-Prize-shaped throughput bench")
     parser.add_argument("--full", action="store_true",
                         help="real Netflix Prize dimensions (480k x 17.7k x 100M)")
+    parser.add_argument("--ials", action="store_true",
+                        help="implicit-feedback iALS at MovieLens-25M "
+                        "dimensions (162k x 59k x 25M, rank 128)")
     parser.add_argument("--users", type=int, default=48_000)
     parser.add_argument("--movies", type=int, default=1_777)
     parser.add_argument("--nnz", type=int, default=10_000_000)
@@ -164,7 +187,7 @@ if __name__ == "__main__":
                         default="float32")
     parser.add_argument("--chunk-elems", type=int, default=1 << 20)
     cli_args = parser.parse_args()
-    if cli_args.scale or cli_args.full:
+    if cli_args.scale or cli_args.full or cli_args.ials:
         scale_main(cli_args)
     else:
         main()

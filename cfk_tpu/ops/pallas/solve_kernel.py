@@ -42,7 +42,12 @@ except ImportError:  # pragma: no cover
 
 _LANES = 128
 # VMEM budget cap: the kernel keeps [k, k, _LANES] float32 blocks live
-# through an unrolled k-step elimination; k=64 → 2 MiB per buffer.
+# through an unrolled k-step elimination; k=64 → 2 MiB per buffer. k=128
+# was measured (raising Mosaic's scoped-VMEM allowance to fit the 8 MiB
+# A-block): it compiles but runs ~10× SLOWER than XLA's cholesky there —
+# the fully-unrolled elimination is VPU-bound at O(k³) while cholesky's
+# custom-call overhead amortizes at larger k. The crossover favors this
+# kernel only up to k = 64, so the cap stays.
 PALLAS_MAX_RANK = 64
 
 
