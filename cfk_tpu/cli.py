@@ -33,8 +33,12 @@ def _eprint(*args) -> None:
     print(*args, file=sys.stderr)
 
 
-def _parse_tcp_url(url: str) -> tuple[str, int, str]:
-    """``tcp://HOST:PORT[/TOPIC]`` → (host, port, topic)."""
+def _parse_tcp_url(url: str, topic_optional: bool = False) -> tuple[str, int, str]:
+    """``tcp://HOST:PORT[/TOPIC]`` → (host, port, topic).
+
+    Without a /TOPIC segment the default ratings topic is returned, or None
+    when ``topic_optional`` (admin commands that act on the whole broker).
+    """
     from cfk_tpu.transport.ingest import RATINGS_TOPIC
 
     if not url.startswith("tcp://"):
@@ -46,7 +50,7 @@ def _parse_tcp_url(url: str) -> tuple[str, int, str]:
     host, _, port_s = addr.rpartition(":")
     if not host or not port_s.isdigit():
         raise ValueError(f"bad broker url {url!r}; expected tcp://HOST:PORT[/TOPIC]")
-    return host, int(port_s), topic or RATINGS_TOPIC
+    return host, int(port_s), topic or (None if topic_optional else RATINGS_TOPIC)
 
 
 def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded",
@@ -297,6 +301,37 @@ def _broker(args) -> int:
         return 0
 
 
+def _topics(args) -> int:
+    """Topic administration against a running broker — the role of the
+    reference's ``setup.sh`` (delete + recreate topics out-of-band,
+    ``setup.sh:14-24``), without a second copy of the partition count."""
+    from cfk_tpu.transport.tcp import TcpBrokerClient
+
+    host, port, topic = _parse_tcp_url(args.broker, topic_optional=True)
+    with TcpBrokerClient(host, port) as client:
+        if args.action == "list":
+            for name in client.topics():
+                print(
+                    f"{name}\tpartitions={client.num_partitions(name)}\t"
+                    + "\t".join(
+                        f"p{p}={client.end_offset(name, p)}"
+                        for p in range(client.num_partitions(name))
+                    )
+                )
+            return 0
+        if topic is None:
+            _eprint(f"error: {args.action} needs tcp://HOST:PORT/TOPIC")
+            return 1
+        if args.action == "create":
+            client.create_topic(topic, args.partitions)
+        elif args.action == "delete":
+            client.delete_topic(topic)
+        elif args.action == "recreate":
+            client.delete_topic(topic)
+            client.create_topic(topic, args.partitions)
+    return 0
+
+
 def _produce(args) -> int:
     """Stream a Netflix-format ratings file into a broker topic.
 
@@ -425,6 +460,15 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--bind", default="127.0.0.1",
                    help="listen address; 0.0.0.0 accepts cross-host clients")
     b.set_defaults(fn=_broker)
+
+    tp = sub.add_parser(
+        "topics", help="broker topic admin (the reference's setup.sh role)"
+    )
+    tp.add_argument("action", choices=["list", "create", "delete", "recreate"])
+    tp.add_argument("--broker", required=True,
+                    help="tcp://HOST:PORT (list) or tcp://HOST:PORT/TOPIC")
+    tp.add_argument("--partitions", type=int, default=4)
+    tp.set_defaults(fn=_topics)
 
     pr = sub.add_parser(
         "produce", help="stream a Netflix-format ratings file into a broker"

@@ -362,3 +362,24 @@ def test_exit_does_not_mask_body_exception(server):
             raise RuntimeError("the real error")
     with server.connect() as c:
         c.delete_topic("mask")
+
+
+def test_cli_topics_admin(server, capsys):
+    # The reference's setup.sh role (delete + recreate topics) as a CLI.
+    from cfk_tpu.cli import main
+
+    base = f"tcp://127.0.0.1:{server.port}"
+    assert main(["topics", "create", "--broker", f"{base}/adm",
+                 "--partitions", "3"]) == 0
+    assert main(["topics", "list", "--broker", base]) == 0
+    out = capsys.readouterr().out
+    assert "adm\tpartitions=3" in out
+    assert main(["topics", "recreate", "--broker", f"{base}/adm",
+                 "--partitions", "5"]) == 0
+    assert main(["topics", "list", "--broker", base]) == 0
+    assert "adm\tpartitions=5" in capsys.readouterr().out
+    assert main(["topics", "delete", "--broker", f"{base}/adm"]) == 0
+    assert main(["topics", "list", "--broker", base]) == 0
+    assert "adm" not in capsys.readouterr().out
+    # create without a topic segment is a clean one-line error
+    assert main(["topics", "create", "--broker", base]) == 1
