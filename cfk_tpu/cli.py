@@ -311,11 +311,12 @@ def _topics(args) -> int:
     with TcpBrokerClient(host, port) as client:
         if args.action == "list":
             for name in client.topics():
+                nparts = client.num_partitions(name)
                 print(
-                    f"{name}\tpartitions={client.num_partitions(name)}\t"
+                    f"{name}\tpartitions={nparts}\t"
                     + "\t".join(
                         f"p{p}={client.end_offset(name, p)}"
-                        for p in range(client.num_partitions(name))
+                        for p in range(nparts)
                     )
                 )
             return 0
@@ -494,9 +495,11 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    from cfk_tpu.transport.tcp import BrokerRequestError
+
     try:
         return args.fn(args)
-    except (ValueError, OSError, KeyError) as e:
+    except (ValueError, OSError, KeyError, BrokerRequestError) as e:
         # User-input errors get one clean line; CFK_TPU_TRACEBACK=1 re-raises
         # for debugging.
         import os

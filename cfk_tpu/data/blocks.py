@@ -419,6 +419,7 @@ class SegmentBlocks:
     seg_rel: np.ndarray  # int32 [S·NC·C] chunk-relative entity row, sorted per chunk
     chunk_entity: np.ndarray  # int32 [S·NC·Ec] shard-local entity row (e_local = trash)
     chunk_count: np.ndarray  # int32 [S·NC·Ec] full rating count of finalized rows (0 else)
+    group_sizes: np.ndarray  # int32 [S·NC·(Ec+1)] physical entries per segment (trash last)
     carry_in: np.ndarray  # float32 [S·NC] 1.0 = chunk's seg 0 continues the previous chunk
     last_seg: np.ndarray  # int32 [S·NC] chunk-relative index of the last real segment
     chunk_first: np.ndarray  # int32 [S·NC] shard-local entity id of each chunk's seg 0
@@ -533,6 +534,9 @@ def build_segment_blocks(
     seg = np.full(num_shards * num_chunks * cap, e_c, dtype=np.int32)  # trash
     chunk_entity = np.full(num_shards * num_chunks * e_c, e_local, dtype=np.int32)
     chunk_count = np.zeros(num_shards * num_chunks * e_c, dtype=np.int32)
+    group_sizes = np.zeros(num_shards * num_chunks * (e_c + 1), dtype=np.int32)
+    # All-padding chunks are one full trash segment.
+    group_sizes.reshape(-1, e_c + 1)[:, e_c] = cap
     carry_in = np.zeros(num_shards * num_chunks, dtype=np.float32)
     last_seg = np.zeros(num_shards * num_chunks, dtype=np.int32)
     chunk_first = np.zeros(num_shards * num_chunks, dtype=np.int32)
@@ -549,7 +553,11 @@ def build_segment_blocks(
             neighbor[dst : dst + n] = f_sorted[p0:p1]
             rmat[dst : dst + n] = r_sorted[p0:p1]
             mask[dst : dst + n] = 1.0
-            seg[dst : dst + n] = local_sorted[p0:p1] - first
+            seg_chunk = (local_sorted[p0:p1] - first).astype(np.int64)
+            seg[dst : dst + n] = seg_chunk
+            sizes = np.bincount(seg_chunk, minlength=e_c + 1).astype(np.int32)
+            sizes[e_c] = cap - n  # tail padding sits in the trash segment
+            group_sizes[ci * (e_c + 1) : (ci + 1) * (e_c + 1)] = sizes
             carry_in[ci] = float(p0 > lo and int(local_sorted[p0 - 1]) == first)
             last_seg[ci] = last - first
             chunk_first[ci] = first
@@ -577,6 +585,7 @@ def build_segment_blocks(
         seg_rel=seg,
         chunk_entity=chunk_entity,
         chunk_count=chunk_count,
+        group_sizes=group_sizes,
         carry_in=carry_in,
         last_seg=last_seg,
         chunk_first=chunk_first,

@@ -80,6 +80,7 @@ def _segment_to_device(blocks: SegmentBlocks) -> dict[str, jax.Array]:
         "seg_rel": jnp.asarray(blocks.seg_rel),
         "chunk_entity": jnp.asarray(blocks.chunk_entity),
         "chunk_count": jnp.asarray(blocks.chunk_count),
+        "group_sizes": jnp.asarray(blocks.group_sizes),
         "carry_in": jnp.asarray(blocks.carry_in),
         "last_seg": jnp.asarray(blocks.last_seg),
     }
@@ -150,6 +151,7 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None):
             blk["seg_rel"],
             blk["chunk_entity"],
             blk["chunk_count"],
+            blk["group_sizes"],
             blk["carry_in"],
             blk["last_seg"],
             entities,

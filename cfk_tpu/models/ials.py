@@ -69,8 +69,8 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     if "seg_rel" in blk:
         return ials_half_step_segment(
             fixed, blk["neighbor_idx"], blk["rating"], blk["mask"],
-            blk["seg_rel"], blk["chunk_entity"], blk["carry_in"],
-            blk["last_seg"], entities, lam, alpha,
+            blk["seg_rel"], blk["chunk_entity"], blk["group_sizes"],
+            blk["carry_in"], blk["last_seg"], entities, lam, alpha,
             gram=gram, statics=chunks, solver=solver,
         )
     return ials_half_step(
@@ -184,8 +184,8 @@ def make_ials_training_step(
             def solve(fixed_full, blk, gram):
                 return ials_half_step_segment(
                     fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
-                    blk["seg"], blk["entity"], blk["cin"], blk["lseg"],
-                    local, config.lam, config.alpha,
+                    blk["seg"], blk["entity"], blk["gsizes"], blk["cin"],
+                    blk["lseg"], local, config.lam, config.alpha,
                     gram=gram, statics=statics, solver=config.solver,
                 )
 

@@ -284,7 +284,7 @@ def default_segment_backend() -> str:
 
 def _segment_gram_flat(
     fixed_factors, neighbor_idx, weight, rating, mask, num_segments,
-    segment_ids, backend,
+    segment_ids, group_sizes, backend,
 ):
     """Gram/RHS contributions of a flat sorted run of ratings.
 
@@ -293,28 +293,29 @@ def _segment_gram_flat(
     ``rating`` is r for explicit, c·preference = c for iALS).  Padding
     entries are masked to zero so their (trash) segment contributes nothing.
 
-    ``backend="ragged"`` computes A as one grouped matmul on the MXU
-    (``lax.ragged_dot_general``) — peak memory is the [C, k] gather;
-    ``"segsum"`` materializes the [C, k, k] per-entry outer products.
+    ``backend="ragged"`` computes A and b together as ONE grouped matmul on
+    the MXU (``lax.ragged_dot_general`` with the rating appended as lhs
+    column k — out[:, :k, :] is A, out[:, k, :] is b), using the
+    host-precomputed per-segment entry counts (``group_sizes``); no scatter
+    ops anywhere, peak memory is the [C, k] gather.  ``"segsum"``
+    materializes the [C, k, k] per-entry outer products and segment-sums
+    them by ``segment_ids``.
     """
     f = fixed_factors[neighbor_idx].astype(jnp.float32) * mask[:, None]
     fw = f * weight[:, None]
     if backend == "ragged":
-        sizes = jax.ops.segment_sum(
-            jnp.ones(segment_ids.shape, jnp.int32), segment_ids,
-            num_segments=num_segments, indices_are_sorted=True,
-        )
-        a = lax.ragged_dot_general(
-            fw, f, sizes, _ragged_gram_ddn(),
+        lhs = jnp.concatenate([fw, rating[:, None]], axis=1)  # [C, k+1]
+        out = lax.ragged_dot_general(
+            lhs, f, group_sizes, _ragged_gram_ddn(),
             precision=lax.Precision.HIGHEST, preferred_element_type=jnp.float32,
-        )
-    elif backend == "segsum":
-        a = jax.ops.segment_sum(
-            fw[:, :, None] * f[:, None, :], segment_ids,
-            num_segments=num_segments, indices_are_sorted=True,
-        )
-    else:
+        )  # [G, k+1, k]
+        return out[:, :-1, :], out[:, -1, :]
+    if backend != "segsum":
         raise ValueError(f"unknown segment gram backend {backend!r}")
+    a = jax.ops.segment_sum(
+        fw[:, :, None] * f[:, None, :], segment_ids,
+        num_segments=num_segments, indices_are_sorted=True,
+    )
     b = jax.ops.segment_sum(
         rating[:, None] * f, segment_ids,
         num_segments=num_segments, indices_are_sorted=True,
@@ -344,27 +345,29 @@ def _segment_scan(fixed_factors, per_chunk_gram, solve_rows, arrays, statics,
                   local_entities):
     """The chunk scan both segment half-steps share.
 
-    ``arrays`` = (nb, rt, mk, seg, ent, cnt, cin, lseg) flat shard-local
-    device arrays; ``per_chunk_gram(nb, rt, mk, seg) -> (A, b)`` builds one
-    chunk's raw Gram/RHS [Ec+1, k, k]/[Ec+1, k]; ``solve_rows(a, b, cnt) ->
-    x`` solves the chunk's Ec rows.  The scan carries (partial A, partial b)
-    of the entity straddling each chunk boundary — ``cin`` gates adding it
-    to segment 0, ``lseg`` extracts the next carry — plus the output matrix,
-    scattered per chunk (non-finalized rows target the trash slot).
+    ``arrays`` = (nb, rt, mk, seg, sizes, ent, cnt, cin, lseg) flat
+    shard-local device arrays; ``per_chunk_gram(nb, rt, mk, seg, sizes) ->
+    (A, b)`` builds one chunk's raw Gram/RHS [Ec+1, k, k]/[Ec+1, k];
+    ``solve_rows(a, b, cnt) -> x`` solves the chunk's Ec rows.  The scan
+    carries (partial A, partial b) of the entity straddling each chunk
+    boundary — ``cin`` gates adding it to segment 0, ``lseg`` extracts the
+    next carry — plus the output matrix, scattered per chunk (non-finalized
+    rows target the trash slot).
     """
     nc, cap, e_c = statics
     k = fixed_factors.shape[-1]
-    nb, rt, mk, seg, ent, cnt, cin, lseg = arrays
+    nb, rt, mk, seg, sizes, ent, cnt, cin, lseg = arrays
     chunks = (
         nb.reshape(nc, cap), rt.reshape(nc, cap), mk.reshape(nc, cap),
-        seg.reshape(nc, cap), ent.reshape(nc, e_c), cnt.reshape(nc, e_c),
+        seg.reshape(nc, cap), sizes.reshape(nc, e_c + 1),
+        ent.reshape(nc, e_c), cnt.reshape(nc, e_c),
         cin.reshape(nc), lseg.reshape(nc),
     )
 
     def body(carry, chunk):
         a0, b0, out = carry
-        nb_c, rt_c, mk_c, seg_c, ent_c, cnt_c, cin_c, lseg_c = chunk
-        a, b = per_chunk_gram(nb_c, rt_c, mk_c, seg_c)
+        nb_c, rt_c, mk_c, seg_c, sz_c, ent_c, cnt_c, cin_c, lseg_c = chunk
+        a, b = per_chunk_gram(nb_c, rt_c, mk_c, seg_c, sz_c)
         a = a.at[0].add(cin_c * a0)
         b = b.at[0].add(cin_c * b0)
         x = solve_rows(a[:e_c], b[:e_c], cnt_c)
@@ -395,6 +398,7 @@ def als_half_step_segment(
     seg_rel: jax.Array,  # [NC·C] chunk-relative entity rows, sorted per chunk
     chunk_entity: jax.Array,  # [NC·Ec] shard-local entity row (trash = E_local)
     chunk_count: jax.Array,  # [NC·Ec] full rating count of finalized rows
+    group_sizes: jax.Array,  # [NC·(Ec+1)] physical entries per segment
     carry_in: jax.Array,  # [NC] 1.0 = seg 0 continues the previous chunk
     last_seg: jax.Array,  # [NC] chunk-relative index of the last real segment
     local_entities: int,
@@ -415,10 +419,10 @@ def als_half_step_segment(
     backend = gram_backend or default_segment_backend()
     e_c = statics[2]
 
-    def chunk_gram(nb_c, rt_c, mk_c, seg_c):
+    def chunk_gram(nb_c, rt_c, mk_c, seg_c, sz_c):
         return _segment_gram_flat(
             fixed_factors, nb_c, jnp.ones_like(rt_c), rt_c, mk_c,
-            e_c + 1, seg_c, backend,
+            e_c + 1, seg_c, sz_c, backend,
         )
 
     def solve_rows(a, b, cnt_c):
@@ -426,8 +430,8 @@ def als_half_step_segment(
 
     return _segment_scan(
         fixed_factors, chunk_gram, solve_rows,
-        (neighbor_idx, rating, mask, seg_rel, chunk_entity, chunk_count,
-         carry_in, last_seg),
+        (neighbor_idx, rating, mask, seg_rel, group_sizes, chunk_entity,
+         chunk_count, carry_in, last_seg),
         statics, local_entities,
     )
 
@@ -439,6 +443,7 @@ def ials_half_step_segment(
     mask: jax.Array,  # [NC·C]
     seg_rel: jax.Array,  # [NC·C]
     chunk_entity: jax.Array,  # [NC·Ec]
+    group_sizes: jax.Array,  # [NC·(Ec+1)]
     carry_in: jax.Array,  # [NC]
     last_seg: jax.Array,  # [NC]
     local_entities: int,
@@ -466,10 +471,10 @@ def ials_half_step_segment(
     backend = gram_backend or default_segment_backend()
     e_c = statics[2]
 
-    def chunk_gram(nb_c, rt_c, mk_c, seg_c):
+    def chunk_gram(nb_c, rt_c, mk_c, seg_c, sz_c):
         return _segment_gram_flat(
             fixed_factors, nb_c, alpha * rt_c, (1.0 + alpha * rt_c) * mk_c,
-            mk_c, e_c + 1, seg_c, backend,
+            mk_c, e_c + 1, seg_c, sz_c, backend,
         )
 
     def solve_rows(a_obs, b, _cnt):
@@ -477,7 +482,7 @@ def ials_half_step_segment(
 
     return _segment_scan(
         fixed_factors, chunk_gram, solve_rows,
-        (neighbor_idx, rating, mask, seg_rel, chunk_entity,
+        (neighbor_idx, rating, mask, seg_rel, group_sizes, chunk_entity,
          jnp.zeros(chunk_entity.shape, jnp.int32), carry_in, last_seg),
         statics, local_entities,
     )

@@ -149,6 +149,7 @@ def _segment_to_tree(blocks: SegmentBlocks) -> dict[str, np.ndarray]:
         "seg": blocks.seg_rel,
         "entity": blocks.chunk_entity,
         "ecount": blocks.chunk_count,
+        "gsizes": blocks.group_sizes,
         "cin": blocks.carry_in,
         "lseg": blocks.last_seg,
     }
@@ -307,8 +308,8 @@ def make_training_step(
             def solve(fixed_full, blk, _gram):
                 return als_half_step_segment(
                     fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
-                    blk["seg"], blk["entity"], blk["ecount"], blk["cin"],
-                    blk["lseg"], local,
+                    blk["seg"], blk["entity"], blk["ecount"], blk["gsizes"],
+                    blk["cin"], blk["lseg"], local,
                     config.lam, statics=statics, solver=config.solver,
                 )
 

@@ -81,6 +81,15 @@ def test_segment_structure_roundtrip():
             all_real = blocks.chunk_entity[blocks.chunk_entity < blocks.local_entities]
             rated = (blocks.count.reshape(shards, -1) > 0).sum()
             assert all_real.size == rated
+            # group_sizes: every chunk's sizes sum to the chunk capacity and
+            # agree with the seg_rel histogram
+            gs = blocks.group_sizes.reshape(-1, e_c + 1)
+            assert np.all(gs.sum(axis=1) == cap)
+            for ci in range(gs.shape[0]):
+                hist = np.bincount(
+                    blocks.seg_rel[ci * cap : (ci + 1) * cap], minlength=e_c + 1
+                )
+                np.testing.assert_array_equal(gs[ci], hist)
             # carry flags: a chunk with carry_in continues the previous
             # chunk's last entity (same shard, seg 0 == prev last_seg entity)
             cin = blocks.carry_in.reshape(shards, nc)
@@ -151,6 +160,7 @@ def test_segment_gram_backends_agree(tiny_coo):
         fixed, jnp.asarray(mb.neighbor_idx), jnp.asarray(mb.rating),
         jnp.asarray(mb.mask), jnp.asarray(mb.seg_rel),
         jnp.asarray(mb.chunk_entity), jnp.asarray(mb.chunk_count),
+        jnp.asarray(mb.group_sizes),
         jnp.asarray(mb.carry_in), jnp.asarray(mb.last_seg),
         mb.local_entities, 0.05,
     )
