@@ -54,11 +54,20 @@ def _parse_tcp_url(url: str, topic_optional: bool = False) -> tuple[str, int, st
 
 
 def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded",
-                  chunk_elems=1 << 20):
+                  chunk_elems=1 << 20, cache_dir=None):
+    import os
+
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.movielens import parse_movielens_csv
     from cfk_tpu.data.netflix import parse_netflix
 
+    if cache_dir and os.path.exists(os.path.join(cache_dir, "meta.json")):
+        # Built blocks are deterministic for a (data, layout, shards,
+        # chunking) tuple; the cache skips minutes of host build at scale.
+        # The cache does not fingerprint its inputs — delete it when the
+        # data or layout flags change.
+        ds = Dataset.load(cache_dir)
+        return ds.coo_dense, ds
     if path.startswith("tcp://"):
         from cfk_tpu.transport.ingest import collect_ratings
         from cfk_tpu.transport.tcp import TcpBrokerClient
@@ -77,10 +86,13 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
         coo = parse_netflix(path)
     else:
         coo = parse_movielens_csv(path, min_rating=min_rating)
-    return coo, Dataset.from_coo(
+    ds = Dataset.from_coo(
         coo, num_shards=num_shards, pad_multiple=pad_multiple, layout=layout,
         chunk_elems=chunk_elems,
     )
+    if cache_dir:
+        ds.save(cache_dir)
+    return coo, ds
 
 
 def _train(args) -> int:
@@ -97,6 +109,7 @@ def _train(args) -> int:
         coo, ds = _load_dataset(
             args.data, args.format, args.min_rating, args.shards,
             args.pad_multiple, args.layout, args.chunk_elems,
+            cache_dir=args.dataset_cache,
         )
     common = dict(
         layout=args.layout,
@@ -422,6 +435,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)
+    t.add_argument(
+        "--dataset-cache", default=None,
+        help="directory for the built-blocks cache: loaded if present, "
+        "written after a fresh build (not input-fingerprinted — delete it "
+        "when data or layout flags change)",
+    )
     t.add_argument("--profile-dir", default=None, help="write a jax.profiler trace")
     t.add_argument(
         "--output", default="auto",

@@ -723,6 +723,19 @@ class Dataset:
     user_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks"  # solve users, neighbors are movies
     coo_dense: RatingsCOO  # dense-index COO (movie_raw/user_raw hold dense idx)
 
+    def save(self, path: str) -> None:
+        """Cache the built dataset on disk; see ``cfk_tpu.data.cache``."""
+        from cfk_tpu.data.cache import save_dataset
+
+        save_dataset(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Dataset":
+        """Load a dataset cached with ``save``."""
+        from cfk_tpu.data.cache import load_dataset
+
+        return load_dataset(path)
+
     @classmethod
     def from_coo(
         cls,

@@ -1,0 +1,88 @@
+"""Dataset on-disk cache: lossless round-trip for every block layout."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import Dataset
+from tests.test_bucketed import powerlaw_coo
+
+
+def assert_trees_equal(a, b, path="ds"):
+    assert type(a) is type(b), f"{path}: {type(a)} != {type(b)}"
+    if isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype, f"{path}: dtype {a.dtype} != {b.dtype}"
+        np.testing.assert_array_equal(a, b, err_msg=path)
+    elif dataclasses.is_dataclass(a):
+        for f in dataclasses.fields(a):
+            assert_trees_equal(
+                getattr(a, f.name), getattr(b, f.name), f"{path}.{f.name}"
+            )
+    elif isinstance(a, tuple):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert_trees_equal(x, y, f"{path}[{i}]")
+    else:
+        assert a == b, f"{path}: {a!r} != {b!r}"
+
+
+@pytest.mark.parametrize("layout", ["padded", "bucketed", "segment"])
+@pytest.mark.parametrize("shards", [1, 4])
+def test_roundtrip_all_layouts(tmp_path, layout, shards):
+    coo = powerlaw_coo(n_movies=60, n_users=90, nnz=1500)
+    ds = Dataset.from_coo(coo, layout=layout, num_shards=shards, chunk_elems=256)
+    ds.save(str(tmp_path / "cache"))
+    loaded = Dataset.load(str(tmp_path / "cache"))
+    assert_trees_equal(ds, loaded)
+
+
+def test_loaded_dataset_trains_identically(tmp_path, tiny_coo):
+    from cfk_tpu.models.als import train_als
+
+    ds = Dataset.from_coo(tiny_coo, layout="segment")
+    ds.save(str(tmp_path / "c"))
+    loaded = Dataset.load(str(tmp_path / "c"))
+    config = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=0, layout="segment")
+    np.testing.assert_array_equal(
+        np.asarray(train_als(ds, config).user_factors),
+        np.asarray(train_als(loaded, config).user_factors),
+    )
+
+
+def test_version_mismatch_rejected(tmp_path):
+    import json
+
+    coo = powerlaw_coo(n_movies=20, n_users=30, nnz=200)
+    ds = Dataset.from_coo(coo)
+    ds.save(str(tmp_path / "c"))
+    meta_path = tmp_path / "c" / "meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["format_version"] = 999
+    meta_path.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="format_version"):
+        Dataset.load(str(tmp_path / "c"))
+
+
+def test_cli_train_uses_cache(tmp_path, capsys):
+    from cfk_tpu.cli import main
+
+    cache = str(tmp_path / "dscache")
+    out = str(tmp_path / "pred.csv")
+    argv = [
+        "train", "--data", "/root/reference/data/data_sample_tiny.txt",
+        "--rank", "3", "--iterations", "1", "--seed", "0",
+        "--layout", "segment", "--dataset-cache", cache,
+        "--output", out, "--metrics", "json",
+    ]
+    assert main(argv) == 0
+    assert (tmp_path / "dscache" / "meta.json").exists()
+    first = capsys.readouterr()
+    # second run loads the cache (same results, no rebuild)
+    assert main(argv) == 0
+    second = capsys.readouterr()
+    import re
+
+    rmse = lambda s: re.search(r'"rmse": ([0-9.]+)', s.out).group(1)
+    assert rmse(first) == rmse(second)
