@@ -79,11 +79,53 @@ def main() -> None:
                 "s_per_iteration": round(s_per_iter, 4),
                 "ratings_per_sec": int(coo.num_ratings * config.num_iterations * 2 / train_s),
                 "train_wall_s": round(train_s, 3),
-                "compile_wall_s": round(warm - train_s, 3),
+                "first_run_wall_s": round(warm, 3),
+                "compile_wall_s": round(max(warm - train_s, 0.0), 3),
                 "ratings": coo.num_ratings,
             }
         )
     )
+
+
+def _upload_probe_seconds(ds) -> float:
+    """Wall seconds to push the dataset's block arrays host→device.
+
+    Every trainer call re-uploads the blocks; at full-Netflix scale the flat
+    segment arrays are ~GBs, and under the axon tunnel that transfer — not
+    the iteration math — dominates a short timed run.  Measuring one upload
+    pass lets the bench report steady-state s/iteration (a real training run
+    uploads once and iterates many times).
+    """
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    host = []
+
+    def collect(v):
+        if isinstance(v, np.ndarray):
+            host.append(v)
+        elif isinstance(v, dict):
+            for x in v.values():
+                collect(x)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                collect(x)
+        elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+            for f in dataclasses.fields(v):
+                collect(getattr(v, f.name))
+
+    collect(ds.movie_blocks)
+    collect(ds.user_blocks)
+    import jax
+
+    # One jitted graph over all arrays: eager per-array ops would each pay a
+    # tunnel dispatch round-trip and over-report by an order of magnitude.
+    probe = jax.jit(lambda xs: sum(x.ravel()[0].astype(jnp.float32) for x in xs))
+    float(probe(host))  # compile warmup (also uploads once)
+    t0 = time.time()
+    float(probe(host))  # upload every array + one dependent fetch
+    return time.time() - t0
 
 
 def scale_main(args) -> None:
@@ -129,12 +171,16 @@ def scale_main(args) -> None:
     model = trainer(ds, config)
     sync(model.user_factors)
     warm = time.time() - t0
+    upload_s = _upload_probe_seconds(ds)
     t0 = time.time()
     model = trainer(ds, config)
     sync(model.user_factors)
     train_s = time.time() - t0
 
-    s_per_iter = train_s / config.num_iterations
+    # Steady-state iteration cost: the timed trainer call pays one block
+    # upload + N iterations; subtract the separately measured upload.
+    steady_s = max(train_s - upload_s, 0.0)
+    s_per_iter = steady_s / config.num_iterations
     print(
         json.dumps(
             {
@@ -149,7 +195,8 @@ def scale_main(args) -> None:
                 # corpus so the ratio stays an (optimistic-linear) estimate.
                 "vs_baseline": round(s_per_iter / (60.0 * nnz / 100_480_507), 4),
                 "ratings_per_sec_per_chip": int(
-                    coo.num_ratings * config.num_iterations * 2 / train_s
+                    coo.num_ratings * config.num_iterations * 2
+                    / max(steady_s, 1e-9)
                 ),
                 "users": users,
                 "movies": movies,
@@ -158,7 +205,14 @@ def scale_main(args) -> None:
                 "layout": args.layout,
                 "dtype": args.dtype,
                 "train_wall_s": round(train_s, 3),
-                "compile_wall_s": round(warm - train_s, 3),
+                "upload_wall_s": round(upload_s, 3),
+                "s_per_iteration_incl_upload": round(
+                    train_s / config.num_iterations, 4
+                ),
+                # first_run includes compile; the difference can go negative
+                # under axon-tunnel timing variance, so clamp the estimate.
+                "first_run_wall_s": round(warm, 3),
+                "compile_wall_s": round(max(warm - train_s, 0.0), 3),
                 "datagen_wall_s": round(gen_s, 3),
                 "blockbuild_wall_s": round(build_s, 3),
             }
