@@ -61,13 +61,40 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
     from cfk_tpu.data.movielens import parse_movielens_csv
     from cfk_tpu.data.netflix import parse_netflix
 
-    if cache_dir and os.path.exists(os.path.join(cache_dir, "meta.json")):
-        # Built blocks are deterministic for a (data, layout, shards,
-        # chunking) tuple; the cache skips minutes of host build at scale.
-        # The cache does not fingerprint its inputs — delete it when the
-        # data or layout flags change.
-        ds = Dataset.load(cache_dir)
-        return ds.coo_dense, ds
+    import zipfile
+
+    # Built blocks are deterministic for this tuple; it is stored in the
+    # cache's meta.json so a cache built from other data or flags is
+    # rebuilt instead of silently reused.  Content fingerprint: size + mtime
+    # for files, per-partition end offsets for broker topics (append-only
+    # logs — the offsets identify the ingested prefix exactly).
+    build_key = {
+        "data": path if path.startswith("tcp://") else os.path.abspath(path),
+        "format": fmt,
+        "min_rating": min_rating,
+        "num_shards": num_shards,
+        "pad_multiple": pad_multiple,
+        "layout": layout,
+        "chunk_elems": chunk_elems,
+    }
+
+    def cache_or_build(build):
+        if cache_dir and os.path.exists(os.path.join(cache_dir, "meta.json")):
+            try:
+                return Dataset.load(cache_dir, expect_build_key=build_key)
+            except (ValueError, KeyError, OSError, zipfile.BadZipFile) as e:
+                # mismatched build key, or a missing/corrupt/truncated cache
+                # file: every broken-cache state self-heals via rebuild
+                _eprint(f"warning: ignoring dataset cache: {e}")
+        coo = build()
+        ds = Dataset.from_coo(
+            coo, num_shards=num_shards, pad_multiple=pad_multiple,
+            layout=layout, chunk_elems=chunk_elems,
+        )
+        if cache_dir:
+            ds.save(cache_dir, build_key=build_key)
+        return ds
+
     if path.startswith("tcp://"):
         from cfk_tpu.transport.ingest import collect_ratings
         from cfk_tpu.transport.tcp import TcpBrokerClient
@@ -80,19 +107,85 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
                 "ingest (records on the broker are already parsed)"
             )
         host, port, topic = _parse_tcp_url(path)
-        with TcpBrokerClient(host, port) as client:
-            coo = collect_ratings(client, topic=topic)
-    elif fmt == "netflix":
-        coo = parse_netflix(path)
+        try:
+            client = TcpBrokerClient(host, port)
+        except OSError as e:
+            # Broker down — a matching cache can still train offline, minus
+            # the offset freshness check (which needs the broker).  The
+            # non-offset key fields must still match exactly.
+            ds = _cache_sans_fingerprint(cache_dir, build_key, Dataset,
+                                         ignore=("end_offsets",))
+            if ds is not None:
+                _eprint(
+                    f"warning: broker unreachable ({e}); using dataset cache "
+                    "without the end-offset freshness check"
+                )
+                return ds
+            raise
+        with client:
+            if cache_dir:
+                from cfk_tpu.transport.tcp import BrokerRequestError
+
+                try:
+                    build_key["end_offsets"] = [
+                        client.end_offset(topic, p)
+                        for p in range(client.num_partitions(topic))
+                    ]
+                except BrokerRequestError as e:
+                    # Topic gone (e.g. deleted after caching): a matching
+                    # cache is the only way to train; offsets unverifiable.
+                    ds = _cache_sans_fingerprint(
+                        cache_dir, build_key, Dataset,
+                        ignore=("end_offsets",))
+                    if ds is not None:
+                        _eprint(
+                            f"warning: topic unavailable ({e}); using "
+                            "dataset cache without the end-offset check"
+                        )
+                        return ds
+                    raise
+            return cache_or_build(lambda: collect_ratings(client, topic=topic))
+    if os.path.exists(path):
+        st = os.stat(path)
+        build_key["data_size"] = st.st_size
+        build_key["data_mtime_ns"] = st.st_mtime_ns
     else:
-        coo = parse_movielens_csv(path, min_rating=min_rating)
-    ds = Dataset.from_coo(
-        coo, num_shards=num_shards, pad_multiple=pad_multiple, layout=layout,
-        chunk_elems=chunk_elems,
-    )
-    if cache_dir:
-        ds.save(cache_dir)
-    return coo, ds
+        # Source file gone (archived/deleted after caching) — a cache whose
+        # key matches on everything but the file fingerprint still trains.
+        ds = _cache_sans_fingerprint(cache_dir, build_key, Dataset,
+                                     ignore=("data_size", "data_mtime_ns"))
+        if ds is not None:
+            _eprint(
+                f"warning: data file {path!r} not found; using dataset "
+                "cache without the size/mtime freshness check"
+            )
+            return ds
+    if fmt == "netflix":
+        return cache_or_build(lambda: parse_netflix(path))
+    return cache_or_build(lambda: parse_movielens_csv(path, min_rating=min_rating))
+
+
+def _cache_sans_fingerprint(cache_dir, build_key, Dataset, ignore):
+    """Load a cache whose content fingerprint cannot be recomputed (broker
+    unreachable, source file deleted), if the stored build key matches ours
+    on every field outside ``ignore``."""
+    import os
+    import zipfile
+
+    from cfk_tpu.data.cache import read_build_key
+
+    if not cache_dir or not os.path.exists(os.path.join(cache_dir, "meta.json")):
+        return None
+    try:
+        stored = read_build_key(cache_dir)
+        if stored is None:
+            return None
+        strip = lambda k: {x: v for x, v in k.items() if x not in ignore}
+        if strip(stored) != strip(build_key):
+            return None
+        return Dataset.load(cache_dir, expect_build_key=stored)
+    except (ValueError, KeyError, OSError, zipfile.BadZipFile):
+        return None
 
 
 def _train(args) -> int:
@@ -106,7 +199,7 @@ def _train(args) -> int:
 
     metrics = Metrics()
     with metrics.phase("ingest"):
-        coo, ds = _load_dataset(
+        ds = _load_dataset(
             args.data, args.format, args.min_rating, args.shards,
             args.pad_multiple, args.layout, args.chunk_elems,
             cache_dir=args.dataset_cache,
@@ -437,9 +530,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-every", type=int, default=1)
     t.add_argument(
         "--dataset-cache", default=None,
-        help="directory for the built-blocks cache: loaded if present, "
-        "written after a fresh build (not input-fingerprinted — delete it "
-        "when data or layout flags change)",
+        help="directory for the built-blocks cache: loaded if present and "
+        "its stored build key (data path/size/mtime + layout flags) matches, "
+        "rebuilt and overwritten otherwise",
     )
     t.add_argument("--profile-dir", default=None, help="write a jax.profiler trace")
     t.add_argument(

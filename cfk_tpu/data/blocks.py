@@ -723,18 +723,18 @@ class Dataset:
     user_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks"  # solve users, neighbors are movies
     coo_dense: RatingsCOO  # dense-index COO (movie_raw/user_raw hold dense idx)
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, build_key: dict | None = None) -> None:
         """Cache the built dataset on disk; see ``cfk_tpu.data.cache``."""
         from cfk_tpu.data.cache import save_dataset
 
-        save_dataset(self, path)
+        save_dataset(self, path, build_key=build_key)
 
     @classmethod
-    def load(cls, path: str) -> "Dataset":
+    def load(cls, path: str, expect_build_key: dict | None = None) -> "Dataset":
         """Load a dataset cached with ``save``."""
         from cfk_tpu.data.cache import load_dataset
 
-        return load_dataset(path)
+        return load_dataset(path, expect_build_key=expect_build_key)
 
     @classmethod
     def from_coo(

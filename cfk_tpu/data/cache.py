@@ -20,6 +20,9 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import tempfile
+import time
+import uuid
 
 import numpy as np
 
@@ -33,7 +36,10 @@ from cfk_tpu.data.blocks import (
     SegmentBlocks,
 )
 
-_FORMAT_VERSION = 1
+# 1: arrays always in "arrays.npz". 2: uniquely-named arrays file recorded in
+# meta.json "arrays" (meta is the atomic commit point pairing the two).
+_FORMAT_VERSION = 2
+_READABLE_VERSIONS = (1, 2)
 
 _CLASSES = {
     cls.__name__: cls
@@ -88,32 +94,129 @@ def _unflatten(spec, arrays):
     return spec
 
 
-def save_dataset(dataset: Dataset, path: str) -> None:
-    """Write ``dataset`` under directory ``path`` (created if missing)."""
+# A concurrent save may still be mid-write to its own uniquely-named arrays
+# file when another save's cleanup pass runs; only unlink files at least this
+# stale so cleanup never races an in-flight writer.
+_CLEANUP_AGE_S = 600.0
+
+
+def save_dataset(dataset: Dataset, path: str, build_key: dict | None = None) -> None:
+    """Write ``dataset`` under directory ``path`` (created if missing).
+
+    Crash- and concurrency-safe: arrays go to a uniquely-named file first and
+    ``meta.json`` — the single commit point, written by atomic rename — is
+    what pairs a skeleton with its arrays file.  A crash mid-save leaves the
+    previous cache fully intact; two concurrent saves each publish a
+    self-consistent (meta, arrays) pair and the last rename wins.
+
+    ``build_key`` (any JSON-serializable dict — e.g. data path + layout
+    flags) is stored verbatim; ``load_dataset`` can require it to match so a
+    cache built under different flags is never silently reused.
+    """
     os.makedirs(path, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     skeleton = _flatten(dataset, "ds", arrays)
-    # Write-then-rename so a crashed save never looks loadable.
-    tmp = os.path.join(path, ".arrays.npz.tmp")
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-    os.replace(tmp, os.path.join(path, "arrays.npz"))
-    meta = {"format_version": _FORMAT_VERSION, "skeleton": skeleton}
-    tmp = os.path.join(path, ".meta.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, os.path.join(path, "meta.json"))
+    arrays_name = f"arrays-{uuid.uuid4().hex}.npz"
+    tmp = os.path.join(path, f".{arrays_name}.tmp")
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, os.path.join(path, arrays_name))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "skeleton": skeleton,
+        "arrays": arrays_name,
+        "build_key": build_key,
+    }
+    fd, tmp = tempfile.mkstemp(dir=path, prefix=".meta.json.")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(path, "meta.json"))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _cleanup_stale(path, keep=arrays_name)
 
 
-def load_dataset(path: str) -> Dataset:
-    """Load a dataset previously written by ``save_dataset``."""
+def _cleanup_stale(path: str, keep: str) -> None:
+    """Remove files orphaned by earlier saves: superseded arrays files and
+    temp files left by hard-crashed writers (SIGKILL during np.savez never
+    runs the except-cleanup — at full-Netflix scale each such .tmp is
+    multi-GB).  Never touches the live pair or anything recent enough to be
+    a concurrent save in flight."""
+    now = time.time()
+    # Protect whatever arrays file the current meta.json references, not
+    # just ``keep``: a loader that stalled past the age guard would
+    # otherwise unlink the pair a concurrent rebuild published meanwhile.
+    live = {keep, "meta.json"}
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            live.add(json.load(f).get("arrays", "arrays.npz"))
+    except (OSError, ValueError):
+        pass
+    for name in os.listdir(path):
+        if name in live:
+            continue
+        orphan = (
+            (name.startswith("arrays") or name.startswith(".arrays"))
+            and (name.endswith(".npz") or name.endswith(".npz.tmp"))
+        ) or name.startswith(".meta.json.")
+        if not orphan:
+            continue
+        full = os.path.join(path, name)
+        try:
+            if now - os.path.getmtime(full) > _CLEANUP_AGE_S:
+                os.unlink(full)
+        except OSError:
+            pass
+
+
+def read_build_key(path: str) -> dict | None:
+    """The build key stored with the cache at ``path`` (None if the cache
+    predates build keys or none was given).  Lets callers make their own
+    freshness decision when parts of the key cannot be recomputed — e.g. a
+    broker-offset fingerprint while the broker is unreachable."""
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f).get("build_key")
+
+
+def load_dataset(path: str, expect_build_key: dict | None = None) -> Dataset:
+    """Load a dataset previously written by ``save_dataset``.
+
+    With ``expect_build_key``, the stored build key must equal it exactly —
+    a cache written from different data or layout flags (or one predating
+    build keys) raises instead of silently training on the wrong blocks.
+    """
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
-    if meta.get("format_version") != _FORMAT_VERSION:
+    if meta.get("format_version") not in _READABLE_VERSIONS:
         raise ValueError(
             f"dataset cache at {path!r} has format_version "
-            f"{meta.get('format_version')!r}; this build reads {_FORMAT_VERSION}"
+            f"{meta.get('format_version')!r}; this build reads "
+            f"{_READABLE_VERSIONS}"
         )
-    with np.load(os.path.join(path, "arrays.npz")) as z:
+    if expect_build_key is not None and meta.get("build_key") != expect_build_key:
+        raise ValueError(
+            f"dataset cache at {path!r} was built with "
+            f"{meta.get('build_key')!r}, which does not match the requested "
+            f"{expect_build_key!r}; rebuild (or delete the cache dir)"
+        )
+    arrays_file = meta.get("arrays", "arrays.npz")
+    with np.load(os.path.join(path, arrays_file)) as z:
         arrays = {k: z[k] for k in z.files}
-    return _unflatten(meta["skeleton"], arrays)
+    ds = _unflatten(meta["skeleton"], arrays)
+    # Sweep superseded files here too: the common steady state is hit-only
+    # (save never runs again), which would otherwise retain a multi-GB
+    # arrays file orphaned by the last rebuild forever.
+    _cleanup_stale(path, keep=arrays_file)
+    return ds

@@ -86,3 +86,137 @@ def test_cli_train_uses_cache(tmp_path, capsys):
 
     rmse = lambda s: re.search(r'"rmse": ([0-9.]+)', s.out).group(1)
     assert rmse(first) == rmse(second)
+
+
+def test_cli_cache_rebuilt_on_flag_change(tmp_path, capsys):
+    """A cache built under different layout flags is rebuilt, not reused:
+    silently loading SegmentBlocks into a padded-layout run would crash deep
+    in training (or worse, train on stale data)."""
+    from cfk_tpu.cli import main
+
+    cache = str(tmp_path / "dscache")
+    base = [
+        "train", "--data", "/root/reference/data/data_sample_tiny.txt",
+        "--rank", "3", "--iterations", "1", "--seed", "0",
+        "--dataset-cache", cache, "--output", "none", "--metrics", "json",
+    ]
+    assert main(base + ["--layout", "segment"]) == 0
+    capsys.readouterr()
+    assert main(base + ["--layout", "padded"]) == 0
+    err = capsys.readouterr().err
+    assert "ignoring dataset cache" in err
+    # the rebuild overwrote the cache with the padded build: a repeat padded
+    # run now hits it cleanly
+    assert main(base + ["--layout", "padded"]) == 0
+    assert "ignoring dataset cache" not in capsys.readouterr().err
+
+
+def test_build_key_mismatch_raises(tmp_path):
+    coo = powerlaw_coo(n_movies=20, n_users=30, nnz=200)
+    ds = Dataset.from_coo(coo)
+    ds.save(str(tmp_path / "c"), build_key={"layout": "padded"})
+    loaded = Dataset.load(
+        str(tmp_path / "c"), expect_build_key={"layout": "padded"}
+    )
+    assert_trees_equal(ds, loaded)
+    with pytest.raises(ValueError, match="does not match"):
+        Dataset.load(str(tmp_path / "c"), expect_build_key={"layout": "segment"})
+    # a cache saved without a key (library users, older saves) also refuses
+    # when the caller demands one
+    ds.save(str(tmp_path / "cnone"))
+    with pytest.raises(ValueError, match="does not match"):
+        Dataset.load(str(tmp_path / "cnone"), expect_build_key={"x": 1})
+
+
+def test_cleanup_removes_stale_orphans(tmp_path):
+    """Superseded arrays files AND temp files from hard-crashed writers
+    (SIGKILL mid-np.savez skips the except-cleanup) are swept once stale;
+    fresh files are kept (they may be a concurrent save in flight)."""
+    import os
+    import time
+
+    c = tmp_path / "c"
+    ds = Dataset.from_coo(powerlaw_coo(n_movies=20, n_users=30, nnz=200))
+    ds.save(str(c))
+    stale = [".arrays-dead.npz.tmp", "arrays-old.npz", ".meta.json.abc123"]
+    for n in stale + ["arrays-fresh.npz"]:
+        (c / n).write_bytes(b"x")
+    old = time.time() - 3600
+    for n in stale:
+        os.utime(c / n, (old, old))
+    ds.save(str(c))  # save runs the cleanup pass
+    names = set(os.listdir(c))
+    assert not (names & set(stale))
+    assert "arrays-fresh.npz" in names  # too recent to touch
+    assert "meta.json" in names
+    assert_trees_equal(ds, Dataset.load(str(c)))
+    # load runs the sweep too (hit-only workflows would otherwise retain
+    # superseded arrays files forever)
+    os.utime(c / "arrays-fresh.npz", (old, old))
+    Dataset.load(str(c))
+    assert "arrays-fresh.npz" not in set(os.listdir(c))
+
+
+def test_v1_layout_still_loads(tmp_path):
+    """Format v1 (arrays always in arrays.npz, no 'arrays' meta key) stays
+    readable: the loader defaults the filename when the key is absent."""
+    import json
+
+    coo = powerlaw_coo(n_movies=20, n_users=30, nnz=200)
+    ds = Dataset.from_coo(coo)
+    c = tmp_path / "c"
+    ds.save(str(c))
+    meta = json.loads((c / "meta.json").read_text())
+    (c / "arrays.npz").write_bytes((c / meta["arrays"]).read_bytes())
+    (c / meta["arrays"]).unlink()
+    meta["format_version"] = 1
+    del meta["arrays"]
+    (c / "meta.json").write_text(json.dumps(meta))
+    assert_trees_equal(ds, Dataset.load(str(c)))
+
+
+def test_cli_cache_survives_deleted_source_file(tmp_path, capsys):
+    """Archiving/deleting the ratings file after caching must not break
+    cached training (the file fingerprint is skipped with a warning), but a
+    layout-flag mismatch still refuses."""
+    import shutil
+
+    from cfk_tpu.cli import main
+
+    data = tmp_path / "ratings.txt"
+    shutil.copy("/root/reference/data/data_sample_tiny.txt", data)
+    cache = str(tmp_path / "dscache")
+    train = [
+        "train", "--data", str(data), "--rank", "3", "--iterations", "1",
+        "--seed", "0", "--dataset-cache", cache, "--output", "none",
+        "--metrics", "json",
+    ]
+    assert main(train) == 0
+    data.unlink()
+    capsys.readouterr()
+    assert main(train) == 0
+    assert "not found; using dataset cache" in capsys.readouterr().err
+    # different layout flags must not ride the missing-file fallback
+    assert main(train + ["--layout", "segment"]) == 1
+    assert "error" in capsys.readouterr().err.lower()
+
+
+def test_resave_is_atomic_pairing(tmp_path):
+    """meta.json is the commit point: each save publishes a self-consistent
+    (skeleton, arrays-file) pair, so re-saving different data over an
+    existing cache can never pair new arrays with the old skeleton."""
+    import json
+
+    c = str(tmp_path / "c")
+    ds_a = Dataset.from_coo(powerlaw_coo(n_movies=20, n_users=30, nnz=200))
+    ds_a.save(c)
+    meta_a = json.loads((tmp_path / "c" / "meta.json").read_text())
+    ds_b = Dataset.from_coo(powerlaw_coo(n_movies=40, n_users=50, nnz=700))
+    ds_b.save(c)
+    meta_b = json.loads((tmp_path / "c" / "meta.json").read_text())
+    assert meta_a["arrays"] != meta_b["arrays"]
+    assert_trees_equal(ds_b, Dataset.load(c))
+    # the superseded arrays file is retained until stale (concurrent-writer
+    # safety) but unreferenced; loading still works if it is deleted
+    (tmp_path / "c" / meta_a["arrays"]).unlink()
+    assert_trees_equal(ds_b, Dataset.load(c))

@@ -289,6 +289,64 @@ def test_cli_produce_then_train_from_broker(server, capsys, tmp_path):
     assert main(["produce", "--broker", url, "--data", TINY]) == 1
 
 
+def test_cli_tcp_dataset_cache_fingerprints_offsets(server, capsys, tmp_path):
+    """The dataset cache's build key for tcp:// sources is the topic's
+    per-partition end offsets: same log → cache hit; a topic with different
+    contents at the same URL → rebuild, never silent reuse of stale blocks."""
+    from cfk_tpu.cli import main
+
+    url = f"tcp://127.0.0.1:{server.port}/ratings-cache-fp"
+    cache = str(tmp_path / "dscache")
+    train = [
+        "train", "--data", url, "--rank", "3", "--iterations", "1",
+        "--seed", "0", "--dataset-cache", cache, "--output", "none",
+        "--metrics", "json",
+    ]
+    assert main(["produce", "--broker", url, "--data", TINY,
+                 "--partitions", "2"]) == 0
+    capsys.readouterr()
+    assert main(train) == 0
+    capsys.readouterr()
+    assert main(train) == 0  # same offsets → cache hit
+    assert "ignoring dataset cache" not in capsys.readouterr().err
+    # same URL, different log contents (re-produced with more partitions →
+    # different per-partition offsets) → the cache must be rebuilt
+    with server.connect() as c:
+        c.delete_topic("ratings-cache-fp")
+    assert main(["produce", "--broker", url, "--data", TINY,
+                 "--partitions", "4"]) == 0
+    capsys.readouterr()
+    assert main(train) == 0
+    assert "ignoring dataset cache" in capsys.readouterr().err
+
+
+def test_cli_tcp_cache_works_with_broker_down(capsys, tmp_path):
+    """A matching tcp-sourced cache still trains with the broker gone —
+    the offset freshness check is skipped with a warning, the other build-key
+    fields must still match exactly."""
+    from cfk_tpu.cli import main
+
+    cache = str(tmp_path / "dscache")
+    with BrokerProcess() as bp:
+        url = f"tcp://127.0.0.1:{bp.port}/ratings-offline"
+        assert main(["produce", "--broker", url, "--data", TINY,
+                     "--partitions", "2"]) == 0
+        train = [
+            "train", "--data", url, "--rank", "3", "--iterations", "1",
+            "--seed", "0", "--dataset-cache", cache, "--output", "none",
+            "--metrics", "json",
+        ]
+        assert main(train) == 0
+    capsys.readouterr()
+    # broker process is dead now; same command must run from the cache
+    assert main(train) == 0
+    err = capsys.readouterr().err
+    assert "broker unreachable" in err
+    # but a cache from different layout flags must NOT be used offline
+    assert main(train + ["--layout", "segment"]) == 1
+    assert "error:" in capsys.readouterr().err
+
+
 def test_end_to_end_train_from_tcp_ingest(server):
     # Full pipeline: broker ingest → blocks → ALS → finite predictions.
     from cfk_tpu.config import ALSConfig
