@@ -94,6 +94,10 @@ def scale_main(args) -> None:
     from cfk_tpu.data.synthetic import synthetic_netflix_coo
     from cfk_tpu.models.als import train_als
 
+    if args.ialspp:
+        args.ials = True
+        if args.layout == "segment":
+            args.layout = "bucketed"  # ials++ needs padded/bucketed
     if args.ials:
         # MovieLens-25M shape (BASELINE.md implicit-feedback target);
         # ratings act as interaction strengths.
@@ -119,6 +123,8 @@ def scale_main(args) -> None:
             rank=args.rank, lam=0.1, alpha=40.0,
             num_iterations=args.iterations, seed=0, layout=args.layout,
             dtype=args.dtype,
+            algorithm="ials++" if args.ialspp else "als",
+            block_size=args.block_size, sweeps=args.sweeps,
         )
         trainer = train_ials
     else:
@@ -165,7 +171,8 @@ def scale_main(args) -> None:
         json.dumps(
             {
                 "metric": (
-                    "synthetic_ml25m_ials_s_per_iteration" if args.ials
+                    "synthetic_ml25m_ialspp_s_per_iteration" if args.ialspp
+                    else "synthetic_ml25m_ials_s_per_iteration" if args.ials
                     else "synthetic_netflix_scale_s_per_iteration"
                 ),
                 "value": round(s_per_iter, 4),
@@ -213,6 +220,11 @@ if __name__ == "__main__":
     parser.add_argument("--ials", action="store_true",
                         help="implicit-feedback iALS at MovieLens-25M "
                         "dimensions (162k x 59k x 25M, rank 128)")
+    parser.add_argument("--ialspp", action="store_true",
+                        help="same shape via iALS++ subspace optimization "
+                        "(bucketed layout, --block-size coordinate blocks)")
+    parser.add_argument("--block-size", type=int, default=32)
+    parser.add_argument("--sweeps", type=int, default=1)
     parser.add_argument("--users", type=int, default=48_000)
     parser.add_argument("--movies", type=int, default=1_777)
     parser.add_argument("--nnz", type=int, default=10_000_000)

@@ -42,10 +42,20 @@ from cfk_tpu.parallel.mesh import AXIS, shard_rows
 
 @dataclasses.dataclass(frozen=True)
 class IALSConfig(ALSConfig):
-    """iALS hyper-parameters; ``lam`` here is plain-λI regularization."""
+    """iALS hyper-parameters; ``lam`` here is plain-λI regularization.
+
+    ``algorithm="ials++"`` switches the per-entity solve from the full k×k
+    normal equations to subspace block coordinate descent (Rendle et al.,
+    PAPERS.md): ``sweeps`` passes over ``rank/block_size`` coordinate blocks
+    per half-iteration, warm-started from the previous epoch's factors.
+    With ``block_size == rank`` one sweep equals the full solve exactly.
+    """
 
     alpha: float = 40.0
     lam: float = 0.1
+    algorithm: str = "als"  # "als" (full k×k solves) | "ials++"
+    block_size: int = 32
+    sweeps: int = 1
 
     def __post_init__(self) -> None:
         super().__post_init__()
@@ -56,12 +66,48 @@ class IALSConfig(ALSConfig):
                 "iALS currently supports exchange='all_gather' only (the "
                 "global-Gram trick needs the full fixed side per shard)"
             )
+        if self.algorithm not in ("als", "ials++"):
+            raise ValueError(f"unknown iALS algorithm {self.algorithm!r}")
+        if self.algorithm == "ials++":
+            if self.layout == "segment":
+                raise ValueError(
+                    "ials++ supports the padded and bucketed layouts "
+                    "(bucketed is the at-scale one); the segment layout's "
+                    "chunk-straddling entities would need cross-chunk score "
+                    "updates — use layout='bucketed'"
+                )
+            if self.rank % self.block_size != 0:
+                raise ValueError(
+                    f"rank {self.rank} not divisible by block_size "
+                    f"{self.block_size}"
+                )
+            if self.sweeps < 1:
+                raise ValueError(f"sweeps must be >= 1, got {self.sweeps}")
 
 
 def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
-               entities=None):
+               entities=None, x_prev=None, algorithm="als", block_size=32,
+               sweeps=1):
     """Dispatch on block layout (tuple = buckets, dict with segment ids =
-    flat segment run, other dict = padded rectangle)."""
+    flat segment run, other dict = padded rectangle).  ``algorithm="ials++"``
+    runs warm-started subspace sweeps from ``x_prev`` instead of full
+    solves (padded/bucketed layouts)."""
+    if algorithm == "ials++":
+        from cfk_tpu.ops.subspace import (
+            ials_pp_half_step,
+            ials_pp_half_step_bucketed,
+        )
+
+        if isinstance(blk, tuple):
+            return ials_pp_half_step_bucketed(
+                fixed, x_prev, blk, chunks, entities, lam, alpha, gram=gram,
+                block_size=block_size, sweeps=sweeps, solver=solver,
+            )
+        return ials_pp_half_step(
+            fixed, x_prev, blk["neighbor_idx"], blk["rating"], blk["mask"],
+            lam, alpha, gram=gram, block_size=block_size, sweeps=sweeps,
+            solver=solver,
+        )
     if isinstance(blk, tuple):
         return ials_half_step_bucketed(
             fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
@@ -83,13 +129,14 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     jax.jit,
     static_argnames=(
         "rank", "num_iterations", "lam", "alpha", "dtype", "solver",
+        "algorithm", "block_size", "sweeps",
         "m_chunks", "u_chunks", "m_entities", "u_entities",
     ),
 )
 def _train_loop(
     key, movie_blocks, user_blocks, u_stats=None, *, rank, num_iterations, lam,
-    alpha, dtype, solver="cholesky", m_chunks=None, u_chunks=None,
-    m_entities=None, u_entities=None,
+    alpha, dtype, solver="cholesky", algorithm="als", block_size=32, sweeps=1,
+    m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     dt = jnp.dtype(dtype)
     if u_stats is not None:  # bucketed layout
@@ -102,16 +149,17 @@ def _train_loop(
         m_rows = movie_blocks["rating"].shape[0]
     u = u.astype(dt)
     m0 = jnp.zeros((m_rows, rank), dtype=dt)
+    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps)
 
     def one_iteration(_, carry):
-        u, _ = carry
+        u, m_prev = carry
         m = _ials_half(
             u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
-            chunks=m_chunks, entities=m_entities,
+            chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
         ).astype(dt)
         u_new = _ials_half(
             m, user_blocks, lam=lam, alpha=alpha, solver=solver,
-            chunks=u_chunks, entities=u_entities,
+            chunks=u_chunks, entities=u_entities, x_prev=u, **alg,
         ).astype(dt)
         return (u_new, m)
 
@@ -146,6 +194,9 @@ def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSMode
             alpha=config.alpha,
             dtype=config.dtype,
             solver=config.solver,
+            algorithm=config.algorithm,
+            block_size=config.block_size,
+            sweeps=config.sweeps,
             **layout_kw,
         )
         u.block_until_ready()
@@ -175,8 +226,57 @@ def make_ials_training_step(
     Per half-iteration: psum the local [k,k] Grams, all_gather the fixed
     factors, solve local entities (per width bucket when ``m_chunks`` given,
     or by segment_sum over the flat local run when ``segment=True``).
+    ``config.algorithm="ials++"`` swaps the full solves for warm-started
+    subspace sweeps — entities are row-sharded and the sweep is per-entity,
+    so the only additional data it needs is the side's own previous local
+    factors (no extra collectives).
     """
     from cfk_tpu.parallel.spmd import gathered_half, wrap_step
+
+    if config.algorithm == "ials++":
+        from cfk_tpu.ops.subspace import (
+            ials_pp_half_step,
+            ials_pp_half_step_bucketed,
+        )
+
+        alg = dict(block_size=config.block_size, sweeps=config.sweeps,
+                   solver=config.solver)
+
+        if m_chunks is not None:  # bucketed layout
+
+            def pp_bkt(chunks, local):
+                def solve(fixed_full, prev_local, blk, gram):
+                    return ials_pp_half_step_bucketed(
+                        fixed_full, prev_local, blk, chunks, local,
+                        config.lam, config.alpha, gram=gram, **alg,
+                    )
+
+                return solve
+
+            return wrap_step(
+                mesh, config,
+                gathered_half(pp_bkt(m_chunks, m_local), with_gram=True,
+                              with_prev=True),
+                gathered_half(pp_bkt(u_chunks, u_local), with_gram=True,
+                              with_prev=True),
+                mspecs, uspecs, carry_prev=True,
+            )
+
+        def pp_padded(fixed_full, prev_local, blk, gram):
+            return ials_pp_half_step(
+                fixed_full, prev_local, blk["neighbor"], blk["rating"],
+                blk["mask"], config.lam, config.alpha, gram=gram, **alg,
+            )
+
+        spec = {
+            "neighbor": P(AXIS, None),
+            "rating": P(AXIS, None),
+            "mask": P(AXIS, None),
+            "count": P(AXIS),
+        }
+        half = gathered_half(pp_padded, with_gram=True, with_prev=True)
+        return wrap_step(mesh, config, half, half, spec, spec,
+                         carry_prev=True)
 
     if segment:  # flat segment layout
 

@@ -129,6 +129,34 @@ def ials_half_step(
     return dispatch_spd_solve(a, b, solver)
 
 
+def walk_buckets(buckets, chunk_rows, arrays_of, piece, out):
+    """The bucket scaffolding every width-bucketed half-step shares.
+
+    For each bucket: extract its per-row arrays (``arrays_of(blk, out)`` —
+    ``out`` is passed so warm-started optimizers can gather the bucket's
+    current factors), run ``piece(*arrays) -> [rows, k]`` — streamed through
+    HBM in [chunk, ...] pieces via ``lax.map`` when ``chunk_rows`` bounds the
+    bucket — and scatter the result into ``out`` at the bucket's entity rows
+    (padding rows target the trash slot; real rows are unique across
+    buckets).
+    """
+    k = out.shape[-1]
+    for blk, chunk in zip(buckets, chunk_rows):
+        arrs = arrays_of(blk, out)
+        rows = arrs[0].shape[0]
+        if chunk is None or chunk >= rows:
+            x = piece(*arrs)
+        else:
+            if rows % chunk != 0:
+                raise ValueError(f"bucket rows {rows} not divisible by chunk {chunk}")
+            reshaped = tuple(
+                a.reshape((rows // chunk, chunk) + a.shape[1:]) for a in arrs
+            )
+            x = lax.map(lambda c: piece(*c), reshaped).reshape(rows, k)
+        out = out.at[blk["entity_local"]].set(x)
+    return out
+
+
 def ials_half_step_bucketed(
     fixed_factors: jax.Array,  # [F, k]
     buckets,  # sequence of dicts {neighbor, rating, mask, entity_local}
@@ -155,20 +183,12 @@ def ials_half_step_bucketed(
         a_obs, b = gather_gram_implicit(fixed_factors, ni, alpha * rt, mk)
         return dispatch_spd_solve(gram[None] + a_obs + reg[None], b, solver)
 
-    out = jnp.zeros((local_entities + 1, k), jnp.float32)
-    for blk, chunk in zip(buckets, chunk_rows):
-        rows = blk["neighbor"].shape[0]
-        if chunk is None or chunk >= rows:
-            x = solve_piece(blk["neighbor"], blk["rating"], blk["mask"])
-        else:
-            if rows % chunk != 0:
-                raise ValueError(f"bucket rows {rows} not divisible by chunk {chunk}")
-            reshape = lambda a: a.reshape((rows // chunk, chunk) + a.shape[1:])
-            x = lax.map(
-                lambda c: solve_piece(c[0], c[1], c[2]),
-                (reshape(blk["neighbor"]), reshape(blk["rating"]), reshape(blk["mask"])),
-            ).reshape(rows, k)
-        out = out.at[blk["entity_local"]].set(x)
+    out = walk_buckets(
+        buckets, chunk_rows,
+        lambda blk, _out: (blk["neighbor"], blk["rating"], blk["mask"]),
+        solve_piece,
+        jnp.zeros((local_entities + 1, k), jnp.float32),
+    )
     return out[:local_entities]
 
 
@@ -539,28 +559,14 @@ def als_half_step_bucketed(
     streams oversized buckets through HBM in [chunk, width, k] pieces.
     """
     k = fixed_factors.shape[-1]
-    out = jnp.zeros((local_entities + 1, k), jnp.float32)
-    for blk, chunk in zip(buckets, chunk_rows):
-        rows = blk["neighbor"].shape[0]
-        if chunk is None or chunk >= rows:
-            x = _solve_chunk(
-                fixed_factors, lam, blk["neighbor"], blk["rating"], blk["mask"],
-                blk["count"], solver,
-            )
-        else:
-            if rows % chunk != 0:
-                raise ValueError(f"bucket rows {rows} not divisible by chunk {chunk}")
-            reshape = lambda a: a.reshape((rows // chunk, chunk) + a.shape[1:])
-            x = lax.map(
-                lambda c: _solve_chunk(fixed_factors, lam, c[0], c[1], c[2], c[3], solver),
-                (
-                    reshape(blk["neighbor"]),
-                    reshape(blk["rating"]),
-                    reshape(blk["mask"]),
-                    reshape(blk["count"]),
-                ),
-            ).reshape(rows, k)
-        # Padding rows target the trash slot local_entities; real rows are
-        # unique across buckets so .set never collides.
-        out = out.at[blk["entity_local"]].set(x)
+    out = walk_buckets(
+        buckets, chunk_rows,
+        lambda blk, _out: (
+            blk["neighbor"], blk["rating"], blk["mask"], blk["count"]
+        ),
+        lambda ni, rt, mk, cnt: _solve_chunk(
+            fixed_factors, lam, ni, rt, mk, cnt, solver
+        ),
+        jnp.zeros((local_entities + 1, k), jnp.float32),
+    )
     return out[:local_entities]

@@ -1,0 +1,171 @@
+"""iALS++ subspace optimization — block coordinate descent for implicit ALS.
+
+Implements the optimizer of Rendle et al., "iALS++: Speeding up Matrix
+Factorization with Subspace Optimization" (PAPERS.md): instead of solving the
+full k×k normal equations per entity per epoch (O(nnz·k² + E·k³)), sweep over
+coordinate blocks of size b, solving a b×b subsystem per entity per block
+(O(nnz·k + nnz·k·b + E·k·b²) per sweep).  At rank 128 with b=32 this is the
+difference between a 2M-FLOP and a 130K-FLOP solve per entity, and the Gram
+work drops by k/b — the big-k regime (the BASELINE.md MovieLens-25M rank-128
+target) is exactly where it pays.
+
+Math (implicit objective, Hu et al. 2008, preferences 1, confidence
+c = 1 + α·r, unobserved weight 1):
+
+    A_u = G + Σ_obs (c−1)·f fᵀ + λI,   b_u = Σ_obs c·f,   G = YᵀY
+
+Block update for coordinate block B with current iterate x:
+
+    A_u[B,B] δ = −g_u[B],   g_u = A_u x − b_u,   x[B] += δ
+
+using  g_u[B] = (x·G)[B] + λ·x[B] + Σ_obs f[B]·((c−1)·s − c),  s = fᵀx.
+The per-interaction scores s are computed once per sweep (the O(nnz·k) term)
+and updated incrementally after each block: s += f[B]ᵀ δ.
+
+Exactness anchor: with block_size = k, one sweep from ANY iterate x0 gives
+x0 + A⁻¹(b − A·x0) = A⁻¹b — bit-for-bit the full iALS solve path's answer
+(same Gram assembly, same solver).  ``tests/test_ialspp.py`` pins this.
+
+Each entity's update is independent given (fixed, G), so the sweep
+vectorizes over entities exactly like the plain half-steps: one rectangle
+for the padded layout, per-width-class rectangles (optionally chunked
+through HBM) for the bucketed layout.  The reference has no implicit model
+at all (SURVEY.md §2.6); this module is beyond-parity capability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cfk_tpu.ops.solve import dispatch_spd_solve
+
+
+def _sweep_rect(
+    fixed: jax.Array,  # [F, k] fixed-side factors
+    x: jax.Array,  # [E, k] current own-side iterate (float32)
+    neighbor_idx: jax.Array,  # [E, P]
+    rating: jax.Array,  # [E, P] raw interaction strengths
+    mask: jax.Array,  # [E, P] 1 = real
+    lam: float,
+    alpha: float,
+    gram: jax.Array,  # [k, k] YᵀY over the FULL fixed side
+    block_size: int,
+    solver: str,
+) -> jax.Array:
+    """One full sweep over all k/block_size coordinate blocks of a rectangle."""
+    k = x.shape[-1]
+    if k % block_size != 0:
+        raise ValueError(f"rank {k} not divisible by block_size {block_size}")
+    f32 = jnp.float32
+    x = x.astype(f32)
+    conf_m1 = (alpha * rating * mask).astype(f32)  # c−1 at observed, 0 at pad
+    c_obs = conf_m1 + mask.astype(f32)  # c at observed, 0 at pad
+    gathered = fixed[neighbor_idx].astype(f32) * mask[..., None]
+    # Scores s = fᵀx per interaction — once per sweep, then rank-b updates.
+    s = jnp.einsum(
+        "epk,ek->ep", gathered, x,
+        preferred_element_type=f32, precision="highest",
+    )
+    eye_b = jnp.eye(block_size, dtype=f32)
+    for j in range(k // block_size):
+        cols = slice(j * block_size, (j + 1) * block_size)
+        f_b = gathered[:, :, cols]  # [E, P, b]
+        w = conf_m1 * s - c_obs  # [E, P]; pad entries are exactly 0
+        g_b = (
+            jnp.einsum("ek,kb->eb", x, gram[:, cols],
+                       preferred_element_type=f32, precision="highest")
+            + lam * x[:, cols]
+            + jnp.einsum("epb,ep->eb", f_b, w,
+                         preferred_element_type=f32, precision="highest")
+        )
+        a_bb = (
+            gram[cols, cols]
+            + lam * eye_b
+            + jnp.einsum("ep,epb,epc->ebc", conf_m1, f_b, f_b,
+                         preferred_element_type=f32, precision="highest")
+        )
+        delta = dispatch_spd_solve(a_bb, -g_b, solver)
+        x = x.at[:, cols].add(delta)
+        s = s + jnp.einsum("epb,eb->ep", f_b, delta,
+                           preferred_element_type=f32, precision="highest")
+    return x
+
+
+def ials_pp_half_step(
+    fixed: jax.Array,  # [F, k]
+    x_prev: jax.Array,  # [E, k] previous own-side factors (warm start)
+    neighbor_idx: jax.Array,
+    rating: jax.Array,
+    mask: jax.Array,
+    lam: float,
+    alpha: float,
+    *,
+    gram: jax.Array | None = None,
+    block_size: int = 32,
+    sweeps: int = 1,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """iALS++ half-iteration over the padded rectangle layout."""
+    from cfk_tpu.ops.solve import global_gram
+
+    if gram is None:
+        gram = global_gram(fixed)
+    for _ in range(sweeps):
+        x_prev = _sweep_rect(
+            fixed, x_prev, neighbor_idx, rating, mask, lam, alpha, gram,
+            block_size, solver,
+        )
+    return x_prev
+
+
+def ials_pp_half_step_bucketed(
+    fixed: jax.Array,  # [F, k]
+    x_prev: jax.Array,  # [local_entities(+pad rows ok), k]
+    buckets,  # sequence of dicts {neighbor, rating, mask, entity_local}
+    chunk_rows,  # same-length sequence of static ints / None
+    local_entities: int,
+    lam: float,
+    alpha: float,
+    *,
+    gram: jax.Array | None = None,
+    block_size: int = 32,
+    sweeps: int = 1,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """iALS++ half-iteration over width-bucketed InBlocks.
+
+    Buckets partition the entities (each rated entity lives in exactly one
+    bucket), so the sweep runs independently per bucket rectangle and
+    scatters back.  Entities in no bucket (zero interactions) keep their
+    previous value — matching the warm-started full-iALS fixpoint, which
+    drives such rows to 0 and our inits already start them at 0.
+    ``chunk_rows`` streams oversized buckets through HBM like the plain
+    bucketed half-step does.
+    """
+    from cfk_tpu.ops.solve import global_gram, walk_buckets
+
+    if gram is None:
+        gram = global_gram(fixed)
+    k = fixed.shape[-1]
+    out = jnp.zeros((local_entities + 1, k), jnp.float32)
+    n = min(x_prev.shape[0], local_entities)
+    out = out.at[:n].set(x_prev[:n].astype(jnp.float32))
+
+    def sweep_piece(xb, ni, rt, mk):
+        for _ in range(sweeps):
+            xb = _sweep_rect(
+                fixed, xb, ni, rt, mk, lam, alpha, gram, block_size, solver
+            )
+        return xb
+
+    out = walk_buckets(
+        buckets, chunk_rows,
+        lambda blk, cur: (
+            cur[blk["entity_local"]], blk["neighbor"], blk["rating"],
+            blk["mask"],
+        ),
+        sweep_piece,
+        out,
+    )
+    return out[:local_entities]

@@ -190,19 +190,25 @@ def tree_specs(tree):
 _tree_specs = tree_specs  # back-compat alias
 
 
-def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs):
+def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs,
+              *, carry_prev=False):
     """The one shard_map scaffold every training step shares.
 
     ``half_m``/``half_u`` map (fixed_local, local_block_tree) → new local
     factors for one side; the wrapper sequences the two half-iterations,
     casts factors to the storage/exchange dtype, and binds the row shardings.
+    With ``carry_prev`` (warm-started optimizers like iALS++) the halves get
+    the side's previous local factors too: (fixed_local, prev_local, blk).
     """
     dtype = jnp.dtype(config.dtype)
 
-    def iteration(u, m_unused, mblk, ublk):
-        del m_unused
-        m = half_m(u, mblk).astype(dtype)
-        u_new = half_u(m, ublk).astype(dtype)
+    def iteration(u, m_prev, mblk, ublk):
+        if carry_prev:
+            m = half_m(u, m_prev, mblk).astype(dtype)
+            u_new = half_u(m, u, ublk).astype(dtype)
+        else:
+            m = half_m(u, mblk).astype(dtype)
+            u_new = half_u(m, ublk).astype(dtype)
         return u_new, m
 
     return _shard_map(
@@ -214,13 +220,16 @@ def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs):
     )
 
 
-def gathered_half(solve, *, with_gram=False):
+def gathered_half(solve, *, with_gram=False, with_prev=False):
     """The all_gather exchange pattern every gathered layout shares.
 
     ``solve(fixed_full, blk, gram) -> factors`` gets the full fixed-side
     factor matrix (one all_gather over ICI per half-iteration) and, with
     ``with_gram`` (iALS), the mesh-wide YᵀY (local Gram psum'd — a [k,k]
-    collective).  Used by both the explicit and implicit SPMD steps so the
+    collective).  ``with_prev`` threads the side's previous local factors
+    through as ``solve(fixed_full, prev_local, blk, gram)`` (iALS++ warm
+    start; the sweep is per-entity so prev stays shard-local, no extra
+    collective).  Used by the explicit and implicit SPMD steps so the
     exchange is written exactly once.
     """
 
@@ -229,7 +238,12 @@ def gathered_half(solve, *, with_gram=False):
         fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
         return solve(fixed_full, blk, gram)
 
-    return half
+    def half_prev(fixed_local, prev_local, blk):
+        gram = lax.psum(global_gram(fixed_local), AXIS) if with_gram else None
+        fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        return solve(fixed_full, prev_local, blk, gram)
+
+    return half_prev if with_prev else half
 
 
 def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
