@@ -27,6 +27,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _gram_compute_dtype(fixed_factors):
+    """(compute dtype, einsum precision) for Gram/RHS contractions.
+
+    float32 factors: full-float32 MXU passes (precision="highest") — the
+    default bf16 passes would perturb the normal equations ~1e-2 relative
+    and break parity with the reference's float32 EJML math.
+
+    bfloat16 factors (the at-scale storage mode): feed the MXU bf16
+    directly with float32 accumulation, at twice the MXU rate and half the
+    gather traffic (profiled: the f32 upcast fusion was the single hottest
+    op in the full-Netflix iteration).  For the UNWEIGHTED Gram A = Σ ffᵀ
+    and the rating RHS this is bit-identical to upcasting first — bf16×bf16
+    products are exact in the float32 accumulator, and star/half-star
+    ratings fit bf16's 8-bit mantissa exactly (measured: medium-config RMSE
+    unchanged to the last printed digit).  The iALS confidence
+    pre-multiplies (gm·(c−1) etc.) DO round each weighted product to bf16
+    before the matmul in this mode — ~0.4% relative on those Gram entries,
+    on top of the storage rounding the caller already opted into.
+    """
+    if fixed_factors.dtype == jnp.bfloat16:
+        return jnp.bfloat16, None
+    return jnp.float32, "highest"
+
+
 def gather_gram(
     fixed_factors: jax.Array,  # [F, k] factors of the side held fixed
     neighbor_idx: jax.Array,  # [E, P] int32
@@ -38,18 +62,16 @@ def gather_gram(
     Returns (A [E, k, k], b [E, k]).  The gather + einsum pair is what XLA
     tiles onto the MXU; padding rows contribute zero via the mask.
     """
+    ct, prec = _gram_compute_dtype(fixed_factors)
     gathered = fixed_factors[neighbor_idx]  # [E, P, k]
-    gm = gathered.astype(jnp.float32) * mask[..., None]
-    # precision="highest": full-float32 MXU passes. The default bf16 passes
-    # perturb the normal equations by ~1e-2 relative, which breaks parity
-    # with the reference's float32 EJML math.
+    gm = gathered.astype(ct) * mask[..., None].astype(ct)
     a = jnp.einsum(
         "epk,epl->ekl", gm, gm,
-        preferred_element_type=jnp.float32, precision="highest",
+        preferred_element_type=jnp.float32, precision=prec,
     )
     b = jnp.einsum(
-        "epk,ep->ek", gm, rating,
-        preferred_element_type=jnp.float32, precision="highest",
+        "epk,ep->ek", gm, rating.astype(ct),
+        preferred_element_type=jnp.float32, precision=prec,
     )
     return a, b
 
@@ -83,25 +105,27 @@ def gather_gram_implicit(
     all fixed-side rows — computed once per half-iteration (the O(k²)
     speedup trick), not per entity.
     """
-    gathered = fixed_factors[neighbor_idx].astype(jnp.float32)
-    gm = gathered * mask[..., None]
-    gw = gm * confidence_m1[..., None]
+    ct, prec = _gram_compute_dtype(fixed_factors)
+    gathered = fixed_factors[neighbor_idx].astype(ct)
+    gm = gathered * mask[..., None].astype(ct)
+    gw = gm * confidence_m1[..., None].astype(ct)
     a = jnp.einsum(
         "epk,epl->ekl", gw, gm,
-        preferred_element_type=jnp.float32, precision="highest",
+        preferred_element_type=jnp.float32, precision=prec,
     )
     b = jnp.einsum(
-        "epk,ep->ek", gm, (confidence_m1 + 1.0) * mask,
-        preferred_element_type=jnp.float32, precision="highest",
+        "epk,ep->ek", gm, ((confidence_m1 + 1.0) * mask).astype(ct),
+        preferred_element_type=jnp.float32, precision=prec,
     )
     return a, b
 
 
 def global_gram(factors: jax.Array) -> jax.Array:
-    """YᵀY over all rows (float32, full precision) — [k, k]."""
-    f = factors.astype(jnp.float32)
+    """YᵀY over all rows (float32 accumulation) — [k, k]."""
+    ct, prec = _gram_compute_dtype(factors)
+    f = factors.astype(ct)
     return jnp.einsum(
-        "fk,fl->kl", f, f, preferred_element_type=jnp.float32, precision="highest"
+        "fk,fl->kl", f, f, preferred_element_type=jnp.float32, precision=prec
     )
 
 
@@ -321,17 +345,23 @@ def _segment_gram_flat(
     materializes the [C, k, k] per-entry outer products and segment-sums
     them by ``segment_ids``.
     """
-    f = fixed_factors[neighbor_idx].astype(jnp.float32) * mask[:, None]
-    fw = f * weight[:, None]
+    ct, prec = _gram_compute_dtype(fixed_factors)
+    f = fixed_factors[neighbor_idx].astype(ct) * mask[:, None].astype(ct)
+    fw = f * weight[:, None].astype(ct)
     if backend == "ragged":
-        lhs = jnp.concatenate([fw, rating[:, None]], axis=1)  # [C, k+1]
+        lhs = jnp.concatenate([fw, rating[:, None].astype(ct)], axis=1)  # [C, k+1]
         out = lax.ragged_dot_general(
             lhs, f, group_sizes, _ragged_gram_ddn(),
-            precision=lax.Precision.HIGHEST, preferred_element_type=jnp.float32,
+            precision=(lax.Precision.HIGHEST if prec else None),
+            preferred_element_type=jnp.float32,
         )  # [G, k+1, k]
         return out[:, :-1, :], out[:, -1, :]
     if backend != "segsum":
         raise ValueError(f"unknown segment gram backend {backend!r}")
+    # segment_sum accumulates in the operand dtype — upcast so bf16-stored
+    # factors still get float32 accumulation like the ragged path.
+    f = f.astype(jnp.float32)
+    fw = fw.astype(jnp.float32)
     a = jax.ops.segment_sum(
         fw[:, :, None] * f[:, None, :], segment_ids,
         num_segments=num_segments, indices_are_sorted=True,

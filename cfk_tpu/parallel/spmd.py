@@ -371,7 +371,9 @@ def make_training_step(
         )
 
     # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI bytes
-    # and HBM); the Gram math upcasts to float32 internally (wrap_step casts).
+    # and HBM); Gram contractions follow the storage dtype — native bf16 MXU
+    # passes with float32 accumulation for bf16 factors, full-f32 "highest"
+    # for float32 (see ops/solve.py _gram_compute_dtype).
     def half(fixed_local, blk):
         return half_rect(
             fixed_local, blk["neighbor"], blk["rating"], blk["mask"], blk["count"]
