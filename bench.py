@@ -161,10 +161,12 @@ def scale_main(args) -> None:
 
     if n1 > 1:
         steady_s = (train_s - short_s) / (n1 - 1) * n1
-        timing_degenerate = steady_s <= 0
+        # A delta much smaller than the fixed cost is indistinguishable
+        # from tunnel noise — rerun with more --iterations for signal.
+        timing_degenerate = steady_s <= 0 or (train_s - short_s) < 0.05 * short_s
     else:
         timing_degenerate = True
-    if timing_degenerate:
+    if steady_s <= 0 or n1 == 1:
         steady_s = train_s  # includes the fixed overhead; flagged below
     s_per_iter = steady_s / n1
     print(
