@@ -159,15 +159,15 @@ def scale_main(args) -> None:
         t_n.append(dn)
     train_s, short_s = min(t_n), min(t_1)
 
-    if n1 > 1:
-        steady_s = (train_s - short_s) / (n1 - 1) * n1
-        # A delta much smaller than the fixed cost is indistinguishable
-        # from tunnel noise — rerun with more --iterations for signal.
-        timing_degenerate = steady_s <= 0 or (train_s - short_s) < 0.05 * short_s
-    else:
-        timing_degenerate = True
-    if steady_s <= 0 or n1 == 1:
-        steady_s = train_s  # includes the fixed overhead; flagged below
+    steady_s = (train_s - short_s) / (n1 - 1) * n1 if n1 > 1 else 0.0
+    # Degenerate when the delta is indistinguishable from tunnel noise
+    # (or one iteration can't separate fixed cost at all) — rerun with more
+    # --iterations for signal.
+    timing_degenerate = (
+        n1 == 1 or steady_s <= 0 or (train_s - short_s) < 0.05 * short_s
+    )
+    if steady_s <= 0:
+        steady_s = train_s  # includes the fixed overhead; flagged above
     s_per_iter = steady_s / n1
     print(
         json.dumps(

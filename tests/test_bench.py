@@ -1,0 +1,40 @@
+"""bench.py contract: the driver parses its LAST stdout line as one JSON
+object with metric/value/unit/vs_baseline — protect that shape (and the
+scale path's argument surface) against refactors."""
+
+import argparse
+import json
+
+
+def _args(**over):
+    base = dict(
+        scale=True, full=False, ials=False, ialspp=False,
+        users=300, movies=80, nnz=2000, rank=8, iterations=2, seed=0,
+        layout="segment", dtype="bfloat16", chunk_elems=1024, repeats=1,
+        block_size=4, sweeps=1,
+    )
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+def test_scale_bench_json_contract(capsys):
+    import bench
+
+    bench.scale_main(_args())
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(line)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in d, key
+    assert d["unit"] == "s/iteration"
+    assert d["value"] >= 0
+    assert d["ratings"] == 2000
+    assert isinstance(d["timing_degenerate"], bool)
+
+
+def test_scale_bench_single_iteration_flags_degenerate(capsys):
+    import bench
+
+    bench.scale_main(_args(iterations=1))
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # one iteration cannot separate fixed overhead from iteration cost
+    assert d["timing_degenerate"] is True
