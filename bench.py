@@ -94,10 +94,13 @@ def scale_main(args) -> None:
     from cfk_tpu.data.synthetic import synthetic_netflix_coo
     from cfk_tpu.models.als import train_als
 
+    if args.alspp and (args.ials or args.ialspp):
+        raise SystemExit("--alspp is the explicit model; drop --ials/--ialspp")
     if args.ialspp:
         args.ials = True
+    if args.ialspp or args.alspp:
         if args.layout == "segment":
-            args.layout = "bucketed"  # ials++ needs padded/bucketed
+            args.layout = "bucketed"  # subspace optimizers need padded/bucketed
     if args.ials:
         # MovieLens-25M shape (BASELINE.md implicit-feedback target);
         # ratings act as interaction strengths.
@@ -131,6 +134,8 @@ def scale_main(args) -> None:
         config = ALSConfig(
             rank=args.rank, lam=0.05, num_iterations=args.iterations,
             seed=0, layout=args.layout, dtype=args.dtype,
+            algorithm="als++" if args.alspp else "als",
+            block_size=args.block_size, sweeps=args.sweeps,
         )
         trainer = train_als
     # Every trainer call pays the same fixed cost (multi-GB block upload +
@@ -194,6 +199,7 @@ def scale_main(args) -> None:
                 "rank": args.rank,
                 "layout": args.layout,
                 "dtype": args.dtype,
+                "algorithm": config.algorithm,
                 "train_wall_s": round(train_s, 3),
                 "one_iter_wall_s": round(short_s, 3),
                 # fixed per-call cost (block upload + dispatch), as implied
@@ -225,6 +231,9 @@ if __name__ == "__main__":
     parser.add_argument("--ialspp", action="store_true",
                         help="same shape via iALS++ subspace optimization "
                         "(bucketed layout, --block-size coordinate blocks)")
+    parser.add_argument("--alspp", action="store_true",
+                        help="explicit model via als++ subspace optimization "
+                        "(bucketed layout)")
     parser.add_argument("--block-size", type=int, default=32)
     parser.add_argument("--sweeps", type=int, default=1)
     parser.add_argument("--users", type=int, default=48_000)
@@ -246,7 +255,8 @@ if __name__ == "__main__":
                         "1e-4: 0.758223 bf16 vs 0.758264 f32)")
     parser.add_argument("--chunk-elems", type=int, default=1 << 20)
     cli_args = parser.parse_args()
-    if cli_args.scale or cli_args.full or cli_args.ials:
+    if (cli_args.scale or cli_args.full or cli_args.ials or cli_args.ialspp
+            or cli_args.alspp):
         scale_main(cli_args)
     else:
         main()

@@ -217,16 +217,16 @@ def _train(args) -> int:
         solve_chunk=args.solve_chunk,
         pad_multiple=args.pad_multiple,
         bucket_chunk_elems=args.chunk_elems,
+        algorithm=args.algorithm,
+        block_size=args.block_size,
+        sweeps=args.sweeps,
     )
     manager = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     ck = dict(checkpoint_manager=manager, checkpoint_every=args.checkpoint_every)
 
     with maybe_profile(args.profile_dir):
         if args.implicit:
-            config = IALSConfig(
-                alpha=args.alpha, algorithm=args.algorithm,
-                block_size=args.block_size, sweeps=args.sweeps, **common,
-            )
+            config = IALSConfig(alpha=args.alpha, **common)
             if args.shards > 1:
                 from cfk_tpu.parallel.mesh import make_mesh
 
@@ -506,15 +506,17 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--lam", type=float, default=0.05)
     t.add_argument("--alpha", type=float, default=40.0, help="iALS confidence weight")
     t.add_argument(
-        "--algorithm", choices=["als", "ials++"], default="als",
-        help="implicit solver: full k-by-k normal equations, or iALS++ "
-        "subspace block coordinate descent (Rendle et al.) — much cheaper "
-        "per epoch at large rank; padded/bucketed layouts",
+        "--algorithm", choices=["als", "als++", "ials++"], default="als",
+        help="per-entity optimizer: 'als' = full k-by-k normal-equation "
+        "solves (the reference's exact semantics); 'als++' (explicit) / "
+        "'ials++' (implicit, Rendle et al.) = warm-started subspace block "
+        "coordinate descent — much cheaper per epoch at large rank; "
+        "padded/bucketed layouts",
     )
     t.add_argument("--block-size", type=int, default=32,
-                   help="iALS++ coordinate block size (must divide rank)")
+                   help="als++/ials++ coordinate block size (must divide rank)")
     t.add_argument("--sweeps", type=int, default=1,
-                   help="iALS++ sweeps over all blocks per half-iteration")
+                   help="als++/ials++ sweeps over all blocks per half-iteration")
     t.add_argument("--iterations", type=int, default=7)
     t.add_argument("--seed", type=int, default=42)
     t.add_argument("--shards", type=int, default=1)

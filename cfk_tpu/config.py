@@ -77,6 +77,20 @@ class ALSConfig:
     # does (--chunk-elems); the chunk hints then live statically on the
     # blocks, not in this config.
     bucket_chunk_elems: int = 1 << 20
+    # Per-entity optimizer.  "als" = the reference's exact full k×k normal-
+    # equation solve every half-iteration.  "als++" = warm-started subspace
+    # block coordinate descent (the explicit-feedback analog of iALS++,
+    # cfk_tpu/ops/subspace.py): per coordinate block B solve
+    # A[B,B]δ = −g[B] with ALS-WR's λ·n·I regularization; with
+    # block_size == rank one sweep equals the full solve exactly.  Cheaper
+    # per epoch at large rank, but a different per-epoch trajectory — the
+    # reference-parity path stays "als".  padded/bucketed layouts only.
+    algorithm: str = "als"
+    block_size: int = 32
+    sweeps: int = 1
+
+    def _valid_algorithms(self) -> tuple[str, ...]:
+        return ("als", "als++")
 
     def __post_init__(self) -> None:
         if self.rank < 1:
@@ -104,3 +118,33 @@ class ALSConfig:
                 "time via Dataset.from_coo(..., chunk_elems=...) "
                 "(config.bucket_chunk_elems / --chunk-elems)"
             )
+        if self.algorithm not in self._valid_algorithms():
+            raise ValueError(
+                f"unknown algorithm {self.algorithm!r} for "
+                f"{type(self).__name__}; valid: {self._valid_algorithms()}"
+            )
+        if self.algorithm != "als":
+            if self.layout == "segment":
+                raise ValueError(
+                    f"{self.algorithm} supports the padded and bucketed "
+                    "layouts (bucketed is the at-scale one); the segment "
+                    "layout's chunk-straddling entities would need "
+                    "cross-chunk score updates — use layout='bucketed'"
+                )
+            if self.rank % self.block_size != 0:
+                raise ValueError(
+                    f"rank {self.rank} not divisible by block_size "
+                    f"{self.block_size}"
+                )
+            if self.sweeps < 1:
+                raise ValueError(f"sweeps must be >= 1, got {self.sweeps}")
+            if self.exchange != "all_gather":
+                raise ValueError(
+                    f"{self.algorithm} supports exchange='all_gather' only"
+                )
+            if self.solve_chunk is not None:
+                raise ValueError(
+                    f"solve_chunk is not honored by {self.algorithm} (the "
+                    "subspace sweep has no entity-chunked padded path); use "
+                    "layout='bucketed' with chunk_elems to bound HBM"
+                )

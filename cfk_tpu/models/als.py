@@ -134,10 +134,29 @@ def _segment_device_setup(dataset: Dataset):
     return _segment_to_device(mb), _segment_to_device(ub), u_stats, layout_kw
 
 
-def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None):
+def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
+          x_prev=None, algorithm="als", block_size=32, sweeps=1):
     """Solve one side against fixed factors; dispatches on the block layout
     (tuple = width buckets, dict with segment ids = flat segment run,
-    other dict = one padded rectangle)."""
+    other dict = one padded rectangle).  ``algorithm="als++"`` runs
+    warm-started subspace sweeps from ``x_prev`` instead of full solves
+    (padded/bucketed layouts)."""
+    if algorithm == "als++":
+        from cfk_tpu.ops.subspace import (
+            als_pp_half_step,
+            als_pp_half_step_bucketed,
+        )
+
+        if isinstance(blk, tuple):
+            return als_pp_half_step_bucketed(
+                fixed, x_prev, blk, chunks, entities, lam,
+                block_size=block_size, sweeps=sweeps, solver=solver,
+            )
+        return als_pp_half_step(
+            fixed, x_prev, blk["neighbor_idx"], blk["rating"], blk["mask"],
+            blk["count"], lam,
+            block_size=block_size, sweeps=sweeps, solver=solver,
+        )
     if isinstance(blk, tuple):
         return als_half_step_bucketed(
             fixed, blk, chunks, entities, lam, solver=solver
@@ -172,12 +191,13 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None):
 
 
 _LAYOUT_STATICS = ("m_chunks", "u_chunks", "m_entities", "u_entities")
+_ALG_STATICS = ("algorithm", "block_size", "sweeps")
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype", "solver")
-    + _LAYOUT_STATICS,
+    + _LAYOUT_STATICS + _ALG_STATICS,
 )
 def _train_loop(
     key: jax.Array,
@@ -191,6 +211,9 @@ def _train_loop(
     solve_chunk: int | None,
     dtype: str = "float32",
     solver: str = "cholesky",
+    algorithm: str = "als",
+    block_size: int = 32,
+    sweeps: int = 1,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -209,10 +232,12 @@ def _train_loop(
     m0 = jnp.zeros((m_rows, rank), dtype=dt)
 
     def one_iteration(_, carry):
-        u, _ = carry
+        u, m_prev = carry
         return _iteration_body(
             u, movie_blocks, user_blocks,
             lam=lam, solve_chunk=solve_chunk, dt=dt, solver=solver,
+            algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+            m_prev=m_prev,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -224,32 +249,38 @@ def _train_loop(
 
 
 def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
-                    solver="cholesky", m_chunks=None, u_chunks=None,
+                    solver="cholesky", algorithm="als", block_size=32,
+                    sweeps=1, m_prev=None, m_chunks=None, u_chunks=None,
                     m_entities=None, u_entities=None):
     """One full iteration (solve M from U, then U from M) — the single source
     of the per-iteration math for both the fused-loop and checkpointed paths.
 
-    Factors are stored in ``dt`` (bfloat16 halves HBM traffic); the Gram
-    accumulation upcasts to float32 inside gather_gram.
+    Factors are stored in ``dt`` (bfloat16 halves HBM traffic); Gram
+    contractions accumulate float32 inside the half-step kernels.
+    ``algorithm="als++"`` warm-starts each side from its previous factors
+    (``m_prev`` / the ``u`` carry) with subspace sweeps.
     """
+    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps)
     m = _half(
         u, movie_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
-        chunks=m_chunks, entities=m_entities,
+        chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
     ).astype(dt)
     u_new = _half(
         m, user_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
-        chunks=u_chunks, entities=u_entities,
+        chunks=u_chunks, entities=u_entities, x_prev=u, **alg,
     ).astype(dt)
     return u_new, m
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("lam", "solve_chunk", "dtype", "solver") + _LAYOUT_STATICS,
-    donate_argnums=(0,),
+    static_argnames=("lam", "solve_chunk", "dtype", "solver")
+    + _LAYOUT_STATICS + _ALG_STATICS,
+    donate_argnums=(0, 1),
 )
 def _one_iteration(
     u: jax.Array,
+    m_prev: jax.Array,
     movie_blocks,
     user_blocks,
     *,
@@ -257,6 +288,9 @@ def _one_iteration(
     solve_chunk: int | None,
     dtype: str,
     solver: str = "cholesky",
+    algorithm: str = "als",
+    block_size: int = 32,
+    sweeps: int = 1,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -265,6 +299,8 @@ def _one_iteration(
     return _iteration_body(
         u, movie_blocks, user_blocks,
         lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype), solver=solver,
+        algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+        m_prev=m_prev,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -318,6 +354,9 @@ def train_als(
                 solve_chunk=config.solve_chunk,
                 dtype=config.dtype,
                 solver=config.solver,
+                algorithm=config.algorithm,
+                block_size=config.block_size,
+                sweeps=config.sweeps,
                 **layout_kw,
             )
             u.block_until_ready()
@@ -351,9 +390,11 @@ def train_als(
         for i in range(start_iter, config.num_iterations):
             with metrics.phase("train"):
                 u, m = _one_iteration(
-                    u, mblocks, ublocks,
+                    u, m, mblocks, ublocks,
                     lam=config.lam, solve_chunk=config.solve_chunk,
                     dtype=config.dtype, solver=config.solver,
+                    algorithm=config.algorithm, block_size=config.block_size,
+                    sweeps=config.sweeps,
                     **layout_kw,
                 )
                 u.block_until_ready()

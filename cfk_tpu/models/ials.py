@@ -53,9 +53,9 @@ class IALSConfig(ALSConfig):
 
     alpha: float = 40.0
     lam: float = 0.1
-    algorithm: str = "als"  # "als" (full k×k solves) | "ials++"
-    block_size: int = 32
-    sweeps: int = 1
+
+    def _valid_algorithms(self) -> tuple[str, ...]:
+        return ("als", "ials++")
 
     def __post_init__(self) -> None:
         super().__post_init__()
@@ -66,23 +66,6 @@ class IALSConfig(ALSConfig):
                 "iALS currently supports exchange='all_gather' only (the "
                 "global-Gram trick needs the full fixed side per shard)"
             )
-        if self.algorithm not in ("als", "ials++"):
-            raise ValueError(f"unknown iALS algorithm {self.algorithm!r}")
-        if self.algorithm == "ials++":
-            if self.layout == "segment":
-                raise ValueError(
-                    "ials++ supports the padded and bucketed layouts "
-                    "(bucketed is the at-scale one); the segment layout's "
-                    "chunk-straddling entities would need cross-chunk score "
-                    "updates — use layout='bucketed'"
-                )
-            if self.rank % self.block_size != 0:
-                raise ValueError(
-                    f"rank {self.rank} not divisible by block_size "
-                    f"{self.block_size}"
-                )
-            if self.sweeps < 1:
-                raise ValueError(f"sweeps must be >= 1, got {self.sweeps}")
 
 
 def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,

@@ -1,9 +1,12 @@
-"""iALS++ subspace optimization — block coordinate descent for implicit ALS.
+"""Subspace optimization — block coordinate descent for both ALS families.
 
 Implements the optimizer of Rendle et al., "iALS++: Speeding up Matrix
-Factorization with Subspace Optimization" (PAPERS.md): instead of solving the
-full k×k normal equations per entity per epoch (O(nnz·k² + E·k³)), sweep over
-coordinate blocks of size b, solving a b×b subsystem per entity per block
+Factorization with Subspace Optimization" (PAPERS.md), plus its direct
+explicit-feedback analog for the flagship ALS-WR model (same block
+coordinate descent on each entity's quadratic, with λ·n·I regularization
+and no global-Gram term): instead of solving the full k×k normal equations
+per entity per epoch (O(nnz·k² + E·k³)), sweep over coordinate blocks of
+size b, solving a b×b subsystem per entity per block
 (O(nnz·k + nnz·k·b + E·k·b²) per sweep).  At rank 128 with b=32 this is the
 difference between a 2M-FLOP and a 130K-FLOP solve per entity, and the Gram
 work drops by k/b — the big-k regime (the BASELINE.md MovieLens-25M rank-128
@@ -49,19 +52,37 @@ def _sweep_rect(
     mask: jax.Array,  # [E, P] 1 = real
     lam: float,
     alpha: float,
-    gram: jax.Array,  # [k, k] YᵀY over the FULL fixed side
+    gram: jax.Array | None,  # [k, k] YᵀY over the FULL fixed side (implicit)
     block_size: int,
     solver: str,
+    count: jax.Array | None = None,  # [E] rating counts (explicit: λ·n·I reg)
 ) -> jax.Array:
-    """One full sweep over all k/block_size coordinate blocks of a rectangle."""
+    """One full sweep over all k/block_size coordinate blocks of a rectangle.
+
+    Implicit mode (``gram`` given): per entity A = G + Σ(c−1)ffᵀ + λI,
+    b = Σ c·f with c = 1 + α·r.  Explicit mode (``count`` given): ALS-WR's
+    A = Σ ffᵀ + λ·n·I, b = Σ r·f — no global-Gram term (unobserved cells
+    don't enter the explicit objective).  Either way the block update is
+    A[B,B]δ = −g[B], g = A·x − b, with the per-interaction scores s = fᵀx
+    computed once and rank-b updated after every block.
+    """
+    implicit = gram is not None
+    if implicit == (count is not None):
+        raise ValueError("exactly one of gram (implicit) / count (explicit)")
     k = x.shape[-1]
     if k % block_size != 0:
         raise ValueError(f"rank {k} not divisible by block_size {block_size}")
     f32 = jnp.float32
     x = x.astype(f32)
-    conf_m1 = (alpha * rating * mask).astype(f32)  # c−1 at observed, 0 at pad
-    c_obs = conf_m1 + mask.astype(f32)  # c at observed, 0 at pad
-    gathered = fixed[neighbor_idx].astype(f32) * mask[..., None]
+    maskf = mask.astype(f32)
+    gathered = fixed[neighbor_idx].astype(f32) * maskf[..., None]
+    if implicit:
+        conf_m1 = (alpha * rating).astype(f32) * maskf  # c−1 obs, 0 pad
+        c_obs = conf_m1 + maskf  # c at observed, 0 at pad
+    else:
+        # ALS-WR weighted ridge: λ·n per entity, floored at λ·1 for
+        # all-padding rows (same floor as regularized_solve).
+        reg_n = lam * jnp.maximum(count.astype(f32), 1.0)  # [E]
     # Scores s = fᵀx per interaction — once per sweep, then rank-b updates.
     s = jnp.einsum(
         "epk,ek->ep", gathered, x,
@@ -71,25 +92,114 @@ def _sweep_rect(
     for j in range(k // block_size):
         cols = slice(j * block_size, (j + 1) * block_size)
         f_b = gathered[:, :, cols]  # [E, P, b]
-        w = conf_m1 * s - c_obs  # [E, P]; pad entries are exactly 0
-        g_b = (
-            jnp.einsum("ek,kb->eb", x, gram[:, cols],
-                       preferred_element_type=f32, precision="highest")
-            + lam * x[:, cols]
-            + jnp.einsum("epb,ep->eb", f_b, w,
-                         preferred_element_type=f32, precision="highest")
-        )
-        a_bb = (
-            gram[cols, cols]
-            + lam * eye_b
-            + jnp.einsum("ep,epb,epc->ebc", conf_m1, f_b, f_b,
-                         preferred_element_type=f32, precision="highest")
-        )
+        if implicit:
+            w = conf_m1 * s - c_obs  # [E, P]; pad entries are exactly 0
+            g_b = (
+                jnp.einsum("ek,kb->eb", x, gram[:, cols],
+                           preferred_element_type=f32, precision="highest")
+                + lam * x[:, cols]
+                + jnp.einsum("epb,ep->eb", f_b, w,
+                             preferred_element_type=f32, precision="highest")
+            )
+            a_bb = (
+                gram[cols, cols]
+                + lam * eye_b
+                + jnp.einsum("ep,epb,epc->ebc", conf_m1, f_b, f_b,
+                             preferred_element_type=f32, precision="highest")
+            )
+        else:
+            w = (s - rating.astype(f32)) * maskf  # residual at observed
+            g_b = (
+                reg_n[:, None] * x[:, cols]
+                + jnp.einsum("epb,ep->eb", f_b, w,
+                             preferred_element_type=f32, precision="highest")
+            )
+            a_bb = (
+                reg_n[:, None, None] * eye_b
+                + jnp.einsum("epb,epc->ebc", f_b, f_b,
+                             preferred_element_type=f32, precision="highest")
+            )
         delta = dispatch_spd_solve(a_bb, -g_b, solver)
         x = x.at[:, cols].add(delta)
         s = s + jnp.einsum("epb,eb->ep", f_b, delta,
                            preferred_element_type=f32, precision="highest")
     return x
+
+
+def als_pp_half_step(
+    fixed: jax.Array,  # [F, k]
+    x_prev: jax.Array,  # [E, k] previous own-side factors (warm start)
+    neighbor_idx: jax.Array,
+    rating: jax.Array,
+    mask: jax.Array,
+    count: jax.Array,  # [E] rating counts (ALS-WR λ·n·I)
+    lam: float,
+    *,
+    block_size: int = 32,
+    sweeps: int = 1,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """Explicit ALS-WR half-iteration by subspace sweeps (padded layout)."""
+    for _ in range(sweeps):
+        x_prev = _sweep_rect(
+            fixed, x_prev, neighbor_idx, rating, mask, lam, 0.0, None,
+            block_size, solver, count=count,
+        )
+    return x_prev
+
+
+def _warm_bucket_walk(
+    k, x_prev, buckets, chunk_rows, local_entities, bucket_keys, sweep_piece
+):
+    """Warm-started bucket scatter shared by both families' bucketed sweeps.
+
+    Seeds the output (with the trash row) from ``x_prev``, walks every
+    bucket extracting the current factor rows plus ``bucket_keys`` arrays,
+    runs ``sweep_piece`` on each piece, and scatters back.  Entities in no
+    bucket (zero interactions) keep their previous value — the warm-started
+    fixpoint for them is 0 and both trainers start them at 0.
+    """
+    from cfk_tpu.ops.solve import walk_buckets
+
+    out = jnp.zeros((local_entities + 1, k), jnp.float32)
+    n = min(x_prev.shape[0], local_entities)
+    out = out.at[:n].set(x_prev[:n].astype(jnp.float32))
+    out = walk_buckets(
+        buckets, chunk_rows,
+        lambda blk, cur: (cur[blk["entity_local"]],)
+        + tuple(blk[key] for key in bucket_keys),
+        sweep_piece,
+        out,
+    )
+    return out[:local_entities]
+
+
+def als_pp_half_step_bucketed(
+    fixed: jax.Array,  # [F, k]
+    x_prev: jax.Array,  # [local_entities, k]
+    buckets,  # sequence of dicts {neighbor, rating, mask, count, entity_local}
+    chunk_rows,
+    local_entities: int,
+    lam: float,
+    *,
+    block_size: int = 32,
+    sweeps: int = 1,
+    solver: str = "cholesky",
+) -> jax.Array:
+    """Explicit ALS-WR half-iteration by subspace sweeps over width buckets."""
+
+    def sweep_piece(xb, ni, rt, mk, cnt):
+        for _ in range(sweeps):
+            xb = _sweep_rect(
+                fixed, xb, ni, rt, mk, lam, 0.0, None, block_size, solver,
+                count=cnt,
+            )
+        return xb
+
+    return _warm_bucket_walk(
+        fixed.shape[-1], x_prev, buckets, chunk_rows, local_entities,
+        ("neighbor", "rating", "mask", "count"), sweep_piece,
+    )
 
 
 def ials_pp_half_step(
@@ -137,20 +247,13 @@ def ials_pp_half_step_bucketed(
 
     Buckets partition the entities (each rated entity lives in exactly one
     bucket), so the sweep runs independently per bucket rectangle and
-    scatters back.  Entities in no bucket (zero interactions) keep their
-    previous value — matching the warm-started full-iALS fixpoint, which
-    drives such rows to 0 and our inits already start them at 0.
-    ``chunk_rows`` streams oversized buckets through HBM like the plain
-    bucketed half-step does.
+    scatters back; ``chunk_rows`` streams oversized buckets through HBM like
+    the plain bucketed half-step does.
     """
-    from cfk_tpu.ops.solve import global_gram, walk_buckets
+    from cfk_tpu.ops.solve import global_gram
 
     if gram is None:
         gram = global_gram(fixed)
-    k = fixed.shape[-1]
-    out = jnp.zeros((local_entities + 1, k), jnp.float32)
-    n = min(x_prev.shape[0], local_entities)
-    out = out.at[:n].set(x_prev[:n].astype(jnp.float32))
 
     def sweep_piece(xb, ni, rt, mk):
         for _ in range(sweeps):
@@ -159,13 +262,7 @@ def ials_pp_half_step_bucketed(
             )
         return xb
 
-    out = walk_buckets(
-        buckets, chunk_rows,
-        lambda blk, cur: (
-            cur[blk["entity_local"]], blk["neighbor"], blk["rating"],
-            blk["mask"],
-        ),
-        sweep_piece,
-        out,
+    return _warm_bucket_walk(
+        fixed.shape[-1], x_prev, buckets, chunk_rows, local_entities,
+        ("neighbor", "rating", "mask"), sweep_piece,
     )
-    return out[:local_entities]

@@ -316,6 +316,43 @@ def make_training_step(
     if uspecs is None:
         uspecs = mspecs
 
+    if config.algorithm == "als++":
+        from cfk_tpu.ops.subspace import (
+            als_pp_half_step,
+            als_pp_half_step_bucketed,
+        )
+
+        alg = dict(block_size=config.block_size, sweeps=config.sweeps,
+                   solver=config.solver)
+
+        if m_chunks is not None:  # bucketed layout
+
+            def pp_bkt(chunks, local):
+                def solve(fixed_full, prev_local, blk, _gram):
+                    return als_pp_half_step_bucketed(
+                        fixed_full, prev_local, blk, chunks, local,
+                        config.lam, **alg,
+                    )
+
+                return solve
+
+            return wrap_step(
+                mesh, config,
+                gathered_half(pp_bkt(m_chunks, m_local), with_prev=True),
+                gathered_half(pp_bkt(u_chunks, u_local), with_prev=True),
+                mspecs, uspecs, carry_prev=True,
+            )
+
+        def pp_padded(fixed_full, prev_local, blk, _gram):
+            return als_pp_half_step(
+                fixed_full, prev_local, blk["neighbor"], blk["rating"],
+                blk["mask"], blk["count"], config.lam, **alg,
+            )
+
+        half = gathered_half(pp_padded, with_prev=True)
+        return wrap_step(mesh, config, half, half, mspecs, uspecs,
+                         carry_prev=True)
+
     if segment:  # flat segment layout, all_gather exchange
 
         def seg_solve(statics, local):

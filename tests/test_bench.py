@@ -8,7 +8,7 @@ import json
 
 def _args(**over):
     base = dict(
-        scale=True, full=False, ials=False, ialspp=False,
+        scale=True, full=False, ials=False, ialspp=False, alspp=False,
         users=300, movies=80, nnz=2000, rank=8, iterations=2, seed=0,
         layout="segment", dtype="bfloat16", chunk_elems=1024, repeats=1,
         block_size=4, sweeps=1,

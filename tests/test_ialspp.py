@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from cfk_tpu.config import ALSConfig
 from cfk_tpu.data.blocks import Dataset, RatingsCOO
 from cfk_tpu.models.ials import IALSConfig, train_ials
 from cfk_tpu.ops.solve import ials_half_step
@@ -157,3 +158,85 @@ def test_config_validation():
         IALSConfig(rank=16, algorithm="ials++", block_size=4, sweeps=0)
     with pytest.raises(ValueError, match="algorithm"):
         IALSConfig(rank=16, algorithm="bogus")
+    # family-specific algorithm names don't cross over
+    with pytest.raises(ValueError, match="algorithm"):
+        ALSConfig(rank=16, algorithm="ials++")
+    with pytest.raises(ValueError, match="algorithm"):
+        IALSConfig(rank=16, algorithm="als++")
+
+
+# ---- explicit-feedback als++ ------------------------------------------------
+
+
+def test_explicit_full_block_is_exact_full_solve():
+    from cfk_tpu.ops.solve import als_half_step
+    from cfk_tpu.ops.subspace import als_pp_half_step
+
+    fixed, nb, rt, mask, x0 = _rect()
+    cnt = mask.sum(axis=1).astype(jnp.int32)
+    full = als_half_step(fixed, nb, rt, mask, cnt, 0.05)
+    pp = als_pp_half_step(
+        fixed, x0, nb, rt, mask, cnt, 0.05, block_size=x0.shape[1], sweeps=1
+    )
+    np.testing.assert_allclose(np.asarray(pp), np.asarray(full), atol=2e-4)
+
+
+@pytest.mark.parametrize("layout", ["padded", "bucketed"])
+def test_explicit_training_mse_tracks_full_als(layout):
+    from cfk_tpu.config import ALSConfig as C
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.models.als import train_als
+
+    ds = Dataset.from_coo(_implicit_coo(), layout=layout)  # ratings 1..5
+    base = dict(rank=16, lam=0.05, num_iterations=10, seed=0, layout=layout)
+    mse_full, _ = mse_rmse_from_blocks(
+        train_als(ds, C(**base)).predict_dense(), ds
+    )
+    mse_pp, _ = mse_rmse_from_blocks(
+        train_als(
+            ds, C(algorithm="als++", block_size=4, sweeps=2, **base)
+        ).predict_dense(),
+        ds,
+    )
+    # warm-started subspace epochs land near the full solver's training MSE
+    assert mse_pp < mse_full * 1.3 + 1e-3, (mse_full, mse_pp)
+
+
+def test_explicit_sharded_matches_single_device():
+    from cfk_tpu.config import ALSConfig as C
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = _implicit_coo(seed=7, n_m=60, n_u=90, nnz=1200)
+    kw = dict(rank=8, lam=0.05, num_iterations=3, seed=0, layout="bucketed",
+              algorithm="als++", block_size=2, sweeps=2)
+    ref = train_als(
+        Dataset.from_coo(coo, num_shards=1, layout="bucketed"), C(**kw)
+    ).predict_dense()
+    got = train_als_sharded(
+        Dataset.from_coo(coo, num_shards=4, layout="bucketed"),
+        C(num_shards=4, **kw),
+        make_mesh(4),
+    ).predict_dense()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_explicit_checkpointed_path_matches_fused(tmp_path):
+    """The Python-stepped (checkpointing) loop and the fused fori_loop agree
+    for als++ — the m_prev threading must be identical in both."""
+    from cfk_tpu.config import ALSConfig as C
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    ds = Dataset.from_coo(_implicit_coo(seed=9, n_m=50, n_u=70, nnz=900))
+    cfg = C(rank=8, lam=0.05, num_iterations=4, seed=0,
+            algorithm="als++", block_size=2, sweeps=1)
+    fused = train_als(ds, cfg)
+    stepped = train_als(
+        ds, cfg, checkpoint_manager=CheckpointManager(str(tmp_path / "ck"))
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused.user_factors), np.asarray(stepped.user_factors),
+        atol=1e-5,
+    )
