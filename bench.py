@@ -255,8 +255,20 @@ if __name__ == "__main__":
                         "1e-4: 0.758223 bf16 vs 0.758264 f32)")
     parser.add_argument("--chunk-elems", type=int, default=1 << 20)
     cli_args = parser.parse_args()
-    if (cli_args.scale or cli_args.full or cli_args.ials or cli_args.ialspp
-            or cli_args.alspp):
-        scale_main(cli_args)
-    else:
-        main()
+    run = (
+        (lambda: scale_main(cli_args))
+        if (cli_args.scale or cli_args.full or cli_args.ials
+            or cli_args.ialspp or cli_args.alspp)
+        else main
+    )
+    try:
+        run()
+    except Exception as e:  # pragma: no cover - needs a flaky device
+        # The axon tunnel throws transient UNAVAILABLE "TPU device error"s
+        # unrelated to the program; one retry distinguishes those from real
+        # failures so a single blip doesn't void the recorded benchmark.
+        if "UNAVAILABLE" not in str(e):
+            raise
+        import sys
+        print(f"transient device error, retrying once: {e}", file=sys.stderr)
+        run()

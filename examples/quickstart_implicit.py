@@ -1,0 +1,53 @@
+"""Implicit-feedback iALS with ranking evaluation, plus the iALS++ optimizer.
+
+Treats ratings as interaction strengths (Hu et al. 2008 confidence
+weighting), holds one interaction per user out, and reports Recall@10 and
+mean percentile rank — the evaluation protocol explicit MSE can't provide.
+Then retrains with the iALS++ subspace optimizer (same API, ~5× cheaper per
+epoch at large rank).
+
+    python examples/quickstart_implicit.py [RATINGS_FILE]
+"""
+
+import dataclasses
+import sys
+
+from cfk_tpu.data.blocks import Dataset
+from cfk_tpu.data.netflix import parse_netflix
+from cfk_tpu.eval.ranking import (
+    leave_one_out_split,
+    mean_percentile_rank,
+    recall_at_k,
+)
+from cfk_tpu.models.ials import IALSConfig, train_ials
+
+
+def evaluate(model, train_coo, heldout):
+    scores = model.predict_dense()
+    return (
+        recall_at_k(scores, train_coo, heldout, k=10),
+        mean_percentile_rank(scores, train_coo, heldout),
+    )
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else (
+        "/root/reference/data/data_sample_tiny.txt"
+    )
+    dcoo = Dataset.from_coo(parse_netflix(path)).coo_dense
+    train_coo, heldout = leave_one_out_split(
+        dcoo.movie_raw, dcoo.user_raw, dcoo.rating, seed=0
+    )
+    dataset = Dataset.from_coo(train_coo)
+
+    config = IALSConfig(rank=16, lam=0.1, alpha=2.0, num_iterations=8, seed=0)
+    recall, mpr = evaluate(train_ials(dataset, config), train_coo, heldout)
+    print(f"iALS   : Recall@10={recall:.3f}  MPR={mpr:.3f}")
+
+    pp = dataclasses.replace(config, algorithm="ials++", block_size=4)
+    recall, mpr = evaluate(train_ials(dataset, pp), train_coo, heldout)
+    print(f"iALS++ : Recall@10={recall:.3f}  MPR={mpr:.3f}")
+
+
+if __name__ == "__main__":
+    main()

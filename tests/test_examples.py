@@ -1,0 +1,34 @@
+"""The examples/ scripts must actually run — they are user-facing API drives.
+
+Executed in-process (the conftest already forces the 8-virtual-device CPU
+platform) on the tiny reference sample.
+"""
+
+import runpy
+import sys
+
+
+def _run(path, argv):
+    old = sys.argv
+    sys.argv = argv
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+def test_quickstart_explicit(capsys):
+    _run("examples/quickstart_explicit.py", ["quickstart_explicit.py"])
+    out = capsys.readouterr().out
+    assert "RMSE=" in out and "top-5 for user" in out
+
+
+def test_quickstart_implicit(capsys):
+    _run("examples/quickstart_implicit.py", ["quickstart_implicit.py"])
+    out = capsys.readouterr().out
+    assert "iALS   :" in out and "iALS++ :" in out
+
+
+def test_sharded_training(capsys):
+    _run("examples/sharded_training.py", ["sharded_training.py"])
+    assert "resumed from" in capsys.readouterr().out
