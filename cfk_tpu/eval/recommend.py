@@ -90,12 +90,20 @@ def recommend_top_k(
         )
     if not 1 <= k <= model.num_movies:
         raise ValueError(f"k must be in [1, {model.num_movies}], got {k}")
-    m = model.movie_factors[: model.num_movies]
+    user_factors, movie_factors = model.user_factors, model.movie_factors
+    if not getattr(user_factors, "is_fully_addressable", True):
+        # Multi-process sharded factors can't be indexed from one controller;
+        # gather once (small [E, k] matrices) and serve from host copies.
+        from cfk_tpu.parallel.mesh import to_host
+
+        user_factors = to_host(user_factors)
+        movie_factors = to_host(movie_factors)
+    m = movie_factors[: model.num_movies]
     out_scores = np.empty((user_rows.shape[0], k), dtype=np.float32)
     out_movies = np.empty((user_rows.shape[0], k), dtype=np.int32)
     for lo in range(0, user_rows.shape[0], chunk):
         rows = user_rows[lo : lo + chunk]
-        u = model.user_factors[rows]  # numpy or jax factors both index fine
+        u = user_factors[rows]  # numpy or jax factors both index fine
         if dataset is not None:
             seen_idx, seen_mask = _seen_lists(rows, dataset, model.num_movies)
         else:

@@ -44,9 +44,15 @@ class ALSModel:
     num_movies: int
 
     def predict_dense(self) -> np.ndarray:
-        """Dense prediction matrix P = U·Mᵀ, [num_users, num_movies]."""
-        u = np.asarray(self.user_factors[: self.num_users], dtype=np.float32)
-        m = np.asarray(self.movie_factors[: self.num_movies], dtype=np.float32)
+        """Dense prediction matrix P = U·Mᵀ, [num_users, num_movies].
+
+        Works under multi-process JAX too: non-addressable sharded factors
+        are process_allgather'd so every host computes the same matrix.
+        """
+        from cfk_tpu.parallel.mesh import to_host
+
+        u = to_host(self.user_factors)[: self.num_users].astype(np.float32)
+        m = to_host(self.movie_factors)[: self.num_movies].astype(np.float32)
         return u @ m.T
 
     def recommend_top_k(self, user_rows, k: int = 10, *, dataset=None,

@@ -37,7 +37,7 @@ from cfk_tpu.ops.solve import (
     init_factors,
     init_factors_stats,
 )
-from cfk_tpu.parallel.mesh import AXIS, shard_rows
+from cfk_tpu.parallel.mesh import AXIS, shard_rows, to_host
 
 
 @dataclasses.dataclass(frozen=True)
@@ -329,7 +329,7 @@ def train_ials_sharded(
 
     metrics = metrics if metrics is not None else Metrics()
     from cfk_tpu.parallel.spmd import validate_sharded_dataset
-    from cfk_tpu.transport.checkpoint import resume_state, should_save
+    from cfk_tpu.transport.checkpoint import resume_state_synced, should_save
 
     validate_sharded_dataset(dataset, config, mesh)
 
@@ -356,11 +356,13 @@ def train_ials_sharded(
         utree = shard_rows(mesh, to_tree(dataset.user_blocks))
 
     dt = jnp.dtype(config.dtype)
-    state = resume_state(
+    state = resume_state_synced(
         checkpoint_manager,
         rank=config.rank,
         model="ials",
         num_iterations=config.num_iterations,
+        u_shape=(dataset.user_blocks.padded_entities, config.rank),
+        m_shape=(dataset.movie_blocks.padded_entities, config.rank),
     )
     if state is not None:
         start_iter = state.iteration
@@ -402,10 +404,12 @@ def train_ials_sharded(
             done, checkpoint_every, config.num_iterations
         ):
             with metrics.phase("checkpoint"):
-                checkpoint_manager.save(
-                    done, np.asarray(u), np.asarray(m),
-                    meta={"rank": config.rank, "model": "ials"},
-                )
+                uh, mh = to_host(u), to_host(m)
+                if jax.process_index() == 0:
+                    checkpoint_manager.save(
+                        done, uh, mh,
+                        meta={"rank": config.rank, "model": "ials"},
+                    )
             metrics.incr("checkpoints")
 
     return ALSModel(

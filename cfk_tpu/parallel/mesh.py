@@ -117,12 +117,34 @@ def shard_rows_global(mesh: Mesh, tree):
 
 
 def shard_rows(mesh: Mesh, tree):
-    """Place a pytree of arrays with axis 0 sharded over the mesh."""
+    """Place a pytree of arrays with axis 0 sharded over the mesh.
+
+    Under multi-process JAX (``jax.process_count() > 1``) a single-controller
+    ``device_put`` cannot address remote hosts' devices, so this routes to
+    ``shard_rows_global`` — every trainer call site stays topology-agnostic.
+    """
+    if jax.process_count() > 1:
+        return shard_rows_global(mesh, tree)
+
     def put(x):
-        spec = P(AXIS, *([None] * (x.ndim - 1)))
+        spec = P(AXIS, *([None] * (np.ndim(x) - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, tree)
+
+
+def to_host(x) -> np.ndarray:
+    """Fetch an array to host numpy, gathering across processes if needed.
+
+    Single-process (or fully-addressable) arrays fetch directly; a
+    multi-process row-sharded global array is ``process_allgather``'d so
+    every host returns the same full matrix (factors are small — [E, k]).
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 def replicated(mesh: Mesh, tree):

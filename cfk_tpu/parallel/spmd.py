@@ -57,7 +57,7 @@ from cfk_tpu.ops.solve import (
     init_factors_stats,
     regularized_solve,
 )
-from cfk_tpu.parallel.mesh import AXIS, shard_rows
+from cfk_tpu.parallel.mesh import AXIS, shard_rows, to_host
 
 
 def _to_varying(x, axis):
@@ -490,14 +490,16 @@ def train_als_sharded(
     mtree = shard_rows(mesh, mtree)
     utree = shard_rows(mesh, utree)
 
-    from cfk_tpu.transport.checkpoint import resume_state, should_save
+    from cfk_tpu.transport.checkpoint import resume_state_synced, should_save
 
     dtype = jnp.dtype(config.dtype)
-    state = resume_state(
+    state = resume_state_synced(
         checkpoint_manager,
         rank=config.rank,
         model="als",
         num_iterations=config.num_iterations,
+        u_shape=(dataset.user_blocks.padded_entities, config.rank),
+        m_shape=(dataset.movie_blocks.padded_entities, config.rank),
     )
     if state is not None:
         start_iter = state.iteration
@@ -523,10 +525,10 @@ def train_als_sharded(
                 jnp.asarray(dataset.user_blocks.count),
                 rank=config.rank,
             ).astype(dtype)
-        u = jax.device_put(u, NamedSharding(mesh, P(AXIS, None)))
-        m = jax.device_put(
+        u = shard_rows(mesh, u)
+        m = shard_rows(
+            mesh,
             np.zeros((dataset.movie_blocks.padded_entities, config.rank), dtype),
-            NamedSharding(mesh, P(AXIS, None)),
         )
 
     from cfk_tpu.utils.metrics import Metrics
@@ -548,16 +550,20 @@ def train_als_sharded(
             done, checkpoint_every, config.num_iterations
         ):
             with metrics.phase("checkpoint"):
-                checkpoint_manager.save(
-                    done,
-                    np.asarray(u),
-                    np.asarray(m),
-                    meta={
-                        "rank": config.rank,
-                        "exchange": config.exchange,
-                        "model": "als",
-                    },
-                )
+                # Multi-process: every host gathers (cheap, factors are
+                # [E, k]) but only process 0 writes the checkpoint dir.
+                uh, mh = to_host(u), to_host(m)
+                if jax.process_index() == 0:
+                    checkpoint_manager.save(
+                        done,
+                        uh,
+                        mh,
+                        meta={
+                            "rank": config.rank,
+                            "exchange": config.exchange,
+                            "model": "als",
+                        },
+                    )
             metrics.incr("checkpoints")
 
     return ALSModel(

@@ -61,6 +61,58 @@ def resume_state(
     return state
 
 
+def resume_state_synced(
+    manager: "CheckpointManager | None",
+    *,
+    rank: int,
+    model: str,
+    num_iterations: int,
+    u_shape: tuple[int, int],
+    m_shape: tuple[int, int],
+) -> "CheckpointState | None":
+    """``resume_state`` with the decision broadcast from process 0.
+
+    Under multi-process JAX, checkpoints are written by process 0 only; if
+    hosts do not share a filesystem, the other processes would see no state
+    (or a stale one) and start at a different iteration — their collectives
+    would then no longer pair up across hosts (distributed deadlock).  This
+    broadcasts process 0's (iteration, factors) so every process resumes in
+    lockstep; single-process, it is exactly ``resume_state``.
+    """
+    import jax
+
+    state = resume_state(
+        manager, rank=rank, model=model, num_iterations=num_iterations
+    )
+    if jax.process_count() == 1:
+        return state
+    from jax.experimental import multihost_utils as mh
+
+    it = int(
+        mh.broadcast_one_to_all(
+            np.asarray(state.iteration if state is not None else -1, np.int32)
+        )
+    )
+    if it < 0:
+        return None
+    u = (
+        state.user_factors.astype(np.float32)
+        if state is not None
+        else np.zeros(u_shape, np.float32)
+    )
+    m = (
+        state.movie_factors.astype(np.float32)
+        if state is not None
+        else np.zeros(m_shape, np.float32)
+    )
+    return CheckpointState(
+        iteration=it,
+        user_factors=np.asarray(mh.broadcast_one_to_all(u)),
+        movie_factors=np.asarray(mh.broadcast_one_to_all(m)),
+        meta=state.meta if state is not None else {"model": model},
+    )
+
+
 def should_save(done: int, every: int, total: int) -> bool:
     """Save cadence: every ``every`` completed iterations, and always at the end."""
     if every < 1:

@@ -1,0 +1,60 @@
+"""Worker process for the multi-host integration test (not a pytest module).
+
+Usage: python tests/multihost_worker.py PROCESS_ID NUM_PROCESSES PORT
+
+Each process owns 4 virtual CPU devices (XLA_FLAGS set by the spawner);
+``initialize_distributed`` wires them into one runtime, Gloo carries the
+cross-process collectives (the DCN stand-in), and the full sharded trainer
+runs over a ``make_multihost_mesh``.  Process 0 prints the resulting RMSE
+for the driver to compare with a single-process run.
+"""
+
+import sys
+
+
+def main() -> None:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from cfk_tpu.parallel.mesh import initialize_distributed, make_multihost_mesh
+
+    got = initialize_distributed(
+        f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
+    )
+    assert got == nprocs, (got, nprocs)
+
+    from cfk_tpu import ALSConfig, parse_netflix
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    n = jax.device_count()
+    coo = parse_netflix("/root/reference/data/data_sample_tiny.txt")
+    dataset = Dataset.from_coo(coo, num_shards=n)
+    config = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=0, num_shards=n)
+    mesh = make_multihost_mesh()
+    ckdir = sys.argv[4] if len(sys.argv) > 4 else None
+    manager = CheckpointManager(ckdir) if ckdir else None
+    model = train_als_sharded(
+        dataset, config, mesh, checkpoint_manager=manager
+    )
+    mse, rmse = mse_rmse_from_blocks(model.predict_dense(), dataset)
+    if manager is not None:
+        # Resume path: a fresh trainer on every process must agree on the
+        # (process-0-written, broadcast) final checkpoint and be a no-op.
+        resumed = train_als_sharded(
+            dataset, config, mesh, checkpoint_manager=manager
+        )
+        mse2, _ = mse_rmse_from_blocks(resumed.predict_dense(), dataset)
+        assert abs(mse - mse2) < 1e-9, (mse, mse2)
+    if jax.process_index() == 0:
+        print(f"MULTIHOST_RESULT mse={mse:.6f} rmse={rmse:.6f} devices={n}")
+
+
+if __name__ == "__main__":
+    main()

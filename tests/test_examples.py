@@ -4,15 +4,18 @@ Executed in-process (the conftest already forces the 8-virtual-device CPU
 platform) on the tiny reference sample.
 """
 
+import os
 import runpy
 import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(path, argv):
     old = sys.argv
     sys.argv = argv
     try:
-        runpy.run_path(path, run_name="__main__")
+        runpy.run_path(os.path.join(_ROOT, path), run_name="__main__")
     finally:
         sys.argv = old
 
