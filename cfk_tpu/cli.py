@@ -221,6 +221,32 @@ def _train(args) -> int:
         block_size=args.block_size,
         sweeps=args.sweeps,
     )
+    heldout = train_coo = None
+    if args.eval_ranking:
+        if not args.implicit:
+            _eprint("error: --eval-ranking requires --implicit (it is a "
+                    "top-K ranking protocol, not a rating-error one)")
+            return 1
+        from cfk_tpu.data.blocks import Dataset
+        from cfk_tpu.eval.ranking import leave_one_out_split
+
+        d = ds.coo_dense
+        train_coo, heldout = leave_one_out_split(
+            d.movie_raw, d.user_raw, d.rating, seed=args.seed
+        )
+        before = (ds.movie_map.num_entities, ds.user_map.num_entities)
+        ds = Dataset.from_coo(
+            train_coo, num_shards=args.shards, pad_multiple=args.pad_multiple,
+            layout=args.layout, chunk_elems=args.chunk_elems,
+        )
+        if (ds.movie_map.num_entities, ds.user_map.num_entities) != before:
+            _eprint(
+                "error: the leave-one-out split removed some entity's only "
+                "interaction; ranking eval needs every movie to keep >= 1 — "
+                "use a denser dataset"
+            )
+            return 1
+
     manager = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     ck = dict(checkpoint_manager=manager, checkpoint_every=args.checkpoint_every)
 
@@ -256,6 +282,17 @@ def _train(args) -> int:
         metrics.gauge("mse", round(mse, 6))
         metrics.gauge("rmse", round(rmse, 6))
         _eprint(f"train MSE={mse:.4f} RMSE={rmse:.4f}")
+    if heldout is not None:
+        from cfk_tpu.eval.ranking import mean_percentile_rank, recall_at_k
+
+        with metrics.phase("eval_ranking"):
+            rec = recall_at_k(preds, train_coo, heldout, k=args.eval_ranking)
+            mpr = mean_percentile_rank(preds, train_coo, heldout)
+        metrics.gauge(f"recall_at_{args.eval_ranking}", round(rec, 6))
+        metrics.gauge("mpr", round(mpr, 6))
+        _eprint(
+            f"leave-one-out Recall@{args.eval_ranking}={rec:.4f} MPR={mpr:.4f}"
+        )
     if args.output != "none":
         with metrics.phase("dump_csv"):
             path = save_prediction_csv(
@@ -512,6 +549,12 @@ def build_parser() -> argparse.ArgumentParser:
         "'ials++' (implicit, Rendle et al.) = warm-started subspace block "
         "coordinate descent — much cheaper per epoch at large rank; "
         "padded/bucketed layouts",
+    )
+    t.add_argument(
+        "--eval-ranking", type=int, default=None, metavar="K",
+        help="(implicit only) hold one interaction per user out before "
+        "training and report leave-one-out Recall@K and mean percentile "
+        "rank after",
     )
     t.add_argument("--block-size", type=int, default=32,
                    help="als++/ials++ coordinate block size (must divide rank)")

@@ -58,6 +58,28 @@ def test_evaluate_shape_mismatch(capsys, tmp_path):
     assert "prediction matrix is" in capsys.readouterr().err
 
 
+def test_train_implicit_eval_ranking(capsys, tmp_path):
+    from cfk_tpu.cli import main
+
+    rc = main([
+        "train", "--data", "/root/reference/data/data_sample_tiny.txt",
+        "--implicit", "--rank", "8", "--alpha", "2", "--iterations", "4",
+        "--seed", "0", "--eval-ranking", "10", "--output", "none",
+        "--metrics", "json",
+    ])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "recall_at_10" in out.out and "mpr" in out.out
+    assert "leave-one-out Recall@10=" in out.err
+    # explicit model refuses the flag
+    assert main([
+        "train", "--data", "/root/reference/data/data_sample_tiny.txt",
+        "--rank", "8", "--iterations", "1", "--eval-ranking", "5",
+        "--output", "none",
+    ]) == 1
+    assert "requires --implicit" in capsys.readouterr().err
+
+
 def test_train_implicit(capsys, tmp_path):
     rc = main([
         "train", "--data", TINY, "--implicit", "--rank", "4",
