@@ -14,7 +14,9 @@ import sys
 import numpy as np
 import pytest
 
-_PORT = 29517
+# Per-run port: a fixed one can collide with a lingering coordinator (or
+# TIME_WAIT socket) from a previous suite run on the same machine.
+_PORT = 29000 + (os.getpid() % 2000)
 
 
 def _spawn(pid: int, nprocs: int, ckdir: str) -> subprocess.Popen:
