@@ -14,6 +14,8 @@ Three subcommands:
   file: the (fixed) replacement for ``scripts/calculate_mse.py`` (which
   reads uninitialized ``np.empty`` memory and can print nan).
 - ``recommend`` — top-K serving from checkpointed factors.
+- ``predict`` — prediction-CSV dump from checkpointed factors (the
+  reference's final-collection phase as a standalone step).
 - ``broker`` / ``produce`` — run the native TCP log broker and stream a
   ratings file into it; ``train --data tcp://HOST:PORT[/TOPIC]`` then
   ingests from the broker (the reference's producer → Kafka → app split,
@@ -385,6 +387,53 @@ def _evaluate(args) -> int:
     return 0
 
 
+def _predict(args) -> int:
+    """Dump the prediction CSV from checkpointed factors, no retraining.
+
+    The reference's final-collection phase (``processors/FeatureCollector.java``:
+    P = U·Mᵀ + CSV dump) as a standalone step over the durable factor store —
+    train once with --checkpoint-dir, then regenerate/evaluate predictions at
+    any time.
+    """
+    from cfk_tpu.data.blocks import RatingsIndex
+    from cfk_tpu.data.movielens import parse_movielens_csv
+    from cfk_tpu.data.netflix import parse_netflix
+    from cfk_tpu.eval.predict import save_prediction_csv
+    from cfk_tpu.models.als import ALSModel
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    if args.format == "netflix":
+        coo = parse_netflix(args.data)
+    else:
+        coo = parse_movielens_csv(args.data, min_rating=args.min_rating)
+    ds = RatingsIndex.from_coo(coo)
+    state = CheckpointManager(args.checkpoint_dir).restore()
+    if state.user_factors.shape[0] < ds.user_map.num_entities or (
+        state.movie_factors.shape[0] < ds.movie_map.num_entities
+    ):
+        _eprint(
+            f"error: checkpoint factors ({state.user_factors.shape[0]} users, "
+            f"{state.movie_factors.shape[0]} movies) are smaller than the "
+            f"data implies ({ds.user_map.num_entities}, "
+            f"{ds.movie_map.num_entities}); wrong --data for this checkpoint?"
+        )
+        return 1
+    model = ALSModel(
+        user_factors=state.user_factors,
+        movie_factors=state.movie_factors,
+        num_users=ds.user_map.num_entities,
+        num_movies=ds.movie_map.num_entities,
+    )
+    path = save_prediction_csv(
+        model.predict_dense(), None if args.output == "auto" else args.output
+    )
+    _eprint(
+        f"predictions from iteration-{state.iteration} checkpoint "
+        f"written to {path}"
+    )
+    return 0
+
+
 def _recommend(args) -> int:
     """Serve top-K from checkpointed factors, printing raw ids."""
     import numpy as np
@@ -619,6 +668,22 @@ def build_parser() -> argparse.ArgumentParser:
     rc.add_argument("--include-seen", action="store_true",
                     help="do not exclude already-rated movies")
     rc.set_defaults(fn=_recommend)
+
+    pd = sub.add_parser(
+        "predict",
+        help="dump the prediction CSV from checkpointed factors "
+        "(the reference's final-collection phase as a standalone step)",
+    )
+    pd.add_argument("--checkpoint-dir", required=True)
+    pd.add_argument("--data", required=True,
+                    help="training data file (raw-id mapping / matrix shape)")
+    pd.add_argument("--format", choices=["netflix", "movielens"], default="netflix")
+    pd.add_argument("--min-rating", type=float, default=0.0)
+    pd.add_argument(
+        "--output", default="auto",
+        help="'auto' = predictions/prediction_matrix_<ts>, or a path",
+    )
+    pd.set_defaults(fn=_predict)
 
     b = sub.add_parser(
         "broker", help="run the native TCP log broker (native/cfk_broker)"

@@ -58,6 +58,34 @@ def test_evaluate_shape_mismatch(capsys, tmp_path):
     assert "prediction matrix is" in capsys.readouterr().err
 
 
+def test_predict_from_checkpoint(capsys, tmp_path):
+    """train --checkpoint-dir, then predict + evaluate without retraining:
+    the standalone dump must score identically to the train-time metrics."""
+    import re
+
+    from cfk_tpu.cli import main
+
+    data = "/root/reference/data/data_sample_tiny.txt"
+    ck = str(tmp_path / "ck")
+    assert main([
+        "train", "--data", data, "--rank", "4", "--iterations", "2",
+        "--seed", "0", "--checkpoint-dir", ck, "--output", "none",
+    ]) == 0
+    rmse_train = re.search(r"RMSE=([0-9.]+)", capsys.readouterr().err).group(1)
+    pred = str(tmp_path / "pred.csv")
+    assert main(["predict", "--checkpoint-dir", ck, "--data", data,
+                 "--output", pred]) == 0
+    assert "iteration-2 checkpoint" in capsys.readouterr().err
+    assert main(["evaluate", data, pred]) == 0
+    rmse_eval = re.search(r"RMSE: ([0-9.]+)", capsys.readouterr().out).group(1)
+    assert abs(float(rmse_train) - float(rmse_eval)) < 1e-3
+    # wrong data for the checkpoint fails loudly
+    assert main(["predict", "--checkpoint-dir", ck, "--data",
+                 "/root/reference/data/data_sample_medium.txt",
+                 "--output", str(tmp_path / "x.csv")]) == 1
+    assert "smaller than the data implies" in capsys.readouterr().err
+
+
 def test_train_implicit_eval_ranking(capsys, tmp_path):
     from cfk_tpu.cli import main
 
