@@ -43,12 +43,26 @@ class ALSModel:
     num_users: int
     num_movies: int
 
-    def predict_dense(self) -> np.ndarray:
+    def predict_dense(self, *, allow_huge: bool = False) -> np.ndarray:
         """Dense prediction matrix P = U·Mᵀ, [num_users, num_movies].
 
         Works under multi-process JAX too: non-addressable sharded factors
         are process_allgather'd so every host computes the same matrix.
+
+        Refuses matrices over ~4e9 cells (16 GB float32) unless
+        ``allow_huge`` — at full-Netflix scale the dense matrix is the one
+        thing that genuinely cannot scale (the reference's collector had
+        the same ceiling); serve with ``recommend_top_k`` instead, which is
+        chunked and never materializes P.
         """
+        cells = self.num_users * self.num_movies
+        if cells > 4_000_000_000 and not allow_huge:
+            raise ValueError(
+                f"dense prediction matrix would be {self.num_users}×"
+                f"{self.num_movies} = {cells:.2e} float32 cells; use "
+                "recommend_top_k (chunked top-K serving) or pass "
+                "allow_huge=True if you really have the RAM"
+            )
         from cfk_tpu.parallel.mesh import to_host
 
         u = to_host(self.user_factors)[: self.num_users].astype(np.float32)

@@ -98,3 +98,17 @@ def test_cli_recommend_roundtrip(tmp_path, capsys):
         user, pairs = line.split("\t")
         assert int(user) in (7, 79)
         assert len(pairs.split(",")) == 5
+
+
+def test_predict_dense_refuses_huge_matrices():
+    import jax.numpy as jnp
+    import pytest
+
+    from cfk_tpu.models.als import ALSModel
+
+    model = ALSModel(
+        user_factors=jnp.zeros((8, 2)), movie_factors=jnp.zeros((8, 2)),
+        num_users=100_000, num_movies=50_000,
+    )
+    with pytest.raises(ValueError, match="recommend_top_k"):
+        model.predict_dense()
