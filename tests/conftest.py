@@ -7,6 +7,11 @@ process.
 """
 
 import os
+import sys
+
+# The package is imported from the source tree (not installed); make the
+# suite cwd-independent.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
