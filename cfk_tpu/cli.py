@@ -192,7 +192,7 @@ def _cache_sans_fingerprint(cache_dir, build_key, Dataset, ignore):
 
 def _train(args) -> int:
     from cfk_tpu.config import ALSConfig
-    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.eval.metrics import mse_rmse_from_model
     from cfk_tpu.eval.predict import save_prediction_csv
     from cfk_tpu.models.als import train_als
     from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
@@ -277,30 +277,43 @@ def _train(args) -> int:
             else:
                 model = train_als(ds, config, metrics=metrics, **ck)
 
-    with metrics.phase("predict"):
-        preds = model.predict_dense()
+    # Both evals stream from the factors (never materializing U·Mᵀ), so they
+    # run at scales where the dense matrix cannot exist; only the CSV dump
+    # still needs dense predictions, and only it is skipped (with a warning)
+    # when they're unmaterializable.
     if not args.implicit:
-        mse, rmse = mse_rmse_from_blocks(preds, ds)
+        with metrics.phase("eval_mse"):
+            mse, rmse = mse_rmse_from_model(model, ds)
         metrics.gauge("mse", round(mse, 6))
         metrics.gauge("rmse", round(rmse, 6))
         _eprint(f"train MSE={mse:.4f} RMSE={rmse:.4f}")
     if heldout is not None:
-        from cfk_tpu.eval.ranking import mean_percentile_rank, recall_at_k
+        from cfk_tpu.eval.ranking import ranking_metrics_from_model
 
         with metrics.phase("eval_ranking"):
-            rec = recall_at_k(preds, train_coo, heldout, k=args.eval_ranking)
-            mpr = mean_percentile_rank(preds, train_coo, heldout)
+            rec, mpr = ranking_metrics_from_model(
+                model, train_coo, heldout, k=args.eval_ranking
+            )
         metrics.gauge(f"recall_at_{args.eval_ranking}", round(rec, 6))
         metrics.gauge("mpr", round(mpr, 6))
         _eprint(
             f"leave-one-out Recall@{args.eval_ranking}={rec:.4f} MPR={mpr:.4f}"
         )
     if args.output != "none":
-        with metrics.phase("dump_csv"):
-            path = save_prediction_csv(
-                preds, None if args.output == "auto" else args.output
-            )
-        _eprint(f"predictions written to {path}")
+        with metrics.phase("predict"):
+            try:
+                preds = model.predict_dense()
+            except ValueError as e:
+                # At full-Netflix scale the trained model is the deliverable;
+                # don't discard it over an unmaterializable side product.
+                preds = None
+                _eprint(f"warning: skipping the prediction CSV dump: {e}")
+        if preds is not None:
+            with metrics.phase("dump_csv"):
+                path = save_prediction_csv(
+                    preds, None if args.output == "auto" else args.output
+                )
+            _eprint(f"predictions written to {path}")
     print(metrics.json_line() if args.metrics == "json" else metrics.logfmt())
     return 0
 
@@ -310,7 +323,7 @@ def _run_reference_form(args) -> int:
     from cfk_tpu.config import ALSConfig
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.netflix import parse_netflix
-    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.eval.metrics import mse_rmse_from_model
     from cfk_tpu.eval.predict import save_prediction_csv
     from cfk_tpu.models.als import train_als
 
@@ -353,13 +366,21 @@ def _run_reference_form(args) -> int:
         model = train_als_sharded(ds, config, mesh)
     else:
         model = train_als(ds, config)
-    preds = model.predict_dense()
-    mse, rmse = mse_rmse_from_blocks(preds, ds)
-    path = save_prediction_csv(preds)
-    _eprint(f"prediction matrix written: {time.strftime('%Y-%m-%d %H:%M:%S')}")
+    mse, rmse = mse_rmse_from_model(model, ds)
+    try:
+        preds = model.predict_dense()
+    except ValueError as e:
+        # Full-Netflix-scale run of the reference form: the dense CSV is the
+        # one unmaterializable artifact; keep the quality numbers.
+        preds = path = None
+        _eprint(f"warning: skipping the prediction CSV dump: {e}")
+    if preds is not None:
+        path = save_prediction_csv(preds)
+        _eprint(f"prediction matrix written: {time.strftime('%Y-%m-%d %H:%M:%S')}")
     print(f"MSE: {mse}")
     print(f"RMSE: {rmse}")
-    print(path)
+    if path is not None:
+        print(path)
     return 0
 
 

@@ -41,8 +41,16 @@ class ALSConfig:
     #                  block by block (the block-to-block-join analog; never
     #                  materializes the full fixed-side matrix per device).
     exchange: Literal["all_gather", "ring"] = "all_gather"
-    # Entities-per-solve chunk; bounds the [chunk, max_nnz, rank] gather that
-    # feeds the MXU. None = solve a whole shard at once.
+    # --- HBM bounding: ONE concept, expressed per layout -------------------
+    # Every layout bounds the same quantity — the transient neighbor-factor
+    # gather feeding the MXU — by streaming solves through HBM in chunks.
+    # ``bucket_chunk_elems`` (below) is the budget in gather *cells*
+    # (rows × width ≈ ratings per chunk) and is consumed at dataset build
+    # time by the bucketed/segment layouts.  For the padded layout, whose
+    # rectangle exists only at run time, the same budget is expressed in
+    # *entities* per chunk here: ``solve_chunk ≈ bucket_chunk_elems //
+    # max_nnz``.  None = solve a whole shard at once (fine until the
+    # [E, max_nnz, rank] gather outgrows HBM).
     solve_chunk: int | None = None
     # Batched k×k SPD solve backend: "cholesky" = XLA custom calls;
     # "pallas" = lane-vectorized Gauss-Jordan TPU kernel (cfk_tpu.ops.pallas);
@@ -70,12 +78,11 @@ class ALSConfig:
     #                arbitrarily skewed degree distributions, and the fastest
     #                layout at full-Netflix scale. all_gather exchange only.
     layout: Literal["padded", "bucketed", "segment"] = "padded"
-    # Bucketed/segment layouts: max gather cells per solve chunk — bounds the
-    # transient [chunk, width, rank] neighbor-factor gather (segment chunks
-    # hold chunk_elems ratings).  Consumed at dataset build time: pass it as
-    # Dataset.from_coo(..., chunk_elems=config.bucket_chunk_elems) — the CLI
-    # does (--chunk-elems); the chunk hints then live statically on the
-    # blocks, not in this config.
+    # The HBM gather-cell budget (see the solve_chunk comment above — same
+    # concept, cell units).  Bucketed/segment layouts consume it at dataset
+    # build time: pass it as Dataset.from_coo(..., chunk_elems=
+    # config.bucket_chunk_elems) — the CLI does (--chunk-elems); the chunk
+    # hints then live statically on the blocks, not in this config.
     bucket_chunk_elems: int = 1 << 20
     # Per-entity optimizer.  "als" = the reference's exact full k×k normal-
     # equation solve every half-iteration.  "als++" = warm-started subspace

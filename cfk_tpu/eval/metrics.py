@@ -35,3 +35,26 @@ def mse_rmse_from_blocks(predictions: np.ndarray, dataset: Dataset) -> tuple[flo
         dataset.coo_dense.movie_raw,
         dataset.coo_dense.rating,
     )
+
+
+def mse_rmse_from_model(model, dataset: Dataset, chunk: int = 1 << 22) -> tuple[float, float]:
+    """MSE/RMSE straight from the factor matrices, never materializing P.
+
+    Predictions at the observed cells are per-row dot products
+    ``Σ_k U[u,k]·M[m,k]`` streamed in nnz chunks — O(chunk·k) memory, so it
+    works at full-Netflix scale where the dense U·Mᵀ matrix
+    (``ALSModel.predict_dense``) would be hundreds of GB.
+    """
+    u, m = model.host_factors()
+    ud = dataset.coo_dense.user_raw
+    md = dataset.coo_dense.movie_raw
+    r = dataset.coo_dense.rating
+    se = 0.0
+    for lo in range(0, r.shape[0], chunk):
+        sl = slice(lo, lo + chunk)
+        pred = np.einsum(
+            "nk,nk->n", u[ud[sl]], m[md[sl]], dtype=np.float64
+        )
+        se += float(np.sum((r[sl].astype(np.float64) - pred) ** 2))
+    mse = se / r.shape[0]
+    return mse, math.sqrt(mse)

@@ -62,30 +62,96 @@ def leave_one_out_split(
     return train, heldout
 
 
+def _validate_index_space(train: RatingsCOO, num_users: int, num_movies: int,
+                          what: str) -> None:
+    if train.user_raw.max(initial=-1) >= num_users or train.movie_raw.max(
+        initial=-1
+    ) >= num_movies:
+        raise ValueError(
+            f"train indices exceed {what} ({num_users} users, {num_movies} "
+            "movies) — the model was trained on a dataset with a different "
+            "dense index space than the split; build the split with "
+            "leave_one_out_split so every entity stays covered in train"
+        )
+
+
+def _tie_averaged_ranks(cand: np.ndarray, held_scores: np.ndarray) -> np.ndarray:
+    """0-based rank of ``held_scores[i]`` within row ``cand[i]`` (train cells
+    already -inf).  Ties count half (excluding the held item's own cell) —
+    otherwise a degenerate constant-score model would score a perfect
+    ranking.  The one copy of the rank semantics shared by the dense and
+    chunked evaluators."""
+    better = (cand > held_scores[:, None]).sum(axis=1)
+    ties = (cand == held_scores[:, None]).sum(axis=1) - 1
+    return better + 0.5 * ties
+
+
+def _num_candidates(train: RatingsCOO, heldout: Heldout, num_users: int,
+                    num_movies: int) -> np.ndarray:
+    """Per-held-out-user count of non-train items (the MPR denominator)."""
+    return num_movies - np.bincount(
+        train.user_raw, minlength=num_users
+    )[heldout.user_dense]
+
+
 def _ranks(
     scores: np.ndarray,  # [num_users, num_movies]
     train: RatingsCOO,  # dense-index COO of training interactions
     heldout: Heldout,
 ) -> np.ndarray:
     """0-based rank of each held-out item among that user's non-train items."""
-    if train.user_raw.max(initial=-1) >= scores.shape[0] or train.movie_raw.max(
-        initial=-1
-    ) >= scores.shape[1]:
-        raise ValueError(
-            f"train indices exceed score matrix {scores.shape} — the model was "
-            "trained on a dataset with a different dense index space than the "
-            "split; build the split with leave_one_out_split so every entity "
-            "stays covered in train"
-        )
+    _validate_index_space(
+        train, scores.shape[0], scores.shape[1], f"score matrix {scores.shape}"
+    )
     s = scores.copy()
     s[train.user_raw, train.movie_raw] = -np.inf  # exclude seen items
     held_scores = s[heldout.user_dense, heldout.movie_dense]
-    cand = s[heldout.user_dense]
-    better = (cand > held_scores[:, None]).sum(axis=1)
-    # Ties count half (excluding the held item's own cell) — otherwise a
-    # degenerate constant-score model would score a perfect ranking.
-    ties = (cand == held_scores[:, None]).sum(axis=1) - 1
-    return better + 0.5 * ties
+    return _tie_averaged_ranks(s[heldout.user_dense], held_scores)
+
+
+def ranks_from_model(
+    model, train: RatingsCOO, heldout: Heldout, chunk: int = 8192
+) -> np.ndarray:
+    """0-based tie-averaged rank of each held-out item, streamed in chunks.
+
+    Semantics match ``_ranks`` on the dense score matrix exactly, but scores
+    are computed per held-out-user chunk ([chunk, num_movies] at a time), so
+    the eval works at scales where U·Mᵀ cannot be materialized — the same
+    generalization ``mse_rmse_from_model`` makes for the MSE eval.
+    """
+    u, m = model.host_factors()
+    _validate_index_space(train, u.shape[0], m.shape[0], "factor shapes")
+    # CSR of train interactions by user, for per-chunk exclusion.
+    order = np.argsort(train.user_raw, kind="stable")
+    tm = train.movie_raw[order].astype(np.int64)
+    starts = np.searchsorted(train.user_raw[order], np.arange(u.shape[0] + 1))
+    out = np.empty(heldout.user_dense.shape[0], dtype=np.float64)
+    for lo in range(0, heldout.user_dense.shape[0], chunk):
+        hu = heldout.user_dense[lo : lo + chunk]
+        hm = heldout.movie_dense[lo : lo + chunk]
+        cand = u[hu] @ m.T  # [c, num_movies]
+        counts = starts[hu + 1] - starts[hu]
+        rows = np.repeat(np.arange(hu.shape[0]), counts)
+        flat = np.arange(counts.sum()) + np.repeat(
+            starts[hu] - np.concatenate(([0], np.cumsum(counts[:-1]))), counts
+        )
+        cand[rows, tm[flat]] = -np.inf  # exclude seen items
+        held_scores = cand[np.arange(hu.shape[0]), hm]
+        out[lo : lo + hu.shape[0]] = _tie_averaged_ranks(cand, held_scores)
+    return out
+
+
+def ranking_metrics_from_model(
+    model, train: RatingsCOO, heldout: Heldout, k: int = 10, chunk: int = 8192
+) -> tuple[float, float]:
+    """(Recall@K, MPR) straight from the factors — one rank pass, no dense P."""
+    if heldout.user_dense.size == 0:
+        raise ValueError("empty heldout set")
+    ranks = ranks_from_model(model, train, heldout, chunk)
+    nc = _num_candidates(train, heldout, model.num_users, model.num_movies)
+    recall = float((ranks < k).mean())
+    mpr = float((ranks / np.maximum(nc - 1, 1)).mean())
+    return recall, mpr
 
 
 def recall_at_k(
@@ -103,8 +169,6 @@ def mean_percentile_rank(
     """Hu et al.'s MPR ∈ [0, 1]; 0.5 = random, lower is better."""
     if heldout.user_dense.size == 0:
         raise ValueError("empty heldout set")
-    num_candidates = scores.shape[1] - np.bincount(
-        train.user_raw, minlength=scores.shape[0]
-    )[heldout.user_dense]
+    nc = _num_candidates(train, heldout, scores.shape[0], scores.shape[1])
     ranks = _ranks(scores, train, heldout)
-    return float((ranks / np.maximum(num_candidates - 1, 1)).mean())
+    return float((ranks / np.maximum(nc - 1, 1)).mean())

@@ -43,11 +43,29 @@ class ALSModel:
     num_users: int
     num_movies: int
 
+    def host_factors(self) -> tuple[np.ndarray, np.ndarray]:
+        """float32 host copies of (U, M) with pad rows trimmed.
+
+        The one place factor hosting is defined — the dense predictor and
+        the factored evaluators (``cfk_tpu.eval.metrics.mse_rmse_from_model``,
+        ``cfk_tpu.eval.ranking.ranks_from_model``) all share it, so they can
+        never diverge on trimming/dtype.  Works under multi-process JAX too:
+        non-addressable sharded factors are process_allgather'd so every host
+        sees the same matrices.  Cached: the post-training path (MSE eval,
+        ranking eval, CSV dump) fetches from device exactly once.
+        """
+        return self._host_factors
+
+    @functools.cached_property
+    def _host_factors(self) -> tuple[np.ndarray, np.ndarray]:
+        from cfk_tpu.parallel.mesh import to_host
+
+        u = to_host(self.user_factors)[: self.num_users].astype(np.float32)
+        m = to_host(self.movie_factors)[: self.num_movies].astype(np.float32)
+        return u, m
+
     def predict_dense(self, *, allow_huge: bool = False) -> np.ndarray:
         """Dense prediction matrix P = U·Mᵀ, [num_users, num_movies].
-
-        Works under multi-process JAX too: non-addressable sharded factors
-        are process_allgather'd so every host computes the same matrix.
 
         Refuses matrices over ~4e9 cells (16 GB float32) unless
         ``allow_huge`` — at full-Netflix scale the dense matrix is the one
@@ -63,10 +81,7 @@ class ALSModel:
                 "recommend_top_k (chunked top-K serving) or pass "
                 "allow_huge=True if you really have the RAM"
             )
-        from cfk_tpu.parallel.mesh import to_host
-
-        u = to_host(self.user_factors)[: self.num_users].astype(np.float32)
-        m = to_host(self.movie_factors)[: self.num_movies].astype(np.float32)
+        u, m = self.host_factors()
         return u @ m.T
 
     def recommend_top_k(self, user_rows, k: int = 10, *, dataset=None,

@@ -81,18 +81,58 @@ def resume_state_synced(
     """
     import jax
 
-    state = resume_state(
-        manager, rank=rank, model=model, num_iterations=num_iterations
-    )
+    def check_shapes(state):
+        # A stale checkpoint with different padded_entities (different
+        # pad_multiple/num_shards) would otherwise crash or hang *inside*
+        # the factor broadcast, since stateless processes allocate zeros of
+        # the current shapes.
+        got = (tuple(state.user_factors.shape), tuple(state.movie_factors.shape))
+        if got != (tuple(u_shape), tuple(m_shape)):
+            raise ValueError(
+                f"checkpoint at iteration {state.iteration} has factor shapes "
+                f"user={got[0]} movie={got[1]}, but this run needs "
+                f"user={tuple(u_shape)} movie={tuple(m_shape)} (padded "
+                "entity counts depend on pad_multiple/num_shards); use a "
+                "fresh checkpoint directory"
+            )
+
     if jax.process_count() == 1:
+        state = resume_state(
+            manager, rank=rank, model=model, num_iterations=num_iterations
+        )
+        if state is not None:
+            check_shapes(state)
         return state
     from jax.experimental import multihost_utils as mh
 
-    it = int(
-        mh.broadcast_one_to_all(
-            np.asarray(state.iteration if state is not None else -1, np.int32)
+    # Only process 0's checkpoint is authoritative — other processes never
+    # read their (possibly stale, possibly differently-shaped) local dirs;
+    # they always contribute current-shape zeros to the factor broadcast.
+    # Process 0 validates BEFORE any collective and broadcasts a status word,
+    # so a bad checkpoint fails loudly on every process instead of leaving
+    # the others hanging in a collective that process 0 never enters.
+    state = None
+    err: Exception | None = None
+    if jax.process_index() == 0:
+        try:
+            state = resume_state(
+                manager, rank=rank, model=model, num_iterations=num_iterations
+            )
+            if state is not None:
+                check_shapes(state)
+        except Exception as e:
+            err = e
+        status = -2 if err is not None else (-1 if state is None else state.iteration)
+    else:
+        status = -1  # overwritten by the broadcast
+    it = int(mh.broadcast_one_to_all(np.asarray(status, np.int64)))
+    if it == -2:
+        if err is not None:
+            raise err
+        raise RuntimeError(
+            "process 0 failed to resume from its checkpoint directory "
+            "(see its log for the underlying error)"
         )
-    )
     if it < 0:
         return None
     u = (

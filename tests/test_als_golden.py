@@ -21,6 +21,20 @@ def test_tiny_golden_mse(tiny_dataset):
     assert rmse <= 0.52
 
 
+def test_factored_mse_matches_dense(tiny_dataset):
+    """The chunked factor-space evaluator must agree with the dense-matrix
+    path (it replaces it at scales where U·Mᵀ cannot be materialized)."""
+    from cfk_tpu.eval.metrics import mse_rmse_from_model
+
+    config = ALSConfig(rank=4, lam=0.05, num_iterations=3, seed=1)
+    model = train_als(tiny_dataset, config)
+    mse_d, rmse_d = mse_rmse_from_blocks(model.predict_dense(), tiny_dataset)
+    mse_f, rmse_f = mse_rmse_from_model(model, tiny_dataset, chunk=1000)
+    # f32 matmul vs f64-accumulated dot products round differently at ~1e-9
+    assert abs(mse_d - mse_f) < 1e-7
+    assert abs(rmse_d - rmse_f) < 1e-7
+
+
 def test_prediction_csv_roundtrip(tiny_dataset, tmp_path):
     config = ALSConfig(rank=3, lam=0.05, num_iterations=2, seed=0)
     model = train_als(tiny_dataset, config)

@@ -50,6 +50,28 @@ def test_train_and_evaluate_roundtrip(capsys, tmp_path):
     assert mse <= 0.27
 
 
+def test_train_survives_unmaterializable_dense_preds(capsys, tmp_path, monkeypatch):
+    """At BASELINE scales the dense U·Mᵀ cannot exist; training must still
+    finish, report factored train MSE, and only skip the CSV dump."""
+    from cfk_tpu.models.als import ALSModel
+
+    def boom(self, *, allow_huge=False):
+        raise ValueError("dense prediction matrix would be huge")
+
+    monkeypatch.setattr(ALSModel, "predict_dense", boom)
+    rc = main([
+        "train", "--data", TINY, "--rank", "3", "--lam", "0.05",
+        "--iterations", "2", "--seed", "0",
+        "--output", str(tmp_path / "pred.csv"), "--metrics", "json",
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "skipping the prediction CSV dump" in captured.err
+    assert "RMSE=" in captured.err  # factored MSE eval still ran
+    metrics = json.loads(captured.out.strip().splitlines()[-1])
+    assert "mse" in metrics["gauges"]
+
+
 def test_evaluate_shape_mismatch(capsys, tmp_path):
     bad = tmp_path / "bad.csv"
     bad.write_text("2 3 real\n1 2 3\n4 5 6\n")

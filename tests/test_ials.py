@@ -82,6 +82,36 @@ def test_ials_beats_random_ranking(rng):
     assert rec > 0.2, f"recall@5 {rec} too low"
 
 
+def test_factored_ranking_matches_dense(rng):
+    """The chunked factor-space ranking eval must agree with the dense-matrix
+    path (it replaces it at scales where U·Mᵀ cannot be materialized)."""
+    from cfk_tpu.eval.ranking import ranking_metrics_from_model
+
+    coo = synthetic_implicit(rng)
+    ds_full = Dataset.from_coo(coo)
+    dcoo = ds_full.coo_dense
+    train, heldout = leave_one_out_split(
+        dcoo.movie_raw, dcoo.user_raw, dcoo.rating, seed=1
+    )
+    ds = Dataset.from_coo(train)
+    model = train_ials(
+        ds, IALSConfig(rank=4, lam=0.1, alpha=10.0, num_iterations=4, seed=0)
+    )
+    scores = model.predict_dense()
+    rec_d = recall_at_k(scores, train, heldout, k=5)
+    mpr_d = mean_percentile_rank(scores, train, heldout)
+    rec_f, mpr_f = ranking_metrics_from_model(
+        model, train, heldout, k=5, chunk=7  # force several chunks
+    )
+    # The two paths compute scores with different GEMM shapes (one full
+    # matmul vs per-chunk matmuls), so last-ulp score differences can flip a
+    # near-tie's rank on some BLAS backends — compare with slack for one
+    # flipped heldout item, not bitwise.
+    slack = 1.0 / heldout.user_dense.size + 1e-12
+    assert abs(rec_d - rec_f) <= slack
+    assert abs(mpr_d - mpr_f) <= slack
+
+
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
 def test_ials_sharded_matches_single(rng):
     coo = synthetic_implicit(rng)

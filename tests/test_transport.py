@@ -297,6 +297,31 @@ def test_rank_mismatch_on_resume_rejected(tiny_dataset, tmp_path):
         )
 
 
+def test_stale_shape_on_synced_resume_rejected(tiny_dataset, tmp_path):
+    """A checkpoint whose padded row counts don't match the current run must
+    fail loudly before any collective, not crash/hang inside the broadcast."""
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.transport.checkpoint import resume_state_synced
+
+    mgr = CheckpointManager(str(tmp_path))
+    train_als(
+        tiny_dataset,
+        ALSConfig(rank=3, lam=0.05, num_iterations=1, seed=5),
+        checkpoint_manager=mgr,
+    )
+    saved = mgr.restore()
+    with pytest.raises(ValueError, match="factor shapes"):
+        resume_state_synced(
+            mgr,
+            rank=3,
+            model="als",
+            num_iterations=2,
+            u_shape=(saved.user_factors.shape[0] + 8, 3),
+            m_shape=saved.movie_factors.shape,
+        )
+
+
 def test_sharded_resume(tiny_coo, tmp_path):
     import jax
 
