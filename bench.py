@@ -175,6 +175,14 @@ def scale_main(args) -> None:
     if steady_s <= 0:
         steady_s = train_s  # includes the fixed overhead; flagged above
     s_per_iter = steady_s / n1
+
+    from cfk_tpu.utils.roofline import als_iteration_cost
+
+    cost = als_iteration_cost(
+        nnz, users, movies, args.rank,
+        factor_bytes=2 if args.dtype == "bfloat16" else 4,
+        implicit=args.ials,
+    )
     print(
         json.dumps(
             {
@@ -192,6 +200,17 @@ def scale_main(args) -> None:
                 "ratings_per_sec_per_chip": int(
                     coo.num_ratings * config.num_iterations * 2 / steady_s
                 ),
+                # Compute-efficiency block (cfk_tpu.utils.roofline): model
+                # FLOPs count the algorithmic minimum (Gram 2·nnz·k·(k+1)·2
+                # + Cholesky-cost solves), MFU is against the v5e bf16 peak,
+                # and hbm_roofline_s is the min-traffic floor the iteration
+                # can never beat.
+                "model_tflops_per_iter": round(cost.model_flops / 1e12, 4),
+                "achieved_tflops": round(cost.achieved_tflops(s_per_iter), 4),
+                "mfu": round(cost.mfu(s_per_iter), 5),
+                "min_hbm_gb_per_iter": round(cost.min_hbm_bytes / 1e9, 3),
+                "hbm_roofline_s": round(cost.hbm_bound_s(), 4),
+                "vs_hbm_roofline": round(s_per_iter / cost.hbm_bound_s(), 2),
                 "timing_degenerate": timing_degenerate,
                 "repeats": args.repeats,
                 "users": users,

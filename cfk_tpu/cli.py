@@ -196,7 +196,6 @@ def _train(args) -> int:
     from cfk_tpu.eval.predict import save_prediction_csv
     from cfk_tpu.models.als import train_als
     from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
-    from cfk_tpu.transport.checkpoint import CheckpointManager
     from cfk_tpu.utils.metrics import Metrics, maybe_profile
 
     metrics = Metrics()
@@ -249,7 +248,9 @@ def _train(args) -> int:
             )
             return 1
 
-    manager = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
+    manager = _make_checkpoint_manager(args)
+    if isinstance(manager, int):
+        return manager
     ck = dict(checkpoint_manager=manager, checkpoint_every=args.checkpoint_every)
 
     with maybe_profile(args.profile_dir):
@@ -263,7 +264,9 @@ def _train(args) -> int:
                 )
             else:
                 if manager is not None:
-                    _eprint("note: --checkpoint-dir ignored for single-shard iALS")
+                    flag = ("--checkpoint-journal" if args.checkpoint_journal
+                            else "--checkpoint-dir")
+                    _eprint(f"note: {flag} ignored for single-shard iALS")
                 model = train_ials(ds, config, metrics=metrics)
         else:
             config = ALSConfig(**common)
@@ -316,6 +319,46 @@ def _train(args) -> int:
             _eprint(f"predictions written to {path}")
     print(metrics.json_line() if args.metrics == "json" else metrics.logfmt())
     return 0
+
+
+def _make_checkpoint_manager(args):
+    """The checkpoint store the train flags select: the npz directory
+    (``--checkpoint-dir``, the fast local default), the transport journal
+    (``--checkpoint-journal``, factors as FeatureRecord frames through a
+    FileBroker dir or a ``tcp:HOST:PORT`` broker — the reference's
+    topics-as-durable-checkpoint design, ``setup.sh:18-21``), or None.
+    Returns an int exit code on flag errors."""
+    journal = getattr(args, "checkpoint_journal", None)
+    if args.checkpoint_dir and journal:
+        _eprint("error: --checkpoint-dir and --checkpoint-journal are "
+                "mutually exclusive")
+        return 2
+    if args.checkpoint_dir:
+        from cfk_tpu.transport.checkpoint import CheckpointManager
+
+        return CheckpointManager(args.checkpoint_dir)
+    if journal:
+        from cfk_tpu.transport.journal import JournalCheckpointManager
+
+        if journal.startswith("tcp://"):
+            from cfk_tpu.transport.tcp import TcpBrokerClient
+
+            try:
+                host, port, _ = _parse_broker_url(journal, topic_optional=True)
+            except ValueError as e:
+                _eprint(f"error: {e}")
+                return 2
+            transport = TcpBrokerClient(host, port)
+        else:
+            from cfk_tpu.transport.filelog import FileBroker
+
+            # fsync per append: the commit marker must never reach disk
+            # before the factor frames it commits (cross-file ordering).
+            transport = FileBroker(journal, fsync=True)
+        return JournalCheckpointManager(
+            transport, num_partitions=args.journal_partitions
+        )
+    return None
 
 
 def _run_reference_form(args) -> int:
@@ -656,6 +699,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)
+    t.add_argument(
+        "--checkpoint-journal", default=None,
+        help="journal factor checkpoints through the transport instead of "
+        "the npz --checkpoint-dir: a directory (FileBroker journal) or "
+        "tcp://HOST:PORT (cfk_broker server); factors travel as "
+        "FeatureRecord wire frames on per-iteration topics, resume replays "
+        "the latest committed iteration",
+    )
+    t.add_argument("--journal-partitions", type=int, default=1)
     t.add_argument(
         "--dataset-cache", default=None,
         help="directory for the built-blocks cache: loaded if present and "

@@ -405,6 +405,8 @@ def train_als(
             rank=config.rank,
             model="als",
             num_iterations=config.num_iterations,
+            u_shape=(dataset.user_blocks.padded_entities, config.rank),
+            m_shape=(dataset.movie_blocks.padded_entities, config.rank),
         )
         if state is not None:
             start_iter = state.iteration

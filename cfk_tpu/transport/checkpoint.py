@@ -29,13 +29,19 @@ def resume_state(
     rank: int,
     model: str,
     num_iterations: int,
+    u_shape: tuple[int, int] | None = None,
+    m_shape: tuple[int, int] | None = None,
 ) -> "CheckpointState | None":
     """Shared resume validation for every trainer.
 
     Returns the latest state, or None when there is nothing to resume.
     Rejects checkpoints whose rank or model family differs from the config,
-    and runs already past ``num_iterations`` (silently returning over-trained
-    factors as an N-iteration model would corrupt experiments).
+    runs already past ``num_iterations`` (silently returning over-trained
+    factors as an N-iteration model would corrupt experiments), and — when
+    the expected ``u_shape``/``m_shape`` are given — stale checkpoints whose
+    padded row counts don't match this run (different pad_multiple/
+    num_shards), which would otherwise surface as an opaque shape error deep
+    inside the jitted iteration.
     """
     if manager is None or manager.latest_iteration() is None:
         return None
@@ -58,6 +64,8 @@ def resume_state(
             f"num_iterations={num_iterations}; restore() an earlier step "
             "explicitly or use a fresh checkpoint directory"
         )
+    if u_shape is not None:
+        _check_shapes(state, u_shape, m_shape)
     return state
 
 
@@ -81,28 +89,11 @@ def resume_state_synced(
     """
     import jax
 
-    def check_shapes(state):
-        # A stale checkpoint with different padded_entities (different
-        # pad_multiple/num_shards) would otherwise crash or hang *inside*
-        # the factor broadcast, since stateless processes allocate zeros of
-        # the current shapes.
-        got = (tuple(state.user_factors.shape), tuple(state.movie_factors.shape))
-        if got != (tuple(u_shape), tuple(m_shape)):
-            raise ValueError(
-                f"checkpoint at iteration {state.iteration} has factor shapes "
-                f"user={got[0]} movie={got[1]}, but this run needs "
-                f"user={tuple(u_shape)} movie={tuple(m_shape)} (padded "
-                "entity counts depend on pad_multiple/num_shards); use a "
-                "fresh checkpoint directory"
-            )
-
     if jax.process_count() == 1:
-        state = resume_state(
-            manager, rank=rank, model=model, num_iterations=num_iterations
+        return resume_state(
+            manager, rank=rank, model=model, num_iterations=num_iterations,
+            u_shape=u_shape, m_shape=m_shape,
         )
-        if state is not None:
-            check_shapes(state)
-        return state
     from jax.experimental import multihost_utils as mh
 
     # Only process 0's checkpoint is authoritative — other processes never
@@ -116,10 +107,9 @@ def resume_state_synced(
     if jax.process_index() == 0:
         try:
             state = resume_state(
-                manager, rank=rank, model=model, num_iterations=num_iterations
+                manager, rank=rank, model=model, num_iterations=num_iterations,
+                u_shape=u_shape, m_shape=m_shape,
             )
-            if state is not None:
-                check_shapes(state)
         except Exception as e:
             err = e
         status = -2 if err is not None else (-1 if state is None else state.iteration)
@@ -151,6 +141,18 @@ def resume_state_synced(
         movie_factors=np.asarray(mh.broadcast_one_to_all(m)),
         meta=state.meta if state is not None else {"model": model},
     )
+
+
+def _check_shapes(state: "CheckpointState", u_shape, m_shape) -> None:
+    got = (tuple(state.user_factors.shape), tuple(state.movie_factors.shape))
+    if got != (tuple(u_shape), tuple(m_shape)):
+        raise ValueError(
+            f"checkpoint at iteration {state.iteration} has factor shapes "
+            f"user={got[0]} movie={got[1]}, but this run needs "
+            f"user={tuple(u_shape)} movie={tuple(m_shape)} (padded entity "
+            "counts depend on pad_multiple/num_shards); use a fresh "
+            "checkpoint directory"
+        )
 
 
 def should_save(done: int, every: int, total: int) -> bool:

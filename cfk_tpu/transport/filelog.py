@@ -168,6 +168,51 @@ class FileBroker:
         self._counts[(topic, partition)] += 1
         self._bytes[(topic, partition)] += _HEADER.size + len(value)
 
+    def produce_frames(
+        self, topic: str, keys, frames, partition: int
+    ) -> None:
+        """Bulk append of n equal-size values in one write syscall.
+
+        ``keys`` is an int array [n], ``frames`` a uint8 array [n, vbytes]
+        (each row one record value).  Semantically identical to n ``produce``
+        calls; exists because checkpoint journaling appends ~500k factor-row
+        frames per iteration and the per-record path would dominate save
+        time with Python-loop and syscall overhead.
+        """
+        import numpy as np
+
+        keys = np.asarray(keys)
+        frames = np.asarray(frames, dtype=np.uint8)
+        n, vbytes = frames.shape
+        if keys.shape != (n,):
+            raise ValueError(f"keys shape {keys.shape} != ({n},)")
+        nparts = self._num_partitions_checked(topic)
+        if not 0 <= partition < nparts:
+            raise IndexError(f"partition {partition} out of range for {topic!r}")
+        fh = self._files.get((topic, partition))
+        if fh is None:
+            fh = open(_log_path(os.path.join(self.directory, topic), partition), "ab")
+            self._files[(topic, partition)] = fh
+        blob = np.empty((n, _HEADER.size + vbytes), np.uint8)
+        blob[:, 0:4] = (
+            np.ascontiguousarray(keys.astype(">i4")).view(np.uint8).reshape(n, 4)
+        )
+        blob[:, 4:8] = np.frombuffer(np.array(vbytes, ">u4").tobytes(), np.uint8)
+        blob[:, 8:] = frames
+        base_count = self._counts[(topic, partition)]
+        base_bytes = self._bytes[(topic, partition)]
+        rec_bytes = _HEADER.size + vbytes
+        index = self._index[(topic, partition)]
+        first = (-base_count) % _INDEX_EVERY
+        for i in range(first, n, _INDEX_EVERY):
+            index.append(base_bytes + i * rec_bytes)
+        fh.write(blob.tobytes())
+        if self._fsync:
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._counts[(topic, partition)] = base_count + n
+        self._bytes[(topic, partition)] = base_bytes + n * rec_bytes
+
     def consume(
         self, topic: str, partition: int, start_offset: int = 0
     ) -> Iterator[Record]:

@@ -1,0 +1,95 @@
+"""Model-FLOP / HBM-byte accounting and MFU for the ALS iteration.
+
+The reference has no notion of compute efficiency — its hot loop is a
+per-entity EJML solve (``processors/MFeatureCalculator.java:85-99``) and its
+only telemetry is wall-clock milliseconds.  On TPU the honest yardstick is
+the hardware: model FLOPs per iteration over the chip's peak (MFU), and the
+minimum HBM traffic over measured bandwidth (roofline).  These numbers are
+printed by ``bench.py`` so every recorded benchmark carries its efficiency.
+
+Conventions
+-----------
+- *Model FLOPs* count the algorithmic minimum, independent of backend: the
+  Gram/RHS contractions (2 FLOPs per MAC) plus one Cholesky-cost solve per
+  entity.  Implementation overhead (the pallas Gauss-Jordan's 2k³ vs
+  Cholesky's k³/3, padding waste, masked lanes) deliberately does NOT count —
+  MFU measures useful work extracted from the chip.
+- *Min HBM bytes* count each operand's unavoidable traffic once: the random
+  neighbor-factor gathers, one read of the block arrays, one write+read of
+  the per-entity Gram/RHS intermediates (they cross an op boundary into the
+  solve), and the factor write-back.  Fusion can only approach this from
+  above; the gap between the measured iteration and ``min_bytes / bandwidth``
+  is the tractable inefficiency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# TPU v5e (v5 lite) single chip, from the public spec sheet.
+V5E_PEAK_BF16_FLOPS = 197e12  # per second
+V5E_HBM_BYTES_PER_S = 819e9
+V5E_HBM_BYTES = 16 * 1024**3
+
+
+@dataclasses.dataclass(frozen=True)
+class IterationCost:
+    """Per-full-iteration (both half-steps) model cost of one ALS sweep."""
+
+    model_flops: float
+    min_hbm_bytes: float
+
+    def achieved_tflops(self, seconds: float) -> float:
+        return self.model_flops / seconds / 1e12
+
+    def mfu(self, seconds: float, peak_flops: float = V5E_PEAK_BF16_FLOPS) -> float:
+        return self.model_flops / seconds / peak_flops
+
+    def hbm_bound_s(self, bandwidth: float = V5E_HBM_BYTES_PER_S) -> float:
+        """Roofline floor: the iteration can never beat this wall-clock."""
+        return self.min_hbm_bytes / bandwidth
+
+
+def als_iteration_cost(
+    nnz: int,
+    num_users: int,
+    num_movies: int,
+    rank: int,
+    *,
+    factor_bytes: int = 2,  # bf16 storage
+    implicit: bool = False,
+) -> IterationCost:
+    """Model FLOPs + minimum HBM bytes for one full ALS(-WR / iALS) iteration.
+
+    FLOPs:
+      - Gram + RHS: every rating contributes one rank-k outer product and one
+        scaled vector add on each side → 2 · nnz · k · (k+1) FLOPs per side
+        (the RHS rides as column k+1 of the grouped matmul).
+      - Solves: one SPD solve per entity per iteration, counted at Cholesky
+        cost k³/3 + 2k² (factorization + two triangular solves).
+      - iALS adds the global Gram YᵀY: 2 · (U+M) · k² per iteration.
+
+    Bytes (minimum):
+      - neighbor-factor gathers: nnz · k · factor_bytes per side,
+      - block arrays read once: neighbor idx (4 B) + rating (4 B) per rating
+        per side (the mask is derivable and the segment metadata is O(E)),
+      - Gram/RHS intermediates cross the matmul→solve op boundary:
+        (U + M) · (k² + k) · 4 bytes written + read,
+      - factor write-back: (U + M) · k · factor_bytes.
+    """
+    k = rank
+    entities = num_users + num_movies
+    gram = 2.0 * nnz * k * (k + 1) * 2  # both sides
+    solve = entities * (k**3 / 3.0 + 2.0 * k**2)
+    flops = gram + solve
+    if implicit:
+        flops += 2.0 * entities * k * k  # global YᵀY
+
+    gather = 2.0 * nnz * k * factor_bytes
+    blocks = 2.0 * nnz * 8
+    gram_io = entities * (k * k + k) * 4.0 * 2
+    factors_out = entities * k * factor_bytes
+    return IterationCost(
+        model_flops=flops,
+        min_hbm_bytes=gather + blocks + gram_io + factors_out,
+    )

@@ -1,0 +1,177 @@
+"""Transport factor journal: the topics-as-durable-checkpoint design.
+
+Covers VERDICT.md round-1 item #3: per-iteration factor shards travel as
+FeatureRecord wire frames through a Transport topic pair (the reference's
+``user-features-i``/``movie-features-i`` journal, ``setup.sh:18-21``), and —
+unlike the reference, which never reads its journal back — training resumes
+from the latest committed iteration.
+"""
+
+import numpy as np
+import pytest
+
+from cfk_tpu.transport.broker import InMemoryBroker
+from cfk_tpu.transport.filelog import FileBroker
+from cfk_tpu.transport.journal import (
+    JournalCheckpointManager,
+    decode_feature_rows,
+    encode_feature_rows,
+)
+from cfk_tpu.transport.serdes import FeatureRecord, decode_feature, encode_feature
+
+
+def test_vectorized_frames_byte_identical_to_serde():
+    """The bulk encoder must produce exactly the FeatureMessage wire format
+    (the whole point: the journal is the codec's live consumer)."""
+    rng = np.random.default_rng(0)
+    mat = rng.standard_normal((5, 3)).astype(np.float32)
+    rows = np.array([0, 7, 2, 9, 4], dtype=np.int64)
+    frames = encode_feature_rows(mat, rows)
+    for i in range(5):
+        want = encode_feature(
+            FeatureRecord(id=int(rows[i]), dependent_ids=(), features=mat[i])
+        )
+        assert frames[i].tobytes() == want
+        rec = decode_feature(frames[i].tobytes())
+        assert rec.id == rows[i]
+        np.testing.assert_array_equal(rec.features, mat[i])
+
+
+def test_decode_feature_rows_roundtrip():
+    rng = np.random.default_rng(1)
+    mat = rng.standard_normal((17, 4)).astype(np.float32)
+    rows = np.arange(17, dtype=np.int64)[::-1].copy()
+    blob = encode_feature_rows(mat, rows).tobytes()
+    ids, feats = decode_feature_rows(blob, 17, 4)
+    np.testing.assert_array_equal(ids, rows)
+    np.testing.assert_array_equal(feats, mat)
+
+
+@pytest.mark.parametrize("partitions", [1, 3])
+def test_save_restore_roundtrip_inmemory(partitions):
+    mgr = JournalCheckpointManager(
+        InMemoryBroker(), num_partitions=partitions
+    )
+    rng = np.random.default_rng(2)
+    u = rng.standard_normal((10, 4)).astype(np.float32)
+    m = rng.standard_normal((7, 4)).astype(np.float32)
+    mgr.save(3, u, m, meta={"model": "als"})
+    assert mgr.latest_iteration() == 3
+    state = mgr.restore()
+    assert state.iteration == 3
+    assert state.meta["model"] == "als"
+    np.testing.assert_array_equal(state.user_factors, u)
+    np.testing.assert_array_equal(state.movie_factors, m)
+
+
+def test_filebroker_journal_survives_reopen(tmp_path):
+    """Kill (close) the broker after a save; a fresh FileBroker over the same
+    directory must restore identical factors — durable-log semantics."""
+    rng = np.random.default_rng(3)
+    u = rng.standard_normal((64, 5)).astype(np.float32)
+    m = rng.standard_normal((33, 5)).astype(np.float32)
+    with FileBroker(str(tmp_path), fsync=False) as broker:
+        mgr = JournalCheckpointManager(broker, num_partitions=2)
+        mgr.save(1, u * 0.5, m * 0.5)
+        mgr.save(2, u, m, meta={"model": "als"})
+    with FileBroker(str(tmp_path), fsync=False) as broker:
+        mgr = JournalCheckpointManager(broker, num_partitions=2)
+        assert mgr.iterations() == [1, 2]
+        state = mgr.restore()
+        assert state.iteration == 2
+        np.testing.assert_array_equal(state.user_factors, u)
+        np.testing.assert_array_equal(state.movie_factors, m)
+        old = mgr.restore(1)
+        np.testing.assert_array_equal(old.user_factors, u * 0.5)
+
+
+def test_uncommitted_iteration_ignored():
+    """A crash between the topic writes and the commit marker must leave the
+    journal at the previous iteration, and a re-save must overwrite."""
+    broker = InMemoryBroker()
+    mgr = JournalCheckpointManager(broker, num_partitions=1)
+    u1, m1 = np.ones((4, 2), np.float32), np.ones((3, 2), np.float32)
+    mgr.save(1, u1, m1)
+    # Simulate the crash: write iteration-2 topics but no commit record.
+    mgr._write_side("user", 2, u1 * 2)
+    mgr._write_side("movie", 2, m1 * 2)
+    assert mgr.latest_iteration() == 1
+    # The re-run saves iteration 2 properly over the torn topics.
+    mgr.save(2, u1 * 3, m1 * 3)
+    state = mgr.restore()
+    assert state.iteration == 2
+    np.testing.assert_array_equal(state.user_factors, u1 * 3)
+
+
+def test_keep_last_prunes_topics():
+    broker = InMemoryBroker()
+    mgr = JournalCheckpointManager(broker, num_partitions=1, keep_last=2)
+    u, m = np.ones((4, 2), np.float32), np.ones((3, 2), np.float32)
+    for i in range(1, 5):
+        mgr.save(i, u * i, m * i)
+    assert mgr.iterations() == [3, 4]
+    with pytest.raises(FileNotFoundError, match="pruned"):
+        mgr.restore(1)
+    np.testing.assert_array_equal(mgr.restore(3).user_factors, u * 3)
+
+
+def test_bfloat16_journal_roundtrip():
+    import ml_dtypes
+
+    mgr = JournalCheckpointManager(InMemoryBroker())
+    u = np.arange(8, dtype=np.float32).reshape(4, 2).astype(ml_dtypes.bfloat16)
+    m = np.ones((3, 2), ml_dtypes.bfloat16)
+    mgr.save(1, u, m)
+    state = mgr.restore()
+    assert state.user_factors.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        state.user_factors.astype(np.float32), u.astype(np.float32)
+    )
+
+
+def test_train_kill_resume_through_journal(tiny_dataset, tmp_path):
+    """The VERDICT #3 round-trip: train N iters → kill → resume from the
+    broker journal → factors identical to an uninterrupted run."""
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.models.als import train_als
+
+    cfg4 = ALSConfig(rank=3, lam=0.05, num_iterations=4, seed=5)
+    straight = train_als(tiny_dataset, cfg4).predict_dense()
+
+    cfg2 = ALSConfig(rank=3, lam=0.05, num_iterations=2, seed=5)
+    with FileBroker(str(tmp_path), fsync=False) as broker:
+        train_als(
+            tiny_dataset, cfg2,
+            checkpoint_manager=JournalCheckpointManager(broker),
+        )  # "crash" after 2 iterations (process ends, broker closes)
+    with FileBroker(str(tmp_path), fsync=False) as broker:
+        mgr = JournalCheckpointManager(broker)
+        assert mgr.latest_iteration() == 2
+        resumed = train_als(
+            tiny_dataset, cfg4, checkpoint_manager=mgr
+        ).predict_dense()
+    np.testing.assert_allclose(resumed, straight, rtol=1e-5, atol=1e-5)
+
+
+def test_journal_through_tcp_broker(tmp_path):
+    """The same journal against a cfk_broker server process."""
+    from cfk_tpu.transport.tcp import BrokerProcess, build_broker
+
+    if not build_broker():
+        pytest.skip("native broker unavailable")
+    rng = np.random.default_rng(4)
+    u = rng.standard_normal((20, 3)).astype(np.float32)
+    m = rng.standard_normal((11, 3)).astype(np.float32)
+    with BrokerProcess(data_dir=str(tmp_path)) as server:
+        with server.connect() as client:
+            mgr = JournalCheckpointManager(client, num_partitions=2)
+            mgr.save(7, u, m, meta={"model": "als"})
+    # Restart the server over the same data dir: the journal must persist.
+    with BrokerProcess(data_dir=str(tmp_path)) as server:
+        with server.connect() as client:
+            mgr = JournalCheckpointManager(client, num_partitions=2)
+            assert mgr.latest_iteration() == 7
+            state = mgr.restore()
+            np.testing.assert_array_equal(state.user_factors, u)
+            np.testing.assert_array_equal(state.movie_factors, m)
+            assert state.meta["model"] == "als"
