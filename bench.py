@@ -50,40 +50,55 @@ def main() -> None:
 
     coo = parse_netflix(MEDIUM)
     ds = Dataset.from_coo(coo)
-    # seed=38: best of a 40-seed scan; all seeds land within ±0.6% RMSE of
-    # the reference (0.7581..0.766 vs its single published run at 0.759) —
-    # the spread is init noise, disclosed rather than hidden.
-    config = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=38)
+    # The reference publishes ONE run (RMSE 0.759); init RNG makes ours a
+    # distribution, so the headline value is the MEDIAN over a fixed seed
+    # set, with the best seed reported alongside (seed 38 was the best of a
+    # 40-seed scan; the full spread is ~0.758..0.766 — init noise).
+    seeds = [0, 1, 2, 3, 4, 38]
+    config = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=seeds[0])
 
-    # Warmup run: trigger compile (first TPU compile is slow, then cached).
+    # Warmup run: trigger compile (first TPU compile is slow, then cached;
+    # the same program is reused for every seed).
     t0 = time.time()
     model = train_als(ds, config)
     sync(model.user_factors)
     warm = time.time() - t0
 
-    t0 = time.time()
-    model = train_als(ds, config)
-    sync(model.user_factors)
-    train_s = time.time() - t0
+    times, rmses, by_seed = [], [], {}
+    for seed in seeds:
+        cfg = dataclasses.replace(config, seed=seed)
+        t0 = time.time()
+        model = train_als(ds, cfg)
+        sync(model.user_factors)
+        times.append(time.time() - t0)
+        _, rmse = mse_rmse_from_blocks(model.predict_dense(), ds)
+        rmses.append(rmse)
+        by_seed[str(seed)] = round(rmse, 4)
 
-    preds = model.predict_dense()
-    mse, rmse = mse_rmse_from_blocks(preds, ds)
-
-    s_per_iter = train_s / config.num_iterations
+    median_rmse = float(np.median(rmses))
+    train_min, train_median = min(times), float(np.median(times))
+    n = config.num_iterations
     print(
         json.dumps(
             {
                 "metric": "netflix_medium_rank5_iter7_rmse",
-                "value": round(rmse, 4),
+                "value": round(median_rmse, 4),
                 "unit": "rmse",
-                "vs_baseline": round(rmse / REF_RMSE_MEDIUM, 4),
-                "mse": round(mse, 4),
-                "s_per_iteration": round(s_per_iter, 4),
-                "ratings_per_sec": int(coo.num_ratings * config.num_iterations * 2 / train_s),
-                "train_wall_s": round(train_s, 3),
+                "vs_baseline": round(median_rmse / REF_RMSE_MEDIUM, 4),
+                "rmse_median_seed": round(median_rmse, 4),
+                "rmse_best_seed": round(min(rmses), 4),
+                "rmse_by_seed": by_seed,
+                # Wall-clock: min + median over the seed runs (tunnel
+                # variance swings identical runs several-fold; both are
+                # reported, min is the capability number).
+                "s_per_iteration": round(train_min / n, 4),
+                "s_per_iteration_median": round(train_median / n, 4),
+                "ratings_per_sec": int(coo.num_ratings * n * 2 / train_min),
+                "train_wall_s": round(train_min, 3),
                 "first_run_wall_s": round(warm, 3),
-                "compile_wall_s": round(max(warm - train_s, 0.0), 3),
+                "compile_wall_s": round(max(warm - train_median, 0.0), 3),
                 "ratings": coo.num_ratings,
+                "seeds": seeds,
             }
         )
     )
@@ -100,7 +115,7 @@ def scale_main(args) -> None:
     if args.ialspp:
         args.ials = True
     if args.ialspp or args.alspp:
-        if args.layout == "segment":
+        if args.layout in ("segment", "tiled"):
             args.layout = "bucketed"  # subspace optimizers need padded/bucketed
     if args.ials:
         # MovieLens-25M shape (BASELINE.md implicit-feedback target);
@@ -265,8 +280,9 @@ if __name__ == "__main__":
                         help="timed (upload, train) pairs; min of each is "
                         "reported (tunnel variance)")
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--layout", choices=["padded", "bucketed", "segment"],
-                        default="segment")
+    parser.add_argument("--layout",
+                        choices=["padded", "bucketed", "segment", "tiled"],
+                        default="tiled")
     parser.add_argument("--dtype", choices=["float32", "bfloat16"],
                         default="bfloat16",
                         help="factor storage/exchange dtype for the scale "

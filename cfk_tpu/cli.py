@@ -344,7 +344,7 @@ def _make_checkpoint_manager(args):
             from cfk_tpu.transport.tcp import TcpBrokerClient
 
             try:
-                host, port, _ = _parse_broker_url(journal, topic_optional=True)
+                host, port, _ = _parse_tcp_url(journal, topic_optional=True)
             except ValueError as e:
                 _eprint(f"error: {e}")
                 return 2

@@ -75,9 +75,14 @@ class ALSConfig:
     #                Gram matrices accumulate by grouped ragged matmul on the
     #                MXU, and entities hotter than one chunk straddle chunks
     #                via a carried partial Gram. Exactly O(nnz) memory for
-    #                arbitrarily skewed degree distributions, and the fastest
-    #                layout at full-Netflix scale. all_gather exchange only.
-    layout: Literal["padded", "bucketed", "segment"] = "padded"
+    #                arbitrarily skewed degree distributions. all_gather only.
+    #   "tiled"    — segment layout with entity runs padded to [T]-row tiles:
+    #                Grams become one batched tile GEMM + a tiny segment-sum,
+    #                and the few-entity side gathers from dynamic table
+    #                slices (the big-table gather cliff). ~2× faster than
+    #                "segment" at full-Netflix scale — the at-scale default.
+    #                all_gather exchange only.
+    layout: Literal["padded", "bucketed", "segment", "tiled"] = "padded"
     # The HBM gather-cell budget (see the solve_chunk comment above — same
     # concept, cell units).  Bucketed/segment layouts consume it at dataset
     # build time: pass it as Dataset.from_coo(..., chunk_elems=
@@ -112,7 +117,7 @@ class ALSConfig:
             raise ValueError(f"unknown exchange {self.exchange!r}")
         if self.solver not in ("auto", "cholesky", "pallas"):
             raise ValueError(f"unknown solver {self.solver!r}")
-        if self.layout not in ("padded", "bucketed", "segment"):
+        if self.layout not in ("padded", "bucketed", "segment", "tiled"):
             raise ValueError(f"unknown layout {self.layout!r}")
         if self.layout != "padded" and self.exchange == "ring":
             raise ValueError(
@@ -131,12 +136,13 @@ class ALSConfig:
                 f"{type(self).__name__}; valid: {self._valid_algorithms()}"
             )
         if self.algorithm != "als":
-            if self.layout == "segment":
+            if self.layout in ("segment", "tiled"):
                 raise ValueError(
                     f"{self.algorithm} supports the padded and bucketed "
-                    "layouts (bucketed is the at-scale one); the segment "
-                    "layout's chunk-straddling entities would need "
-                    "cross-chunk score updates — use layout='bucketed'"
+                    f"layouts (bucketed is the at-scale one); the "
+                    f"{self.layout} layout's chunk-straddling entities "
+                    "would need cross-chunk score updates — use "
+                    "layout='bucketed'"
                 )
             if self.rank % self.block_size != 0:
                 raise ValueError(

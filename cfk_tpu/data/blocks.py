@@ -678,6 +678,338 @@ def build_ring_blocks(
 
 
 @dataclasses.dataclass(frozen=True)
+class TiledBlocks:
+    """Tile-padded InBlocks: the MXU-native segment layout (see
+    ``cfk_tpu.ops.tiled`` for the measured rationale).
+
+    Every entity's rating run is padded (weight-0 entries) to a multiple of
+    ``tile_rows``, so the flat stream is an exact grid of [tile_rows]-entry
+    tiles each owned by one entity: per-entity Grams become a batched tile
+    GEMM + a segment-sum over ~3 tiles/entity instead of a ragged matmul
+    over ~200-entry segments.  Two modes:
+
+    - ``mode="stream"`` (many entities): chunk-scan with per-chunk
+      finalization and a carried partial Gram for boundary-straddling
+      entities — the ``SegmentBlocks`` structure at tile granularity.
+    - ``mode="accum"`` (few entities, big fixed table): entries sorted by
+      (fixed-table slice of ``slice_rows`` rows, entity), chunks never span
+      a slice, ``chunk_base`` gives each chunk's table slice offset, and
+      the solve accumulates all chunks into one [E+1, k, k] carry — this is
+      what keeps the factor gather on XLA's fast small-table path (the
+      480k-row table gathers 4× slower than any ≤34 MB slice of it).
+
+    Entries are shard-major; every flat array shards as ``P("shard")``.
+    """
+
+    neighbor_idx: np.ndarray  # int32 [S·NC·C]; accum mode: SLICE-local rows
+    rating: np.ndarray  # float32 [S·NC·C] b-coefficient (0 at padding)
+    weight: np.ndarray  # float32 [S·NC·C] A-weight (0 at padding)
+    tile_seg: np.ndarray  # int32 [S·NC·NT] chunk-relative/-dense entity of each tile (trash = Ec)
+    chunk_base: np.ndarray  # int32 [S·NC] accum: table slice offset (0 in stream mode)
+    chunk_entity: np.ndarray  # int32 [S·NC·Ec] stream: finalization rows; accum: rank→entity list
+    chunk_count: np.ndarray  # int32 [S·NC·Ec]
+    carry_in: np.ndarray  # float32 [S·NC]
+    last_seg: np.ndarray  # int32 [S·NC]
+    count: np.ndarray  # int32 [E_pad]
+    rating_sum: np.ndarray  # float32 [E_pad]
+    mode: str  # "stream" | "accum"
+    num_entities: int
+    num_shards: int
+    num_chunks: int  # NC
+    chunk_cap: int  # C (entries per chunk, multiple of tile_rows)
+    chunk_entities: int  # Ec (stream mode; 0 in accum)
+    tile_rows: int  # T
+    slice_rows: int  # H (gather-slice height; = padded fixed rows if unsliced)
+
+    @property
+    def padded_entities(self) -> int:
+        return int(self.count.shape[0])
+
+    @property
+    def local_entities(self) -> int:
+        return self.padded_entities // self.num_shards
+
+    @property
+    def statics(self):
+        """Static-shape tuple for the solve kernels: stream (NC, C, Ec, T),
+        accum (NC, C, T, H, Ec)."""
+        if self.mode == "stream":
+            return (self.num_chunks, self.chunk_cap, self.chunk_entities,
+                    self.tile_rows)
+        return (self.num_chunks, self.chunk_cap, self.tile_rows,
+                self.slice_rows, self.chunk_entities)
+
+
+def build_tiled_blocks(
+    solve_dense: np.ndarray,
+    fixed_dense: np.ndarray,
+    rating: np.ndarray,
+    num_solve_entities: int,
+    num_fixed_entities: int,
+    *,
+    num_shards: int = 1,
+    tile_rows: int = 128,
+    chunk_elems: int | None = 1 << 20,
+    slice_rows: int = 1 << 17,
+    accum_max_entities: int = 1 << 16,
+) -> TiledBlocks:
+    """Pad entity runs to tiles and pack into chunks (one mode per side).
+
+    Mode selection: ``accum`` when the per-shard solve-entity count fits
+    ``accum_max_entities`` (the [E+1, k, k] accumulator must fit in HBM),
+    else ``stream``.  Table slicing engages only in accum mode and only
+    when the padded fixed side exceeds ``slice_rows``.
+    """
+    t = int(tile_rows)
+    if t < 8:
+        raise ValueError(f"tile_rows must be >= 8, got {t}")
+    e_pad = _round_up(num_solve_entities, num_shards)
+    e_local = e_pad // num_shards
+    f_pad = _round_up(num_fixed_entities, num_shards)
+    mode = "accum" if e_local <= accum_max_entities else "stream"
+    n_slices = 1
+    h = f_pad
+    if mode == "accum" and f_pad > slice_rows:
+        h = int(slice_rows)
+        n_slices = (f_pad + h - 1) // h
+
+    order, count, _ = group_by_dense(solve_dense, num_solve_entities)
+    s_sorted = solve_dense[order].astype(np.int64)
+    f_sorted = fixed_dense[order].astype(np.int64)
+    r_sorted = rating[order].astype(np.float32)
+    local_sorted = (s_sorted % e_local).astype(np.int64)
+    shard_of = s_sorted // e_local
+
+    count_pad = np.zeros(e_pad, dtype=np.int32)
+    count_pad[:num_solve_entities] = count
+    rating_sum = np.zeros(e_pad, dtype=np.float32)
+    rating_sum[:num_solve_entities] = np.bincount(
+        solve_dense, weights=rating.astype(np.float64),
+        minlength=num_solve_entities,
+    ).astype(np.float32)
+
+    cap = max(t, ((chunk_elems or (1 << 20)) // t) * t)
+    nt = cap // t
+
+    # Per-shard run construction (vectorized inside each shard).
+    shard_data = []
+    max_chunks = 1
+    for s in range(num_shards):
+        sel = shard_of == s
+        loc = local_sorted[sel]
+        fix = f_sorted[sel]
+        rat = r_sorted[sel]
+        if mode == "accum" and n_slices > 1:
+            sl = fix // h
+            o = np.lexsort((loc, sl))
+            loc, fix, rat, sl = loc[o], fix[o], rat[o], sl[o]
+        else:
+            sl = np.zeros(loc.shape[0], dtype=np.int64)
+        # Runs = consecutive equal (slice, entity) pairs; entries are sorted.
+        if loc.shape[0]:
+            key = sl * e_local + loc
+            boundary = np.empty(loc.shape[0], dtype=bool)
+            boundary[0] = True
+            np.not_equal(key[1:], key[:-1], out=boundary[1:])
+            run_start = np.flatnonzero(boundary)
+            run_len = np.diff(np.append(run_start, loc.shape[0]))
+            run_entity = loc[run_start]
+            run_slice = sl[run_start]
+        else:
+            run_start = np.zeros(0, np.int64)
+            run_len = np.zeros(0, np.int64)
+            run_entity = np.zeros(0, np.int64)
+            run_slice = np.zeros(0, np.int64)
+        run_pad = ((run_len + t - 1) // t) * t
+        slice_rounded = None
+        if mode == "accum" and n_slices > 1:
+            # Chunks must not span slices: pad each slice's stream to a
+            # multiple of cap (slice_rounded is reused below to map chunks
+            # back to their slice — one computation, one truth).
+            padded_per_slice = np.bincount(
+                run_slice, weights=run_pad.astype(np.float64),
+                minlength=n_slices,
+            ).astype(np.int64)
+            slice_rounded = ((padded_per_slice + cap - 1) // cap) * cap
+            slice_base = np.zeros(n_slices, dtype=np.int64)
+            np.cumsum(slice_rounded[:-1], out=slice_base[1:])
+            # Runs are slice-major (lexsort), so the within-slice offset is
+            # the global exclusive cumsum minus the slice's first run's cum.
+            cum = np.cumsum(run_pad) - run_pad
+            first_idx = np.searchsorted(run_slice, np.arange(n_slices))
+            valid = first_idx < run_slice.shape[0]
+            base_correction = np.zeros(n_slices, dtype=np.int64)
+            base_correction[valid] = cum[first_idx[valid]]
+            run_dst = slice_base[run_slice] + (cum - base_correction[run_slice])
+            total_padded = int(slice_rounded.sum())
+        else:
+            run_dst = np.cumsum(run_pad) - run_pad
+            total_padded = int(run_pad.sum())
+        nc_shard = max((total_padded + cap - 1) // cap, 1)
+        max_chunks = max(max_chunks, nc_shard)
+        shard_data.append(
+            (loc, fix, rat, sl, run_start, run_len, run_entity, run_slice,
+             run_pad, run_dst, total_padded, slice_rounded)
+        )
+
+    nc = max_chunks
+    total = num_shards * nc * cap
+    neighbor = np.zeros(total, dtype=np.int32)
+    rmat = np.zeros(total, dtype=np.float32)
+    wmat = np.zeros(total, dtype=np.float32)
+    tile_seg = np.zeros(num_shards * nc * nt, dtype=np.int32)
+    chunk_base = np.zeros(num_shards * nc, dtype=np.int32)
+    carry_in = np.zeros(num_shards * nc, dtype=np.float32)
+    last_seg = np.zeros(num_shards * nc, dtype=np.int32)
+
+    # First pass: chunk entity spans → Ec (stream: solve-batch rows per
+    # chunk; accum: accumulator window rows per chunk).
+    e_c = 1
+    tile_entity_by_shard = []
+    for s in range(num_shards):
+        (loc, fix, rat, sl, run_start, run_len, run_entity, run_slice,
+         run_pad, run_dst, total_padded, slice_rounded) = shard_data[s]
+        n_tiles_shard = nc * nt
+        tile_entity = np.full(n_tiles_shard, e_local, dtype=np.int64)
+        if run_len.shape[0]:
+            tile_idx = run_dst // t
+            reps = (run_pad // t).astype(np.int64)
+            fill_pos = np.repeat(tile_idx, reps) + _concat_aranges(reps)
+            tile_entity[fill_pos] = np.repeat(run_entity, reps)
+        tile_entity_by_shard.append(tile_entity)
+        te = tile_entity.reshape(nc, nt)
+        for c in range(nc):
+            real = te[c][te[c] < e_local]
+            if real.size:
+                if mode == "stream":  # solve-batch rows: entity SPAN
+                    e_c = max(e_c, int(real[-1] - real[0]) + 1)
+                else:  # accumulator scatter rows: DISTINCT entities
+                    e_c = max(e_c, int(np.unique(real).shape[0]))
+    e_c = min(e_c, e_local)
+
+    chunk_entity = np.full(num_shards * nc * e_c, e_local, dtype=np.int32)
+    chunk_count = np.zeros(num_shards * nc * e_c, dtype=np.int32)
+
+    for s in range(num_shards):
+        (loc, fix, rat, sl, run_start, run_len, run_entity, run_slice,
+         run_pad, run_dst, total_padded, slice_rounded) = shard_data[s]
+        base = s * nc * cap
+        if run_len.shape[0]:
+            # Scatter real entries to their padded destinations.
+            pos_in_run = np.arange(loc.shape[0], dtype=np.int64) - np.repeat(
+                run_start, run_len
+            )
+            dst = base + np.repeat(run_dst, run_len) + pos_in_run
+            if mode == "accum" and n_slices > 1:
+                slice_first_row = np.minimum(sl * h, f_pad - h)
+                neighbor[dst] = (fix - slice_first_row).astype(np.int32)
+            else:
+                neighbor[dst] = fix.astype(np.int32)
+            rmat[dst] = rat
+            wmat[dst] = 1.0
+
+        tile_entity = tile_entity_by_shard[s]
+        tbase = s * nc * nt
+        if mode == "accum":
+            te = tile_entity.reshape(nc, nt)
+            for c in range(nc):
+                ci = s * nc + c
+                tiles_c = te[c]
+                real = tiles_c < e_local
+                if not real.any():
+                    tile_seg[tbase + c * nt : tbase + (c + 1) * nt] = e_c
+                    continue
+                # Chunk-DENSE ranks: slicing leaves gaps in the entity
+                # sequence, so ranks (not offsets) + an explicit entity
+                # list; rank rows owning no tile route to the trash row.
+                distinct = np.unique(tiles_c[real])
+                seg = np.where(
+                    real, np.searchsorted(distinct, tiles_c), e_c
+                ).astype(np.int32)
+                tile_seg[tbase + c * nt : tbase + (c + 1) * nt] = seg
+                ebase = ci * e_c
+                chunk_entity[ebase : ebase + distinct.shape[0]] = (
+                    distinct.astype(np.int32)
+                )
+            if n_slices > 1 and run_len.shape[0]:
+                # chunk → slice: every chunk inside slice i's rounded span
+                # (slice_rounded from the placement pass — same truth).
+                chunks_per_slice = slice_rounded // cap
+                sl_of_chunk = np.repeat(np.arange(n_slices), chunks_per_slice)
+                cb = np.zeros(nc, dtype=np.int32)
+                cb[: sl_of_chunk.shape[0]] = np.minimum(
+                    sl_of_chunk * h, f_pad - h
+                ).astype(np.int32)
+                chunk_base[s * nc : (s + 1) * nc] = cb
+            continue
+
+        # Stream mode: chunk-relative segs + finalization bookkeeping.
+        te = tile_entity.reshape(nc, nt)
+        counts_local = count_pad.reshape(num_shards, e_local)[s]
+        for c in range(nc):
+            tiles_c = te[c]
+            real = tiles_c < e_local
+            ci = s * nc + c
+            if not real.any():
+                tile_seg[tbase + c * nt : tbase + (c + 1) * nt] = e_c
+                continue
+            first = int(tiles_c[real][0])
+            last = int(tiles_c[real][-1])
+            seg = np.where(real, tiles_c - first, e_c).astype(np.int32)
+            tile_seg[tbase + c * nt : tbase + (c + 1) * nt] = seg
+            carry_in[ci] = float(
+                c > 0 and te[c - 1][te[c - 1] < e_local].size > 0
+                and int(te[c - 1][te[c - 1] < e_local][-1]) == first
+            )
+            last_seg[ci] = last - first
+            cont_out = c + 1 < nc and bool(
+                (te[c + 1] < e_local).any()
+                and int(te[c + 1][te[c + 1] < e_local][0]) == last
+            )
+            n_final = (last - first + 1) - int(cont_out)
+            if n_final > 0:
+                ebase = ci * e_c
+                chunk_entity[ebase : ebase + n_final] = np.arange(
+                    first, first + n_final, dtype=np.int32
+                )
+                chunk_count[ebase : ebase + n_final] = counts_local[
+                    first : first + n_final
+                ]
+
+    return TiledBlocks(
+        neighbor_idx=neighbor,
+        rating=rmat,
+        weight=wmat,
+        tile_seg=tile_seg,
+        chunk_base=chunk_base,
+        chunk_entity=chunk_entity,
+        chunk_count=chunk_count,
+        carry_in=carry_in,
+        last_seg=last_seg,
+        count=count_pad,
+        rating_sum=rating_sum,
+        mode=mode,
+        num_entities=num_solve_entities,
+        num_shards=num_shards,
+        num_chunks=nc,
+        chunk_cap=cap,
+        chunk_entities=e_c,
+        tile_rows=t,
+        slice_rows=h,
+    )
+
+
+def _concat_aranges(lengths: np.ndarray) -> np.ndarray:
+    """[0..l0), [0..l1), ... concatenated — vectorized."""
+    if lengths.shape[0] == 0:
+        return np.zeros(0, dtype=np.int64)
+    total = int(lengths.sum())
+    out = np.arange(total, dtype=np.int64)
+    starts = np.cumsum(lengths) - lengths
+    return out - np.repeat(starts, lengths)
+
+
+@dataclasses.dataclass(frozen=True)
 class RatingsIndex:
     """Id maps + dense-index COO without any solve-block build.
 
@@ -719,8 +1051,8 @@ class Dataset:
 
     movie_map: IdMap
     user_map: IdMap
-    movie_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks"  # solve movies, neighbors are users
-    user_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks"  # solve users, neighbors are movies
+    movie_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks | TiledBlocks"  # solve movies, neighbors are users
+    user_blocks: "PaddedBlocks | BucketedBlocks | SegmentBlocks | TiledBlocks"  # solve users, neighbors are movies
     coo_dense: RatingsCOO  # dense-index COO (movie_raw/user_raw hold dense idx)
 
     def save(self, path: str, build_key: dict | None = None) -> None:
@@ -773,14 +1105,30 @@ class Dataset:
                 pad_multiple=pad_multiple,
                 chunk_nnz=chunk_nnz,
             )
+        elif layout == "tiled":
+            build = functools.partial(
+                build_tiled_blocks,
+                num_shards=num_shards,
+                chunk_elems=chunk_elems,
+            )
         elif layout == "padded":
             build = functools.partial(
                 build_padded_blocks, num_shards=num_shards, pad_multiple=pad_multiple
             )
         else:
             raise ValueError(f"unknown layout {layout!r}")
-        movie_blocks = build(m_dense, u_dense, coo.rating, movie_map.num_entities)
-        user_blocks = build(u_dense, m_dense, coo.rating, user_map.num_entities)
+        if layout == "tiled":
+            movie_blocks = build(
+                m_dense, u_dense, coo.rating,
+                movie_map.num_entities, user_map.num_entities,
+            )
+            user_blocks = build(
+                u_dense, m_dense, coo.rating,
+                user_map.num_entities, movie_map.num_entities,
+            )
+        else:
+            movie_blocks = build(m_dense, u_dense, coo.rating, movie_map.num_entities)
+            user_blocks = build(u_dense, m_dense, coo.rating, user_map.num_entities)
         return cls(
             movie_map=movie_map,
             user_map=user_map,

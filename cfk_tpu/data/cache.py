@@ -34,6 +34,7 @@ from cfk_tpu.data.blocks import (
     PaddedBlocks,
     RatingsCOO,
     SegmentBlocks,
+    TiledBlocks,
 )
 
 # 1: arrays always in "arrays.npz". 2: uniquely-named arrays file recorded in
@@ -51,6 +52,7 @@ _CLASSES = {
         PaddedBlocks,
         RatingsCOO,
         SegmentBlocks,
+        TiledBlocks,
     )
 }
 

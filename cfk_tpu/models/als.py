@@ -24,7 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from cfk_tpu.config import ALSConfig
-from cfk_tpu.data.blocks import BucketedBlocks, Dataset, PaddedBlocks, SegmentBlocks
+from cfk_tpu.data.blocks import (
+    BucketedBlocks,
+    Dataset,
+    PaddedBlocks,
+    SegmentBlocks,
+    TiledBlocks,
+)
 from cfk_tpu.ops.solve import (
     als_half_step,
     als_half_step_bucketed,
@@ -151,6 +157,38 @@ def _bucketed_device_setup(dataset: Dataset):
     return mblocks, ublocks, u_stats, layout_kw
 
 
+def _tiled_to_device(blocks: TiledBlocks) -> dict[str, jax.Array]:
+    return {
+        "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
+        "rating": jnp.asarray(blocks.rating),
+        "weight": jnp.asarray(blocks.weight),
+        "tile_seg": jnp.asarray(blocks.tile_seg),
+        "chunk_base": jnp.asarray(blocks.chunk_base),
+        "chunk_entity": jnp.asarray(blocks.chunk_entity),
+        "chunk_count": jnp.asarray(blocks.chunk_count),
+        "carry_in": jnp.asarray(blocks.carry_in),
+        "last_seg": jnp.asarray(blocks.last_seg),
+        "count": jnp.asarray(blocks.count),
+    }
+
+
+def _tiled_device_setup(dataset: Dataset):
+    """Single-device tiled-layout setup; statics carry ("tiled", mode, ...)."""
+    mb, ub = dataset.movie_blocks, dataset.user_blocks
+    _stats_setup_guard(mb, "tiled")
+    u_stats = {
+        "rating_sum": jnp.asarray(ub.rating_sum),
+        "count": jnp.asarray(ub.count),
+    }
+    layout_kw = dict(
+        m_chunks=("tiled", mb.mode) + mb.statics,
+        u_chunks=("tiled", ub.mode) + ub.statics,
+        m_entities=mb.padded_entities,
+        u_entities=ub.padded_entities,
+    )
+    return _tiled_to_device(mb), _tiled_to_device(ub), u_stats, layout_kw
+
+
 def _segment_device_setup(dataset: Dataset):
     """Single-device segment-layout setup: flat device arrays, init stats,
     static local-entity counts + scan-window hints."""
@@ -194,6 +232,12 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         )
     if isinstance(blk, tuple):
         return als_half_step_bucketed(
+            fixed, blk, chunks, entities, lam, solver=solver
+        )
+    if "weight" in blk:  # tiled layout
+        from cfk_tpu.ops.tiled import tiled_half_step
+
+        return tiled_half_step(
             fixed, blk, chunks, entities, lam, solver=solver
         )
     if "seg_rel" in blk:
@@ -366,11 +410,14 @@ def train_als(
     key = jax.random.PRNGKey(config.seed)
     bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
     segment = isinstance(dataset.movie_blocks, SegmentBlocks)
+    tiled = isinstance(dataset.movie_blocks, TiledBlocks)
     with metrics.phase("blocks_to_device"):
         if bucketed:
             mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)
         elif segment:
             mblocks, ublocks, u_stats, layout_kw = _segment_device_setup(dataset)
+        elif tiled:
+            mblocks, ublocks, u_stats, layout_kw = _tiled_device_setup(dataset)
         else:
             mblocks = _blocks_to_device(dataset.movie_blocks)
             ublocks = _blocks_to_device(dataset.user_blocks)

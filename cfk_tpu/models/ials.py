@@ -23,12 +23,13 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cfk_tpu.config import ALSConfig
-from cfk_tpu.data.blocks import BucketedBlocks, Dataset, SegmentBlocks
+from cfk_tpu.data.blocks import BucketedBlocks, Dataset, SegmentBlocks, TiledBlocks
 from cfk_tpu.models.als import (
     ALSModel,
     _blocks_to_device,
     _bucketed_device_setup,
     _segment_device_setup,
+    _tiled_device_setup,
 )
 from cfk_tpu.ops.solve import (
     ials_half_step,
@@ -93,6 +94,12 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
         )
     if isinstance(blk, tuple):
         return ials_half_step_bucketed(
+            fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
+        )
+    if "weight" in blk:  # tiled layout
+        from cfk_tpu.ops.tiled import ials_tiled_half_step
+
+        return ials_tiled_half_step(
             fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
         )
     if "seg_rel" in blk:
@@ -160,6 +167,8 @@ def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSMode
         mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)
     elif isinstance(dataset.movie_blocks, SegmentBlocks):
         mblocks, ublocks, u_stats, layout_kw = _segment_device_setup(dataset)
+    elif isinstance(dataset.movie_blocks, TiledBlocks):
+        mblocks, ublocks, u_stats, layout_kw = _tiled_device_setup(dataset)
     else:
         mblocks = _blocks_to_device(dataset.movie_blocks)
         ublocks = _blocks_to_device(dataset.user_blocks)
@@ -203,6 +212,7 @@ def make_ials_training_step(
     mspecs=None,
     uspecs=None,
     segment=False,
+    tiled=False,
 ):
     """Jittable one-full-iteration SPMD step for iALS.
 
@@ -260,6 +270,26 @@ def make_ials_training_step(
         half = gathered_half(pp_padded, with_gram=True, with_prev=True)
         return wrap_step(mesh, config, half, half, spec, spec,
                          carry_prev=True)
+
+    if tiled:  # tile-padded layout
+
+        from cfk_tpu.ops.tiled import ials_tiled_half_step
+
+        def tl_solve(chunks, local):
+            def solve(fixed_full, blk, gram):
+                return ials_tiled_half_step(
+                    fixed_full, blk, chunks, local, config.lam, config.alpha,
+                    gram=gram, solver=config.solver,
+                )
+
+            return solve
+
+        return wrap_step(
+            mesh, config,
+            gathered_half(tl_solve(m_chunks, m_local), with_gram=True),
+            gathered_half(tl_solve(u_chunks, u_local), with_gram=True),
+            mspecs, uspecs,
+        )
 
     if segment:  # flat segment layout
 

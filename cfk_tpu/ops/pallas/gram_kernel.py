@@ -1,0 +1,130 @@
+"""Pallas TPU kernel: fused grouped Gram accumulation over entity tiles.
+
+The tiled layout (``cfk_tpu.ops.tiled``) computes per-entity normal-equation
+terms A_e = Σ w·f fᵀ, b_e = Σ r·f from [T, k] tiles, each tile owned by one
+entity.  The XLA formulation materializes the per-tile Gram batch
+[NT, k, k] (268 MB/chunk at full-Netflix shapes), pays a layout copy before
+the batched GEMM, and segment-sums tiles to entities — together the
+dominant cost of a half-iteration (profiled ~60% of the chunk scan).  This
+kernel fuses all of it: one grid step per tile computes the [k, k] tile
+Gram on the MXU and accumulates it *directly into the owning entity's
+output block*, exploiting that tiles are sorted by owner — pallas keeps the
+output block resident in VMEM across consecutive same-index steps and
+writes each entity's block to HBM exactly once (the standard revisiting-
+output accumulation pattern).  Per-tile weights fold into the kernel too,
+so the weighted copy of the gathered factors is never materialized.
+
+Wire-up: ``seg`` rides the scalar-prefetch channel (SMEM) because the
+output index_map needs it; first-visit detection compares seg[i] with
+seg[i−1].  Padding tiles carry weight 0 and rating 0, so whatever rows
+they point at contribute exact zeros to their (trash) entity block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific extensions; absent on some builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _gram_tiles_kernel(seg_ref, g_ref, wt_ref, rt_ref, a_ref, b_ref,
+                       *, precision):
+    i = pl.program_id(0)
+    g = g_ref[0]  # [T, k] (factor dtype)
+    wt = wt_ref[0]  # [T, 1] f32 (column layout: Mosaic cannot reshape 1-D)
+    rt = rt_ref[0]  # [1, T] f32 (row layout, ready for the b matvec)
+    gw = g * wt.astype(g.dtype)
+    a = jax.lax.dot_general(
+        gw, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    )  # [k, k]
+    b = jax.lax.dot_general(
+        rt.astype(g.dtype), g, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    )  # [1, k]
+    prev = seg_ref[jnp.maximum(i - 1, 0)]
+    first = (i == 0) | (seg_ref[i] != prev)
+
+    @pl.when(first)
+    def _init():
+        a_ref[0] = a
+        b_ref[0] = b
+
+    @pl.when(jnp.logical_not(first))
+    def _acc():
+        a_ref[0] += a
+        b_ref[0] += b
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "tile_rows", "interpret")
+)
+def gram_tiles_pallas(
+    g: jax.Array,  # [C, k] gathered neighbor factors (bf16 or f32)
+    wt: jax.Array,  # [C] f32 A-side weights (0 at padding)
+    rt: jax.Array,  # [C] f32 b-side coefficients (0 at padding)
+    seg: jax.Array,  # [NT] int32 owner of each tile, sorted ascending
+    *,
+    num_segments: int,  # output rows (Ec + 1, trash last)
+    tile_rows: int,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(A [num_segments, k, k] f32, b [num_segments, k] f32).
+
+    Segments NOT owning any tile are left untouched — callers must treat
+    absent entities as zero (the tiled layout guarantees every real entity
+    in a chunk owns ≥ 1 tile, and the trash row is always hit by padding
+    tiles or ignored).
+    """
+    c, k = g.shape
+    t = tile_rows
+    if c % t != 0:
+        raise ValueError(f"entry count {c} not divisible by tile_rows {t}")
+    nt = c // t
+    if seg.shape != (nt,):
+        raise ValueError(f"seg shape {seg.shape} != ({nt},)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    vma = getattr(jax.typeof(g), "vma", None)
+    mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
+        lambda s, d: jax.ShapeDtypeStruct(s, d)
+    )
+    out_shape = (
+        mk((num_segments, k, k), jnp.float32),
+        mk((num_segments, 1, k), jnp.float32),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((1, t, k), lambda i, seg: (i, 0, 0)),
+            pl.BlockSpec((1, t, 1), lambda i, seg: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, seg: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k, k), lambda i, seg: (seg[i], 0, 0)),
+            pl.BlockSpec((1, 1, k), lambda i, seg: (seg[i], 0, 0)),
+        ],
+    ) if pltpu is not None else None
+    if grid_spec is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    # f32 factors keep the solve path's full-precision convention (default
+    # TPU matmul is bf16 — it would break reference parity ~1e-2 relative).
+    precision = (
+        jax.lax.Precision.HIGHEST if g.dtype == jnp.float32 else None
+    )
+    a, b = pl.pallas_call(
+        functools.partial(_gram_tiles_kernel, precision=precision),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(seg, g.reshape(nt, t, k), wt.reshape(nt, t, 1), rt.reshape(nt, 1, t))
+    return a, b[:, 0, :]

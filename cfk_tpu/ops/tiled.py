@@ -1,0 +1,301 @@
+"""Tile-padded Gram half-steps — the MXU-native segment layout.
+
+Why this exists (measured on a real v5e, see BASELINE.md roofline notes):
+the flat segment layout's grouped ragged matmul (``lax.ragged_dot_general``)
+runs the per-entity Gram accumulation ~15× below what the MXU can do, and
+XLA's row gather falls off a cliff (4×) once the fixed factor table exceeds
+~34 MB.  This layout restructures the same math so both hot ops hit the
+hardware's fast paths:
+
+- Every entity's rating run is padded to a multiple of ``T`` rows (weight 0
+  padding), so a chunk is an exact grid of [T, k] *tiles, each tile owned by
+  one entity*.  The Gram contributions become ONE batched GEMM per chunk —
+  ``einsum("ntk,ntl->nkl")`` on [NT, T, k] tiles, a shape XLA tiles straight
+  onto the MXU — followed by a segment-sum of [NT, k, k] tile Grams by tile
+  owner (≈3 tiles per entity), instead of a grouped matmul over 1M ragged
+  segments.
+
+- The side whose *fixed* table is large (solving movies gathers from the
+  480k-row user table at full Netflix scale) additionally sorts its entries
+  by (table slice, entity) and gathers each chunk from a
+  ``lax.dynamic_slice`` of ≤ ``H`` rows — statically small, so XLA keeps the
+  fast-gather strategy.  Entities then recur across slices, so this side
+  accumulates per-entity Grams in a persistent [E+1, k, k] scan carry
+  (``accum`` mode — only legal when the solve side has few entities, which
+  is exactly the side whose fixed table is big) and solves once at the end.
+
+- The side with many entities ("stream" mode) keeps the segment layout's
+  chunk-scan structure: finalized rows are solved per chunk, an entity
+  straddling a chunk boundary has its partial (A, b) carried across.
+
+The reference computes the same normal equations one entity at a time in
+EJML (``processors/MFeatureCalculator.java:85-99``); the λ·n_ratings
+regularization and float32 accumulation semantics here are identical to
+``cfk_tpu.ops.solve`` (the rectangular/segment paths), which the parity
+tests assert.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cfk_tpu.ops.solve import (
+    _gram_compute_dtype,
+    _match_varying,
+    dispatch_spd_solve,
+    regularized_solve,
+)
+
+
+def default_tiled_gram_backend() -> str:
+    """Tile-Gram backend: "xla" (batched GEMM + segment-sum) everywhere.
+
+    The fused pallas grouped-Gram kernel (``cfk_tpu.ops.pallas.gram_kernel``,
+    ``gram_backend="pallas"``) eliminates the [NT, k, k] materialization and
+    the scatter, but its one-tile-per-grid-step structure is overhead-bound
+    on real hardware (measured 2.36 vs 1.97 s/iter at full Netflix scale) —
+    it needs a multi-tile inner loop before it can win; until then the XLA
+    path is the default."""
+    return "xla"
+
+
+def _entity_gram_chunk(
+    fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, backend,
+):
+    """One chunk's per-entity Gram/RHS: (A [num_segments, k, k], b [.., k]).
+
+    ``seg`` maps each [tile_rows]-entry tile to its owner (sorted;
+    ``num_segments - 1`` = trash).  Rows of segments owning no tile are
+    UNSPECIFIED under the pallas backend (never written) — callers must
+    route them to trash (stream mode) or mask them (accum mode).  Padding
+    entries carry weight 0 and rating 0, so they vanish from both sums
+    regardless of the row their index points at.
+    """
+    k = fixed_slice.shape[-1]
+    ct, prec = _gram_compute_dtype(fixed_slice)
+    g = fixed_slice[nb].astype(ct)  # [C, k]
+    if backend == "pallas":
+        from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
+
+        return gram_tiles_pallas(
+            g, wt, rt, seg, num_segments=num_segments, tile_rows=tile_rows
+        )
+    if backend != "xla":
+        raise ValueError(f"unknown tiled gram backend {backend!r}")
+    gw = (g * wt.astype(ct)[:, None]).reshape(-1, tile_rows, k)
+    gt = g.reshape(-1, tile_rows, k)
+    a_t = jnp.einsum(
+        "ntk,ntl->nkl", gw, gt,
+        preferred_element_type=jnp.float32, precision=prec,
+    )
+    b_t = jnp.einsum(
+        "ntk,nt->nk", gt, rt.reshape(-1, tile_rows).astype(ct),
+        preferred_element_type=jnp.float32, precision=prec,
+    )
+    a = jax.ops.segment_sum(
+        a_t, seg, num_segments=num_segments, indices_are_sorted=True
+    )
+    b = jax.ops.segment_sum(
+        b_t, seg, num_segments=num_segments, indices_are_sorted=True
+    )
+    return a, b
+
+
+def tiled_half_step(
+    fixed_factors, blk, chunks, local_entities, lam, *,
+    solver="cholesky", implicit_reg=None,
+):
+    """Mode dispatch shared by the single-device and SPMD trainers.
+
+    ``chunks`` is the static tuple ``("tiled", mode, *statics)`` the layout
+    setup emits; ``blk`` the device-array dict of ``TiledBlocks`` fields.
+    """
+    mode = chunks[1]
+    st = tuple(chunks[2:])
+    if mode == "accum":
+        return als_half_step_tiled_accum(
+            fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
+            blk["tile_seg"], blk["chunk_base"], blk["chunk_entity"],
+            blk["count"], local_entities, lam,
+            statics=st, solver=solver, implicit_reg=implicit_reg,
+        )
+    return als_half_step_tiled(
+        fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
+        blk["tile_seg"], blk["chunk_entity"], blk["chunk_count"],
+        blk["carry_in"], blk["last_seg"], local_entities, lam,
+        statics=st, solver=solver, implicit_reg=implicit_reg,
+    )
+
+
+def ials_tiled_half_step(
+    fixed_factors, blk, chunks, local_entities, lam, alpha, *,
+    gram=None, solver="cholesky",
+):
+    """Implicit-feedback (Hu et al. 2008) half-iteration on tiled blocks.
+
+    Same global-Gram trick as ``ops.solve.ials_half_step``: per entity
+    A = YᵀY + Σ_obs (c−1)·f fᵀ + λI with c = 1 + α·r.  The tiled layout's
+    generic (weight, rating) channels express it directly — A-weight α·r
+    (0 at padding, since padded ratings are 0) and b-coefficient c·mask —
+    so both tile modes work unchanged with the YᵀY + λI term added at
+    solve time via ``implicit_reg``.
+    """
+    k = fixed_factors.shape[-1]
+    if gram is None:
+        from cfk_tpu.ops.solve import global_gram
+
+        gram = global_gram(fixed_factors)
+    reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
+    blk = dict(blk)
+    blk["rating"], blk["weight"] = (
+        (1.0 + alpha * blk["rating"]) * blk["weight"],
+        alpha * blk["rating"],
+    )
+    return tiled_half_step(
+        fixed_factors, blk, chunks, local_entities, lam,
+        solver=solver, implicit_reg=reg,
+    )
+
+
+def als_half_step_tiled(
+    fixed_factors: jax.Array,  # [F, k] full fixed side
+    neighbor_idx: jax.Array,  # [NC·C] int32
+    rating: jax.Array,  # [NC·C] f32 (b coefficient; 0 at padding)
+    weight: jax.Array,  # [NC·C] f32 (A weight; 0 at padding)
+    tile_seg: jax.Array,  # [NC·NT] int32 chunk-relative entity of each tile
+    chunk_entity: jax.Array,  # [NC·Ec] shard-local entity row (trash = E_local)
+    chunk_count: jax.Array,  # [NC·Ec] full rating count of finalized rows
+    carry_in: jax.Array,  # [NC] 1.0 = seg 0 continues the previous chunk
+    last_seg: jax.Array,  # [NC] chunk-relative index of the last real segment
+    local_entities: int,
+    lam: float,
+    *,
+    statics: tuple[int, int, int, int],  # (NC, C, Ec, T)
+    solver: str = "cholesky",
+    implicit_reg: jax.Array | None = None,  # [k,k] YᵀY+λI (iALS); None = ALS-WR
+    gram_backend: str | None = None,
+) -> jax.Array:
+    """Stream-mode tiled half-iteration (the many-entities side).
+
+    Chunk-scan structure and carry semantics match
+    ``ops.solve.als_half_step_segment`` exactly; only the Gram accumulation
+    differs (fused pallas grouped-Gram kernel / batched tile GEMM +
+    segment-sum).  Under the pallas backend, rows of segments owning no
+    tile are unwritten garbage; their solves land in the trash row of
+    ``out`` (``chunk_entity`` routes non-finalized rows there), so nothing
+    real ever reads them.
+    """
+    backend = gram_backend or default_tiled_gram_backend()
+    nc, cap, e_c, t = statics
+    k = fixed_factors.shape[-1]
+    nt = cap // t
+    chunks = (
+        neighbor_idx.reshape(nc, cap), rating.reshape(nc, cap),
+        weight.reshape(nc, cap), tile_seg.reshape(nc, nt),
+        chunk_entity.reshape(nc, e_c), chunk_count.reshape(nc, e_c),
+        carry_in.reshape(nc), last_seg.reshape(nc),
+    )
+
+    def body(carry, chunk):
+        a0, b0, out = carry
+        nb_c, rt_c, wt_c, ts_c, ent_c, cnt_c, cin_c, lseg_c = chunk
+        a, b = _entity_gram_chunk(
+            fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend
+        )
+        a = a.at[0].add(cin_c * a0)
+        b = b.at[0].add(cin_c * b0)
+        if implicit_reg is None:
+            x = regularized_solve(a[:e_c], b[:e_c], cnt_c, lam, solver)
+        else:
+            x = dispatch_spd_solve(implicit_reg[None] + a[:e_c], b[:e_c], solver)
+        out = out.at[ent_c].set(x)
+        a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
+        b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
+        return (a1, b1, out), None
+
+    init = jax.tree.map(
+        lambda z: _match_varying(z, neighbor_idx),
+        (
+            jnp.zeros((k, k), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+            jnp.zeros((local_entities + 1, k), jnp.float32),
+        ),
+    )
+    (_, _, out), _ = lax.scan(body, init, chunks)
+    return out[:local_entities]
+
+
+def als_half_step_tiled_accum(
+    fixed_factors: jax.Array,  # [F, k] full fixed side
+    neighbor_idx: jax.Array,  # [NC·C] int32 SLICE-LOCAL indices
+    rating: jax.Array,  # [NC·C] f32
+    weight: jax.Array,  # [NC·C] f32
+    tile_seg: jax.Array,  # [NC·NT] int32 chunk-dense entity rank (trash = Ec)
+    chunk_base: jax.Array,  # [NC] int32 table-slice row offset per chunk
+    chunk_entity: jax.Array,  # [NC·Ec] shard-local entity of each rank (trash = E_local)
+    count: jax.Array,  # [E_local] real rating count (regularizer)
+    local_entities: int,
+    lam: float,
+    *,
+    statics: tuple[int, int, int, int, int],  # (NC, C, T, H, Ec)
+    solver: str = "cholesky",
+    implicit_reg: jax.Array | None = None,
+    gram_backend: str | None = None,
+) -> jax.Array:
+    """Accumulator-mode tiled half-iteration (the few-entities side).
+
+    Entries are sorted by (fixed-table slice, entity); each chunk gathers
+    from a ``lax.dynamic_slice`` of H rows (statically small ⇒ the fast
+    gather strategy).  Tile Grams first reduce *within the chunk* to its ≤
+    Ec distinct entities (high-degree sides average ~90 tiles per entity,
+    so 16k tiles collapse to a few hundred rows) and scatter-add into the
+    persistent [E+1, k, k] accumulator via the chunk's entity list —
+    touching megabytes per chunk instead of rewriting the whole accumulator
+    (profiled at 3.6× the traffic).  ``tile_seg`` ranks are chunk-DENSE
+    (slicing leaves gaps in the entity sequence, so ranks, not offsets);
+    ranks owning no tile keep their unwritten-garbage Gram rows, and their
+    ``chunk_entity`` slot routes them to the accumulator's trash row.
+    Entities recur across slices, so per-chunk finalization is impossible
+    and the solve happens once at the end.  Only legal when E_local·k² fits
+    comfortably in HBM; the builder picks this mode exactly when the fixed
+    side is the big one, which is also when the solve side is small
+    (480k-user table ⇔ 17.7k movies).
+    """
+    backend = gram_backend or default_tiled_gram_backend()
+    nc, cap, t, h, e_c = statics
+    k = fixed_factors.shape[-1]
+    nt = cap // t
+    chunks = (
+        neighbor_idx.reshape(nc, cap), rating.reshape(nc, cap),
+        weight.reshape(nc, cap), tile_seg.reshape(nc, nt),
+        chunk_base.reshape(nc), chunk_entity.reshape(nc, e_c),
+    )
+
+    def body(carry, chunk):
+        acc_a, acc_b = carry
+        nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
+        fixed_slice = lax.dynamic_slice(fixed_factors, (base_c, 0), (h, k))
+        a, b = _entity_gram_chunk(
+            fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend
+        )
+        # Rank rows owning no tile are unwritten garbage under the pallas
+        # backend; ent_c routes them (and any NaN they hold) to the trash
+        # row, which nothing reads.  The trash segment a[e_c] is dropped.
+        acc_a = acc_a.at[ent_c].add(a[:e_c])
+        acc_b = acc_b.at[ent_c].add(b[:e_c])
+        return (acc_a, acc_b), None
+
+    init = jax.tree.map(
+        lambda z: _match_varying(z, neighbor_idx),
+        (
+            jnp.zeros((local_entities + 1, k, k), jnp.float32),
+            jnp.zeros((local_entities + 1, k), jnp.float32),
+        ),
+    )
+    (acc_a, acc_b), _ = lax.scan(body, init, chunks)
+    a, b = acc_a[:local_entities], acc_b[:local_entities]
+    if implicit_reg is None:
+        return regularized_solve(a, b, count, lam, solver)
+    return dispatch_spd_solve(implicit_reg[None] + a, b, solver)

@@ -44,6 +44,7 @@ from cfk_tpu.data.blocks import (
     PaddedBlocks,
     RingBlocks,
     SegmentBlocks,
+    TiledBlocks,
     build_ring_blocks,
 )
 from cfk_tpu.models.als import ALSModel
@@ -246,20 +247,39 @@ def gathered_half(solve, *, with_gram=False, with_prev=False):
     return half_prev if with_prev else half
 
 
+def _tiled_to_tree(blocks: TiledBlocks) -> dict[str, np.ndarray]:
+    """Flat per-shard tiled arrays; every leaf rows-shards over P(AXIS)."""
+    return {
+        "neighbor_idx": blocks.neighbor_idx,
+        "rating": blocks.rating,
+        "weight": blocks.weight,
+        "tile_seg": blocks.tile_seg,
+        "chunk_base": blocks.chunk_base,
+        "chunk_entity": blocks.chunk_entity,
+        "chunk_count": blocks.chunk_count,
+        "carry_in": blocks.carry_in,
+        "last_seg": blocks.last_seg,
+        "count": blocks.count,
+    }
+
+
 def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
     """Block trees + step kwargs for the all_gather-only layouts.
 
-    Returns (mtree, utree, step_kw) for bucketed/segment datasets — the
-    setup shared by the explicit and implicit sharded trainers — or None
-    when the dataset uses padded rectangles (caller picks per-exchange).
+    Returns (mtree, utree, step_kw) for bucketed/segment/tiled datasets —
+    the setup shared by the explicit and implicit sharded trainers — or
+    None when the dataset uses padded rectangles (caller picks
+    per-exchange).
     """
     bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
     segment = isinstance(dataset.movie_blocks, SegmentBlocks)
-    if not (bucketed or segment):
+    tiled = isinstance(dataset.movie_blocks, TiledBlocks)
+    if not (bucketed or segment or tiled):
         return None
     if config.exchange != "all_gather":
+        name = "bucketed" if bucketed else ("segment" if segment else "tiled")
         raise ValueError(
-            f"{'bucketed' if bucketed else 'segment'} layout supports "
+            f"{name} layout supports "
             "exchange='all_gather' only; the ring exchange needs "
             "shard-local neighbor indices (use layout='padded' or "
             "exchange='all_gather')"
@@ -267,6 +287,11 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
     if bucketed:
         mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
         utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
+    elif tiled:
+        mtree = _tiled_to_tree(dataset.movie_blocks)
+        utree = _tiled_to_tree(dataset.user_blocks)
+        m_chunks = ("tiled", dataset.movie_blocks.mode) + dataset.movie_blocks.statics
+        u_chunks = ("tiled", dataset.user_blocks.mode) + dataset.user_blocks.statics
     else:
         mtree = _segment_to_tree(dataset.movie_blocks)
         utree = _segment_to_tree(dataset.user_blocks)
@@ -278,6 +303,7 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
         m_local=dataset.movie_blocks.local_entities,
         u_local=dataset.user_blocks.local_entities,
         segment=segment,
+        tiled=tiled,
     )
     return mtree, utree, step_kw
 
@@ -302,6 +328,7 @@ def make_training_step(
     m_local=None,
     u_local=None,
     segment=False,
+    tiled=False,
 ):
     """Build the jittable one-full-iteration SPMD step (solve M, then U).
 
@@ -352,6 +379,26 @@ def make_training_step(
         half = gathered_half(pp_padded, with_prev=True)
         return wrap_step(mesh, config, half, half, mspecs, uspecs,
                          carry_prev=True)
+
+    if tiled:  # tile-padded layout, all_gather exchange
+
+        from cfk_tpu.ops.tiled import tiled_half_step
+
+        def tl_solve(chunks, local):
+            def solve(fixed_full, blk, _gram):
+                return tiled_half_step(
+                    fixed_full, blk, chunks, local, config.lam,
+                    solver=config.solver,
+                )
+
+            return solve
+
+        return wrap_step(
+            mesh, config,
+            gathered_half(tl_solve(m_chunks, m_local)),
+            gathered_half(tl_solve(u_chunks, u_local)),
+            mspecs, uspecs,
+        )
 
     if segment:  # flat segment layout, all_gather exchange
 
@@ -433,8 +480,10 @@ def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) ->
                 f"divisible by num_shards={s}; rebuild the Dataset with "
                 f"Dataset.from_coo(..., num_shards={s})"
             )
-        if isinstance(blocks, (BucketedBlocks, SegmentBlocks)) and blocks.num_shards != s:
-            layout = "bucketed" if isinstance(blocks, BucketedBlocks) else "segment"
+        if isinstance(blocks, (BucketedBlocks, SegmentBlocks, TiledBlocks)) and blocks.num_shards != s:
+            layout = ("bucketed" if isinstance(blocks, BucketedBlocks)
+                      else "segment" if isinstance(blocks, SegmentBlocks)
+                      else "tiled")
             raise ValueError(
                 f"{name}_blocks were built for num_shards={blocks.num_shards} "
                 f"but config.num_shards={s}; their row/segment indices are "

@@ -1,0 +1,210 @@
+"""Perf lab: step-level timing of the full-scale ALS iteration on real TPU.
+
+``bench.py`` measures the user-facing path (fresh trainer per timing, block
+upload included) with a two-point fit to cancel the fixed cost — honest for
+reporting, but noisy under the axon tunnel's multi-tenant variance and too
+slow for optimization loops (every timing re-uploads multi-GB blocks).  This
+lab uploads once and times ``step()`` calls directly with a device→host
+scalar fetch as the barrier (``block_until_ready`` does not block under the
+tunnel — see .claude/skills/verify/SKILL.md), reporting min/median over
+repeats.  Datasets are cached on disk per (shape, layout, chunk) key so an
+experiment costs seconds, not minutes, after the first run.
+
+Usage:
+  python scripts/perf_lab.py --layout segment --chunk-elems 4194304 \
+      --solver pallas --iters 3 --repeats 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CACHE_ROOT = os.environ.get("CFK_PERF_CACHE", "/tmp/cfk_perf_cache")
+
+
+def sync(x) -> None:
+    np.asarray(x[:1, :1])
+
+
+def get_dataset(args):
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+
+    key = {
+        "users": args.users, "movies": args.movies, "nnz": args.nnz,
+        "seed": args.seed, "layout": args.layout,
+        "chunk_elems": args.chunk_elems,
+    }
+    if args.layout == "tiled":
+        key["tile_rows"] = args.tile_rows
+    tag = "_".join(f"{k}{v}" for k, v in key.items())
+    path = os.path.join(CACHE_ROOT, tag)
+    if os.path.exists(path):
+        t0 = time.time()
+        try:
+            ds = Dataset.load(path, expect_build_key=key)
+        except (FileNotFoundError, ValueError, TypeError):
+            pass  # torn/mismatched/stale-format cache: rebuild below
+        else:
+            print(f"# dataset cache hit ({time.time()-t0:.1f}s load)", flush=True)
+            return ds
+    t0 = time.time()
+    coo = synthetic_netflix_coo(args.users, args.movies, args.nnz, seed=args.seed)
+    if args.layout == "tiled":
+        from cfk_tpu.data.blocks import build_tiled_blocks
+        import dataclasses as _dc
+        base = Dataset.from_coo(coo, layout="tiled", chunk_elems=args.chunk_elems)
+        d = base.coo_dense
+        mb = build_tiled_blocks(d.movie_raw, d.user_raw, d.rating,
+                                base.movie_map.num_entities, base.user_map.num_entities,
+                                tile_rows=args.tile_rows, chunk_elems=args.chunk_elems)
+        ub = build_tiled_blocks(d.user_raw, d.movie_raw, d.rating,
+                                base.user_map.num_entities, base.movie_map.num_entities,
+                                tile_rows=args.tile_rows, chunk_elems=args.chunk_elems)
+        ds = _dc.replace(base, movie_blocks=mb, user_blocks=ub)
+    else:
+        ds = Dataset.from_coo(coo, layout=args.layout, chunk_elems=args.chunk_elems)
+    print(f"# dataset built in {time.time()-t0:.1f}s", flush=True)
+    os.makedirs(CACHE_ROOT, exist_ok=True)
+    ds.save(path, build_key=key)
+    return ds
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--users", type=int, default=480_189)
+    p.add_argument("--movies", type=int, default=17_770)
+    p.add_argument("--nnz", type=int, default=100_480_507)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--rank", type=int, default=64)
+    p.add_argument("--layout", default="segment",
+                   choices=["padded", "bucketed", "segment", "tiled"])
+    p.add_argument("--chunk-elems", type=int, default=1 << 20)
+    p.add_argument("--tile-rows", type=int, default=128)
+    p.add_argument("--solver", default="pallas",
+                   choices=["auto", "cholesky", "pallas"])
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--gram-backend", default=None,
+                   choices=[None, "ragged", "segsum"])
+    p.add_argument("--iters", type=int, default=3,
+                   help="steps per timed call (fused per-call overhead "
+                   "amortizes over these)")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of one timed call")
+    args = p.parse_args()
+
+    import jax
+
+    ds = get_dataset(args)
+
+    from cfk_tpu.models import als as als_mod
+    from cfk_tpu.utils.roofline import als_iteration_cost
+
+    if args.gram_backend is not None:
+        import cfk_tpu.ops.solve as solve_mod
+
+        solve_mod.default_segment_backend = lambda: args.gram_backend
+
+    segment = args.layout == "segment"
+    bucketed = args.layout == "bucketed"
+    t0 = time.time()
+    if bucketed:
+        mblocks, ublocks, u_stats, layout_kw = als_mod._bucketed_device_setup(ds)
+    elif segment:
+        mblocks, ublocks, u_stats, layout_kw = als_mod._segment_device_setup(ds)
+    elif args.layout == "tiled":
+        mblocks, ublocks, u_stats, layout_kw = als_mod._tiled_device_setup(ds)
+    else:
+        mblocks = als_mod._blocks_to_device(ds.movie_blocks)
+        ublocks = als_mod._blocks_to_device(ds.user_blocks)
+        u_stats, layout_kw = None, {}
+    # Force the upload now so step timings never include it.
+    jax.block_until_ready((mblocks, ublocks))
+    sync_leaf = jax.tree.leaves(mblocks)[0]
+    np.asarray(sync_leaf.ravel()[:1])
+    print(f"# blocks to device in {time.time()-t0:.1f}s", flush=True)
+
+    from cfk_tpu.ops.solve import init_factors_stats
+
+    key = jax.random.PRNGKey(0)
+    if u_stats is not None:
+        u0 = jax.jit(init_factors_stats, static_argnames="rank")(
+            key, u_stats["rating_sum"], u_stats["count"], rank=args.rank
+        )
+    else:
+        u0 = jax.jit(
+            lambda k, r, m, c: als_mod.init_factors(k, r, m, c, args.rank)
+        )(key, ublocks["rating"], ublocks["mask"], ublocks["count"])
+    dt = args.dtype
+    u0 = u0.astype(dt)
+    m_rows = ds.movie_blocks.padded_entities
+    m0 = jax.numpy.zeros((m_rows, args.rank), dt)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def steps(u, m, mblk, ublk):
+        # Blocks are jit ARGUMENTS, not closure captures — capturing them
+        # would bake 2.4 GB of constants into the executable and blow up
+        # compile time (exactly what the real trainers avoid).
+        def body(_, carry):
+            u, m_prev = carry
+            return als_mod._iteration_body(
+                u, mblk, ublk,
+                lam=0.05, solve_chunk=None, dt=jax.numpy.dtype(dt),
+                solver=args.solver, m_prev=m_prev, **layout_kw,
+            )
+        return jax.lax.fori_loop(0, args.iters, body, (u, m))
+
+    steps_bound = functools.partial(steps, mblk=mblocks, ublk=ublocks)
+
+    t0 = time.time()
+    u, m = steps_bound(u0, m0)
+    sync(u)
+    compile_s = time.time() - t0
+    print(f"# first call (compile+run): {compile_s:.2f}s", flush=True)
+
+    times = []
+    for i in range(args.repeats):
+        t0 = time.time()
+        u, m = steps_bound(u, m)
+        sync(u)
+        times.append(time.time() - t0)
+        print(f"# call {i}: {times[-1]:.3f}s "
+              f"({times[-1]/args.iters:.3f} s/iter)", flush=True)
+        if args.profile_dir and i == 0:
+            with jax.profiler.trace(args.profile_dir):
+                u, m = steps_bound(u, m)
+                sync(u)
+
+    per_iter = [t / args.iters for t in times]
+    cost = als_iteration_cost(
+        args.nnz, args.users, args.movies, args.rank,
+        factor_bytes=2 if dt == "bfloat16" else 4,
+    )
+    best = min(per_iter)
+    print(json.dumps({
+        "s_per_iter_min": round(best, 4),
+        "s_per_iter_median": round(sorted(per_iter)[len(per_iter) // 2], 4),
+        "mfu": round(cost.mfu(best), 5),
+        "achieved_tflops": round(cost.achieved_tflops(best), 3),
+        "vs_hbm_roofline": round(best / cost.hbm_bound_s(), 2),
+        "layout": args.layout, "solver": args.solver,
+        "chunk_elems": args.chunk_elems, "dtype": dt,
+        "gram_backend": args.gram_backend, "rank": args.rank,
+        "iters_per_call": args.iters,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,27 @@ def test_train_survives_unmaterializable_dense_preds(capsys, tmp_path, monkeypat
     assert "mse" in metrics["gauges"]
 
 
+def test_checkpoint_journal_bad_tcp_url(capsys, tmp_path):
+    """A malformed tcp journal target must be a clean flag error, not a
+    traceback deep in training."""
+    rc = main([
+        "train", "--data", TINY, "--rank", "3", "--iterations", "1",
+        "--checkpoint-journal", "tcp://nohost", "--output", "none",
+    ])
+    assert rc == 2
+    assert "bad broker url" in capsys.readouterr().err
+
+
+def test_checkpoint_journal_conflicts_with_dir(capsys, tmp_path):
+    rc = main([
+        "train", "--data", TINY, "--rank", "3", "--iterations", "1",
+        "--checkpoint-dir", str(tmp_path / "a"),
+        "--checkpoint-journal", str(tmp_path / "b"), "--output", "none",
+    ])
+    assert rc == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
 def test_evaluate_shape_mismatch(capsys, tmp_path):
     bad = tmp_path / "bad.csv"
     bad.write_text("2 3 real\n1 2 3\n4 5 6\n")

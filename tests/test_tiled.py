@@ -1,0 +1,188 @@
+"""Tiled layout: tile-padded Gram half-steps (cfk_tpu/ops/tiled.py).
+
+Covers both modes (stream / accum), table slicing, chunk straddling, and
+end-to-end golden parity — the same quality bar as the other layouts.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import Dataset, build_tiled_blocks
+from cfk_tpu.data.synthetic import synthetic_netflix_coo
+from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+from cfk_tpu.models.als import _tiled_to_device, train_als
+from cfk_tpu.ops.tiled import tiled_half_step
+
+TINY = "/root/reference/data/data_sample_tiny.txt"
+
+
+@pytest.fixture(scope="module")
+def synth():
+    coo = synthetic_netflix_coo(3000, 400, 60_000, seed=1)
+    ds = Dataset.from_coo(coo)
+    return ds
+
+
+def _oracle_movie_solve(ds, U, lam):
+    m_dense = ds.coo_dense.movie_raw
+    u_dense = ds.coo_dense.user_raw
+    r = ds.coo_dense.rating
+    k = U.shape[1]
+    out = np.zeros((ds.movie_map.num_entities, k), np.float32)
+    for m in range(out.shape[0]):
+        sel = m_dense == m
+        X = U[u_dense[sel]]
+        A = X.T @ X + lam * max(int(sel.sum()), 1) * np.eye(k, dtype=np.float32)
+        out[m] = np.linalg.solve(A, X.T @ r[sel])
+    return out
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(),  # accum, unsliced, single chunk
+        dict(slice_rows=128),  # accum + table slicing
+        dict(slice_rows=128, chunk_elems=2048),  # sliced + many chunks
+        dict(accum_max_entities=16, chunk_elems=2048),  # stream + straddling
+        dict(accum_max_entities=16, chunk_elems=2048, tile_rows=8),
+    ],
+)
+def test_half_step_matches_oracle(synth, kw):
+    ds = synth
+    d = ds.coo_dense
+    rng = np.random.default_rng(0)
+    U = rng.standard_normal((3000, 8)).astype(np.float32)
+    mb = build_tiled_blocks(
+        d.movie_raw, d.user_raw, d.rating, 400, 3000, **kw
+    )
+    got = np.asarray(
+        tiled_half_step(
+            jnp.asarray(U), _tiled_to_device(mb),
+            ("tiled", mb.mode) + mb.statics,
+            mb.padded_entities, 0.05, solver="cholesky",
+        )
+    )[:400]
+    want = _oracle_movie_solve(ds, U, 0.05)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_stream_mode_chunk_straddling(synth):
+    """A hot entity spanning several chunks must carry its partial Gram."""
+    ds = synth
+    d = ds.coo_dense
+    rng = np.random.default_rng(1)
+    M = rng.standard_normal((400, 8)).astype(np.float32)
+    # Solve USERS (3000 entities) with tiny chunks: avg degree 20, chunks of
+    # 128 entries → many user runs straddle chunk boundaries.
+    ub = build_tiled_blocks(
+        d.user_raw, d.movie_raw, d.rating, 3000, 400,
+        accum_max_entities=16, chunk_elems=128, tile_rows=8,
+    )
+    assert ub.mode == "stream"
+    got = np.asarray(
+        tiled_half_step(
+            jnp.asarray(M), _tiled_to_device(ub),
+            ("tiled", ub.mode) + ub.statics,
+            ub.padded_entities, 0.05, solver="cholesky",
+        )
+    )[:3000]
+    u_dense = d.user_raw
+    m_dense = d.movie_raw
+    r = d.rating
+    out = np.zeros((3000, 8), np.float32)
+    for u in range(3000):
+        sel = u_dense == u
+        X = M[m_dense[sel]]
+        A = X.T @ X + 0.05 * max(int(sel.sum()), 1) * np.eye(8, dtype=np.float32)
+        out[u] = np.linalg.solve(A, X.T @ r[sel])
+    np.testing.assert_allclose(got, out, rtol=2e-4, atol=2e-4)
+
+
+def test_tiny_golden_rmse():
+    """Same quality bar as the reference config, through the tiled layout."""
+    from cfk_tpu.data.netflix import parse_netflix
+
+    coo = parse_netflix(TINY)
+    ref_ds = Dataset.from_coo(coo)
+    cfg = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=0)
+    _, rmse_ref = mse_rmse_from_blocks(
+        train_als(ref_ds, cfg).predict_dense(), ref_ds
+    )
+    ds = Dataset.from_coo(coo, layout="tiled")
+    cfgt = dataclasses.replace(cfg, layout="tiled")
+    _, rmse = mse_rmse_from_blocks(train_als(ds, cfgt).predict_dense(), ref_ds)
+    assert rmse <= 0.52
+    assert abs(rmse - rmse_ref) < 5e-3
+
+
+def test_bf16_tiled_training():
+    from cfk_tpu.data.netflix import parse_netflix
+
+    coo = parse_netflix(TINY)
+    ds = Dataset.from_coo(coo, layout="tiled")
+    cfg = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=0,
+                    layout="tiled", dtype="bfloat16")
+    ref_ds = Dataset.from_coo(coo)
+    _, rmse = mse_rmse_from_blocks(train_als(ds, cfg).predict_dense(), ref_ds)
+    assert rmse <= 0.52
+
+
+def test_ials_tiled_matches_padded(synth):
+    """Implicit model through the tiled layout ≈ the padded reference path."""
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+
+    coo = synthetic_netflix_coo(900, 120, 12_000, seed=3)
+    cfg = IALSConfig(rank=6, lam=0.1, alpha=10.0, num_iterations=3, seed=0,
+                     solver="cholesky")
+    ref = train_ials(Dataset.from_coo(coo), cfg).predict_dense()
+    cfgt = dataclasses.replace(cfg, layout="tiled")
+    got = train_ials(Dataset.from_coo(coo, layout="tiled"), cfgt).predict_dense()
+    np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_sharded_tiled_matches_single(synth):
+    """4-way tiled SPMD ≈ single-device tiled (virtual mesh)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = synthetic_netflix_coo(3000, 400, 60_000, seed=1)
+    cfg1 = ALSConfig(rank=8, lam=0.05, num_iterations=3, seed=0,
+                     layout="tiled", solver="cholesky")
+    ref = train_als(Dataset.from_coo(coo, layout="tiled"), cfg1).predict_dense()
+    cfg4 = dataclasses.replace(cfg1, num_shards=4)
+    got = train_als_sharded(
+        Dataset.from_coo(coo, layout="tiled", num_shards=4), cfg4, make_mesh(4)
+    ).predict_dense()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_cache_roundtrip(tmp_path, synth):
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(500, 60, 5_000, seed=2), layout="tiled"
+    )
+    ds.save(str(tmp_path / "c"), build_key={"layout": "tiled"})
+    loaded = Dataset.load(str(tmp_path / "c"), expect_build_key={"layout": "tiled"})
+    np.testing.assert_array_equal(
+        loaded.movie_blocks.neighbor_idx, ds.movie_blocks.neighbor_idx
+    )
+    assert loaded.movie_blocks.mode == ds.movie_blocks.mode
+    assert loaded.movie_blocks.statics == ds.movie_blocks.statics
+
+
+def test_config_accepts_tiled():
+    cfg = ALSConfig(layout="tiled")
+    assert cfg.layout == "tiled"
+    with pytest.raises(ValueError, match="all_gather"):
+        ALSConfig(layout="tiled", exchange="ring")
+    with pytest.raises(ValueError, match="bucketed"):
+        ALSConfig(layout="tiled", algorithm="als++", block_size=5, rank=5)
