@@ -75,6 +75,27 @@ def _gauss_kernel(a_ref, b_ref, x_ref, *, k: int):
     x_ref[:] = b
 
 
+def _gauss_multi_kernel(a_ref, b_ref, x_ref, *, k: int):
+    """Multi-RHS variant: a_ref [k,k,T], b_ref [k,m,T] → x_ref [k,m,T].
+
+    The same unrolled Gauss-Jordan with the row operations applied to an
+    [m]-wide RHS block — the building block of the blocked (Schur) solve
+    for ranks above the single-kernel VMEM cap."""
+    a = a_ref[:]
+    b = b_ref[:]
+    rows3 = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0)
+    for j in range(k):
+        inv = 1.0 / a[j, j, :]  # [T]
+        row = a[j] * inv[None, :]  # [k,T]
+        bj = b[j] * inv[None, :]  # [m,T]
+        col = a[:, j, :]  # [k,T]
+        a = jnp.where(rows3 == j, row[None, :, :],
+                      a - col[:, None, :] * row[None, :, :])
+        b = jnp.where(rows3 == j, bj[None, :, :],
+                      b - col[:, None, :] * bj[None, :, :])
+    x_ref[:] = b
+
+
 def _pad_to(x: jax.Array, size: int, axis: int) -> jax.Array:
     pad = size - x.shape[axis]
     if pad == 0:
@@ -82,6 +103,99 @@ def _pad_to(x: jax.Array, size: int, axis: int) -> jax.Array:
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
+
+
+def _lane_padded_inputs(a, b, b_pad_axis, interpret):
+    """Shared wrapper prologue: lane-pad the batch, turn the all-zero padded
+    systems into identity systems (the elimination would divide by zero),
+    and resolve interpret mode.  Returns (a_p, b_p, e, e_pad, tile, interp).
+    """
+    k = a.shape[0]
+    e = a.shape[2]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tile = _LANES
+    e_pad = ((e + tile - 1) // tile) * tile
+    a_p = _pad_to(a, e_pad, axis=2)
+    b_p = _pad_to(b, e_pad, axis=b_pad_axis)
+    if e_pad != e:
+        pad_lane = jnp.arange(e_pad) >= e
+        a_p = a_p + jnp.eye(k, dtype=a.dtype)[:, :, None] * pad_lane[None, None, :]
+    return a_p, b_p, e, e_pad, tile, interpret
+
+
+def _solve_call(kernel, a_p, b_p, b_block, out_struct, tile, interpret,
+                vmem_limit=None):
+    """Shared pallas_call plumbing: VMEM block specs (skipped in interpret
+    mode), vma tagging of the output aval (under shard_map the output must
+    carry the inputs' varying-mesh-axes), and the optional scoped-VMEM
+    raise."""
+    k = a_p.shape[0]
+    e_pad = a_p.shape[2]
+    mem = {"memory_space": _VMEM} if _VMEM is not None and not interpret else {}
+    nb = len(b_block)
+    b_map = (lambda i: (0, 0, i)) if nb == 3 else (lambda i: (0, i))
+    specs = dict(
+        in_specs=[
+            pl.BlockSpec((k, k, tile), lambda i: (0, 0, i), **mem),
+            pl.BlockSpec(b_block, b_map, **mem),
+        ],
+        out_specs=pl.BlockSpec(b_block, b_map, **mem),
+    )
+    shape, dtype = out_struct
+    vma = getattr(jax.typeof(a_p), "vma", None)
+    if vma:
+        out_shape = jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    else:
+        out_shape = jax.ShapeDtypeStruct(shape, dtype)
+    kwargs = {}
+    if vmem_limit is not None and pltpu is not None and not interpret:
+        params = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams"
+        )
+        kwargs["compiler_params"] = params(vmem_limit_bytes=vmem_limit)
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=(e_pad // tile,),
+        interpret=interpret,
+        **specs,
+        **kwargs,
+    )(a_p, b_p)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gauss_solve_multi_pallas(
+    a: jax.Array,  # [k, k, E] float32, SPD per system
+    b: jax.Array,  # [k, m, E] float32 — m right-hand sides per system
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:  # [k, m, E]
+    """Solve A X = B with an [m]-wide RHS block per system (batch-last).
+
+    Used by the blocked Schur solve for rank > PALLAS_MAX_RANK: one call
+    computes A₁₁⁻¹[A₁₂ | b₁] in a single elimination.  VMEM holds
+    [k, k, tile] + [k, m, tile] live through the unrolled elimination, so
+    m is capped at PALLAS_MAX_RANK + 8 and the scoped-VMEM budget is raised
+    (the default 16 MB is ~24 MB short at k = m = 64).
+    """
+    k, m, e = b.shape
+    if a.shape != (k, k, e):
+        raise ValueError(f"a shape {a.shape} != ({k},{k},{e})")
+    if k > PALLAS_MAX_RANK or m > PALLAS_MAX_RANK + 8:
+        raise ValueError(
+            f"gauss_solve_multi_pallas supports k <= {PALLAS_MAX_RANK}, "
+            f"m <= {PALLAS_MAX_RANK + 8} (VMEM budget), got k={k} m={m}"
+        )
+    a_p, b_p, e, e_pad, tile, interpret = _lane_padded_inputs(
+        a, b, 2, interpret
+    )
+    x = _solve_call(
+        functools.partial(_gauss_multi_kernel, k=k),
+        a_p, b_p, (k, m, tile), ((k, m, e_pad), a.dtype), tile, interpret,
+        vmem_limit=40 * 1024 * 1024,
+    )
+    return x[:, :, :e]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -98,47 +212,11 @@ def gauss_solve_pallas(
             f"gauss_solve_pallas supports rank <= {PALLAS_MAX_RANK} (VMEM "
             f"budget), got {k}; use the cholesky backend"
         )
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    tile = _LANES
-    e_pad = ((e + tile - 1) // tile) * tile
-    a_p = _pad_to(a, e_pad, axis=2)
-    b_p = _pad_to(b, e_pad, axis=1)
-    # Padded systems are all-zero → the kernel would divide by zero. Make
-    # them identity systems (x = 0 for b = 0) to keep arithmetic finite.
-    if e_pad != e:
-        pad_lane = jnp.arange(e_pad) >= e
-        a_p = a_p + jnp.eye(k, dtype=a.dtype)[:, :, None] * pad_lane[None, None, :]
-    grid = (e_pad // tile,)
-    kwargs = {}
-    if _VMEM is not None and not interpret:
-        kwargs = dict(
-            in_specs=[
-                pl.BlockSpec((k, k, tile), lambda i: (0, 0, i), memory_space=_VMEM),
-                pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=_VMEM),
-            ],
-            out_specs=pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=_VMEM),
-        )
-    else:
-        kwargs = dict(
-            in_specs=[
-                pl.BlockSpec((k, k, tile), lambda i: (0, 0, i)),
-                pl.BlockSpec((k, tile), lambda i: (0, i)),
-            ],
-            out_specs=pl.BlockSpec((k, tile), lambda i: (0, i)),
-        )
-    # Under shard_map the output aval must carry the same varying-mesh-axes
-    # (vma) tag as the inputs; outside shard_map vma is empty/absent.
-    vma = getattr(jax.typeof(a_p), "vma", None)
-    if vma:
-        out_shape = jax.ShapeDtypeStruct((k, e_pad), a.dtype, vma=vma)
-    else:
-        out_shape = jax.ShapeDtypeStruct((k, e_pad), a.dtype)
-    x = pl.pallas_call(
+    a_p, b_p, e, e_pad, tile, interpret = _lane_padded_inputs(
+        a, b, 1, interpret
+    )
+    x = _solve_call(
         functools.partial(_gauss_kernel, k=k),
-        out_shape=out_shape,
-        grid=grid,
-        interpret=interpret,
-        **kwargs,
-    )(a_p, b_p)
+        a_p, b_p, (k, tile), ((k, e_pad), a.dtype), tile, interpret,
+    )
     return x[:, :e]

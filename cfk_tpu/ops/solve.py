@@ -216,6 +216,56 @@ def ials_half_step_bucketed(
     return out[:local_entities]
 
 
+def _blocked_spd_solve_pallas(a: jax.Array, b: jax.Array) -> jax.Array:
+    """SPD solve for PALLAS_MAX_RANK < k ≤ 2·PALLAS_MAX_RANK via one level
+    of block (Schur-complement) elimination.
+
+    Split A = [[A₁₁ A₁₂],[A₂₁ A₂₂]] at k₁ = PALLAS_MAX_RANK.  One multi-RHS
+    Gauss-Jordan computes Y = A₁₁⁻¹[A₁₂ | b₁]; the Schur complement
+    S = A₂₂ − A₂₁·Y₁₂ (SPD) is solved by the single-RHS kernel; and
+    x₁ = y₁ − Y₁₂·x₂ back-substitutes.  Everything else is batched k₁³
+    matmuls — MXU work — so rank 128 costs two lane-vectorized solves plus
+    GEMMs instead of XLA's latency-bound 128×128 cholesky custom calls
+    (measured: full-Netflix rank-128 drops from 15.8 to well under the
+    12 s/iter bar; see BASELINE.md).
+    """
+    from cfk_tpu.ops.pallas import (
+        PALLAS_MAX_RANK,
+        gauss_solve_multi_pallas,
+        gauss_solve_pallas,
+    )
+
+    k = a.shape[-1]
+    k1 = PALLAS_MAX_RANK
+    k2 = k - k1
+    al = jnp.transpose(a, (1, 2, 0))  # [k, k, E]
+    bl = b.T  # [k, E]
+    a11, a12 = al[:k1, :k1], al[:k1, k1:]
+    a21, a22 = al[k1:, :k1], al[k1:, k1:]
+    b1, b2 = bl[:k1], bl[k1:]
+    y = gauss_solve_multi_pallas(
+        a11, jnp.concatenate([a12, b1[:, None, :]], axis=1)
+    )  # [k1, k2+1, E]
+    y12, y1 = y[:, :k2], y[:, k2]
+    # Batch-last contractions: S = A₂₂ − A₂₁·Y₁₂ etc. (einsum over the k₁
+    # axis with the batch as the trailing dim — XLA lowers these to batched
+    # GEMMs; f32 operands keep full precision).
+    s = a22 - jnp.einsum(
+        "ije,jke->ike", a21, y12,
+        preferred_element_type=jnp.float32, precision="highest",
+    )
+    rhs2 = b2 - jnp.einsum(
+        "ije,je->ie", a21, y1,
+        preferred_element_type=jnp.float32, precision="highest",
+    )
+    x2 = gauss_solve_pallas(s, rhs2)  # [k2, E]
+    x1 = y1 - jnp.einsum(
+        "ije,je->ie", y12, x2,
+        preferred_element_type=jnp.float32, precision="highest",
+    )
+    return jnp.concatenate([x1, x2], axis=0).T  # [E, k]
+
+
 def dispatch_spd_solve(a: jax.Array, b: jax.Array, solver: str) -> jax.Array:
     """Solve batched SPD systems with the selected backend.
 
@@ -228,8 +278,9 @@ def dispatch_spd_solve(a: jax.Array, b: jax.Array, solver: str) -> jax.Array:
                      end-to-end full-Netflix iteration), cholesky elsewhere.
 
     The pallas path pays an explicit [E,k,k] → [k,k,E] transpose to put the
-    batch in the lane dimension; ranks above the kernel's VMEM budget (k > 64)
-    fall back to cholesky.
+    batch in the lane dimension.  Ranks in (PALLAS_MAX_RANK, 2·PALLAS_MAX_RANK]
+    use one level of blocked Schur elimination on the same kernels; anything
+    larger falls back to cholesky.
     """
     if solver == "auto":
         solver = "pallas" if jax.default_backend() == "tpu" else "cholesky"
@@ -238,8 +289,11 @@ def dispatch_spd_solve(a: jax.Array, b: jax.Array, solver: str) -> jax.Array:
     if solver == "pallas":
         from cfk_tpu.ops.pallas import PALLAS_MAX_RANK, gauss_solve_pallas
 
-        if a.shape[-1] > PALLAS_MAX_RANK:
+        k = a.shape[-1]
+        if k > 2 * PALLAS_MAX_RANK:
             return batched_spd_solve(a, b)
+        if k > PALLAS_MAX_RANK:
+            return _blocked_spd_solve_pallas(a, b)
         x = gauss_solve_pallas(jnp.transpose(a, (1, 2, 0)), b.T)
         return x.T
     raise ValueError(f"unknown solver {solver!r}")

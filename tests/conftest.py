@@ -19,10 +19,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 # The environment's sitecustomize force-registers the TPU platform and
 # overrides JAX_PLATFORMS, so the CPU override must go through jax.config
-# before any backend is initialized.
+# before any backend is initialized.  CFK_TPU_TESTS=1 skips the override so
+# the real-hardware tests (tests/test_pallas_tpu.py) can see the chip:
+#   CFK_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -q
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("CFK_TPU_TESTS") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -50,19 +50,62 @@ def test_config_rejects_unknown_solver():
         ALSConfig(solver="lu")
 
 
-def test_rank_above_cap_falls_back_to_cholesky(rng):
+def test_rank_above_blocked_cap_falls_back_to_cholesky(rng):
     from cfk_tpu.ops.pallas import PALLAS_MAX_RANK, gauss_solve_pallas
 
-    k = PALLAS_MAX_RANK + 8
+    # Above 2·PALLAS_MAX_RANK even the blocked Schur path bows out; the
+    # dispatcher must hand off to cholesky (bitwise-identical here, since
+    # the fallback IS batched_spd_solve).
+    k = 2 * PALLAS_MAX_RANK + 8
     a, b, _ = spd_batch(rng, 4, k)
-    # dispatch silently falls back...
     out = dispatch_spd_solve(jnp.asarray(a), jnp.asarray(b), "pallas")
-    np.testing.assert_allclose(
-        out, batched_spd_solve(jnp.asarray(a), jnp.asarray(b)), rtol=1e-4, atol=1e-4
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        np.asarray(batched_spd_solve(jnp.asarray(a), jnp.asarray(b))),
     )
-    # ...while the kernel itself refuses loudly.
+    # ...while the kernels themselves refuse loudly.
     with pytest.raises(ValueError, match="rank"):
         gauss_solve_pallas(jnp.asarray(a.transpose(1, 2, 0)), jnp.asarray(b.T))
+
+
+@pytest.mark.parametrize("k", [96, 128])
+def test_blocked_schur_solve_matches_cholesky(k):
+    """Ranks above PALLAS_MAX_RANK route through one level of blocked Schur
+    elimination on the same kernels (interpret mode here; compiled coverage
+    in tests/test_pallas_tpu.py)."""
+    import jax.numpy as jnp
+
+    from cfk_tpu.ops.solve import batched_spd_solve, dispatch_spd_solve
+
+    rng = np.random.default_rng(k)
+    e = 60
+    x = rng.standard_normal((e, k, 12)).astype(np.float32)
+    a = np.einsum("ekr,elr->ekl", x, x) + 8.0 * np.eye(k, dtype=np.float32)
+    b = rng.standard_normal((e, k)).astype(np.float32)
+    want = np.asarray(batched_spd_solve(jnp.asarray(a), jnp.asarray(b)))
+    got = np.asarray(dispatch_spd_solve(jnp.asarray(a), jnp.asarray(b), "pallas"))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_multi_rhs_kernel_matches_loop():
+    """gauss_solve_multi_pallas solves every RHS column like the single-RHS
+    kernel does."""
+    import jax.numpy as jnp
+
+    from cfk_tpu.ops.pallas import gauss_solve_multi_pallas, gauss_solve_pallas
+
+    rng = np.random.default_rng(1)
+    k, m, e = 16, 5, 40
+    x = rng.standard_normal((e, k, 8)).astype(np.float32)
+    a = np.einsum("ekr,elr->ekl", x, x) + 4.0 * np.eye(k, dtype=np.float32)
+    bs = rng.standard_normal((e, k, m)).astype(np.float32)
+    al = jnp.asarray(np.transpose(a, (1, 2, 0)))
+    got = np.asarray(
+        gauss_solve_multi_pallas(al, jnp.asarray(np.transpose(bs, (1, 2, 0))))
+    )
+    for j in range(m):
+        want = np.asarray(gauss_solve_pallas(al, jnp.asarray(bs[:, :, j].T)))
+        np.testing.assert_allclose(got[:, j, :], want, rtol=1e-4, atol=1e-4)
 
 
 def test_sharded_pallas_matches_single_device(tiny_coo):

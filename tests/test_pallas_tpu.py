@@ -1,0 +1,84 @@
+"""Compiled-on-real-TPU pallas kernel correctness (VERDICT r1 item #8).
+
+Interpret mode (the CPU tests) accepts programs Mosaic rejects and its
+numerics differ from the compiled kernel, so the solvers are also verified
+compiled on hardware.  Skipped unless a TPU backend is active:
+
+    CFK_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -q
+
+(tests/conftest.py forces the CPU platform unless CFK_TPU_TESTS=1.)
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="needs a real TPU backend (run with CFK_TPU_TESTS=1)",
+)
+
+
+def _spd_batch(rng, e, k, dtype=np.float32):
+    x = rng.standard_normal((e, k, max(k // 8, 2))).astype(dtype)
+    a = np.einsum("ekr,elr->ekl", x, x) + 3.0 * np.eye(k, dtype=dtype)
+    b = rng.standard_normal((e, k)).astype(dtype)
+    return a, b
+
+
+# k = 5 (reference parity rank), 32, and 64 including a non-multiple-of-128
+# batch so the padded-lane edge (identity-padded systems) is exercised.
+@pytest.mark.parametrize("k,e", [(5, 77), (32, 300), (64, 257)])
+def test_gauss_solve_compiled_matches_cholesky(k, e):
+    from cfk_tpu.ops.solve import batched_spd_solve
+    from cfk_tpu.ops.pallas import gauss_solve_pallas
+
+    rng = np.random.default_rng(k)
+    a, b = _spd_batch(rng, e, k)
+    want = np.asarray(batched_spd_solve(jnp.asarray(a), jnp.asarray(b)))
+    got = np.asarray(
+        gauss_solve_pallas(
+            jnp.asarray(np.transpose(a, (1, 2, 0))), jnp.asarray(b.T),
+            interpret=False,
+        )
+    ).T
+    resid = np.einsum("ekl,el->ek", a, got) - b
+    assert np.abs(resid).max() < 1e-3, "kernel solution does not satisfy Ax=b"
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("k", [96, 128])
+def test_blocked_solve_compiled_matches_cholesky(k):
+    from cfk_tpu.ops.solve import batched_spd_solve, dispatch_spd_solve
+
+    rng = np.random.default_rng(k)
+    a, b = _spd_batch(rng, 200, k)
+    want = np.asarray(batched_spd_solve(jnp.asarray(a), jnp.asarray(b)))
+    got = np.asarray(dispatch_spd_solve(jnp.asarray(a), jnp.asarray(b), "pallas"))
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
+def test_gram_tiles_kernel_compiled():
+    """The fused grouped-Gram kernel, compiled: must match the XLA path."""
+    from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
+
+    rng = np.random.default_rng(0)
+    t, nt, k, segs = 64, 64, 32, 17
+    g = rng.standard_normal((nt * t, k)).astype(np.float32)
+    wt = (rng.random(nt * t) > 0.2).astype(np.float32)
+    rt = rng.random(nt * t).astype(np.float32) * wt
+    seg = np.sort(rng.integers(0, segs - 1, size=nt)).astype(np.int32)
+    a, b = gram_tiles_pallas(
+        jnp.asarray(g), jnp.asarray(wt), jnp.asarray(rt), jnp.asarray(seg),
+        num_segments=segs, tile_rows=t, interpret=False,
+    )
+    a, b = np.asarray(a), np.asarray(b)
+    for s in np.unique(seg):
+        rows = np.repeat(seg == s, t)
+        gw = g[rows] * wt[rows][:, None]
+        np.testing.assert_allclose(a[s], gw.T @ g[rows], rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(
+            b[s], g[rows].T @ rt[rows], rtol=2e-3, atol=2e-3
+        )
