@@ -40,6 +40,9 @@ class ALSConfig:
     #   "ring"       — ppermute ring, shards accumulate partial Gram matrices
     #                  block by block (the block-to-block-join analog; never
     #                  materializes the full fixed-side matrix per device).
+    #                  Available for the padded and tiled layouts; tiled ring
+    #                  datasets must be built with Dataset.from_coo(...,
+    #                  ring=True).
     exchange: Literal["all_gather", "ring"] = "all_gather"
     # --- HBM bounding: ONE concept, expressed per layout -------------------
     # Every layout bounds the same quantity — the transient neighbor-factor
@@ -119,7 +122,7 @@ class ALSConfig:
             raise ValueError(f"unknown solver {self.solver!r}")
         if self.layout not in ("padded", "bucketed", "segment", "tiled"):
             raise ValueError(f"unknown layout {self.layout!r}")
-        if self.layout != "padded" and self.exchange == "ring":
+        if self.layout not in ("padded", "tiled") and self.exchange == "ring":
             raise ValueError(
                 f"layout={self.layout!r} supports exchange='all_gather' only"
             )

@@ -710,6 +710,7 @@ class TiledBlocks:
     chunk_count: np.ndarray  # int32 [S·NC·Ec]
     carry_in: np.ndarray  # float32 [S·NC]
     last_seg: np.ndarray  # int32 [S·NC]
+    slice_starts: np.ndarray  # int32 [S·(n_slices+1)] accum: chunk range per slice
     count: np.ndarray  # int32 [E_pad]
     rating_sum: np.ndarray  # float32 [E_pad]
     mode: str  # "stream" | "accum"
@@ -720,6 +721,8 @@ class TiledBlocks:
     chunk_entities: int  # Ec (stream mode; 0 in accum)
     tile_rows: int  # T
     slice_rows: int  # H (gather-slice height; = padded fixed rows if unsliced)
+    num_slices: int = 1  # accum: fixed-table slices (ring: = num_shards)
+    ring: bool = False  # built for the ppermute ring exchange
 
     @property
     def padded_entities(self) -> int:
@@ -752,6 +755,7 @@ def build_tiled_blocks(
     chunk_elems: int | None = 1 << 20,
     slice_rows: int = 1 << 17,
     accum_max_entities: int = 1 << 16,
+    ring: bool = False,
 ) -> TiledBlocks:
     """Pad entity runs to tiles and pack into chunks (one mode per side).
 
@@ -766,12 +770,24 @@ def build_tiled_blocks(
     e_pad = _round_up(num_solve_entities, num_shards)
     e_local = e_pad // num_shards
     f_pad = _round_up(num_fixed_entities, num_shards)
-    mode = "accum" if e_local <= accum_max_entities else "stream"
-    n_slices = 1
-    h = f_pad
-    if mode == "accum" and f_pad > slice_rows:
-        h = int(slice_rows)
-        n_slices = (f_pad + h - 1) // h
+    if ring:
+        # Ring (block-to-block join) exchange: slices ARE the fixed-side
+        # factor shards, so at ring step r a device processes exactly the
+        # sub-stream whose neighbors live in the block it currently holds.
+        # Forces accum machinery: entities recur across slices, and the
+        # per-entity accumulator [E_local+1, k, k+1] must fit HBM — the
+        # ring's memory economics on TPU (see PARITY.md / BASELINE.md).
+        mode = "accum"
+        n_slices = num_shards
+        # f_pad = _round_up(num_fixed, num_shards) above, so this divides.
+        h = f_pad // num_shards
+    else:
+        mode = "accum" if e_local <= accum_max_entities else "stream"
+        n_slices = 1
+        h = f_pad
+        if mode == "accum" and f_pad > slice_rows:
+            h = int(slice_rows)
+            n_slices = (f_pad + h - 1) // h
 
     order, count, _ = group_by_dense(solve_dense, num_solve_entities)
     s_sorted = solve_dense[order].astype(np.int64)
@@ -889,6 +905,7 @@ def build_tiled_blocks(
 
     chunk_entity = np.full(num_shards * nc * e_c, e_local, dtype=np.int32)
     chunk_count = np.zeros(num_shards * nc * e_c, dtype=np.int32)
+    slice_starts = np.zeros(num_shards * (n_slices + 1), dtype=np.int32)
 
     for s in range(num_shards):
         (loc, fix, rat, sl, run_start, run_len, run_entity, run_slice,
@@ -931,6 +948,7 @@ def build_tiled_blocks(
                 chunk_entity[ebase : ebase + distinct.shape[0]] = (
                     distinct.astype(np.int32)
                 )
+            sbase = s * (n_slices + 1)
             if n_slices > 1 and run_len.shape[0]:
                 # chunk → slice: every chunk inside slice i's rounded span
                 # (slice_rounded from the placement pass — same truth).
@@ -941,6 +959,14 @@ def build_tiled_blocks(
                     sl_of_chunk * h, f_pad - h
                 ).astype(np.int32)
                 chunk_base[s * nc : (s + 1) * nc] = cb
+                np.cumsum(
+                    chunks_per_slice,
+                    out=slice_starts[sbase + 1 : sbase + n_slices + 1],
+                )
+            else:
+                slice_starts[sbase + 1 : sbase + n_slices + 1] = (
+                    (total_padded + cap - 1) // cap
+                )
             continue
 
         # Stream mode: chunk-relative segs + finalization bookkeeping.
@@ -986,6 +1012,7 @@ def build_tiled_blocks(
         chunk_count=chunk_count,
         carry_in=carry_in,
         last_seg=last_seg,
+        slice_starts=slice_starts,
         count=count_pad,
         rating_sum=rating_sum,
         mode=mode,
@@ -996,6 +1023,8 @@ def build_tiled_blocks(
         chunk_entities=e_c,
         tile_rows=t,
         slice_rows=h,
+        num_slices=n_slices,
+        ring=ring,
     )
 
 
@@ -1077,6 +1106,7 @@ class Dataset:
         pad_multiple: int = 8,
         layout: str = "padded",
         chunk_elems: int | None = 1 << 20,
+        ring: bool = False,
     ) -> "Dataset":
         movie_map, m_dense = index_entities(coo.movie_raw)
         user_map, u_dense = index_entities(coo.user_raw)
@@ -1110,6 +1140,7 @@ class Dataset:
                 build_tiled_blocks,
                 num_shards=num_shards,
                 chunk_elems=chunk_elems,
+                ring=ring,
             )
         elif layout == "padded":
             build = functools.partial(
@@ -1117,6 +1148,11 @@ class Dataset:
             )
         else:
             raise ValueError(f"unknown layout {layout!r}")
+        if ring and layout != "tiled":
+            raise ValueError(
+                "ring=True applies to layout='tiled' (the padded layout's "
+                "ring blocks are built by the sharded trainer itself)"
+            )
         if layout == "tiled":
             movie_blocks = build(
                 m_dense, u_dense, coo.rating,

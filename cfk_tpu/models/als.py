@@ -168,6 +168,7 @@ def _tiled_to_device(blocks: TiledBlocks) -> dict[str, jax.Array]:
         "chunk_count": jnp.asarray(blocks.chunk_count),
         "carry_in": jnp.asarray(blocks.carry_in),
         "last_seg": jnp.asarray(blocks.last_seg),
+        "slice_starts": jnp.asarray(blocks.slice_starts),
         "count": jnp.asarray(blocks.count),
     }
 

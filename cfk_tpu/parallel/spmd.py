@@ -259,8 +259,77 @@ def _tiled_to_tree(blocks: TiledBlocks) -> dict[str, np.ndarray]:
         "chunk_count": blocks.chunk_count,
         "carry_in": blocks.carry_in,
         "last_seg": blocks.last_seg,
+        "slice_starts": blocks.slice_starts,
         "count": blocks.count,
     }
+
+
+def half_step_tiled_ring(
+    fixed_local, blk, chunks, local_entities, *, lam, num_shards,
+    solver="cholesky", gram_backend=None,
+):
+    """Tiled-layout half-iteration over the ppermute ring (block-to-block
+    join) — the reference's headline join strategy at the at-scale layout.
+
+    The ring-built tiled blocks sort each shard's entries by (owner shard
+    of the neighbor, entity) with slices exactly the fixed-side factor
+    shards, so at ring step r the device processes slice (my − r) mod S —
+    whose neighbor indices are local to the factor block it currently
+    holds — and scatter-adds chunk-dense per-entity Grams into a
+    persistent [E_local+1, ...] accumulator; one batched solve at the end.
+    S − 1 ppermutes per half-iteration; the full fixed-side matrix is
+    never materialized per device (O(F/S·k) factor memory, the
+    block-to-block property), traded against the O(E_local·k²)
+    accumulator the join needs on TPU — PARITY.md discusses when that
+    trade wins.
+    """
+    from cfk_tpu.ops.tiled import _entity_gram_chunk, default_tiled_gram_backend
+
+    backend = gram_backend or default_tiled_gram_backend()
+    _, _, nc, cap, t, h, e_c = chunks
+    s = num_shards
+    nt = cap // t
+    k = fixed_local.shape[-1]
+    my = lax.axis_index(AXIS)
+    perm = [(i, (i + 1) % s) for i in range(s)]
+    nb, rt, wt = blk["neighbor_idx"], blk["rating"], blk["weight"]
+    ts, ent = blk["tile_seg"], blk["chunk_entity"]
+    starts = blk["slice_starts"]  # [S+1]
+
+    def slice_grams(acc, factors, t_idx):
+        def chunk_body(i, acc):
+            acc_a, acc_b = acc
+            nb_c = lax.dynamic_slice(nb, (i * cap,), (cap,))
+            rt_c = lax.dynamic_slice(rt, (i * cap,), (cap,))
+            wt_c = lax.dynamic_slice(wt, (i * cap,), (cap,))
+            ts_c = lax.dynamic_slice(ts, (i * nt,), (nt,))
+            ent_c = lax.dynamic_slice(ent, (i * e_c,), (e_c,))
+            a, b = _entity_gram_chunk(
+                factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend
+            )
+            return (acc_a.at[ent_c].add(a[:e_c]), acc_b.at[ent_c].add(b[:e_c]))
+
+        return lax.fori_loop(starts[t_idx], starts[t_idx + 1], chunk_body, acc)
+
+    def body(r, carry):
+        acc_a, acc_b, factors = carry
+        t_idx = (my - r) % s
+        acc_a, acc_b = slice_grams((acc_a, acc_b), factors, t_idx)
+        factors = lax.ppermute(factors, AXIS, perm)
+        return acc_a, acc_b, factors
+
+    a0 = _to_varying(
+        jnp.zeros((local_entities + 1, k, k), jnp.float32), AXIS
+    )
+    b0 = _to_varying(jnp.zeros((local_entities + 1, k), jnp.float32), AXIS)
+    acc_a, acc_b, factors = lax.fori_loop(0, s - 1, body, (a0, b0, fixed_local))
+    acc_a, acc_b = slice_grams(
+        (acc_a, acc_b), factors, (my - (s - 1)) % s
+    )
+    return regularized_solve(
+        acc_a[:local_entities], acc_b[:local_entities],
+        blk["count"], lam, solver,
+    )
 
 
 def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
@@ -276,14 +345,25 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
     tiled = isinstance(dataset.movie_blocks, TiledBlocks)
     if not (bucketed or segment or tiled):
         return None
-    if config.exchange != "all_gather":
-        name = "bucketed" if bucketed else ("segment" if segment else "tiled")
+    ring = config.exchange == "ring"
+    if ring and not tiled:
+        name = "bucketed" if bucketed else "segment"
         raise ValueError(
-            f"{name} layout supports "
-            "exchange='all_gather' only; the ring exchange needs "
-            "shard-local neighbor indices (use layout='padded' or "
-            "exchange='all_gather')"
+            f"{name} layout supports exchange='all_gather' only; the ring "
+            "exchange is available for layout='padded' and layout='tiled' "
+            "(build the tiled dataset with Dataset.from_coo(..., "
+            "ring=True))"
         )
+    if tiled:
+        for name, blocks in (("movie", dataset.movie_blocks),
+                             ("user", dataset.user_blocks)):
+            if ring != blocks.ring:
+                raise ValueError(
+                    f"config.exchange={config.exchange!r} but the tiled "
+                    f"{name}_blocks were built with ring={blocks.ring}; "
+                    f"rebuild with Dataset.from_coo(..., layout='tiled', "
+                    f"ring={ring})"
+                )
     if bucketed:
         mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
         utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
@@ -380,9 +460,28 @@ def make_training_step(
         return wrap_step(mesh, config, half, half, mspecs, uspecs,
                          carry_prev=True)
 
-    if tiled:  # tile-padded layout, all_gather exchange
+    if tiled:  # tile-padded layout
 
         from cfk_tpu.ops.tiled import tiled_half_step
+
+        if config.exchange == "ring":
+
+            def ring_half(chunks, local):
+                def half(fixed_local, blk):
+                    return half_step_tiled_ring(
+                        fixed_local, blk, chunks, local,
+                        lam=config.lam, num_shards=config.num_shards,
+                        solver=config.solver,
+                    )
+
+                return half
+
+            return wrap_step(
+                mesh, config,
+                ring_half(m_chunks, m_local),
+                ring_half(u_chunks, u_local),
+                mspecs, uspecs,
+            )
 
         def tl_solve(chunks, local):
             def solve(fixed_full, blk, _gram):

@@ -166,6 +166,57 @@ def test_sharded_tiled_matches_single(synth):
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
 
+def test_ring_tiled_matches_allgather(synth):
+    """The block-to-block join at the at-scale layout: 4-way ring == 1-way
+    all_gather (VERDICT r1 item #2)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = synthetic_netflix_coo(3000, 400, 60_000, seed=1)
+    cfg1 = ALSConfig(rank=8, lam=0.05, num_iterations=3, seed=0,
+                     layout="tiled", solver="cholesky")
+    ref = train_als(Dataset.from_coo(coo, layout="tiled"), cfg1).predict_dense()
+    cfg4 = dataclasses.replace(cfg1, num_shards=4, exchange="ring")
+    ds4 = Dataset.from_coo(coo, layout="tiled", num_shards=4, ring=True)
+    assert ds4.movie_blocks.ring and ds4.user_blocks.ring
+    assert ds4.movie_blocks.num_slices == 4
+    got = train_als_sharded(ds4, cfg4, make_mesh(4)).predict_dense()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_config_dataset_mismatch_rejected(synth):
+    """exchange='ring' with an all_gather-built tiled dataset (or vice
+    versa) must fail loudly before XLA sees wrong indices."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = synthetic_netflix_coo(500, 60, 5_000, seed=2)
+    mesh = make_mesh(4)
+    ds_ag = Dataset.from_coo(coo, layout="tiled", num_shards=4)
+    cfg_ring = ALSConfig(rank=4, num_iterations=1, num_shards=4,
+                         layout="tiled", exchange="ring", solver="cholesky")
+    with pytest.raises(ValueError, match="ring"):
+        train_als_sharded(ds_ag, cfg_ring, mesh)
+    ds_ring = Dataset.from_coo(coo, layout="tiled", num_shards=4, ring=True)
+    cfg_ag = dataclasses.replace(cfg_ring, exchange="all_gather")
+    with pytest.raises(ValueError, match="ring"):
+        train_als_sharded(ds_ring, cfg_ag, mesh)
+
+
+def test_ring_requires_tiled_layout():
+    coo = synthetic_netflix_coo(100, 20, 500, seed=0)
+    with pytest.raises(ValueError, match="ring"):
+        Dataset.from_coo(coo, layout="segment", ring=True)
+
+
 def test_cache_roundtrip(tmp_path, synth):
     ds = Dataset.from_coo(
         synthetic_netflix_coo(500, 60, 5_000, seed=2), layout="tiled"
@@ -182,7 +233,10 @@ def test_cache_roundtrip(tmp_path, synth):
 def test_config_accepts_tiled():
     cfg = ALSConfig(layout="tiled")
     assert cfg.layout == "tiled"
+    # Ring is available for tiled (unlike bucketed/segment)...
+    assert ALSConfig(layout="tiled", exchange="ring").exchange == "ring"
     with pytest.raises(ValueError, match="all_gather"):
-        ALSConfig(layout="tiled", exchange="ring")
+        ALSConfig(layout="segment", exchange="ring")
+    # ...but the subspace optimizers are not.
     with pytest.raises(ValueError, match="bucketed"):
         ALSConfig(layout="tiled", algorithm="als++", block_size=5, rank=5)
