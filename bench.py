@@ -114,6 +114,9 @@ def scale_main(args) -> None:
         raise SystemExit("--alspp is the explicit model; drop --ials/--ialspp")
     if args.ialspp:
         args.ials = True
+    if args.planted and args.ials:
+        raise SystemExit("--planted generates signed ratings; iALS needs "
+                         "non-negative interaction strengths")
     if args.ialspp or args.alspp:
         if args.layout in ("segment", "tiled"):
             args.layout = "bucketed"  # subspace optimizers need padded/bucketed
@@ -131,7 +134,19 @@ def scale_main(args) -> None:
         users, movies, nnz = args.users, args.movies, args.nnz
 
     t0 = time.time()
-    coo = synthetic_netflix_coo(users, movies, nnz, seed=args.seed)
+    held = None
+    if args.planted:
+        # Quality validation at unfetchable-corpus shapes (VERDICT #6):
+        # ratings come from known rank-`args.rank` factors + N(0, σ²) noise;
+        # held-out RMSE near σ proves the at-scale pipeline recovers them.
+        from cfk_tpu.data.synthetic import planted_factor_coo
+
+        coo, held = planted_factor_coo(
+            users, movies, nnz, rank=args.rank, noise=args.planted_noise,
+            heldout=1_000_000, seed=args.seed,
+        )
+    else:
+        coo = synthetic_netflix_coo(users, movies, nnz, seed=args.seed)
     gen_s = time.time() - t0
     t0 = time.time()
     ds = Dataset.from_coo(coo, layout=args.layout, chunk_elems=args.chunk_elems)
@@ -148,7 +163,7 @@ def scale_main(args) -> None:
         trainer = train_ials
     else:
         config = ALSConfig(
-            rank=args.rank, lam=0.05, num_iterations=args.iterations,
+            rank=args.rank, lam=args.lam, num_iterations=args.iterations,
             seed=0, layout=args.layout, dtype=args.dtype,
             algorithm="als++" if args.alspp else "als",
             block_size=args.block_size, sweeps=args.sweeps,
@@ -190,6 +205,18 @@ def scale_main(args) -> None:
     if steady_s <= 0:
         steady_s = train_s  # includes the fixed overhead; flagged above
     s_per_iter = steady_s / n1
+
+    quality = {}
+    if held is not None:
+        from cfk_tpu.eval.metrics import mse_rmse_heldout
+
+        _, prmse, pn = mse_rmse_heldout(model, ds, held)
+        quality = {
+            "planted_heldout_rmse": round(prmse, 4),
+            "planted_noise_floor": args.planted_noise,
+            "planted_rmse_over_floor": round(prmse / args.planted_noise, 3),
+            "planted_heldout_cells": pn,
+        }
 
     from cfk_tpu.utils.roofline import als_iteration_cost
 
@@ -249,6 +276,7 @@ def scale_main(args) -> None:
                 "compile_wall_s": round(max(warm - train_s, 0.0), 3),
                 "datagen_wall_s": round(gen_s, 3),
                 "blockbuild_wall_s": round(build_s, 3),
+                **quality,
             }
         )
     )
@@ -382,6 +410,17 @@ if __name__ == "__main__":
                         "either way (medium-config RMSE is identical to "
                         "1e-4: 0.758223 bf16 vs 0.758264 f32)")
     parser.add_argument("--chunk-elems", type=int, default=1 << 20)
+    parser.add_argument("--lam", type=float, default=0.05,
+                        help="explicit-model regularization for the scale "
+                        "bench (ALS-WR lambda*n semantics; planted runs "
+                        "want ~0.002 — the lambda*n ridge must stay below "
+                        "the O(1)-scale planted Gram)")
+    parser.add_argument("--planted", action="store_true",
+                        help="generate ratings from known planted factors + "
+                        "noise and report held-out recovery RMSE vs the "
+                        "noise floor (quality validation at unfetchable-"
+                        "corpus shapes)")
+    parser.add_argument("--planted-noise", type=float, default=0.2)
     parser.add_argument("--compare-exchange", action="store_true",
                         help="ring (block-to-block join) vs all_gather "
                         "(all-to-all join) on an 8-virtual-device CPU mesh "

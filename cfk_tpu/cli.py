@@ -56,7 +56,7 @@ def _parse_tcp_url(url: str, topic_optional: bool = False) -> tuple[str, int, st
 
 
 def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded",
-                  chunk_elems=1 << 20, cache_dir=None):
+                  chunk_elems=1 << 20, cache_dir=None, ring=False):
     import os
 
     from cfk_tpu.data.blocks import Dataset
@@ -79,6 +79,8 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
         "layout": layout,
         "chunk_elems": chunk_elems,
     }
+    if ring:  # absent for non-ring keys so existing caches stay valid
+        build_key["ring"] = True
 
     def cache_or_build(build):
         if cache_dir and os.path.exists(os.path.join(cache_dir, "meta.json")):
@@ -91,7 +93,7 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
         coo = build()
         ds = Dataset.from_coo(
             coo, num_shards=num_shards, pad_multiple=pad_multiple,
-            layout=layout, chunk_elems=chunk_elems,
+            layout=layout, chunk_elems=chunk_elems, ring=ring,
         )
         if cache_dir:
             ds.save(cache_dir, build_key=build_key)
@@ -204,6 +206,7 @@ def _train(args) -> int:
             args.data, args.format, args.min_rating, args.shards,
             args.pad_multiple, args.layout, args.chunk_elems,
             cache_dir=args.dataset_cache,
+            ring=args.exchange == "ring" and args.layout == "tiled",
         )
     common = dict(
         layout=args.layout,
@@ -686,7 +689,8 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--solve-chunk", type=int, default=None)
     t.add_argument("--pad-multiple", type=int, default=8)
     t.add_argument(
-        "--layout", choices=["padded", "bucketed", "segment"], default="padded",
+        "--layout", choices=["padded", "bucketed", "segment", "tiled"],
+        default="padded",
         help="InBlock layout: one rectangle, power-of-two width buckets, or "
         "flat segment runs with grouped ragged-matmul Grams (exactly O(nnz) "
         "memory for arbitrarily skewed data; fastest at full-Netflix scale)",

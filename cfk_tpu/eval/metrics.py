@@ -37,6 +37,37 @@ def mse_rmse_from_blocks(predictions: np.ndarray, dataset: Dataset) -> tuple[flo
     )
 
 
+def mse_rmse_heldout(
+    model, dataset, held, chunk: int = 1 << 22
+) -> tuple[float, float, int]:
+    """(MSE, RMSE, cells evaluated) on held-out raw-id cells.
+
+    ``held`` is a RatingsCOO with RAW external ids; cells whose user or
+    movie never appeared in training (no dense index) are dropped — their
+    factors don't exist.  Streams factor-space dot products like
+    ``mse_rmse_from_model``.  Used by the planted-factor quality
+    validation (bench.py --planted, tests/test_planted.py).
+    """
+    u, m = model.host_factors()
+    um, mm = dataset.user_map, dataset.movie_map
+    u_idx = np.searchsorted(um.raw_ids, held.user_raw)
+    m_idx = np.searchsorted(mm.raw_ids, held.movie_raw)
+    u_idx = np.minimum(u_idx, um.num_entities - 1)
+    m_idx = np.minimum(m_idx, mm.num_entities - 1)
+    known = (um.raw_ids[u_idx] == held.user_raw) & (
+        mm.raw_ids[m_idx] == held.movie_raw
+    )
+    ud, md, r = u_idx[known], m_idx[known], held.rating[known]
+    se = 0.0
+    for lo in range(0, r.shape[0], chunk):
+        sl = slice(lo, lo + chunk)
+        pred = np.einsum("nk,nk->n", u[ud[sl]], m[md[sl]], dtype=np.float64)
+        se += float(np.sum((r[sl].astype(np.float64) - pred) ** 2))
+    n = int(r.shape[0])
+    mse = se / max(n, 1)
+    return mse, math.sqrt(mse), n
+
+
 def mse_rmse_from_model(model, dataset: Dataset, chunk: int = 1 << 22) -> tuple[float, float]:
     """MSE/RMSE straight from the factor matrices, never materializing P.
 

@@ -11,7 +11,7 @@ def _args(**over):
         scale=True, full=False, ials=False, ialspp=False, alspp=False,
         users=300, movies=80, nnz=2000, rank=8, iterations=2, seed=0,
         layout="segment", dtype="bfloat16", chunk_elems=1024, repeats=1,
-        block_size=4, sweeps=1,
+        block_size=4, sweeps=1, lam=0.05, planted=False, planted_noise=0.2,
     )
     base.update(over)
     return argparse.Namespace(**base)
