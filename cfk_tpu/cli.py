@@ -324,6 +324,21 @@ def _train(args) -> int:
     return 0
 
 
+def _journal_transport(journal: str, *, fsync: bool):
+    """Transport for a --checkpoint-journal target: tcp://HOST:PORT broker
+    or a FileBroker directory.  Raises ValueError on a malformed URL and
+    OSError when the broker is unreachable — callers turn both into clean
+    CLI errors."""
+    if journal.startswith("tcp://"):
+        from cfk_tpu.transport.tcp import TcpBrokerClient
+
+        host, port, _ = _parse_tcp_url(journal, topic_optional=True)
+        return TcpBrokerClient(host, port)
+    from cfk_tpu.transport.filelog import FileBroker
+
+    return FileBroker(journal, fsync=fsync)
+
+
 def _make_checkpoint_manager(args):
     """The checkpoint store the train flags select: the npz directory
     (``--checkpoint-dir``, the fast local default), the transport journal
@@ -343,21 +358,13 @@ def _make_checkpoint_manager(args):
     if journal:
         from cfk_tpu.transport.journal import JournalCheckpointManager
 
-        if journal.startswith("tcp://"):
-            from cfk_tpu.transport.tcp import TcpBrokerClient
-
-            try:
-                host, port, _ = _parse_tcp_url(journal, topic_optional=True)
-            except ValueError as e:
-                _eprint(f"error: {e}")
-                return 2
-            transport = TcpBrokerClient(host, port)
-        else:
-            from cfk_tpu.transport.filelog import FileBroker
-
-            # fsync per append: the commit marker must never reach disk
-            # before the factor frames it commits (cross-file ordering).
-            transport = FileBroker(journal, fsync=True)
+        try:
+            # fsync per append for the training journal: the commit marker
+            # must never reach disk before the factor frames it commits.
+            transport = _journal_transport(journal, fsync=True)
+        except (ValueError, OSError) as e:
+            _eprint(f"error: {e}")
+            return 2
         return JournalCheckpointManager(
             transport, num_partitions=args.journal_partitions
         )
@@ -454,6 +461,30 @@ def _evaluate(args) -> int:
     return 0
 
 
+def _serving_state(args):
+    """Restore factors for the serving subcommands from either store:
+    --checkpoint-dir (npz directory) or --checkpoint-journal (transport
+    journal — a FileBroker directory or tcp://HOST:PORT broker)."""
+    if bool(args.checkpoint_dir) == bool(args.checkpoint_journal):
+        _eprint("error: pass exactly one of --checkpoint-dir / "
+                "--checkpoint-journal")
+        return None
+    try:
+        if args.checkpoint_dir:
+            from cfk_tpu.transport.checkpoint import CheckpointManager
+
+            return CheckpointManager(args.checkpoint_dir).restore()
+        from cfk_tpu.transport.journal import JournalCheckpointManager
+
+        transport = _journal_transport(args.checkpoint_journal, fsync=False)
+        return JournalCheckpointManager(transport).restore()
+    except (ValueError, OSError) as e:
+        # Malformed URL, unreachable broker, or an empty/uncommitted store —
+        # common operator mistakes; a clean error beats a traceback.
+        _eprint(f"error: {e}")
+        return None
+
+
 def _predict(args) -> int:
     """Dump the prediction CSV from checkpointed factors, no retraining.
 
@@ -467,14 +498,15 @@ def _predict(args) -> int:
     from cfk_tpu.data.netflix import parse_netflix
     from cfk_tpu.eval.predict import save_prediction_csv
     from cfk_tpu.models.als import ALSModel
-    from cfk_tpu.transport.checkpoint import CheckpointManager
 
     if args.format == "netflix":
         coo = parse_netflix(args.data)
     else:
         coo = parse_movielens_csv(args.data, min_rating=args.min_rating)
     ds = RatingsIndex.from_coo(coo)
-    state = CheckpointManager(args.checkpoint_dir).restore()
+    state = _serving_state(args)
+    if state is None:
+        return 2
     if state.user_factors.shape[0] < ds.user_map.num_entities or (
         state.movie_factors.shape[0] < ds.movie_map.num_entities
     ):
@@ -509,7 +541,6 @@ def _recommend(args) -> int:
     from cfk_tpu.data.movielens import parse_movielens_csv
     from cfk_tpu.data.netflix import parse_netflix
     from cfk_tpu.models.als import ALSModel
-    from cfk_tpu.transport.checkpoint import CheckpointManager
 
     # Only the id maps + seen lists are needed — never build solve blocks
     # (a padded rectangle at full-Netflix scale would dwarf serving memory).
@@ -518,7 +549,9 @@ def _recommend(args) -> int:
     else:
         coo = parse_movielens_csv(args.data, min_rating=args.min_rating)
     ds = RatingsIndex.from_coo(coo)
-    state = CheckpointManager(args.checkpoint_dir).restore()
+    state = _serving_state(args)
+    if state is None:
+        return 2
     model = ALSModel(
         user_factors=state.user_factors,
         movie_factors=state.movie_factors,
@@ -691,15 +724,17 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--layout", choices=["padded", "bucketed", "segment", "tiled"],
         default="padded",
-        help="InBlock layout: one rectangle, power-of-two width buckets, or "
-        "flat segment runs with grouped ragged-matmul Grams (exactly O(nnz) "
-        "memory for arbitrarily skewed data; fastest at full-Netflix scale)",
+        help="InBlock layout: one rectangle (padded), power-of-two width "
+        "buckets (bucketed), flat segment runs with grouped ragged-matmul "
+        "Grams (segment; exactly O(nnz) memory for arbitrarily skewed "
+        "data), or tile-padded runs with batched-GEMM Grams and sliced-"
+        "table gathers (tiled; the fastest at full-Netflix scale)",
     )
     t.add_argument(
         "--chunk-elems", type=int, default=1 << 20,
-        help="bucketed/segment layouts: HBM budget for the per-solve-chunk "
-        "neighbor-factor gather (bucketed: rows·width cells; segment: "
-        "ratings per scan chunk)",
+        help="bucketed/segment/tiled layouts: HBM budget for the per-solve-"
+        "chunk neighbor-factor gather (bucketed: rows·width cells; "
+        "segment/tiled: ratings per scan chunk)",
     )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)
@@ -734,7 +769,10 @@ def build_parser() -> argparse.ArgumentParser:
     rc = sub.add_parser(
         "recommend", help="top-K recommendations from checkpointed factors"
     )
-    rc.add_argument("--checkpoint-dir", required=True)
+    rc.add_argument("--checkpoint-dir", default=None)
+    rc.add_argument("--checkpoint-journal", default=None,
+                    help="serve from a transport journal instead "
+                    "(directory or tcp://HOST:PORT)")
     rc.add_argument("--data", required=True,
                     help="training data file (raw-id mapping + exclude-seen)")
     rc.add_argument("--format", choices=["netflix", "movielens"], default="netflix")
@@ -751,7 +789,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="dump the prediction CSV from checkpointed factors "
         "(the reference's final-collection phase as a standalone step)",
     )
-    pd.add_argument("--checkpoint-dir", required=True)
+    pd.add_argument("--checkpoint-dir", default=None)
+    pd.add_argument("--checkpoint-journal", default=None,
+                    help="serve from a transport journal instead "
+                    "(directory or tcp://HOST:PORT)")
     pd.add_argument("--data", required=True,
                     help="training data file (raw-id mapping / matrix shape)")
     pd.add_argument("--format", choices=["netflix", "movielens"], default="netflix")

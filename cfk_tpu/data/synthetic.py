@@ -81,10 +81,16 @@ def planted_factor_coo(
     total = nnz + heldout
     m_idx = rng.choice(num_movies, size=total, p=zipf_probs(num_movies, movie_skew))
     u_idx = rng.choice(num_users, size=total, p=zipf_probs(num_users, user_skew))
-    r = (
-        np.einsum("nk,nk->n", u_star[u_idx], m_star[m_idx])
-        + noise * rng.standard_normal(total)
-    ).astype(np.float32)
+    # Chunked dot products: unchunked [total, rank] gathers would spike
+    # ~52 GB host RAM at the full Netflix shape.
+    r = np.empty(total, dtype=np.float32)
+    chunk = 1 << 22
+    for lo in range(0, total, chunk):
+        sl = slice(lo, lo + chunk)
+        r[sl] = np.einsum(
+            "nk,nk->n", u_star[u_idx[sl]], m_star[m_idx[sl]]
+        )
+    r += (noise * rng.standard_normal(total)).astype(np.float32)
     train = RatingsCOO(
         movie_raw=m_ids[m_idx[:nnz]], user_raw=u_ids[u_idx[:nnz]],
         rating=r[:nnz],

@@ -153,6 +153,30 @@ def test_train_kill_resume_through_journal(tiny_dataset, tmp_path):
     np.testing.assert_allclose(resumed, straight, rtol=1e-5, atol=1e-5)
 
 
+def test_cli_serving_from_journal(tmp_path, capsys):
+    """predict/recommend serve straight from the transport journal — the
+    full topics-as-durable-checkpoint loop: train → journal → serve."""
+    from cfk_tpu.cli import main
+
+    tiny = "/root/reference/data/data_sample_tiny.txt"
+    j = str(tmp_path / "journal")
+    assert main(["train", "--data", tiny, "--rank", "3", "--iterations", "2",
+                 "--seed", "0", "--checkpoint-journal", j,
+                 "--output", "none"]) == 0
+    pred = str(tmp_path / "pred.csv")
+    assert main(["predict", "--checkpoint-journal", j, "--data", tiny,
+                 "--output", pred]) == 0
+    assert main(["evaluate", tiny, pred]) == 0
+    assert main(["recommend", "--checkpoint-journal", j, "--data", tiny,
+                 "--users", "6", "-k", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "\t" in out.strip().splitlines()[-1]  # user\tmovie:score pairs
+    # Exactly one store must be selected.
+    assert main(["recommend", "--data", tiny, "--users", "6"]) == 2
+    assert main(["predict", "--checkpoint-dir", j, "--checkpoint-journal", j,
+                 "--data", tiny, "--output", pred]) == 2
+
+
 def test_journal_through_tcp_broker(tmp_path):
     """The same journal against a cfk_broker server process."""
     from cfk_tpu.transport.tcp import BrokerProcess, build_broker
