@@ -266,11 +266,7 @@ def _train(args) -> int:
                     ds, config, make_mesh(args.shards), metrics=metrics, **ck
                 )
             else:
-                if manager is not None:
-                    flag = ("--checkpoint-journal" if args.checkpoint_journal
-                            else "--checkpoint-dir")
-                    _eprint(f"note: {flag} ignored for single-shard iALS")
-                model = train_ials(ds, config, metrics=metrics)
+                model = train_ials(ds, config, metrics=metrics, **ck)
         else:
             config = ALSConfig(**common)
             if args.shards > 1:

@@ -64,7 +64,8 @@ def planted_factor_coo(
 
     The quality validation for shapes whose real corpus is unfetchable
     (VERDICT r1 item #6): plant U* [users, rank], M* [movies, rank] with
-    entries N(0, 1/√rank) — so planted ratings are O(1) — and emit
+    entries N(0, rank^-1/4) — so the rank-term dot product u*·m* has unit
+    variance and planted ratings are O(1) — and emit
     r = u*·m* + ε, ε ~ N(0, noise²), at Zipf-popular (user, movie) pairs.
     A correctly working at-scale pipeline (layout + bf16 storage + pallas
     solver + sharding) must drive held-out RMSE down toward the noise
@@ -74,8 +75,8 @@ def planted_factor_coo(
     rng = np.random.default_rng(seed)
     u_star = rng.standard_normal((num_users, rank)).astype(np.float32)
     m_star = rng.standard_normal((num_movies, rank)).astype(np.float32)
-    u_star /= np.sqrt(rank) ** 0.5
-    m_star /= np.sqrt(rank) ** 0.5
+    u_star /= rank ** 0.25
+    m_star /= rank ** 0.25
     m_ids = rng.permutation(num_movies).astype(np.int64) + 1
     u_ids = rng.permutation(num_users).astype(np.int64) + 1
     total = nnz + heldout

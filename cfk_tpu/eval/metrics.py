@@ -87,5 +87,5 @@ def mse_rmse_from_model(model, dataset: Dataset, chunk: int = 1 << 22) -> tuple[
             "nk,nk->n", u[ud[sl]], m[md[sl]], dtype=np.float64
         )
         se += float(np.sum((r[sl].astype(np.float64) - pred) ** 2))
-    mse = se / r.shape[0]
+    mse = se / max(r.shape[0], 1)
     return mse, math.sqrt(mse)

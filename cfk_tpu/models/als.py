@@ -445,23 +445,11 @@ def train_als(
             u.block_until_ready()
         metrics.incr("iterations", config.num_iterations)
     else:
-        from cfk_tpu.transport.checkpoint import resume_state, should_save
+        from cfk_tpu.transport.checkpoint import checkpointed_train_loop
 
         dt = jnp.dtype(config.dtype)
-        state = resume_state(
-            checkpoint_manager,
-            rank=config.rank,
-            model="als",
-            num_iterations=config.num_iterations,
-            u_shape=(dataset.user_blocks.padded_entities, config.rank),
-            m_shape=(dataset.movie_blocks.padded_entities, config.rank),
-        )
-        if state is not None:
-            start_iter = state.iteration
-            u = jnp.asarray(state.user_factors, dtype=dt)
-            m = jnp.asarray(state.movie_factors, dtype=dt)
-        else:
-            start_iter = 0
+
+        def init_fn():
             if u_stats is not None:
                 u = init_factors_stats(
                     key, u_stats["rating_sum"], u_stats["count"], config.rank
@@ -472,26 +460,31 @@ def train_als(
                     config.rank,
                 ).astype(dt)
             m = jnp.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
-        for i in range(start_iter, config.num_iterations):
-            with metrics.phase("train"):
-                u, m = _one_iteration(
-                    u, m, mblocks, ublocks,
-                    lam=config.lam, solve_chunk=config.solve_chunk,
-                    dtype=config.dtype, solver=config.solver,
-                    algorithm=config.algorithm, block_size=config.block_size,
-                    sweeps=config.sweeps,
-                    **layout_kw,
-                )
-                u.block_until_ready()
-            metrics.incr("iterations")
-            done = i + 1
-            if should_save(done, checkpoint_every, config.num_iterations):
-                with metrics.phase("checkpoint"):
-                    checkpoint_manager.save(
-                        done, np.asarray(u), np.asarray(m),
-                        meta={"rank": config.rank, "model": "als"},
-                    )
-                metrics.incr("checkpoints")
+            return u, m
+
+        def step_fn(u, m):
+            return _one_iteration(
+                u, m, mblocks, ublocks,
+                lam=config.lam, solve_chunk=config.solve_chunk,
+                dtype=config.dtype, solver=config.solver,
+                algorithm=config.algorithm, block_size=config.block_size,
+                sweeps=config.sweeps,
+                **layout_kw,
+            )
+
+        u, m = checkpointed_train_loop(
+            checkpoint_manager,
+            model="als",
+            rank=config.rank,
+            num_iterations=config.num_iterations,
+            u_shape=(dataset.user_blocks.padded_entities, config.rank),
+            m_shape=(dataset.movie_blocks.padded_entities, config.rank),
+            dtype=dt,
+            init_fn=init_fn,
+            step_fn=step_fn,
+            metrics=metrics,
+            checkpoint_every=checkpoint_every,
+        )
     return ALSModel(
         user_factors=u,
         movie_factors=m,

@@ -139,26 +139,76 @@ def _train_loop(
         m_rows = movie_blocks["rating"].shape[0]
     u = u.astype(dt)
     m0 = jnp.zeros((m_rows, rank), dtype=dt)
-    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps)
 
     def one_iteration(_, carry):
         u, m_prev = carry
-        m = _ials_half(
-            u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
-            chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
-        ).astype(dt)
-        u_new = _ials_half(
-            m, user_blocks, lam=lam, alpha=alpha, solver=solver,
-            chunks=u_chunks, entities=u_entities, x_prev=u, **alg,
-        ).astype(dt)
-        return (u_new, m)
+        return _ials_iteration_body(
+            u, m_prev, movie_blocks, user_blocks,
+            lam=lam, alpha=alpha, dt=dt, solver=solver,
+            algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+            m_chunks=m_chunks, u_chunks=u_chunks,
+            m_entities=m_entities, u_entities=u_entities,
+        )
 
     return lax.fori_loop(0, num_iterations, one_iteration, (u, m0))
 
 
-def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSModel:
+def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
+                         dt, solver, algorithm, block_size, sweeps,
+                         m_chunks, u_chunks, m_entities, u_entities):
+    """One full iALS iteration (movies from users, then users from movies) —
+    the single source of the per-iteration math for the fused-loop and
+    checkpointed paths (mirrors ``als._iteration_body``)."""
+    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps)
+    m = _ials_half(
+        u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
+        chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
+    ).astype(dt)
+    u_new = _ials_half(
+        m, user_blocks, lam=lam, alpha=alpha, solver=solver,
+        chunks=u_chunks, entities=u_entities, x_prev=u, **alg,
+    ).astype(dt)
+    return (u_new, m)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "lam", "alpha", "dtype", "solver", "algorithm", "block_size",
+        "sweeps", "m_chunks", "u_chunks", "m_entities", "u_entities",
+    ),
+    donate_argnums=(0, 1),
+)
+def _one_iteration(
+    u, m_prev, movie_blocks, user_blocks, *, lam, alpha, dtype,
+    solver="cholesky", algorithm="als", block_size=32, sweeps=1,
+    m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
+):
+    return _ials_iteration_body(
+        u, m_prev, movie_blocks, user_blocks,
+        lam=lam, alpha=alpha, dt=jnp.dtype(dtype), solver=solver,
+        algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+        m_chunks=m_chunks, u_chunks=u_chunks,
+        m_entities=m_entities, u_entities=u_entities,
+    )
+
+
+def train_ials(
+    dataset: Dataset,
+    config: IALSConfig,
+    *,
+    checkpoint_manager=None,
+    checkpoint_every: int = 1,
+    metrics=None,
+) -> ALSModel:
     """Single-device implicit ALS. Ratings in the dataset are interaction
-    strengths (counts, play-time, explicit stars — anything ≥ 0)."""
+    strengths (counts, play-time, explicit stars — anything ≥ 0).
+
+    Checkpoint semantics match ``als.train_als``: without a manager the loop
+    runs as one fused ``fori_loop``; with one, iterations step from Python,
+    factors are journaled every ``checkpoint_every`` iterations, and training
+    resumes from the latest committed step (the reference's ``setup.sh:18-21``
+    journal applies to every model, so ours does too)."""
     from cfk_tpu.utils.metrics import Metrics
 
     metrics = metrics if metrics is not None else Metrics()
@@ -174,25 +224,66 @@ def train_ials(dataset: Dataset, config: IALSConfig, *, metrics=None) -> ALSMode
         ublocks = _blocks_to_device(dataset.user_blocks)
         u_stats = None
         layout_kw = {}
-    with metrics.phase("train"):
-        u, m = _train_loop(
-            key,
-            mblocks,
-            ublocks,
-            u_stats,
+    if checkpoint_manager is None:
+        with metrics.phase("train"):
+            u, m = _train_loop(
+                key,
+                mblocks,
+                ublocks,
+                u_stats,
+                rank=config.rank,
+                num_iterations=config.num_iterations,
+                lam=config.lam,
+                alpha=config.alpha,
+                dtype=config.dtype,
+                solver=config.solver,
+                algorithm=config.algorithm,
+                block_size=config.block_size,
+                sweeps=config.sweeps,
+                **layout_kw,
+            )
+            u.block_until_ready()
+        metrics.incr("iterations", config.num_iterations)
+    else:
+        from cfk_tpu.transport.checkpoint import checkpointed_train_loop
+
+        dt = jnp.dtype(config.dtype)
+
+        def init_fn():
+            if u_stats is not None:
+                u = init_factors_stats(
+                    key, u_stats["rating_sum"], u_stats["count"], config.rank
+                ).astype(dt)
+            else:
+                u = init_factors(
+                    key, ublocks["rating"], ublocks["mask"], ublocks["count"],
+                    config.rank,
+                ).astype(dt)
+            m = jnp.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
+            return u, m
+
+        def step_fn(u, m):
+            return _one_iteration(
+                u, m, mblocks, ublocks,
+                lam=config.lam, alpha=config.alpha, dtype=config.dtype,
+                solver=config.solver, algorithm=config.algorithm,
+                block_size=config.block_size, sweeps=config.sweeps,
+                **layout_kw,
+            )
+
+        u, m = checkpointed_train_loop(
+            checkpoint_manager,
+            model="ials",
             rank=config.rank,
             num_iterations=config.num_iterations,
-            lam=config.lam,
-            alpha=config.alpha,
-            dtype=config.dtype,
-            solver=config.solver,
-            algorithm=config.algorithm,
-            block_size=config.block_size,
-            sweeps=config.sweeps,
-            **layout_kw,
+            u_shape=(dataset.user_blocks.padded_entities, config.rank),
+            m_shape=(dataset.movie_blocks.padded_entities, config.rank),
+            dtype=dt,
+            init_fn=init_fn,
+            step_fn=step_fn,
+            metrics=metrics,
+            checkpoint_every=checkpoint_every,
         )
-        u.block_until_ready()
-    metrics.incr("iterations", config.num_iterations)
     return ALSModel(
         user_factors=u,
         movie_factors=m,

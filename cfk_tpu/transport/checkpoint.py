@@ -69,6 +69,58 @@ def resume_state(
     return state
 
 
+def checkpointed_train_loop(
+    manager,
+    *,
+    model: str,
+    rank: int,
+    num_iterations: int,
+    u_shape: tuple[int, int],
+    m_shape: tuple[int, int],
+    dtype,
+    init_fn,
+    step_fn,
+    metrics,
+    checkpoint_every: int = 1,
+):
+    """The single-process checkpointed training loop every trainer shares.
+
+    Resumes from the manager's latest committed state (validated by
+    ``resume_state``) or calls ``init_fn() -> (u, m)``; then steps
+    ``step_fn(u, m) -> (u, m)`` from Python, journaling factors every
+    ``checkpoint_every`` iterations under ``metrics`` phases.  Factoring
+    this out keeps save cadence / resume validation / metrics accounting
+    identical across model families by construction (ADVICE r3).
+    """
+    import jax.numpy as jnp
+
+    state = resume_state(
+        manager, rank=rank, model=model, num_iterations=num_iterations,
+        u_shape=u_shape, m_shape=m_shape,
+    )
+    if state is not None:
+        start_iter = state.iteration
+        u = jnp.asarray(state.user_factors, dtype=dtype)
+        m = jnp.asarray(state.movie_factors, dtype=dtype)
+    else:
+        start_iter = 0
+        u, m = init_fn()
+    for i in range(start_iter, num_iterations):
+        with metrics.phase("train"):
+            u, m = step_fn(u, m)
+            u.block_until_ready()
+        metrics.incr("iterations")
+        done = i + 1
+        if should_save(done, checkpoint_every, num_iterations):
+            with metrics.phase("checkpoint"):
+                manager.save(
+                    done, np.asarray(u), np.asarray(m),
+                    meta={"rank": rank, "model": model},
+                )
+            metrics.incr("checkpoints")
+    return u, m
+
+
 def resume_state_synced(
     manager: "CheckpointManager | None",
     *,

@@ -159,9 +159,12 @@ class FileBroker:
         if fh is None:
             fh = open(_log_path(os.path.join(self.directory, topic), partition), "ab")
             self._files[(topic, partition)] = fh
+        # Pack BEFORE touching the seek index: pack raises on key overflow,
+        # and a stale index entry would mislabel every later indexed consume.
+        record = _HEADER.pack(key, len(value)) + value
         if self._counts[(topic, partition)] % _INDEX_EVERY == 0:
             self._index[(topic, partition)].append(self._bytes[(topic, partition)])
-        fh.write(_HEADER.pack(key, len(value)) + value)
+        fh.write(record)
         if self._fsync:
             fh.flush()
             os.fsync(fh.fileno())
@@ -186,6 +189,13 @@ class FileBroker:
         n, vbytes = frames.shape
         if keys.shape != (n,):
             raise ValueError(f"keys shape {keys.shape} != ({n},)")
+        if n and (keys.min() < -(2**31) or keys.max() >= 2**31):
+            # Match the per-record path, where struct.pack('>i') raises on
+            # overflow — astype('>i4') below would silently wrap instead.
+            raise OverflowError(
+                f"record keys must fit int32, got range "
+                f"[{int(keys.min())}, {int(keys.max())}]"
+            )
         nparts = self._num_partitions_checked(topic)
         if not 0 <= partition < nparts:
             raise IndexError(f"partition {partition} out of range for {topic!r}")

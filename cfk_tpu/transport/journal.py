@@ -103,8 +103,13 @@ class JournalCheckpointManager:
         self.transport = transport
         self.num_partitions = num_partitions
         self.keep_last = keep_last
+
+    def _ensure_commits_topic(self) -> None:
+        # Created lazily on first save so restore-only usage (predict /
+        # recommend serving) never mutates the target — pointing serving at
+        # a wrong path errors instead of scaffolding an empty journal there.
         try:
-            transport.create_topic(_COMMITS, 1)
+            self.transport.create_topic(_COMMITS, 1)
         except ValueError:
             pass  # existing journal: resume against it
 
@@ -145,6 +150,7 @@ class JournalCheckpointManager:
         # are upcast on the wire and re-cast at restore, like the npz store.
         u32 = u.astype(np.float32)
         m32 = m.astype(np.float32)
+        self._ensure_commits_topic()
         self._write_side("user", iteration, u32)
         self._write_side("movie", iteration, m32)
         commit = {
@@ -169,6 +175,13 @@ class JournalCheckpointManager:
 
     def _commits(self) -> dict[int, dict]:
         out: dict[int, dict] = {}
+        try:
+            self.transport.num_partitions(_COMMITS)
+        except KeyError:
+            raise FileNotFoundError(
+                "no checkpoint journal here (the "
+                f"{_COMMITS!r} topic does not exist) — is the path right?"
+            ) from None
         for rec in self.transport.consume(_COMMITS, 0):
             commit = json.loads(rec.value.decode())
             out[int(commit["iteration"])] = commit  # later commit wins
@@ -183,9 +196,13 @@ class JournalCheckpointManager:
 
     def iterations(self) -> list[int]:
         """Committed iterations whose topics still exist (not pruned)."""
+        try:
+            commits = self._commits()
+        except FileNotFoundError:
+            return []  # fresh journal: nothing saved yet
         return sorted(
             it
-            for it in self._commits()
+            for it in commits
             if self._topic_exists(self._topic("user", it))
             and self._topic_exists(self._topic("movie", it))
         )

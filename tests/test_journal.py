@@ -64,6 +64,43 @@ def test_save_restore_roundtrip_inmemory(partitions):
     np.testing.assert_array_equal(state.movie_factors, m)
 
 
+def test_restore_only_usage_never_mutates_target(tmp_path):
+    """Pointing a restore-only manager at a wrong/empty directory must error,
+    not scaffold a journal there (ADVICE r2: read paths used to create the
+    commits topic as a side effect of __init__)."""
+    broker = FileBroker(str(tmp_path / "not_a_journal"))
+    mgr = JournalCheckpointManager(broker)
+    assert mgr.latest_iteration() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+    broker.close()
+    assert list((tmp_path / "not_a_journal").iterdir()) == []
+
+
+def test_bulk_frame_keys_must_fit_int32(tmp_path):
+    """produce_frames must reject keys that struct.pack('>i') would reject,
+    instead of silently wrapping them through astype('>i4')."""
+    broker = FileBroker(str(tmp_path))
+    broker.create_topic("t", 1)
+    frames = np.zeros((2, 4), np.uint8)
+    with pytest.raises(OverflowError):
+        broker.produce_frames("t", np.array([0, 2**31]), frames, 0)
+    import struct
+
+    with pytest.raises(struct.error):  # the per-record path it now mirrors
+        broker.produce("t", 2**31, b"abcd", 0)
+    # A failed produce must leave no trace: the seek index in particular
+    # (appending it before pack raised used to duplicate the offset-0 entry
+    # and shift every later index slot — silent wrong records on any
+    # indexed consume past the first index stride).
+    assert broker._index[("t", 0)] == []
+    broker.produce("t", 7, b"wxyz", 0)
+    assert broker._index[("t", 0)] == [0]
+    recs = list(broker.consume("t", 0, start_offset=0))
+    assert [(r.key, r.value) for r in recs] == [(7, b"wxyz")]
+    broker.close()
+
+
 def test_filebroker_journal_survives_reopen(tmp_path):
     """Kill (close) the broker after a save; a fresh FileBroker over the same
     directory must restore identical factors — durable-log semantics."""
@@ -148,6 +185,30 @@ def test_train_kill_resume_through_journal(tiny_dataset, tmp_path):
         mgr = JournalCheckpointManager(broker)
         assert mgr.latest_iteration() == 2
         resumed = train_als(
+            tiny_dataset, cfg4, checkpoint_manager=mgr
+        ).predict_dense()
+    np.testing.assert_allclose(resumed, straight, rtol=1e-5, atol=1e-5)
+
+
+def test_ials_train_kill_resume_through_journal(tiny_dataset, tmp_path):
+    """VERDICT r2 item #5: the journal round-trip for single-shard iALS —
+    every trainer gets checkpoint/resume, not just explicit ALS."""
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+
+    cfg4 = IALSConfig(rank=3, lam=0.1, alpha=10.0, num_iterations=4, seed=5)
+    straight = train_ials(tiny_dataset, cfg4).predict_dense()
+
+    cfg2 = IALSConfig(rank=3, lam=0.1, alpha=10.0, num_iterations=2, seed=5)
+    with FileBroker(str(tmp_path), fsync=False) as broker:
+        train_ials(
+            tiny_dataset, cfg2,
+            checkpoint_manager=JournalCheckpointManager(broker),
+        )  # "crash" after 2 iterations (process ends, broker closes)
+    with FileBroker(str(tmp_path), fsync=False) as broker:
+        mgr = JournalCheckpointManager(broker)
+        assert mgr.latest_iteration() == 2
+        assert mgr.restore().meta["model"] == "ials"
+        resumed = train_ials(
             tiny_dataset, cfg4, checkpoint_manager=mgr
         ).predict_dense()
     np.testing.assert_allclose(resumed, straight, rtol=1e-5, atol=1e-5)
