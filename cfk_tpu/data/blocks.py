@@ -870,7 +870,12 @@ def build_tiled_blocks(
 
     nc = max_chunks
     total = num_shards * nc * cap
-    neighbor = np.zeros(total, dtype=np.int32)
+    # Padding entries index the ZERO ROW the gram kernels append to the
+    # fixed table/slice (= its height h), so gathered padding contributes
+    # exact zeros even on the unit-weight fast path that never multiplies
+    # by the weight channel.  (Format version 3 — older blocks pointed
+    # padding at row 0 and relied on weight 0.)
+    neighbor = np.full(total, h, dtype=np.int32)
     rmat = np.zeros(total, dtype=np.float32)
     wmat = np.zeros(total, dtype=np.float32)
     tile_seg = np.zeros(num_shards * nc * nt, dtype=np.int32)

@@ -39,8 +39,13 @@ from cfk_tpu.data.blocks import (
 
 # 1: arrays always in "arrays.npz". 2: uniquely-named arrays file recorded in
 # meta.json "arrays" (meta is the atomic commit point pairing the two).
-_FORMAT_VERSION = 2
-_READABLE_VERSIONS = (1, 2)
+# 3: tiled-layout padding entries index the appended zero row of the fixed
+#    table (neighbor = slice height) instead of row 0 — pre-3 TILED caches
+#    would silently compute garbage under the unit-weight fast path, so
+#    those specifically are refused (other layouts are unchanged and stay
+#    readable).
+_FORMAT_VERSION = 3
+_READABLE_VERSIONS = (1, 2, 3)
 
 _CLASSES = {
     cls.__name__: cls
@@ -212,6 +217,16 @@ def load_dataset(path: str, expect_build_key: dict | None = None) -> Dataset:
             f"dataset cache at {path!r} was built with "
             f"{meta.get('build_key')!r}, which does not match the requested "
             f"{expect_build_key!r}; rebuild (or delete the cache dir)"
+        )
+    if meta.get("format_version") < 3 and "TiledBlocks" in json.dumps(
+        meta["skeleton"]
+    ):
+        raise ValueError(
+            f"dataset cache at {path!r} holds format-"
+            f"{meta.get('format_version')} tiled blocks, whose padding "
+            "entries index row 0 instead of the appended zero row; this "
+            "build would compute garbage from them — delete the cache dir "
+            "and rebuild"
         )
     arrays_file = meta.get("arrays", "arrays.npz")
     with np.load(os.path.join(path, arrays_file)) as z:

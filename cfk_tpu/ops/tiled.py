@@ -50,42 +50,61 @@ from cfk_tpu.ops.solve import (
 
 
 def default_tiled_gram_backend() -> str:
-    """Tile-Gram backend: "xla" (batched GEMM + segment-sum) everywhere.
+    """Tile-Gram backend: the fused pallas grouped-Gram kernel.
 
-    The fused pallas grouped-Gram kernel (``cfk_tpu.ops.pallas.gram_kernel``,
-    ``gram_backend="pallas"``) eliminates the [NT, k, k] materialization and
-    the scatter, but its one-tile-per-grid-step structure is overhead-bound
-    on real hardware (measured 2.36 vs 1.97 s/iter at full Netflix scale) —
-    it needs a multi-tile inner loop before it can win; until then the XLA
-    path is the default."""
-    return "xla"
+    Measured on the real v5e at the full Netflix shape (rank 64, bf16,
+    512k-entry chunks): the multi-tile kernel holds the whole per-chunk
+    (A, b) output resident in VMEM, so the [NT, k, k] tile-Gram batch, its
+    segment-sum read-back, the zero-fill, and the pre-GEMM layout copy all
+    disappear — 1.285 s/iter (XLA backend) → 0.85 s/iter end-to-end.
+    Round 2's one-tile-per-grid-step kernel lost this comparison (2.36 vs
+    1.97 — overhead-bound); the multi-tile redesign (VERDICT r2 item #1)
+    is what made pallas the measured default.  ``gram_backend="xla"``
+    (batched GEMM + segment-sum) remains for A/B measurement."""
+    return "pallas"
 
 
 def _entity_gram_chunk(
     fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, backend,
+    unit_weights=False,
 ):
     """One chunk's per-entity Gram/RHS: (A [num_segments, k, k], b [.., k]).
 
     ``seg`` maps each [tile_rows]-entry tile to its owner (sorted;
     ``num_segments - 1`` = trash).  Rows of segments owning no tile are
     UNSPECIFIED under the pallas backend (never written) — callers must
-    route them to trash (stream mode) or mask them (accum mode).  Padding
-    entries carry weight 0 and rating 0, so they vanish from both sums
-    regardless of the row their index points at.
+    route them to trash (stream mode) or mask them (accum mode).
+
+    A zero row is appended to the fixed slice and padding entries index it
+    (format-3 blocks), so padding contributes exact zeros BEFORE any weight
+    is applied.  ``unit_weights=True`` (explicit ALS: real weights are all
+    1.0) therefore skips the w·f multiply entirely — measured 0.18 s/iter
+    of pure elementwise traffic at the full Netflix shape.  The weighted
+    path multiplies post-gather, where the copy fuses into the gather.
     """
     k = fixed_slice.shape[-1]
     ct, prec = _gram_compute_dtype(fixed_slice)
-    g = fixed_slice[nb].astype(ct)  # [C, k]
+    fz = jnp.concatenate([
+        fixed_slice,
+        _match_varying(jnp.zeros((1, k), fixed_slice.dtype), fixed_slice),
+    ])
+    g = fz[nb].astype(ct)  # [C, k]
     if backend == "pallas":
         from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
 
+        # A raw [C, 1] weight operand would relayout catastrophically
+        # (one element per (8, 128) tile); the weighted copy streams in
+        # the factors' natural layout instead (see the kernel's doc).
+        gw = None if unit_weights else g * wt.astype(ct)[:, None]
         return gram_tiles_pallas(
-            g, wt, rt, seg, num_segments=num_segments, tile_rows=tile_rows
+            g, gw, rt, seg, num_segments=num_segments, tile_rows=tile_rows
         )
     if backend != "xla":
         raise ValueError(f"unknown tiled gram backend {backend!r}")
-    gw = (g * wt.astype(ct)[:, None]).reshape(-1, tile_rows, k)
     gt = g.reshape(-1, tile_rows, k)
+    gw = gt if unit_weights else (
+        g * wt.astype(ct)[:, None]
+    ).reshape(-1, tile_rows, k)
     a_t = jnp.einsum(
         "ntk,ntl->nkl", gw, gt,
         preferred_element_type=jnp.float32, precision=prec,
@@ -202,7 +221,8 @@ def als_half_step_tiled(
         a0, b0, out = carry
         nb_c, rt_c, wt_c, ts_c, ent_c, cnt_c, cin_c, lseg_c = chunk
         a, b = _entity_gram_chunk(
-            fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend
+            fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+            unit_weights=implicit_reg is None,
         )
         a = a.at[0].add(cin_c * a0)
         b = b.at[0].add(cin_c * b0)
@@ -278,7 +298,8 @@ def als_half_step_tiled_accum(
         nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
         fixed_slice = lax.dynamic_slice(fixed_factors, (base_c, 0), (h, k))
         a, b = _entity_gram_chunk(
-            fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend
+            fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+            unit_weights=implicit_reg is None,
         )
         # Rank rows owning no tile are unwritten garbage under the pallas
         # backend; ent_c routes them (and any NaN they hold) to the trash

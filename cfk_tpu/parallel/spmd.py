@@ -305,7 +305,8 @@ def half_step_tiled_ring(
             ts_c = lax.dynamic_slice(ts, (i * nt,), (nt,))
             ent_c = lax.dynamic_slice(ent, (i * e_c,), (e_c,))
             a, b = _entity_gram_chunk(
-                factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend
+                factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                unit_weights=True,  # the ring is explicit-ALS only
             )
             return (acc_a.at[ent_c].add(a[:e_c]), acc_b.at[ent_c].add(b[:e_c]))
 

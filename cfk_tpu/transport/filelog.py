@@ -159,12 +159,13 @@ class FileBroker:
         if fh is None:
             fh = open(_log_path(os.path.join(self.directory, topic), partition), "ab")
             self._files[(topic, partition)] = fh
-        # Pack BEFORE touching the seek index: pack raises on key overflow,
-        # and a stale index entry would mislabel every later indexed consume.
         record = _HEADER.pack(key, len(value)) + value
+        fh.write(record)
+        # The seek index is only touched AFTER pack and write both succeed:
+        # an entry appended ahead of a failure (key overflow, ENOSPC) would
+        # duplicate on retry and silently mislabel every indexed consume.
         if self._counts[(topic, partition)] % _INDEX_EVERY == 0:
             self._index[(topic, partition)].append(self._bytes[(topic, partition)])
-        fh.write(record)
         if self._fsync:
             fh.flush()
             os.fsync(fh.fileno())
@@ -212,11 +213,12 @@ class FileBroker:
         base_count = self._counts[(topic, partition)]
         base_bytes = self._bytes[(topic, partition)]
         rec_bytes = _HEADER.size + vbytes
+        fh.write(blob.tobytes())
+        # Index entries only after the write succeeds (see produce()).
         index = self._index[(topic, partition)]
         first = (-base_count) % _INDEX_EVERY
         for i in range(first, n, _INDEX_EVERY):
             index.append(base_bytes + i * rec_bytes)
-        fh.write(blob.tobytes())
         if self._fsync:
             fh.flush()
             os.fsync(fh.fileno())

@@ -95,6 +95,10 @@ def main() -> None:
                    choices=["float32", "bfloat16"])
     p.add_argument("--gram-backend", default=None,
                    choices=[None, "ragged", "segsum"])
+    p.add_argument("--tiled-gram-backend", default=None,
+                   choices=[None, "xla", "pallas"])
+    p.add_argument("--group-tiles", type=int, default=None,
+                   help="pallas tiled-gram group size override")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -114,6 +118,23 @@ def main() -> None:
         import cfk_tpu.ops.solve as solve_mod
 
         solve_mod.default_segment_backend = lambda: args.gram_backend
+    if args.tiled_gram_backend is not None:
+        import cfk_tpu.ops.tiled as tiled_mod
+
+        tiled_mod.default_tiled_gram_backend = (
+            lambda: args.tiled_gram_backend
+        )
+    if args.group_tiles is not None:
+        import cfk_tpu.ops.pallas.gram_kernel as gk
+
+        _orig = gk.gram_tiles_pallas
+
+        def _patched(*a, **kw):
+            kw.setdefault("group_tiles", args.group_tiles)
+            return _orig(*a, **kw)
+
+        gk.gram_tiles_pallas = _patched
+
 
     segment = args.layout == "segment"
     bucketed = args.layout == "bucketed"

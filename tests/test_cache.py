@@ -157,6 +157,26 @@ def test_cleanup_removes_stale_orphans(tmp_path):
     assert "arrays-fresh.npz" not in set(os.listdir(c))
 
 
+def test_pre_v3_tiled_cache_refused(tmp_path):
+    """Format-<3 TILED caches must refuse to load: their padding entries
+    index row 0 (relying on weight 0), and the format-3 unit-weight fast
+    path would silently compute garbage from them.  Other layouts stay
+    readable (covered by test_v1_layout_still_loads)."""
+    import json
+
+    import pytest
+
+    coo = powerlaw_coo(n_movies=20, n_users=30, nnz=200)
+    ds = Dataset.from_coo(coo, layout="tiled", chunk_elems=256)
+    c = tmp_path / "c"
+    ds.save(str(c))
+    meta = json.loads((c / "meta.json").read_text())
+    meta["format_version"] = 2
+    (c / "meta.json").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="zero row"):
+        Dataset.load(str(c))
+
+
 def test_v1_layout_still_loads(tmp_path):
     """Format v1 (arrays always in arrays.npz, no 'arrays' meta key) stays
     readable: the loader defaults the filename when the key is absent."""

@@ -60,25 +60,33 @@ def test_blocked_solve_compiled_matches_cholesky(k):
     np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
 
 
-def test_gram_tiles_kernel_compiled():
-    """The fused grouped-Gram kernel, compiled: must match the XLA path."""
+@pytest.mark.parametrize("unit_weights", [False, True])
+def test_gram_tiles_kernel_compiled(unit_weights):
+    """The fused grouped-Gram kernel, compiled: must match the XLA path.
+
+    Covers both streams: the two-stream weighted form (iALS) and the
+    single-stream unit-weight form (explicit ALS — ``gw=None``)."""
     from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
 
     rng = np.random.default_rng(0)
     t, nt, k, segs = 64, 64, 32, 17
     g = rng.standard_normal((nt * t, k)).astype(np.float32)
-    wt = (rng.random(nt * t) > 0.2).astype(np.float32)
-    rt = rng.random(nt * t).astype(np.float32) * wt
+    wt = (
+        np.ones(nt * t, np.float32) if unit_weights
+        else rng.random(nt * t).astype(np.float32)
+    )
+    rt = rng.random(nt * t).astype(np.float32)
     seg = np.sort(rng.integers(0, segs - 1, size=nt)).astype(np.int32)
+    gw = None if unit_weights else jnp.asarray(g * wt[:, None])
     a, b = gram_tiles_pallas(
-        jnp.asarray(g), jnp.asarray(wt), jnp.asarray(rt), jnp.asarray(seg),
+        jnp.asarray(g), gw, jnp.asarray(rt), jnp.asarray(seg),
         num_segments=segs, tile_rows=t, interpret=False,
     )
     a, b = np.asarray(a), np.asarray(b)
     for s in np.unique(seg):
         rows = np.repeat(seg == s, t)
-        gw = g[rows] * wt[rows][:, None]
-        np.testing.assert_allclose(a[s], gw.T @ g[rows], rtol=2e-3, atol=2e-3)
+        gws = g[rows] * wt[rows][:, None]
+        np.testing.assert_allclose(a[s], gws.T @ g[rows], rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(
             b[s], g[rows].T @ rt[rows], rtol=2e-3, atol=2e-3
         )

@@ -72,6 +72,48 @@ def test_half_step_matches_oracle(synth, kw):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_gram_backends_agree(synth):
+    """The pallas default and the XLA segment-sum backend must agree on a
+    full half-step, both modes (r3: pallas became the measured default)."""
+    from cfk_tpu.ops.tiled import als_half_step_tiled, als_half_step_tiled_accum
+
+    ds = synth
+    d = ds.coo_dense
+    rng = np.random.default_rng(2)
+    builds = [
+        (d.movie_raw, d.user_raw, 400, 3000,
+         dict(slice_rows=128, chunk_elems=2048)),  # accum
+        (d.user_raw, d.movie_raw, 3000, 400,
+         dict(accum_max_entities=16, chunk_elems=2048, tile_rows=8)),  # stream
+    ]
+    for solve_d, fixed_d, n_solve, n_fixed, kw in builds:
+        blocks = build_tiled_blocks(
+            solve_d, fixed_d, d.rating, n_solve, n_fixed, **kw
+        )
+        fixed = jnp.asarray(
+            rng.standard_normal((n_fixed, 8)).astype(np.float32)
+        )
+        outs = {}
+        for backend in ("xla", "pallas"):
+            blk = _tiled_to_device(blocks)
+            fn = (als_half_step_tiled_accum if blocks.mode == "accum"
+                  else als_half_step_tiled)
+            args = ((fixed, blk["neighbor_idx"], blk["rating"], blk["weight"],
+                     blk["tile_seg"], blk["chunk_base"], blk["chunk_entity"],
+                     blk["count"], blocks.padded_entities, 0.05)
+                    if blocks.mode == "accum" else
+                    (fixed, blk["neighbor_idx"], blk["rating"], blk["weight"],
+                     blk["tile_seg"], blk["chunk_entity"], blk["chunk_count"],
+                     blk["carry_in"], blk["last_seg"],
+                     blocks.padded_entities, 0.05))
+            outs[backend] = np.asarray(
+                fn(*args, statics=blocks.statics, gram_backend=backend)
+            )[:n_solve]
+        np.testing.assert_allclose(
+            outs["pallas"], outs["xla"], rtol=2e-5, atol=2e-5
+        )
+
+
 def test_stream_mode_chunk_straddling(synth):
     """A hot entity spanning several chunks must carry its partial Gram."""
     ds = synth
