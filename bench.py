@@ -42,6 +42,118 @@ def sync(x) -> None:
 
 
 def main() -> None:
+    """Default driver entry: medium-parity RMSE row, then a compact
+    at-scale tiled row (VERDICT r2 item #2 — the recorded artifact must
+    carry scale perf + roofline numbers, not just parity RMSE), combined
+    into ONE final JSON line."""
+    medium = medium_main()
+    print("# medium: " + json.dumps(medium))
+    scale = at_scale_quick()
+    print("# at_scale: " + json.dumps(scale))
+    print(json.dumps({**medium, "at_scale": scale}))
+
+
+def at_scale_quick() -> dict:
+    """A sub-scale tiled row sized to finish in ~2 min on the chip.
+
+    EVERY axis at 1/3 Netflix (users, movies, AND ratings) so the density
+    — hence the tile-padding ratio — and both per-side modes match the
+    full corpus: user half stream (160k entities), movie half sliced
+    accum (the 160k-row fixed table still exceeds one 131072-row slice).
+    Shapes that scale only nnz measure the wrong regime: sparse rows
+    explode tile padding ~6×, and small entity counts flip the user half
+    into accum.
+
+    Timing is steady-state: blocks upload ONCE, then a fused 3-iteration
+    step program is timed min-of-N with a scalar fetch as the barrier —
+    the ``--scale`` two-point trainer fit would be swamped here by the
+    multi-GB tunnel upload (~40 s fixed vs ~0.5 s of signal).  The
+    full-shape estimate extrapolates linearly in nnz (entities scale
+    along, so solves do too); recorded ground truth for the full shape
+    comes from ``--scale --full`` runs (BASELINE.md)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.models import als as als_mod
+    from cfk_tpu.ops.solve import init_factors_stats
+    from cfk_tpu.utils.roofline import als_iteration_cost
+
+    users, movies, nnz = 160_063, 5_923, 33_493_502
+    rank, iters, repeats, lam = 64, 3, 4, 0.05
+    t0 = time.time()
+    coo = synthetic_netflix_coo(users, movies, nnz, seed=0)
+    gen_s = time.time() - t0
+    t0 = time.time()
+    ds = Dataset.from_coo(coo, layout="tiled", chunk_elems=524_288)
+    build_s = time.time() - t0
+
+    t0 = time.time()
+    mblocks, ublocks, u_stats, layout_kw = als_mod._tiled_device_setup(ds)
+    jax.block_until_ready((mblocks, ublocks))
+    np.asarray(jax.tree.leaves(mblocks)[0].ravel()[:1])
+    upload_s = time.time() - t0
+
+    key = jax.random.PRNGKey(0)
+    u0 = jax.jit(init_factors_stats, static_argnames="rank")(
+        key, u_stats["rating_sum"], u_stats["count"], rank=rank
+    ).astype(jnp.bfloat16)
+    m0 = jnp.zeros((ds.movie_blocks.padded_entities, rank), jnp.bfloat16)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def steps(u, m, mblk, ublk):
+        def body(_, carry):
+            u, m_prev = carry
+            return als_mod._iteration_body(
+                u, mblk, ublk, lam=lam, solve_chunk=None,
+                dt=jnp.dtype(jnp.bfloat16), solver="pallas", m_prev=m_prev,
+                **layout_kw,
+            )
+        return jax.lax.fori_loop(0, iters, body, (u, m))
+
+    t0 = time.time()
+    u, m = steps(u0, m0, mblocks, ublocks)
+    sync(u)
+    warm = time.time() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.time()
+        u, m = steps(u, m, mblocks, ublocks)
+        sync(u)
+        times.append(time.time() - t0)
+    per_iter = [t / iters for t in times]
+    s_per_iter = min(per_iter)
+
+    from cfk_tpu.utils.roofline import FULL_NETFLIX_NNZ, roofline_row
+
+    cost = als_iteration_cost(nnz, users, movies, rank, factor_bytes=2)
+    return {
+        "metric": "synthetic_third_netflix_steady_s_per_iteration",
+        "value": round(s_per_iter, 4),
+        "unit": "s/iteration",
+        "vs_baseline": round(s_per_iter / (60.0 * nnz / FULL_NETFLIX_NNZ), 4),
+        "s_per_iteration_median": round(
+            float(np.median(per_iter)), 4
+        ),
+        "ratings_per_sec_per_chip": int(nnz * 2 / s_per_iter),
+        **roofline_row(cost, s_per_iter),
+        "full_netflix_extrapolated_s_per_iter": round(
+            s_per_iter * FULL_NETFLIX_NNZ / nnz, 4
+        ),
+        "users": users, "movies": movies, "ratings": nnz, "rank": rank,
+        "layout": "tiled", "dtype": "bfloat16", "repeats": repeats,
+        "iters_per_call": iters,
+        "first_call_wall_s": round(warm, 3),
+        "upload_wall_s": round(upload_s, 3),
+        "datagen_wall_s": round(gen_s, 3),
+        "blockbuild_wall_s": round(build_s, 3),
+    }
+
+
+def medium_main() -> dict:
     from cfk_tpu.config import ALSConfig
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.netflix import parse_netflix
@@ -78,33 +190,37 @@ def main() -> None:
     median_rmse = float(np.median(rmses))
     train_min, train_median = min(times), float(np.median(times))
     n = config.num_iterations
-    print(
-        json.dumps(
-            {
-                "metric": "netflix_medium_rank5_iter7_rmse",
-                "value": round(median_rmse, 4),
-                "unit": "rmse",
-                "vs_baseline": round(median_rmse / REF_RMSE_MEDIUM, 4),
-                "rmse_median_seed": round(median_rmse, 4),
-                "rmse_best_seed": round(min(rmses), 4),
-                "rmse_by_seed": by_seed,
-                # Wall-clock: min + median over the seed runs (tunnel
-                # variance swings identical runs several-fold; both are
-                # reported, min is the capability number).
-                "s_per_iteration": round(train_min / n, 4),
-                "s_per_iteration_median": round(train_median / n, 4),
-                "ratings_per_sec": int(coo.num_ratings * n * 2 / train_min),
-                "train_wall_s": round(train_min, 3),
-                "first_run_wall_s": round(warm, 3),
-                "compile_wall_s": round(max(warm - train_median, 0.0), 3),
-                "ratings": coo.num_ratings,
-                "seeds": seeds,
-            }
-        )
-    )
+    return {
+        "metric": "netflix_medium_rank5_iter7_rmse",
+        "value": round(median_rmse, 4),
+        "unit": "rmse",
+        # vs_baseline compares OUR median over a fixed 6-seed set to the
+        # reference's single published run (its init RNG was never swept);
+        # ~1.0 means statistically indistinguishable quality — the seed
+        # spread (~0.758–0.766) is init noise, not model difference.
+        "vs_baseline": round(median_rmse / REF_RMSE_MEDIUM, 4),
+        "rmse_median_seed": round(median_rmse, 4),
+        "rmse_best_seed": round(min(rmses), 4),
+        "rmse_by_seed": by_seed,
+        # Wall-clock: min + median over the seed runs (tunnel
+        # variance swings identical runs several-fold; both are
+        # reported, min is the capability number).
+        "s_per_iteration": round(train_min / n, 4),
+        "s_per_iteration_median": round(train_median / n, 4),
+        "ratings_per_sec": int(coo.num_ratings * n * 2 / train_min),
+        "train_wall_s": round(train_min, 3),
+        "first_run_wall_s": round(warm, 3),
+        "compile_wall_s": round(max(warm - train_median, 0.0), 3),
+        "ratings": coo.num_ratings,
+        "seeds": seeds,
+    }
 
 
 def scale_main(args) -> None:
+    print(json.dumps(run_scale(args)))
+
+
+def run_scale(args) -> dict:
     from cfk_tpu.config import ALSConfig
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.synthetic import synthetic_netflix_coo
@@ -225,61 +341,68 @@ def scale_main(args) -> None:
         factor_bytes=2 if args.dtype == "bfloat16" else 4,
         implicit=args.ials,
     )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "synthetic_ml25m_ialspp_s_per_iteration" if args.ialspp
-                    else "synthetic_ml25m_ials_s_per_iteration" if args.ials
-                    else "synthetic_netflix_scale_s_per_iteration"
-                ),
-                "value": round(s_per_iter, 4),
-                "unit": "s/iteration",
-                # BASELINE.json bar: < 60 s/iteration at full Netflix scale.
-                # Sub-scale runs are scaled by their nnz fraction of the full
-                # corpus so the ratio stays an (optimistic-linear) estimate.
-                "vs_baseline": round(s_per_iter / (60.0 * nnz / 100_480_507), 4),
-                "ratings_per_sec_per_chip": int(
-                    coo.num_ratings * config.num_iterations * 2 / steady_s
-                ),
-                # Compute-efficiency block (cfk_tpu.utils.roofline): model
-                # FLOPs count the algorithmic minimum (Gram 2·nnz·k·(k+1)·2
-                # + Cholesky-cost solves), MFU is against the v5e bf16 peak,
-                # and hbm_roofline_s is the min-traffic floor the iteration
-                # can never beat.
-                "model_tflops_per_iter": round(cost.model_flops / 1e12, 4),
-                "achieved_tflops": round(cost.achieved_tflops(s_per_iter), 4),
-                "mfu": round(cost.mfu(s_per_iter), 5),
-                "min_hbm_gb_per_iter": round(cost.min_hbm_bytes / 1e9, 3),
-                "hbm_roofline_s": round(cost.hbm_bound_s(), 4),
-                "vs_hbm_roofline": round(s_per_iter / cost.hbm_bound_s(), 2),
-                "timing_degenerate": timing_degenerate,
-                "repeats": args.repeats,
-                "users": users,
-                "movies": movies,
-                "ratings": nnz,
-                "rank": args.rank,
-                "layout": args.layout,
-                "dtype": args.dtype,
-                "algorithm": config.algorithm,
-                "train_wall_s": round(train_s, 3),
-                "one_iter_wall_s": round(short_s, 3),
-                # fixed per-call cost (block upload + dispatch), as implied
-                # by the two-point fit
-                "fixed_overhead_wall_s": round(
-                    max(short_s - s_per_iter, 0.0), 3
-                ),
-                "s_per_iteration_incl_upload": round(train_s / n1, 4),
-                # first_run includes compile; the difference can go negative
-                # under axon-tunnel timing variance, so clamp the estimate.
-                "first_run_wall_s": round(warm, 3),
-                "compile_wall_s": round(max(warm - train_s, 0.0), 3),
-                "datagen_wall_s": round(gen_s, 3),
-                "blockbuild_wall_s": round(build_s, 3),
-                **quality,
-            }
-        )
+    from cfk_tpu.utils.roofline import FULL_NETFLIX_NNZ, roofline_row
+
+    full_nnz = FULL_NETFLIX_NNZ
+    extrapolated = (
+        {}
+        if nnz >= full_nnz or args.ials
+        else {
+            # Optimistic-linear in nnz; ground truth for the full shape is
+            # the recorded `--scale --full` runs (BASELINE.md).
+            "full_netflix_extrapolated_s_per_iter": round(
+                s_per_iter * full_nnz / nnz, 4
+            ),
+        }
     )
+    return {
+        "metric": (
+            "synthetic_ml25m_ialspp_s_per_iteration" if args.ialspp
+            else "synthetic_ml25m_ials_s_per_iteration" if args.ials
+            else "synthetic_netflix_scale_s_per_iteration"
+        ),
+        "value": round(s_per_iter, 4),
+        "unit": "s/iteration",
+        # BASELINE.json bar: < 60 s/iteration at full Netflix scale.
+        # Sub-scale runs are scaled by their nnz fraction of the full
+        # corpus so the ratio stays an (optimistic-linear) estimate.
+        "vs_baseline": round(s_per_iter / (60.0 * nnz / full_nnz), 4),
+        "ratings_per_sec_per_chip": int(
+            coo.num_ratings * config.num_iterations * 2 / steady_s
+        ),
+        # Compute-efficiency block (cfk_tpu.utils.roofline): model
+        # FLOPs count the algorithmic minimum (Gram 2·nnz·k·(k+1)·2
+        # + Cholesky-cost solves), MFU is against the v5e bf16 peak,
+        # hbm_roofline_s is the min-traffic floor, and gather_roofline_s
+        # the measured row-gather-engine floor — the binding resource for
+        # ALS on this chip (see cfk_tpu/utils/roofline.py).
+        **roofline_row(cost, s_per_iter),
+        **extrapolated,
+        "timing_degenerate": timing_degenerate,
+        "repeats": args.repeats,
+        "users": users,
+        "movies": movies,
+        "ratings": nnz,
+        "rank": args.rank,
+        "layout": args.layout,
+        "dtype": args.dtype,
+        "algorithm": config.algorithm,
+        "train_wall_s": round(train_s, 3),
+        "one_iter_wall_s": round(short_s, 3),
+        # fixed per-call cost (block upload + dispatch), as implied
+        # by the two-point fit
+        "fixed_overhead_wall_s": round(
+            max(short_s - s_per_iter, 0.0), 3
+        ),
+        "s_per_iteration_incl_upload": round(train_s / n1, 4),
+        # first_run includes compile; the difference can go negative
+        # under axon-tunnel timing variance, so clamp the estimate.
+        "first_run_wall_s": round(warm, 3),
+        "compile_wall_s": round(max(warm - train_s, 0.0), 3),
+        "datagen_wall_s": round(gen_s, 3),
+        "blockbuild_wall_s": round(build_s, 3),
+        **quality,
+    }
 
 
 def compare_exchange_main(args) -> None:
@@ -409,7 +532,8 @@ if __name__ == "__main__":
                         "bench; Gram accumulation and solves are float32 "
                         "either way (medium-config RMSE is identical to "
                         "1e-4: 0.758223 bf16 vs 0.758264 f32)")
-    parser.add_argument("--chunk-elems", type=int, default=1 << 20)
+    parser.add_argument("--chunk-elems", type=int, default=524_288,
+                        help="entries per tiled/segment chunk; 512k beat 1M on-chip\n                        (segment accumulators fit VMEM)")
     parser.add_argument("--lam", type=float, default=0.05,
                         help="explicit-model regularization for the scale "
                         "bench (ALS-WR lambda*n semantics; planted runs "

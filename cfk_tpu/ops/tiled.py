@@ -89,6 +89,13 @@ def _entity_gram_chunk(
         _match_varying(jnp.zeros((1, k), fixed_slice.dtype), fixed_slice),
     ])
     g = fz[nb].astype(ct)  # [C, k]
+    if backend == "pallas" and 2 * num_segments * k * (k + 1) * 4 > (96 << 20):
+        # The kernel keeps the whole (A, b) chunk output resident in VMEM
+        # (double-buffered); past ~96 MB it cannot compile.  Dense shapes
+        # never get here (full Netflix peaks at ~37 MB), but sparse ones
+        # (many distinct entities per chunk) fall back to the XLA
+        # segment-sum path instead of a Mosaic OOM.
+        backend = "xla"
     if backend == "pallas":
         from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
 

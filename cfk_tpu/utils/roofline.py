@@ -30,6 +30,14 @@ import dataclasses
 V5E_PEAK_BF16_FLOPS = 197e12  # per second
 V5E_HBM_BYTES_PER_S = 819e9
 V5E_HBM_BYTES = 16 * 1024**3
+# Measured on this chip (r3 gather micro-bench + in-scan profile): XLA's
+# row-gather engine sustains ~600M rows/s on ≤34 MB tables REGARDLESS of
+# row width (64-col bf16 and 128-col rows time identically) — the gather
+# is row-slot-bound, not byte-bound.  ALS is two gathers per rating per
+# iteration, which makes THIS the binding resource at full Netflix scale,
+# not HBM bandwidth: the row-gather floor (~0.36 s/iter) sits 6.7× above
+# the naive HBM roofline (54 ms).
+V5E_GATHER_ROWS_PER_S = 600e6
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +46,7 @@ class IterationCost:
 
     model_flops: float
     min_hbm_bytes: float
+    gather_rows: float  # factor rows fetched by index per iteration
 
     def achieved_tflops(self, seconds: float) -> float:
         return self.model_flops / seconds / 1e12
@@ -46,8 +55,39 @@ class IterationCost:
         return self.model_flops / seconds / peak_flops
 
     def hbm_bound_s(self, bandwidth: float = V5E_HBM_BYTES_PER_S) -> float:
-        """Roofline floor: the iteration can never beat this wall-clock."""
+        """Naive roofline floor: minimum HBM traffic over peak bandwidth."""
         return self.min_hbm_bytes / bandwidth
+
+    def gather_bound_s(
+        self, rows_per_s: float = V5E_GATHER_ROWS_PER_S
+    ) -> float:
+        """Gather-engine floor: the binding resource for ALS on this chip.
+
+        Every rating needs its neighbor's factor row on each side every
+        iteration, and the measured engine rate is per ROW, independent of
+        row bytes — so 2·nnz rows / rate bounds the iteration from below
+        more tightly than HBM bandwidth does (6.7× at full Netflix)."""
+        return self.gather_rows / rows_per_s
+
+
+FULL_NETFLIX_NNZ = 100_480_507
+
+
+def roofline_row(cost: IterationCost, s_per_iter: float) -> dict:
+    """The efficiency fields every recorded benchmark row carries.
+
+    One definition so bench.py's rows and scripts/perf_lab.py can never
+    drift on which metrics exist or how they're computed."""
+    return {
+        "model_tflops_per_iter": round(cost.model_flops / 1e12, 4),
+        "achieved_tflops": round(cost.achieved_tflops(s_per_iter), 4),
+        "mfu": round(cost.mfu(s_per_iter), 5),
+        "min_hbm_gb_per_iter": round(cost.min_hbm_bytes / 1e9, 3),
+        "hbm_roofline_s": round(cost.hbm_bound_s(), 4),
+        "vs_hbm_roofline": round(s_per_iter / cost.hbm_bound_s(), 2),
+        "gather_roofline_s": round(cost.gather_bound_s(), 4),
+        "vs_gather_roofline": round(s_per_iter / cost.gather_bound_s(), 2),
+    }
 
 
 def als_iteration_cost(
@@ -92,4 +132,5 @@ def als_iteration_cost(
     return IterationCost(
         model_flops=flops,
         min_hbm_bytes=gather + blocks + gram_io + factors_out,
+        gather_rows=2.0 * nnz,
     )

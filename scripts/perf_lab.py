@@ -214,12 +214,12 @@ def main() -> None:
         factor_bytes=2 if dt == "bfloat16" else 4,
     )
     best = min(per_iter)
+    from cfk_tpu.utils.roofline import roofline_row
+
     print(json.dumps({
         "s_per_iter_min": round(best, 4),
         "s_per_iter_median": round(sorted(per_iter)[len(per_iter) // 2], 4),
-        "mfu": round(cost.mfu(best), 5),
-        "achieved_tflops": round(cost.achieved_tflops(best), 3),
-        "vs_hbm_roofline": round(best / cost.hbm_bound_s(), 2),
+        **roofline_row(cost, best),
         "layout": args.layout, "solver": args.solver,
         "chunk_elems": args.chunk_elems, "dtype": dt,
         "gram_backend": args.gram_backend, "rank": args.rank,
