@@ -80,7 +80,7 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
         "chunk_elems": chunk_elems,
     }
     if ring:  # absent for non-ring keys so existing caches stay valid
-        build_key["ring"] = True
+        build_key["ring"] = ring
 
     def cache_or_build(build):
         if cache_dir and os.path.exists(os.path.join(cache_dir, "meta.json")):
@@ -206,7 +206,11 @@ def _train(args) -> int:
             args.data, args.format, args.min_rating, args.shards,
             args.pad_multiple, args.layout, args.chunk_elems,
             cache_dir=args.dataset_cache,
-            ring=args.exchange == "ring" and args.layout == "tiled",
+            ring=(
+                (args.exchange if args.exchange == "auto"
+                 else args.exchange == "ring")
+                if args.layout == "tiled" else False
+            ),
         )
     common = dict(
         layout=args.layout,
@@ -219,8 +223,8 @@ def _train(args) -> int:
         dtype=args.dtype,
         solver=args.solver,
         solve_chunk=args.solve_chunk,
+        hbm_chunk_elems=args.chunk_elems,
         pad_multiple=args.pad_multiple,
-        bucket_chunk_elems=args.chunk_elems,
         algorithm=args.algorithm,
         block_size=args.block_size,
         sweeps=args.sweeps,
@@ -708,14 +712,21 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--iterations", type=int, default=7)
     t.add_argument("--seed", type=int, default=42)
     t.add_argument("--shards", type=int, default=1)
-    t.add_argument("--exchange", choices=["all_gather", "ring"], default="all_gather")
+    t.add_argument("--exchange", choices=["all_gather", "ring", "auto"],
+                   default="all_gather",
+                   help="fixed-factor exchange; 'auto' (tiled layout) picks "
+                   "per half: ring where the Gram accumulator fits, "
+                   "all_gather elsewhere")
     t.add_argument(
         "--solver", choices=["auto", "cholesky", "pallas"], default="auto",
         help="batched k-by-k solve backend: auto = pallas Gauss-Jordan "
         "kernel on TPU (rank <= 64), XLA cholesky elsewhere",
     )
     t.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
-    t.add_argument("--solve-chunk", type=int, default=None)
+    t.add_argument("--solve-chunk", type=int, default=None,
+                   help="DEPRECATED: explicit entities per padded-layout "
+                   "solve chunk; --chunk-elems is the one HBM budget for "
+                   "every layout")
     t.add_argument("--pad-multiple", type=int, default=8)
     t.add_argument(
         "--layout", choices=["padded", "bucketed", "segment", "tiled"],
@@ -728,9 +739,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument(
         "--chunk-elems", type=int, default=1 << 20,
-        help="bucketed/segment/tiled layouts: HBM budget for the per-solve-"
-        "chunk neighbor-factor gather (bucketed: rows·width cells; "
-        "segment/tiled: ratings per scan chunk)",
+        help="the ONE HBM budget, in gather cells, for every layout: "
+        "bucketed/segment/tiled consume it at dataset build time "
+        "(ratings per scan chunk); padded derives entities per solve "
+        "chunk from it at run time",
     )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)

@@ -42,18 +42,35 @@ class ALSConfig:
     #                  materializes the full fixed-side matrix per device).
     #                  Available for the padded and tiled layouts; tiled ring
     #                  datasets must be built with Dataset.from_coo(...,
-    #                  ring=True).
-    exchange: Literal["all_gather", "ring"] = "all_gather"
-    # --- HBM bounding: ONE concept, expressed per layout -------------------
+    #                  ring=True).  BOTH halves ring — refused when a half's
+    #                  per-entity ring accumulator could not fit (many solve
+    #                  entities), which is exactly when all_gather is
+    #                  strictly better there.
+    #   "auto"       — per-HALF memory optimum (tiled layout only): ring on
+    #                  the half whose fixed table is big and solve entities
+    #                  few (movies at Netflix shape: rotate 480k-user blocks
+    #                  instead of all_gathering them), all_gather on the
+    #                  other (its ring accumulator would dwarf the table it
+    #                  saves).  Build the dataset with Dataset.from_coo(...,
+    #                  ring="auto").
+    exchange: Literal["all_gather", "ring", "auto"] = "all_gather"
+    # --- HBM bounding: ONE knob ------------------------------------------
     # Every layout bounds the same quantity — the transient neighbor-factor
     # gather feeding the MXU — by streaming solves through HBM in chunks.
-    # ``bucket_chunk_elems`` (below) is the budget in gather *cells*
-    # (rows × width ≈ ratings per chunk) and is consumed at dataset build
-    # time by the bucketed/segment layouts.  For the padded layout, whose
-    # rectangle exists only at run time, the same budget is expressed in
-    # *entities* per chunk here: ``solve_chunk ≈ bucket_chunk_elems //
-    # max_nnz``.  None = solve a whole shard at once (fine until the
-    # [E, max_nnz, rank] gather outgrows HBM).
+    # ``hbm_chunk_elems`` is that budget in gather *cells* (rows × width ≈
+    # ratings per chunk) for every layout:
+    #   - padded: consumed at solve time — entities per chunk are derived
+    #     as ``hbm_chunk_elems // rectangle_width`` (see
+    #     ``padded_solve_chunk``);
+    #   - bucketed/segment/tiled: consumed at dataset build time — pass it
+    #     as ``Dataset.from_coo(..., chunk_elems=cfg.chunk_cells())`` (the
+    #     CLI's --chunk-elems does); the chunk hints then live statically
+    #     on the blocks.
+    # None = layout defaults (padded: whole shard at once; build-time
+    # layouts: the 1M-cell default).
+    hbm_chunk_elems: int | None = None
+    # DEPRECATED alias: entities per padded-layout solve chunk, overriding
+    # the derived value.  Use hbm_chunk_elems.
     solve_chunk: int | None = None
     # Batched k×k SPD solve backend: "cholesky" = XLA custom calls;
     # "pallas" = lane-vectorized Gauss-Jordan TPU kernel (cfk_tpu.ops.pallas);
@@ -86,11 +103,8 @@ class ALSConfig:
     #                "segment" at full-Netflix scale — the at-scale default.
     #                all_gather exchange only.
     layout: Literal["padded", "bucketed", "segment", "tiled"] = "padded"
-    # The HBM gather-cell budget (see the solve_chunk comment above — same
-    # concept, cell units).  Bucketed/segment layouts consume it at dataset
-    # build time: pass it as Dataset.from_coo(..., chunk_elems=
-    # config.bucket_chunk_elems) — the CLI does (--chunk-elems); the chunk
-    # hints then live statically on the blocks, not in this config.
+    # DEPRECATED alias for hbm_chunk_elems (the build-time consumption is
+    # described there); retained so round-2 configs keep working.
     bucket_chunk_elems: int = 1 << 20
     # Per-entity optimizer.  "als" = the reference's exact full k×k normal-
     # equation solve every half-iteration.  "als++" = warm-started subspace
@@ -107,6 +121,27 @@ class ALSConfig:
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "als++")
 
+    def chunk_cells(self) -> int:
+        """The gather-cell budget for build-time layouts: the one knob
+        (``hbm_chunk_elems``) when set, else the deprecated
+        ``bucket_chunk_elems`` (whose default is the historical 1M)."""
+        if self.hbm_chunk_elems is not None:
+            return self.hbm_chunk_elems
+        return self.bucket_chunk_elems
+
+    def padded_solve_chunk(self, width: int) -> int | None:
+        """Entities per padded-layout solve chunk under the cell budget.
+
+        The deprecated explicit ``solve_chunk`` (entity units) wins when
+        set; otherwise ``hbm_chunk_elems // width`` — the same budget the
+        build-time layouts consume, derived for a rectangle ``width``
+        columns wide.  None = solve the whole shard at once."""
+        if self.solve_chunk is not None:
+            return self.solve_chunk
+        if self.hbm_chunk_elems is None:
+            return None
+        return max(1, self.hbm_chunk_elems // max(width, 1))
+
     def __post_init__(self) -> None:
         if self.rank < 1:
             raise ValueError(f"rank must be >= 1, got {self.rank}")
@@ -116,7 +151,7 @@ class ALSConfig:
             raise ValueError(f"num_shards must be >= 1, got {self.num_shards}")
         if self.lam < 0:
             raise ValueError(f"lam must be >= 0, got {self.lam}")
-        if self.exchange not in ("all_gather", "ring"):
+        if self.exchange not in ("all_gather", "ring", "auto"):
             raise ValueError(f"unknown exchange {self.exchange!r}")
         if self.solver not in ("auto", "cholesky", "pallas"):
             raise ValueError(f"unknown solver {self.solver!r}")
@@ -126,12 +161,23 @@ class ALSConfig:
             raise ValueError(
                 f"layout={self.layout!r} supports exchange='all_gather' only"
             )
+        if self.exchange == "auto" and self.layout != "tiled":
+            raise ValueError(
+                "exchange='auto' (per-half ring/all_gather selection) "
+                f"applies to layout='tiled'; layout={self.layout!r} should "
+                "pick 'all_gather' or 'ring' explicitly"
+            )
+        if self.hbm_chunk_elems is not None and self.hbm_chunk_elems < 1:
+            raise ValueError(
+                f"hbm_chunk_elems must be >= 1, got {self.hbm_chunk_elems}"
+            )
         if self.layout != "padded" and self.solve_chunk is not None:
             raise ValueError(
-                f"solve_chunk applies to layout='padded' only; with "
-                f"layout={self.layout!r} chunking is set at dataset build "
-                "time via Dataset.from_coo(..., chunk_elems=...) "
-                "(config.bucket_chunk_elems / --chunk-elems)"
+                f"solve_chunk (deprecated) applies to layout='padded' "
+                f"only; use hbm_chunk_elems — one budget for every layout "
+                f"(build-time layouts consume it via Dataset.from_coo(..., "
+                "chunk_elems=cfg.chunk_cells()), which the CLI's "
+                "--chunk-elems does)"
             )
         if self.algorithm not in self._valid_algorithms():
             raise ValueError(

@@ -770,6 +770,20 @@ def build_tiled_blocks(
     e_pad = _round_up(num_solve_entities, num_shards)
     e_local = e_pad // num_shards
     f_pad = _round_up(num_fixed_entities, num_shards)
+    if ring and e_local > accum_max_entities:
+        # The ring join forces accum machinery: an [E_local+1, k, k+1]
+        # accumulator per device.  Past accum_max_entities that
+        # accumulator dwarfs the all_gather table the ring would save
+        # (full Netflix user half: ~1 GB accumulator vs a 61 MB table) —
+        # all_gather is strictly better there, so refuse instead of
+        # building a memory trap.  ring="auto" picks per side.
+        raise ValueError(
+            f"ring=True with {e_local} solve entities per shard (> "
+            f"accum_max_entities={accum_max_entities}): the ring's "
+            "per-entity Gram accumulator would exceed the all_gather "
+            "table it saves.  Use Dataset.from_coo(..., ring='auto') "
+            "(ring only where it wins) or exchange='all_gather'."
+        )
     if ring:
         # Ring (block-to-block join) exchange: slices ARE the fixed-side
         # factor shards, so at ring step r a device processes exactly the
@@ -1111,8 +1125,21 @@ class Dataset:
         pad_multiple: int = 8,
         layout: str = "padded",
         chunk_elems: int | None = 1 << 20,
-        ring: bool = False,
+        ring: bool | str | tuple = False,
+        accum_max_entities: int = 1 << 16,
+        rank_hint: int = 64,
     ) -> "Dataset":
+        """``ring`` (tiled layout): False/True build both halves for the
+        all_gather/ring exchange; a ``(movie_ring, user_ring)`` tuple sets
+        each half explicitly; ``"auto"`` picks PER HALF by the actual
+        memory comparison — ring exactly where its per-device bytes
+        (fixed-table shard + the [E_local+1, k, k+1] Gram accumulator)
+        undercut the all_gather'd full table, evaluated at ``rank_hint``
+        (bf16 factors assumed — the at-scale default; f32 only favors
+        ring more).  At Netflix shape that is ring movie-half (rotate
+        480k-user blocks instead of all_gathering 61 MB) + all_gather
+        user-half (whose ring accumulator would be ~1 GB), the optimum
+        the exchange comparison identifies (BASELINE.md)."""
         movie_map, m_dense = index_entities(coo.movie_raw)
         user_map, u_dense = index_entities(coo.user_raw)
         if layout == "bucketed":
@@ -1145,7 +1172,7 @@ class Dataset:
                 build_tiled_blocks,
                 num_shards=num_shards,
                 chunk_elems=chunk_elems,
-                ring=ring,
+                accum_max_entities=accum_max_entities,
             )
         elif layout == "padded":
             build = functools.partial(
@@ -1155,17 +1182,64 @@ class Dataset:
             raise ValueError(f"unknown layout {layout!r}")
         if ring and layout != "tiled":
             raise ValueError(
-                "ring=True applies to layout='tiled' (the padded layout's "
+                "ring applies to layout='tiled' (the padded layout's "
                 "ring blocks are built by the sharded trainer itself)"
             )
+        if not isinstance(ring, (bool, tuple)) and ring != "auto":
+            raise ValueError(
+                f"ring must be True/False/'auto'/(movie, user), got {ring!r}"
+            )
         if layout == "tiled":
+            def ring_saves_memory(n_solve: int, n_fixed: int) -> bool:
+                # Per-device bytes, bf16 factors at rank_hint: ring holds
+                # one fixed-table shard plus the per-entity accumulator;
+                # all_gather holds the whole fixed table.
+                k = rank_hint
+                e_local = -(-n_solve // num_shards)
+                f_pad = _round_up(n_fixed, num_shards)
+                acc = (e_local + 1) * (k * k + k) * 4
+                return f_pad // num_shards * k * 2 + acc < f_pad * k * 2
+
+            def fits_accum(n_solve: int) -> bool:
+                # The ring forces accum machinery; past the cap the
+                # builder refuses outright (build_tiled_blocks).
+                return -(-n_solve // num_shards) <= accum_max_entities
+
+            if ring == "auto":
+                m_ring = (ring_saves_memory(movie_map.num_entities,
+                                            user_map.num_entities)
+                          and fits_accum(movie_map.num_entities))
+                u_ring = (ring_saves_memory(user_map.num_entities,
+                                            movie_map.num_entities)
+                          and fits_accum(user_map.num_entities))
+            else:
+                if isinstance(ring, tuple):
+                    m_ring, u_ring = ring
+                else:
+                    m_ring = u_ring = ring
+                for side, r, ns, nf in (
+                    ("movie", m_ring, movie_map.num_entities,
+                     user_map.num_entities),
+                    ("user", u_ring, user_map.num_entities,
+                     movie_map.num_entities),
+                ):
+                    if r and fits_accum(ns) and not ring_saves_memory(ns, nf):
+                        import warnings
+
+                        warnings.warn(
+                            f"ring-built {side} half: the per-entity Gram "
+                            "accumulator exceeds the all_gather table it "
+                            f"saves (at rank≈{rank_hint}) — all_gather is "
+                            "strictly better there; consider ring='auto'",
+                            stacklevel=2,
+                        )
             movie_blocks = build(
                 m_dense, u_dense, coo.rating,
-                movie_map.num_entities, user_map.num_entities,
+                movie_map.num_entities, user_map.num_entities, ring=m_ring,
             )
             user_blocks = build(
                 u_dense, m_dense, coo.rating,
-                user_map.num_entities, movie_map.num_entities,
+                user_map.num_entities, movie_map.num_entities, ring=u_ring,
             )
         else:
             movie_blocks = build(m_dense, u_dense, coo.rating, movie_map.num_entities)

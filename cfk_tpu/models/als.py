@@ -424,6 +424,16 @@ def train_als(
             ublocks = _blocks_to_device(dataset.user_blocks)
             u_stats = None
             layout_kw = {}
+    # The padded layout consumes the unified HBM budget at solve time:
+    # entities per chunk derived from the wider rectangle (conservative for
+    # the narrower side).  Build-time layouts consumed it at from_coo.
+    solve_chunk = None
+    if not (bucketed or segment or tiled):
+        width = max(
+            dataset.movie_blocks.neighbor_idx.shape[1],
+            dataset.user_blocks.neighbor_idx.shape[1],
+        )
+        solve_chunk = config.padded_solve_chunk(width)
     if checkpoint_manager is None:
         with metrics.phase("train"):
             u, m = _train_loop(
@@ -434,7 +444,7 @@ def train_als(
                 rank=config.rank,
                 num_iterations=config.num_iterations,
                 lam=config.lam,
-                solve_chunk=config.solve_chunk,
+                solve_chunk=solve_chunk,
                 dtype=config.dtype,
                 solver=config.solver,
                 algorithm=config.algorithm,
@@ -465,7 +475,7 @@ def train_als(
         def step_fn(u, m):
             return _one_iteration(
                 u, m, mblocks, ublocks,
-                lam=config.lam, solve_chunk=config.solve_chunk,
+                lam=config.lam, solve_chunk=solve_chunk,
                 dtype=config.dtype, solver=config.solver,
                 algorithm=config.algorithm, block_size=config.block_size,
                 sweeps=config.sweeps,

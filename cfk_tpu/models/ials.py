@@ -304,6 +304,8 @@ def make_ials_training_step(
     uspecs=None,
     segment=False,
     tiled=False,
+    m_ring=False,
+    u_ring=False,
 ):
     """Jittable one-full-iteration SPMD step for iALS.
 
@@ -317,6 +319,12 @@ def make_ials_training_step(
     """
     from cfk_tpu.parallel.spmd import gathered_half, wrap_step
 
+    if m_ring or u_ring:
+        raise ValueError(
+            "iALS needs the full fixed side per shard (global-Gram trick): "
+            "ring-built tiled blocks are unusable — rebuild with "
+            "Dataset.from_coo(..., ring=False)"
+        )
     if config.algorithm == "ials++":
         from cfk_tpu.ops.subspace import (
             ials_pp_half_step,

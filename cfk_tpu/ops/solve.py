@@ -314,6 +314,21 @@ def regularized_solve(
     return dispatch_spd_solve(a, b, solver)
 
 
+def pad_rows_to_multiple(arrays, multiple: int):
+    """Zero-pad every array's leading (entity) axis to a multiple.
+
+    The shared prologue of entity-chunked scans whose chunk size comes
+    from the HBM cell budget (an arbitrary integer): padded rows carry
+    zero mask/count, so their solves/Grams are inert and callers slice
+    the result back to the real count.  Returns (arrays, pad)."""
+    e = arrays[0].shape[0]
+    pad = (-e) % multiple
+    if pad:
+        rowpad = lambda x: jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+        arrays = tuple(rowpad(x) for x in arrays)
+    return arrays, pad
+
+
 def _solve_chunk(
     fixed_factors: jax.Array,
     lam: float,
@@ -340,8 +355,10 @@ def als_half_step(
 ) -> jax.Array:
     """One ALS half-iteration: solve all [E] entities against fixed factors.
 
-    ``solve_chunk`` bounds the [chunk, P, k] gather living in HBM at once by
-    scanning over entity chunks (E must divide evenly; callers pad).
+    ``solve_chunk`` bounds the [chunk, P, k] gather living in HBM at once
+    by scanning over entity chunks.  An indivisible E is padded with
+    zero-mask rows (their λ-floored solves are sliced off), so budget-
+    derived chunk sizes (``ALSConfig.padded_solve_chunk``) always work.
     """
     if solve_chunk is None or solve_chunk >= neighbor_idx.shape[0]:
         return _solve_chunk(
@@ -349,9 +366,10 @@ def als_half_step(
         )
 
     e = neighbor_idx.shape[0]
-    if e % solve_chunk != 0:
-        raise ValueError(f"entity count {e} not divisible by solve_chunk {solve_chunk}")
-    n_chunks = e // solve_chunk
+    (neighbor_idx, rating, mask, count), pad = pad_rows_to_multiple(
+        (neighbor_idx, rating, mask, count), solve_chunk
+    )
+    n_chunks = (e + pad) // solve_chunk
 
     def body(_, chunk):
         ni, r, m, c = chunk
@@ -361,7 +379,7 @@ def als_half_step(
     _, out = lax.scan(
         body, None, (reshape(neighbor_idx), reshape(rating), reshape(mask), reshape(count))
     )
-    return out.reshape(e, fixed_factors.shape[-1])
+    return out.reshape(e + pad, fixed_factors.shape[-1])[:e]
 
 
 def _ragged_gram_ddn():

@@ -82,21 +82,26 @@ def half_step_allgather(
 
 
 def _gram_chunked(blk, nb_t, rt_t, mk_t, solve_chunk):
-    """gather_gram over entity chunks: bounds the [chunk, P_ring, k] gather."""
+    """gather_gram over entity chunks: bounds the [chunk, P_ring, k] gather.
+
+    An indivisible entity count is padded with zero-mask rows (their Grams
+    are exact zeros, sliced off), so budget-derived chunk sizes always
+    work."""
     if solve_chunk is None or solve_chunk >= nb_t.shape[0]:
         return gather_gram(blk, nb_t, rt_t, mk_t)
+    from cfk_tpu.ops.solve import pad_rows_to_multiple
+
     e = nb_t.shape[0]
-    if e % solve_chunk != 0:
-        raise ValueError(
-            f"local entity count {e} not divisible by solve_chunk {solve_chunk}"
-        )
-    n_chunks = e // solve_chunk
+    (nb_t, rt_t, mk_t), pad = pad_rows_to_multiple(
+        (nb_t, rt_t, mk_t), solve_chunk
+    )
+    n_chunks = (e + pad) // solve_chunk
     reshape = lambda x: x.reshape((n_chunks, solve_chunk) + x.shape[1:])
     a, b = lax.map(
         lambda c: gather_gram(blk, *c), (reshape(nb_t), reshape(rt_t), reshape(mk_t))
     )
     k = blk.shape[-1]
-    return a.reshape(e, k, k), b.reshape(e, k)
+    return a.reshape(e + pad, k, k)[:e], b.reshape(e + pad, k)[:e]
 
 
 def half_step_ring(
@@ -350,12 +355,16 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
     if ring and not tiled:
         name = "bucketed" if bucketed else "segment"
         raise ValueError(
-            f"{name} layout supports exchange='all_gather' only; the ring "
-            "exchange is available for layout='padded' and layout='tiled' "
-            "(build the tiled dataset with Dataset.from_coo(..., "
-            "ring=True))"
+            f"{name} layout supports exchange='all_gather' only — the ring "
+            "join needs the owner-shard-sorted entry stream the padded and "
+            "tiled layouts have, and tiled strictly dominates "
+            f"{name} at ring-relevant scales (PARITY.md 'Known intentional "
+            "divergences' #5); build the tiled dataset with "
+            "Dataset.from_coo(..., ring=True) or ring='auto'"
         )
-    if tiled:
+    if tiled and config.exchange != "auto":
+        # "auto" takes each half's ring flag as built (the builder chose
+        # per side); the explicit exchanges require matching blocks.
         for name, blocks in (("movie", dataset.movie_blocks),
                              ("user", dataset.user_blocks)):
             if ring != blocks.ring:
@@ -363,7 +372,7 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
                     f"config.exchange={config.exchange!r} but the tiled "
                     f"{name}_blocks were built with ring={blocks.ring}; "
                     f"rebuild with Dataset.from_coo(..., layout='tiled', "
-                    f"ring={ring})"
+                    f"ring={ring if config.exchange == 'ring' else False})"
                 )
     if bucketed:
         mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
@@ -386,6 +395,11 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
         segment=segment,
         tiled=tiled,
     )
+    if tiled:
+        step_kw.update(
+            m_ring=dataset.movie_blocks.ring,
+            u_ring=dataset.user_blocks.ring,
+        )
     return mtree, utree, step_kw
 
 
@@ -410,6 +424,8 @@ def make_training_step(
     u_local=None,
     segment=False,
     tiled=False,
+    m_ring=False,
+    u_ring=False,
 ):
     """Build the jittable one-full-iteration SPMD step (solve M, then U).
 
@@ -465,38 +481,33 @@ def make_training_step(
 
         from cfk_tpu.ops.tiled import tiled_half_step
 
-        if config.exchange == "ring":
+        def ring_half(chunks, local):
+            def half(fixed_local, blk):
+                return half_step_tiled_ring(
+                    fixed_local, blk, chunks, local,
+                    lam=config.lam, num_shards=config.num_shards,
+                    solver=config.solver,
+                )
 
-            def ring_half(chunks, local):
-                def half(fixed_local, blk):
-                    return half_step_tiled_ring(
-                        fixed_local, blk, chunks, local,
-                        lam=config.lam, num_shards=config.num_shards,
-                        solver=config.solver,
-                    )
+            return half
 
-                return half
-
-            return wrap_step(
-                mesh, config,
-                ring_half(m_chunks, m_local),
-                ring_half(u_chunks, u_local),
-                mspecs, uspecs,
-            )
-
-        def tl_solve(chunks, local):
+        def ag_half(chunks, local):
             def solve(fixed_full, blk, _gram):
                 return tiled_half_step(
                     fixed_full, blk, chunks, local, config.lam,
                     solver=config.solver,
                 )
 
-            return solve
+            return gathered_half(solve)
 
+        # Each half picks its exchange from how its blocks were built —
+        # exchange="auto" mixes them (ring movie-half + all_gather
+        # user-half at Netflix shape, the per-side memory optimum);
+        # "ring"/"all_gather" build both sides the same way.
         return wrap_step(
             mesh, config,
-            gathered_half(tl_solve(m_chunks, m_local)),
-            gathered_half(tl_solve(u_chunks, u_local)),
+            (ring_half if m_ring else ag_half)(m_chunks, m_local),
+            (ring_half if u_ring else ag_half)(u_chunks, u_local),
             mspecs, uspecs,
         )
 
@@ -542,7 +553,6 @@ def make_training_step(
         half_rect = functools.partial(
             half_step_allgather,
             lam=config.lam,
-            solve_chunk=config.solve_chunk,
             solver=config.solver,
         )
     else:
@@ -550,7 +560,6 @@ def make_training_step(
             half_step_ring,
             lam=config.lam,
             num_shards=config.num_shards,
-            solve_chunk=config.solve_chunk,
             solver=config.solver,
         )
 
@@ -559,8 +568,12 @@ def make_training_step(
     # passes with float32 accumulation for bf16 factors, full-f32 "highest"
     # for float32 (see ops/solve.py _gram_compute_dtype).
     def half(fixed_local, blk):
+        # Unified HBM budget → entities per chunk, derived from THIS
+        # side's rectangle width (static inside the traced shard).
         return half_rect(
-            fixed_local, blk["neighbor"], blk["rating"], blk["mask"], blk["count"]
+            fixed_local, blk["neighbor"], blk["rating"], blk["mask"],
+            blk["count"],
+            solve_chunk=config.padded_solve_chunk(blk["neighbor"].shape[-1]),
         )
 
     return wrap_step(mesh, config, half, half, mspecs, uspecs)

@@ -253,6 +253,43 @@ def test_ring_config_dataset_mismatch_rejected(synth):
         train_als_sharded(ds_ring, cfg_ag, mesh)
 
 
+def test_exchange_auto_mixes_ring_and_allgather(synth):
+    """VERDICT r2 item #3: exchange='auto' expresses the per-half memory
+    optimum — ring on the few-entity half, all_gather on the many-entity
+    half — and matches the single-device result exactly."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = synthetic_netflix_coo(3000, 400, 60_000, seed=1)
+    cfg1 = ALSConfig(rank=8, lam=0.05, num_iterations=3, seed=0,
+                     layout="tiled", solver="cholesky")
+    ref = train_als(Dataset.from_coo(coo, layout="tiled"), cfg1).predict_dense()
+    # At rank_hint=8 the memory inequality lands asymmetric at test scale
+    # (the Netflix shape's optimum, miniaturized): movie half rings
+    # (shard 12,000 B + accumulator 29,088 B < 48,000 B all_gather'd user
+    # table), user half all_gathers (its 216 kB accumulator dwarfs the
+    # 6.4 kB movie table).
+    ds4 = Dataset.from_coo(coo, layout="tiled", num_shards=4, ring="auto",
+                           rank_hint=8)
+    assert ds4.movie_blocks.ring and not ds4.user_blocks.ring
+    cfg4 = dataclasses.replace(cfg1, num_shards=4, exchange="auto")
+    got = train_als_sharded(ds4, cfg4, make_mesh(4)).predict_dense()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_oversized_ring_half_refused():
+    """An explicit ring build whose per-entity accumulator would exceed
+    the all_gather table it saves must refuse with the auto hint."""
+    coo = synthetic_netflix_coo(500, 60, 5_000, seed=2)
+    with pytest.raises(ValueError, match="auto"):
+        Dataset.from_coo(coo, layout="tiled", num_shards=4, ring=True,
+                         accum_max_entities=100)
+
+
 def test_ring_requires_tiled_layout():
     coo = synthetic_netflix_coo(100, 20, 500, seed=0)
     with pytest.raises(ValueError, match="ring"):
