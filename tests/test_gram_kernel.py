@@ -1,0 +1,83 @@
+"""Fused grouped-Gram kernel unit tests (interpret mode on CPU).
+
+Compiled-on-hardware coverage lives in tests/test_pallas_tpu.py; these
+cover the kernel's walk/flush logic across shapes the TPU tests don't:
+group sizes that don't divide the tile count (the m-halving loop), single
+tiles per owner, owners spanning group boundaries, and both weight modes.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
+
+
+def _reference(g, wt, rt, seg, segs, t, k):
+    a = np.zeros((segs, k, k), np.float32)
+    b = np.zeros((segs, k), np.float32)
+    for s in np.unique(seg):
+        rows = np.repeat(seg == s, t)
+        gw = g[rows] * wt[rows][:, None]
+        a[s] = gw.T @ g[rows]
+        b[s] = g[rows].T @ rt[rows]
+    return a, b
+
+
+@pytest.mark.parametrize(
+    "t,nt,k,segs,m",
+    [
+        (64, 64, 32, 17, 16),
+        (128, 32, 64, 9, 16),
+        (8, 24, 16, 5, 16),  # nt % 16 != 0 → m halves to 8
+        (16, 10, 8, 30, 64),  # nt % 64/32/16/8 != 0 → m halves to 2
+        (8, 7, 8, 7, 64),  # prime tile count → m = 1
+    ],
+)
+@pytest.mark.parametrize("unit_weights", [False, True])
+def test_gram_kernel_matches_reference(t, nt, k, segs, m, unit_weights):
+    rng = np.random.default_rng(t * nt + k)
+    g = rng.standard_normal((nt * t, k)).astype(np.float32)
+    wt = (
+        np.ones(nt * t, np.float32) if unit_weights
+        else rng.random(nt * t).astype(np.float32)
+    )
+    rt = rng.random(nt * t).astype(np.float32)
+    seg = np.sort(rng.integers(0, segs - 1, size=nt)).astype(np.int32)
+    gw = None if unit_weights else jnp.asarray(g * wt[:, None])
+    a, b = gram_tiles_pallas(
+        jnp.asarray(g), gw, jnp.asarray(rt), jnp.asarray(seg),
+        num_segments=segs, tile_rows=t, group_tiles=m,
+    )
+    want_a, want_b = _reference(g, wt, rt, seg, segs, t, k)
+    a, b = np.asarray(a), np.asarray(b)
+    for s in np.unique(seg):  # absent owners' rows are unspecified
+        np.testing.assert_allclose(a[s], want_a[s], rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(b[s], want_b[s], rtol=2e-3, atol=2e-3)
+
+
+def test_gram_kernel_single_owner_spanning_all_groups():
+    """One owner across every group: began=False flushes must accumulate
+    rather than assign (the bug class the walk's flag exists to prevent)."""
+    t, nt, k = 8, 8, 16
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((nt * t, k)).astype(np.float32)
+    rt = rng.random(nt * t).astype(np.float32)
+    seg = np.zeros(nt, np.int32)
+    a, b = gram_tiles_pallas(
+        jnp.asarray(g), None, jnp.asarray(rt), jnp.asarray(seg),
+        num_segments=2, tile_rows=t, group_tiles=2,
+    )
+    np.testing.assert_allclose(np.asarray(a)[0], g.T @ g, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(b)[0], g.T @ rt, rtol=2e-3, atol=2e-3)
+
+
+def test_gram_kernel_rejects_mismatched_gw():
+    g = jnp.zeros((64, 8))
+    with pytest.raises(ValueError, match="gw"):
+        gram_tiles_pallas(
+            g, jnp.zeros((64, 4)), jnp.zeros(64), jnp.zeros(8, jnp.int32),
+            num_segments=3, tile_rows=8,
+        )
