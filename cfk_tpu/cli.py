@@ -55,8 +55,28 @@ def _parse_tcp_url(url: str, topic_optional: bool = False) -> tuple[str, int, st
     return host, int(port_s), topic or (None if topic_optional else RATINGS_TOPIC)
 
 
+AUTO_LAYOUT_TILED_NNZ = 2_000_000  # above this, tiled wins (BASELINE.md)
+
+
+def _resolve_auto_layout(coo, algorithm="als", solve_chunk=None) -> str:
+    """layout='auto': one padded rectangle for small data (fastest to
+    compile, no chunking machinery), the tiled layout once the data is
+    big enough for its batched-GEMM Grams to matter.  Constrained by the
+    rest of the invocation: an explicit (deprecated) --solve-chunk only
+    means anything on the padded layout, and the subspace optimizers
+    (als++/ials++) need padded/bucketed — bucketed is their at-scale
+    layout (what bench.py's subspace path uses)."""
+    if solve_chunk is not None:
+        return "padded"
+    big = coo.num_ratings >= AUTO_LAYOUT_TILED_NNZ
+    if algorithm != "als":
+        return "bucketed" if big else "padded"
+    return "tiled" if big else "padded"
+
+
 def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded",
-                  chunk_elems=1 << 20, cache_dir=None, ring=False):
+                  chunk_elems=1 << 20, cache_dir=None, ring=False,
+                  auto_resolver=_resolve_auto_layout):
     import os
 
     from cfk_tpu.data.blocks import Dataset
@@ -91,9 +111,10 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
                 # file: every broken-cache state self-heals via rebuild
                 _eprint(f"warning: ignoring dataset cache: {e}")
         coo = build()
+        resolved = auto_resolver(coo) if layout == "auto" else layout
         ds = Dataset.from_coo(
             coo, num_shards=num_shards, pad_multiple=pad_multiple,
-            layout=layout, chunk_elems=chunk_elems, ring=ring,
+            layout=resolved, chunk_elems=chunk_elems, ring=ring,
         )
         if cache_dir:
             ds.save(cache_dir, build_key=build_key)
@@ -201,6 +222,19 @@ def _train(args) -> int:
     from cfk_tpu.utils.metrics import Metrics, maybe_profile
 
     metrics = Metrics()
+    if args.layout == "auto" and args.exchange == "auto":
+        # The per-half exchange builds on the tiled layout only (config
+        # validation says so); resolve up front so ring blocks are built.
+        args.layout = "tiled"
+    if args.layout == "auto" and args.exchange == "ring":
+        # Both ring-capable layouts work; padded needs no build-time ring
+        # blocks and has no per-shard accumulator cap — the safe default
+        # (pass --layout tiled explicitly for the tiled ring).
+        args.layout = "padded"
+
+    def _resolver(coo):
+        return _resolve_auto_layout(coo, args.algorithm, args.solve_chunk)
+
     with metrics.phase("ingest"):
         ds = _load_dataset(
             args.data, args.format, args.min_rating, args.shards,
@@ -211,7 +245,20 @@ def _train(args) -> int:
                  else args.exchange == "ring")
                 if args.layout == "tiled" else False
             ),
+            auto_resolver=_resolver,
         )
+    if args.layout == "auto":
+        # Reflect what _resolve_auto_layout (or a cache hit) actually built,
+        # so the config matches the blocks.
+        from cfk_tpu.data.blocks import (
+            BucketedBlocks, SegmentBlocks, TiledBlocks,
+        )
+
+        args.layout = {
+            BucketedBlocks: "bucketed",
+            SegmentBlocks: "segment",
+            TiledBlocks: "tiled",
+        }.get(type(ds.movie_blocks), "padded")
     common = dict(
         layout=args.layout,
         rank=args.rank,
@@ -729,13 +776,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "every layout")
     t.add_argument("--pad-multiple", type=int, default=8)
     t.add_argument(
-        "--layout", choices=["padded", "bucketed", "segment", "tiled"],
-        default="padded",
+        "--layout",
+        choices=["auto", "padded", "bucketed", "segment", "tiled"],
+        default="auto",
         help="InBlock layout: one rectangle (padded), power-of-two width "
         "buckets (bucketed), flat segment runs with grouped ragged-matmul "
         "Grams (segment; exactly O(nnz) memory for arbitrarily skewed "
-        "data), or tile-padded runs with batched-GEMM Grams and sliced-"
-        "table gathers (tiled; the fastest at full-Netflix scale)",
+        "data), or tile-padded runs with batched-GEMM Grams via the fused "
+        "pallas kernel (tiled; the fastest at full-Netflix scale). "
+        "Default 'auto': padded below 2M ratings, tiled above",
     )
     t.add_argument(
         "--chunk-elems", type=int, default=1 << 20,

@@ -743,6 +743,11 @@ class TiledBlocks:
                 self.slice_rows, self.chunk_entities)
 
 
+TILED_SLICE_ROWS_DEFAULT = 1 << 17  # ≤34 MB bf16 rank-64 slice: the
+# measured fast-gather regime (BASELINE.md); perf_lab keys caches on
+# deviations from this same constant
+
+
 def build_tiled_blocks(
     solve_dense: np.ndarray,
     fixed_dense: np.ndarray,
@@ -753,7 +758,7 @@ def build_tiled_blocks(
     num_shards: int = 1,
     tile_rows: int = 128,
     chunk_elems: int | None = 1 << 20,
-    slice_rows: int = 1 << 17,
+    slice_rows: int = TILED_SLICE_ROWS_DEFAULT,
     accum_max_entities: int = 1 << 16,
     ring: bool = False,
 ) -> TiledBlocks:

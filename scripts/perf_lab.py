@@ -35,8 +35,11 @@ def sync(x) -> None:
 
 
 def get_dataset(args):
-    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.blocks import TILED_SLICE_ROWS_DEFAULT, Dataset
     from cfk_tpu.data.synthetic import synthetic_netflix_coo
+
+    if args.slice_rows is None:
+        args.slice_rows = TILED_SLICE_ROWS_DEFAULT
 
     key = {
         "users": args.users, "movies": args.movies, "nnz": args.nnz,
@@ -45,6 +48,8 @@ def get_dataset(args):
     }
     if args.layout == "tiled":
         key["tile_rows"] = args.tile_rows
+        if args.slice_rows != TILED_SLICE_ROWS_DEFAULT:
+            key["slice_rows"] = args.slice_rows
     tag = "_".join(f"{k}{v}" for k, v in key.items())
     path = os.path.join(CACHE_ROOT, tag)
     if os.path.exists(path):
@@ -65,10 +70,12 @@ def get_dataset(args):
         d = base.coo_dense
         mb = build_tiled_blocks(d.movie_raw, d.user_raw, d.rating,
                                 base.movie_map.num_entities, base.user_map.num_entities,
-                                tile_rows=args.tile_rows, chunk_elems=args.chunk_elems)
+                                tile_rows=args.tile_rows, chunk_elems=args.chunk_elems,
+                                slice_rows=args.slice_rows)
         ub = build_tiled_blocks(d.user_raw, d.movie_raw, d.rating,
                                 base.user_map.num_entities, base.movie_map.num_entities,
-                                tile_rows=args.tile_rows, chunk_elems=args.chunk_elems)
+                                tile_rows=args.tile_rows, chunk_elems=args.chunk_elems,
+                                slice_rows=args.slice_rows)
         ds = _dc.replace(base, movie_blocks=mb, user_blocks=ub)
     else:
         ds = Dataset.from_coo(coo, layout=args.layout, chunk_elems=args.chunk_elems)
@@ -89,6 +96,9 @@ def main() -> None:
                    choices=["padded", "bucketed", "segment", "tiled"])
     p.add_argument("--chunk-elems", type=int, default=1 << 20)
     p.add_argument("--tile-rows", type=int, default=128)
+    p.add_argument("--slice-rows", type=int, default=None,
+                   help="accum-mode fixed-table gather slice height "
+                   "(default: the builder's TILED_SLICE_ROWS_DEFAULT)")
     p.add_argument("--solver", default="pallas",
                    choices=["auto", "cholesky", "pallas"])
     p.add_argument("--dtype", default="bfloat16",

@@ -50,6 +50,29 @@ def test_train_and_evaluate_roundtrip(capsys, tmp_path):
     assert mse <= 0.27
 
 
+def test_auto_layout_resolution(capsys, monkeypatch):
+    """--layout auto (the default): padded below the threshold, tiled
+    above, and ring/auto exchanges force tiled up front."""
+    import cfk_tpu.cli as cli
+
+    class _Coo:
+        num_ratings = 100
+
+    assert cli._resolve_auto_layout(_Coo()) == "padded"
+    _Coo.num_ratings = cli.AUTO_LAYOUT_TILED_NNZ
+    assert cli._resolve_auto_layout(_Coo()) == "tiled"
+    # End-to-end: tiny data under auto trains on the padded path and the
+    # resolved layout reaches the config (no 'auto' leaks into ALSConfig).
+    rc = main(["train", "--data", TINY, "--rank", "3", "--iterations", "2",
+               "--seed", "0", "--output", "none"])
+    assert rc == 0
+    # Forcing the threshold to 0 makes the same data resolve to tiled.
+    monkeypatch.setattr(cli, "AUTO_LAYOUT_TILED_NNZ", 0)
+    rc = main(["train", "--data", TINY, "--rank", "3", "--iterations", "2",
+               "--seed", "0", "--output", "none", "--chunk-elems", "4096"])
+    assert rc == 0
+
+
 def test_train_survives_unmaterializable_dense_preds(capsys, tmp_path, monkeypatch):
     """At BASELINE scales the dense U·Mᵀ cannot exist; training must still
     finish, report factored train MSE, and only skip the CSV dump."""
