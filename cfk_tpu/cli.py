@@ -76,7 +76,7 @@ def _resolve_auto_layout(coo, algorithm="als", solve_chunk=None) -> str:
 
 def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padded",
                   chunk_elems=1 << 20, cache_dir=None, ring=False,
-                  auto_resolver=_resolve_auto_layout):
+                  auto_resolver=_resolve_auto_layout, auto_key=None):
     import os
 
     from cfk_tpu.data.blocks import Dataset
@@ -101,6 +101,12 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
     }
     if ring:  # absent for non-ring keys so existing caches stay valid
         build_key["ring"] = ring
+    if layout == "auto" and auto_key:
+        # layout='auto' resolves from the data AND the invocation
+        # (algorithm, solve_chunk constrain the choice) — without these in
+        # the key, a cache built under `als` would be silently reused by
+        # `ials++` with a layout that invocation cannot train on.
+        build_key.update(auto_key)
 
     def cache_or_build(build):
         if cache_dir and os.path.exists(os.path.join(cache_dir, "meta.json")):
@@ -246,6 +252,10 @@ def _train(args) -> int:
                 if args.layout == "tiled" else False
             ),
             auto_resolver=_resolver,
+            auto_key={
+                "algorithm": args.algorithm,
+                "solve_chunk": args.solve_chunk,
+            },
         )
     if args.layout == "auto":
         # Reflect what _resolve_auto_layout (or a cache hit) actually built,

@@ -2,10 +2,12 @@ from cfk_tpu.ops.pallas.solve_kernel import (
     PALLAS_MAX_RANK,
     gauss_solve_multi_pallas,
     gauss_solve_pallas,
+    gauss_solve_reg_pallas,
 )
 
 __all__ = [
     "PALLAS_MAX_RANK",
     "gauss_solve_multi_pallas",
     "gauss_solve_pallas",
+    "gauss_solve_reg_pallas",
 ]

@@ -100,6 +100,143 @@ def _gauss_multi_kernel(a_ref, b_ref, x_ref, *, k: int):
     x_ref[:] = b
 
 
+def _gauss_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k: int, reg_mode: str,
+                      lam: float):
+    """Fused batch-first solve: a_ref [T,k,k], b_ref [T,k], r_ref the
+    regularizer (``diag``: [T] rating counts; ``matrix``: [k,k] YᵀY+λI),
+    x_ref [T,k].
+
+    The round-3 profile showed the batch-last pallas solve paying three
+    HBM round-trips outside the kernel: the λ·n·I diagonal add re-wrote the
+    whole [E,k,k] Gram batch (~40 MB per chunk), and the [E,k,k]→[k,k,E]
+    transpose plus the output transpose-back each copied it again
+    (``copy.65``/``fusion.41``, ~66 ms of the 820 ms iteration).  Here the
+    transposes happen in VMEM on the [T,k,k] block and the regularizer is
+    added to the diagonal in-register, so HBM sees exactly one read of
+    (A, b) and one write of x.  Padding systems (count 0 ⇒ reg λ·1) become
+    λ·I — SPD — so no identity-fill prologue is needed either.
+    """
+    a = jnp.transpose(a_ref[...], (1, 2, 0))  # [k,k,T] batch-last
+    b = b_ref[...].T  # [k,T]
+    if reg_mode == "diag":
+        # [1, T] block (1-D s32 operands draw an XLA T(1024) layout Mosaic
+        # rejects; 2-D rows use the standard tiling).
+        reg = lam * jnp.maximum(r_ref[0, :].astype(jnp.float32), 1.0)  # [T]
+        r3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 0)
+        c3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 1)
+        a = a + jnp.where(r3 == c3, reg[None, None, :], 0.0)
+    else:  # matrix: one [k,k] SPD term shared across the batch (iALS)
+        a = a + r_ref[...][:, :, None]
+    rows3 = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0)
+    rows2 = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)
+    for j in range(k):  # k is static → fully unrolled
+        inv = 1.0 / a[j, j, :]
+        row = a[j] * inv[None, :]
+        bj = b[j] * inv
+        col = a[:, j, :]
+        a = jnp.where(rows3 == j, row[None, :, :],
+                      a - col[:, None, :] * row[None, :, :])
+        b = jnp.where(rows2 == j, bj[None, :], b - col * bj[None, :])
+    x_ref[...] = b.T
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reg_mode", "lam", "interpret")
+)
+def gauss_solve_reg_pallas(
+    a: jax.Array,  # [E, k, k] float32 Gram batch (batch-FIRST)
+    b: jax.Array,  # [E, k] float32
+    reg: jax.Array,  # diag mode: [E] rating counts; matrix mode: [k,k]
+    *,
+    reg_mode: str = "diag",
+    lam: float = 0.0,
+    interpret: bool | None = None,
+) -> jax.Array:  # [E, k]
+    """Regularize and solve a batch of SPD systems in one kernel pass.
+
+    ``reg_mode="diag"`` applies ALS-WR's λ·max(n,1)·I (reference semantics,
+    ``processors/MFeatureCalculator.java:91-95``); ``reg_mode="matrix"``
+    adds a shared [k,k] SPD term (iALS's YᵀY+λI).  Batch-first layout in
+    and out — the transposes the batch-last kernels need are done in VMEM,
+    so callers no longer pay the [E,k,k] HBM transpose or a separate
+    regularization pass.
+    """
+    e, k, k2 = a.shape
+    if k != k2 or b.shape != (e, k):
+        raise ValueError(f"bad shapes a={a.shape} b={b.shape}")
+    if k > PALLAS_MAX_RANK:
+        raise ValueError(
+            f"gauss_solve_reg_pallas supports rank <= {PALLAS_MAX_RANK}, "
+            f"got {k}; use the cholesky backend"
+        )
+    if reg_mode == "diag":
+        if reg.shape != (e,):
+            raise ValueError(f"diag reg shape {reg.shape} != ({e},)")
+    elif reg_mode == "matrix":
+        if reg.shape != (k, k):
+            raise ValueError(f"matrix reg shape {reg.shape} != ({k},{k})")
+    else:
+        raise ValueError(f"unknown reg_mode {reg_mode!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tile = _LANES
+    if interpret:
+        # The HLO interpreter needs exact block tiling; compiled Mosaic
+        # handles the ragged last block itself (out-of-bounds reads are
+        # unspecified but stay in their own lanes — each lane is an
+        # independent system — and out-of-bounds writes are dropped), so
+        # on TPU no [E,k,k] pad/slice copy is paid (the pad alone was
+        # ~28 ms/iter at full Netflix).
+        e_pad = ((e + tile - 1) // tile) * tile
+        a_p = _pad_to(a, e_pad, axis=0)
+        b_p = _pad_to(b, e_pad, axis=0)
+        r_p = (
+            _pad_to(reg, e_pad, axis=0)[None, :]
+            if reg_mode == "diag" else reg
+        )
+    else:
+        e_pad = e
+        a_p, b_p = a, b
+        r_p = reg[None, :] if reg_mode == "diag" else reg
+    mem = {"memory_space": _VMEM} if _VMEM is not None and not interpret else {}
+    r_spec = (
+        pl.BlockSpec((1, tile), lambda i: (0, i), **mem)
+        if reg_mode == "diag"
+        else pl.BlockSpec((k, k), lambda i: (0, 0), **mem)
+    )
+    vma = getattr(jax.typeof(a_p), "vma", None)
+    out_shape = (
+        jax.ShapeDtypeStruct((e_pad, k), jnp.float32, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct((e_pad, k), jnp.float32)
+    )
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        # The batch-first input block + its in-kernel batch-last transpose
+        # both sit in VMEM through the unrolled elimination (~20 MB at
+        # k=64); the default 16 MB scoped allowance is just short.
+        params = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams"
+        )
+        kwargs["compiler_params"] = params(vmem_limit_bytes=40 * 1024 * 1024)
+    x = pl.pallas_call(
+        functools.partial(
+            _gauss_reg_kernel, k=k, reg_mode=reg_mode, lam=lam
+        ),
+        out_shape=out_shape,
+        grid=((e_pad + tile - 1) // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, k, k), lambda i: (i, 0, 0), **mem),
+            pl.BlockSpec((tile, k), lambda i: (i, 0), **mem),
+            r_spec,
+        ],
+        out_specs=pl.BlockSpec((tile, k), lambda i: (i, 0), **mem),
+        interpret=interpret,
+        **kwargs,
+    )(a_p, b_p, r_p)
+    return x[:e]
+
+
 def _pad_to(x: jax.Array, size: int, axis: int) -> jax.Array:
     pad = size - x.shape[axis]
     if pad == 0:

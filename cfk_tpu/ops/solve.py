@@ -149,8 +149,8 @@ def ials_half_step(
     if gram is None:
         gram = global_gram(fixed_factors)
     a_obs, b = gather_gram_implicit(fixed_factors, neighbor_idx, alpha * rating, mask)
-    a = gram[None] + a_obs + lam * jnp.eye(k, dtype=jnp.float32)[None]
-    return dispatch_spd_solve(a, b, solver)
+    reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
+    return regularized_solve_matrix(a_obs, b, reg, solver)
 
 
 def walk_buckets(buckets, chunk_rows, arrays_of, piece, out):
@@ -205,7 +205,7 @@ def ials_half_step_bucketed(
 
     def solve_piece(ni, rt, mk):
         a_obs, b = gather_gram_implicit(fixed_factors, ni, alpha * rt, mk)
-        return dispatch_spd_solve(gram[None] + a_obs + reg[None], b, solver)
+        return regularized_solve_matrix(a_obs, b, gram + reg, solver)
 
     out = walk_buckets(
         buckets, chunk_rows,
@@ -282,8 +282,7 @@ def dispatch_spd_solve(a: jax.Array, b: jax.Array, solver: str) -> jax.Array:
     use one level of blocked Schur elimination on the same kernels; anything
     larger falls back to cholesky.
     """
-    if solver == "auto":
-        solver = "pallas" if jax.default_backend() == "tpu" else "cholesky"
+    solver = _resolve_solver(solver)
     if solver == "cholesky":
         return batched_spd_solve(a, b)
     if solver == "pallas":
@@ -299,6 +298,12 @@ def dispatch_spd_solve(a: jax.Array, b: jax.Array, solver: str) -> jax.Array:
     raise ValueError(f"unknown solver {solver!r}")
 
 
+def _resolve_solver(solver: str) -> str:
+    if solver == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "cholesky"
+    return solver
+
+
 def regularized_solve(
     a: jax.Array, b: jax.Array, count: jax.Array, lam: float, solver: str = "cholesky"
 ) -> jax.Array:
@@ -307,11 +312,39 @@ def regularized_solve(
     The n floor at 1 keeps all-padding rows (n = 0) SPD; real rows always have
     n ≥ 1 so their math is exact reference semantics
     (``processors/MFeatureCalculator.java:91-95``).
+
+    On the pallas backend at supported ranks the regularization, the
+    batch-last transposes, and the elimination run as ONE kernel
+    (``gauss_solve_reg_pallas``) — the separate diagonal-add pass re-wrote
+    the whole Gram batch through HBM every chunk (round-3 profile).
     """
+    from cfk_tpu.ops.pallas import PALLAS_MAX_RANK, gauss_solve_reg_pallas
+
     k = a.shape[-1]
+    if _resolve_solver(solver) == "pallas" and k <= PALLAS_MAX_RANK:
+        return gauss_solve_reg_pallas(
+            a, b, count, reg_mode="diag", lam=float(lam)
+        )
     reg = lam * jnp.maximum(count.astype(jnp.float32), 1.0)
     a = a + reg[:, None, None] * jnp.eye(k, dtype=a.dtype)
     return dispatch_spd_solve(a, b, solver)
+
+
+def regularized_solve_matrix(
+    a: jax.Array, b: jax.Array, reg: jax.Array, solver: str = "cholesky"
+) -> jax.Array:
+    """Solve (A_e + R) x_e = b_e with one shared [k,k] SPD term R.
+
+    The iALS half-steps' per-entity systems all add the same global
+    YᵀY + λI (Hu et al. 2008); fusing the add into the pallas solve skips
+    an [E,k,k] HBM rewrite per chunk, exactly like ``regularized_solve``.
+    """
+    from cfk_tpu.ops.pallas import PALLAS_MAX_RANK, gauss_solve_reg_pallas
+
+    k = a.shape[-1]
+    if _resolve_solver(solver) == "pallas" and k <= PALLAS_MAX_RANK:
+        return gauss_solve_reg_pallas(a, b, reg, reg_mode="matrix")
+    return dispatch_spd_solve(a + reg[None], b, solver)
 
 
 def pad_rows_to_multiple(arrays, multiple: int):
@@ -600,7 +633,7 @@ def ials_half_step_segment(
         )
 
     def solve_rows(a_obs, b, _cnt):
-        return dispatch_spd_solve(reg[None] + a_obs, b, solver)
+        return regularized_solve_matrix(a_obs, b, reg, solver)
 
     return _segment_scan(
         fixed_factors, chunk_gram, solve_rows,

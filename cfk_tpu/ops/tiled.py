@@ -44,8 +44,8 @@ from jax import lax
 from cfk_tpu.ops.solve import (
     _gram_compute_dtype,
     _match_varying,
-    dispatch_spd_solve,
     regularized_solve,
+    regularized_solve_matrix,
 )
 
 
@@ -66,7 +66,7 @@ def default_tiled_gram_backend() -> str:
 
 def _entity_gram_chunk(
     fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, backend,
-    unit_weights=False,
+    unit_weights=False, zero_appended=False,
 ):
     """One chunk's per-entity Gram/RHS: (A [num_segments, k, k], b [.., k]).
 
@@ -77,17 +77,24 @@ def _entity_gram_chunk(
 
     A zero row is appended to the fixed slice and padding entries index it
     (format-3 blocks), so padding contributes exact zeros BEFORE any weight
-    is applied.  ``unit_weights=True`` (explicit ALS: real weights are all
-    1.0) therefore skips the w·f multiply entirely — measured 0.18 s/iter
-    of pure elementwise traffic at the full Netflix shape.  The weighted
-    path multiplies post-gather, where the copy fuses into the gather.
+    is applied.  ``zero_appended=True`` says the caller already placed that
+    zero row (accum mode appends it per SLICE outside the chunk scan — the
+    in-body concatenate re-copied the 17 MB slice every chunk, ~25 ms/iter
+    in the round-3 profile).  ``unit_weights=True`` (explicit ALS: real
+    weights are all 1.0) skips the w·f multiply entirely — measured 0.18
+    s/iter of pure elementwise traffic at the full Netflix shape.  The
+    weighted path multiplies post-gather, where the copy fuses into the
+    gather.
     """
     k = fixed_slice.shape[-1]
     ct, prec = _gram_compute_dtype(fixed_slice)
-    fz = jnp.concatenate([
-        fixed_slice,
-        _match_varying(jnp.zeros((1, k), fixed_slice.dtype), fixed_slice),
-    ])
+    if zero_appended:
+        fz = fixed_slice
+    else:
+        fz = jnp.concatenate([
+            fixed_slice,
+            _match_varying(jnp.zeros((1, k), fixed_slice.dtype), fixed_slice),
+        ])
     g = fz[nb].astype(ct)  # [C, k]
     if backend == "pallas" and 2 * num_segments * k * (k + 1) * 4 > (96 << 20):
         # The kernel keeps the whole (A, b) chunk output resident in VMEM
@@ -225,32 +232,60 @@ def als_half_step_tiled(
     )
 
     def body(carry, chunk):
-        a0, b0, out = carry
+        a0, b0 = carry
         nb_c, rt_c, wt_c, ts_c, ent_c, cnt_c, cin_c, lseg_c = chunk
         a, b = _entity_gram_chunk(
             fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None,
         )
-        a = a.at[0].add(cin_c * a0)
-        b = b.at[0].add(cin_c * b0)
+        # Segment 0 may continue the previous chunk's last entity.  Folding
+        # the carried partial into the batch via ``a.at[0].add`` rewrote the
+        # whole [Ec,k,k] Gram batch through HBM every chunk (~0.17 ms/chunk
+        # in the round-3 profile); instead the batch is solved as-is
+        # (including the trash row — solving it beats slicing it away,
+        # which copied the batch again) and segment 0 is re-solved alone
+        # with the carry applied — one [1,k,k] system and a one-row fixup.
         if implicit_reg is None:
-            x = regularized_solve(a[:e_c], b[:e_c], cnt_c, lam, solver)
+            cnt_full = jnp.concatenate(
+                [cnt_c, jnp.ones((1,), cnt_c.dtype)]
+            )
+            x = regularized_solve(a, b, cnt_full, lam, solver)
         else:
-            x = dispatch_spd_solve(implicit_reg[None] + a[:e_c], b[:e_c], solver)
-        out = out.at[ent_c].set(x)
+            x = regularized_solve_matrix(a, b, implicit_reg, solver)
+        a00 = a[0] + cin_c * a0
+        b00 = b[0] + cin_c * b0
+        if implicit_reg is None:
+            x0 = regularized_solve(
+                a00[None], b00[None], cnt_c[:1], lam, solver
+            )
+        else:
+            x0 = regularized_solve_matrix(
+                a00[None], b00[None], implicit_reg, solver
+            )
+        x = x.at[0].set(x0[0])
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
         b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
-        return (a1, b1, out), None
+        a1 = a1 + jnp.where(lseg_c == 0, cin_c, 0.0) * a0
+        b1 = b1 + jnp.where(lseg_c == 0, cin_c, 0.0) * b0
+        return (a1, b1), x[:e_c]
 
     init = jax.tree.map(
         lambda z: _match_varying(z, neighbor_idx),
         (
             jnp.zeros((k, k), jnp.float32),
             jnp.zeros((k,), jnp.float32),
-            jnp.zeros((local_entities + 1, k), jnp.float32),
         ),
     )
-    (_, _, out), _ = lax.scan(body, init, chunks)
+    # Solutions are emitted as stacked scan outputs and scattered ONCE
+    # after the loop — carrying the [E+1, k] output buffer through the
+    # scan rewrote it copy-on-write every chunk.  Trash-row collisions
+    # (every non-finalized position routes to E_local) are harmless:
+    # scatter-set keeps one of them and the trash row is dropped below.
+    _, xs = lax.scan(body, init, chunks)
+    out = _match_varying(
+        jnp.zeros((local_entities + 1, k), jnp.float32), neighbor_idx
+    )
+    out = out.at[chunk_entity.reshape(nc * e_c)].set(xs.reshape(nc * e_c, k))
     return out[:local_entities]
 
 
@@ -300,13 +335,40 @@ def als_half_step_tiled_accum(
         chunk_base.reshape(nc), chunk_entity.reshape(nc, e_c),
     )
 
+    # Build each slice's [h+1, k] gather window (zero row appended) ONCE,
+    # outside the chunk scan — the in-body concatenate re-copied the whole
+    # 17 MB slice every chunk (``pad.41``, ~25 ms/iter at full Netflix).
+    # Window bases replicate the builder's clamp (`min(s·h, F−h)`,
+    # blocks.py) and are static, so the windows are static slices; a chunk
+    # finds its window by comparing its base against the static base list
+    # (the clamped last base is NOT a multiple of h, so `base // h` would
+    # misroute it).
+    f_rows = fixed_factors.shape[0]
+    n_slices = max(1, -(-f_rows // h))
+    bases = [min(s * h, max(f_rows - h, 0)) for s in range(n_slices)]
+    zrow = _match_varying(
+        jnp.zeros((1, k), fixed_factors.dtype), fixed_factors
+    )
+    gz = jnp.stack([
+        jnp.concatenate([
+            lax.slice_in_dim(fixed_factors, b, b + h), zrow
+        ])
+        for b in bases
+    ])  # [n_slices, h+1, k]
+    bases_arr = _match_varying(
+        jnp.asarray(bases, jnp.int32), fixed_factors
+    )
+
     def body(carry, chunk):
         acc_a, acc_b = carry
         nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
-        fixed_slice = lax.dynamic_slice(fixed_factors, (base_c, 0), (h, k))
+        s_idx = jnp.sum((base_c >= bases_arr).astype(jnp.int32)) - 1
+        fixed_slice = lax.dynamic_index_in_dim(
+            gz, s_idx, 0, keepdims=False
+        )
         a, b = _entity_gram_chunk(
             fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-            unit_weights=implicit_reg is None,
+            unit_weights=implicit_reg is None, zero_appended=True,
         )
         # Rank rows owning no tile are unwritten garbage under the pallas
         # backend; ent_c routes them (and any NaN they hold) to the trash
@@ -326,4 +388,4 @@ def als_half_step_tiled_accum(
     a, b = acc_a[:local_entities], acc_b[:local_entities]
     if implicit_reg is None:
         return regularized_solve(a, b, count, lam, solver)
-    return dispatch_spd_solve(implicit_reg[None] + a, b, solver)
+    return regularized_solve_matrix(a, b, implicit_reg, solver)

@@ -49,6 +49,7 @@ from cfk_tpu.data.blocks import (
 )
 from cfk_tpu.models.als import ALSModel
 from cfk_tpu.ops.solve import (
+    _match_varying,
     als_half_step,
     als_half_step_bucketed,
     als_half_step_segment,
@@ -302,6 +303,13 @@ def half_step_tiled_ring(
     starts = blk["slice_starts"]  # [S+1]
 
     def slice_grams(acc, factors, t_idx):
+        # One zero-row append per ring step, not per chunk (the chunk-scan
+        # body would otherwise re-copy the whole block every chunk).
+        fz = jnp.concatenate([
+            factors,
+            _match_varying(jnp.zeros((1, k), factors.dtype), factors),
+        ])
+
         def chunk_body(i, acc):
             acc_a, acc_b = acc
             nb_c = lax.dynamic_slice(nb, (i * cap,), (cap,))
@@ -310,8 +318,9 @@ def half_step_tiled_ring(
             ts_c = lax.dynamic_slice(ts, (i * nt,), (nt,))
             ent_c = lax.dynamic_slice(ent, (i * e_c,), (e_c,))
             a, b = _entity_gram_chunk(
-                factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                fz, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
                 unit_weights=True,  # the ring is explicit-ALS only
+                zero_appended=True,
             )
             return (acc_a.at[ent_c].add(a[:e_c]), acc_b.at[ent_c].add(b[:e_c]))
 

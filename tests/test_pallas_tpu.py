@@ -90,3 +90,39 @@ def test_gram_tiles_kernel_compiled(unit_weights):
         np.testing.assert_allclose(
             b[s], g[rows].T @ rt[rows], rtol=2e-3, atol=2e-3
         )
+
+
+@pytest.mark.parametrize("reg_mode,k,e", [
+    ("diag", 64, 257), ("diag", 5, 77), ("matrix", 64, 300),
+])
+def test_gauss_solve_reg_compiled(reg_mode, k, e):
+    """The fused batch-first reg+solve kernel, compiled: ragged last grid
+    block (e not a multiple of 128) and both regularizer modes."""
+    from cfk_tpu.ops.pallas import gauss_solve_reg_pallas
+    from cfk_tpu.ops.solve import batched_spd_solve
+
+    rng = np.random.default_rng(e)
+    a, b = _spd_batch(rng, e, k)
+    if reg_mode == "diag":
+        cnt = rng.integers(0, 50, size=e).astype(np.int32)
+        lam = 0.05
+        reg = lam * np.maximum(cnt.astype(np.float32), 1.0)
+        a_reg = a + reg[:, None, None] * np.eye(k, dtype=np.float32)
+        got = np.asarray(gauss_solve_reg_pallas(
+            jnp.asarray(a), jnp.asarray(b), jnp.asarray(cnt),
+            reg_mode="diag", lam=lam, interpret=False,
+        ))
+    else:
+        r = rng.standard_normal((k, 4)).astype(np.float32)
+        rm = r @ r.T + 0.1 * np.eye(k, dtype=np.float32)
+        a_reg = a + rm[None]
+        got = np.asarray(gauss_solve_reg_pallas(
+            jnp.asarray(a), jnp.asarray(b), jnp.asarray(rm),
+            reg_mode="matrix", interpret=False,
+        ))
+    want = np.asarray(
+        batched_spd_solve(jnp.asarray(a_reg), jnp.asarray(b))
+    )
+    resid = np.einsum("ekl,el->ek", a_reg, got) - b
+    assert np.abs(resid).max() < 1e-3
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
