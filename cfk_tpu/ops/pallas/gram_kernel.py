@@ -110,9 +110,13 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision):
         def _flush(row=prev_row, began=began, acc_a=acc_a, acc_b=acc_b):
             flush(row, began, acc_a, acc_b)
 
-        keep = jnp.logical_not(change)
-        acc_a = jnp.where(keep, acc_a + a_all[i], a_all[i])
-        acc_b = jnp.where(keep, acc_b + b_all[i], b_all[i])
+        # Arithmetic select: acc·keep + a is ONE fused multiply-add per
+        # vreg where where(keep, acc+a, a) costs an add AND a select —
+        # the accumulation chain is the kernel's VPU hot path (~60 ns/tile
+        # over 1.8M tiles/iter at full Netflix).
+        keep_f = 1.0 - change.astype(jnp.float32)
+        acc_a = acc_a * keep_f + a_all[i]
+        acc_b = acc_b * keep_f + b_all[i]
         began = jnp.logical_or(began, change)
     flush(seg_ref[base + m - 1], began, acc_a, acc_b)
 

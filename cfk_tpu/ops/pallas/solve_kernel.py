@@ -53,6 +53,11 @@ _LANES = 128
 # custom-call overhead amortizes at larger k. The crossover favors this
 # kernel only up to k = 64, so the cap stays.
 PALLAS_MAX_RANK = 64
+# The LU variant does k³/3 VPU work (vs Gauss-Jordan's ~3k³ chain of
+# fma+select over the full matrix), which moves its crossover past
+# k = 128: one direct LU beats the blocked Schur composition of k=64 GJ
+# kernels AND skips Schur's XLA-level [E,k,k] transposes.
+LU_MAX_RANK = 128
 
 
 def _gauss_kernel(a_ref, b_ref, x_ref, *, k: int):
@@ -100,6 +105,56 @@ def _gauss_multi_kernel(a_ref, b_ref, x_ref, *, k: int):
     x_ref[:] = b
 
 
+def _apply_reg(a, r_ref, *, k: int, reg_mode: str, lam: float):
+    """Add the regularizer to a batch-last [k,k,T] block in-register:
+    ``diag`` = λ·max(n,1)·I from the [1,T] count row (ALS-WR), ``matrix``
+    = one shared [k,k] SPD term (iALS's YᵀY+λI)."""
+    if reg_mode == "diag":
+        # [1, T] block (1-D s32 operands draw an XLA T(1024) layout Mosaic
+        # rejects; 2-D rows use the standard tiling).
+        reg = lam * jnp.maximum(r_ref[0, :].astype(jnp.float32), 1.0)  # [T]
+        r3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 0)
+        c3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 1)
+        return a + jnp.where(r3 == c3, reg[None, None, :], 0.0)
+    # matrix: one [k,k] SPD term shared across the batch (iALS)
+    return a + r_ref[...][:, :, None]
+
+
+def _lu_reg_kernel(a_ref, b_ref, r_ref, x_ref, u_scr, y_scr, x_scr, *,
+                   k: int, reg_mode: str, lam: float):
+    """Fused reg + LU solve, batch-first in/out — the k³/3 alternative to
+    Gauss-Jordan's k³.
+
+    No-pivot LU is stable here for the same reason GJ is (SPD + ridge).
+    The elimination runs in REVERSE variable order with a shrinking
+    trailing matrix: pure-functional shrink needs no in-register scatter
+    (Mosaic has none), and eliminating the LAST variable keeps every slice
+    offset-0 — Mosaic's sublane-broadcast lowering rejects offset slices
+    (measured: offset-1 slices fail to lower, offset-0 of any length
+    compile).  Pivot rows go to a VMEM scratch; forward substitution then
+    rebuilds x in increasing order.  ~6× fewer VPU ops than the GJ kernel
+    (Σ(n−1)² vs k·k² select+fma chains).
+    """
+    a = jnp.transpose(a_ref[...], (1, 2, 0))  # [k,k,T]
+    y = b_ref[...].T  # [k,T]
+    tr = _apply_reg(a, r_ref, k=k, reg_mode=reg_mode, lam=lam)
+    for n in range(k, 0, -1):  # static → unrolled; eliminate x_{n-1}
+        inv = 1.0 / tr[n - 1, n - 1, :]
+        yn = y[n - 1] * inv
+        y_scr[n - 1, :] = yn
+        if n > 1:
+            row = tr[n - 1, :n - 1, :] * inv[None, :]
+            col = tr[:n - 1, n - 1, :]
+            u_scr[n - 1, :n - 1, :] = row
+            tr = tr[:n - 1, :n - 1, :] - col[:, None, :] * row[None, :, :]
+            y = y[:n - 1] - col * yn[None, :]
+    x_scr[0, :] = y_scr[0, :]
+    for j in range(1, k):
+        corr = jnp.sum(u_scr[j, :j, :] * x_scr[:j, :], axis=0)
+        x_scr[j, :] = y_scr[j, :] - corr
+    x_ref[...] = x_scr[...].T
+
+
 def _gauss_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k: int, reg_mode: str,
                       lam: float):
     """Fused batch-first solve: a_ref [T,k,k], b_ref [T,k], r_ref the
@@ -118,15 +173,7 @@ def _gauss_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k: int, reg_mode: str,
     """
     a = jnp.transpose(a_ref[...], (1, 2, 0))  # [k,k,T] batch-last
     b = b_ref[...].T  # [k,T]
-    if reg_mode == "diag":
-        # [1, T] block (1-D s32 operands draw an XLA T(1024) layout Mosaic
-        # rejects; 2-D rows use the standard tiling).
-        reg = lam * jnp.maximum(r_ref[0, :].astype(jnp.float32), 1.0)  # [T]
-        r3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 0)
-        c3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 1)
-        a = a + jnp.where(r3 == c3, reg[None, None, :], 0.0)
-    else:  # matrix: one [k,k] SPD term shared across the batch (iALS)
-        a = a + r_ref[...][:, :, None]
+    a = _apply_reg(a, r_ref, k=k, reg_mode=reg_mode, lam=lam)
     rows3 = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0)
     rows2 = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)
     for j in range(k):  # k is static → fully unrolled
@@ -140,8 +187,28 @@ def _gauss_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k: int, reg_mode: str,
     x_ref[...] = b.T
 
 
+def default_reg_solve_algo() -> str:
+    """Elimination algorithm for the fused reg+solve kernel: ``"lu"``
+    (reverse-order no-pivot LU, k³/3 VPU work, rank cap 128) vs ``"gj"``
+    (Gauss-Jordan, k³, cap 64).  At k=64 they measure identically in the
+    production chunk scan (the kernel is issue-rate-bound, not FLOP-bound);
+    LU is the default because it extends the fused path to k=128 — one
+    direct solve instead of the blocked Schur composition.  gj kept for
+    A/B measurement (`perf_lab --reg-solve-algo`)."""
+    return "lu"
+
+
+def _fused_reg_rank_cap() -> int:
+    """Largest rank the fused reg+solve path handles with the DEFAULT
+    algorithm — what the dispatchers in ``ops.solve`` route on."""
+    return (
+        LU_MAX_RANK if default_reg_solve_algo() == "lu" and pltpu is not None
+        else PALLAS_MAX_RANK
+    )
+
+
 @functools.partial(
-    jax.jit, static_argnames=("reg_mode", "lam", "interpret")
+    jax.jit, static_argnames=("reg_mode", "lam", "interpret", "algo")
 )
 def gauss_solve_reg_pallas(
     a: jax.Array,  # [E, k, k] float32 Gram batch (batch-FIRST)
@@ -151,6 +218,7 @@ def gauss_solve_reg_pallas(
     reg_mode: str = "diag",
     lam: float = 0.0,
     interpret: bool | None = None,
+    algo: str | None = None,
 ) -> jax.Array:  # [E, k]
     """Regularize and solve a batch of SPD systems in one kernel pass.
 
@@ -164,9 +232,14 @@ def gauss_solve_reg_pallas(
     e, k, k2 = a.shape
     if k != k2 or b.shape != (e, k):
         raise ValueError(f"bad shapes a={a.shape} b={b.shape}")
-    if k > PALLAS_MAX_RANK:
+    if algo is None:
+        algo = default_reg_solve_algo()
+    if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
+        algo = "gj"
+    cap = LU_MAX_RANK if algo == "lu" else PALLAS_MAX_RANK
+    if k > cap:
         raise ValueError(
-            f"gauss_solve_reg_pallas supports rank <= {PALLAS_MAX_RANK}, "
+            f"gauss_solve_reg_pallas[{algo}] supports rank <= {cap}, "
             f"got {k}; use the cholesky backend"
         )
     if reg_mode == "diag":
@@ -214,15 +287,31 @@ def gauss_solve_reg_pallas(
     if pltpu is not None and not interpret:
         # The batch-first input block + its in-kernel batch-last transpose
         # both sit in VMEM through the unrolled elimination (~20 MB at
-        # k=64); the default 16 MB scoped allowance is just short.
+        # k=64, ~4× that at k=128); the default 16 MB scoped allowance is
+        # far short.
         params = getattr(pltpu, "CompilerParams", None) or getattr(
             pltpu, "TPUCompilerParams"
         )
-        kwargs["compiler_params"] = params(vmem_limit_bytes=40 * 1024 * 1024)
-    x = pl.pallas_call(
-        functools.partial(
+        kwargs["compiler_params"] = params(
+            vmem_limit_bytes=(40 if k <= 64 else 100) * 1024 * 1024
+        )
+    if algo == "lu":
+        kern = functools.partial(
+            _lu_reg_kernel, k=k, reg_mode=reg_mode, lam=lam
+        )
+        kwargs["scratch_shapes"] = [
+            pltpu.VMEM((k, k, tile), jnp.float32),
+            pltpu.VMEM((k, tile), jnp.float32),
+            pltpu.VMEM((k, tile), jnp.float32),
+        ]
+    elif algo == "gj":
+        kern = functools.partial(
             _gauss_reg_kernel, k=k, reg_mode=reg_mode, lam=lam
-        ),
+        )
+    else:
+        raise ValueError(f"unknown reg-solve algo {algo!r}")
+    x = pl.pallas_call(
+        kern,
         out_shape=out_shape,
         grid=((e_pad + tile - 1) // tile,),
         in_specs=[

@@ -318,10 +318,11 @@ def regularized_solve(
     (``gauss_solve_reg_pallas``) — the separate diagonal-add pass re-wrote
     the whole Gram batch through HBM every chunk (round-3 profile).
     """
-    from cfk_tpu.ops.pallas import PALLAS_MAX_RANK, gauss_solve_reg_pallas
+    from cfk_tpu.ops.pallas import gauss_solve_reg_pallas
+    from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
 
     k = a.shape[-1]
-    if _resolve_solver(solver) == "pallas" and k <= PALLAS_MAX_RANK:
+    if _resolve_solver(solver) == "pallas" and k <= _fused_reg_rank_cap():
         return gauss_solve_reg_pallas(
             a, b, count, reg_mode="diag", lam=float(lam)
         )
@@ -339,10 +340,11 @@ def regularized_solve_matrix(
     YᵀY + λI (Hu et al. 2008); fusing the add into the pallas solve skips
     an [E,k,k] HBM rewrite per chunk, exactly like ``regularized_solve``.
     """
-    from cfk_tpu.ops.pallas import PALLAS_MAX_RANK, gauss_solve_reg_pallas
+    from cfk_tpu.ops.pallas import gauss_solve_reg_pallas
+    from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
 
     k = a.shape[-1]
-    if _resolve_solver(solver) == "pallas" and k <= PALLAS_MAX_RANK:
+    if _resolve_solver(solver) == "pallas" and k <= _fused_reg_rank_cap():
         return gauss_solve_reg_pallas(a, b, reg, reg_mode="matrix")
     return dispatch_spd_solve(a + reg[None], b, solver)
 

@@ -338,6 +338,11 @@ def als_half_step_tiled_accum(
     # Build each slice's [h+1, k] gather window (zero row appended) ONCE,
     # outside the chunk scan — the in-body concatenate re-copied the whole
     # 17 MB slice every chunk (``pad.41``, ~25 ms/iter at full Netflix).
+    # Cost of the win: ``gz`` is a second resident copy of the fixed-side
+    # table (~61 MB bf16 for the full-Netflix user side) — accepted
+    # because accum mode's dominant allocation is the [E+1,k,k]
+    # accumulator (~290 MB there) and HBM is 16 GB; revisit before the
+    # accumulator side ever grows past HBM/3.
     # Window bases replicate the builder's clamp (`min(s·h, F−h)`,
     # blocks.py) and are static, so the windows are static slices; a chunk
     # finds its window by comparing its base against the static base list

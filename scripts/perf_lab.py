@@ -109,6 +109,8 @@ def main() -> None:
                    choices=[None, "xla", "pallas"])
     p.add_argument("--group-tiles", type=int, default=None,
                    help="pallas tiled-gram group size override")
+    p.add_argument("--reg-solve-algo", default=None, choices=[None, "gj", "lu"],
+                   help="fused reg+solve elimination algorithm override")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -134,6 +136,10 @@ def main() -> None:
         tiled_mod.default_tiled_gram_backend = (
             lambda: args.tiled_gram_backend
         )
+    if args.reg_solve_algo is not None:
+        import cfk_tpu.ops.pallas.solve_kernel as sk
+
+        sk.default_reg_solve_algo = lambda: args.reg_solve_algo
     if args.group_tiles is not None:
         import cfk_tpu.ops.pallas.gram_kernel as gk
 

@@ -94,6 +94,7 @@ def test_gram_tiles_kernel_compiled(unit_weights):
 
 @pytest.mark.parametrize("reg_mode,k,e", [
     ("diag", 64, 257), ("diag", 5, 77), ("matrix", 64, 300),
+    ("diag", 128, 200), ("matrix", 128, 137),  # LU path above the GJ cap
 ])
 def test_gauss_solve_reg_compiled(reg_mode, k, e):
     """The fused batch-first reg+solve kernel, compiled: ragged last grid
