@@ -155,7 +155,8 @@ def _train_loop(
 
 def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
                          dt, solver, algorithm, block_size, sweeps,
-                         m_chunks, u_chunks, m_entities, u_entities):
+                         m_chunks=None, u_chunks=None, m_entities=None,
+                         u_entities=None):
     """One full iALS iteration (movies from users, then users from movies) —
     the single source of the per-iteration math for the fused-loop and
     checkpointed paths (mirrors ``als._iteration_body``)."""

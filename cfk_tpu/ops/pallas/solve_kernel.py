@@ -194,8 +194,18 @@ def default_reg_solve_algo() -> str:
     production chunk scan (the kernel is issue-rate-bound, not FLOP-bound);
     LU is the default because it extends the fused path to k=128 — one
     direct solve instead of the blocked Schur composition.  gj kept for
-    A/B measurement (`perf_lab --reg-solve-algo`)."""
-    return "lu"
+    A/B measurement (`perf_lab --reg-solve-algo` or the
+    ``CFK_REG_SOLVE_ALGO`` env var, which also flips every bench.py
+    path).  The env var is read at TRACE time: set it before the first
+    solve of the process — later changes are baked out by the jit cache."""
+    import os
+
+    algo = os.environ.get("CFK_REG_SOLVE_ALGO", "lu")
+    if algo not in ("lu", "gj"):
+        raise ValueError(
+            f"CFK_REG_SOLVE_ALGO must be 'lu' or 'gj', got {algo!r}"
+        )
+    return algo
 
 
 def _fused_reg_rank_cap() -> int:

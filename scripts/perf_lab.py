@@ -111,6 +111,9 @@ def main() -> None:
                    help="pallas tiled-gram group size override")
     p.add_argument("--reg-solve-algo", default=None, choices=[None, "gj", "lu"],
                    help="fused reg+solve elimination algorithm override")
+    p.add_argument("--ials", action="store_true",
+                   help="time the implicit-feedback (iALS) iteration body")
+    p.add_argument("--alpha", type=float, default=40.0)
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -196,6 +199,15 @@ def main() -> None:
         # compile time (exactly what the real trainers avoid).
         def body(_, carry):
             u, m_prev = carry
+            if args.ials:
+                from cfk_tpu.models.ials import _ials_iteration_body
+
+                return _ials_iteration_body(
+                    u, m_prev, mblk, ublk,
+                    lam=0.05, alpha=args.alpha, dt=jax.numpy.dtype(dt),
+                    solver=args.solver, algorithm="als", block_size=32,
+                    sweeps=1, **layout_kw,
+                )
             return als_mod._iteration_body(
                 u, mblk, ublk,
                 lam=0.05, solve_chunk=None, dt=jax.numpy.dtype(dt),
