@@ -53,14 +53,24 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
-def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision):
-    # refs = (gw_ref, rt_ref, a_ref, b_ref) weighted, (rt_ref, a_ref,
-    # b_ref) unit-weight (gw ≡ g: padding gathers the zero row, so the
-    # weighted stream would be byte-identical — skip its DMA entirely).
-    if len(refs) == 4:
-        gw_ref, rt_ref, a_ref, b_ref = refs
-    else:
-        (rt_ref, a_ref, b_ref), gw_ref = refs, g_ref
+def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
+                        with_carry):
+    # refs = (gw_ref?, rt_ref, [ca_ref, cb_ref, ci_ref], a_ref, b_ref):
+    # gw present iff weighted (gw ≡ g on the unit-weight path: padding
+    # gathers the zero row, so the weighted stream would be byte-identical
+    # — skip its DMA entirely); the carry triple present iff the caller
+    # folds a previous chunk's partial (A, b) into segment 0 (stream
+    # mode's boundary straddle — doing it here is ~free, while folding it
+    # outside either rewrote the whole Gram batch through HBM or cost a
+    # separate one-system solve per chunk, 97 ms/iter at rank 128).
+    refs = list(refs)
+    a_ref, b_ref = refs[-2:]
+    del refs[-2:]
+    if with_carry:
+        ca_ref, cb_ref, ci_ref = refs[-3:]
+        del refs[-3:]
+    gw_ref = refs.pop(0) if len(refs) == 2 else g_ref
+    rt_ref = refs[0]
     gi = pl.program_id(0)
     base = gi * m
     # All m tile Grams are issued before the accumulation walk (they have
@@ -102,6 +112,14 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision):
     # callers route them to trash exactly as they did for the v1 kernel.
     began = (gi == 0) | (seg_ref[base] != seg_ref[jnp.maximum(base - 1, 0)])
     acc_a, acc_b = a_all[0], b_all[0]
+    if with_carry:
+        # Segment 0 owns the chunk's first tile whenever cin is 1 (the
+        # continued entity has entries here by definition), so adding the
+        # scaled carry into the running partial at grid step 0 lands it in
+        # segment 0's flushed row; cin = 0 multiplies it away.
+        fold = jnp.where(gi == 0, ci_ref[0, 0], 0.0)
+        acc_a = acc_a + fold * ca_ref[...]
+        acc_b = acc_b + fold * cb_ref[...]
     for i in range(1, m):  # m is static → unrolled
         change = seg_ref[base + i] != seg_ref[base + i - 1]
         prev_row = seg_ref[base + i - 1]
@@ -137,6 +155,7 @@ def gram_tiles_pallas(
     # 128→0.823 s/iter at full Netflix — 64 is the knee (128 only bloats
     # the unrolled walk and compile time)
     interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(A [num_segments, k, k] f32, b [num_segments, k] f32).
 
@@ -147,6 +166,12 @@ def gram_tiles_pallas(
     factors' natural layout.  ``gw=None`` declares all real weights are
     1.0 (explicit ALS; padding already gathers the appended zero row) and
     halves the kernel's input traffic.
+
+    ``carry = (a0 [k,k] f32, b0 [k] f32, cin scalar f32)`` adds
+    ``cin·(a0, b0)`` into segment 0's sums — the stream scan's
+    chunk-boundary straddle, folded here where it costs one fma pass per
+    group instead of an [Ec,k,k] HBM rewrite or a separate one-system
+    solve outside.
 
     Rows of segments owning no tile are UNSPECIFIED (never written) —
     callers must route them to trash (stream mode) or mask them (accum
@@ -187,6 +212,10 @@ def gram_tiles_pallas(
                                 indices_are_sorted=True)
         b = jax.ops.segment_sum(b_t, seg, num_segments=num_segments,
                                 indices_are_sorted=True)
+        if carry is not None:
+            ca, cb, ci = carry
+            a = a.at[0].add(ci * ca)
+            b = b.at[0].add(ci * cb)
         return a, b
     m = group_tiles
     while nt % m != 0:  # grid must tile exactly; m=1 always divides
@@ -203,11 +232,17 @@ def gram_tiles_pallas(
     if pltpu is None:  # pragma: no cover - non-TPU pallas build
         raise RuntimeError("pallas TPU extensions unavailable")
     fac_spec = pl.BlockSpec((m * t, k), lambda i, seg: (i, 0))
+    carry_specs = [] if carry is None else [
+        pl.BlockSpec((k, k), lambda i, seg: (0, 0)),
+        pl.BlockSpec((1, k), lambda i, seg: (0, 0)),
+        pl.BlockSpec((1, 1), lambda i, seg: (0, 0)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nt // m,),
         in_specs=([fac_spec] * (1 if gw is None else 2))
-        + [pl.BlockSpec((1, m * t), lambda i, seg: (0, i))],
+        + [pl.BlockSpec((1, m * t), lambda i, seg: (0, i))]
+        + carry_specs,
         out_specs=[
             pl.BlockSpec((num_segments, k, k), lambda i, seg: (0, 0, 0)),
             pl.BlockSpec((num_segments, 1, k), lambda i, seg: (0, 0, 0)),
@@ -234,13 +269,19 @@ def gram_tiles_pallas(
             vmem_limit_bytes=min(2 * out_bytes + 4 * in_bytes + (12 << 20),
                                  110 << 20)
         )
+    carry_ops = [] if carry is None else [
+        carry[0].astype(jnp.float32),
+        carry[1].reshape(1, k).astype(jnp.float32),
+        carry[2].reshape(1, 1).astype(jnp.float32),
+    ]
     a, b = pl.pallas_call(
         functools.partial(
-            _gram_groups_kernel, m=m, t=t, k=k, precision=precision
+            _gram_groups_kernel, m=m, t=t, k=k, precision=precision,
+            with_carry=carry is not None,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
         **kwargs,
-    )(seg, g, *([] if gw is None else [gw]), rt.reshape(1, c))
+    )(seg, g, *([] if gw is None else [gw]), rt.reshape(1, c), *carry_ops)
     return a, b[:, 0, :]

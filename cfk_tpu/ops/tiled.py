@@ -66,7 +66,7 @@ def default_tiled_gram_backend() -> str:
 
 def _entity_gram_chunk(
     fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, backend,
-    unit_weights=False, zero_appended=False,
+    unit_weights=False, zero_appended=False, carry=None,
 ):
     """One chunk's per-entity Gram/RHS: (A [num_segments, k, k], b [.., k]).
 
@@ -111,7 +111,8 @@ def _entity_gram_chunk(
         # the factors' natural layout instead (see the kernel's doc).
         gw = None if unit_weights else g * wt.astype(ct)[:, None]
         return gram_tiles_pallas(
-            g, gw, rt, seg, num_segments=num_segments, tile_rows=tile_rows
+            g, gw, rt, seg, num_segments=num_segments, tile_rows=tile_rows,
+            carry=carry,
         )
     if backend != "xla":
         raise ValueError(f"unknown tiled gram backend {backend!r}")
@@ -133,6 +134,10 @@ def _entity_gram_chunk(
     b = jax.ops.segment_sum(
         b_t, seg, num_segments=num_segments, indices_are_sorted=True
     )
+    if carry is not None:
+        ca, cb, ci = carry
+        a = a.at[0].add(ci * ca)
+        b = b.at[0].add(ci * cb)
     return a, b
 
 
@@ -234,17 +239,18 @@ def als_half_step_tiled(
     def body(carry, chunk):
         a0, b0 = carry
         nb_c, rt_c, wt_c, ts_c, ent_c, cnt_c, cin_c, lseg_c = chunk
+        # Segment 0 may continue the previous chunk's last entity; the
+        # carried partial is folded into segment 0 INSIDE the Gram kernel
+        # (one fma pass over the resident accumulator) — folding it
+        # outside either rewrote the whole [Ec,k,k] batch through HBM
+        # (~0.17 ms/chunk) or cost a separate one-system solve per chunk
+        # (~0.1 ms/chunk at rank 128).
         a, b = _entity_gram_chunk(
             fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-            unit_weights=implicit_reg is None,
+            unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
         )
-        # Segment 0 may continue the previous chunk's last entity.  Folding
-        # the carried partial into the batch via ``a.at[0].add`` rewrote the
-        # whole [Ec,k,k] Gram batch through HBM every chunk (~0.17 ms/chunk
-        # in the round-3 profile); instead the batch is solved as-is
-        # (including the trash row — solving it beats slicing it away,
-        # which copied the batch again) and segment 0 is re-solved alone
-        # with the carry applied — one [1,k,k] system and a one-row fixup.
+        # The whole batch is solved including the trash row — solving it
+        # beats slicing it away, which copied the batch again.
         if implicit_reg is None:
             cnt_full = jnp.concatenate(
                 [cnt_c, jnp.ones((1,), cnt_c.dtype)]
@@ -252,21 +258,8 @@ def als_half_step_tiled(
             x = regularized_solve(a, b, cnt_full, lam, solver)
         else:
             x = regularized_solve_matrix(a, b, implicit_reg, solver)
-        a00 = a[0] + cin_c * a0
-        b00 = b[0] + cin_c * b0
-        if implicit_reg is None:
-            x0 = regularized_solve(
-                a00[None], b00[None], cnt_c[:1], lam, solver
-            )
-        else:
-            x0 = regularized_solve_matrix(
-                a00[None], b00[None], implicit_reg, solver
-            )
-        x = x.at[0].set(x0[0])
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
         b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
-        a1 = a1 + jnp.where(lseg_c == 0, cin_c, 0.0) * a0
-        b1 = b1 + jnp.where(lseg_c == 0, cin_c, 0.0) * b0
         return (a1, b1), x[:e_c]
 
     init = jax.tree.map(
@@ -368,6 +361,13 @@ def als_half_step_tiled_accum(
         acc_a, acc_b = carry
         nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
         s_idx = jnp.sum((base_c >= bases_arr).astype(jnp.int32)) - 1
+        # The per-chunk window COPY (dynamic_index of gz, ~9 ms/iter at
+        # rank 64) is the cheap side of a measured trade: gathering
+        # straight from the flattened [n_slices·(h+1), k] table with a
+        # scalar row offset (no copy) regressed 0.71 → 1.67 s/iter —
+        # XLA's gather strategy keys on OPERAND size, and the flat table
+        # is past the ~34 MB fast-gather cliff even though each chunk
+        # only touches one window of it.
         fixed_slice = lax.dynamic_index_in_dim(
             gz, s_idx, 0, keepdims=False
         )

@@ -127,3 +127,44 @@ def test_gauss_solve_reg_compiled(reg_mode, k, e):
     resid = np.einsum("ekl,el->ek", a_reg, got) - b
     assert np.abs(resid).max() < 1e-3
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_gram_tiles_kernel_carry_compiled():
+    """The in-kernel chunk-boundary carry fold: cin scales the carried
+    (a0, b0) into segment 0's sums; cin=0 is a no-op."""
+    from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
+
+    rng = np.random.default_rng(7)
+    t, nt, k, segs = 64, 64, 32, 17
+    g = rng.standard_normal((nt * t, k)).astype(np.float32)
+    rt = rng.random(nt * t).astype(np.float32)
+    seg = np.sort(rng.integers(0, segs - 1, size=nt)).astype(np.int32)
+    seg[0] = 0  # carry semantics: segment 0 owns the first tile
+    a0 = rng.standard_normal((k, k)).astype(np.float32)
+    b0 = rng.standard_normal(k).astype(np.float32)
+    base_a, base_b = gram_tiles_pallas(
+        jnp.asarray(g), None, jnp.asarray(rt), jnp.asarray(seg),
+        num_segments=segs, tile_rows=t, interpret=False,
+    )
+    for cin in (0.0, 1.0):
+        a, b = gram_tiles_pallas(
+            jnp.asarray(g), None, jnp.asarray(rt), jnp.asarray(seg),
+            num_segments=segs, tile_rows=t, interpret=False,
+            carry=(jnp.asarray(a0), jnp.asarray(b0), jnp.float32(cin)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(a[0]), np.asarray(base_a[0]) + cin * a0,
+            rtol=2e-3, atol=2e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(b[0]), np.asarray(base_b[0]) + cin * b0,
+            rtol=2e-3, atol=2e-3,
+        )
+        # Only rows of segments that own a tile are specified; compare
+        # exactly those (minus segment 0, which carries the fold).
+        owned = np.unique(seg)
+        owned = owned[owned != 0]
+        np.testing.assert_allclose(
+            np.asarray(a)[owned], np.asarray(base_a)[owned],
+            rtol=1e-5, atol=1e-5,
+        )
