@@ -244,7 +244,9 @@ def als_half_step_tiled(
         # (one fma pass over the resident accumulator) — folding it
         # outside either rewrote the whole [Ec,k,k] batch through HBM
         # (~0.17 ms/chunk) or cost a separate one-system solve per chunk
-        # (~0.1 ms/chunk at rank 128).
+        # (~0.1 ms/chunk at rank 128).  The non-default gram_backend="xla"
+        # A/B path DOES still pay the at[0].add batch rewrite (see
+        # _entity_gram_chunk) — acceptable for a measurement-only branch.
         a, b = _entity_gram_chunk(
             fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),

@@ -50,6 +50,8 @@ def get_dataset(args):
         key["tile_rows"] = args.tile_rows
         if args.slice_rows != TILED_SLICE_ROWS_DEFAULT:
             key["slice_rows"] = args.slice_rows
+        if args.accum_chunk_elems is not None:
+            key["accum_chunk_elems"] = args.accum_chunk_elems
     tag = "_".join(f"{k}{v}" for k, v in key.items())
     path = os.path.join(CACHE_ROOT, tag)
     if os.path.exists(path):
@@ -70,7 +72,10 @@ def get_dataset(args):
         d = base.coo_dense
         mb = build_tiled_blocks(d.movie_raw, d.user_raw, d.rating,
                                 base.movie_map.num_entities, base.user_map.num_entities,
-                                tile_rows=args.tile_rows, chunk_elems=args.chunk_elems,
+                                tile_rows=args.tile_rows,
+                                chunk_elems=(args.chunk_elems
+                                             if args.accum_chunk_elems is None
+                                             else args.accum_chunk_elems),
                                 slice_rows=args.slice_rows)
         ub = build_tiled_blocks(d.user_raw, d.movie_raw, d.rating,
                                 base.user_map.num_entities, base.movie_map.num_entities,
@@ -114,6 +119,10 @@ def main() -> None:
     p.add_argument("--ials", action="store_true",
                    help="time the implicit-feedback (iALS) iteration body")
     p.add_argument("--alpha", type=float, default=40.0)
+    p.add_argument("--accum-chunk-elems", type=int, default=None,
+                   help="tiled: separate chunk size for the accum (movie) "
+                   "side — its per-chunk VMEM need is tiny, so bigger "
+                   "chunks cut scan overheads")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
