@@ -131,7 +131,13 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
         # Arithmetic select: acc·keep + a is ONE fused multiply-add per
         # vreg where where(keep, acc+a, a) costs an add AND a select —
         # the accumulation chain is the kernel's VPU hot path (~60 ns/tile
-        # over 1.8M tiles/iter at full Netflix).
+        # over 1.8M tiles/iter at full Netflix).  Failure-mode caveat: a
+        # non-finite acc (diverged factors) survives the ×0.0 reset as NaN
+        # (inf·0 = NaN), so ONE bad tile Gram poisons every later segment
+        # in the group, where a where-select would have discarded it at
+        # the boundary.  Acceptable: non-finite factors are already a
+        # broken run, and the trainers' outputs go NaN either way — this
+        # only widens the blast radius within an already-lost iteration.
         keep_f = 1.0 - change.astype(jnp.float32)
         acc_a = acc_a * keep_f + a_all[i]
         acc_b = acc_b * keep_f + b_all[i]

@@ -196,8 +196,12 @@ def default_reg_solve_algo() -> str:
     direct solve instead of the blocked Schur composition.  gj kept for
     A/B measurement (`perf_lab --reg-solve-algo` or the
     ``CFK_REG_SOLVE_ALGO`` env var, which also flips every bench.py
-    path).  The env var is read at TRACE time: set it before the first
-    solve of the process — later changes are baked out by the jit cache."""
+    path).  ``gauss_solve_reg_pallas`` resolves this default BEFORE its
+    jit boundary, so the concrete algorithm is part of the jit cache key
+    and flipping the env var (or monkeypatching this function) between
+    calls compiles the right kernel instead of silently reusing the
+    previous one.  Programs that jit a whole training step still bake the
+    value in at THEIR trace time."""
     import os
 
     algo = os.environ.get("CFK_REG_SOLVE_ALGO", "lu")
@@ -217,9 +221,6 @@ def _fused_reg_rank_cap() -> int:
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("reg_mode", "lam", "interpret", "algo")
-)
 def gauss_solve_reg_pallas(
     a: jax.Array,  # [E, k, k] float32 Gram batch (batch-FIRST)
     b: jax.Array,  # [E, k] float32
@@ -238,14 +239,38 @@ def gauss_solve_reg_pallas(
     and out — the transposes the batch-last kernels need are done in VMEM,
     so callers no longer pay the [E,k,k] HBM transpose or a separate
     regularization pass.
+
+    ``algo=None`` is resolved HERE, outside the jit boundary, so the jit
+    cache key always carries the concrete 'lu'/'gj' — flipping the
+    default between calls (env var or monkeypatch) recompiles instead of
+    silently reusing the previously traced kernel.
     """
-    e, k, k2 = a.shape
-    if k != k2 or b.shape != (e, k):
-        raise ValueError(f"bad shapes a={a.shape} b={b.shape}")
     if algo is None:
         algo = default_reg_solve_algo()
     if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
         algo = "gj"
+    return _gauss_solve_reg_pallas(
+        a, b, reg, reg_mode=reg_mode, lam=lam, interpret=interpret,
+        algo=algo,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reg_mode", "lam", "interpret", "algo")
+)
+def _gauss_solve_reg_pallas(
+    a: jax.Array,
+    b: jax.Array,
+    reg: jax.Array,
+    *,
+    reg_mode: str,
+    lam: float,
+    interpret: bool | None,
+    algo: str,
+) -> jax.Array:
+    e, k, k2 = a.shape
+    if k != k2 or b.shape != (e, k):
+        raise ValueError(f"bad shapes a={a.shape} b={b.shape}")
     cap = LU_MAX_RANK if algo == "lu" else PALLAS_MAX_RANK
     if k > cap:
         raise ValueError(

@@ -323,9 +323,20 @@ def regularized_solve(
 
     k = a.shape[-1]
     if _resolve_solver(solver) == "pallas" and k <= _fused_reg_rank_cap():
-        return gauss_solve_reg_pallas(
-            a, b, count, reg_mode="diag", lam=float(lam)
-        )
+        # The fused kernel bakes λ in as a compile-time constant; a traced
+        # lam (e.g. a per-step tuned regularizer) cannot concretize, so it
+        # takes the unfused path below — same math, one extra HBM pass —
+        # instead of a ConcretizationTypeError only the pallas path raised.
+        try:
+            lam_static = float(lam)
+        except jax.errors.ConcretizationTypeError:
+            # Only the traced case falls through; genuinely invalid lam
+            # (None, multi-element arrays) still raises at the call site.
+            lam_static = None
+        if lam_static is not None:
+            return gauss_solve_reg_pallas(
+                a, b, count, reg_mode="diag", lam=lam_static
+            )
     reg = lam * jnp.maximum(count.astype(jnp.float32), 1.0)
     a = a + reg[:, None, None] * jnp.eye(k, dtype=a.dtype)
     return dispatch_spd_solve(a, b, solver)

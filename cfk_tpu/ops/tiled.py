@@ -49,6 +49,11 @@ from cfk_tpu.ops.solve import (
 )
 
 
+_GZ_HOISTED_BUDGET_BYTES = 2 << 30  # accum-mode hoisted gather windows:
+# past ~2 GB the duplicate table stops being a rounding error next to the
+# [E+1, k, k] accumulator and the per-chunk dynamic_slice path takes over
+
+
 def default_tiled_gram_backend() -> str:
     """Tile-Gram backend: the fused pallas grouped-Gram kernel.
 
@@ -349,12 +354,21 @@ def als_half_step_tiled_accum(
     zrow = _match_varying(
         jnp.zeros((1, k), fixed_factors.dtype), fixed_factors
     )
-    gz = jnp.stack([
-        jnp.concatenate([
-            lax.slice_in_dim(fixed_factors, b, b + h), zrow
-        ])
-        for b in bases
-    ])  # [n_slices, h+1, k]
+    # The hoisted window stack is a second resident copy of the fixed
+    # table (~61 MB bf16 at full Netflix — fine next to the ~290 MB
+    # accumulator).  On corpora where it would stop being a rounding
+    # error (> _GZ_HOISTED_BUDGET_BYTES), degrade to the per-chunk
+    # dynamic_slice + concat path instead of OOMing: same math, pays the
+    # in-body slice copy the hoist was measured to save (~25 ms/iter).
+    gz_bytes = n_slices * (h + 1) * k * fixed_factors.dtype.itemsize
+    hoist = gz_bytes <= _GZ_HOISTED_BUDGET_BYTES
+    if hoist:
+        gz = jnp.stack([
+            jnp.concatenate([
+                lax.slice_in_dim(fixed_factors, b, b + h), zrow
+            ])
+            for b in bases
+        ])  # [n_slices, h+1, k]
     bases_arr = _match_varying(
         jnp.asarray(bases, jnp.int32), fixed_factors
     )
@@ -362,17 +376,22 @@ def als_half_step_tiled_accum(
     def body(carry, chunk):
         acc_a, acc_b = carry
         nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
-        s_idx = jnp.sum((base_c >= bases_arr).astype(jnp.int32)) - 1
-        # The per-chunk window COPY (dynamic_index of gz, ~9 ms/iter at
-        # rank 64) is the cheap side of a measured trade: gathering
-        # straight from the flattened [n_slices·(h+1), k] table with a
-        # scalar row offset (no copy) regressed 0.71 → 1.67 s/iter —
-        # XLA's gather strategy keys on OPERAND size, and the flat table
-        # is past the ~34 MB fast-gather cliff even though each chunk
-        # only touches one window of it.
-        fixed_slice = lax.dynamic_index_in_dim(
-            gz, s_idx, 0, keepdims=False
-        )
+        if hoist:
+            s_idx = jnp.sum((base_c >= bases_arr).astype(jnp.int32)) - 1
+            # The per-chunk window COPY (dynamic_index of gz, ~9 ms/iter
+            # at rank 64) is the cheap side of a measured trade: gathering
+            # straight from the flattened [n_slices·(h+1), k] table with a
+            # scalar row offset (no copy) regressed 0.71 → 1.67 s/iter —
+            # XLA's gather strategy keys on OPERAND size, and the flat
+            # table is past the ~34 MB fast-gather cliff even though each
+            # chunk only touches one window of it.
+            fixed_slice = lax.dynamic_index_in_dim(
+                gz, s_idx, 0, keepdims=False
+            )
+        else:
+            fixed_slice = jnp.concatenate([
+                lax.dynamic_slice_in_dim(fixed_factors, base_c, h), zrow
+            ])
         a, b = _entity_gram_chunk(
             fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None, zero_appended=True,
