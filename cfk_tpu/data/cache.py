@@ -237,3 +237,103 @@ def load_dataset(path: str, expect_build_key: dict | None = None) -> Dataset:
     # arrays file orphaned by the last rebuild forever.
     _cleanup_stale(path, keep=arrays_file)
     return ds
+
+
+def cached_scale_dataset(
+    *,
+    users: int,
+    movies: int,
+    nnz: int,
+    seed: int = 0,
+    layout: str = "tiled",
+    chunk_elems: int = 1 << 19,
+    tile_rows: int = 128,
+    slice_rows: int | None = None,
+    accum_chunk_elems: int | None = None,
+    dense_stream: bool = False,
+    cache_root: str | None = None,
+    log=print,
+) -> Dataset:
+    """Build-or-load a synthetic Netflix-shaped dataset, disk-cached.
+
+    The shared steady-state measurement path of ``scripts/perf_lab.py``
+    and ``bench.py``'s headline rows: at full-corpus shapes the host-side
+    block build costs minutes while being fully deterministic for the
+    key below, so both tools key the same cache (tag format unchanged
+    from perf_lab round 2 — existing caches keep hitting).
+    """
+    import time
+
+    from cfk_tpu.data.blocks import TILED_SLICE_ROWS_DEFAULT
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+
+    if slice_rows is None:
+        slice_rows = TILED_SLICE_ROWS_DEFAULT
+    root = cache_root or os.environ.get(
+        "CFK_PERF_CACHE", "/tmp/cfk_perf_cache"
+    )
+    key = {
+        "users": users, "movies": movies, "nnz": nnz,
+        "seed": seed, "layout": layout,
+        "chunk_elems": chunk_elems,
+    }
+    if layout == "tiled":
+        key["tile_rows"] = tile_rows
+        if slice_rows != TILED_SLICE_ROWS_DEFAULT:
+            key["slice_rows"] = slice_rows
+        if accum_chunk_elems is not None:
+            key["accum_chunk_elems"] = accum_chunk_elems
+        if dense_stream:
+            key["dense"] = 1
+    tag = "_".join(f"{k}{v}" for k, v in key.items())
+    path = os.path.join(root, tag)
+    if os.path.exists(path):
+        t0 = time.time()
+        try:
+            ds = Dataset.load(path, expect_build_key=key)
+        except (FileNotFoundError, ValueError, TypeError):
+            pass  # torn/mismatched/stale-format cache: rebuild below
+        else:
+            log(f"# dataset cache hit ({time.time()-t0:.1f}s load)",
+                flush=True)
+            return ds
+    t0 = time.time()
+    coo = synthetic_netflix_coo(users, movies, nnz, seed=seed)
+    if layout == "tiled":
+        from cfk_tpu.data.blocks import (
+            RatingsCOO,
+            build_tiled_blocks,
+            index_entities,
+        )
+
+        movie_map, m_dense = index_entities(coo.movie_raw)
+        user_map, u_dense = index_entities(coo.user_raw)
+        mb = build_tiled_blocks(
+            m_dense, u_dense, coo.rating,
+            movie_map.num_entities, user_map.num_entities,
+            tile_rows=tile_rows,
+            chunk_elems=(chunk_elems if accum_chunk_elems is None
+                         else accum_chunk_elems),
+            slice_rows=slice_rows,
+        )
+        ub = build_tiled_blocks(
+            u_dense, m_dense, coo.rating,
+            user_map.num_entities, movie_map.num_entities,
+            tile_rows=tile_rows, chunk_elems=chunk_elems,
+            slice_rows=slice_rows, dense_stream=dense_stream,
+        )
+        ds = Dataset(
+            movie_map=movie_map, user_map=user_map,
+            movie_blocks=mb, user_blocks=ub,
+            coo_dense=RatingsCOO(
+                movie_raw=m_dense.astype(np.int64),
+                user_raw=u_dense.astype(np.int64),
+                rating=coo.rating.astype(np.float32),
+            ),
+        )
+    else:
+        ds = Dataset.from_coo(coo, layout=layout, chunk_elems=chunk_elems)
+    log(f"# dataset built in {time.time()-t0:.1f}s", flush=True)
+    os.makedirs(root, exist_ok=True)
+    ds.save(path, build_key=key)
+    return ds

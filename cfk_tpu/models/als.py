@@ -158,6 +158,19 @@ def _bucketed_device_setup(dataset: Dataset):
 
 
 def _tiled_to_device(blocks: TiledBlocks) -> dict[str, jax.Array]:
+    if blocks.mode == "dstream":
+        # The dense stream has no per-entry weight channel and carries its
+        # window metadata in tile_meta — upload only what the kernel reads.
+        return {
+            "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
+            "rating": jnp.asarray(blocks.rating),
+            "tile_meta": jnp.asarray(blocks.tile_meta),
+            "chunk_entity": jnp.asarray(blocks.chunk_entity),
+            "chunk_count": jnp.asarray(blocks.chunk_count),
+            "carry_in": jnp.asarray(blocks.carry_in),
+            "last_seg": jnp.asarray(blocks.last_seg),
+            "count": jnp.asarray(blocks.count),
+        }
     return {
         "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
         "rating": jnp.asarray(blocks.rating),
@@ -235,7 +248,7 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         return als_half_step_bucketed(
             fixed, blk, chunks, entities, lam, solver=solver
         )
-    if "weight" in blk:  # tiled layout
+    if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import tiled_half_step
 
         return tiled_half_step(

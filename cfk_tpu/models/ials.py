@@ -96,9 +96,10 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
         return ials_half_step_bucketed(
             fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
         )
-    if "weight" in blk:  # tiled layout
+    if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import ials_tiled_half_step
 
+        # dstream blocks raise inside (no per-entry A-weight channel).
         return ials_tiled_half_step(
             fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
         )

@@ -145,6 +145,222 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
     flush(seg_ref[base + m - 1], began, acc_a, acc_b)
 
 
+def _gram_dense_kernel(sc_ref, g_ref, rt_ref, *refs, m, t, k, ng, nt,
+                       precision, with_carry):
+    # Dense-stream variant: tiles are [t]-row WINDOWS into the dense
+    # gathered stream at 16-aligned dynamic offsets (``pl.multiple_of``
+    # — Mosaic rejects unhinted dynamic sublane slices of bf16 refs, and
+    # sub-(16,128)-tile offsets straddle two VMEM tiles per vreg load,
+    # which measured away the whole dense-stream win), with
+    # rows outside [lo, hi) masked out of ONE dot operand (zeroed rows
+    # contribute nothing to A; the tile-aligned rt carries zeros outside
+    # the window, so b needs no mask).  Walk/flush semantics are identical
+    # to ``_gram_groups_kernel``: owners' tiles are contiguous (trash
+    # slots inherit the previous owner's seg with an empty window), rows
+    # of absent segments are never written.
+    refs = list(refs)
+    a_ref, b_ref = refs[-2:]
+    del refs[-2:]
+    if with_carry:
+        ca_ref, cb_ref, ci_ref = refs
+    gi = pl.program_id(0)
+    base = gi * m
+    s_lb, s_lo, s_hi, s_seg = ng, ng + nt, ng + 2 * nt, ng + 3 * nt
+    # Row iota hoisted out of the unrolled loop; the window test
+    # (rows >= lo) & (rows < hi) is ONE unsigned compare on (rows - lo)
+    # — the mask chain is per-tile VPU work on the walk's critical path.
+    rows = jax.lax.broadcasted_iota(jnp.int32, (t, k), 0)
+    a_all, b_all = [], []
+    for i in range(m):
+        ti = base + i
+        lb = pl.multiple_of(sc_ref[s_lb + ti], 16)
+        lo = sc_ref[s_lo + ti]
+        hi = sc_ref[s_hi + ti]
+        keep = (rows - lo).astype(jnp.uint32) < (hi - lo).astype(jnp.uint32)
+        gt = g_ref[pl.ds(lb, t), :]
+        gm = jnp.where(keep, gt, jnp.zeros_like(gt))
+        r_i = rt_ref[:, i * t:(i + 1) * t]  # [1, t]
+        a_all.append(jax.lax.dot_general(
+            gm, gt, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        ))
+        b_all.append(jax.lax.dot_general(
+            r_i.astype(gt.dtype), gt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        ))
+
+    def flush(row, began, acc_a, acc_b):
+        @pl.when(began)
+        def _assign():
+            a_ref[pl.ds(row, 1)] = acc_a[None]
+            b_ref[pl.ds(row, 1)] = acc_b[None]
+
+        @pl.when(jnp.logical_not(began))
+        def _accumulate():
+            a_ref[pl.ds(row, 1)] += acc_a[None]
+            b_ref[pl.ds(row, 1)] += acc_b[None]
+
+    seg = lambda i: sc_ref[s_seg + i]
+    began = (gi == 0) | (seg(base) != seg(jnp.maximum(base - 1, 0)))
+    acc_a, acc_b = a_all[0], b_all[0]
+    if with_carry:
+        fold = jnp.where(gi == 0, ci_ref[0, 0], 0.0)
+        acc_a = acc_a + fold * ca_ref[...]
+        acc_b = acc_b + fold * cb_ref[...]
+    for i in range(1, m):
+        change = seg(base + i) != seg(base + i - 1)
+        prev_row = seg(base + i - 1)
+
+        @pl.when(change)
+        def _flush(row=prev_row, began=began, acc_a=acc_a, acc_b=acc_b):
+            flush(row, began, acc_a, acc_b)
+
+        keep_f = 1.0 - change.astype(jnp.float32)
+        acc_a = acc_a * keep_f + a_all[i]
+        acc_b = acc_b * keep_f + b_all[i]
+        began = jnp.logical_or(began, change)
+    flush(seg(base + m - 1), began, acc_a, acc_b)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_segments", "tile_rows", "num_tiles", "num_groups",
+        "block_rows", "interpret",
+    ),
+)
+def gram_tiles_dense_pallas(
+    g: jax.Array,  # [C, k] densely packed gathered factors (bf16/f32)
+    rt: jax.Array,  # [NT·T] f32 TILE-ALIGNED b coefficients (0 off-window)
+    meta: jax.Array,  # [NG + 4·NT] int32: g_blk ‖ lb ‖ lo ‖ hi ‖ seg
+    *,
+    num_segments: int,
+    tile_rows: int,
+    num_tiles: int,  # NT (tile slots)
+    num_groups: int,  # NG (grid steps; group size m = NT // NG)
+    block_rows: int,  # BG (stream rows per pipelined block)
+    interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Dense-stream grouped Gram: the unpadded-gather variant of
+    ``gram_tiles_pallas``.
+
+    The stream ``g`` carries only real entries (16-row run alignment,
+    ~3.4% pad at Netflix shape vs 26% tile padding) — the win is on XLA's
+    row-slot-bound gather engine, which produces ``g`` upstream.  The
+    kernel pipelines ``g`` in [BG, k] blocks chosen by the per-group
+    prefetched block index ``meta[:NG]`` (the builder keeps every group's
+    tile windows inside one block), loads each tile as a [T]-row window
+    at a dynamic 16-aligned offset, and masks rows outside [lo, hi).
+    Same unwritten-absent-rows contract and chunk-boundary ``carry`` as
+    ``gram_tiles_pallas``.  See ``data.blocks._build_dense_stream`` for
+    the metadata layout and contiguity guarantees.
+    """
+    c, k = g.shape
+    t = tile_rows
+    nt, ng, bg = num_tiles, num_groups, block_rows
+    if nt % ng != 0:
+        raise ValueError(f"num_tiles {nt} not divisible by num_groups {ng}")
+    m = nt // ng
+    if rt.shape != (nt * t,):
+        raise ValueError(f"rt shape {rt.shape} != ({nt * t},)")
+    if meta.shape != (ng + 4 * nt,):
+        raise ValueError(f"meta shape {meta.shape} != ({ng + 4 * nt},)")
+    if c % bg != 0 or bg < t:
+        raise ValueError(f"stream length {c} not a multiple of block_rows "
+                         f"{bg} >= tile_rows {t}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret:
+        # Vectorized emulation (CPU tests, shard_map interpret — same vma
+        # rationale as gram_tiles_pallas): zeros for absent rows.
+        prec = (jax.lax.Precision.HIGHEST if g.dtype == jnp.float32
+                else None)
+        gblk = meta[:ng]
+        lb = meta[ng:ng + nt]
+        lo = meta[ng + nt:ng + 2 * nt]
+        hi = meta[ng + 2 * nt:ng + 3 * nt]
+        seg = meta[ng + 3 * nt:]
+        absrow = jnp.repeat(gblk, m) * bg + lb  # [NT]
+        win = absrow[:, None] + jnp.arange(t)[None, :]  # [NT, T]
+        gt = g[win]  # [NT, T, k]
+        rows = jnp.arange(t)[None, :]
+        keep = (rows >= lo[:, None]) & (rows < hi[:, None])
+        gm = jnp.where(keep[..., None], gt, jnp.zeros_like(gt))
+        a_t = jnp.einsum("ntk,ntl->nkl", gm, gt,
+                         preferred_element_type=jnp.float32, precision=prec)
+        b_t = jnp.einsum("ntk,nt->nk", gt,
+                         rt.reshape(nt, t).astype(g.dtype), precision=prec,
+                         preferred_element_type=jnp.float32)
+        a = jax.ops.segment_sum(a_t, seg, num_segments=num_segments,
+                                indices_are_sorted=True)
+        b = jax.ops.segment_sum(b_t, seg, num_segments=num_segments,
+                                indices_are_sorted=True)
+        if carry is not None:
+            ca, cb, ci = carry
+            a = a.at[0].add(ci * ca)
+            b = b.at[0].add(ci * cb)
+        return a, b
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+
+    vma = getattr(jax.typeof(g), "vma", None)
+    mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
+        lambda s, d: jax.ShapeDtypeStruct(s, d)
+    )
+    out_shape = (
+        mk((num_segments, k, k), jnp.float32),
+        mk((num_segments, 1, k), jnp.float32),
+    )
+    carry_specs = [] if carry is None else [
+        pl.BlockSpec((k, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, 1), lambda i, sc: (0, 0)),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ng,),
+        in_specs=[
+            pl.BlockSpec((bg, k), lambda i, sc: (sc[i], 0)),
+            pl.BlockSpec((1, m * t), lambda i, sc: (0, i)),
+        ] + carry_specs,
+        out_specs=[
+            pl.BlockSpec((num_segments, k, k), lambda i, sc: (0, 0, 0)),
+            pl.BlockSpec((num_segments, 1, k), lambda i, sc: (0, 0, 0)),
+        ],
+    )
+    precision = (
+        jax.lax.Precision.HIGHEST if g.dtype == jnp.float32 else None
+    )
+    out_bytes = num_segments * k * (k + 1) * 4
+    # Mosaic budgets input windows at 4 B/elem even for bf16 (measured in
+    # the compile-OOM dump), and the resident output at 2× its bytes.
+    in_bytes = 2 * (bg * k * 4 + m * t * 4)
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(2 * out_bytes + in_bytes + (10 << 20),
+                             124 << 20)
+    )}
+    carry_ops = [] if carry is None else [
+        carry[0].astype(jnp.float32),
+        carry[1].reshape(1, k).astype(jnp.float32),
+        carry[2].reshape(1, 1).astype(jnp.float32),
+    ]
+    a, b = pl.pallas_call(
+        functools.partial(
+            _gram_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt,
+            precision=precision, with_carry=carry is not None,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(meta, g, rt.reshape(1, nt * t), *carry_ops)
+    return a, b[:, 0, :]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_segments", "tile_rows", "group_tiles", "interpret"),

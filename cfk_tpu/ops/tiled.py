@@ -164,6 +164,13 @@ def tiled_half_step(
             blk["count"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
         )
+    if mode == "dstream":
+        return als_half_step_tiled_dense(
+            fixed_factors, blk["neighbor_idx"], blk["rating"],
+            blk["tile_meta"], blk["chunk_entity"], blk["chunk_count"],
+            blk["carry_in"], blk["last_seg"], local_entities, lam,
+            statics=st, solver=solver, implicit_reg=implicit_reg,
+        )
     return als_half_step_tiled(
         fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
         blk["tile_seg"], blk["chunk_entity"], blk["chunk_count"],
@@ -185,6 +192,12 @@ def ials_tiled_half_step(
     so both tile modes work unchanged with the YᵀY + λI term added at
     solve time via ``implicit_reg``.
     """
+    if chunks[1] == "dstream":
+        raise ValueError(
+            "dense-stream tiled blocks carry no per-entry A-weight channel "
+            "(unit-weight explicit ALS only); build the dataset with "
+            "dense_stream=False for iALS"
+        )
     k = fixed_factors.shape[-1]
     if gram is None:
         from cfk_tpu.ops.solve import global_gram
@@ -287,6 +300,94 @@ def als_half_step_tiled(
     )
     out = out.at[chunk_entity.reshape(nc * e_c)].set(xs.reshape(nc * e_c, k))
     return out[:local_entities]
+
+
+def als_half_step_tiled_dense(
+    fixed_factors: jax.Array,  # [F, k] full fixed side
+    neighbor_idx: jax.Array,  # [NC·C] int32 DENSE stream (pad8 → zero row)
+    rating: jax.Array,  # [NC·NT·T] f32 TILE-ALIGNED b coefficients
+    tile_meta: jax.Array,  # [NC·(NG+4·NT)] int32 per-tile window metadata
+    chunk_entity: jax.Array,  # [NC·Ec] finalization rows (trash = E_local)
+    chunk_count: jax.Array,  # [NC·Ec]
+    carry_in: jax.Array,  # [NC]
+    last_seg: jax.Array,  # [NC]
+    local_entities: int,
+    lam: float,
+    *,
+    statics: tuple[int, int, int, int, int, int, int],  # (NC,C,Ec,T,NT,NG,BG)
+    solver: str = "cholesky",
+    implicit_reg: jax.Array | None = None,
+    gram_backend: str | None = None,
+) -> jax.Array:
+    """Dense-stream tiled half-iteration (the many-entities side, unpadded).
+
+    Identical scan/carry/finalization semantics to ``als_half_step_tiled``;
+    the difference is the stream: entries are packed with only 16-row run
+    alignment (the XLA gather that feeds each chunk fetches ~nnz rows, not
+    ~1.26·nnz — the row-slot-bound gather engine is the iteration's
+    binding resource), and the pallas kernel reconstructs [T]-row tiles as
+    masked dynamic windows (``gram_tiles_dense_pallas``).  Unit-weight
+    explicit ALS only — ``ials_tiled_half_step`` steers iALS to the padded
+    stream layout."""
+    if implicit_reg is not None:
+        raise ValueError(
+            "dense-stream tiled blocks are unit-weight (explicit ALS) only"
+        )
+    backend = gram_backend or default_tiled_gram_backend()
+    nc, cap, e_c, t, nt, ng, bg = statics
+    k = fixed_factors.shape[-1]
+    ct, _ = _gram_compute_dtype(fixed_factors)
+    fz = jnp.concatenate([
+        fixed_factors,
+        _match_varying(jnp.zeros((1, k), fixed_factors.dtype), fixed_factors),
+    ])
+    chunks = (
+        neighbor_idx.reshape(nc, cap), rating.reshape(nc, nt * t),
+        tile_meta.reshape(nc, ng + 4 * nt), last_seg.reshape(nc),
+        carry_in.reshape(nc), chunk_count.reshape(nc, e_c),
+    )
+
+    def body_solve(carry, chunk):
+        a0, b0 = carry
+        nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk
+        g = fz[nb_c].astype(ct)
+        a, b = gram_tiles_dense_pallas_dispatch(
+            g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
+            num_tiles=nt, num_groups=ng, block_rows=bg,
+            carry=(a0, b0, cin_c), backend=backend,
+        )
+        cnt_full = jnp.concatenate([cnt_c, jnp.ones((1,), cnt_c.dtype)])
+        x = regularized_solve(a, b, cnt_full, lam, solver)
+        a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
+        b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
+        return (a1, b1), x[:e_c]
+
+    init = jax.tree.map(
+        lambda z: _match_varying(z, neighbor_idx),
+        (
+            jnp.zeros((k, k), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+        ),
+    )
+    _, xs = lax.scan(body_solve, init, chunks)
+    out = _match_varying(
+        jnp.zeros((local_entities + 1, k), jnp.float32), neighbor_idx
+    )
+    out = out.at[chunk_entity.reshape(nc * e_c)].set(xs.reshape(nc * e_c, k))
+    return out[:local_entities]
+
+
+def gram_tiles_dense_pallas_dispatch(g, rt, meta, *, num_segments, tile_rows,
+                                     num_tiles, num_groups, block_rows,
+                                     carry, backend):
+    """Route to the dense kernel (or its XLA emulation for A/B runs)."""
+    from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_dense_pallas
+
+    return gram_tiles_dense_pallas(
+        g, rt, meta, num_segments=num_segments, tile_rows=tile_rows,
+        num_tiles=num_tiles, num_groups=num_groups, block_rows=block_rows,
+        carry=carry, interpret=True if backend == "xla" else None,
+    )
 
 
 def als_half_step_tiled_accum(

@@ -255,6 +255,17 @@ def gathered_half(solve, *, with_gram=False, with_prev=False):
 
 def _tiled_to_tree(blocks: TiledBlocks) -> dict[str, np.ndarray]:
     """Flat per-shard tiled arrays; every leaf rows-shards over P(AXIS)."""
+    if blocks.mode == "dstream":
+        return {
+            "neighbor_idx": blocks.neighbor_idx,
+            "rating": blocks.rating,
+            "tile_meta": blocks.tile_meta,
+            "chunk_entity": blocks.chunk_entity,
+            "chunk_count": blocks.chunk_count,
+            "carry_in": blocks.carry_in,
+            "last_seg": blocks.last_seg,
+            "count": blocks.count,
+        }
     return {
         "neighbor_idx": blocks.neighbor_idx,
         "rating": blocks.rating,

@@ -52,8 +52,37 @@ def main() -> None:
         )
         mse2, _ = mse_rmse_from_blocks(resumed.predict_dense(), dataset)
         assert abs(mse - mse2) < 1e-9, (mse, mse2)
+
+    # The AT-SCALE layout across the real process boundary (the flagship
+    # config): tiled with per-half exchange="auto", and the dense-stream
+    # variant — both must reproduce the padded run's quality over the
+    # 2-process Gloo mesh, not just over single-process virtual devices.
+    import dataclasses
+
+    ds_tiled = Dataset.from_coo(
+        coo, num_shards=n, layout="tiled", ring="auto", chunk_elems=1024,
+        ring_warn=False,
+    )
+    cfg_tiled = dataclasses.replace(config, layout="tiled", exchange="auto")
+    model_t = train_als_sharded(ds_tiled, cfg_tiled, mesh)
+    mse_t, _ = mse_rmse_from_blocks(model_t.predict_dense(), ds_tiled)
+    assert abs(mse_t - mse) < 1e-3, (mse_t, mse)
+
+    ds_dense = Dataset.from_coo(
+        coo, num_shards=n, layout="tiled", chunk_elems=1024,
+        dense_stream=True, accum_max_entities=0,
+    )
+    assert ds_dense.user_blocks.mode == "dstream"
+    cfg_dense = dataclasses.replace(
+        config, layout="tiled", exchange="all_gather"
+    )
+    model_d = train_als_sharded(ds_dense, cfg_dense, mesh)
+    mse_d, _ = mse_rmse_from_blocks(model_d.predict_dense(), ds_dense)
+    assert abs(mse_d - mse) < 1e-3, (mse_d, mse)
+
     if jax.process_index() == 0:
         print(f"MULTIHOST_RESULT mse={mse:.6f} rmse={rmse:.6f} devices={n}")
+        print(f"MULTIHOST_TILED mse_auto={mse_t:.6f} mse_dense={mse_d:.6f}")
 
 
 if __name__ == "__main__":

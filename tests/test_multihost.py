@@ -54,6 +54,14 @@ def test_two_process_training_matches_single_process(tiny_coo, tmp_path):
                   "".join(outs))
     assert m, f"no result line:\n{outs[0][-2000:]}"
     mse_multi = float(m.group(1))
+    # The at-scale tiled layout (exchange="auto" + dense stream) ran across
+    # the process boundary too; the worker asserts its parity in-process
+    # and reports it here for the record.
+    mt = re.search(r"MULTIHOST_TILED mse_auto=([0-9.]+) mse_dense=([0-9.]+)",
+                   "".join(outs))
+    assert mt, f"no tiled result line:\n{outs[0][-2000:]}"
+    assert abs(float(mt.group(1)) - mse_multi) < 1e-3
+    assert abs(float(mt.group(2)) - mse_multi) < 1e-3
 
     # Single-process 8-device reference (the conftest already provides the
     # 8-virtual-device CPU platform in this process).

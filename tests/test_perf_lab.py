@@ -1,0 +1,107 @@
+"""scripts/perf_lab.py — the source of every headline perf number — gets the
+same contract protection as bench.py: the JSON row shape, the min/median
+timing math (against an injected deterministic clock), and the dataset
+cache round-trip, all on CPU with tiny shapes."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "perf_lab", os.path.join(_ROOT, "scripts", "perf_lab.py")
+)
+perf_lab = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(perf_lab)
+
+
+def _args(**over):
+    base = dict(
+        users=300, movies=80, nnz=2000, seed=0, rank=8,
+        layout="segment", chunk_elems=1024, tile_rows=16, slice_rows=None,
+        solver="cholesky", dtype="float32", gram_backend=None,
+        tiled_gram_backend=None, group_tiles=None, reg_solve_algo=None,
+        ials=False, alpha=40.0, accum_chunk_elems=None, dense_stream=False,
+        iters=2, repeats=3, profile_dir=None,
+    )
+    base.update(over)
+    import argparse
+
+    return argparse.Namespace(**base)
+
+
+def test_parser_matches_args_fixture():
+    # The fixture above must cover exactly the parser's surface, so a new
+    # flag cannot silently diverge from what run_lab is tested with.
+    ns = perf_lab.make_parser().parse_args([])
+    assert set(vars(ns)) == set(vars(_args()))
+
+
+def test_json_row_contract(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    row = perf_lab.run_lab(_args())
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == row  # last stdout line IS the row
+    for key in ("s_per_iter_min", "s_per_iter_median", "mfu",
+                "hbm_roofline_s", "gather_roofline_s", "vs_gather_roofline",
+                "layout", "rank", "iters_per_call"):
+        assert key in row, key
+    assert row["s_per_iter_min"] >= 0
+    assert row["s_per_iter_min"] <= row["s_per_iter_median"]
+    assert row["layout"] == "segment"
+
+
+def test_tiled_dense_stream_row(tmp_path, monkeypatch):
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    row = perf_lab.run_lab(_args(layout="tiled", dense_stream=True,
+                                 chunk_elems=512, repeats=2))
+    assert row["layout"] == "tiled"
+    assert row["s_per_iter_min"] >= 0
+
+
+def test_dataset_cache_round_trip(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    a = _args()
+    ds1 = perf_lab.get_dataset(a)
+    first = capsys.readouterr().out
+    assert "cache hit" not in first
+    ds2 = perf_lab.get_dataset(_args())
+    second = capsys.readouterr().out
+    assert "cache hit" in second
+    np.testing.assert_array_equal(
+        ds1.coo_dense.rating, ds2.coo_dense.rating
+    )
+
+
+def test_measure_steps_min_median_math(capsys):
+    # Deterministic clock: each timed call brackets exactly one pair of
+    # clock() reads; scripted durations 0.9, 0.3, 0.6 → min 0.3.
+    durations = iter([0.9, 0.3, 0.6])
+    now = [0.0]
+    pending = [None]
+
+    def clock():
+        if pending[0] is None:
+            pending[0] = next(durations)
+            return now[0]
+        now[0] += pending[0]
+        pending[0] = None
+        return now[0]
+
+    calls = []
+
+    def fake_steps(u, m):
+        calls.append(1)
+        return u, m
+
+    u = np.zeros((2, 2), np.float32)
+    times, *_ = perf_lab.measure_steps(
+        fake_steps, u, u, repeats=3, iters=3, clock=clock,
+    )
+    assert len(calls) == 3
+    np.testing.assert_allclose(times, [0.9, 0.3, 0.6])
+    per_iter = [t / 3 for t in times]
+    np.testing.assert_allclose(min(per_iter), 0.1)
+    np.testing.assert_allclose(sorted(per_iter)[1], 0.2)  # the reported median

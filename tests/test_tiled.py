@@ -146,6 +146,139 @@ def test_stream_mode_chunk_straddling(synth):
     np.testing.assert_allclose(got, out, rtol=2e-4, atol=2e-4)
 
 
+def test_dense_stream_matches_oracle(synth):
+    """The unpadded dense-stream layout (dstream) solves the same normal
+    equations as the padded stream — tile windows, masks, carries and the
+    balanced entity permutation included."""
+    ds = synth
+    d = ds.coo_dense
+    rng = np.random.default_rng(3)
+    M = rng.standard_normal((400, 8)).astype(np.float32)
+    ub = build_tiled_blocks(
+        d.user_raw, d.movie_raw, d.rating, 3000, 400,
+        accum_max_entities=0, chunk_elems=256, tile_rows=16,
+        dense_stream=True,
+    )
+    assert ub.mode == "dstream"
+    got = np.asarray(
+        tiled_half_step(
+            jnp.asarray(M), _tiled_to_device(ub),
+            ("tiled", ub.mode) + ub.statics,
+            ub.padded_entities, 0.05, solver="cholesky",
+        )
+    )[:3000]
+    out = np.zeros((3000, 8), np.float32)
+    for u in range(3000):
+        sel = d.user_raw == u
+        X = M[d.movie_raw[sel]]
+        A = X.T @ X + 0.05 * max(int(sel.sum()), 1) * np.eye(8, dtype=np.float32)
+        out[u] = np.linalg.solve(A, X.T @ d.rating[sel])
+    np.testing.assert_allclose(got, out, rtol=2e-4, atol=2e-4)
+
+
+def test_dense_stream_gather_slots_shrink(synth):
+    """The point of the format: gather slots ≈ nnz (16-row alignment), not
+    the padded stream's ceil(run/T)·T."""
+    from cfk_tpu.data.blocks import DENSE_STREAM_ALIGN
+
+    d = synth.coo_dense
+    nnz = d.rating.shape[0]
+    dense = build_tiled_blocks(
+        d.user_raw, d.movie_raw, d.rating, 3000, 400,
+        accum_max_entities=0, chunk_elems=2048, dense_stream=True,
+    )
+    padded = build_tiled_blocks(
+        d.user_raw, d.movie_raw, d.rating, 3000, 400,
+        accum_max_entities=0, chunk_elems=2048,
+    )
+    # Real gather slots = positions not pointing at the appended zero row
+    # (chunk-capacity tail rounding also points there, so compare those).
+    dense_real = int((dense.neighbor_idx != dense.slice_rows).sum())
+    padded_real = int((padded.neighbor_idx != padded.slice_rows).sum())
+    assert dense_real == nnz == padded_real
+    dense_cells = dense.num_chunks * dense.chunk_cap
+    padded_cells = padded.num_chunks * padded.chunk_cap
+    assert dense_cells < padded_cells  # fewer chunks × same capacity
+    # Within-stream padding obeys the alignment bound: < ALIGN extra rows
+    # per entity, plus at most one chunk of tail-capacity rounding.
+    assert (dense_cells - nnz
+            < DENSE_STREAM_ALIGN * 3000 + dense.chunk_cap)
+
+
+def test_dense_stream_multi_shard_parity(synth):
+    ds = synth
+    d = ds.coo_dense
+    rng = np.random.default_rng(4)
+    M = rng.standard_normal((400, 8)).astype(np.float32)
+    one = build_tiled_blocks(
+        d.user_raw, d.movie_raw, d.rating, 3000, 400,
+        accum_max_entities=0, chunk_elems=512, dense_stream=True,
+    )
+    x1 = np.asarray(
+        tiled_half_step(
+            jnp.asarray(M), _tiled_to_device(one),
+            ("tiled", one.mode) + one.statics,
+            one.padded_entities, 0.05,
+        )
+    )[:3000]
+    sharded = build_tiled_blocks(
+        d.user_raw, d.movie_raw, d.rating, 3000, 400, num_shards=4,
+        accum_max_entities=0, chunk_elems=512, dense_stream=True,
+    )
+    e_local = sharded.local_entities
+    outs = []
+    for s in range(4):
+        blk = {}
+        full = _tiled_to_device(sharded)
+        for key, arr in full.items():
+            n = arr.shape[0] // 4
+            blk[key] = arr[s * n:(s + 1) * n]
+        outs.append(np.asarray(
+            tiled_half_step(
+                jnp.asarray(M), blk,
+                ("tiled", sharded.mode) + sharded.statics,
+                e_local, 0.05,
+            )
+        ))
+    xs = np.concatenate(outs)[:3000]
+    np.testing.assert_allclose(xs, x1, rtol=2e-4, atol=2e-4)
+
+
+def test_dense_stream_rejects_ials(synth):
+    d = synth.coo_dense
+    ub = build_tiled_blocks(
+        d.user_raw, d.movie_raw, d.rating, 3000, 400,
+        accum_max_entities=0, chunk_elems=512, dense_stream=True,
+    )
+    from cfk_tpu.ops.tiled import ials_tiled_half_step
+
+    with pytest.raises(ValueError, match="dense-stream"):
+        ials_tiled_half_step(
+            jnp.zeros((400, 8)), _tiled_to_device(ub),
+            ("tiled", ub.mode) + ub.statics,
+            ub.padded_entities, 0.1, 2.0,
+        )
+
+
+def test_dense_stream_cache_roundtrip(tmp_path, synth):
+    ds = Dataset.from_coo(
+        synth.coo_dense, layout="tiled", chunk_elems=512,
+        accum_max_entities=0, dense_stream=True,
+    )
+    assert ds.user_blocks.mode == "dstream"
+    path = str(tmp_path / "dense_ds")
+    ds.save(path, build_key={"dense": 1})
+    loaded = Dataset.load(path, expect_build_key={"dense": 1})
+    assert loaded.user_blocks.mode == "dstream"
+    np.testing.assert_array_equal(
+        loaded.user_blocks.tile_meta, ds.user_blocks.tile_meta
+    )
+    np.testing.assert_array_equal(
+        loaded.user_blocks.neighbor_idx, ds.user_blocks.neighbor_idx
+    )
+    assert loaded.user_blocks.statics == ds.user_blocks.statics
+
+
 def test_tiny_golden_rmse():
     """Same quality bar as the reference config, through the tiled layout."""
     from cfk_tpu.data.netflix import parse_netflix
