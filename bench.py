@@ -182,20 +182,31 @@ def full_rank64_row() -> dict:
 
     users, movies, nnz = 480_189, 17_770, 100_480_507
     t0 = time.time()
-    # Measured-best chunking (r4 sweep over {64k..1M}²): 128k dense user
-    # chunks (the XLA gather engine rate RISES as chunks shrink: ~390M
-    # rows/s at 512k, ~470M at 256k) + 256k accum movie chunks.
+    # Measured-best chunking (r4 sweep over {32k..1M}²): 64k dense user
+    # chunks (the XLA gather engine rate RISES as chunks shrink — ~390M
+    # rows/s at 512k, ~470M at 256k — with the knee at 64k: 32k reverses)
+    # + 256k accum movie chunks.
     ds = cached_scale_dataset(
         users=users, movies=movies, nnz=nnz, seed=0, layout="tiled",
-        chunk_elems=131_072, accum_chunk_elems=262_144, dense_stream=True,
+        chunk_elems=65_536, accum_chunk_elems=262_144, dense_stream=True,
     )
     prep = time.time() - t0
     steady = _steady_state(ds, rank=64, iters=3, repeats=4, lam=0.05)
-    return _headline_row(
+    row = _headline_row(
         "netflix_full_rank64_steady_s_per_iteration",
         users=users, movies=movies, nnz=nnz, rank=64,
         layout_tag="tiled+dense-stream", steady=steady, prep_s=prep,
     )
+    # Gather-slot padding per half (the round-4 lever: the dense user
+    # stream carries ~3.4% padded slots vs 26% tile-padded).
+    ub, mb = ds.user_blocks, ds.movie_blocks
+    row["user_gather_pad_fraction"] = round(
+        ub.num_chunks * ub.chunk_cap / nnz - 1.0, 4
+    )
+    row["movie_gather_pad_fraction"] = round(
+        mb.num_chunks * mb.chunk_cap / nnz - 1.0, 4
+    )
+    return row
 
 
 def full_rank128_row() -> dict:
@@ -293,7 +304,7 @@ def at_scale_quick() -> dict:
 
     ds = cached_scale_dataset(
         users=users, movies=movies, nnz=nnz, seed=0, layout="tiled",
-        chunk_elems=131_072, accum_chunk_elems=262_144, dense_stream=True,
+        chunk_elems=65_536, accum_chunk_elems=262_144, dense_stream=True,
     )
     gen_s = build_s = time.time() - t0
 
