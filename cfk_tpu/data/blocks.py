@@ -725,6 +725,8 @@ class TiledBlocks:
     ring: bool = False  # built for the ppermute ring exchange
     # Dense-stream mode ("dstream") only — see _build_dense_stream:
     tile_meta: np.ndarray | None = None  # int32 [S·NC·(NG+4·NT)]
+    rating_dense: np.ndarray | None = None  # f32 [S·NC·C] stream-aligned
+    # per-entry ratings (the weighted path's A-weight source; 0 at pad)
     num_tiles: int = 0  # NT (tile slots per chunk, = NG·group_tiles)
     num_groups: int = 0  # NG (kernel grid steps per chunk)
     block_rows: int = 0  # BG (gather-stream rows per pipelined block)
@@ -779,7 +781,10 @@ def build_tiled_blocks(
     else ``stream``.  Table slicing engages only in accum mode and only
     when the padded fixed side exceeds ``slice_rows``.  ``dense_stream``
     upgrades the stream side to the unpadded dense layout
-    (``_build_dense_stream`` — unit-weight explicit ALS only).
+    (``_build_dense_stream`` — the measured explicit-ALS default at
+    scale; iALS runs it too via the weighted channels, but measured
+    slower than the padded stream at the ML-25M rank-128 target, see
+    BASELINE.md round-4 notes).
     """
     if dense_stream and not ring:
         e_l = _round_up(num_solve_entities, num_shards) // num_shards
@@ -1165,9 +1170,13 @@ def _build_dense_stream(
     every owner's tiles contiguous in the walk — the kernel contract.
     The b-side coefficients stay TILE-ALIGNED in ``rating`` ([NC·NT·T],
     zeros outside each tile's window) so b needs no in-kernel mask and no
-    dynamic lane slicing.  Unit-weight explicit ALS only: there is no
-    dense per-entry A-weight channel (iALS keeps the padded stream —
-    ``ials_tiled_half_step`` steers).
+    dynamic lane slicing.  For the WEIGHTED path (iALS) the blocks also
+    carry ``weight`` tile-aligned (1.0 at real entries — the generic mask
+    channel the iALS coefficient transform needs) and ``rating_dense``
+    aligned with the gather stream (the per-entry A-weight source: the
+    half-step premultiplies gw = g·aw in XLA, and the kernel masks the gw
+    operand of each tile Gram).  Unit-weight explicit ALS never uploads
+    those two arrays.
 
     Reference semantics unchanged: same normal equations per entity
     (``processors/MFeatureCalculator.java:85-99``), asserted equal to the
@@ -1302,6 +1311,8 @@ def _build_dense_stream(
     mw = ng + 4 * nt
     neighbor = np.full(num_shards * nc * cap, h, dtype=np.int32)
     rt_tiled = np.zeros(num_shards * nc * nt * t, dtype=np.float32)
+    wt_tiled = np.zeros(num_shards * nc * nt * t, dtype=np.float32)
+    rating_dense = np.zeros(num_shards * nc * cap, dtype=np.float32)
     tile_meta = np.zeros((num_shards, nc, mw), dtype=np.int32)
     chunk_entity = np.full(num_shards * nc * e_c, e_local, dtype=np.int32)
     chunk_count = np.zeros(num_shards * nc * e_c, dtype=np.int32)
@@ -1315,14 +1326,16 @@ def _build_dense_stream(
             continue
         base = s * nc * cap
         neighbor[base + d["dst"]] = d["fix2"].astype(np.int32)
+        rating_dense[base + d["dst"]] = d["rat2"]
 
         tc, sl = d["tile_chunk"], d["slot"]
         lbv, lov, hiv, sgv = d["lb"], d["lo"], d["hi"], d["seg_val"]
-        # Entries → tile-aligned rating slots.
+        # Entries → tile-aligned rating/weight slots.
         et = np.searchsorted(d["tile_off"], d["dst"], side="right") - 1
         row = d["dst"] - d["tile_off"][et] + lov[et]
         rt_idx = (s * nc + tc[et]) * nt * t + sl[et] * t + row
         rt_tiled[rt_idx] = d["rat2"]
+        wt_tiled[rt_idx] = 1.0
 
         meta = tile_meta[s]
         gsel = d["g_change"]
@@ -1364,7 +1377,7 @@ def _build_dense_stream(
     return TiledBlocks(
         neighbor_idx=neighbor,
         rating=rt_tiled,
-        weight=np.zeros(0, dtype=np.float32),
+        weight=wt_tiled,
         tile_seg=np.zeros(0, dtype=np.int32),
         chunk_base=np.zeros(0, dtype=np.int32),
         chunk_entity=chunk_entity,
@@ -1385,6 +1398,7 @@ def _build_dense_stream(
         num_slices=1,
         ring=False,
         tile_meta=tile_meta.reshape(-1),
+        rating_dense=rating_dense,
         num_tiles=nt,
         num_groups=ng,
         block_rows=bg,

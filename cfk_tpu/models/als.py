@@ -157,11 +157,14 @@ def _bucketed_device_setup(dataset: Dataset):
     return mblocks, ublocks, u_stats, layout_kw
 
 
-def _tiled_to_device(blocks: TiledBlocks) -> dict[str, jax.Array]:
+def _tiled_to_device(blocks: TiledBlocks, weighted: bool = False
+                     ) -> dict[str, jax.Array]:
     if blocks.mode == "dstream":
-        # The dense stream has no per-entry weight channel and carries its
-        # window metadata in tile_meta — upload only what the kernel reads.
-        return {
+        # Window metadata rides in tile_meta; upload only what the model's
+        # kernel reads — the weighted channels (tile-aligned weight +
+        # stream-aligned rating_dense, ~1 GB at full Netflix) only for
+        # iALS, never for the unit-weight explicit path.
+        d = {
             "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
             "rating": jnp.asarray(blocks.rating),
             "tile_meta": jnp.asarray(blocks.tile_meta),
@@ -171,6 +174,15 @@ def _tiled_to_device(blocks: TiledBlocks) -> dict[str, jax.Array]:
             "last_seg": jnp.asarray(blocks.last_seg),
             "count": jnp.asarray(blocks.count),
         }
+        if weighted:
+            if not blocks.weight.size or blocks.rating_dense is None:
+                raise ValueError(
+                    "these dense-stream blocks predate the weighted "
+                    "channels — rebuild the dataset (delete its cache)"
+                )
+            d["weight"] = jnp.asarray(blocks.weight)
+            d["rating_dense"] = jnp.asarray(blocks.rating_dense)
+        return d
     return {
         "neighbor_idx": jnp.asarray(blocks.neighbor_idx),
         "rating": jnp.asarray(blocks.rating),
@@ -186,8 +198,11 @@ def _tiled_to_device(blocks: TiledBlocks) -> dict[str, jax.Array]:
     }
 
 
-def _tiled_device_setup(dataset: Dataset):
-    """Single-device tiled-layout setup; statics carry ("tiled", mode, ...)."""
+def _tiled_device_setup(dataset: Dataset, weighted: bool = False):
+    """Single-device tiled-layout setup; statics carry ("tiled", mode, ...).
+
+    ``weighted=True`` (the iALS trainer) stages the dense-stream weighted
+    channels too."""
     mb, ub = dataset.movie_blocks, dataset.user_blocks
     _stats_setup_guard(mb, "tiled")
     u_stats = {
@@ -200,7 +215,8 @@ def _tiled_device_setup(dataset: Dataset):
         m_entities=mb.padded_entities,
         u_entities=ub.padded_entities,
     )
-    return _tiled_to_device(mb), _tiled_to_device(ub), u_stats, layout_kw
+    return (_tiled_to_device(mb, weighted), _tiled_to_device(ub, weighted),
+            u_stats, layout_kw)
 
 
 def _segment_device_setup(dataset: Dataset):

@@ -99,7 +99,9 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import ials_tiled_half_step
 
-        # dstream blocks raise inside (no per-entry A-weight channel).
+        # dstream blocks run the weighted dense path (gw premultiply)
+        # when staged with their weighted channels; unweighted staging
+        # raises a rebuild/steering error inside.
         return ials_tiled_half_step(
             fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
         )
@@ -220,7 +222,10 @@ def train_ials(
     elif isinstance(dataset.movie_blocks, SegmentBlocks):
         mblocks, ublocks, u_stats, layout_kw = _segment_device_setup(dataset)
     elif isinstance(dataset.movie_blocks, TiledBlocks):
-        mblocks, ublocks, u_stats, layout_kw = _tiled_device_setup(dataset)
+        mblocks, ublocks, u_stats, layout_kw = _tiled_device_setup(
+            dataset, weighted=dataset.movie_blocks.mode == "dstream"
+            or dataset.user_blocks.mode == "dstream"
+        )
     else:
         mblocks = _blocks_to_device(dataset.movie_blocks)
         ublocks = _blocks_to_device(dataset.user_blocks)
@@ -474,7 +479,12 @@ def train_ials_sharded(
 
     from cfk_tpu.parallel.spmd import gathered_layout_trees, tree_specs
 
-    gathered = gathered_layout_trees(dataset, config)
+    gathered = gathered_layout_trees(
+        dataset, config,
+        weighted=isinstance(dataset.movie_blocks, TiledBlocks)
+        and "dstream" in (dataset.movie_blocks.mode,
+                          dataset.user_blocks.mode),
+    )
     stats_init = gathered is not None  # bucketed/segment: init from stats
     step_kw = {}
     if gathered is not None:

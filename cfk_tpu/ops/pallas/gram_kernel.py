@@ -145,8 +145,8 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
     flush(seg_ref[base + m - 1], began, acc_a, acc_b)
 
 
-def _gram_dense_kernel(sc_ref, g_ref, rt_ref, *refs, m, t, k, ng, nt,
-                       precision, with_carry):
+def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
+                       precision, with_carry, weighted):
     # Dense-stream variant: tiles are [t]-row WINDOWS into the dense
     # gathered stream at 16-aligned dynamic offsets (``pl.multiple_of``
     # — Mosaic rejects unhinted dynamic sublane slices of bf16 refs, and
@@ -162,7 +162,10 @@ def _gram_dense_kernel(sc_ref, g_ref, rt_ref, *refs, m, t, k, ng, nt,
     a_ref, b_ref = refs[-2:]
     del refs[-2:]
     if with_carry:
-        ca_ref, cb_ref, ci_ref = refs
+        ca_ref, cb_ref, ci_ref = refs[-3:]
+        del refs[-3:]
+    gw_ref = refs.pop(0) if weighted else None
+    rt_ref = refs[0]
     gi = pl.program_id(0)
     base = gi * m
     s_lb, s_lo, s_hi, s_seg = ng, ng + nt, ng + 2 * nt, ng + 3 * nt
@@ -178,7 +181,11 @@ def _gram_dense_kernel(sc_ref, g_ref, rt_ref, *refs, m, t, k, ng, nt,
         hi = sc_ref[s_hi + ti]
         keep = (rows - lo).astype(jnp.uint32) < (hi - lo).astype(jnp.uint32)
         gt = g_ref[pl.ds(lb, t), :]
-        gm = jnp.where(keep, gt, jnp.zeros_like(gt))
+        # One masked operand suffices: masked rows contribute zero rank-1
+        # terms.  Weighted path masks the premultiplied gw stream (whose
+        # out-of-window rows hold OTHER entities' real weights).
+        first = gw_ref[pl.ds(lb, t), :] if weighted else gt
+        gm = jnp.where(keep, first, jnp.zeros_like(first))
         r_i = rt_ref[:, i * t:(i + 1) * t]  # [1, t]
         a_all.append(jax.lax.dot_general(
             gm, gt, (((0,), (0,)), ((), ())),
@@ -239,6 +246,7 @@ def gram_tiles_dense_pallas(
     num_tiles: int,  # NT (tile slots)
     num_groups: int,  # NG (grid steps; group size m = NT // NG)
     block_rows: int,  # BG (stream rows per pipelined block)
+    gw: jax.Array | None = None,  # [C, k] A-weighted stream (iALS); None=unit
     interpret: bool | None = None,
     carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ) -> tuple[jax.Array, jax.Array]:
@@ -271,6 +279,11 @@ def gram_tiles_dense_pallas(
                          f"{bg} >= tile_rows {t}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if gw is not None and (gw.shape != g.shape or gw.dtype != g.dtype):
+        raise ValueError(
+            f"gw must match g ({g.shape}, {g.dtype}), got "
+            f"{gw.shape}, {gw.dtype}"
+        )
     if interpret:
         # Vectorized emulation (CPU tests, shard_map interpret — same vma
         # rationale as gram_tiles_pallas): zeros for absent rows.
@@ -286,7 +299,8 @@ def gram_tiles_dense_pallas(
         gt = g[win]  # [NT, T, k]
         rows = jnp.arange(t)[None, :]
         keep = (rows >= lo[:, None]) & (rows < hi[:, None])
-        gm = jnp.where(keep[..., None], gt, jnp.zeros_like(gt))
+        first = gt if gw is None else gw[win]
+        gm = jnp.where(keep[..., None], first, jnp.zeros_like(first))
         a_t = jnp.einsum("ntk,ntl->nkl", gm, gt,
                          preferred_element_type=jnp.float32, precision=prec)
         b_t = jnp.einsum("ntk,nt->nk", gt,
@@ -317,11 +331,15 @@ def gram_tiles_dense_pallas(
         pl.BlockSpec((1, k), lambda i, sc: (0, 0)),
         pl.BlockSpec((1, 1), lambda i, sc: (0, 0)),
     ]
+    gw_specs = [] if gw is None else [
+        pl.BlockSpec((bg, k), lambda i, sc: (sc[i], 0)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(ng,),
         in_specs=[
             pl.BlockSpec((bg, k), lambda i, sc: (sc[i], 0)),
+        ] + gw_specs + [
             pl.BlockSpec((1, m * t), lambda i, sc: (0, i)),
         ] + carry_specs,
         out_specs=[
@@ -335,7 +353,7 @@ def gram_tiles_dense_pallas(
     out_bytes = num_segments * k * (k + 1) * 4
     # Mosaic budgets input windows at 4 B/elem even for bf16 (measured in
     # the compile-OOM dump), and the resident output at 2× its bytes.
-    in_bytes = 2 * (bg * k * 4 + m * t * 4)
+    in_bytes = 2 * (bg * k * 4 * (1 if gw is None else 2) + m * t * 4)
     params = getattr(pltpu, "CompilerParams", None) or getattr(
         pltpu, "TPUCompilerParams"
     )
@@ -352,12 +370,14 @@ def gram_tiles_dense_pallas(
         functools.partial(
             _gram_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt,
             precision=precision, with_carry=carry is not None,
+            weighted=gw is not None,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
         **kwargs,
-    )(meta, g, rt.reshape(1, nt * t), *carry_ops)
+    )(meta, g, *([] if gw is None else [gw]), rt.reshape(1, nt * t),
+      *carry_ops)
     return a, b[:, 0, :]
 
 

@@ -170,6 +170,7 @@ def tiled_half_step(
             blk["tile_meta"], blk["chunk_entity"], blk["chunk_count"],
             blk["carry_in"], blk["last_seg"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
+            aweight_dense=blk.get("aweight_dense"),
         )
     return als_half_step_tiled(
         fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
@@ -192,12 +193,6 @@ def ials_tiled_half_step(
     so both tile modes work unchanged with the YᵀY + λI term added at
     solve time via ``implicit_reg``.
     """
-    if chunks[1] == "dstream":
-        raise ValueError(
-            "dense-stream tiled blocks carry no per-entry A-weight channel "
-            "(unit-weight explicit ALS only); build the dataset with "
-            "dense_stream=False for iALS"
-        )
     k = fixed_factors.shape[-1]
     if gram is None:
         from cfk_tpu.ops.solve import global_gram
@@ -205,6 +200,26 @@ def ials_tiled_half_step(
         gram = global_gram(fixed_factors)
     reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
     blk = dict(blk)
+    if chunks[1] == "dstream":
+        # Dense-stream weighted path: the b-coefficient transform runs on
+        # the TILE-ALIGNED channels (rating carries r at valid slots,
+        # weight the 1.0 mask), while the A-weight α·r comes from the
+        # STREAM-ALIGNED rating_dense so the half-step can premultiply
+        # the gathered factors (gw = g·aw) for the kernel's masked
+        # operand.  Zero at pad slots either way.
+        if "rating_dense" not in blk or "weight" not in blk:
+            raise ValueError(
+                "iALS on dense-stream blocks needs the weighted channels "
+                "(rating_dense + tile-aligned weight); this dataset was "
+                "staged without them — use the iALS device setup "
+                "(weighted=True) or rebuild"
+            )
+        blk["rating"] = (1.0 + alpha * blk["rating"]) * blk["weight"]
+        blk["aweight_dense"] = alpha * blk["rating_dense"]
+        return tiled_half_step(
+            fixed_factors, blk, chunks, local_entities, lam,
+            solver=solver, implicit_reg=reg,
+        )
     blk["rating"], blk["weight"] = (
         (1.0 + alpha * blk["rating"]) * blk["weight"],
         alpha * blk["rating"],
@@ -318,6 +333,7 @@ def als_half_step_tiled_dense(
     solver: str = "cholesky",
     implicit_reg: jax.Array | None = None,
     gram_backend: str | None = None,
+    aweight_dense: jax.Array | None = None,  # [NC·C] per-entry A-weights
 ) -> jax.Array:
     """Dense-stream tiled half-iteration (the many-entities side, unpadded).
 
@@ -326,12 +342,14 @@ def als_half_step_tiled_dense(
     alignment (the XLA gather that feeds each chunk fetches ~nnz rows, not
     ~1.26·nnz — the row-slot-bound gather engine is the iteration's
     binding resource), and the pallas kernel reconstructs [T]-row tiles as
-    masked dynamic windows (``gram_tiles_dense_pallas``).  Unit-weight
-    explicit ALS only — ``ials_tiled_half_step`` steers iALS to the padded
-    stream layout."""
-    if implicit_reg is not None:
+    masked dynamic windows (``gram_tiles_dense_pallas``).  The weighted
+    path (iALS: ``implicit_reg`` + ``aweight_dense``) premultiplies the
+    gathered factors per chunk (gw = g·aw — the elementwise multiply
+    fuses into the gather) and the kernel masks the gw operand."""
+    if implicit_reg is not None and aweight_dense is None:
         raise ValueError(
-            "dense-stream tiled blocks are unit-weight (explicit ALS) only"
+            "weighted dense-stream half-step needs aweight_dense (the "
+            "per-entry A-weights aligned with the gather stream)"
         )
     backend = gram_backend or default_tiled_gram_backend()
     nc, cap, e_c, t, nt, ng, bg = statics
@@ -346,18 +364,24 @@ def als_half_step_tiled_dense(
         tile_meta.reshape(nc, ng + 4 * nt), last_seg.reshape(nc),
         carry_in.reshape(nc), chunk_count.reshape(nc, e_c),
     )
+    if implicit_reg is not None:
+        chunks = chunks + (aweight_dense.reshape(nc, cap),)
 
     def body_solve(carry, chunk):
         a0, b0 = carry
-        nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk
+        nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk[:6]
         g = fz[nb_c].astype(ct)
+        gw = None if implicit_reg is None else g * chunk[6].astype(ct)[:, None]
         a, b = gram_tiles_dense_pallas_dispatch(
             g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
-            num_tiles=nt, num_groups=ng, block_rows=bg,
+            num_tiles=nt, num_groups=ng, block_rows=bg, gw=gw,
             carry=(a0, b0, cin_c), backend=backend,
         )
-        cnt_full = jnp.concatenate([cnt_c, jnp.ones((1,), cnt_c.dtype)])
-        x = regularized_solve(a, b, cnt_full, lam, solver)
+        if implicit_reg is None:
+            cnt_full = jnp.concatenate([cnt_c, jnp.ones((1,), cnt_c.dtype)])
+            x = regularized_solve(a, b, cnt_full, lam, solver)
+        else:
+            x = regularized_solve_matrix(a, b, implicit_reg, solver)
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
         b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
         return (a1, b1), x[:e_c]
@@ -379,14 +403,14 @@ def als_half_step_tiled_dense(
 
 def gram_tiles_dense_pallas_dispatch(g, rt, meta, *, num_segments, tile_rows,
                                      num_tiles, num_groups, block_rows,
-                                     carry, backend):
+                                     carry, backend, gw=None):
     """Route to the dense kernel (or its XLA emulation for A/B runs)."""
     from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_dense_pallas
 
     return gram_tiles_dense_pallas(
         g, rt, meta, num_segments=num_segments, tile_rows=tile_rows,
         num_tiles=num_tiles, num_groups=num_groups, block_rows=block_rows,
-        carry=carry, interpret=True if backend == "xla" else None,
+        gw=gw, carry=carry, interpret=True if backend == "xla" else None,
     )
 
 

@@ -253,10 +253,11 @@ def gathered_half(solve, *, with_gram=False, with_prev=False):
     return half_prev if with_prev else half
 
 
-def _tiled_to_tree(blocks: TiledBlocks) -> dict[str, np.ndarray]:
+def _tiled_to_tree(blocks: TiledBlocks, weighted: bool = False
+                   ) -> dict[str, np.ndarray]:
     """Flat per-shard tiled arrays; every leaf rows-shards over P(AXIS)."""
     if blocks.mode == "dstream":
-        return {
+        d = {
             "neighbor_idx": blocks.neighbor_idx,
             "rating": blocks.rating,
             "tile_meta": blocks.tile_meta,
@@ -266,6 +267,15 @@ def _tiled_to_tree(blocks: TiledBlocks) -> dict[str, np.ndarray]:
             "last_seg": blocks.last_seg,
             "count": blocks.count,
         }
+        if weighted:
+            if not blocks.weight.size or blocks.rating_dense is None:
+                raise ValueError(
+                    "these dense-stream blocks predate the weighted "
+                    "channels — rebuild the dataset (delete its cache)"
+                )
+            d["weight"] = blocks.weight
+            d["rating_dense"] = blocks.rating_dense
+        return d
     return {
         "neighbor_idx": blocks.neighbor_idx,
         "rating": blocks.rating,
@@ -358,13 +368,16 @@ def half_step_tiled_ring(
     )
 
 
-def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
+def gathered_layout_trees(dataset: Dataset, config: ALSConfig,
+                          weighted: bool = False):
     """Block trees + step kwargs for the all_gather-only layouts.
 
     Returns (mtree, utree, step_kw) for bucketed/segment/tiled datasets —
     the setup shared by the explicit and implicit sharded trainers — or
     None when the dataset uses padded rectangles (caller picks
-    per-exchange).
+    per-exchange).  ``weighted=True`` (the iALS trainer) ships the
+    dense-stream weighted channels too; explicit ALS skips their ~1 GB
+    dead upload at full Netflix.
     """
     bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
     segment = isinstance(dataset.movie_blocks, SegmentBlocks)
@@ -398,8 +411,8 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig):
         mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
         utree, u_chunks = _bucketed_to_tree(dataset.user_blocks)
     elif tiled:
-        mtree = _tiled_to_tree(dataset.movie_blocks)
-        utree = _tiled_to_tree(dataset.user_blocks)
+        mtree = _tiled_to_tree(dataset.movie_blocks, weighted)
+        utree = _tiled_to_tree(dataset.user_blocks, weighted)
         m_chunks = ("tiled", dataset.movie_blocks.mode) + dataset.movie_blocks.statics
         u_chunks = ("tiled", dataset.user_blocks.mode) + dataset.user_blocks.statics
     else:

@@ -160,7 +160,8 @@ def run_lab(args) -> dict:
     elif segment:
         mblocks, ublocks, u_stats, layout_kw = als_mod._segment_device_setup(ds)
     elif args.layout == "tiled":
-        mblocks, ublocks, u_stats, layout_kw = als_mod._tiled_device_setup(ds)
+        mblocks, ublocks, u_stats, layout_kw = als_mod._tiled_device_setup(
+            ds, weighted=args.ials)
     else:
         mblocks = als_mod._blocks_to_device(ds.movie_blocks)
         ublocks = als_mod._blocks_to_device(ds.user_blocks)

@@ -244,7 +244,55 @@ def test_dense_stream_multi_shard_parity(synth):
     np.testing.assert_allclose(xs, x1, rtol=2e-4, atol=2e-4)
 
 
-def test_dense_stream_rejects_ials(synth):
+def test_dense_stream_ials_matches_padded(synth):
+    """The weighted dense path (gw premultiply + masked first operand)
+    reproduces the padded stream's iALS half-step."""
+    from cfk_tpu.ops.tiled import ials_tiled_half_step
+
+    ds = synth
+    d = ds.coo_dense
+    rng = np.random.default_rng(5)
+    M = jnp.asarray(rng.standard_normal((400, 8)).astype(np.float32))
+    outs = {}
+    for dense in (False, True):
+        ub = build_tiled_blocks(
+            d.user_raw, d.movie_raw, d.rating, 3000, 400,
+            accum_max_entities=0, chunk_elems=256, tile_rows=16,
+            dense_stream=dense,
+        )
+        assert ub.mode == ("dstream" if dense else "stream")
+        outs[dense] = np.asarray(ials_tiled_half_step(
+            M, _tiled_to_device(ub, weighted=dense),
+            ("tiled", ub.mode) + ub.statics,
+            ub.padded_entities, 0.1, 2.0,
+        ))[:3000]
+    np.testing.assert_allclose(outs[True], outs[False],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_dense_stream_ials_sharded_matches_single(synth):
+    """The weighted channels must survive the SPMD tree path: sharded
+    dense-stream iALS == single-device padded iALS."""
+    import dataclasses
+
+    from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
+    from cfk_tpu.parallel.mesh import make_mesh
+
+    ds1 = Dataset.from_coo(synth.coo_dense, layout="tiled", chunk_elems=512)
+    cfg = IALSConfig(rank=6, lam=0.1, alpha=2.0, num_iterations=2, seed=0,
+                     layout="tiled")
+    ref = train_ials(ds1, cfg).predict_dense()
+    ds4 = Dataset.from_coo(
+        synth.coo_dense, layout="tiled", chunk_elems=512, num_shards=4,
+        dense_stream=True, accum_max_entities=0,
+    )
+    assert ds4.user_blocks.mode == "dstream"
+    cfg4 = dataclasses.replace(cfg, num_shards=4)
+    got = train_ials_sharded(ds4, cfg4, make_mesh(4)).predict_dense()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_dense_stream_staging_guards(synth):
     d = synth.coo_dense
     ub = build_tiled_blocks(
         d.user_raw, d.movie_raw, d.rating, 3000, 400,
@@ -252,7 +300,8 @@ def test_dense_stream_rejects_ials(synth):
     )
     from cfk_tpu.ops.tiled import ials_tiled_half_step
 
-    with pytest.raises(ValueError, match="dense-stream"):
+    # iALS on a blk staged WITHOUT the weighted channels steers loudly.
+    with pytest.raises(ValueError, match="weighted"):
         ials_tiled_half_step(
             jnp.zeros((400, 8)), _tiled_to_device(ub),
             ("tiled", ub.mode) + ub.statics,
