@@ -102,7 +102,7 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
     }
     if ring:  # absent for non-ring keys so existing caches stay valid
         build_key["ring"] = ring
-    if dense_stream and layout in ("tiled", "auto"):
+    if dense_stream and layout == "tiled":
         # Same back-compat rule — and only for layouts that can actually
         # consume the flag: recording it for explicit padded/bucketed/
         # segment builds would spuriously invalidate their caches while
@@ -115,23 +115,54 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
         # `ials++` with a layout that invocation cannot train on.
         build_key.update(auto_key)
 
+    # For layout='auto' the dense flag only changes the blocks when the
+    # resolution lands on tiled — unknowable before the data is parsed, so
+    # the flag cannot be keyed up front (keying it on the UNRESOLVED layout
+    # spuriously invalidated pre-existing auto caches whose resolution was
+    # segment/bucketed — ADVICE r4).  Saves record the flag iff the
+    # resolved build consumed it; loads accept the flagless key too, but
+    # only when the cached dataset is NOT tiled (a flagless tiled cache is
+    # a padded build and must not serve a dense request).
+    auto_dense = dense_stream and layout == "auto"
+
     def cache_or_build(build):
         if cache_dir and os.path.exists(os.path.join(cache_dir, "meta.json")):
-            try:
-                return Dataset.load(cache_dir, expect_build_key=build_key)
-            except (ValueError, KeyError, OSError, zipfile.BadZipFile) as e:
-                # mismatched build key, or a missing/corrupt/truncated cache
-                # file: every broken-cache state self-heals via rebuild
-                _eprint(f"warning: ignoring dataset cache: {e}")
+            keys = ([{**build_key, "dense_stream": True}, build_key]
+                    if auto_dense else [build_key])
+            err = None
+            for key in keys:
+                try:
+                    ds = Dataset.load(cache_dir, expect_build_key=key)
+                except (ValueError, KeyError, OSError,
+                        zipfile.BadZipFile) as e:
+                    # mismatched build key, or a missing/corrupt/truncated
+                    # cache file: every broken-cache state self-heals via
+                    # rebuild
+                    err = e
+                    continue
+                from cfk_tpu.data.blocks import TiledBlocks
+
+                if (auto_dense and "dense_stream" not in key
+                        and isinstance(ds.user_blocks, TiledBlocks)):
+                    err = ValueError(
+                        "cached auto-layout dataset resolved to tiled "
+                        "without the dense stream; dense run rebuilds"
+                    )
+                    continue
+                return ds
+            _eprint(f"warning: ignoring dataset cache: {err}")
         coo = build()
         resolved = auto_resolver(coo) if layout == "auto" else layout
+        use_dense = dense_stream and resolved == "tiled"
         ds = Dataset.from_coo(
             coo, num_shards=num_shards, pad_multiple=pad_multiple,
             layout=resolved, chunk_elems=chunk_elems, ring=ring,
-            dense_stream=dense_stream and resolved == "tiled",
+            dense_stream=use_dense,
         )
         if cache_dir:
-            ds.save(cache_dir, build_key=build_key)
+            key = ({**build_key, "dense_stream": True}
+                   if auto_dense and use_dense else build_key)
+            ds.save(cache_dir, build_key=key)
         return ds
 
     if path.startswith("tcp://"):
@@ -153,7 +184,8 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
             # the offset freshness check (which needs the broker).  The
             # non-offset key fields must still match exactly.
             ds = _cache_sans_fingerprint(cache_dir, build_key, Dataset,
-                                         ignore=("end_offsets",))
+                                         ignore=("end_offsets",),
+                                         auto_dense=auto_dense)
             if ds is not None:
                 _eprint(
                     f"warning: broker unreachable ({e}); using dataset cache "
@@ -175,7 +207,7 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
                     # cache is the only way to train; offsets unverifiable.
                     ds = _cache_sans_fingerprint(
                         cache_dir, build_key, Dataset,
-                        ignore=("end_offsets",))
+                        ignore=("end_offsets",), auto_dense=auto_dense)
                     if ds is not None:
                         _eprint(
                             f"warning: topic unavailable ({e}); using "
@@ -192,7 +224,8 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
         # Source file gone (archived/deleted after caching) — a cache whose
         # key matches on everything but the file fingerprint still trains.
         ds = _cache_sans_fingerprint(cache_dir, build_key, Dataset,
-                                     ignore=("data_size", "data_mtime_ns"))
+                                     ignore=("data_size", "data_mtime_ns"),
+                                     auto_dense=auto_dense)
         if ds is not None:
             _eprint(
                 f"warning: data file {path!r} not found; using dataset "
@@ -204,10 +237,17 @@ def _load_dataset(path, fmt, min_rating, num_shards, pad_multiple, layout="padde
     return cache_or_build(lambda: parse_movielens_csv(path, min_rating=min_rating))
 
 
-def _cache_sans_fingerprint(cache_dir, build_key, Dataset, ignore):
+def _cache_sans_fingerprint(cache_dir, build_key, Dataset, ignore,
+                            auto_dense=False):
     """Load a cache whose content fingerprint cannot be recomputed (broker
     unreachable, source file deleted), if the stored build key matches ours
-    on every field outside ``ignore``."""
+    on every field outside ``ignore``.
+
+    ``auto_dense`` applies the same dual-key rule as the online path: a
+    layout='auto' + dense_stream run matches a stored key WITH the
+    ``dense_stream`` flag (its own prior dense-resolved-tiled save) or one
+    without it — but a flagless cache that turns out to be tiled is a
+    padded-stream build and must not serve a dense request."""
     import os
     import zipfile
 
@@ -220,9 +260,17 @@ def _cache_sans_fingerprint(cache_dir, build_key, Dataset, ignore):
         if stored is None:
             return None
         strip = lambda k: {x: v for x, v in k.items() if x not in ignore}
-        if strip(stored) != strip(build_key):
+        s, b = strip(stored), strip(build_key)
+        flagged_ok = auto_dense and s == {**b, "dense_stream": True}
+        if s != b and not flagged_ok:
             return None
-        return Dataset.load(cache_dir, expect_build_key=stored)
+        ds = Dataset.load(cache_dir, expect_build_key=stored)
+        if auto_dense and not flagged_ok:
+            from cfk_tpu.data.blocks import TiledBlocks
+
+            if isinstance(ds.user_blocks, TiledBlocks):
+                return None
+        return ds
     except (ValueError, KeyError, OSError, zipfile.BadZipFile):
         return None
 

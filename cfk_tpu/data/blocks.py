@@ -1500,7 +1500,14 @@ class Dataset:
         ring more).  At Netflix shape that is ring movie-half (rotate
         480k-user blocks instead of all_gathering 61 MB) + all_gather
         user-half (whose ring accumulator would be ~1 GB), the optimum
-        the exchange comparison identifies (BASELINE.md)."""
+        the exchange comparison identifies (BASELINE.md).
+
+        ``dense_stream`` (tiled layout) upgrades each STREAM-mode half to
+        the unpadded dense layout; a half that runs in accum mode (its
+        per-shard solve entities fit ``accum_max_entities`` — e.g. the
+        movie half at Netflix shape) keeps the accum layout by design, and
+        ring halves carry the accum machinery too, so ``ring=True`` +
+        ``dense_stream=True`` leaves no half for the flag and warns."""
         movie_map, m_dense = index_entities(coo.movie_raw)
         user_map, u_dense = index_entities(coo.user_raw)
         if layout == "bucketed":
@@ -1603,6 +1610,21 @@ class Dataset:
                             "strictly better there; consider ring='auto'",
                             stacklevel=2,
                         )
+            if dense_stream and m_ring and u_ring and ring_warn:
+                # Ring halves carry the accum machinery (per-slice sweeps
+                # need the per-entity accumulator), so with BOTH resolved
+                # halves ring-built the dense-stream request has no half to
+                # apply to — warn instead of silently dropping it
+                # (ADVICE r4); the per-half accum fallback is documented in
+                # the docstring above.
+                import warnings
+
+                warnings.warn(
+                    "dense_stream=True is ignored: both halves are "
+                    "ring-built (ring implies the accum machinery); build "
+                    "with ring=False/'auto' or drop dense_stream",
+                    stacklevel=2,
+                )
             movie_blocks = build(
                 m_dense, u_dense, coo.rating,
                 movie_map.num_entities, user_map.num_entities, ring=m_ring,

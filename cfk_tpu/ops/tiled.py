@@ -71,7 +71,7 @@ def default_tiled_gram_backend() -> str:
 
 def _entity_gram_chunk(
     fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, backend,
-    unit_weights=False, zero_appended=False, carry=None,
+    unit_weights=False, zero_appended=False, carry=None, stage="full",
 ):
     """One chunk's per-entity Gram/RHS: (A [num_segments, k, k], b [.., k]).
 
@@ -101,6 +101,21 @@ def _entity_gram_chunk(
             _match_varying(jnp.zeros((1, k), fixed_slice.dtype), fixed_slice),
         ])
     g = fz[nb].astype(ct)  # [C, k]
+    if stage == "gather":
+        # Measurement probe (``tiled_half_step(stage=...)``): stop after
+        # the gather (+ the weighted premultiply the production path pays)
+        # and fold everything into a scalar so nothing is dead-code
+        # eliminated — the full-array reduce is negligible next to the
+        # row-slot-bound gather it sinks.  The weighted path sinks BOTH
+        # streams: production materializes g and gw as separate kernel
+        # operands, and sinking only gw would let XLA fuse gather+multiply
+        # into one output buffer the production path doesn't get.
+        sink = jnp.sum(g.astype(jnp.float32))
+        if not unit_weights:
+            sink = sink + jnp.sum(
+                (g * wt.astype(ct)[:, None]).astype(jnp.float32)
+            )
+        return sink, None
     if backend == "pallas" and 2 * num_segments * k * (k + 1) * 4 > (96 << 20):
         # The kernel keeps the whole (A, b) chunk output resident in VMEM
         # (double-buffered); past ~96 MB it cannot compile.  Dense shapes
@@ -148,12 +163,21 @@ def _entity_gram_chunk(
 
 def tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, *,
-    solver="cholesky", implicit_reg=None,
+    solver="cholesky", implicit_reg=None, stage="full",
 ):
     """Mode dispatch shared by the single-device and SPMD trainers.
 
     ``chunks`` is the static tuple ``("tiled", mode, *statics)`` the layout
     setup emits; ``blk`` the device-array dict of ``TiledBlocks`` fields.
+
+    ``stage`` (static; measurement hook for ``scripts/decompose.py``) stops
+    the half-step after a prefix of its pipeline and returns a [1, 1] f32
+    sink instead of factors, so each term of an iteration can be timed as
+    the LITERAL production ops (VERDICT r4 #4): ``"gather"`` = the per-chunk
+    neighbor-factor gather (incl. the weighted premultiply where the
+    production path pays it), ``"gram"`` = gather + the fused Gram kernel
+    with carry threading, ``"accum"`` (accum mode only) = everything but
+    the final solve.  ``"full"`` (default) is the unchanged production path.
     """
     mode = chunks[1]
     st = tuple(chunks[2:])
@@ -163,6 +187,7 @@ def tiled_half_step(
             blk["tile_seg"], blk["chunk_base"], blk["chunk_entity"],
             blk["count"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
+            stage=stage,
         )
     if mode == "dstream":
         return als_half_step_tiled_dense(
@@ -170,19 +195,19 @@ def tiled_half_step(
             blk["tile_meta"], blk["chunk_entity"], blk["chunk_count"],
             blk["carry_in"], blk["last_seg"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
-            aweight_dense=blk.get("aweight_dense"),
+            aweight_dense=blk.get("aweight_dense"), stage=stage,
         )
     return als_half_step_tiled(
         fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
         blk["tile_seg"], blk["chunk_entity"], blk["chunk_count"],
         blk["carry_in"], blk["last_seg"], local_entities, lam,
-        statics=st, solver=solver, implicit_reg=implicit_reg,
+        statics=st, solver=solver, implicit_reg=implicit_reg, stage=stage,
     )
 
 
 def ials_tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, alpha, *,
-    gram=None, solver="cholesky",
+    gram=None, solver="cholesky", stage="full",
 ):
     """Implicit-feedback (Hu et al. 2008) half-iteration on tiled blocks.
 
@@ -218,7 +243,7 @@ def ials_tiled_half_step(
         blk["aweight_dense"] = alpha * blk["rating_dense"]
         return tiled_half_step(
             fixed_factors, blk, chunks, local_entities, lam,
-            solver=solver, implicit_reg=reg,
+            solver=solver, implicit_reg=reg, stage=stage,
         )
     blk["rating"], blk["weight"] = (
         (1.0 + alpha * blk["rating"]) * blk["weight"],
@@ -226,7 +251,7 @@ def ials_tiled_half_step(
     )
     return tiled_half_step(
         fixed_factors, blk, chunks, local_entities, lam,
-        solver=solver, implicit_reg=reg,
+        solver=solver, implicit_reg=reg, stage=stage,
     )
 
 
@@ -247,6 +272,7 @@ def als_half_step_tiled(
     solver: str = "cholesky",
     implicit_reg: jax.Array | None = None,  # [k,k] YᵀY+λI (iALS); None = ALS-WR
     gram_backend: str | None = None,
+    stage: str = "full",
 ) -> jax.Array:
     """Stream-mode tiled half-iteration (the many-entities side).
 
@@ -268,6 +294,36 @@ def als_half_step_tiled(
         chunk_entity.reshape(nc, e_c), chunk_count.reshape(nc, e_c),
         carry_in.reshape(nc), last_seg.reshape(nc),
     )
+
+    if stage != "full":
+        if stage not in ("gather", "gram"):
+            raise ValueError(f"stream mode has no stage {stage!r}")
+
+        def probe(carry, chunk):
+            acc, a0, b0 = carry
+            nb_c, rt_c, wt_c, ts_c, ent_c, cnt_c, cin_c, lseg_c = chunk
+            if stage == "gather":
+                s, _ = _entity_gram_chunk(
+                    fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
+                    backend, unit_weights=implicit_reg is None,
+                    stage="gather",
+                )
+                return (acc + s, a0, b0), None
+            a, b = _entity_gram_chunk(
+                fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+            )
+            a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
+            b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
+            return (acc + a[0, 0, 0] + b[0, 0], a1, b1), None
+
+        init = jax.tree.map(
+            lambda z: _match_varying(z, neighbor_idx),
+            (jnp.zeros((), jnp.float32), jnp.zeros((k, k), jnp.float32),
+             jnp.zeros((k,), jnp.float32)),
+        )
+        (acc, _, _), _ = lax.scan(probe, init, chunks)
+        return acc.reshape(1, 1)
 
     def body(carry, chunk):
         a0, b0 = carry
@@ -334,6 +390,7 @@ def als_half_step_tiled_dense(
     implicit_reg: jax.Array | None = None,
     gram_backend: str | None = None,
     aweight_dense: jax.Array | None = None,  # [NC·C] per-entry A-weights
+    stage: str = "full",
 ) -> jax.Array:
     """Dense-stream tiled half-iteration (the many-entities side, unpadded).
 
@@ -366,6 +423,40 @@ def als_half_step_tiled_dense(
     )
     if implicit_reg is not None:
         chunks = chunks + (aweight_dense.reshape(nc, cap),)
+
+    if stage != "full":
+        if stage not in ("gather", "gram"):
+            raise ValueError(f"dstream mode has no stage {stage!r}")
+
+        def probe(carry, chunk):
+            acc, a0, b0 = carry
+            nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk[:6]
+            g = fz[nb_c].astype(ct)
+            gw = (None if implicit_reg is None
+                  else g * chunk[6].astype(ct)[:, None])
+            if stage == "gather":
+                # Weighted path: production materializes BOTH streams (g
+                # and gw are separate kernel operands), so sink both.
+                s = jnp.sum(g.astype(jnp.float32))
+                if gw is not None:
+                    s = s + jnp.sum(gw.astype(jnp.float32))
+                return (acc + s, a0, b0), None
+            a, b = gram_tiles_dense_pallas_dispatch(
+                g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
+                num_tiles=nt, num_groups=ng, block_rows=bg, gw=gw,
+                carry=(a0, b0, cin_c), backend=backend,
+            )
+            a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
+            b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
+            return (acc + a[0, 0, 0] + b[0, 0], a1, b1), None
+
+        init = jax.tree.map(
+            lambda z: _match_varying(z, neighbor_idx),
+            (jnp.zeros((), jnp.float32), jnp.zeros((k, k), jnp.float32),
+             jnp.zeros((k,), jnp.float32)),
+        )
+        (acc, _, _), _ = lax.scan(probe, init, chunks)
+        return acc.reshape(1, 1)
 
     def body_solve(carry, chunk):
         a0, b0 = carry
@@ -430,6 +521,7 @@ def als_half_step_tiled_accum(
     solver: str = "cholesky",
     implicit_reg: jax.Array | None = None,
     gram_backend: str | None = None,
+    stage: str = "full",
 ) -> jax.Array:
     """Accumulator-mode tiled half-iteration (the few-entities side).
 
@@ -498,9 +590,7 @@ def als_half_step_tiled_accum(
         jnp.asarray(bases, jnp.int32), fixed_factors
     )
 
-    def body(carry, chunk):
-        acc_a, acc_b = carry
-        nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
+    def select_window(base_c):
         if hoist:
             s_idx = jnp.sum((base_c >= bases_arr).astype(jnp.int32)) - 1
             # The per-chunk window COPY (dynamic_index of gz, ~9 ms/iter
@@ -517,6 +607,43 @@ def als_half_step_tiled_accum(
             fixed_slice = jnp.concatenate([
                 lax.dynamic_slice_in_dim(fixed_factors, base_c, h), zrow
             ])
+        return fixed_slice
+
+    if stage == "gather":
+        def probe(acc, chunk):
+            nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
+            s, _ = _entity_gram_chunk(
+                select_window(base_c), nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
+                backend, unit_weights=implicit_reg is None,
+                zero_appended=True, stage="gather",
+            )
+            return acc + s, None
+
+        init = _match_varying(jnp.zeros((), jnp.float32), neighbor_idx)
+        acc, _ = lax.scan(probe, init, chunks)
+        return acc.reshape(1, 1)
+    if stage == "gram":
+        def probe(acc, chunk):
+            nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
+            a, b = _entity_gram_chunk(
+                select_window(base_c), nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
+                backend, unit_weights=implicit_reg is None,
+                zero_appended=True,
+            )
+            # a[0] rows may be unwritten garbage for absent ranks in other
+            # chunks, but rank 0 always owns the chunk's first tile.
+            return acc + a[0, 0, 0] + b[0, 0], None
+
+        init = _match_varying(jnp.zeros((), jnp.float32), neighbor_idx)
+        acc, _ = lax.scan(probe, init, chunks)
+        return acc.reshape(1, 1)
+    if stage not in ("accum", "full"):
+        raise ValueError(f"accum mode has no stage {stage!r}")
+
+    def body(carry, chunk):
+        acc_a, acc_b = carry
+        nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
+        fixed_slice = select_window(base_c)
         a, b = _entity_gram_chunk(
             fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None, zero_appended=True,
@@ -536,6 +663,8 @@ def als_half_step_tiled_accum(
         ),
     )
     (acc_a, acc_b), _ = lax.scan(body, init, chunks)
+    if stage == "accum":  # everything but the final solve
+        return (acc_a[0, 0, 0] + acc_b[0, 0]).reshape(1, 1)
     a, b = acc_a[:local_entities], acc_b[:local_entities]
     if implicit_reg is None:
         return regularized_solve(a, b, count, lam, solver)

@@ -1,0 +1,75 @@
+"""The tiled half-step ``stage`` measurement hook (scripts/decompose.py):
+every probe stage must run the production prefix and return a finite [1, 1]
+sink, on all three tiled modes, explicit and weighted — so the on-chip
+decomposition never diverges from code that actually trains."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cfk_tpu.data.blocks import Dataset
+from cfk_tpu.data.synthetic import synthetic_netflix_coo
+from cfk_tpu.models import als as als_mod
+from cfk_tpu.ops.tiled import ials_tiled_half_step, tiled_half_step
+
+
+@pytest.fixture(scope="module")
+def staged():
+    coo = synthetic_netflix_coo(900, 70, 20_000, seed=3)
+    # Small accum cap forces the user half into stream mode while the
+    # movie half stays accum — both scan structures exercised; dense
+    # stream on the user half via dense_stream=True.
+    ds = Dataset.from_coo(coo, layout="tiled", chunk_elems=2048,
+                          accum_max_entities=256, dense_stream=True)
+    mblocks, ublocks, _, kw = als_mod._tiled_device_setup(ds, weighted=True)
+    assert kw["m_chunks"][1] == "accum"
+    assert kw["u_chunks"][1] == "dstream"
+    return ds, mblocks, ublocks, kw
+
+
+@pytest.mark.parametrize("half", ["movie", "user"])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_probe_stages_run_and_are_finite(staged, half, weighted):
+    ds, mblocks, ublocks, kw = staged
+    k = 8
+    u = jnp.ones((ds.user_blocks.padded_entities, k), jnp.float32) * 0.1
+    m = jnp.ones((ds.movie_blocks.padded_entities, k), jnp.float32) * 0.1
+    blk = mblocks if half == "movie" else ublocks
+    chunks = kw["m_chunks" if half == "movie" else "u_chunks"]
+    ents = kw["m_entities" if half == "movie" else "u_entities"]
+    fixed = u if half == "movie" else m
+    stages = ["gather", "gram"] + (["accum"] if chunks[1] == "accum" else [])
+    for stage in stages:
+        if weighted:
+            x = ials_tiled_half_step(fixed, blk, chunks, ents, 0.1, 40.0,
+                                     solver="cholesky", stage=stage)
+        else:
+            x = tiled_half_step(fixed, blk, chunks, ents, 0.05,
+                                solver="cholesky", stage=stage)
+        assert x.shape == (1, 1), stage
+        assert np.isfinite(np.asarray(x)).all(), stage
+
+
+def test_unknown_stage_rejected(staged):
+    ds, mblocks, ublocks, kw = staged
+    u = jnp.ones((ds.user_blocks.padded_entities, 8), jnp.float32)
+    m = jnp.ones((ds.movie_blocks.padded_entities, 8), jnp.float32)
+    with pytest.raises(ValueError, match="stage"):
+        tiled_half_step(u, mblocks, kw["m_chunks"], kw["m_entities"], 0.05,
+                        stage="bogus")
+    with pytest.raises(ValueError, match="stage"):
+        tiled_half_step(m, ublocks, kw["u_chunks"], kw["u_entities"], 0.05,
+                        stage="bogus")
+
+
+def test_stage_full_unchanged(staged):
+    """stage='full' must be the production path byte-for-byte (the hook is
+    measurement-only): same factors as calling without the parameter."""
+    ds, mblocks, ublocks, kw = staged
+    k = 8
+    u = jnp.ones((ds.user_blocks.padded_entities, k), jnp.float32) * 0.1
+    base = tiled_half_step(u, mblocks, kw["m_chunks"], kw["m_entities"], 0.05,
+                           solver="cholesky")
+    full = tiled_half_step(u, mblocks, kw["m_chunks"], kw["m_entities"], 0.05,
+                           solver="cholesky", stage="full")
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(full))

@@ -61,7 +61,8 @@ def test_planted_recovery_sharded_ring(planted):
     from cfk_tpu.parallel.spmd import train_als_sharded
 
     train, held = planted
-    ds = Dataset.from_coo(train, layout="tiled", num_shards=4, ring=True)
+    ds = Dataset.from_coo(train, layout="tiled", num_shards=4, ring=True,
+                          ring_warn=False)
     cfg = ALSConfig(rank=16, lam=0.005, num_iterations=10, seed=0,
                     layout="tiled", dtype="bfloat16", num_shards=4,
                     exchange="ring")

@@ -405,7 +405,8 @@ def test_ring_tiled_matches_allgather(synth):
                      layout="tiled", solver="cholesky")
     ref = train_als(Dataset.from_coo(coo, layout="tiled"), cfg1).predict_dense()
     cfg4 = dataclasses.replace(cfg1, num_shards=4, exchange="ring")
-    ds4 = Dataset.from_coo(coo, layout="tiled", num_shards=4, ring=True)
+    ds4 = Dataset.from_coo(coo, layout="tiled", num_shards=4, ring=True,
+                           ring_warn=False)
     assert ds4.movie_blocks.ring and ds4.user_blocks.ring
     assert ds4.movie_blocks.num_slices == 4
     got = train_als_sharded(ds4, cfg4, make_mesh(4)).predict_dense()
@@ -429,7 +430,8 @@ def test_ring_config_dataset_mismatch_rejected(synth):
                          layout="tiled", exchange="ring", solver="cholesky")
     with pytest.raises(ValueError, match="ring"):
         train_als_sharded(ds_ag, cfg_ring, mesh)
-    ds_ring = Dataset.from_coo(coo, layout="tiled", num_shards=4, ring=True)
+    ds_ring = Dataset.from_coo(coo, layout="tiled", num_shards=4,
+                               ring=True, ring_warn=False)
     cfg_ag = dataclasses.replace(cfg_ring, exchange="all_gather")
     with pytest.raises(ValueError, match="ring"):
         train_als_sharded(ds_ring, cfg_ag, mesh)
@@ -469,7 +471,7 @@ def test_oversized_ring_half_refused():
     coo = synthetic_netflix_coo(500, 60, 5_000, seed=2)
     with pytest.raises(ValueError, match="auto"):
         Dataset.from_coo(coo, layout="tiled", num_shards=4, ring=True,
-                         accum_max_entities=100)
+                         accum_max_entities=100, ring_warn=False)
 
 
 def test_ring_requires_tiled_layout():
