@@ -313,11 +313,17 @@ def _train(args) -> int:
                 "solve_chunk": args.solve_chunk,
             },
             # The unpadded dense gather stream is the measured at-scale
-            # default for explicit unit-weight ALS (0.707 → 0.652 s/iter
-            # full Netflix rank 64); iALS needs the per-entry weight
-            # channel the padded stream carries.
-            dense_stream=(args.algorithm == "als"
-                          and not getattr(args, "implicit", False)),
+            # default for BOTH models (round 5): explicit ALS 0.707 →
+            # 0.652 s/iter full Netflix rank 64 (round 4), and — with the
+            # sqrt-reparameterized weight stream replacing round 4's
+            # premultiplied second stream — iALS ML-25M rank 128 0.662 →
+            # 0.630 s/iter (the dense builder always stages the
+            # rating_dense channel the weighted path needs).  Subspace
+            # optimizers (als++/ials++) use padded/bucketed layouts, and
+            # an explicit --exchange ring build carries the accum
+            # machinery on both halves — the flag has no half to apply to
+            # there, so don't request it (avoids the builder's warning).
+            dense_stream=args.exchange != "ring",
         )
     if args.layout == "auto":
         # Reflect what _resolve_auto_layout (or a cache hit) actually built,

@@ -1610,13 +1610,16 @@ class Dataset:
                             "strictly better there; consider ring='auto'",
                             stacklevel=2,
                         )
-            if dense_stream and m_ring and u_ring and ring_warn:
+            if dense_stream and m_ring and u_ring and ring_warn \
+                    and ring != "auto":
                 # Ring halves carry the accum machinery (per-slice sweeps
                 # need the per-entity accumulator), so with BOTH resolved
                 # halves ring-built the dense-stream request has no half to
                 # apply to — warn instead of silently dropping it
                 # (ADVICE r4); the per-half accum fallback is documented in
-                # the docstring above.
+                # the docstring above.  ring='auto' is exempt: there the
+                # ring resolution is the requested memory optimum, not a
+                # user error the warning could correct.
                 import warnings
 
                 warnings.warn(

@@ -55,21 +55,21 @@ except ImportError:  # pragma: no cover
 
 def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
                         with_carry):
-    # refs = (gw_ref?, rt_ref, [ca_ref, cb_ref, ci_ref], a_ref, b_ref):
-    # gw present iff weighted (gw ≡ g on the unit-weight path: padding
-    # gathers the zero row, so the weighted stream would be byte-identical
-    # — skip its DMA entirely); the carry triple present iff the caller
-    # folds a previous chunk's partial (A, b) into segment 0 (stream
-    # mode's boundary straddle — doing it here is ~free, while folding it
-    # outside either rewrote the whole Gram batch through HBM or cost a
-    # separate one-system solve per chunk, 97 ms/iter at rank 128).
+    # refs = (rt_ref, [ca_ref, cb_ref, ci_ref], a_ref, b_ref): the carry
+    # triple present iff the caller folds a previous chunk's partial
+    # (A, b) into segment 0 (stream mode's boundary straddle — doing it
+    # here is ~free, while folding it outside either rewrote the whole
+    # Gram batch through HBM or cost a separate one-system solve per
+    # chunk, 97 ms/iter at rank 128).  Per-entry weights are expressed
+    # upstream as the sqrt-reparameterized stream (g = √w·f — see
+    # ``ops.tiled.ials_tiled_half_step``), so ONE stream serves both
+    # weight modes; round 4's second premultiplied gw stream is gone.
     refs = list(refs)
     a_ref, b_ref = refs[-2:]
     del refs[-2:]
     if with_carry:
         ca_ref, cb_ref, ci_ref = refs[-3:]
         del refs[-3:]
-    gw_ref = refs.pop(0) if len(refs) == 2 else g_ref
     rt_ref = refs[0]
     gi = pl.program_id(0)
     base = gi * m
@@ -80,10 +80,9 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
     a_all, b_all = [], []
     for i in range(m):  # m is static → unrolled
         g_i = g_ref[i * t:(i + 1) * t, :]  # [t, k]
-        gw_i = g_i if gw_ref is g_ref else gw_ref[i * t:(i + 1) * t, :]
         r_i = rt_ref[:, i * t:(i + 1) * t]  # [1, t]
         a_all.append(jax.lax.dot_general(
-            gw_i, g_i, (((0,), (0,)), ((), ())),
+            g_i, g_i, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         ))  # [k, k]
         b_all.append(jax.lax.dot_general(
@@ -146,7 +145,7 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
 
 
 def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
-                       precision, with_carry, weighted):
+                       precision, with_carry):
     # Dense-stream variant: tiles are [t]-row WINDOWS into the dense
     # gathered stream at 16-aligned dynamic offsets (``pl.multiple_of``
     # — Mosaic rejects unhinted dynamic sublane slices of bf16 refs, and
@@ -157,14 +156,15 @@ def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
     # the window, so b needs no mask).  Walk/flush semantics are identical
     # to ``_gram_groups_kernel``: owners' tiles are contiguous (trash
     # slots inherit the previous owner's seg with an empty window), rows
-    # of absent segments are never written.
+    # of absent segments are never written.  Weighted (iALS) runs stream
+    # gs = √aw·f through this same unit-weight form (sqrt
+    # reparameterization, ``ops.tiled.ials_tiled_half_step``).
     refs = list(refs)
     a_ref, b_ref = refs[-2:]
     del refs[-2:]
     if with_carry:
         ca_ref, cb_ref, ci_ref = refs[-3:]
         del refs[-3:]
-    gw_ref = refs.pop(0) if weighted else None
     rt_ref = refs[0]
     gi = pl.program_id(0)
     base = gi * m
@@ -182,10 +182,8 @@ def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
         keep = (rows - lo).astype(jnp.uint32) < (hi - lo).astype(jnp.uint32)
         gt = g_ref[pl.ds(lb, t), :]
         # One masked operand suffices: masked rows contribute zero rank-1
-        # terms.  Weighted path masks the premultiplied gw stream (whose
-        # out-of-window rows hold OTHER entities' real weights).
-        first = gw_ref[pl.ds(lb, t), :] if weighted else gt
-        gm = jnp.where(keep, first, jnp.zeros_like(first))
+        # terms.
+        gm = jnp.where(keep, gt, jnp.zeros_like(gt))
         r_i = rt_ref[:, i * t:(i + 1) * t]  # [1, t]
         a_all.append(jax.lax.dot_general(
             gm, gt, (((0,), (0,)), ((), ())),
@@ -246,7 +244,6 @@ def gram_tiles_dense_pallas(
     num_tiles: int,  # NT (tile slots)
     num_groups: int,  # NG (grid steps; group size m = NT // NG)
     block_rows: int,  # BG (stream rows per pipelined block)
-    gw: jax.Array | None = None,  # [C, k] A-weighted stream (iALS); None=unit
     interpret: bool | None = None,
     carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
 ) -> tuple[jax.Array, jax.Array]:
@@ -261,8 +258,12 @@ def gram_tiles_dense_pallas(
     tile windows inside one block), loads each tile as a [T]-row window
     at a dynamic 16-aligned offset, and masks rows outside [lo, hi).
     Same unwritten-absent-rows contract and chunk-boundary ``carry`` as
-    ``gram_tiles_pallas``.  See ``data.blocks._build_dense_stream`` for
-    the metadata layout and contiguity guarantees.
+    ``gram_tiles_pallas``.  Weighted (iALS) callers pass the
+    sqrt-reparameterized stream g = √aw·f with rescaled ``rt`` — one
+    stream serves both weight modes (round 5; the former second ``gw``
+    stream doubled pipelined traffic and squeezed VMEM at k = 128).
+    See ``data.blocks._build_dense_stream`` for the metadata layout and
+    contiguity guarantees.
     """
     c, k = g.shape
     t = tile_rows
@@ -279,11 +280,6 @@ def gram_tiles_dense_pallas(
                          f"{bg} >= tile_rows {t}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if gw is not None and (gw.shape != g.shape or gw.dtype != g.dtype):
-        raise ValueError(
-            f"gw must match g ({g.shape}, {g.dtype}), got "
-            f"{gw.shape}, {gw.dtype}"
-        )
     if interpret:
         # Vectorized emulation (CPU tests, shard_map interpret — same vma
         # rationale as gram_tiles_pallas): zeros for absent rows.
@@ -299,8 +295,7 @@ def gram_tiles_dense_pallas(
         gt = g[win]  # [NT, T, k]
         rows = jnp.arange(t)[None, :]
         keep = (rows >= lo[:, None]) & (rows < hi[:, None])
-        first = gt if gw is None else gw[win]
-        gm = jnp.where(keep[..., None], first, jnp.zeros_like(first))
+        gm = jnp.where(keep[..., None], gt, jnp.zeros_like(gt))
         a_t = jnp.einsum("ntk,ntl->nkl", gm, gt,
                          preferred_element_type=jnp.float32, precision=prec)
         b_t = jnp.einsum("ntk,nt->nk", gt,
@@ -331,15 +326,11 @@ def gram_tiles_dense_pallas(
         pl.BlockSpec((1, k), lambda i, sc: (0, 0)),
         pl.BlockSpec((1, 1), lambda i, sc: (0, 0)),
     ]
-    gw_specs = [] if gw is None else [
-        pl.BlockSpec((bg, k), lambda i, sc: (sc[i], 0)),
-    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(ng,),
         in_specs=[
             pl.BlockSpec((bg, k), lambda i, sc: (sc[i], 0)),
-        ] + gw_specs + [
             pl.BlockSpec((1, m * t), lambda i, sc: (0, i)),
         ] + carry_specs,
         out_specs=[
@@ -353,7 +344,7 @@ def gram_tiles_dense_pallas(
     out_bytes = num_segments * k * (k + 1) * 4
     # Mosaic budgets input windows at 4 B/elem even for bf16 (measured in
     # the compile-OOM dump), and the resident output at 2× its bytes.
-    in_bytes = 2 * (bg * k * 4 * (1 if gw is None else 2) + m * t * 4)
+    in_bytes = 2 * (bg * k * 4 + m * t * 4)
     params = getattr(pltpu, "CompilerParams", None) or getattr(
         pltpu, "TPUCompilerParams"
     )
@@ -370,14 +361,12 @@ def gram_tiles_dense_pallas(
         functools.partial(
             _gram_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt,
             precision=precision, with_carry=carry is not None,
-            weighted=gw is not None,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
         **kwargs,
-    )(meta, g, *([] if gw is None else [gw]), rt.reshape(1, nt * t),
-      *carry_ops)
+    )(meta, g, rt.reshape(1, nt * t), *carry_ops)
     return a, b[:, 0, :]
 
 
@@ -387,7 +376,6 @@ def gram_tiles_dense_pallas(
 )
 def gram_tiles_pallas(
     g: jax.Array,  # [C, k] gathered neighbor factors (bf16 or f32)
-    gw: jax.Array | None,  # [C, k] w·f, same dtype; None = weights all 1
     rt: jax.Array,  # [C] f32 b-side coefficients (0 at padding)
     seg: jax.Array,  # [NT] int32 owner of each tile (sorted by the layout)
     *,
@@ -401,13 +389,16 @@ def gram_tiles_pallas(
 ) -> tuple[jax.Array, jax.Array]:
     """(A [num_segments, k, k] f32, b [num_segments, k] f32).
 
-    The caller supplies the weighted copy ``gw = wt[:, None] * g`` instead
-    of the raw weight column: a [C, 1] f32 operand relayouts into one
-    element per (8, 128) tile (measured 0.4 ms/chunk of pure copy), while
-    ``gw`` fuses into the producing gather for free and streams in the
-    factors' natural layout.  ``gw=None`` declares all real weights are
-    1.0 (explicit ALS; padding already gathers the appended zero row) and
-    halves the kernel's input traffic.
+    ONE stream serves both weight modes: weighted (iALS) callers pass the
+    sqrt-reparameterized copy g = √w·f (which fuses into the producing
+    gather for free and streams in the factors' natural layout) with
+    b-coefficients rescaled by 1/√w — so A = gᵀg = Σ w·f fᵀ and
+    b = Σ c·f exactly (``ops.tiled.ials_tiled_half_step``).  A raw
+    [C, 1] weight column would relayout into one element per (8, 128)
+    tile (measured 0.4 ms/chunk of pure copy), and round 4's second
+    premultiplied gw stream doubled the pipelined input traffic — both
+    are avoided by construction.  Padding entries gather the appended
+    zero row, so they vanish from both sums.
 
     ``carry = (a0 [k,k] f32, b0 [k] f32, cin scalar f32)`` adds
     ``cin·(a0, b0)`` into segment 0's sums — the stream scan's
@@ -417,14 +408,9 @@ def gram_tiles_pallas(
 
     Rows of segments owning no tile are UNSPECIFIED (never written) —
     callers must route them to trash (stream mode) or mask them (accum
-    mode).  Padding entries gather exact zero rows, so they vanish from
-    both sums.
+    mode).
     """
     c, k = g.shape
-    if gw is not None and (gw.shape != (c, k) or gw.dtype != g.dtype):
-        raise ValueError(
-            f"gw must match g ({(c, k)}, {g.dtype}), got {gw.shape}, {gw.dtype}"
-        )
     t = tile_rows
     if c % t != 0:
         raise ValueError(f"entry count {c} not divisible by tile_rows {t}")
@@ -444,8 +430,7 @@ def gram_tiles_pallas(
         prec = (jax.lax.Precision.HIGHEST if g.dtype == jnp.float32
                 else None)
         gt = g.reshape(-1, tile_rows, k)
-        gwt = gt if gw is None else gw.reshape(-1, tile_rows, k)
-        a_t = jnp.einsum("ntk,ntl->nkl", gwt, gt,
+        a_t = jnp.einsum("ntk,ntl->nkl", gt, gt,
                          preferred_element_type=jnp.float32, precision=prec)
         b_t = jnp.einsum("ntk,nt->nk", gt,
                          rt.reshape(-1, tile_rows).astype(g.dtype),
@@ -482,8 +467,8 @@ def gram_tiles_pallas(
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nt // m,),
-        in_specs=([fac_spec] * (1 if gw is None else 2))
-        + [pl.BlockSpec((1, m * t), lambda i, seg: (0, i))]
+        in_specs=[fac_spec,
+                  pl.BlockSpec((1, m * t), lambda i, seg: (0, i))]
         + carry_specs,
         out_specs=[
             pl.BlockSpec((num_segments, k, k), lambda i, seg: (0, 0, 0)),
@@ -502,8 +487,7 @@ def gram_tiles_pallas(
         # 2× it plus the streamed input blocks with headroom (the default
         # 16 MB scoped allowance is far too small for S ≈ 2.5k segments).
         out_bytes = num_segments * k * (k + 1) * 4
-        n_fac = 1 if gw is None else 2
-        in_bytes = 2 * (m * t * (n_fac * k + 1) * 4)
+        in_bytes = 2 * (m * t * (k + 1) * 4)
         params = getattr(pltpu, "CompilerParams", None) or getattr(
             pltpu, "TPUCompilerParams"
         )
@@ -525,5 +509,5 @@ def gram_tiles_pallas(
         out_shape=out_shape,
         interpret=interpret,
         **kwargs,
-    )(seg, g, *([] if gw is None else [gw]), rt.reshape(1, c), *carry_ops)
+    )(seg, g, rt.reshape(1, c), *carry_ops)
     return a, b[:, 0, :]

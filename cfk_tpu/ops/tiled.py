@@ -87,9 +87,15 @@ def _entity_gram_chunk(
     in-body concatenate re-copied the 17 MB slice every chunk, ~25 ms/iter
     in the round-3 profile).  ``unit_weights=True`` (explicit ALS: real
     weights are all 1.0) skips the w·f multiply entirely — measured 0.18
-    s/iter of pure elementwise traffic at the full Netflix shape.  The
-    weighted path multiplies post-gather, where the copy fuses into the
-    gather.
+    s/iter of pure elementwise traffic at the full Netflix shape.
+
+    The weighted path (iALS) takes ``wt`` as the **sqrt-reparameterized**
+    per-entry weight √aw: the single stream gs = √aw·f (the multiply fuses
+    into the producing gather) is used as BOTH Gram operands, so
+    A = Σ aw·f fᵀ with the same kernel traffic as the unit path — round
+    4's premultiplied second stream (gw = aw·f next to plain g) doubled
+    the pipelined input for nothing (``ials_tiled_half_step`` rescales the
+    b-coefficients by 1/√aw to compensate).
     """
     k = fixed_slice.shape[-1]
     ct, prec = _gram_compute_dtype(fixed_slice)
@@ -101,21 +107,19 @@ def _entity_gram_chunk(
             _match_varying(jnp.zeros((1, k), fixed_slice.dtype), fixed_slice),
         ])
     g = fz[nb].astype(ct)  # [C, k]
+    if not unit_weights:
+        # Sqrt-weighted single stream (see docstring): the multiply fuses
+        # into the producing gather, and everything downstream — kernel
+        # operands, probes, both backends — sees one stream, exactly like
+        # the unit path.
+        g = g * wt.astype(ct)[:, None]
     if stage == "gather":
         # Measurement probe (``tiled_half_step(stage=...)``): stop after
-        # the gather (+ the weighted premultiply the production path pays)
-        # and fold everything into a scalar so nothing is dead-code
-        # eliminated — the full-array reduce is negligible next to the
-        # row-slot-bound gather it sinks.  The weighted path sinks BOTH
-        # streams: production materializes g and gw as separate kernel
-        # operands, and sinking only gw would let XLA fuse gather+multiply
-        # into one output buffer the production path doesn't get.
-        sink = jnp.sum(g.astype(jnp.float32))
-        if not unit_weights:
-            sink = sink + jnp.sum(
-                (g * wt.astype(ct)[:, None]).astype(jnp.float32)
-            )
-        return sink, None
+        # the gather (+ the fused √aw multiply where weighted) and fold
+        # everything into a scalar so nothing is dead-code eliminated —
+        # the full-array reduce is negligible next to the row-slot-bound
+        # gather it sinks.
+        return jnp.sum(g.astype(jnp.float32)), None
     if backend == "pallas" and 2 * num_segments * k * (k + 1) * 4 > (96 << 20):
         # The kernel keeps the whole (A, b) chunk output resident in VMEM
         # (double-buffered); past ~96 MB it cannot compile.  Dense shapes
@@ -126,22 +130,15 @@ def _entity_gram_chunk(
     if backend == "pallas":
         from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_pallas
 
-        # A raw [C, 1] weight operand would relayout catastrophically
-        # (one element per (8, 128) tile); the weighted copy streams in
-        # the factors' natural layout instead (see the kernel's doc).
-        gw = None if unit_weights else g * wt.astype(ct)[:, None]
         return gram_tiles_pallas(
-            g, gw, rt, seg, num_segments=num_segments, tile_rows=tile_rows,
-            carry=carry,
+            g, rt, seg, num_segments=num_segments,
+            tile_rows=tile_rows, carry=carry,
         )
     if backend != "xla":
         raise ValueError(f"unknown tiled gram backend {backend!r}")
     gt = g.reshape(-1, tile_rows, k)
-    gw = gt if unit_weights else (
-        g * wt.astype(ct)[:, None]
-    ).reshape(-1, tile_rows, k)
     a_t = jnp.einsum(
-        "ntk,ntl->nkl", gw, gt,
+        "ntk,ntl->nkl", gt, gt,
         preferred_element_type=jnp.float32, precision=prec,
     )
     b_t = jnp.einsum(
@@ -205,6 +202,10 @@ def tiled_half_step(
     )
 
 
+_SQRT_WEIGHT_EPS = 1e-12  # clamp for α·r = 0 entries: their A-term becomes
+# ε·f fᵀ (≪ the λ ≥ 0.01 ridge) while b stays exact — (c/√ε)·(√ε·f) = c·f.
+
+
 def ials_tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, alpha, *,
     gram=None, solver="cholesky", stage="full",
@@ -212,11 +213,22 @@ def ials_tiled_half_step(
     """Implicit-feedback (Hu et al. 2008) half-iteration on tiled blocks.
 
     Same global-Gram trick as ``ops.solve.ials_half_step``: per entity
-    A = YᵀY + Σ_obs (c−1)·f fᵀ + λI with c = 1 + α·r.  The tiled layout's
-    generic (weight, rating) channels express it directly — A-weight α·r
-    (0 at padding, since padded ratings are 0) and b-coefficient c·mask —
-    so both tile modes work unchanged with the YᵀY + λI term added at
-    solve time via ``implicit_reg``.
+    A = YᵀY + Σ_obs (c−1)·f fᵀ + λI with c = 1 + α·r.  The per-entry
+    A-weight is carried as a **sqrt reparameterization** (round 5): the
+    half-steps stream ONE weighted copy gs = √(α·r)·f and compute
+    A = gsᵀgs = Σ α·r·f fᵀ exactly, with the b-coefficient rescaled to
+    c/√(α·r) so b = Σ (c/√aw)·(√aw·f) = Σ c·f.  Round 4's premultiplied
+    gw = α·r·f second stream DOUBLED the Gram kernels' pipelined input
+    traffic and (at k = 128) squeezed VMEM — which is what made the dense
+    layout measure slower for iALS (VERDICT r4 #3); the reparameterization
+    makes the weighted path byte-identical in kernel traffic to the
+    unit-weight path (no second stream, no kernel change).  Entries with
+    α·r = 0 are kept exact in b by the ε clamp (``_SQRT_WEIGHT_EPS``);
+    negative interaction strengths are invalid for iALS — the trainers
+    reject them at entry (``models.ials._check_nonnegative_strengths``),
+    so the clamp here never sees one on a supported path.  Both tile
+    modes work unchanged with the YᵀY + λI term added at solve time via
+    ``implicit_reg``.
     """
     k = fixed_factors.shape[-1]
     if gram is None:
@@ -225,30 +237,31 @@ def ials_tiled_half_step(
         gram = global_gram(fixed_factors)
     reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
     blk = dict(blk)
+    if chunks[1] == "dstream" and ("rating_dense" not in blk
+                                   or "weight" not in blk):
+        raise ValueError(
+            "iALS on dense-stream blocks needs the weighted channels "
+            "(rating_dense + tile-aligned weight); this dataset was "
+            "staged without them — use the iALS device setup "
+            "(weighted=True) or rebuild"
+        )
+    # b-coefficient c·mask, rescaled by 1/√aw from the TILE-ALIGNED
+    # channels (rating carries r at valid slots, weight the 1.0 mask; both
+    # zero at padding, so rt' is zero there too).
+    aw_tile = jnp.sqrt(jnp.maximum(alpha * blk["rating"], _SQRT_WEIGHT_EPS))
+    rt_scaled = (1.0 + alpha * blk["rating"]) * blk["weight"] / aw_tile
     if chunks[1] == "dstream":
-        # Dense-stream weighted path: the b-coefficient transform runs on
-        # the TILE-ALIGNED channels (rating carries r at valid slots,
-        # weight the 1.0 mask), while the A-weight α·r comes from the
-        # STREAM-ALIGNED rating_dense so the half-step can premultiply
-        # the gathered factors (gw = g·aw) for the kernel's masked
-        # operand.  Zero at pad slots either way.
-        if "rating_dense" not in blk or "weight" not in blk:
-            raise ValueError(
-                "iALS on dense-stream blocks needs the weighted channels "
-                "(rating_dense + tile-aligned weight); this dataset was "
-                "staged without them — use the iALS device setup "
-                "(weighted=True) or rebuild"
-            )
-        blk["rating"] = (1.0 + alpha * blk["rating"]) * blk["weight"]
-        blk["aweight_dense"] = alpha * blk["rating_dense"]
+        # Dense-stream weighted path: the √aw factor multiplies the
+        # gathered stream (aweight_dense, STREAM-ALIGNED), fusing into the
+        # gather; the kernel then runs its UNIT-weight path on gs.
+        blk["rating"] = rt_scaled
+        blk["aweight_dense"] = jnp.sqrt(jnp.maximum(
+            alpha * blk["rating_dense"], _SQRT_WEIGHT_EPS))
         return tiled_half_step(
             fixed_factors, blk, chunks, local_entities, lam,
             solver=solver, implicit_reg=reg, stage=stage,
         )
-    blk["rating"], blk["weight"] = (
-        (1.0 + alpha * blk["rating"]) * blk["weight"],
-        alpha * blk["rating"],
-    )
+    blk["rating"], blk["weight"] = rt_scaled, aw_tile
     return tiled_half_step(
         fixed_factors, blk, chunks, local_entities, lam,
         solver=solver, implicit_reg=reg, stage=stage,
@@ -400,9 +413,10 @@ def als_half_step_tiled_dense(
     ~1.26·nnz — the row-slot-bound gather engine is the iteration's
     binding resource), and the pallas kernel reconstructs [T]-row tiles as
     masked dynamic windows (``gram_tiles_dense_pallas``).  The weighted
-    path (iALS: ``implicit_reg`` + ``aweight_dense``) premultiplies the
-    gathered factors per chunk (gw = g·aw — the elementwise multiply
-    fuses into the gather) and the kernel masks the gw operand."""
+    path (iALS: ``implicit_reg`` + ``aweight_dense`` carrying √aw)
+    multiplies the single gathered stream (gs = √aw·g, fused into the
+    gather) and runs the kernel's unit-weight path on it — see
+    ``ials_tiled_half_step`` for the sqrt reparameterization."""
     if implicit_reg is not None and aweight_dense is None:
         raise ValueError(
             "weighted dense-stream half-step needs aweight_dense (the "
@@ -432,18 +446,13 @@ def als_half_step_tiled_dense(
             acc, a0, b0 = carry
             nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk[:6]
             g = fz[nb_c].astype(ct)
-            gw = (None if implicit_reg is None
-                  else g * chunk[6].astype(ct)[:, None])
+            if implicit_reg is not None:  # sqrt-weighted single stream
+                g = g * chunk[6].astype(ct)[:, None]
             if stage == "gather":
-                # Weighted path: production materializes BOTH streams (g
-                # and gw are separate kernel operands), so sink both.
-                s = jnp.sum(g.astype(jnp.float32))
-                if gw is not None:
-                    s = s + jnp.sum(gw.astype(jnp.float32))
-                return (acc + s, a0, b0), None
+                return (acc + jnp.sum(g.astype(jnp.float32)), a0, b0), None
             a, b = gram_tiles_dense_pallas_dispatch(
                 g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
-                num_tiles=nt, num_groups=ng, block_rows=bg, gw=gw,
+                num_tiles=nt, num_groups=ng, block_rows=bg,
                 carry=(a0, b0, cin_c), backend=backend,
             )
             a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
@@ -462,10 +471,11 @@ def als_half_step_tiled_dense(
         a0, b0 = carry
         nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk[:6]
         g = fz[nb_c].astype(ct)
-        gw = None if implicit_reg is None else g * chunk[6].astype(ct)[:, None]
+        if implicit_reg is not None:  # sqrt-weighted single stream
+            g = g * chunk[6].astype(ct)[:, None]
         a, b = gram_tiles_dense_pallas_dispatch(
             g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
-            num_tiles=nt, num_groups=ng, block_rows=bg, gw=gw,
+            num_tiles=nt, num_groups=ng, block_rows=bg,
             carry=(a0, b0, cin_c), backend=backend,
         )
         if implicit_reg is None:
@@ -494,14 +504,14 @@ def als_half_step_tiled_dense(
 
 def gram_tiles_dense_pallas_dispatch(g, rt, meta, *, num_segments, tile_rows,
                                      num_tiles, num_groups, block_rows,
-                                     carry, backend, gw=None):
+                                     carry, backend):
     """Route to the dense kernel (or its XLA emulation for A/B runs)."""
     from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_dense_pallas
 
     return gram_tiles_dense_pallas(
         g, rt, meta, num_segments=num_segments, tile_rows=tile_rows,
         num_tiles=num_tiles, num_groups=num_groups, block_rows=block_rows,
-        gw=gw, carry=carry, interpret=True if backend == "xla" else None,
+        carry=carry, interpret=True if backend == "xla" else None,
     )
 
 

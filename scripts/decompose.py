@@ -39,7 +39,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(__file__))
-from perf_lab import get_dataset, make_parser, measure_steps, sync  # noqa: E402
+from perf_lab import get_dataset, make_parser, sync  # noqa: E402
 
 
 def main() -> None:

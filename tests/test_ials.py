@@ -179,3 +179,26 @@ def test_movielens_parser_errors(tmp_path):
     p.write_text("userId,movieId,rating,timestamp\n1,xx,4.0,100\n")
     with pytest.raises(ValueError, match=":2: malformed"):
         parse_movielens_csv(str(p))
+
+def test_ials_rejects_negative_strengths(rng):
+    """Negative interaction strengths would train an inconsistent normal
+    equation under the sqrt-reparameterized weight stream — both trainers
+    must refuse at entry."""
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.parallel.mesh import make_mesh
+
+    coo = synthetic_netflix_coo(60, 12, 300, seed=9)
+    bad = coo.rating.copy()
+    bad[5] = -1.0
+    import dataclasses as _dc
+
+    coo = _dc.replace(coo, rating=bad)
+    ds = Dataset.from_coo(coo)
+    cfg = IALSConfig(rank=4, lam=0.1, alpha=5.0, num_iterations=1, seed=0)
+    with pytest.raises(ValueError, match="non-negative"):
+        train_ials(ds, cfg)
+    cfg4 = IALSConfig(rank=4, lam=0.1, alpha=5.0, num_iterations=1, seed=0,
+                      num_shards=4)
+    with pytest.raises(ValueError, match="non-negative"):
+        train_ials_sharded(Dataset.from_coo(coo, num_shards=4), cfg4,
+                           make_mesh(4))

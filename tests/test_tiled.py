@@ -245,8 +245,9 @@ def test_dense_stream_multi_shard_parity(synth):
 
 
 def test_dense_stream_ials_matches_padded(synth):
-    """The weighted dense path (gw premultiply + masked first operand)
-    reproduces the padded stream's iALS half-step."""
+    """The weighted dense path (sqrt-reparameterized single stream
+    gs = √aw·f, masked as the kernel's first operand) reproduces the
+    padded stream's iALS half-step."""
     from cfk_tpu.ops.tiled import ials_tiled_half_step
 
     ds = synth
