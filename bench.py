@@ -247,6 +247,8 @@ def full_rank64_row() -> dict:
     row["movie_gather_pad_fraction"] = round(
         mb.num_chunks * mb.chunk_cap / nnz - 1.0, 4
     )
+    # VERDICT r4 #6: the dense kernel's trash-slot share, in the record.
+    row["dense_walk_trash_fraction"] = round(ub.dense_trash_fraction, 4)
     return row
 
 
@@ -276,16 +278,17 @@ def ials_row() -> dict:
     """MovieLens-25M-shaped implicit feedback, rank 128, full iALS solves
     (steady-state — the two-point fit was recorded misleading here).
     Round 5: the dense stream with the sqrt-reparameterized weight
-    (single gs = √aw·f stream) replaced the padded default — 0.662
-    padded vs 0.630 dense measured, reversing round 4's two-stream
-    dense negative (0.87)."""
+    (single gs = √aw·f stream) replaced the padded default — padded
+    0.662 vs dense 0.630 at 80k chunks, reversing round 4's two-stream
+    dense negative (0.87) — and the chunk sweep put the knee at 48k:
+    {64k → 0.627, 48k → 0.604, 32k → 0.606, 112k → 0.842}."""
     from cfk_tpu.data.cache import cached_scale_dataset
 
     users, movies, nnz = 162_541, 59_047, 25_000_095
     t0 = time.time()
     ds = cached_scale_dataset(
         users=users, movies=movies, nnz=nnz, seed=0, layout="tiled",
-        chunk_elems=81_920, dense_stream=True,
+        chunk_elems=49_152, dense_stream=True,
     )
     prep = time.time() - t0
     steady = _steady_state(

@@ -1,0 +1,313 @@
+"""Prototype: batched SPD solve via recursive block inversion on the MXU.
+
+The round-5 decomposition showed the fused LU-128 solve kernel is the
+binding term at rank 128 (0.63 s of the 1.25 s iteration, VPU-issue-bound
+at ~k³/3 per system).  This prototype moves the elimination onto the MXU:
+invert each regularized Gram A via symmetric 2×2 block recursion
+
+    P   = A11⁻¹ A12            (batched matmul)
+    S   = A22 − A12ᵀ P         (batched matmul; A21 = A12ᵀ by symmetry,
+                                expressed via dot_general contraction dims
+                                — no in-kernel transposes)
+    B11 = A11⁻¹ + P S⁻¹ Pᵀ     B12 = −P S⁻¹
+    B21 = −S⁻¹ Pᵀ              B22 = S⁻¹
+    x   = B b
+
+with leaf blocks (n ≤ LEAF) inverted by a full-width Gauss-Jordan using
+one-hot pivot arithmetic (no lane-indexed reads/writes).  Batch-FIRST
+layout [T, k, k] so the batched matmuls are Mosaic's supported
+batch-leading rank-3 dot_generals; lane/sublane HALF-slices (m = n/2 ≥ 8)
+are static offset slices, checked empirically here.
+
+Run CPU (interpret): python scripts/exp_binv.py --interpret
+Run TPU:             python scripts/exp_binv.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:
+    pltpu = None
+
+LEAF = 16
+
+
+def _leaf_inverse(a, n):
+    """Full-width GJ inverse of [T, n, n] blocks, n small (≤ LEAF).
+
+    One-hot arithmetic throughout: pivot extraction is a masked reduce,
+    row updates are full-width fma — no lane-indexed ops.
+    """
+    t = a.shape[0]
+    eye = (jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+           == jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+           ).astype(a.dtype)
+    m = jnp.concatenate([a, jnp.broadcast_to(eye[None], (t, n, n))], axis=2)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+    for j in range(n):
+        oh = (jax.lax.broadcasted_iota(jnp.int32, (1, 2 * n), 1) == j
+              ).astype(a.dtype)  # [1, 2n] lane one-hot
+        rj = (rows == j).astype(a.dtype)[None]  # [1, n, 1]
+        piv = jnp.sum(m * oh[None], axis=2, keepdims=True)  # [T, n, 1]
+        pj = jnp.sum(piv * rj, axis=1, keepdims=True)  # [T, 1, 1]
+        inv = 1.0 / pj
+        prow = jnp.sum(m * rj * inv, axis=1, keepdims=True)  # [T, 1, 2n]
+        m = jnp.where((rows == j)[None], prow, m - piv * prow)
+    return m[:, :, n:]
+
+
+def _block_inverse(a, n):
+    if n <= LEAF:
+        return _leaf_inverse(a, n)
+    m = n // 2
+    a11 = a[:, :m, :m]
+    a12 = a[:, :m, m:]
+    a22 = a[:, m:, m:]
+    i11 = _block_inverse(a11, m)
+    dot = functools.partial(
+        jax.lax.dot_general,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    bat = ((2,), (1,)), ((0,), (0,))  # contract lhs lanes x rhs rows
+    p = dot(i11, a12, bat)  # [T, m, m] = A11^-1 A12
+    # S = A22 - A12^T P  (contract ROWS of both: A12^T P without transpose)
+    s = a22 - dot(a12, p, (((1,), (1,)), ((0,), (0,))))
+    is_ = _block_inverse(s, m)
+    psi = dot(p, is_, bat)  # P S^-1
+    # B11 = A11^-1 + (P S^-1) P^T: contract LANES of both
+    b11 = i11 + dot(psi, p, (((2,), (2,)), ((0,), (0,))))
+    b12 = -psi
+    # B21 = -S^-1 P^T
+    b21 = -dot(is_, p, (((2,), (2,)), ((0,), (0,))))
+    top = jnp.concatenate([b11, b12], axis=2)
+    bot = jnp.concatenate([b21, is_], axis=2)
+    return jnp.concatenate([top, bot], axis=1)
+
+
+def _binv_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k, reg_mode, lam):
+    a = a_ref[...]  # [T, k, k] batch-first
+    if reg_mode == "diag":
+        reg = lam * jnp.maximum(r_ref[0, :].astype(jnp.float32), 1.0)  # [T]
+        r3 = jax.lax.broadcasted_iota(jnp.int32, (1, k, k), 1)
+        c3 = jax.lax.broadcasted_iota(jnp.int32, (1, k, k), 2)
+        a = a + jnp.where(r3 == c3, reg[:, None, None], 0.0)
+    else:
+        a = a + r_ref[...][None]
+    binv = _block_inverse(a, k)
+    b = b_ref[...]  # [T, k]
+    dot = functools.partial(
+        jax.lax.dot_general,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    mv = (((2,), (1,)), ((0,), (0,)))
+    x = dot(binv, b, mv)
+    # One iterative-refinement step recovers the digits the explicit
+    # inverse loses vs a factor-solve (~2 extra matvecs, trivial next to
+    # the inversion's matmuls).
+    r = b - dot(a, x, mv)
+    x_ref[...] = x + dot(binv, r, mv)
+
+
+def _pad_tile(a, b, reg, reg_mode, tile):
+    """Pad the batch to a tile multiple with identity systems — shared by
+    both pallas entry points so OOB grid blocks can never read garbage
+    (1/pivot on an undefined row would poison the block with NaN)."""
+    e, k, _ = a.shape
+    e_pad = ((e + tile - 1) // tile) * tile
+    if e_pad != e:
+        pad = e_pad - e
+        a = jnp.concatenate(
+            [a, jnp.broadcast_to(jnp.eye(k, dtype=a.dtype)[None],
+                                 (pad, k, k))])
+        if b is not None:
+            b = jnp.concatenate([b, jnp.zeros((pad, k), b.dtype)])
+        if reg is not None and reg_mode == "diag":
+            reg = jnp.concatenate([reg, jnp.zeros((pad,), reg.dtype)])
+    return a, b, reg, e, e_pad
+
+
+def _compiler_params(vmem_bytes):
+    if pltpu is None:
+        return {}
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return {"compiler_params": params(vmem_limit_bytes=vmem_bytes)}
+
+
+@functools.partial(jax.jit, static_argnames=("reg_mode", "lam", "interpret",
+                                             "tile"))
+def binv_solve_reg(a, b, reg, *, reg_mode="diag", lam=0.0, interpret=False,
+                   tile=128):
+    k = a.shape[1]
+    a, b, reg, e, e_pad = _pad_tile(a, b, reg, reg_mode, tile)
+    r_op = reg[None, :] if reg_mode == "diag" else reg
+    r_spec = (pl.BlockSpec((1, tile), lambda i: (0, i))
+              if reg_mode == "diag" else
+              pl.BlockSpec((k, k), lambda i: (0, 0)))
+    kwargs = {} if interpret else _compiler_params(
+        min(100 << 20, 8 * tile * k * k * 4))
+    x = pl.pallas_call(
+        functools.partial(_binv_reg_kernel, k=k, reg_mode=reg_mode, lam=lam),
+        out_shape=jax.ShapeDtypeStruct((e_pad, k), jnp.float32),
+        grid=(e_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, k, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((tile, k), lambda i: (i, 0)),
+            r_spec,
+        ],
+        out_specs=pl.BlockSpec((tile, k), lambda i: (i, 0)),
+        interpret=interpret,
+        **kwargs,
+    )(a, b, r_op)
+    return x[:e]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--k", type=int, default=128)
+    ap.add_argument("--e", type=int, default=334 * 16)
+    ap.add_argument("--tile", type=int, default=128)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+    if args.interpret:
+        jax.config.update("jax_platforms", "cpu")
+    k = args.k
+    e = (args.e // args.tile) * args.tile  # timing harness reshapes by tile
+    rng = np.random.default_rng(0)
+    x0 = rng.standard_normal((e, k, max(k // 8, 2))).astype(np.float32)
+    a = np.einsum("ekr,elr->ekl", x0, x0)
+    b = rng.standard_normal((e, k)).astype(np.float32)
+    cnt = rng.integers(1, 400, size=e).astype(np.int32)
+    lam = 0.05
+    a_reg = a + (lam * np.maximum(cnt, 1))[:, None, None] * np.eye(
+        k, dtype=np.float32)
+
+    aj, bj, cj = jnp.asarray(a), jnp.asarray(b), jnp.asarray(cnt)
+    got = np.asarray(binv_solve_reg(aj, bj, cj, reg_mode="diag", lam=lam,
+                                    interpret=args.interpret,
+                                    tile=args.tile))
+    want = np.linalg.solve(a_reg, b[..., None])[..., 0]
+    resid = np.einsum("ekl,el->ek", a_reg, got) - b
+    print("max |Ax-b|:", float(np.abs(resid).max()),
+          " rel x err:", float(np.abs(got - want).max()
+                               / np.abs(want).max()))
+
+    if args.interpret:
+        return
+    # Timing vs the fused LU kernel, scanned over fresh chunk slices like
+    # production (loop-invariant fori harnesses mislead for pallas).
+    from cfk_tpu.ops.pallas.solve_kernel import gauss_solve_reg_pallas
+
+    nc = e // args.tile  # treat each tile as a "chunk" for freshness
+    a4 = aj.reshape(nc, args.tile, k, k)
+    b4 = bj.reshape(nc, args.tile, k)
+    c4 = cj.reshape(nc, args.tile)
+
+    def scan_time(fn, label):
+        @jax.jit
+        def run(a4, b4, c4):
+            def body(acc, ch):
+                ac, bc, cc = ch
+                x = fn(ac, bc, cc)
+                return acc + jnp.sum(x[:1, :1]), None
+            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                  (a4, b4, c4))
+            return acc
+        run(a4, b4, c4).block_until_ready()
+        np.asarray(run(a4, b4, c4))  # warm
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.time()
+            v = run(a4, b4, c4)
+            np.asarray(v)
+            times.append(time.time() - t0)
+        per = min(times) / e
+        print(f"{label}: {min(times)*1e3:.2f} ms for {e} systems "
+              f"({per*1e9:.0f} ns/system)")
+
+    scan_time(lambda ac, bc, cc: binv_solve_reg(
+        ac, bc, cc, reg_mode="diag", lam=lam, tile=args.tile), "binv")
+    scan_time(lambda ac, bc, cc: gauss_solve_reg_pallas(
+        ac, bc, cc, reg_mode="diag", lam=lam, interpret=False), "lu  ")
+
+
+if __name__ == "__main__":
+    main()
+
+
+# ---- XLA-level Schur recursion over a pallas leaf inverse ----------------
+# The fully-fused recursive kernel compiles too slowly past n=32 (15.6 s
+# leaf-16, 26 s n=32, >15 min n=128).  Variant: only the n<=32 inverse is a
+# pallas kernel; the 128->64->32 Schur levels run as XLA batched matmuls
+# (full MXU, compiles in seconds, pays HBM for intermediates).
+
+def _pallas_inv(a, *, interpret=False, tile=128):
+    """[E, n, n] SPD batch inverse via the fused recursive kernel (n<=32)."""
+    n = a.shape[1]
+    a, _, _, e, e_pad = _pad_tile(a, None, None, "matrix", tile)
+    kwargs = {} if interpret else _compiler_params(64 << 20)
+    def kern(a_ref, x_ref):
+        x_ref[...] = _block_inverse(a_ref[...], n)
+    x = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((e_pad, n, n), jnp.float32),
+        grid=(e_pad // tile,),
+        in_specs=[pl.BlockSpec((tile, n, n), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((tile, n, n), lambda i: (i, 0, 0)),
+        interpret=interpret,
+        **kwargs,
+    )(a)
+    return x[:e]
+
+
+def _xla_block_inverse(a, *, leaf=32, interpret=False):
+    """Symmetric 2x2 Schur inversion, XLA level; [E, n, n] -> [E, n, n]."""
+    e, n, _ = a.shape
+    if n <= leaf:
+        return _pallas_inv(a, interpret=interpret)
+    m = n // 2
+    hi = jax.lax.Precision.HIGHEST
+    mm = functools.partial(jnp.einsum, precision=hi,
+                           preferred_element_type=jnp.float32)
+    a11, a12, a22 = a[:, :m, :m], a[:, :m, m:], a[:, m:, m:]
+    i11 = _xla_block_inverse(a11, leaf=leaf, interpret=interpret)
+    p = mm("eij,ejk->eik", i11, a12)
+    s = a22 - mm("eji,ejk->eik", a12, p)
+    is_ = _xla_block_inverse(s, leaf=leaf, interpret=interpret)
+    psi = mm("eij,ejk->eik", p, is_)
+    b11 = i11 + mm("eij,ekj->eik", psi, p)
+    b21 = -mm("eij,ekj->eik", is_, p)
+    top = jnp.concatenate([b11, -psi], axis=2)
+    bot = jnp.concatenate([b21, is_], axis=2)
+    return jnp.concatenate([top, bot], axis=1)
+
+
+def xla_binv_solve_reg(a, b, reg, *, reg_mode="diag", lam=0.0,
+                       interpret=False, leaf=32):
+    e, k, _ = a.shape
+    if reg_mode == "diag":
+        r = lam * jnp.maximum(reg.astype(jnp.float32), 1.0)
+        a = a + r[:, None, None] * jnp.eye(k, dtype=jnp.float32)[None]
+    else:
+        a = a + reg[None]
+    binv = _xla_block_inverse(a, leaf=leaf, interpret=interpret)
+    hi = jax.lax.Precision.HIGHEST
+    mm = functools.partial(jnp.einsum, precision=hi,
+                           preferred_element_type=jnp.float32)
+    x = mm("eij,ej->ei", binv, b)
+    r1 = b - mm("eij,ej->ei", a, x)
+    return x + mm("eij,ej->ei", binv, r1)
