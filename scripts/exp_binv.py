@@ -20,7 +20,9 @@ batch-leading rank-3 dot_generals; lane/sublane HALF-slices (m = n/2 ≥ 8)
 are static offset slices, checked empirically here.
 
 Run CPU (interpret): python scripts/exp_binv.py --interpret
-Run TPU:             python scripts/exp_binv.py
+Run TPU:             python scripts/exp_binv.py            (XLA-level variant;
+                     the fused kernel needs --mode fused --k 32 — its Mosaic
+                     compile is the recorded pathological negative past n=32)
 """
 
 from __future__ import annotations
@@ -178,6 +180,10 @@ def binv_solve_reg(a, b, reg, *, reg_mode="diag", lam=0.0, interpret=False,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--mode", choices=["auto", "fused", "xla"],
+                    default="auto",
+                    help="auto: fused kernel when it compiles (interpret "
+                    "or k <= 32), else the XLA-level Schur variant")
     ap.add_argument("--k", type=int, default=128)
     ap.add_argument("--e", type=int, default=334 * 16)
     ap.add_argument("--tile", type=int, default=128)
@@ -185,6 +191,17 @@ def main():
     args = ap.parse_args()
     if args.interpret:
         jax.config.update("jax_platforms", "cpu")
+    if args.mode == "auto":
+        args.mode = "fused" if (args.interpret or args.k <= 32) else "xla"
+    if args.mode == "fused" and not args.interpret and args.k > 32:
+        raise SystemExit(
+            "the fused kernel's Mosaic compile is pathological past n=32 "
+            "(the recorded negative: 26 s at n=32, >15 min at n=128) — "
+            "run --interpret for numerics, --k 32, or --mode xla"
+        )
+    solve = (binv_solve_reg if args.mode == "fused"
+             else xla_binv_solve_reg)
+    print(f"# mode: {args.mode}")
     k = args.k
     e = (args.e // args.tile) * args.tile  # timing harness reshapes by tile
     rng = np.random.default_rng(0)
@@ -197,9 +214,9 @@ def main():
         k, dtype=np.float32)
 
     aj, bj, cj = jnp.asarray(a), jnp.asarray(b), jnp.asarray(cnt)
-    got = np.asarray(binv_solve_reg(aj, bj, cj, reg_mode="diag", lam=lam,
-                                    interpret=args.interpret,
-                                    tile=args.tile))
+    kw = ({"tile": args.tile} if args.mode == "fused" else {})
+    got = np.asarray(solve(aj, bj, cj, reg_mode="diag", lam=lam,
+                           interpret=args.interpret, **kw))
     want = np.linalg.solve(a_reg, b[..., None])[..., 0]
     resid = np.einsum("ekl,el->ek", a_reg, got) - b
     print("max |Ax-b|:", float(np.abs(resid).max()),
@@ -239,14 +256,10 @@ def main():
         print(f"{label}: {min(times)*1e3:.2f} ms for {e} systems "
               f"({per*1e9:.0f} ns/system)")
 
-    scan_time(lambda ac, bc, cc: binv_solve_reg(
-        ac, bc, cc, reg_mode="diag", lam=lam, tile=args.tile), "binv")
+    scan_time(lambda ac, bc, cc: solve(
+        ac, bc, cc, reg_mode="diag", lam=lam, **kw), f"binv-{args.mode}")
     scan_time(lambda ac, bc, cc: gauss_solve_reg_pallas(
         ac, bc, cc, reg_mode="diag", lam=lam, interpret=False), "lu  ")
-
-
-if __name__ == "__main__":
-    main()
 
 
 # ---- XLA-level Schur recursion over a pallas leaf inverse ----------------
@@ -311,3 +324,7 @@ def xla_binv_solve_reg(a, b, reg, *, reg_mode="diag", lam=0.0,
     x = mm("eij,ej->ei", binv, b)
     r1 = b - mm("eij,ej->ei", a, x)
     return x + mm("eij,ej->ei", binv, r1)
+
+
+if __name__ == "__main__":
+    main()
