@@ -54,7 +54,8 @@ def _compact_row(row: dict) -> dict:
     if "error" in row:
         return {"error": row["error"][:120]}
     keep = ("value", "vs_baseline", "vs_gather_roofline", "s_per_iteration",
-            "s_per_iteration_median", "rmse_best_seed", "layout")
+            "s_per_iteration_median", "rmse_best_seed", "layout",
+            "exchange_s_per_iter", "compute_s_per_iter")
     return {k: row[k] for k in keep if k in row}
 
 
@@ -95,6 +96,16 @@ def main() -> None:
     scale = at_scale_quick()
     print("# at_scale: " + json.dumps(scale))
     rows = {"medium": medium, "at_scale": scale}
+    # The ring-layout overlap A/B + exchange/compute split (subprocess:
+    # the virtual mesh flag must precede jax init).  CFK_BENCH_OVERLAP=0
+    # skips it.
+    if os.environ.get("CFK_BENCH_OVERLAP", "1") != "0":
+        try:
+            ov = _overlap_ab_row()
+        except Exception as e:  # pragma: no cover - subprocess-dependent
+            ov = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# overlap_ring: " + json.dumps(ov))
+        rows["overlap_ring"] = ov
     if os.environ.get("CFK_BENCH_HEADLINE", "1") != "0":
         for name, fn in (
             ("full_rank64", full_rank64_row),
@@ -635,6 +646,163 @@ def run_scale(args) -> dict:
     }
 
 
+def _virtual_cpu_mesh(shards: int):
+    """Force an N-virtual-device CPU platform; MUST run before the first
+    jax computation (XLA reads the host-device-count flag at backend
+    init).  Shared by every virtual-mesh bench mode.  Returns the jax
+    module."""
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={shards}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def overlap_ab_main(args) -> None:
+    print(json.dumps(run_overlap_ab(args)))
+
+
+def _overlap_ab_row() -> dict:
+    """The default-run overlap row: a subprocess, because the virtual CPU
+    mesh needs ``xla_force_host_platform_device_count`` set before jax
+    initializes (main() has already initialized the backend by now)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, __file__, "--overlap-ab"],
+        capture_output=True, text=True, timeout=3600,
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip()[-300:]
+        return {"error": f"overlap-ab subprocess failed: {tail}"}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_overlap_ab(args) -> dict:
+    """Tentpole A/B: double-buffered (overlap=on) vs serial (overlap=off)
+    ring exchange, plus the per-half-iteration exchange/compute split, on
+    the ML-25M-proportioned synthetic shape scaled by ``--overlap-div``.
+
+    By default runs on a virtual CPU mesh (like ``--compare-exchange``):
+    one chip is all this environment exposes, so absolute seconds are
+    CPU-relative — the A/B ratio, the split, and the bit-exactness check
+    are the portable quantities.  On a host with a real multi-chip mesh,
+    pass ``--overlap-device-mesh`` to measure the ICI story on the actual
+    devices instead.
+    The split is measured with ``ring_probe`` steps (exchange = only the
+    S−1 ppermutes per half; compute = the same Gram/solve work with no
+    transfers), each with the same step/jit scaffold as the real
+    iteration.
+    """
+    import dataclasses as dc
+
+    if args.overlap_device_mesh:
+        # Real-hardware mode (the ROADMAP follow-up): use whatever devices
+        # the default platform exposes — requires >= --shards of them.
+        import jax
+    else:
+        jax = _virtual_cpu_mesh(args.shards)
+    import jax.numpy as jnp
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.ops.solve import init_factors_stats
+    from cfk_tpu.parallel import spmd
+    from cfk_tpu.parallel.mesh import make_mesh, shard_rows
+
+    div = args.overlap_div
+    users, movies, nnz = 162_541 // div, 59_047 // div, 25_000_095 // div
+    rank, s, iters = args.overlap_rank, args.shards, args.iterations
+    coo = synthetic_netflix_coo(users, movies, nnz, seed=args.seed)
+    ds = Dataset.from_coo(
+        coo, layout="tiled", num_shards=s, ring=True,
+        chunk_elems=args.overlap_chunk_elems,
+    )
+    mesh = make_mesh(s)
+    base = ALSConfig(
+        rank=rank, lam=0.05, num_iterations=iters, seed=0, layout="tiled",
+        exchange="ring", solver="cholesky", num_shards=s,
+    )
+
+    mtree, utree, step_kw = spmd.gathered_layout_trees(ds, base)
+    mtree = shard_rows(mesh, mtree)
+    utree = shard_rows(mesh, utree)
+
+    def init_factors():
+        key = jax.random.PRNGKey(0)
+        u0 = jax.jit(init_factors_stats, static_argnames="rank")(
+            key, jnp.asarray(ds.user_blocks.rating_sum),
+            jnp.asarray(ds.user_blocks.count), rank=rank,
+        )
+        m0 = jnp.zeros((ds.movie_blocks.padded_entities, rank), jnp.float32)
+        return shard_rows(mesh, u0), shard_rows(mesh, m0)
+
+    def timed(cfg, probe=None):
+        step = jax.jit(
+            spmd.make_training_step(
+                mesh, cfg, spmd.tree_specs(mtree), spmd.tree_specs(utree),
+                ring_probe=probe, **step_kw,
+            )
+        )
+        u, m = init_factors()
+        u, m = step(u, m, mtree, utree)  # compile + warm
+        jax.block_until_ready((u, m))
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.time()
+            for _ in range(iters):
+                u, m = step(u, m, mtree, utree)
+            jax.block_until_ready((u, m))
+            times.append((time.time() - t0) / iters)
+        return min(times), np.asarray(u, np.float32), np.asarray(
+            m, np.float32
+        )
+
+    on_s, on_u, on_m = timed(dc.replace(base, overlap=True))
+    off_s, off_u, off_m = timed(dc.replace(base, overlap=False))
+    # The split: same scaffold, phase-isolated steps (timing-only factors).
+    exch_s, _, _ = timed(base, probe="exchange")
+    comp_s, _, _ = timed(base, probe="compute")
+    max_diff = float(
+        max(np.abs(on_u - off_u).max(), np.abs(on_m - off_m).max())
+    )
+    return {
+        "metric": "synthetic_ml25m_ring_overlap_ab_s_per_iteration",
+        "value": round(on_s, 4),
+        "unit": "s/iteration",
+        # the A/B itself: ≤ 1.0 = overlap=on no slower than the serial
+        # schedule (the acceptance bar; the win is hardware-dependent —
+        # CPU has no async ICI, so ~1.0 is the honest expectation here).
+        "vs_baseline": round(on_s / off_s, 4),
+        "overlap_on_s_per_iter": round(on_s, 4),
+        "overlap_off_s_per_iter": round(off_s, 4),
+        # per-ITERATION split (both halves): transfers-only vs
+        # compute-only step timings from the ring probes.
+        "exchange_s_per_iter": round(exch_s, 4),
+        "compute_s_per_iter": round(comp_s, 4),
+        # what perfect overlap could hide at these phase durations
+        "exchange_fraction_of_serial": round(
+            exch_s / max(exch_s + comp_s, 1e-12), 4
+        ),
+        "max_abs_factor_diff_on_vs_off": max_diff,
+        "users": users, "movies": movies, "ratings": nnz, "rank": rank,
+        "shards": s, "iterations": iters, "repeats": args.repeats,
+        "layout": "tiled+ring", "overlap_div": div,
+        "backend": (
+            f"{jax.default_backend()}-device-mesh"
+            if args.overlap_device_mesh
+            else "cpu-virtual-mesh (relative timings)"
+        ),
+    }
+
+
 def compare_exchange_main(args) -> None:
     """The reference's headline experiment (its README.md:216-224): the
     block-to-block join (ring) vs the all-to-all join (all_gather), same
@@ -647,15 +815,7 @@ def compare_exchange_main(args) -> None:
     decides the trade on real hardware.  See BASELINE.md for the recorded
     table and what real multi-chip would change.
     """
-    import os
-
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={args.shards}"
-    ).strip()
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    _virtual_cpu_mesh(args.shards)
     from cfk_tpu.config import ALSConfig
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.synthetic import synthetic_netflix_coo
@@ -780,9 +940,29 @@ if __name__ == "__main__":
                         "(all-to-all join) on an 8-virtual-device CPU mesh "
                         "— the reference's README.md:216-224 experiment")
     parser.add_argument("--shards", type=int, default=8)
+    parser.add_argument("--overlap-ab", action="store_true",
+                        help="double-buffered vs serial ring exchange A/B "
+                        "+ exchange/compute timing split on a virtual CPU "
+                        "mesh (ML-25M shape / --overlap-div)")
+    parser.add_argument("--overlap-div", type=int, default=64,
+                        help="ML-25M shape divisor for --overlap-ab (1 = "
+                        "the full 162k x 59k x 25M shape; the default "
+                        "keeps the CPU-mesh A/B under a few minutes)")
+    parser.add_argument("--overlap-rank", type=int, default=32)
+    parser.add_argument("--overlap-device-mesh", action="store_true",
+                        help="run --overlap-ab on the real device mesh "
+                        "(needs >= --shards devices) instead of the "
+                        "virtual CPU mesh — the mode that measures the "
+                        "actual ICI overlap win")
+    parser.add_argument("--overlap-chunk-elems", type=int, default=32_768,
+                        help="tiled chunk size for --overlap-ab (small "
+                        "enough that each shard streams several chunks, "
+                        "so the chunk pipeline is exercised too)")
     cli_args = parser.parse_args()
     run = (
-        (lambda: compare_exchange_main(cli_args))
+        (lambda: overlap_ab_main(cli_args))
+        if cli_args.overlap_ab
+        else (lambda: compare_exchange_main(cli_args))
         if cli_args.compare_exchange
         else (lambda: scale_main(cli_args))
         if (cli_args.scale or cli_args.full or cli_args.ials

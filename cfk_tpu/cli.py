@@ -276,13 +276,18 @@ def _cache_sans_fingerprint(cache_dir, build_key, Dataset, ignore,
 
 
 def _train(args) -> int:
-    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.config import ALSConfig, set_async_collective_permute
     from cfk_tpu.eval.metrics import mse_rmse_from_model
     from cfk_tpu.eval.predict import save_prediction_csv
     from cfk_tpu.models.als import train_als
     from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
     from cfk_tpu.utils.metrics import Metrics, maybe_profile
 
+    # Must land in LIBTPU_INIT_ARGS before the first jax computation (the
+    # dataset load below initializes the backend, which is when libtpu
+    # reads the env on TPU; never XLA_FLAGS — CPU/GPU-only XLA aborts on
+    # the unknown TPU flag).
+    set_async_collective_permute(args.async_collective_permute)
     metrics = Metrics()
     if args.layout == "auto" and args.exchange == "auto":
         # The per-half exchange builds on the tiled layout only (config
@@ -345,6 +350,8 @@ def _train(args) -> int:
         seed=args.seed,
         num_shards=args.shards,
         exchange=args.exchange,
+        overlap=not args.no_overlap,
+        async_collective_permute=args.async_collective_permute,
         dtype=args.dtype,
         solver=args.solver,
         solve_chunk=args.solve_chunk,
@@ -842,6 +849,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fixed-factor exchange; 'auto' (tiled layout) picks "
                    "per half: ring where the Gram accumulator fits, "
                    "all_gather elsewhere")
+    t.add_argument(
+        "--no-overlap", action="store_true",
+        help="pin the serial exchange/compute schedule instead of the "
+        "default double-buffered pipelines (A/B measurement; factors are "
+        "bit-identical either way — see ARCHITECTURE.md 'Exchange/compute "
+        "overlap')",
+    )
+    t.add_argument(
+        "--async-collective-permute", choices=["auto", "on", "off"],
+        default="auto",
+        help="force XLA's async collective-permute pass via "
+        "LIBTPU_INIT_ARGS "
+        "(the escape hatch for the ring overlap's transfer hiding); "
+        "'auto' keeps the compiler default",
+    )
     t.add_argument(
         "--solver", choices=["auto", "cholesky", "pallas"], default="auto",
         help="batched k-by-k solve backend: auto = pallas Gauss-Jordan "

@@ -1,0 +1,98 @@
+"""JAX version compatibility shims.
+
+The framework targets the current JAX API (top-level ``jax.shard_map`` with
+``check_vma``, ``jax.typeof`` + varying-mesh-axes types, ``lax.pvary`` /
+``lax.pcast``), but must also run on older installs (0.4.x) where none of
+those exist: there the vma system is absent entirely, so the correct
+degradation is "no vma marking at all" — collectives still place correctly,
+we just lose the static checker.  Every call site goes through this module
+instead of sniffing ``hasattr`` locally, so the support matrix lives in one
+file.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+from jax import lax
+
+
+@functools.lru_cache(maxsize=1)
+def _shard_map_fn():
+    try:  # jax >= 0.6 exposes shard_map at top level
+        return jax.shard_map
+    except AttributeError:  # pragma: no cover - version-dependent
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm
+
+
+@functools.lru_cache(maxsize=1)
+def _shard_map_check_kwarg() -> str | None:
+    """Name of shard_map's static-checker toggle on this JAX.
+
+    ``check_vma`` on current JAX, ``check_rep`` on 0.4.x-era shard_map,
+    None if the signature is opaque (pass nothing and take the default).
+    """
+    try:
+        params = inspect.signature(_shard_map_fn()).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        return None
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return name
+    return None  # pragma: no cover - exotic builds
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check=True):
+    """``jax.shard_map`` across JAX versions.
+
+    ``check`` maps onto whichever static replication/vma checker this JAX
+    has (``check_vma`` today, ``check_rep`` historically).  On 0.4.x the
+    rep checker predates several collectives/ops we emit inside the ring
+    bodies (``optimization_barrier`` has no rep rule there), so ``check``
+    is only honored when True is known to work — callers that must disable
+    it still can.
+    """
+    kw = {}
+    name = _shard_map_check_kwarg()
+    if name is not None:
+        kw[name] = check if name == "check_vma" else False
+    return _shard_map_fn()(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def has_vma_system() -> bool:
+    """True when this JAX has the typed varying-mesh-axes system (and the
+    pallas toolchain that goes with it).  Old installs (0.4.x) predate it;
+    their pallas HLO interpreter is also orders of magnitude slower on the
+    grouped-Gram kernels, so callers use this to prefer the XLA emulation
+    there."""
+    return hasattr(jax, "typeof")
+
+
+def typeof_vma(x):
+    """``jax.typeof(x).vma`` where the vma system exists, else None."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    try:
+        return getattr(typeof(x), "vma", None)
+    except TypeError:  # pragma: no cover - non-typeable values
+        return None
+
+
+def to_varying(x, axis):
+    """Mark x device-varying over ``axis``.
+
+    ``pcast`` on jax >= 0.9, ``pvary`` before; identity on installs that
+    predate the vma system (nothing to mark — carries typecheck unmarked).
+    """
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis)
+    return x

@@ -11,7 +11,87 @@ whole configuration is one frozen dataclass threaded explicitly.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Literal
+
+
+_ASYNC_PERMUTE_FLAG = "xla_tpu_enable_async_collective_permute"
+
+
+def set_async_collective_permute(mode: str) -> None:
+    """Force XLA's async collective-permute pass on/off.
+
+    The double-buffered ring schedule only hides its ICI transfers when the
+    compiler splits each collective-permute into start/done pairs and lets
+    independent compute run in between; this is the escape hatch when that
+    pass itself is the suspect (e.g. an A/B against the serial schedule
+    that wants the transfer synchronous at the compiler level too).
+
+    The flag travels via ``LIBTPU_INIT_ARGS`` — parsed only when libtpu
+    actually initializes a TPU backend, and silently unused everywhere
+    else.  It must NOT go through ``XLA_FLAGS``: CPU/GPU-only XLA builds
+    treat the TPU-only flag as unknown and ABORT the whole process at
+    backend init (``parse_flags_from_env.cc: F Unknown flags`` — measured
+    in this container, where libtpu is importable but the CPU backend
+    parses the env).  libtpu reads the env at TPU init, so this must run
+    BEFORE the first TPU computation — the CLI applies it at trainer
+    entry, before the dataset load touches jax; the sharded trainers
+    re-apply best-effort.  An existing occurrence of the flag is
+    REWRITTEN to the requested value (an explicit on/off must win over
+    leftovers from a previous experiment).  Idempotent; "auto" is a no-op
+    (the compiler default already schedules collective permutes async on
+    current TPU toolchains).
+    """
+    if mode == "auto":
+        return
+    if mode not in ("on", "off"):
+        raise ValueError(f"unknown async_collective_permute {mode!r}")
+    want = f"--{_ASYNC_PERMUTE_FLAG}={'true' if mode == 'on' else 'false'}"
+    flags = os.environ.get("LIBTPU_INIT_ARGS", "")
+    parts = [p for p in flags.split() if _ASYNC_PERMUTE_FLAG not in p]
+    os.environ["LIBTPU_INIT_ARGS"] = " ".join(parts + [want])
+
+
+def _jax_backend_initialized() -> bool:
+    """Best-effort: has any XLA backend already been created?  Uses a
+    private jax registry (the only signal there is); unknowable → False."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
+
+
+def apply_overlap_xla_flags(config: "ALSConfig") -> None:
+    """``set_async_collective_permute`` from a config (trainer entry).
+
+    The sharded trainers run after a Mesh exists — i.e. after the backend
+    initialized and libtpu already parsed LIBTPU_INIT_ARGS — so from there
+    an explicit on/off can no longer take effect this process.  The env is
+    still written (idempotent; helps forked workers), but a loud warning
+    says to apply it earlier (the CLI does, before the dataset load; a
+    library user should call ``set_async_collective_permute`` before the
+    first jax computation)."""
+    if config.async_collective_permute == "auto":
+        return
+    if _jax_backend_initialized():
+        import warnings
+
+        warnings.warn(
+            f"async_collective_permute="
+            f"{config.async_collective_permute!r} set after the jax "
+            "backend initialized: libtpu has already parsed "
+            "LIBTPU_INIT_ARGS, so this run keeps the compiler default — "
+            "call cfk_tpu.config.set_async_collective_permute(...) before "
+            "the first jax computation (the CLI does this) for it to "
+            "take effect"
+        )
+    set_async_collective_permute(config.async_collective_permute)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +134,23 @@ class ALSConfig:
     #                  saves).  Build the dataset with Dataset.from_coo(...,
     #                  ring="auto").
     exchange: Literal["all_gather", "ring", "auto"] = "all_gather"
+    # Communication/compute overlap — the default execution mode for every
+    # ring-layout half-iteration and chunk-streaming body: ring steps are
+    # double-buffered (the next block's ppermute is issued before the
+    # current block's Gram consumes it) and chunk scans prefetch chunk c+1's
+    # neighbor-factor gather while chunk c solves (cfk_tpu.ops.pipeline).
+    # False pins the serial reference schedule (each phase drains before
+    # the next starts) — the measurement baseline of bench.py --overlap-ab.
+    # Factors are bit-identical either way (tests/test_overlap.py).
+    overlap: bool = True
+    # Escape hatch for XLA's async collective-permute scheduling on TPU —
+    # the compiler pass that actually hides the ring's ppermute behind the
+    # double-buffered Gram compute.  "auto" leaves the compiler default
+    # (async on current XLA); "on"/"off" force the flag via
+    # LIBTPU_INIT_ARGS (``apply_overlap_xla_flags`` — must run before TPU
+    # backend init to take effect, which the sharded trainers attempt
+    # best-effort; harmless off-TPU, where libtpu never parses it).
+    async_collective_permute: Literal["auto", "on", "off"] = "auto"
     # --- HBM bounding: ONE knob ------------------------------------------
     # Every layout bounds the same quantity — the transient neighbor-factor
     # gather feeding the MXU — by streaming solves through HBM in chunks.
@@ -143,6 +240,11 @@ class ALSConfig:
         return max(1, self.hbm_chunk_elems // max(width, 1))
 
     def __post_init__(self) -> None:
+        if self.async_collective_permute not in ("auto", "on", "off"):
+            raise ValueError(
+                "unknown async_collective_permute "
+                f"{self.async_collective_permute!r}"
+            )
         if self.rank < 1:
             raise ValueError(f"rank must be >= 1, got {self.rank}")
         if self.num_iterations < 1:

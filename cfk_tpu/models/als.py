@@ -238,7 +238,8 @@ def _segment_device_setup(dataset: Dataset):
 
 
 def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
-          x_prev=None, algorithm="als", block_size=32, sweeps=1):
+          x_prev=None, algorithm="als", block_size=32, sweeps=1,
+          overlap=None):
     """Solve one side against fixed factors; dispatches on the block layout
     (tuple = width buckets, dict with segment ids = flat segment run,
     other dict = one padded rectangle).  ``algorithm="als++"`` runs
@@ -254,6 +255,7 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
             return als_pp_half_step_bucketed(
                 fixed, x_prev, blk, chunks, entities, lam,
                 block_size=block_size, sweeps=sweeps, solver=solver,
+                overlap=overlap,
             )
         return als_pp_half_step(
             fixed, x_prev, blk["neighbor_idx"], blk["rating"], blk["mask"],
@@ -262,13 +264,13 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         )
     if isinstance(blk, tuple):
         return als_half_step_bucketed(
-            fixed, blk, chunks, entities, lam, solver=solver
+            fixed, blk, chunks, entities, lam, solver=solver, overlap=overlap
         )
     if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import tiled_half_step
 
         return tiled_half_step(
-            fixed, blk, chunks, entities, lam, solver=solver
+            fixed, blk, chunks, entities, lam, solver=solver, overlap=overlap
         )
     if "seg_rel" in blk:
         return als_half_step_segment(
@@ -296,11 +298,12 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         lam,
         solve_chunk=solve_chunk,
         solver=solver,
+        overlap=overlap,
     )
 
 
 _LAYOUT_STATICS = ("m_chunks", "u_chunks", "m_entities", "u_entities")
-_ALG_STATICS = ("algorithm", "block_size", "sweeps")
+_ALG_STATICS = ("algorithm", "block_size", "sweeps", "overlap")
 
 
 @functools.partial(
@@ -323,6 +326,7 @@ def _train_loop(
     algorithm: str = "als",
     block_size: int = 32,
     sweeps: int = 1,
+    overlap: bool | None = None,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -346,7 +350,7 @@ def _train_loop(
             u, movie_blocks, user_blocks,
             lam=lam, solve_chunk=solve_chunk, dt=dt, solver=solver,
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-            m_prev=m_prev,
+            overlap=overlap, m_prev=m_prev,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -359,8 +363,8 @@ def _train_loop(
 
 def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
                     solver="cholesky", algorithm="als", block_size=32,
-                    sweeps=1, m_prev=None, m_chunks=None, u_chunks=None,
-                    m_entities=None, u_entities=None):
+                    sweeps=1, overlap=None, m_prev=None, m_chunks=None,
+                    u_chunks=None, m_entities=None, u_entities=None):
     """One full iteration (solve M from U, then U from M) — the single source
     of the per-iteration math for both the fused-loop and checkpointed paths.
 
@@ -369,7 +373,8 @@ def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
     ``algorithm="als++"`` warm-starts each side from its previous factors
     (``m_prev`` / the ``u`` carry) with subspace sweeps.
     """
-    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps)
+    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+               overlap=overlap)
     m = _half(
         u, movie_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -400,6 +405,7 @@ def _one_iteration(
     algorithm: str = "als",
     block_size: int = 32,
     sweeps: int = 1,
+    overlap: bool | None = None,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -409,7 +415,7 @@ def _one_iteration(
         u, movie_blocks, user_blocks,
         lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype), solver=solver,
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-        m_prev=m_prev,
+        overlap=overlap, m_prev=m_prev,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -479,6 +485,7 @@ def train_als(
                 algorithm=config.algorithm,
                 block_size=config.block_size,
                 sweeps=config.sweeps,
+                overlap=config.overlap,
                 **layout_kw,
             )
             u.block_until_ready()
@@ -507,7 +514,7 @@ def train_als(
                 lam=config.lam, solve_chunk=solve_chunk,
                 dtype=config.dtype, solver=config.solver,
                 algorithm=config.algorithm, block_size=config.block_size,
-                sweeps=config.sweeps,
+                sweeps=config.sweeps, overlap=config.overlap,
                 **layout_kw,
             )
 

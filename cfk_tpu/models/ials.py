@@ -71,7 +71,7 @@ class IALSConfig(ALSConfig):
 
 def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
                entities=None, x_prev=None, algorithm="als", block_size=32,
-               sweeps=1):
+               sweeps=1, overlap=None):
     """Dispatch on block layout (tuple = buckets, dict with segment ids =
     flat segment run, other dict = padded rectangle).  ``algorithm="ials++"``
     runs warm-started subspace sweeps from ``x_prev`` instead of full
@@ -86,6 +86,7 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
             return ials_pp_half_step_bucketed(
                 fixed, x_prev, blk, chunks, entities, lam, alpha, gram=gram,
                 block_size=block_size, sweeps=sweeps, solver=solver,
+                overlap=overlap,
             )
         return ials_pp_half_step(
             fixed, x_prev, blk["neighbor_idx"], blk["rating"], blk["mask"],
@@ -94,7 +95,8 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
         )
     if isinstance(blk, tuple):
         return ials_half_step_bucketed(
-            fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
+            fixed, blk, chunks, entities, lam, alpha, gram=gram,
+            solver=solver, overlap=overlap,
         )
     if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import ials_tiled_half_step
@@ -103,7 +105,8 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
         # when staged with their weighted channels; unweighted staging
         # raises a rebuild/steering error inside.
         return ials_tiled_half_step(
-            fixed, blk, chunks, entities, lam, alpha, gram=gram, solver=solver
+            fixed, blk, chunks, entities, lam, alpha, gram=gram,
+            solver=solver, overlap=overlap,
         )
     if "seg_rel" in blk:
         return ials_half_step_segment(
@@ -122,13 +125,14 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     jax.jit,
     static_argnames=(
         "rank", "num_iterations", "lam", "alpha", "dtype", "solver",
-        "algorithm", "block_size", "sweeps",
+        "algorithm", "block_size", "sweeps", "overlap",
         "m_chunks", "u_chunks", "m_entities", "u_entities",
     ),
 )
 def _train_loop(
     key, movie_blocks, user_blocks, u_stats=None, *, rank, num_iterations, lam,
     alpha, dtype, solver="cholesky", algorithm="als", block_size=32, sweeps=1,
+    overlap=None,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     dt = jnp.dtype(dtype)
@@ -149,6 +153,7 @@ def _train_loop(
             u, m_prev, movie_blocks, user_blocks,
             lam=lam, alpha=alpha, dt=dt, solver=solver,
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+            overlap=overlap,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -158,12 +163,13 @@ def _train_loop(
 
 def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
                          dt, solver, algorithm, block_size, sweeps,
-                         m_chunks=None, u_chunks=None, m_entities=None,
-                         u_entities=None):
+                         overlap=None, m_chunks=None, u_chunks=None,
+                         m_entities=None, u_entities=None):
     """One full iALS iteration (movies from users, then users from movies) —
     the single source of the per-iteration math for the fused-loop and
     checkpointed paths (mirrors ``als._iteration_body``)."""
-    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps)
+    alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+               overlap=overlap)
     m = _ials_half(
         u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -179,19 +185,22 @@ def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
     jax.jit,
     static_argnames=(
         "lam", "alpha", "dtype", "solver", "algorithm", "block_size",
-        "sweeps", "m_chunks", "u_chunks", "m_entities", "u_entities",
+        "sweeps", "overlap", "m_chunks", "u_chunks", "m_entities",
+        "u_entities",
     ),
     donate_argnums=(0, 1),
 )
 def _one_iteration(
     u, m_prev, movie_blocks, user_blocks, *, lam, alpha, dtype,
     solver="cholesky", algorithm="als", block_size=32, sweeps=1,
+    overlap=None,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     return _ials_iteration_body(
         u, m_prev, movie_blocks, user_blocks,
         lam=lam, alpha=alpha, dt=jnp.dtype(dtype), solver=solver,
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
+        overlap=overlap,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -207,10 +216,13 @@ def _check_nonnegative_strengths(dataset: Dataset) -> None:
     import numpy as np
 
     r = dataset.coo_dense.rating
-    if r.size and float(np.min(r)) < 0:
+    if not r.size:
+        return
+    mn = float(np.min(r))  # once — the second np.min re-scanned 100M rows
+    if mn < 0:
         raise ValueError(
             "iALS requires non-negative interaction strengths "
-            f"(min rating {float(np.min(r))}); rescale or clamp the data "
+            f"(min rating {mn}); rescale or clamp the data "
             "(see cfk_tpu.models.ials docstring)"
         )
 
@@ -266,6 +278,7 @@ def train_ials(
                 algorithm=config.algorithm,
                 block_size=config.block_size,
                 sweeps=config.sweeps,
+                overlap=config.overlap,
                 **layout_kw,
             )
             u.block_until_ready()
@@ -294,6 +307,7 @@ def train_ials(
                 lam=config.lam, alpha=config.alpha, dtype=config.dtype,
                 solver=config.solver, algorithm=config.algorithm,
                 block_size=config.block_size, sweeps=config.sweeps,
+                overlap=config.overlap,
                 **layout_kw,
             )
 
@@ -366,7 +380,8 @@ def make_ials_training_step(
                 def solve(fixed_full, prev_local, blk, gram):
                     return ials_pp_half_step_bucketed(
                         fixed_full, prev_local, blk, chunks, local,
-                        config.lam, config.alpha, gram=gram, **alg,
+                        config.lam, config.alpha, gram=gram,
+                        overlap=config.overlap, **alg,
                     )
 
                 return solve
@@ -404,7 +419,7 @@ def make_ials_training_step(
             def solve(fixed_full, blk, gram):
                 return ials_tiled_half_step(
                     fixed_full, blk, chunks, local, config.lam, config.alpha,
-                    gram=gram, solver=config.solver,
+                    gram=gram, solver=config.solver, overlap=config.overlap,
                 )
 
             return solve
@@ -442,7 +457,7 @@ def make_ials_training_step(
             def solve(fixed_full, blk, gram):
                 return ials_half_step_bucketed(
                     fixed_full, blk, chunks, local, config.lam, config.alpha,
-                    gram=gram, solver=config.solver,
+                    gram=gram, solver=config.solver, overlap=config.overlap,
                 )
 
             return solve
@@ -482,7 +497,10 @@ def train_ials_sharded(
     """Multi-device iALS over a 1-D mesh, with optional checkpoint/resume."""
     from cfk_tpu.utils.metrics import Metrics
 
+    from cfk_tpu.config import apply_overlap_xla_flags
+
     _check_nonnegative_strengths(dataset)
+    apply_overlap_xla_flags(config)
     metrics = metrics if metrics is not None else Metrics()
     from cfk_tpu.parallel.spmd import validate_sharded_dataset
     from cfk_tpu.transport.checkpoint import resume_state_synced, should_save

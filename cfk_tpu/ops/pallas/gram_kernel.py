@@ -45,6 +45,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from cfk_tpu.compat import has_vma_system, typeof_vma
 from jax.experimental import pallas as pl
 
 try:  # TPU-specific extensions; absent on some builds
@@ -298,8 +300,12 @@ def gram_tiles_dense_pallas(
         gm = jnp.where(keep[..., None], gt, jnp.zeros_like(gt))
         a_t = jnp.einsum("ntk,ntl->nkl", gm, gt,
                          preferred_element_type=jnp.float32, precision=prec)
+        # rt stays float32 (ADVICE r5): the iALS ε-clamped b-coefficient
+        # loses ~0.5–1% relative accuracy under a bf16 cast, and the real
+        # kernel consumes the f32 stream directly.
         b_t = jnp.einsum("ntk,nt->nk", gt,
-                         rt.reshape(nt, t).astype(g.dtype), precision=prec,
+                         rt.reshape(nt, t).astype(jnp.float32),
+                         precision=prec,
                          preferred_element_type=jnp.float32)
         a = jax.ops.segment_sum(a_t, seg, num_segments=num_segments,
                                 indices_are_sorted=True)
@@ -313,7 +319,7 @@ def gram_tiles_dense_pallas(
     if pltpu is None:  # pragma: no cover - non-TPU pallas build
         raise RuntimeError("pallas TPU extensions unavailable")
 
-    vma = getattr(jax.typeof(g), "vma", None)
+    vma = typeof_vma(g)
     mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
         lambda s, d: jax.ShapeDtypeStruct(s, d)
     )
@@ -419,21 +425,24 @@ def gram_tiles_pallas(
         raise ValueError(f"seg shape {seg.shape} != ({nt},)")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if interpret and getattr(jax.typeof(g), "vma", None):
+    if interpret and (typeof_vma(g) or not has_vma_system()):
         # Under shard_map with vma checking, the pallas HLO interpreter's
         # grid loop slices varying operands with unvarying grid counters
         # and fails the vma match.  Mosaic compilation is unaffected (the
         # indexing lives inside the kernel binary), so only CPU-interpret
         # sharded runs (tests, dryrun_multichip) take this branch: the
         # same math via segment-sum, zeros for absent rows (a superset of
-        # the kernel's unspecified-rows contract).
+        # the kernel's unspecified-rows contract).  Old-jax installs
+        # (no vma system) take it too: their HLO interpreter predates
+        # this kernel's patterns and runs orders of magnitude slower.
         prec = (jax.lax.Precision.HIGHEST if g.dtype == jnp.float32
                 else None)
         gt = g.reshape(-1, tile_rows, k)
         a_t = jnp.einsum("ntk,ntl->nkl", gt, gt,
                          preferred_element_type=jnp.float32, precision=prec)
+        # rt stays float32 (ADVICE r5) — see the dense emulation above.
         b_t = jnp.einsum("ntk,nt->nk", gt,
-                         rt.reshape(-1, tile_rows).astype(g.dtype),
+                         rt.reshape(-1, tile_rows).astype(jnp.float32),
                          preferred_element_type=jnp.float32, precision=prec)
         a = jax.ops.segment_sum(a_t, seg, num_segments=num_segments,
                                 indices_are_sorted=True)
@@ -448,7 +457,7 @@ def gram_tiles_pallas(
     while nt % m != 0:  # grid must tile exactly; m=1 always divides
         m //= 2
 
-    vma = getattr(jax.typeof(g), "vma", None)
+    vma = typeof_vma(g)
     mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
         lambda s, d: jax.ShapeDtypeStruct(s, d)
     )

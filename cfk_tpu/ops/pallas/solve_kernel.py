@@ -34,6 +34,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from cfk_tpu.compat import typeof_vma
 from jax.experimental import pallas as pl
 
 try:  # TPU-specific memory spaces; absent on some builds
@@ -312,7 +314,7 @@ def _gauss_solve_reg_pallas(
         if reg_mode == "diag"
         else pl.BlockSpec((k, k), lambda i: (0, 0), **mem)
     )
-    vma = getattr(jax.typeof(a_p), "vma", None)
+    vma = typeof_vma(a_p)
     out_shape = (
         jax.ShapeDtypeStruct((e_pad, k), jnp.float32, vma=vma)
         if vma
@@ -408,7 +410,7 @@ def _solve_call(kernel, a_p, b_p, b_block, out_struct, tile, interpret,
         out_specs=pl.BlockSpec(b_block, b_map, **mem),
     )
     shape, dtype = out_struct
-    vma = getattr(jax.typeof(a_p), "vma", None)
+    vma = typeof_vma(a_p)
     if vma:
         out_shape = jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     else:

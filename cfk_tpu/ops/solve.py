@@ -153,17 +153,23 @@ def ials_half_step(
     return regularized_solve_matrix(a_obs, b, reg, solver)
 
 
-def walk_buckets(buckets, chunk_rows, arrays_of, piece, out):
+def walk_buckets(buckets, chunk_rows, arrays_of, piece, out, overlap=None):
     """The bucket scaffolding every width-bucketed half-step shares.
 
     For each bucket: extract its per-row arrays (``arrays_of(blk, out)`` —
     ``out`` is passed so warm-started optimizers can gather the bucket's
     current factors), run ``piece(*arrays) -> [rows, k]`` — streamed through
-    HBM in [chunk, ...] pieces via ``lax.map`` when ``chunk_rows`` bounds the
-    bucket — and scatter the result into ``out`` at the bucket's entity rows
-    (padding rows target the trash slot; real rows are unique across
-    buckets).
+    HBM in [chunk, ...] pieces when ``chunk_rows`` bounds the bucket — and
+    scatter the result into ``out`` at the bucket's entity rows (padding
+    rows target the trash slot; real rows are unique across buckets).
+
+    The chunk stream is double-buffered by default
+    (``ops.pipeline.chunk_map``): chunk c+1's operand fetch is issued
+    before ``piece`` runs on chunk c, so the HBM reads hide behind the
+    solve; ``overlap=False`` is the serial ``lax.map`` reference schedule.
     """
+    from cfk_tpu.ops.pipeline import chunk_map
+
     k = out.shape[-1]
     for blk, chunk in zip(buckets, chunk_rows):
         arrs = arrays_of(blk, out)
@@ -173,10 +179,13 @@ def walk_buckets(buckets, chunk_rows, arrays_of, piece, out):
         else:
             if rows % chunk != 0:
                 raise ValueError(f"bucket rows {rows} not divisible by chunk {chunk}")
+            n_chunks = rows // chunk
             reshaped = tuple(
-                a.reshape((rows // chunk, chunk) + a.shape[1:]) for a in arrs
+                a.reshape((n_chunks, chunk) + a.shape[1:]) for a in arrs
             )
-            x = lax.map(lambda c: piece(*c), reshaped).reshape(rows, k)
+            x = chunk_map(
+                piece, reshaped, n_chunks, overlap=overlap
+            ).reshape(rows, k)
         out = out.at[blk["entity_local"]].set(x)
     return out
 
@@ -191,6 +200,7 @@ def ials_half_step_bucketed(
     *,
     gram: jax.Array | None = None,
     solver: str = "cholesky",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """Implicit-feedback half-iteration over width-bucketed InBlocks.
 
@@ -212,6 +222,7 @@ def ials_half_step_bucketed(
         lambda blk, _out: (blk["neighbor"], blk["rating"], blk["mask"]),
         solve_piece,
         jnp.zeros((local_entities + 1, k), jnp.float32),
+        overlap=overlap,
     )
     return out[:local_entities]
 
@@ -398,6 +409,7 @@ def als_half_step(
     *,
     solve_chunk: Optional[int] = None,
     solver: str = "cholesky",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """One ALS half-iteration: solve all [E] entities against fixed factors.
 
@@ -405,11 +417,13 @@ def als_half_step(
     by scanning over entity chunks.  An indivisible E is padded with
     zero-mask rows (their λ-floored solves are sliced off), so budget-
     derived chunk sizes (``ALSConfig.padded_solve_chunk``) always work.
+    The chunk stream is double-buffered by default (``ops.pipeline``).
     """
     if solve_chunk is None or solve_chunk >= neighbor_idx.shape[0]:
         return _solve_chunk(
             fixed_factors, lam, neighbor_idx, rating, mask, count, solver
         )
+    from cfk_tpu.ops.pipeline import chunk_map
 
     e = neighbor_idx.shape[0]
     (neighbor_idx, rating, mask, count), pad = pad_rows_to_multiple(
@@ -417,13 +431,13 @@ def als_half_step(
     )
     n_chunks = (e + pad) // solve_chunk
 
-    def body(_, chunk):
-        ni, r, m, c = chunk
-        return None, _solve_chunk(fixed_factors, lam, ni, r, m, c, solver)
-
     reshape = lambda x: x.reshape((n_chunks, solve_chunk) + x.shape[1:])
-    _, out = lax.scan(
-        body, None, (reshape(neighbor_idx), reshape(rating), reshape(mask), reshape(count))
+    out = chunk_map(
+        lambda ni, r, m, c: _solve_chunk(fixed_factors, lam, ni, r, m, c,
+                                         solver),
+        (reshape(neighbor_idx), reshape(rating), reshape(mask),
+         reshape(count)),
+        n_chunks, overlap=overlap,
     )
     return out.reshape(e + pad, fixed_factors.shape[-1])[:e]
 
@@ -696,6 +710,7 @@ def als_half_step_bucketed(
     lam: float,
     *,
     solver: str = "cholesky",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """One ALS half-iteration over width-bucketed InBlocks.
 
@@ -716,5 +731,6 @@ def als_half_step_bucketed(
             fixed_factors, lam, ni, rt, mk, cnt, solver
         ),
         jnp.zeros((local_entities + 1, k), jnp.float32),
+        overlap=overlap,
     )
     return out[:local_entities]

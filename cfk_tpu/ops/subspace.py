@@ -149,7 +149,8 @@ def als_pp_half_step(
 
 
 def _warm_bucket_walk(
-    k, x_prev, buckets, chunk_rows, local_entities, bucket_keys, sweep_piece
+    k, x_prev, buckets, chunk_rows, local_entities, bucket_keys, sweep_piece,
+    overlap=None,
 ):
     """Warm-started bucket scatter shared by both families' bucketed sweeps.
 
@@ -157,7 +158,9 @@ def _warm_bucket_walk(
     bucket extracting the current factor rows plus ``bucket_keys`` arrays,
     runs ``sweep_piece`` on each piece, and scatters back.  Entities in no
     bucket (zero interactions) keep their previous value — the warm-started
-    fixpoint for them is 0 and both trainers start them at 0.
+    fixpoint for them is 0 and both trainers start them at 0.  ``overlap``
+    double-buffers chunked buckets (chunk c+1's operand fetch under chunk
+    c's sweep — ``ops.pipeline``), the default.
     """
     from cfk_tpu.ops.solve import walk_buckets
 
@@ -170,6 +173,7 @@ def _warm_bucket_walk(
         + tuple(blk[key] for key in bucket_keys),
         sweep_piece,
         out,
+        overlap=overlap,
     )
     return out[:local_entities]
 
@@ -185,6 +189,7 @@ def als_pp_half_step_bucketed(
     block_size: int = 32,
     sweeps: int = 1,
     solver: str = "cholesky",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """Explicit ALS-WR half-iteration by subspace sweeps over width buckets."""
 
@@ -199,6 +204,7 @@ def als_pp_half_step_bucketed(
     return _warm_bucket_walk(
         fixed.shape[-1], x_prev, buckets, chunk_rows, local_entities,
         ("neighbor", "rating", "mask", "count"), sweep_piece,
+        overlap=overlap,
     )
 
 
@@ -242,6 +248,7 @@ def ials_pp_half_step_bucketed(
     block_size: int = 32,
     sweeps: int = 1,
     solver: str = "cholesky",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """iALS++ half-iteration over width-bucketed InBlocks.
 
@@ -265,4 +272,5 @@ def ials_pp_half_step_bucketed(
     return _warm_bucket_walk(
         fixed.shape[-1], x_prev, buckets, chunk_rows, local_entities,
         ("neighbor", "rating", "mask"), sweep_piece,
+        overlap=overlap,
     )

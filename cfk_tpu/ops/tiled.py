@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cfk_tpu.ops.pipeline import prefetch_scan, resolve_overlap
 from cfk_tpu.ops.solve import (
     _gram_compute_dtype,
     _match_varying,
@@ -72,6 +73,7 @@ def default_tiled_gram_backend() -> str:
 def _entity_gram_chunk(
     fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, backend,
     unit_weights=False, zero_appended=False, carry=None, stage="full",
+    pregathered=None,
 ):
     """One chunk's per-entity Gram/RHS: (A [num_segments, k, k], b [.., k]).
 
@@ -96,17 +98,28 @@ def _entity_gram_chunk(
     4's premultiplied second stream (gw = aw·f next to plain g) doubled
     the pipelined input for nothing (``ials_tiled_half_step`` rescales the
     b-coefficients by 1/√aw to compensate).
+
+    ``pregathered`` (the overlap pipelines) hands in the chunk's gathered
+    stream ``fz[nb].astype(ct)`` fetched one loop step early
+    (``ops.pipeline.prefetch_scan``); the weight multiply and everything
+    downstream run here unchanged, so the pipelined result is bit-equal to
+    the in-body gather.
     """
     k = fixed_slice.shape[-1]
     ct, prec = _gram_compute_dtype(fixed_slice)
-    if zero_appended:
-        fz = fixed_slice
+    if pregathered is not None:
+        g = pregathered  # [C, k], already in ct
     else:
-        fz = jnp.concatenate([
-            fixed_slice,
-            _match_varying(jnp.zeros((1, k), fixed_slice.dtype), fixed_slice),
-        ])
-    g = fz[nb].astype(ct)  # [C, k]
+        if zero_appended:
+            fz = fixed_slice
+        else:
+            fz = jnp.concatenate([
+                fixed_slice,
+                _match_varying(
+                    jnp.zeros((1, k), fixed_slice.dtype), fixed_slice
+                ),
+            ])
+        g = fz[nb].astype(ct)  # [C, k]
     if not unit_weights:
         # Sqrt-weighted single stream (see docstring): the multiply fuses
         # into the producing gather, and everything downstream — kernel
@@ -141,8 +154,13 @@ def _entity_gram_chunk(
         "ntk,ntl->nkl", gt, gt,
         preferred_element_type=jnp.float32, precision=prec,
     )
+    # rt stays float32: the iALS sqrt-reparameterized b-coefficient
+    # c/√(ε-clamped aw) reaches ~1e6·c at zero-strength entries, where a
+    # bf16 cast costs ~0.5–1% relative b error (ADVICE r5); accumulation
+    # is float32 anyway via preferred_element_type, so only this operand's
+    # input rounding was at stake.
     b_t = jnp.einsum(
-        "ntk,nt->nk", gt, rt.reshape(-1, tile_rows).astype(ct),
+        "ntk,nt->nk", gt, rt.reshape(-1, tile_rows).astype(jnp.float32),
         preferred_element_type=jnp.float32, precision=prec,
     )
     a = jax.ops.segment_sum(
@@ -160,7 +178,7 @@ def _entity_gram_chunk(
 
 def tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, *,
-    solver="cholesky", implicit_reg=None, stage="full",
+    solver="cholesky", implicit_reg=None, stage="full", overlap=None,
 ):
     """Mode dispatch shared by the single-device and SPMD trainers.
 
@@ -184,7 +202,7 @@ def tiled_half_step(
             blk["tile_seg"], blk["chunk_base"], blk["chunk_entity"],
             blk["count"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
-            stage=stage,
+            stage=stage, overlap=overlap,
         )
     if mode == "dstream":
         return als_half_step_tiled_dense(
@@ -193,12 +211,14 @@ def tiled_half_step(
             blk["carry_in"], blk["last_seg"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
             aweight_dense=blk.get("aweight_dense"), stage=stage,
+            overlap=overlap,
         )
     return als_half_step_tiled(
         fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
         blk["tile_seg"], blk["chunk_entity"], blk["chunk_count"],
         blk["carry_in"], blk["last_seg"], local_entities, lam,
         statics=st, solver=solver, implicit_reg=implicit_reg, stage=stage,
+        overlap=overlap,
     )
 
 
@@ -208,7 +228,7 @@ _SQRT_WEIGHT_EPS = 1e-12  # clamp for α·r = 0 entries: their A-term becomes
 
 def ials_tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, alpha, *,
-    gram=None, solver="cholesky", stage="full",
+    gram=None, solver="cholesky", stage="full", overlap=None,
 ):
     """Implicit-feedback (Hu et al. 2008) half-iteration on tiled blocks.
 
@@ -259,12 +279,12 @@ def ials_tiled_half_step(
             alpha * blk["rating_dense"], _SQRT_WEIGHT_EPS))
         return tiled_half_step(
             fixed_factors, blk, chunks, local_entities, lam,
-            solver=solver, implicit_reg=reg, stage=stage,
+            solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
         )
     blk["rating"], blk["weight"] = rt_scaled, aw_tile
     return tiled_half_step(
         fixed_factors, blk, chunks, local_entities, lam,
-        solver=solver, implicit_reg=reg, stage=stage,
+        solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
     )
 
 
@@ -286,6 +306,7 @@ def als_half_step_tiled(
     implicit_reg: jax.Array | None = None,  # [k,k] YᵀY+λI (iALS); None = ALS-WR
     gram_backend: str | None = None,
     stage: str = "full",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """Stream-mode tiled half-iteration (the many-entities side).
 
@@ -296,8 +317,16 @@ def als_half_step_tiled(
     tile are unwritten garbage; their solves land in the trash row of
     ``out`` (``chunk_entity`` routes non-finalized rows there), so nothing
     real ever reads them.
+
+    With ``overlap`` (the default) the chunk scan is double-buffered
+    (``ops.pipeline.prefetch_scan``): chunk c+1's neighbor-factor gather —
+    the row-slot-bound phase — is issued before chunk c's Gram+solve
+    consume the other buffer, so the gather engine and the MXU run
+    concurrently instead of strictly alternating.  Same gathers, same
+    per-chunk op order, bit-identical factors (``tests/test_overlap.py``).
     """
     backend = gram_backend or default_tiled_gram_backend()
+    overlap = resolve_overlap(overlap)
     nc, cap, e_c, t = statics
     k = fixed_factors.shape[-1]
     nt = cap // t
@@ -338,6 +367,16 @@ def als_half_step_tiled(
         (acc, _, _), _ = lax.scan(probe, init, chunks)
         return acc.reshape(1, 1)
 
+    def solve_chunk_rows(a, b, cnt_c):
+        # The whole batch is solved including the trash row — solving it
+        # beats slicing it away, which copied the batch again.
+        if implicit_reg is None:
+            cnt_full = jnp.concatenate(
+                [cnt_c, jnp.ones((1,), cnt_c.dtype)]
+            )
+            return regularized_solve(a, b, cnt_full, lam, solver)
+        return regularized_solve_matrix(a, b, implicit_reg, solver)
+
     def body(carry, chunk):
         a0, b0 = carry
         nb_c, rt_c, wt_c, ts_c, ent_c, cnt_c, cin_c, lseg_c = chunk
@@ -353,15 +392,7 @@ def als_half_step_tiled(
             fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
         )
-        # The whole batch is solved including the trash row — solving it
-        # beats slicing it away, which copied the batch again.
-        if implicit_reg is None:
-            cnt_full = jnp.concatenate(
-                [cnt_c, jnp.ones((1,), cnt_c.dtype)]
-            )
-            x = regularized_solve(a, b, cnt_full, lam, solver)
-        else:
-            x = regularized_solve_matrix(a, b, implicit_reg, solver)
+        x = solve_chunk_rows(a, b, cnt_c)
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
         b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
         return (a1, b1), x[:e_c]
@@ -378,7 +409,43 @@ def als_half_step_tiled(
     # scan rewrote it copy-on-write every chunk.  Trash-row collisions
     # (every non-finalized position routes to E_local) are harmless:
     # scatter-set keeps one of them and the trash row is dropped below.
-    _, xs = lax.scan(body, init, chunks)
+    if overlap:
+        # Double-buffered: the [cap, k] gather for chunk c+1 is issued
+        # before chunk c's Gram/solve; the zero row is appended to the
+        # fixed table ONCE (the serial body re-concatenates per chunk —
+        # same values either way).
+        ct, _ = _gram_compute_dtype(fixed_factors)
+        fz = jnp.concatenate([
+            fixed_factors,
+            _match_varying(
+                jnp.zeros((k,), fixed_factors.dtype)[None], fixed_factors
+            ),
+        ])
+
+        def fetch(i):
+            nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
+            return fz[nb_c].astype(ct)
+
+        def compute(carry, g_cur, x, _i):
+            a0, b0 = carry
+            rt_c, wt_c, ts_c, cnt_c, cin_c, lseg_c = x
+            a, b = _entity_gram_chunk(
+                fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+                pregathered=g_cur,
+            )
+            x_rows = solve_chunk_rows(a, b, cnt_c)
+            a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
+            b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
+            return (a1, b1), x_rows[:e_c]
+
+        _, xs = prefetch_scan(
+            fetch, compute, nc, init,
+            xs=(chunks[1], chunks[2], chunks[3], chunks[5], chunks[6],
+                chunks[7]),
+        )
+    else:
+        _, xs = lax.scan(body, init, chunks)
     out = _match_varying(
         jnp.zeros((local_entities + 1, k), jnp.float32), neighbor_idx
     )
@@ -404,6 +471,7 @@ def als_half_step_tiled_dense(
     gram_backend: str | None = None,
     aweight_dense: jax.Array | None = None,  # [NC·C] per-entry A-weights
     stage: str = "full",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """Dense-stream tiled half-iteration (the many-entities side, unpadded).
 
@@ -416,13 +484,16 @@ def als_half_step_tiled_dense(
     path (iALS: ``implicit_reg`` + ``aweight_dense`` carrying √aw)
     multiplies the single gathered stream (gs = √aw·g, fused into the
     gather) and runs the kernel's unit-weight path on it — see
-    ``ials_tiled_half_step`` for the sqrt reparameterization."""
+    ``ials_tiled_half_step`` for the sqrt reparameterization.  ``overlap``
+    double-buffers the chunk scan exactly as in ``als_half_step_tiled``
+    (the dense gather for chunk c+1 runs under chunk c's Gram/solve)."""
     if implicit_reg is not None and aweight_dense is None:
         raise ValueError(
             "weighted dense-stream half-step needs aweight_dense (the "
             "per-entry A-weights aligned with the gather stream)"
         )
     backend = gram_backend or default_tiled_gram_backend()
+    overlap = resolve_overlap(overlap)
     nc, cap, e_c, t, nt, ng, bg = statics
     k = fixed_factors.shape[-1]
     ct, _ = _gram_compute_dtype(fixed_factors)
@@ -467,12 +538,11 @@ def als_half_step_tiled_dense(
         (acc, _, _), _ = lax.scan(probe, init, chunks)
         return acc.reshape(1, 1)
 
-    def body_solve(carry, chunk):
+    def gram_solve(carry, g, x):
         a0, b0 = carry
-        nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk[:6]
-        g = fz[nb_c].astype(ct)
+        rt_c, meta_c, lseg_c, cin_c, cnt_c = x[:5]
         if implicit_reg is not None:  # sqrt-weighted single stream
-            g = g * chunk[6].astype(ct)[:, None]
+            g = g * x[5].astype(ct)[:, None]
         a, b = gram_tiles_dense_pallas_dispatch(
             g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
             num_tiles=nt, num_groups=ng, block_rows=bg,
@@ -480,12 +550,12 @@ def als_half_step_tiled_dense(
         )
         if implicit_reg is None:
             cnt_full = jnp.concatenate([cnt_c, jnp.ones((1,), cnt_c.dtype)])
-            x = regularized_solve(a, b, cnt_full, lam, solver)
+            x_rows = regularized_solve(a, b, cnt_full, lam, solver)
         else:
-            x = regularized_solve_matrix(a, b, implicit_reg, solver)
+            x_rows = regularized_solve_matrix(a, b, implicit_reg, solver)
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
         b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
-        return (a1, b1), x[:e_c]
+        return (a1, b1), x_rows[:e_c]
 
     init = jax.tree.map(
         lambda z: _match_varying(z, neighbor_idx),
@@ -494,7 +564,27 @@ def als_half_step_tiled_dense(
             jnp.zeros((k,), jnp.float32),
         ),
     )
-    _, xs = lax.scan(body_solve, init, chunks)
+    if overlap:
+        # Double-buffered: chunk c+1's dense gather (the iteration's
+        # binding resource — see the layout rationale above) is issued
+        # before chunk c's Gram/solve; the √aw premultiply stays at
+        # compute time so the fetch is a pure gather.
+        def fetch(i):
+            nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
+            return fz[nb_c].astype(ct)
+
+        _, xs = prefetch_scan(
+            fetch,
+            lambda carry, g, x, _i: gram_solve(carry, g, x),
+            nc, init, xs=chunks[1:],
+        )
+    else:
+        _, xs = lax.scan(
+            lambda carry, chunk: gram_solve(
+                carry, fz[chunk[0]].astype(ct), chunk[1:]
+            ),
+            init, chunks,
+        )
     out = _match_varying(
         jnp.zeros((local_entities + 1, k), jnp.float32), neighbor_idx
     )
@@ -532,6 +622,7 @@ def als_half_step_tiled_accum(
     implicit_reg: jax.Array | None = None,
     gram_backend: str | None = None,
     stage: str = "full",
+    overlap: bool | None = None,
 ) -> jax.Array:
     """Accumulator-mode tiled half-iteration (the few-entities side).
 
@@ -551,8 +642,12 @@ def als_half_step_tiled_accum(
     comfortably in HBM; the builder picks this mode exactly when the fixed
     side is the big one, which is also when the solve side is small
     (480k-user table ⇔ 17.7k movies).
+
+    ``overlap`` double-buffers the chunk scan: chunk c+1's window select +
+    gather is issued before chunk c's Gram + accumulator scatter-add.
     """
     backend = gram_backend or default_tiled_gram_backend()
+    overlap = resolve_overlap(overlap)
     nc, cap, t, h, e_c = statics
     k = fixed_factors.shape[-1]
     nt = cap // t
@@ -640,9 +735,15 @@ def als_half_step_tiled_accum(
                 backend, unit_weights=implicit_reg is None,
                 zero_appended=True,
             )
-            # a[0] rows may be unwritten garbage for absent ranks in other
-            # chunks, but rank 0 always owns the chunk's first tile.
-            return acc + a[0, 0, 0] + b[0, 0], None
+            # Sink a row the pallas kernel is GUARANTEED to have written:
+            # the owner of the chunk's first tile (ts_c[0] — the accum
+            # analog of the stream probe's lseg-indexed a1/b1).  Row 0 is
+            # unwritten garbage in all-trash padding chunks, and garbage
+            # NaN would poison the probe accumulator (ADVICE r5).
+            s0 = ts_c[0]
+            a1 = lax.dynamic_index_in_dim(a, s0, 0, keepdims=False)
+            b1 = lax.dynamic_index_in_dim(b, s0, 0, keepdims=False)
+            return acc + a1[0, 0] + b1[0], None
 
         init = _match_varying(jnp.zeros((), jnp.float32), neighbor_idx)
         acc, _ = lax.scan(probe, init, chunks)
@@ -650,20 +751,23 @@ def als_half_step_tiled_accum(
     if stage not in ("accum", "full"):
         raise ValueError(f"accum mode has no stage {stage!r}")
 
-    def body(carry, chunk):
+    def accumulate(carry, a, b, ent_c):
+        # Rank rows owning no tile are unwritten garbage under the pallas
+        # backend; ent_c routes them (and any NaN they hold) to the trash
+        # row, which nothing reads.  The trash segment a[e_c] is dropped.
         acc_a, acc_b = carry
+        acc_a = acc_a.at[ent_c].add(a[:e_c])
+        acc_b = acc_b.at[ent_c].add(b[:e_c])
+        return acc_a, acc_b
+
+    def body(carry, chunk):
         nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
         fixed_slice = select_window(base_c)
         a, b = _entity_gram_chunk(
             fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None, zero_appended=True,
         )
-        # Rank rows owning no tile are unwritten garbage under the pallas
-        # backend; ent_c routes them (and any NaN they hold) to the trash
-        # row, which nothing reads.  The trash segment a[e_c] is dropped.
-        acc_a = acc_a.at[ent_c].add(a[:e_c])
-        acc_b = acc_b.at[ent_c].add(b[:e_c])
-        return (acc_a, acc_b), None
+        return accumulate(carry, a, b, ent_c), None
 
     init = jax.tree.map(
         lambda z: _match_varying(z, neighbor_idx),
@@ -672,7 +776,36 @@ def als_half_step_tiled_accum(
             jnp.zeros((local_entities + 1, k), jnp.float32),
         ),
     )
-    (acc_a, acc_b), _ = lax.scan(body, init, chunks)
+    if overlap:
+        # Double-buffered: chunk c+1's window select + slice-local gather
+        # runs under chunk c's Gram + accumulator scatter-add.  The window
+        # bases come from the raw [NC] chunk_base array so the fetch needs
+        # no chunk tuple.
+        ct, _ = _gram_compute_dtype(fixed_factors)
+        base_flat = chunk_base.reshape(nc)
+
+        def fetch(i):
+            base_c = lax.dynamic_index_in_dim(
+                base_flat, i, 0, keepdims=False
+            )
+            nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
+            return select_window(base_c)[nb_c].astype(ct)
+
+        def compute(carry, g_cur, x, _i):
+            rt_c, wt_c, ts_c, ent_c = x
+            a, b = _entity_gram_chunk(
+                fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                unit_weights=implicit_reg is None, zero_appended=True,
+                pregathered=g_cur,
+            )
+            return accumulate(carry, a, b, ent_c), None
+
+        (acc_a, acc_b), _ = prefetch_scan(
+            fetch, compute, nc, init,
+            xs=(chunks[1], chunks[2], chunks[3], chunks[5]),
+        )
+    else:
+        (acc_a, acc_b), _ = lax.scan(body, init, chunks)
     if stage == "accum":  # everything but the final solve
         return (acc_a[0, 0, 0] + acc_b[0, 0]).reshape(1, 1)
     a, b = acc_a[:local_entities], acc_b[:local_entities]

@@ -32,11 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
+from cfk_tpu.compat import shard_map as _compat_shard_map, to_varying
 from cfk_tpu.config import ALSConfig
 from cfk_tpu.data.blocks import (
     BucketedBlocks,
@@ -62,11 +58,7 @@ from cfk_tpu.ops.solve import (
 from cfk_tpu.parallel.mesh import AXIS, shard_rows, to_host
 
 
-def _to_varying(x, axis):
-    """Mark x device-varying over ``axis`` (pcast on jax ≥ 0.9, pvary before)."""
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, axis, to="varying")
-    return lax.pvary(x, axis)
+_to_varying = to_varying  # compat: pcast / pvary / identity by jax version
 
 
 def half_step_allgather(
@@ -82,14 +74,16 @@ def half_step_allgather(
     )
 
 
-def _gram_chunked(blk, nb_t, rt_t, mk_t, solve_chunk):
+def _gram_chunked(blk, nb_t, rt_t, mk_t, solve_chunk, overlap=None):
     """gather_gram over entity chunks: bounds the [chunk, P_ring, k] gather.
 
     An indivisible entity count is padded with zero-mask rows (their Grams
     are exact zeros, sliced off), so budget-derived chunk sizes always
-    work."""
+    work.  The chunk stream is double-buffered (``ops.pipeline.chunk_map``):
+    chunk c+1's operand fetch is issued while chunk c's Gram runs."""
     if solve_chunk is None or solve_chunk >= nb_t.shape[0]:
         return gather_gram(blk, nb_t, rt_t, mk_t)
+    from cfk_tpu.ops.pipeline import chunk_map
     from cfk_tpu.ops.solve import pad_rows_to_multiple
 
     e = nb_t.shape[0]
@@ -98,16 +92,38 @@ def _gram_chunked(blk, nb_t, rt_t, mk_t, solve_chunk):
     )
     n_chunks = (e + pad) // solve_chunk
     reshape = lambda x: x.reshape((n_chunks, solve_chunk) + x.shape[1:])
-    a, b = lax.map(
-        lambda c: gather_gram(blk, *c), (reshape(nb_t), reshape(rt_t), reshape(mk_t))
+    a, b = chunk_map(
+        lambda ni, ri, mi: gather_gram(blk, ni, ri, mi),
+        (reshape(nb_t), reshape(rt_t), reshape(mk_t)),
+        n_chunks, overlap=overlap,
     )
     k = blk.shape[-1]
     return a.reshape(e + pad, k, k)[:e], b.reshape(e + pad, k)[:e]
 
 
+def _ring_rotate(blk, perm, compute, *, overlap):
+    """One double-buffered ring step: the next block's ``ppermute`` is
+    issued BEFORE the Gram consumes the current one (two factor buffers
+    alive — the classic double buffer), so XLA's async collective-permute
+    scheduling can run the ICI transfer under the compute.  With
+    ``overlap=False`` an ``optimization_barrier`` pins the serial reference
+    schedule (compute fully drains, then the transfer starts) — the A/B
+    ``bench.py --overlap-ab`` measures.  Returns (compute result, next
+    block); both orders run identical ops on identical values, so factors
+    are bit-equal either way (``tests/test_overlap.py``)."""
+    if overlap:
+        nxt = lax.ppermute(blk, AXIS, perm)
+        out = compute(blk)
+    else:
+        out = compute(blk)
+        out, blk = lax.optimization_barrier((out, blk))
+        nxt = lax.ppermute(blk, AXIS, perm)
+    return out, nxt
+
+
 def half_step_ring(
     fixed_local, nb, rt, mk, cnt, *, lam, num_shards, solve_chunk=None,
-    solver="cholesky",
+    solver="cholesky", overlap=None, probe=None,
 ):
     """Per-shard half-iteration accumulating Gram blocks around a ppermute ring.
 
@@ -116,7 +132,17 @@ def half_step_ring(
     shard holds the factor block of fixed shard (my_index − r) mod S; the
     final step's block is consumed without a trailing ppermute (S−1 transfers
     per half-iteration, not S).
+
+    The loop is a double-buffered pipeline (``_ring_rotate``): block r+1's
+    transfer is in flight while block r's Gram accumulates.  ``probe``
+    (timing-only, used by the bench's exchange/compute split) runs just the
+    transfers ("exchange") or just the Gram/solve with no transfers
+    ("compute") — same op counts as the respective phase of the real
+    half-iteration, numerically meaningless factors.
     """
+    from cfk_tpu.ops.pipeline import resolve_overlap
+
+    overlap = resolve_overlap(overlap)
     my = lax.axis_index(AXIS)
     e = nb.shape[0]
     k = fixed_local.shape[-1]
@@ -130,12 +156,27 @@ def half_step_ring(
             jnp.take(rt, t, axis=1),
             jnp.take(mk, t, axis=1),
             solve_chunk,
+            overlap,
+        )
+
+    if probe == "exchange":  # transfers only; factors are a timing sink
+        blk = lax.fori_loop(
+            0, num_shards - 1,
+            lambda r, blk: lax.ppermute(blk, AXIS, perm),
+            fixed_local,
+        )
+        return jnp.zeros((e, k), jnp.float32) + jnp.sum(blk).astype(
+            jnp.float32
         )
 
     def body(r, carry):
         a, b, blk = carry
-        ap, bp = gram_at(blk, r)
-        blk = lax.ppermute(blk, AXIS, perm)
+        if probe == "compute":  # Gram/solve only: never rotate the block
+            ap, bp = gram_at(blk, r)
+            return (a + ap, b + bp, blk)
+        (ap, bp), blk = _ring_rotate(
+            blk, perm, lambda cur: gram_at(cur, r), overlap=overlap
+        )
         return (a + ap, b + bp, blk)
 
     # Mark the zero accumulators device-varying so the fori_loop carry type
@@ -218,12 +259,12 @@ def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs,
             u_new = half_u(m, ublk).astype(dtype)
         return u_new, m
 
-    return _shard_map(
+    return _compat_shard_map(
         iteration,
         mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
         out_specs=(P(AXIS, None), P(AXIS, None)),
-        check_vma=use_check_vma(config),
+        check=use_check_vma(config),
     )
 
 
@@ -293,7 +334,7 @@ def _tiled_to_tree(blocks: TiledBlocks, weighted: bool = False
 
 def half_step_tiled_ring(
     fixed_local, blk, chunks, local_entities, *, lam, num_shards,
-    solver="cholesky", gram_backend=None,
+    solver="cholesky", gram_backend=None, overlap=None, probe=None,
 ):
     """Tiled-layout half-iteration over the ppermute ring (block-to-block
     join) — the reference's headline join strategy at the at-scale layout.
@@ -309,9 +350,16 @@ def half_step_tiled_ring(
     block-to-block property), traded against the O(E_local·k²)
     accumulator the join needs on TPU — PARITY.md discusses when that
     trade wins.
+
+    Each ring step is double-buffered (``_ring_rotate``): the next block's
+    ppermute is issued before the current block's chunk loop starts, so
+    the ICI transfer hides behind the slice's Gram accumulation.
+    ``probe``/``overlap`` as in ``half_step_ring``.
     """
+    from cfk_tpu.ops.pipeline import resolve_overlap
     from cfk_tpu.ops.tiled import _entity_gram_chunk, default_tiled_gram_backend
 
+    overlap = resolve_overlap(overlap)
     backend = gram_backend or default_tiled_gram_backend()
     _, _, nc, cap, t, h, e_c = chunks
     s = num_shards
@@ -347,11 +395,27 @@ def half_step_tiled_ring(
 
         return lax.fori_loop(starts[t_idx], starts[t_idx + 1], chunk_body, acc)
 
+    if probe == "exchange":  # transfers only; factors are a timing sink
+        factors = lax.fori_loop(
+            0, s - 1,
+            lambda r, f: lax.ppermute(f, AXIS, perm),
+            fixed_local,
+        )
+        return jnp.zeros((local_entities, k), jnp.float32) + jnp.sum(
+            factors
+        ).astype(jnp.float32)
+
     def body(r, carry):
         acc_a, acc_b, factors = carry
         t_idx = (my - r) % s
-        acc_a, acc_b = slice_grams((acc_a, acc_b), factors, t_idx)
-        factors = lax.ppermute(factors, AXIS, perm)
+        if probe == "compute":  # chunk loops only: never rotate the block
+            acc_a, acc_b = slice_grams((acc_a, acc_b), factors, t_idx)
+            return acc_a, acc_b, factors
+        (acc_a, acc_b), factors = _ring_rotate(
+            factors, perm,
+            lambda cur: slice_grams((acc_a, acc_b), cur, t_idx),
+            overlap=overlap,
+        )
         return acc_a, acc_b, factors
 
     a0 = _to_varying(
@@ -459,6 +523,7 @@ def make_training_step(
     tiled=False,
     m_ring=False,
     u_ring=False,
+    ring_probe=None,
 ):
     """Build the jittable one-full-iteration SPMD step (solve M, then U).
 
@@ -468,6 +533,11 @@ def make_training_step(
     solves each width bucket of the local shard; the segment layout
     (``segment=True``; ``m_chunks`` is then the static scan-window hint)
     all_gathers the fixed side and segment-sums the local flat rating run.
+
+    ``config.overlap`` selects the double-buffered (comm/compute overlapped)
+    ring and chunk schedules — the default — or the serial reference
+    schedule; ``ring_probe`` ("exchange"/"compute", timing-only) builds the
+    split-measurement step the bench's overlap A/B uses.
     """
     dtype = jnp.dtype(config.dtype)
     if uspecs is None:
@@ -519,7 +589,8 @@ def make_training_step(
                 return half_step_tiled_ring(
                     fixed_local, blk, chunks, local,
                     lam=config.lam, num_shards=config.num_shards,
-                    solver=config.solver,
+                    solver=config.solver, overlap=config.overlap,
+                    probe=ring_probe,
                 )
 
             return half
@@ -528,7 +599,7 @@ def make_training_step(
             def solve(fixed_full, blk, _gram):
                 return tiled_half_step(
                     fixed_full, blk, chunks, local, config.lam,
-                    solver=config.solver,
+                    solver=config.solver, overlap=config.overlap,
                 )
 
             return gathered_half(solve)
@@ -570,7 +641,7 @@ def make_training_step(
             def solve(fixed_full, blk, _gram):
                 return als_half_step_bucketed(
                     fixed_full, blk, chunks, local, config.lam,
-                    solver=config.solver,
+                    solver=config.solver, overlap=config.overlap,
                 )
 
             return solve
@@ -594,6 +665,8 @@ def make_training_step(
             lam=config.lam,
             num_shards=config.num_shards,
             solver=config.solver,
+            overlap=config.overlap,
+            probe=ring_probe,
         )
 
     # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI bytes
@@ -654,7 +727,10 @@ def train_als_sharded(
     (the explicit form of the reference's never-read per-iteration topic
     journal — SURVEY.md §5 checkpoint/resume).
     """
+    from cfk_tpu.config import apply_overlap_xla_flags
+
     s = config.num_shards
+    apply_overlap_xla_flags(config)
     validate_sharded_dataset(dataset, config, mesh)
 
     gathered = gathered_layout_trees(dataset, config)

@@ -107,6 +107,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="tiled: separate chunk size for the accum (movie) "
                    "side — its per-chunk VMEM need is tiny, so bigger "
                    "chunks cut scan overheads")
+    p.add_argument("--overlap", default="on", choices=["on", "off"],
+                   help="comm/compute overlap A/B axis: 'on' (default) = "
+                   "double-buffered chunk/ring pipelines "
+                   "(cfk_tpu.ops.pipeline), 'off' = the serial reference "
+                   "schedule — same math, bit-identical factors")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -140,6 +145,10 @@ def run_lab(args) -> dict:
         import cfk_tpu.ops.pallas.solve_kernel as sk
 
         sk.default_reg_solve_algo = lambda: args.reg_solve_algo
+    if args.overlap == "off":
+        import cfk_tpu.ops.pipeline as pipeline_mod
+
+        pipeline_mod.default_overlap = lambda: False
     if args.group_tiles is not None:
         import cfk_tpu.ops.pallas.gram_kernel as gk
 
@@ -248,7 +257,7 @@ def run_lab(args) -> dict:
         "layout": args.layout, "solver": args.solver,
         "chunk_elems": args.chunk_elems, "dtype": dt,
         "gram_backend": args.gram_backend, "rank": args.rank,
-        "iters_per_call": args.iters,
+        "iters_per_call": args.iters, "overlap": args.overlap,
     }
     print(json.dumps(row))
     return row
