@@ -55,7 +55,8 @@ def _compact_row(row: dict) -> dict:
         return {"error": row["error"][:120]}
     keep = ("value", "vs_baseline", "vs_gather_roofline", "s_per_iteration",
             "s_per_iteration_median", "rmse_best_seed", "layout",
-            "exchange_s_per_iter", "compute_s_per_iter")
+            "exchange_s_per_iter", "compute_s_per_iter",
+            "factors_bit_exact", "removed_bytes_per_chunk")
     return {k: row[k] for k in keep if k in row}
 
 
@@ -106,6 +107,16 @@ def main() -> None:
             ov = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# overlap_ring: " + json.dumps(ov))
         rows["overlap_ring"] = ov
+    # The fused/split Gram+solve epilogue A/B + removed-HBM-traffic
+    # estimate (subprocess for the same virtual-mesh reason).
+    # CFK_BENCH_FUSED=0 skips it.
+    if os.environ.get("CFK_BENCH_FUSED", "1") != "0":
+        try:
+            fa = _fused_ab_row()
+        except Exception as e:  # pragma: no cover - subprocess-dependent
+            fa = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# fused_epilogue: " + json.dumps(fa))
+        rows["fused_epilogue"] = fa
     if os.environ.get("CFK_BENCH_HEADLINE", "1") != "0":
         for name, fn in (
             ("full_rank64", full_rank64_row),
@@ -803,6 +814,163 @@ def run_overlap_ab(args) -> dict:
     }
 
 
+def fused_ab_main(args) -> None:
+    print(json.dumps(run_fused_ab(args)))
+
+
+def _fused_ab_row() -> dict:
+    """The default-run fused/split row: a subprocess, because the virtual
+    CPU mesh needs ``xla_force_host_platform_device_count`` set before jax
+    initializes (main() has already initialized the backend by now)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, __file__, "--fused-ab"],
+        capture_output=True, text=True, timeout=3600,
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip()[-300:]
+        return {"error": f"fused-ab subprocess failed: {tail}"}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_fused_ab(args) -> dict:
+    """Tentpole A/B: fused Gram+solve epilogue (each chunk's normal
+    equations solved inside the Gram kernel's VMEM residency) vs the split
+    Gram→HBM→solve schedule, on the ML-25M-proportioned synthetic shape
+    scaled by ``--fused-div``, sharded over a virtual CPU mesh.
+
+    Like ``--overlap-ab``, absolute seconds on the CPU mesh are relative
+    only (the emulation route has no VMEM to win back); the portable
+    quantities are the factor-equivalence check (bit-exact on the
+    emulation route — the twin and the split path run the identical
+    segment-sum + fused reg+solve) and the analytic per-chunk HBM traffic
+    the fused path removes on the real Pallas route: the split schedule
+    writes the [Ec+1, k, k] A-batch + [Ec+1, k] b to HBM and reads both
+    back for the batched solve; fused writes only the solved [Ec+1, k]
+    rows + one [k, k+1] carry row.
+    """
+    import dataclasses as dc
+
+    jax = _virtual_cpu_mesh(args.shards)
+    import jax.numpy as jnp
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.ops.solve import init_factors_stats
+    from cfk_tpu.parallel import spmd
+    from cfk_tpu.parallel.mesh import make_mesh, shard_rows
+
+    div = args.fused_div
+    users, movies, nnz = 162_541 // div, 59_047 // div, 25_000_095 // div
+    rank, s, iters = args.fused_rank, args.shards, args.iterations
+    coo = synthetic_netflix_coo(users, movies, nnz, seed=args.seed)
+    # Force BOTH halves into the dense-stream chunk scan (accum off): the
+    # per-chunk fused epilogue is what this A/B measures, and at the
+    # div-scaled shape the default accum threshold would swallow both
+    # halves into the end-of-scan solve (whose fused/split pair differs by
+    # elimination algorithm, not by the removed round-trip).
+    ds = Dataset.from_coo(
+        coo, layout="tiled", num_shards=s,
+        chunk_elems=args.fused_chunk_elems,
+        accum_max_entities=0, dense_stream=True,
+    )
+    mesh = make_mesh(s)
+    base = ALSConfig(
+        rank=rank, lam=0.05, num_iterations=iters, seed=0, layout="tiled",
+        exchange="all_gather", solver="pallas", num_shards=s,
+    )
+
+    mtree, utree, step_kw = spmd.gathered_layout_trees(ds, base)
+    mtree = shard_rows(mesh, mtree)
+    utree = shard_rows(mesh, utree)
+
+    def init_factors():
+        key = jax.random.PRNGKey(0)
+        u0 = jax.jit(
+            init_factors_stats, static_argnames=("rank", "num_entities")
+        )(
+            key, jnp.asarray(ds.user_blocks.rating_sum),
+            jnp.asarray(ds.user_blocks.count), rank=rank,
+            num_entities=ds.user_blocks.num_entities,
+        )
+        m0 = jnp.zeros((ds.movie_blocks.padded_entities, rank), jnp.float32)
+        return shard_rows(mesh, u0), shard_rows(mesh, m0)
+
+    def timed(cfg):
+        step = jax.jit(
+            spmd.make_training_step(
+                mesh, cfg, spmd.tree_specs(mtree), spmd.tree_specs(utree),
+                **step_kw,
+            )
+        )
+        u, m = init_factors()
+        u, m = step(u, m, mtree, utree)  # compile + warm
+        jax.block_until_ready((u, m))
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.time()
+            for _ in range(iters):
+                u, m = step(u, m, mtree, utree)
+            jax.block_until_ready((u, m))
+            times.append((time.time() - t0) / iters)
+        return min(times), np.asarray(u, np.float32), np.asarray(
+            m, np.float32
+        )
+
+    on_s, on_u, on_m = timed(dc.replace(base, fused_epilogue=True))
+    off_s, off_u, off_m = timed(dc.replace(base, fused_epilogue=False))
+    max_diff = float(
+        max(np.abs(on_u - off_u).max(), np.abs(on_m - off_m).max())
+    )
+    # Analytic per-chunk HBM traffic on the real Pallas route.  BOTH
+    # halves run the per-chunk dstream scan here (accum_max_entities=0
+    # above), so the removed-per-iteration number sums both; the headline
+    # per-chunk pair is quoted from the user half (the bigger scan).
+    def _half_bytes(blocks):
+        s_rows = blocks.chunk_entities + 1  # Ec + trash
+        split = 2 * s_rows * rank * (rank + 1) * 4  # A+b write AND readback
+        fused = s_rows * rank * 4 + rank * (rank + 1) * 4  # x + carry row
+        return split, fused, blocks.num_chunks
+
+    ub = ds.user_blocks
+    split_ab, fused_wb, chunks_per_iter = _half_bytes(ub)
+    removed_iter = sum(
+        (sp - fu) * nc
+        for sp, fu, nc in (_half_bytes(ds.user_blocks),
+                           _half_bytes(ds.movie_blocks))
+    )
+    return {
+        "metric": "synthetic_ml25m_fused_epilogue_ab_s_per_iteration",
+        "value": round(on_s, 4),
+        "unit": "s/iteration",
+        # the A/B itself: ≤ 1.0 = fused no slower than split.  On the CPU
+        # emulation route both run the same XLA ops, so ~1.0 is the honest
+        # expectation here; the HBM win is Pallas-route-only.
+        "vs_baseline": round(on_s / off_s, 4),
+        "fused_on_s_per_iter": round(on_s, 4),
+        "fused_off_s_per_iter": round(off_s, 4),
+        "max_abs_factor_diff_fused_vs_split": max_diff,
+        "factors_bit_exact": bool(max_diff == 0.0),
+        # per-chunk HBM bytes on the Pallas route (analytic, from the
+        # built statics): what split round-trips vs what fused writes back.
+        "split_chunk_ab_roundtrip_bytes": split_ab,
+        "fused_chunk_writeback_bytes": fused_wb,
+        "removed_bytes_per_chunk": split_ab - fused_wb,
+        "stream_chunks_per_shard_per_iter": chunks_per_iter,
+        "removed_bytes_per_iter_per_shard": removed_iter,
+        "chunk_entities": ub.chunk_entities,
+        "user_half_mode": ub.mode,
+        "movie_half_mode": ds.movie_blocks.mode,
+        "users": users, "movies": movies, "ratings": nnz, "rank": rank,
+        "shards": s, "iterations": iters, "repeats": args.repeats,
+        "layout": "tiled+all_gather", "fused_div": div,
+        "backend": "cpu-virtual-mesh (relative timings; HBM bytes analytic)",
+    }
+
+
 def compare_exchange_main(args) -> None:
     """The reference's headline experiment (its README.md:216-224): the
     block-to-block join (ring) vs the all-to-all join (all_gather), same
@@ -940,6 +1108,21 @@ if __name__ == "__main__":
                         "(all-to-all join) on an 8-virtual-device CPU mesh "
                         "— the reference's README.md:216-224 experiment")
     parser.add_argument("--shards", type=int, default=8)
+    parser.add_argument("--fused-ab", action="store_true",
+                        help="fused Gram+solve epilogue vs split "
+                        "Gram→HBM→solve A/B + per-chunk HBM traffic "
+                        "estimate on a virtual CPU mesh (ML-25M shape / "
+                        "--fused-div)")
+    parser.add_argument("--fused-div", type=int, default=128,
+                        help="ML-25M shape divisor for --fused-ab (the "
+                        "default keeps the CPU-mesh A/B under a few "
+                        "minutes — the emulation route interprets the "
+                        "solve kernels)")
+    parser.add_argument("--fused-rank", type=int, default=16)
+    parser.add_argument("--fused-chunk-elems", type=int, default=16_384,
+                        help="tiled chunk size for --fused-ab (small "
+                        "enough that the stream half scans several chunks "
+                        "per shard, so the per-chunk fusion is exercised)")
     parser.add_argument("--overlap-ab", action="store_true",
                         help="double-buffered vs serial ring exchange A/B "
                         "+ exchange/compute timing split on a virtual CPU "
@@ -960,7 +1143,9 @@ if __name__ == "__main__":
                         "so the chunk pipeline is exercised too)")
     cli_args = parser.parse_args()
     run = (
-        (lambda: overlap_ab_main(cli_args))
+        (lambda: fused_ab_main(cli_args))
+        if cli_args.fused_ab
+        else (lambda: overlap_ab_main(cli_args))
         if cli_args.overlap_ab
         else (lambda: compare_exchange_main(cli_args))
         if cli_args.compare_exchange

@@ -96,3 +96,28 @@ def to_varying(x, axis):
     if hasattr(lax, "pvary"):
         return lax.pvary(x, axis)
     return x
+
+
+def emulate_fused_gram_solve(a, b, reg, *, reg_mode, lam, lseg):
+    """XLA twin of the fused Gram+solve epilogue — the interpret/old-jax
+    route, so CPU CI exercises the same code shape the Mosaic kernel runs.
+
+    Given the chunk's emulated (A [S, k, k], b [S, k]) normal-equation
+    sums, return exactly what ``gram_solve_tiles_pallas`` returns:
+
+        (x [S, k], carry_a [k, k], carry_b [k])
+
+    — the carry row extracted RAW (pre-ridge) at ``lseg``, and the whole
+    batch regularized + solved by the same fused reg+solve elimination the
+    kernel's epilogue runs (``gauss_solve_reg_pallas``, which interprets
+    off-TPU).  Because the split chunk path computes the identical
+    segment-sum (A, b) and calls the identical reg+solve on it, fused and
+    split factors are BIT-IDENTICAL on this route — the equivalence the
+    fused/split regression tests pin.
+    """
+    from cfk_tpu.ops.pallas.solve_kernel import gauss_solve_reg_pallas
+
+    x = gauss_solve_reg_pallas(a, b, reg, reg_mode=reg_mode, lam=lam)
+    ca = lax.dynamic_index_in_dim(a, lseg, 0, keepdims=False)
+    cb = lax.dynamic_index_in_dim(b, lseg, 0, keepdims=False)
+    return x, ca, cb

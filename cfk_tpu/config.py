@@ -143,6 +143,23 @@ class ALSConfig:
     # the next starts) — the measurement baseline of bench.py --overlap-ab.
     # Factors are bit-identical either way (tests/test_overlap.py).
     overlap: bool = True
+    # Fused Gram+solve epilogue: solve each chunk's normal equations INSIDE
+    # the pallas Gram kernel's VMEM residency (ridge + lane-vectorized
+    # elimination on the resident [Ec, k, k] batch), writing back only the
+    # solved [Ec, k] factor rows — the split path's per-chunk A-batch HBM
+    # write + readback disappears (cfk_tpu/ops/pallas/gram_kernel.py;
+    # ARCHITECTURE.md "Fused Gram+solve epilogue").  None = the process
+    # default (on wherever legal: pallas gram backend + pallas solver +
+    # rank within the fused elimination's cap — LU 128 / GJ 64 — with
+    # automatic fallback to the split schedule otherwise).  False pins the
+    # split Gram→HBM→solve schedule in the tiled chunk scans (factors
+    # bit-exact either way — the split chunk solve keeps the one-pass
+    # reg+solve kernel, so only the round-trip toggles; the bench.py
+    # --fused-ab baseline) and gates the accum/ring paths' final fused
+    # reg+solve pass.  The knob does not reach the segment/bucketed/
+    # padded half-steps, whose solves follow the process default
+    # (ops.solve.default_fused_epilogue) only.
+    fused_epilogue: bool | None = None
     # Escape hatch for XLA's async collective-permute scheduling on TPU —
     # the compiler pass that actually hides the ring's ppermute behind the
     # double-buffered Gram compute.  "auto" leaves the compiler default
@@ -244,6 +261,11 @@ class ALSConfig:
             raise ValueError(
                 "unknown async_collective_permute "
                 f"{self.async_collective_permute!r}"
+            )
+        if self.fused_epilogue not in (None, True, False):
+            raise ValueError(
+                f"fused_epilogue must be None/True/False, got "
+                f"{self.fused_epilogue!r}"
             )
         if self.rank < 1:
             raise ValueError(f"rank must be >= 1, got {self.rank}")

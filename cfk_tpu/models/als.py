@@ -239,7 +239,7 @@ def _segment_device_setup(dataset: Dataset):
 
 def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
           x_prev=None, algorithm="als", block_size=32, sweeps=1,
-          overlap=None):
+          overlap=None, fused_epilogue=None):
     """Solve one side against fixed factors; dispatches on the block layout
     (tuple = width buckets, dict with segment ids = flat segment run,
     other dict = one padded rectangle).  ``algorithm="als++"`` runs
@@ -270,7 +270,8 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         from cfk_tpu.ops.tiled import tiled_half_step
 
         return tiled_half_step(
-            fixed, blk, chunks, entities, lam, solver=solver, overlap=overlap
+            fixed, blk, chunks, entities, lam, solver=solver,
+            overlap=overlap, fused_epilogue=fused_epilogue,
         )
     if "seg_rel" in blk:
         return als_half_step_segment(
@@ -303,7 +304,8 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
 
 
 _LAYOUT_STATICS = ("m_chunks", "u_chunks", "m_entities", "u_entities")
-_ALG_STATICS = ("algorithm", "block_size", "sweeps", "overlap")
+_ALG_STATICS = ("algorithm", "block_size", "sweeps", "overlap",
+                "fused_epilogue")
 
 
 @functools.partial(
@@ -327,6 +329,7 @@ def _train_loop(
     block_size: int = 32,
     sweeps: int = 1,
     overlap: bool | None = None,
+    fused_epilogue: bool | None = None,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -350,7 +353,7 @@ def _train_loop(
             u, movie_blocks, user_blocks,
             lam=lam, solve_chunk=solve_chunk, dt=dt, solver=solver,
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-            overlap=overlap, m_prev=m_prev,
+            overlap=overlap, fused_epilogue=fused_epilogue, m_prev=m_prev,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -363,7 +366,8 @@ def _train_loop(
 
 def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
                     solver="cholesky", algorithm="als", block_size=32,
-                    sweeps=1, overlap=None, m_prev=None, m_chunks=None,
+                    sweeps=1, overlap=None, fused_epilogue=None,
+                    m_prev=None, m_chunks=None,
                     u_chunks=None, m_entities=None, u_entities=None):
     """One full iteration (solve M from U, then U from M) — the single source
     of the per-iteration math for both the fused-loop and checkpointed paths.
@@ -374,7 +378,7 @@ def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
     (``m_prev`` / the ``u`` carry) with subspace sweeps.
     """
     alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-               overlap=overlap)
+               overlap=overlap, fused_epilogue=fused_epilogue)
     m = _half(
         u, movie_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -406,6 +410,7 @@ def _one_iteration(
     block_size: int = 32,
     sweeps: int = 1,
     overlap: bool | None = None,
+    fused_epilogue: bool | None = None,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -415,7 +420,7 @@ def _one_iteration(
         u, movie_blocks, user_blocks,
         lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype), solver=solver,
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-        overlap=overlap, m_prev=m_prev,
+        overlap=overlap, fused_epilogue=fused_epilogue, m_prev=m_prev,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -486,6 +491,7 @@ def train_als(
                 block_size=config.block_size,
                 sweeps=config.sweeps,
                 overlap=config.overlap,
+                fused_epilogue=config.fused_epilogue,
                 **layout_kw,
             )
             u.block_until_ready()
@@ -515,6 +521,7 @@ def train_als(
                 dtype=config.dtype, solver=config.solver,
                 algorithm=config.algorithm, block_size=config.block_size,
                 sweeps=config.sweeps, overlap=config.overlap,
+                fused_epilogue=config.fused_epilogue,
                 **layout_kw,
             )
 

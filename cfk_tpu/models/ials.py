@@ -71,7 +71,7 @@ class IALSConfig(ALSConfig):
 
 def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
                entities=None, x_prev=None, algorithm="als", block_size=32,
-               sweeps=1, overlap=None):
+               sweeps=1, overlap=None, fused_epilogue=None):
     """Dispatch on block layout (tuple = buckets, dict with segment ids =
     flat segment run, other dict = padded rectangle).  ``algorithm="ials++"``
     runs warm-started subspace sweeps from ``x_prev`` instead of full
@@ -106,7 +106,7 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
         # raises a rebuild/steering error inside.
         return ials_tiled_half_step(
             fixed, blk, chunks, entities, lam, alpha, gram=gram,
-            solver=solver, overlap=overlap,
+            solver=solver, overlap=overlap, fused_epilogue=fused_epilogue,
         )
     if "seg_rel" in blk:
         return ials_half_step_segment(
@@ -125,14 +125,14 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     jax.jit,
     static_argnames=(
         "rank", "num_iterations", "lam", "alpha", "dtype", "solver",
-        "algorithm", "block_size", "sweeps", "overlap",
+        "algorithm", "block_size", "sweeps", "overlap", "fused_epilogue",
         "m_chunks", "u_chunks", "m_entities", "u_entities",
     ),
 )
 def _train_loop(
     key, movie_blocks, user_blocks, u_stats=None, *, rank, num_iterations, lam,
     alpha, dtype, solver="cholesky", algorithm="als", block_size=32, sweeps=1,
-    overlap=None,
+    overlap=None, fused_epilogue=None,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     dt = jnp.dtype(dtype)
@@ -153,7 +153,7 @@ def _train_loop(
             u, m_prev, movie_blocks, user_blocks,
             lam=lam, alpha=alpha, dt=dt, solver=solver,
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-            overlap=overlap,
+            overlap=overlap, fused_epilogue=fused_epilogue,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -163,13 +163,14 @@ def _train_loop(
 
 def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
                          dt, solver, algorithm, block_size, sweeps,
-                         overlap=None, m_chunks=None, u_chunks=None,
+                         overlap=None, fused_epilogue=None,
+                         m_chunks=None, u_chunks=None,
                          m_entities=None, u_entities=None):
     """One full iALS iteration (movies from users, then users from movies) —
     the single source of the per-iteration math for the fused-loop and
     checkpointed paths (mirrors ``als._iteration_body``)."""
     alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-               overlap=overlap)
+               overlap=overlap, fused_epilogue=fused_epilogue)
     m = _ials_half(
         u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -185,22 +186,22 @@ def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
     jax.jit,
     static_argnames=(
         "lam", "alpha", "dtype", "solver", "algorithm", "block_size",
-        "sweeps", "overlap", "m_chunks", "u_chunks", "m_entities",
-        "u_entities",
+        "sweeps", "overlap", "fused_epilogue", "m_chunks", "u_chunks",
+        "m_entities", "u_entities",
     ),
     donate_argnums=(0, 1),
 )
 def _one_iteration(
     u, m_prev, movie_blocks, user_blocks, *, lam, alpha, dtype,
     solver="cholesky", algorithm="als", block_size=32, sweeps=1,
-    overlap=None,
+    overlap=None, fused_epilogue=None,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     return _ials_iteration_body(
         u, m_prev, movie_blocks, user_blocks,
         lam=lam, alpha=alpha, dt=jnp.dtype(dtype), solver=solver,
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-        overlap=overlap,
+        overlap=overlap, fused_epilogue=fused_epilogue,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -279,6 +280,7 @@ def train_ials(
                 block_size=config.block_size,
                 sweeps=config.sweeps,
                 overlap=config.overlap,
+                fused_epilogue=config.fused_epilogue,
                 **layout_kw,
             )
             u.block_until_ready()
@@ -308,6 +310,7 @@ def train_ials(
                 solver=config.solver, algorithm=config.algorithm,
                 block_size=config.block_size, sweeps=config.sweeps,
                 overlap=config.overlap,
+                fused_epilogue=config.fused_epilogue,
                 **layout_kw,
             )
 
@@ -420,6 +423,7 @@ def make_ials_training_step(
                 return ials_tiled_half_step(
                     fixed_full, blk, chunks, local, config.lam, config.alpha,
                     gram=gram, solver=config.solver, overlap=config.overlap,
+                    fused_epilogue=config.fused_epilogue,
                 )
 
             return solve
@@ -549,21 +553,31 @@ def train_ials_sharded(
         m = shard_rows(mesh, state.movie_factors.astype(dt))
     else:
         start_iter = 0
+        # Draw at the REAL entity count so the init (hence the trajectory)
+        # is independent of shard-count padding — see init_factors_stats.
         key = jax.random.PRNGKey(config.seed)
+        init_kw = dict(
+            rank=config.rank,
+            num_entities=dataset.user_blocks.num_entities,
+        )
         if stats_init:
-            u = jax.jit(init_factors_stats, static_argnames="rank")(
+            u = jax.jit(
+                init_factors_stats, static_argnames=("rank", "num_entities")
+            )(
                 key,
                 jnp.asarray(dataset.user_blocks.rating_sum),
                 jnp.asarray(dataset.user_blocks.count),
-                rank=config.rank,
+                **init_kw,
             ).astype(dt)
         else:
-            u = jax.jit(init_factors, static_argnames="rank")(
+            u = jax.jit(
+                init_factors, static_argnames=("rank", "num_entities")
+            )(
                 key,
                 jnp.asarray(dataset.user_blocks.rating),
                 jnp.asarray(dataset.user_blocks.mask),
                 jnp.asarray(dataset.user_blocks.count),
-                rank=config.rank,
+                **init_kw,
             ).astype(dt)
         u = shard_rows(mesh, u)
         m = shard_rows(

@@ -45,6 +45,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from cfk_tpu.compat import has_vma_system, typeof_vma
 from jax.experimental import pallas as pl
@@ -54,31 +55,18 @@ try:  # TPU-specific extensions; absent on some builds
 except ImportError:  # pragma: no cover
     pltpu = None
 
+_SOLVE_LANES = 128  # lane width of the fused epilogue's solve tiles — the
+# same 128-system batching the standalone solve kernels use
 
-def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
-                        with_carry):
-    # refs = (rt_ref, [ca_ref, cb_ref, ci_ref], a_ref, b_ref): the carry
-    # triple present iff the caller folds a previous chunk's partial
-    # (A, b) into segment 0 (stream mode's boundary straddle — doing it
-    # here is ~free, while folding it outside either rewrote the whole
-    # Gram batch through HBM or cost a separate one-system solve per
-    # chunk, 97 ms/iter at rank 128).  Per-entry weights are expressed
-    # upstream as the sqrt-reparameterized stream (g = √w·f — see
-    # ``ops.tiled.ials_tiled_half_step``), so ONE stream serves both
-    # weight modes; round 4's second premultiplied gw stream is gone.
-    refs = list(refs)
-    a_ref, b_ref = refs[-2:]
-    del refs[-2:]
-    if with_carry:
-        ca_ref, cb_ref, ci_ref = refs[-3:]
-        del refs[-3:]
-    rt_ref = refs[0]
-    gi = pl.program_id(0)
-    base = gi * m
-    # All m tile Grams are issued before the accumulation walk (they have
-    # no dependence on it), so the MXU pipelines them back-to-back.  Tiles
-    # are sliced statically — a [m·t, k] → [m, t, k] shape cast is not
-    # supported by Mosaic's layout inference for every (t, k).
+
+def _tile_grams(g_ref, rt_ref, *, m, t, k, precision):
+    """The m tile Grams of one grid step's [m·t, k] factor block.
+
+    All m are issued before the accumulation walk (they have no dependence
+    on it), so the MXU pipelines them back-to-back.  Tiles are sliced
+    statically — a [m·t, k] → [m, t, k] shape cast is not supported by
+    Mosaic's layout inference for every (t, k).
+    """
     a_all, b_all = [], []
     for i in range(m):  # m is static → unrolled
         g_i = g_ref[i * t:(i + 1) * t, :]  # [t, k]
@@ -91,86 +79,19 @@ def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
             r_i.astype(g_i.dtype), g_i, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         ))  # [1, k]
-
-    def flush(row, began, acc_a, acc_b):
-        @pl.when(began)
-        def _assign():
-            a_ref[pl.ds(row, 1)] = acc_a[None]
-            b_ref[pl.ds(row, 1)] = acc_b[None]
-
-        @pl.when(jnp.logical_not(began))
-        def _accumulate():
-            a_ref[pl.ds(row, 1)] += acc_a[None]
-            b_ref[pl.ds(row, 1)] += acc_b[None]
-
-    # Walk the group's tiles holding the running owner's partial (A, b) in
-    # registers; output rows are touched only when the owner changes — ~one
-    # write per entity instead of one read-modify-write per tile.  ``began``
-    # = the running owner's first tile is inside this group, so its flush
-    # ASSIGNS (first visit — which is what makes zero-init unnecessary);
-    # otherwise the row already holds earlier groups' partials and the
-    # flush accumulates.  Rows owning no tile are never written (garbage);
-    # callers route them to trash exactly as they did for the v1 kernel.
-    began = (gi == 0) | (seg_ref[base] != seg_ref[jnp.maximum(base - 1, 0)])
-    acc_a, acc_b = a_all[0], b_all[0]
-    if with_carry:
-        # Segment 0 owns the chunk's first tile whenever cin is 1 (the
-        # continued entity has entries here by definition), so adding the
-        # scaled carry into the running partial at grid step 0 lands it in
-        # segment 0's flushed row; cin = 0 multiplies it away.
-        fold = jnp.where(gi == 0, ci_ref[0, 0], 0.0)
-        acc_a = acc_a + fold * ca_ref[...]
-        acc_b = acc_b + fold * cb_ref[...]
-    for i in range(1, m):  # m is static → unrolled
-        change = seg_ref[base + i] != seg_ref[base + i - 1]
-        prev_row = seg_ref[base + i - 1]
-
-        @pl.when(change)
-        def _flush(row=prev_row, began=began, acc_a=acc_a, acc_b=acc_b):
-            flush(row, began, acc_a, acc_b)
-
-        # Arithmetic select: acc·keep + a is ONE fused multiply-add per
-        # vreg where where(keep, acc+a, a) costs an add AND a select —
-        # the accumulation chain is the kernel's VPU hot path (~60 ns/tile
-        # over 1.8M tiles/iter at full Netflix).  Failure-mode caveat: a
-        # non-finite acc (diverged factors) survives the ×0.0 reset as NaN
-        # (inf·0 = NaN), so ONE bad tile Gram poisons every later segment
-        # in the group, where a where-select would have discarded it at
-        # the boundary.  Acceptable: non-finite factors are already a
-        # broken run, and the trainers' outputs go NaN either way — this
-        # only widens the blast radius within an already-lost iteration.
-        keep_f = 1.0 - change.astype(jnp.float32)
-        acc_a = acc_a * keep_f + a_all[i]
-        acc_b = acc_b * keep_f + b_all[i]
-        began = jnp.logical_or(began, change)
-    flush(seg_ref[base + m - 1], began, acc_a, acc_b)
+    return a_all, b_all
 
 
-def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
-                       precision, with_carry):
-    # Dense-stream variant: tiles are [t]-row WINDOWS into the dense
-    # gathered stream at 16-aligned dynamic offsets (``pl.multiple_of``
-    # — Mosaic rejects unhinted dynamic sublane slices of bf16 refs, and
-    # sub-(16,128)-tile offsets straddle two VMEM tiles per vreg load,
-    # which measured away the whole dense-stream win), with
-    # rows outside [lo, hi) masked out of ONE dot operand (zeroed rows
-    # contribute nothing to A; the tile-aligned rt carries zeros outside
-    # the window, so b needs no mask).  Walk/flush semantics are identical
-    # to ``_gram_groups_kernel``: owners' tiles are contiguous (trash
-    # slots inherit the previous owner's seg with an empty window), rows
-    # of absent segments are never written.  Weighted (iALS) runs stream
-    # gs = √aw·f through this same unit-weight form (sqrt
-    # reparameterization, ``ops.tiled.ials_tiled_half_step``).
-    refs = list(refs)
-    a_ref, b_ref = refs[-2:]
-    del refs[-2:]
-    if with_carry:
-        ca_ref, cb_ref, ci_ref = refs[-3:]
-        del refs[-3:]
-    rt_ref = refs[0]
-    gi = pl.program_id(0)
-    base = gi * m
-    s_lb, s_lo, s_hi, s_seg = ng, ng + nt, ng + 2 * nt, ng + 3 * nt
+def _tile_grams_dense(sc_ref, g_ref, rt_ref, *, m, t, k, base, ng, nt,
+                      precision):
+    """Dense-stream tile Grams: [t]-row WINDOWS into the gathered stream at
+    16-aligned dynamic offsets (``pl.multiple_of`` — Mosaic rejects
+    unhinted dynamic sublane slices of bf16 refs, and sub-(16,128)-tile
+    offsets straddle two VMEM tiles per vreg load), with rows outside
+    [lo, hi) masked out of ONE dot operand (zeroed rows contribute nothing
+    to A; the tile-aligned rt carries zeros outside the window, so b needs
+    no mask)."""
+    s_lb, s_lo, s_hi = ng, ng + nt, ng + 2 * nt
     # Row iota hoisted out of the unrolled loop; the window test
     # (rows >= lo) & (rows < hi) is ONE unsigned compare on (rows - lo)
     # — the mask chain is per-tile VPU work on the walk's critical path.
@@ -195,7 +116,28 @@ def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
             r_i.astype(gt.dtype), gt, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         ))
+    return a_all, b_all
 
+
+def _walk_tiles(seg_of, a_all, b_all, *, gi, base, m, a_ref, b_ref, carry):
+    """The owner-run accumulation walk shared by every grouped-Gram kernel.
+
+    Walks the group's m tiles holding the running owner's partial (A, b) in
+    registers; (a_ref, b_ref) rows — output blocks in the split kernels,
+    VMEM scratch in the fused ones — are touched only when the owner
+    changes: ~one write per entity instead of one read-modify-write per
+    tile.  ``began`` = the running owner's first tile is inside this group,
+    so its flush ASSIGNS (first visit — which is what makes zero-init
+    unnecessary); otherwise the row already holds earlier groups' partials
+    and the flush accumulates.  Rows owning no tile are never written
+    (garbage); callers route them to trash exactly as before.
+
+    ``carry = (ca_ref, cb_ref, ci_ref)`` folds a previous chunk's partial
+    (A, b) into segment 0 at grid step 0 (stream mode's boundary straddle
+    — doing it here is ~free, while folding it outside either rewrote the
+    whole Gram batch through HBM or cost a separate one-system solve per
+    chunk, 97 ms/iter at rank 128).
+    """
     def flush(row, began, acc_a, acc_b):
         @pl.when(began)
         def _assign():
@@ -207,26 +149,143 @@ def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
             a_ref[pl.ds(row, 1)] += acc_a[None]
             b_ref[pl.ds(row, 1)] += acc_b[None]
 
-    seg = lambda i: sc_ref[s_seg + i]
-    began = (gi == 0) | (seg(base) != seg(jnp.maximum(base - 1, 0)))
+    began = (gi == 0) | (seg_of(base) != seg_of(jnp.maximum(base - 1, 0)))
     acc_a, acc_b = a_all[0], b_all[0]
-    if with_carry:
+    if carry is not None:
+        # Segment 0 owns the chunk's first tile whenever cin is 1 (the
+        # continued entity has entries here by definition), so adding the
+        # scaled carry into the running partial at grid step 0 lands it in
+        # segment 0's flushed row; cin = 0 multiplies it away.
+        ca_ref, cb_ref, ci_ref = carry
         fold = jnp.where(gi == 0, ci_ref[0, 0], 0.0)
         acc_a = acc_a + fold * ca_ref[...]
         acc_b = acc_b + fold * cb_ref[...]
-    for i in range(1, m):
-        change = seg(base + i) != seg(base + i - 1)
-        prev_row = seg(base + i - 1)
+    for i in range(1, m):  # m is static → unrolled
+        change = seg_of(base + i) != seg_of(base + i - 1)
+        prev_row = seg_of(base + i - 1)
 
         @pl.when(change)
         def _flush(row=prev_row, began=began, acc_a=acc_a, acc_b=acc_b):
             flush(row, began, acc_a, acc_b)
 
+        # Arithmetic select: acc·keep + a is ONE fused multiply-add per
+        # vreg where where(keep, acc+a, a) costs an add AND a select —
+        # the accumulation chain is the kernel's VPU hot path (~60 ns/tile
+        # over 1.8M tiles/iter at full Netflix).  Failure-mode caveat: a
+        # non-finite acc (diverged factors) survives the ×0.0 reset as NaN
+        # (inf·0 = NaN), so ONE bad tile Gram poisons every later segment
+        # in the group, where a where-select would have discarded it at
+        # the boundary.  Acceptable: non-finite factors are already a
+        # broken run, and the trainers' outputs go NaN either way — this
+        # only widens the blast radius within an already-lost iteration.
         keep_f = 1.0 - change.astype(jnp.float32)
         acc_a = acc_a * keep_f + a_all[i]
         acc_b = acc_b * keep_f + b_all[i]
         began = jnp.logical_or(began, change)
-    flush(seg(base + m - 1), began, acc_a, acc_b)
+    flush(seg_of(base + m - 1), began, acc_a, acc_b)
+
+
+def _solve_epilogue(a_scr, b_scr, reg_ref, lseg, x_ref, cao_ref, cbo_ref,
+                    lu_scr, *, k, s_pad, reg_mode, lam, algo):
+    """The fused Gram+solve epilogue: ridge + eliminate the VMEM-resident
+    (A, b) in place, write back only the solved rows.
+
+    Runs once, at the LAST grid step, after the walk's final flush: the
+    chunk's whole (A [s_pad, k, k], b [s_pad, 1, k]) batch lives in VMEM
+    *scratch* (never HBM — the split path's [Ec, k, k] write + readback is
+    the round-trip this removes).  Per 128-lane tile it transposes to the
+    solve kernels' batch-last layout, applies the regularizer in-register
+    (``apply_reg_lanes`` — ``diag`` λ·max(n,1)·I from the padded count
+    row, ``matrix`` one shared [k,k] Y'Y+λI), and runs the same
+    lane-vectorized elimination the standalone reg+solve kernels use
+    (``lu_solve_lanes``/``gj_solve_lanes``, ``solve_kernel.py``).  The
+    chunk-boundary carry row (RAW, pre-ridge — the next chunk folds it
+    into its own sums) is extracted at ``lseg`` before the solve.
+
+    Rows of segments owning no tile hold scratch garbage; their "solves"
+    produce garbage confined to their own lanes (every lane is an
+    independent system) and callers route those rows to trash, exactly as
+    they did for the unwritten rows of the split kernels.
+    """
+    cao_ref[...] = a_scr[pl.ds(lseg, 1)][0]
+    cbo_ref[...] = b_scr[pl.ds(lseg, 1)][0]
+
+    def tile_body(i, c):
+        ts = pl.multiple_of(i * _SOLVE_LANES, _SOLVE_LANES)
+        a_blt = jnp.transpose(
+            a_scr[pl.ds(ts, _SOLVE_LANES)], (1, 2, 0)
+        )  # [k, k, T] batch-last
+        y = b_scr[pl.ds(ts, _SOLVE_LANES)][:, 0, :].T  # [k, T]
+        reg = (reg_ref[0, pl.ds(ts, _SOLVE_LANES)] if reg_mode == "diag"
+               else reg_ref[...])
+        from cfk_tpu.ops.pallas.solve_kernel import (
+            apply_reg_lanes,
+            gj_solve_lanes,
+            lu_solve_lanes,
+        )
+
+        tr = apply_reg_lanes(a_blt, reg, k=k, reg_mode=reg_mode, lam=lam)
+        if algo == "lu":
+            xt = lu_solve_lanes(tr, y, *lu_scr, k=k)
+        else:
+            xt = gj_solve_lanes(tr, y, k=k)
+        x_ref[pl.ds(ts, _SOLVE_LANES)] = xt.T
+        return c
+
+    lax.fori_loop(0, s_pad // _SOLVE_LANES, tile_body, 0)
+
+
+def _gram_groups_kernel(seg_ref, g_ref, *refs, m, t, k, precision,
+                        with_carry):
+    # refs = (rt_ref, [ca_ref, cb_ref, ci_ref], a_ref, b_ref): the carry
+    # triple present iff the caller folds a previous chunk's partial
+    # (A, b) into segment 0 (stream mode's boundary straddle — folded in
+    # the walk, see ``_walk_tiles``).  Per-entry weights are expressed
+    # upstream as the sqrt-reparameterized stream (g = √w·f — see
+    # ``ops.tiled.ials_tiled_half_step``), so ONE stream serves both
+    # weight modes; round 4's second premultiplied gw stream is gone.
+    refs = list(refs)
+    a_ref, b_ref = refs[-2:]
+    del refs[-2:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref = refs[0]
+    gi = pl.program_id(0)
+    base = gi * m
+    a_all, b_all = _tile_grams(g_ref, rt_ref, m=m, t=t, k=k,
+                               precision=precision)
+    _walk_tiles(lambda i: seg_ref[i], a_all, b_all, gi=gi, base=base, m=m,
+                a_ref=a_ref, b_ref=b_ref, carry=carry)
+
+
+def _gram_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt,
+                       precision, with_carry):
+    # Dense-stream variant (see ``_tile_grams_dense`` for the windowing).
+    # Walk/flush semantics are identical to ``_gram_groups_kernel``:
+    # owners' tiles are contiguous (trash slots inherit the previous
+    # owner's seg with an empty window), rows of absent segments are never
+    # written.  Weighted (iALS) runs stream gs = √aw·f through this same
+    # unit-weight form (sqrt reparameterization,
+    # ``ops.tiled.ials_tiled_half_step``).
+    refs = list(refs)
+    a_ref, b_ref = refs[-2:]
+    del refs[-2:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref = refs[0]
+    gi = pl.program_id(0)
+    base = gi * m
+    s_seg = ng + 3 * nt
+    a_all, b_all = _tile_grams_dense(
+        sc_ref, g_ref, rt_ref, m=m, t=t, k=k, base=base, ng=ng, nt=nt,
+        precision=precision,
+    )
+    _walk_tiles(lambda i: sc_ref[s_seg + i], a_all, b_all, gi=gi, base=base,
+                m=m, a_ref=a_ref, b_ref=b_ref, carry=carry)
 
 
 @functools.partial(
@@ -285,37 +344,10 @@ def gram_tiles_dense_pallas(
     if interpret:
         # Vectorized emulation (CPU tests, shard_map interpret — same vma
         # rationale as gram_tiles_pallas): zeros for absent rows.
-        prec = (jax.lax.Precision.HIGHEST if g.dtype == jnp.float32
-                else None)
-        gblk = meta[:ng]
-        lb = meta[ng:ng + nt]
-        lo = meta[ng + nt:ng + 2 * nt]
-        hi = meta[ng + 2 * nt:ng + 3 * nt]
-        seg = meta[ng + 3 * nt:]
-        absrow = jnp.repeat(gblk, m) * bg + lb  # [NT]
-        win = absrow[:, None] + jnp.arange(t)[None, :]  # [NT, T]
-        gt = g[win]  # [NT, T, k]
-        rows = jnp.arange(t)[None, :]
-        keep = (rows >= lo[:, None]) & (rows < hi[:, None])
-        gm = jnp.where(keep[..., None], gt, jnp.zeros_like(gt))
-        a_t = jnp.einsum("ntk,ntl->nkl", gm, gt,
-                         preferred_element_type=jnp.float32, precision=prec)
-        # rt stays float32 (ADVICE r5): the iALS ε-clamped b-coefficient
-        # loses ~0.5–1% relative accuracy under a bf16 cast, and the real
-        # kernel consumes the f32 stream directly.
-        b_t = jnp.einsum("ntk,nt->nk", gt,
-                         rt.reshape(nt, t).astype(jnp.float32),
-                         precision=prec,
-                         preferred_element_type=jnp.float32)
-        a = jax.ops.segment_sum(a_t, seg, num_segments=num_segments,
-                                indices_are_sorted=True)
-        b = jax.ops.segment_sum(b_t, seg, num_segments=num_segments,
-                                indices_are_sorted=True)
-        if carry is not None:
-            ca, cb, ci = carry
-            a = a.at[0].add(ci * ca)
-            b = b.at[0].add(ci * cb)
-        return a, b
+        return _emulate_gram_dense(
+            g, rt, meta, num_segments=num_segments, tile_rows=t,
+            num_tiles=nt, num_groups=ng, block_rows=bg, carry=carry,
+        )
     if pltpu is None:  # pragma: no cover - non-TPU pallas build
         raise RuntimeError("pallas TPU extensions unavailable")
 
@@ -435,24 +467,10 @@ def gram_tiles_pallas(
         # the kernel's unspecified-rows contract).  Old-jax installs
         # (no vma system) take it too: their HLO interpreter predates
         # this kernel's patterns and runs orders of magnitude slower.
-        prec = (jax.lax.Precision.HIGHEST if g.dtype == jnp.float32
-                else None)
-        gt = g.reshape(-1, tile_rows, k)
-        a_t = jnp.einsum("ntk,ntl->nkl", gt, gt,
-                         preferred_element_type=jnp.float32, precision=prec)
-        # rt stays float32 (ADVICE r5) — see the dense emulation above.
-        b_t = jnp.einsum("ntk,nt->nk", gt,
-                         rt.reshape(-1, tile_rows).astype(jnp.float32),
-                         preferred_element_type=jnp.float32, precision=prec)
-        a = jax.ops.segment_sum(a_t, seg, num_segments=num_segments,
-                                indices_are_sorted=True)
-        b = jax.ops.segment_sum(b_t, seg, num_segments=num_segments,
-                                indices_are_sorted=True)
-        if carry is not None:
-            ca, cb, ci = carry
-            a = a.at[0].add(ci * ca)
-            b = b.at[0].add(ci * cb)
-        return a, b
+        return _emulate_gram_tiles(
+            g, rt, seg, num_segments=num_segments, tile_rows=tile_rows,
+            carry=carry,
+        )
     m = group_tiles
     while nt % m != 0:  # grid must tile exactly; m=1 always divides
         m //= 2
@@ -520,3 +538,496 @@ def gram_tiles_pallas(
         **kwargs,
     )(seg, g, rt.reshape(1, c), *carry_ops)
     return a, b[:, 0, :]
+
+
+def _emulate_gram_tiles(g, rt, seg, *, num_segments, tile_rows, carry):
+    """XLA segment-sum emulation of the grouped-Gram kernel (interpret /
+    shard_map-vma / old-jax routes): zeros for absent rows — a superset of
+    the kernel's unspecified-rows contract."""
+    k = g.shape[-1]
+    prec = (jax.lax.Precision.HIGHEST if g.dtype == jnp.float32 else None)
+    gt = g.reshape(-1, tile_rows, k)
+    a_t = jnp.einsum("ntk,ntl->nkl", gt, gt,
+                     preferred_element_type=jnp.float32, precision=prec)
+    # rt stays float32 (ADVICE r5): the iALS ε-clamped b-coefficient
+    # loses ~0.5–1% relative accuracy under a bf16 cast, and the real
+    # kernel consumes the f32 stream directly.
+    b_t = jnp.einsum("ntk,nt->nk", gt,
+                     rt.reshape(-1, tile_rows).astype(jnp.float32),
+                     preferred_element_type=jnp.float32, precision=prec)
+    a = jax.ops.segment_sum(a_t, seg, num_segments=num_segments,
+                            indices_are_sorted=True)
+    b = jax.ops.segment_sum(b_t, seg, num_segments=num_segments,
+                            indices_are_sorted=True)
+    if carry is not None:
+        ca, cb, ci = carry
+        a = a.at[0].add(ci * ca)
+        b = b.at[0].add(ci * cb)
+    return a, b
+
+
+def _emulate_gram_dense(g, rt, meta, *, num_segments, tile_rows, num_tiles,
+                        num_groups, block_rows, carry):
+    """XLA emulation of the dense-stream grouped-Gram kernel: windowed
+    gathers + masked einsums + segment-sum, zeros for absent rows."""
+    k = g.shape[-1]
+    t, nt, ng, bg = tile_rows, num_tiles, num_groups, block_rows
+    m = nt // ng
+    prec = (jax.lax.Precision.HIGHEST if g.dtype == jnp.float32 else None)
+    gblk = meta[:ng]
+    lb = meta[ng:ng + nt]
+    lo = meta[ng + nt:ng + 2 * nt]
+    hi = meta[ng + 2 * nt:ng + 3 * nt]
+    seg = meta[ng + 3 * nt:ng + 4 * nt]
+    absrow = jnp.repeat(gblk, m) * bg + lb  # [NT]
+    win = absrow[:, None] + jnp.arange(t)[None, :]  # [NT, T]
+    gt = g[win]  # [NT, T, k]
+    rows = jnp.arange(t)[None, :]
+    keep = (rows >= lo[:, None]) & (rows < hi[:, None])
+    gm = jnp.where(keep[..., None], gt, jnp.zeros_like(gt))
+    a_t = jnp.einsum("ntk,ntl->nkl", gm, gt,
+                     preferred_element_type=jnp.float32, precision=prec)
+    # rt stays float32 (ADVICE r5) — see _emulate_gram_tiles.
+    b_t = jnp.einsum("ntk,nt->nk", gt,
+                     rt.reshape(nt, t).astype(jnp.float32),
+                     precision=prec, preferred_element_type=jnp.float32)
+    a = jax.ops.segment_sum(a_t, seg, num_segments=num_segments,
+                            indices_are_sorted=True)
+    b = jax.ops.segment_sum(b_t, seg, num_segments=num_segments,
+                            indices_are_sorted=True)
+    if carry is not None:
+        ca, cb, ci = carry
+        a = a.at[0].add(ci * ca)
+        b = b.at[0].add(ci * cb)
+    return a, b
+
+
+def _fused_scratch_bytes(s_pad: int, k: int) -> int:
+    """VMEM bytes of the fused epilogue's resident state: the (A, b)
+    scratch plus the elimination's [k, k, 128]-class temporaries (budgeted
+    at the worst case — LU's three scratch buffers plus the in-register
+    transposed tile).  ONE formula, shared by the support gate below and
+    the pallas_call budget (``_fused_call_pieces``), so the two can never
+    drift into a gate that admits a shape the compiler then rejects."""
+    return (s_pad * k * (k + 1) + 4 * k * k * _SOLVE_LANES) * 4
+
+
+def fused_gram_solve_supported(num_segments: int, k: int) -> bool:
+    """Can the fused Gram+solve epilogue handle this chunk shape?
+
+    Two gates: the rank must fit the fused reg+solve elimination's cap
+    (LU 128 / GJ 64 — past it the dispatcher's cholesky/Schur backends are
+    needed, which only exist as separate passes), and the lane-padded
+    (A, b) scratch (``_fused_scratch_bytes`` — same formula the compile
+    budget uses) must leave VMEM headroom for the double-buffered input
+    blocks under the ~124 MB scoped ceiling.  The 72 MB gate reserves
+    ≥ 50 MB for inputs (the gate cannot see the chunk's block size, so it
+    is conservative: a refused shape takes the split path — same math,
+    one extra round-trip — never a Mosaic compile failure).
+    """
+    from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
+
+    if k > _fused_reg_rank_cap():
+        return False
+    s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
+    return _fused_scratch_bytes(s_pad, k) <= (72 << 20)
+
+
+def _gram_solve_groups_kernel(seg_ref, g_ref, *refs, m, t, k, s_pad,
+                              nt_total, precision, with_carry, reg_mode,
+                              lam, algo):
+    """Fused variant of ``_gram_groups_kernel``: the walk accumulates into
+    VMEM *scratch* instead of output blocks, and the last grid step runs
+    the ridge+solve epilogue in place (``_solve_epilogue``), writing back
+    only the solved [s_pad, k] rows and the chunk-boundary carry row.
+    ``seg_ref`` carries the chunk's lseg appended at index ``nt_total``.
+    """
+    refs = list(refs)
+    if algo == "lu":
+        lu_scr = tuple(refs[-3:])
+        del refs[-3:]
+    else:
+        lu_scr = None
+    a_scr, b_scr = refs[-2:]
+    del refs[-2:]
+    x_ref, cao_ref, cbo_ref = refs[-3:]
+    del refs[-3:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref, reg_ref = refs[0], refs[1]
+    gi = pl.program_id(0)
+    base = gi * m
+    a_all, b_all = _tile_grams(g_ref, rt_ref, m=m, t=t, k=k,
+                               precision=precision)
+    _walk_tiles(lambda i: seg_ref[i], a_all, b_all, gi=gi, base=base, m=m,
+                a_ref=a_scr, b_ref=b_scr, carry=carry)
+
+    @pl.when(gi == pl.num_programs(0) - 1)
+    def _epilogue():
+        _solve_epilogue(
+            a_scr, b_scr, reg_ref, seg_ref[nt_total], x_ref, cao_ref,
+            cbo_ref, lu_scr, k=k, s_pad=s_pad, reg_mode=reg_mode, lam=lam,
+            algo=algo,
+        )
+
+
+def _gram_solve_dense_kernel(sc_ref, g_ref, *refs, m, t, k, ng, nt, s_pad,
+                             precision, with_carry, reg_mode, lam, algo):
+    """Fused variant of ``_gram_dense_kernel`` — same scratch-resident walk
+    + last-step ridge+solve epilogue as ``_gram_solve_groups_kernel``.
+    ``sc_ref`` carries the chunk's lseg appended at index ``ng + 4·nt``."""
+    refs = list(refs)
+    if algo == "lu":
+        lu_scr = tuple(refs[-3:])
+        del refs[-3:]
+    else:
+        lu_scr = None
+    a_scr, b_scr = refs[-2:]
+    del refs[-2:]
+    x_ref, cao_ref, cbo_ref = refs[-3:]
+    del refs[-3:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref, reg_ref = refs[0], refs[1]
+    gi = pl.program_id(0)
+    base = gi * m
+    s_seg = ng + 3 * nt
+    a_all, b_all = _tile_grams_dense(
+        sc_ref, g_ref, rt_ref, m=m, t=t, k=k, base=base, ng=ng, nt=nt,
+        precision=precision,
+    )
+    _walk_tiles(lambda i: sc_ref[s_seg + i], a_all, b_all, gi=gi, base=base,
+                m=m, a_ref=a_scr, b_ref=b_scr, carry=carry)
+
+    @pl.when(gi == pl.num_programs(0) - 1)
+    def _epilogue():
+        _solve_epilogue(
+            a_scr, b_scr, reg_ref, sc_ref[ng + 4 * nt], x_ref, cao_ref,
+            cbo_ref, lu_scr, k=k, s_pad=s_pad, reg_mode=reg_mode, lam=lam,
+            algo=algo,
+        )
+
+
+def _fused_call_pieces(k, s_pad, num_segments, reg, reg_mode, carry, vma,
+                       algo):
+    """The plumbing every fused wrapper shares: reg/carry operands and
+    specs, lane-padded output shapes, scratch shapes, and the VMEM budget.
+    Returns (reg_op, reg_spec, carry_ops, carry_specs, out_shape,
+    scratch_shapes, extra_vmem_bytes)."""
+    mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
+        lambda s, d: jax.ShapeDtypeStruct(s, d)
+    )
+    if reg_mode == "diag":
+        reg_op = jnp.pad(
+            reg.astype(jnp.float32), (0, s_pad - num_segments)
+        ).reshape(1, s_pad)
+        reg_spec = pl.BlockSpec((1, s_pad), lambda i, sc: (0, 0))
+    else:
+        reg_op = reg.astype(jnp.float32)
+        reg_spec = pl.BlockSpec((k, k), lambda i, sc: (0, 0))
+    carry_specs = [] if carry is None else [
+        pl.BlockSpec((k, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, 1), lambda i, sc: (0, 0)),
+    ]
+    carry_ops = [] if carry is None else [
+        carry[0].astype(jnp.float32),
+        carry[1].reshape(1, k).astype(jnp.float32),
+        carry[2].reshape(1, 1).astype(jnp.float32),
+    ]
+    out_shape = (
+        mk((s_pad, k), jnp.float32),      # x
+        mk((k, k), jnp.float32),          # carry A row (raw, pre-ridge)
+        mk((1, k), jnp.float32),          # carry b row
+    )
+    out_specs = [
+        pl.BlockSpec((s_pad, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((k, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, k), lambda i, sc: (0, 0)),
+    ]
+    scratch = [
+        pltpu.VMEM((s_pad, k, k), jnp.float32),
+        pltpu.VMEM((s_pad, 1, k), jnp.float32),
+    ]
+    if algo == "lu":
+        scratch += [
+            pltpu.VMEM((k, k, _SOLVE_LANES), jnp.float32),
+            pltpu.VMEM((k, _SOLVE_LANES), jnp.float32),
+            pltpu.VMEM((k, _SOLVE_LANES), jnp.float32),
+        ]
+    # Scratch is single-buffered (unlike the split kernels' resident
+    # output, which Mosaic double-buffers even at a constant index) — the
+    # fused path actually NEEDS LESS VMEM than split despite solving in
+    # place.  Budget: scratch + elimination temporaries + headroom
+    # (same formula the support gate applies — see _fused_scratch_bytes).
+    scratch_bytes = _fused_scratch_bytes(s_pad, k)
+    return (reg_op, reg_spec, carry_ops, carry_specs, out_shape, out_specs,
+            scratch, scratch_bytes)
+
+
+def gram_solve_tiles_pallas(
+    g: jax.Array,  # [C, k] gathered neighbor factors (bf16 or f32)
+    rt: jax.Array,  # [C] f32 b-side coefficients (0 at padding)
+    seg: jax.Array,  # [NT] int32 owner of each tile (sorted by the layout)
+    reg: jax.Array,  # diag: [num_segments] counts; matrix: [k, k] YᵀY+λI
+    lseg: jax.Array,  # int32 scalar: the carry row to extract
+    *,
+    num_segments: int,
+    tile_rows: int,
+    group_tiles: int = 64,
+    reg_mode: str = "diag",
+    lam: float = 0.0,
+    interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+    algo: str | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused Gram + ridge + solve over entity tiles: the chunk's normal
+    equations never leave the kernel's VMEM residency.
+
+    Same contract as ``gram_tiles_pallas`` for the Gram accumulation
+    (sorted contiguous owners, unwritten absent rows, the chunk-boundary
+    ``carry`` fold), but instead of writing (A [S, k, k], b [S, k]) to HBM
+    for a separate batched solve, the last grid step applies the
+    regularizer and runs the lane-vectorized elimination on the
+    VMEM-resident batch (``_solve_epilogue``), returning
+
+        (x [num_segments, k], carry_a [k, k], carry_b [k])
+
+    where (carry_a, carry_b) is the RAW (pre-ridge) row at ``lseg`` — the
+    partial sums of the entity straddling the next chunk boundary.  This
+    removes the split path's per-chunk [Ec, k, k] A-batch write + readback
+    (~2·Ec·k² f32 of pure HBM traffic per chunk) that PR 1's prefetch
+    pipelines left as the exposed hot path.
+
+    Off-TPU (interpret) and on old-jax installs this routes to the
+    XLA-emulation twin (``cfk_tpu.compat.emulate_fused_gram_solve``): the
+    same segment-sum Gram the split path emulates plus the interpret-mode
+    fused reg+solve kernel — bit-identical to running split with
+    ``gram_backend="xla"`` + the pallas solver, which is what the fused/
+    split regression tests pin.  Rank cap and VMEM sizing are gated by
+    ``fused_gram_solve_supported``; callers fall back to split past it.
+    """
+    if algo is None:
+        from cfk_tpu.ops.pallas.solve_kernel import default_reg_solve_algo
+
+        algo = default_reg_solve_algo()
+    if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
+        algo = "gj"
+    return _gram_solve_tiles_pallas(
+        g, rt, seg, reg, lseg, num_segments=num_segments,
+        tile_rows=tile_rows, group_tiles=group_tiles, reg_mode=reg_mode,
+        lam=lam, interpret=interpret, carry=carry, algo=algo,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "tile_rows", "group_tiles", "reg_mode",
+                     "lam", "interpret", "algo"),
+)
+def _gram_solve_tiles_pallas(
+    g, rt, seg, reg, lseg, *, num_segments, tile_rows, group_tiles,
+    reg_mode, lam, interpret, carry, algo,
+):
+    c, k = g.shape
+    t = tile_rows
+    if c % t != 0:
+        raise ValueError(f"entry count {c} not divisible by tile_rows {t}")
+    nt = c // t
+    if seg.shape != (nt,):
+        raise ValueError(f"seg shape {seg.shape} != ({nt},)")
+    _check_reg_shape(reg, reg_mode, num_segments, k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret or not has_vma_system():
+        # The XLA-emulation twin (compat.py): CPU CI and old-jax installs
+        # exercise the same fused code shape without Mosaic.
+        from cfk_tpu.compat import emulate_fused_gram_solve
+
+        a, b = _emulate_gram_tiles(
+            g, rt, seg, num_segments=num_segments, tile_rows=t, carry=carry,
+        )
+        return emulate_fused_gram_solve(
+            a, b, reg, reg_mode=reg_mode, lam=lam, lseg=lseg,
+        )
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    m = group_tiles
+    while nt % m != 0:  # grid must tile exactly; m=1 always divides
+        m //= 2
+    s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
+    vma = typeof_vma(g)
+    (reg_op, reg_spec, carry_ops, carry_specs, out_shape, out_specs,
+     scratch, scratch_bytes) = _fused_call_pieces(
+        k, s_pad, num_segments, reg, reg_mode, carry, vma, algo)
+    fac_spec = pl.BlockSpec((m * t, k), lambda i, sc: (i, 0))
+    seg_plus = jnp.concatenate(
+        [seg.astype(jnp.int32), jnp.asarray(lseg, jnp.int32).reshape(1)]
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt // m,),
+        in_specs=[fac_spec,
+                  pl.BlockSpec((1, m * t), lambda i, sc: (0, i)),
+                  reg_spec] + carry_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    precision = (
+        jax.lax.Precision.HIGHEST if g.dtype == jnp.float32 else None
+    )
+    in_bytes = 2 * (m * t * (k + 1) * 4)
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(scratch_bytes + 4 * in_bytes + (12 << 20),
+                             124 << 20)
+    )}
+    x, cao, cbo = pl.pallas_call(
+        functools.partial(
+            _gram_solve_groups_kernel, m=m, t=t, k=k, s_pad=s_pad,
+            nt_total=nt, precision=precision,
+            with_carry=carry is not None, reg_mode=reg_mode, lam=lam,
+            algo=algo,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(seg_plus, g, rt.reshape(1, c), reg_op, *carry_ops)
+    return x[:num_segments], cao, cbo[0]
+
+
+def gram_solve_tiles_dense_pallas(
+    g: jax.Array,  # [C, k] densely packed gathered factors (bf16/f32)
+    rt: jax.Array,  # [NT·T] f32 TILE-ALIGNED b coefficients (0 off-window)
+    meta: jax.Array,  # [NG + 4·NT] int32: g_blk ‖ lb ‖ lo ‖ hi ‖ seg
+    reg: jax.Array,  # diag: [num_segments] counts; matrix: [k, k]
+    lseg: jax.Array,  # int32 scalar: the carry row to extract
+    *,
+    num_segments: int,
+    tile_rows: int,
+    num_tiles: int,
+    num_groups: int,
+    block_rows: int,
+    reg_mode: str = "diag",
+    lam: float = 0.0,
+    interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+    algo: str | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused Gram+solve for the dense-stream layout — the unpadded-gather
+    variant of ``gram_solve_tiles_pallas`` (same epilogue, dense windowed
+    walk; see ``gram_tiles_dense_pallas`` for the stream/metadata
+    contract)."""
+    if algo is None:
+        from cfk_tpu.ops.pallas.solve_kernel import default_reg_solve_algo
+
+        algo = default_reg_solve_algo()
+    if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
+        algo = "gj"
+    return _gram_solve_tiles_dense_pallas(
+        g, rt, meta, reg, lseg, num_segments=num_segments,
+        tile_rows=tile_rows, num_tiles=num_tiles, num_groups=num_groups,
+        block_rows=block_rows, reg_mode=reg_mode, lam=lam,
+        interpret=interpret, carry=carry, algo=algo,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "tile_rows", "num_tiles", "num_groups",
+                     "block_rows", "reg_mode", "lam", "interpret", "algo"),
+)
+def _gram_solve_tiles_dense_pallas(
+    g, rt, meta, reg, lseg, *, num_segments, tile_rows, num_tiles,
+    num_groups, block_rows, reg_mode, lam, interpret, carry, algo,
+):
+    c, k = g.shape
+    t = tile_rows
+    nt, ng, bg = num_tiles, num_groups, block_rows
+    if nt % ng != 0:
+        raise ValueError(f"num_tiles {nt} not divisible by num_groups {ng}")
+    m = nt // ng
+    if rt.shape != (nt * t,):
+        raise ValueError(f"rt shape {rt.shape} != ({nt * t},)")
+    if meta.shape != (ng + 4 * nt,):
+        raise ValueError(f"meta shape {meta.shape} != ({ng + 4 * nt},)")
+    if c % bg != 0 or bg < t:
+        raise ValueError(f"stream length {c} not a multiple of block_rows "
+                         f"{bg} >= tile_rows {t}")
+    _check_reg_shape(reg, reg_mode, num_segments, k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret or not has_vma_system():
+        from cfk_tpu.compat import emulate_fused_gram_solve
+
+        a, b = _emulate_gram_dense(
+            g, rt, meta, num_segments=num_segments, tile_rows=t,
+            num_tiles=nt, num_groups=ng, block_rows=bg, carry=carry,
+        )
+        return emulate_fused_gram_solve(
+            a, b, reg, reg_mode=reg_mode, lam=lam, lseg=lseg,
+        )
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
+    vma = typeof_vma(g)
+    (reg_op, reg_spec, carry_ops, carry_specs, out_shape, out_specs,
+     scratch, scratch_bytes) = _fused_call_pieces(
+        k, s_pad, num_segments, reg, reg_mode, carry, vma, algo)
+    meta_plus = jnp.concatenate(
+        [meta.astype(jnp.int32), jnp.asarray(lseg, jnp.int32).reshape(1)]
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ng,),
+        in_specs=[
+            pl.BlockSpec((bg, k), lambda i, sc: (sc[i], 0)),
+            pl.BlockSpec((1, m * t), lambda i, sc: (0, i)),
+            reg_spec,
+        ] + carry_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    precision = (
+        jax.lax.Precision.HIGHEST if g.dtype == jnp.float32 else None
+    )
+    in_bytes = 2 * (bg * k * 4 + m * t * 4)
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(scratch_bytes + in_bytes + (10 << 20),
+                             124 << 20)
+    )}
+    x, cao, cbo = pl.pallas_call(
+        functools.partial(
+            _gram_solve_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt,
+            s_pad=s_pad, precision=precision, with_carry=carry is not None,
+            reg_mode=reg_mode, lam=lam, algo=algo,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(meta_plus, g, rt.reshape(1, nt * t), reg_op, *carry_ops)
+    return x[:num_segments], cao, cbo[0]
+
+
+def _check_reg_shape(reg, reg_mode, num_segments, k):
+    if reg_mode == "diag":
+        if reg.shape != (num_segments,):
+            raise ValueError(
+                f"diag reg shape {reg.shape} != ({num_segments},)"
+            )
+    elif reg_mode == "matrix":
+        if reg.shape != (k, k):
+            raise ValueError(f"matrix reg shape {reg.shape} != ({k},{k})")
+    else:
+        raise ValueError(f"unknown reg_mode {reg_mode!r}")

@@ -62,13 +62,14 @@ PALLAS_MAX_RANK = 64
 LU_MAX_RANK = 128
 
 
-def _gauss_kernel(a_ref, b_ref, x_ref, *, k: int):
-    """Solve T systems at once: a_ref [k,k,T], b_ref [k,T] → x_ref [k,T]."""
-    a = a_ref[:]
-    b = b_ref[:]
-    # Row-index planes for the pivot-row selects below (in-kernel iota:
-    # pallas kernels cannot capture array constants, and Mosaic needs
-    # multi-dim iota).
+def gj_solve_lanes(a, b, *, k: int):
+    """In-register Gauss-Jordan over lanes: a [k,k,T], b [k,T] → x [k,T].
+
+    The elimination core shared by the standalone solve kernels and the
+    fused Gram+solve epilogue (``ops.pallas.gram_kernel``).  Row-index
+    planes come from in-kernel iota (pallas kernels cannot capture array
+    constants, and Mosaic needs multi-dim iota).
+    """
     rows3 = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0)
     rows2 = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)
     for j in range(k):  # k is static → fully unrolled
@@ -83,7 +84,40 @@ def _gauss_kernel(a_ref, b_ref, x_ref, *, k: int):
         a = jnp.where(rows3 == j, row[None, :, :],
                       a - col[:, None, :] * row[None, :, :])
         b = jnp.where(rows2 == j, bj[None, :], b - col * bj[None, :])
-    x_ref[:] = b
+    return b
+
+
+def lu_solve_lanes(tr, y, u_scr, y_scr, x_scr, *, k: int):
+    """In-register reverse-order no-pivot LU over lanes: tr [k,k,T],
+    y [k,T] → x [k,T] (read back from ``x_scr``).
+
+    The k³/3 elimination core of ``_lu_reg_kernel``, factored so the fused
+    Gram+solve epilogue can run it on VMEM-resident Gram tiles.  Pivot rows
+    go to the ``u_scr``/``y_scr`` VMEM scratch; forward substitution
+    rebuilds x in increasing order through ``x_scr``.  See ``_lu_reg_kernel``
+    for why the elimination runs in REVERSE variable order (offset-0
+    slices are the only ones Mosaic's sublane broadcast lowers).
+    """
+    for n in range(k, 0, -1):  # static → unrolled; eliminate x_{n-1}
+        inv = 1.0 / tr[n - 1, n - 1, :]
+        yn = y[n - 1] * inv
+        y_scr[n - 1, :] = yn
+        if n > 1:
+            row = tr[n - 1, :n - 1, :] * inv[None, :]
+            col = tr[:n - 1, n - 1, :]
+            u_scr[n - 1, :n - 1, :] = row
+            tr = tr[:n - 1, :n - 1, :] - col[:, None, :] * row[None, :, :]
+            y = y[:n - 1] - col * yn[None, :]
+    x_scr[0, :] = y_scr[0, :]
+    for j in range(1, k):
+        corr = jnp.sum(u_scr[j, :j, :] * x_scr[:j, :], axis=0)
+        x_scr[j, :] = y_scr[j, :] - corr
+    return x_scr[...]
+
+
+def _gauss_kernel(a_ref, b_ref, x_ref, *, k: int):
+    """Solve T systems at once: a_ref [k,k,T], b_ref [k,T] → x_ref [k,T]."""
+    x_ref[:] = gj_solve_lanes(a_ref[:], b_ref[:], k=k)
 
 
 def _gauss_multi_kernel(a_ref, b_ref, x_ref, *, k: int):
@@ -107,19 +141,26 @@ def _gauss_multi_kernel(a_ref, b_ref, x_ref, *, k: int):
     x_ref[:] = b
 
 
-def _apply_reg(a, r_ref, *, k: int, reg_mode: str, lam: float):
+def apply_reg_lanes(a, reg, *, k: int, reg_mode: str, lam: float):
     """Add the regularizer to a batch-last [k,k,T] block in-register:
-    ``diag`` = λ·max(n,1)·I from the [1,T] count row (ALS-WR), ``matrix``
-    = one shared [k,k] SPD term (iALS's YᵀY+λI)."""
+    ``diag`` = λ·max(n,1)·I from a [T] count lane vector (ALS-WR),
+    ``matrix`` = one shared [k,k] SPD term (iALS's YᵀY+λI).  Shared by
+    the standalone reg+solve kernels and the fused Gram+solve epilogue."""
     if reg_mode == "diag":
-        # [1, T] block (1-D s32 operands draw an XLA T(1024) layout Mosaic
-        # rejects; 2-D rows use the standard tiling).
-        reg = lam * jnp.maximum(r_ref[0, :].astype(jnp.float32), 1.0)  # [T]
+        regv = lam * jnp.maximum(reg.astype(jnp.float32), 1.0)  # [T]
         r3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 0)
         c3 = jax.lax.broadcasted_iota(jnp.int32, (k, k, 1), 1)
-        return a + jnp.where(r3 == c3, reg[None, None, :], 0.0)
+        return a + jnp.where(r3 == c3, regv[None, None, :], 0.0)
     # matrix: one [k,k] SPD term shared across the batch (iALS)
-    return a + r_ref[...][:, :, None]
+    return a + reg[:, :, None]
+
+
+def _apply_reg(a, r_ref, *, k: int, reg_mode: str, lam: float):
+    """``apply_reg_lanes`` from the kernel's regularizer ref: the diag
+    counts ride as a [1, T] block (1-D s32 operands draw an XLA T(1024)
+    layout Mosaic rejects; 2-D rows use the standard tiling)."""
+    reg = r_ref[0, :] if reg_mode == "diag" else r_ref[...]
+    return apply_reg_lanes(a, reg, k=k, reg_mode=reg_mode, lam=lam)
 
 
 def _lu_reg_kernel(a_ref, b_ref, r_ref, x_ref, u_scr, y_scr, x_scr, *,
@@ -140,21 +181,7 @@ def _lu_reg_kernel(a_ref, b_ref, r_ref, x_ref, u_scr, y_scr, x_scr, *,
     a = jnp.transpose(a_ref[...], (1, 2, 0))  # [k,k,T]
     y = b_ref[...].T  # [k,T]
     tr = _apply_reg(a, r_ref, k=k, reg_mode=reg_mode, lam=lam)
-    for n in range(k, 0, -1):  # static → unrolled; eliminate x_{n-1}
-        inv = 1.0 / tr[n - 1, n - 1, :]
-        yn = y[n - 1] * inv
-        y_scr[n - 1, :] = yn
-        if n > 1:
-            row = tr[n - 1, :n - 1, :] * inv[None, :]
-            col = tr[:n - 1, n - 1, :]
-            u_scr[n - 1, :n - 1, :] = row
-            tr = tr[:n - 1, :n - 1, :] - col[:, None, :] * row[None, :, :]
-            y = y[:n - 1] - col * yn[None, :]
-    x_scr[0, :] = y_scr[0, :]
-    for j in range(1, k):
-        corr = jnp.sum(u_scr[j, :j, :] * x_scr[:j, :], axis=0)
-        x_scr[j, :] = y_scr[j, :] - corr
-    x_ref[...] = x_scr[...].T
+    x_ref[...] = lu_solve_lanes(tr, y, u_scr, y_scr, x_scr, k=k).T
 
 
 def _gauss_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k: int, reg_mode: str,
@@ -176,17 +203,7 @@ def _gauss_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k: int, reg_mode: str,
     a = jnp.transpose(a_ref[...], (1, 2, 0))  # [k,k,T] batch-last
     b = b_ref[...].T  # [k,T]
     a = _apply_reg(a, r_ref, k=k, reg_mode=reg_mode, lam=lam)
-    rows3 = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0)
-    rows2 = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)
-    for j in range(k):  # k is static → fully unrolled
-        inv = 1.0 / a[j, j, :]
-        row = a[j] * inv[None, :]
-        bj = b[j] * inv
-        col = a[:, j, :]
-        a = jnp.where(rows3 == j, row[None, :, :],
-                      a - col[:, None, :] * row[None, :, :])
-        b = jnp.where(rows2 == j, bj[None, :], b - col * bj[None, :])
-    x_ref[...] = b.T
+    x_ref[...] = gj_solve_lanes(a, b, k=k).T
 
 
 def default_reg_solve_algo() -> str:

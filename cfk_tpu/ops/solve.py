@@ -315,8 +315,27 @@ def _resolve_solver(solver: str) -> str:
     return solver
 
 
+def default_fused_epilogue() -> bool:
+    """Process-wide default for the fused-epilogue family: the Gram
+    kernels' in-VMEM ridge+solve (``ops.pallas.gram_kernel.
+    gram_solve_tiles_pallas``) and the fused reg+solve dispatch below.
+    True = fuse wherever the backend/rank gates allow — the production
+    mode (the split path's per-chunk [Ec, k, k] A-batch write + readback
+    is pure HBM traffic the fusion removes).  Patchable for A/B
+    measurement (``scripts/perf_lab.py --fused off``, ``bench.py
+    --fused-ab``) exactly like ``ops.pipeline.default_overlap``; per-call
+    ``fused=`` and ``ALSConfig.fused_epilogue`` override it explicitly."""
+    return True
+
+
+def resolve_fused_epilogue(fused) -> bool:
+    """Per-call override if given, else the process default."""
+    return default_fused_epilogue() if fused is None else bool(fused)
+
+
 def regularized_solve(
-    a: jax.Array, b: jax.Array, count: jax.Array, lam: float, solver: str = "cholesky"
+    a: jax.Array, b: jax.Array, count: jax.Array, lam: float,
+    solver: str = "cholesky", fused: bool | None = None,
 ) -> jax.Array:
     """Apply ALS-WR regularization λ·n_ratings·I and solve.
 
@@ -328,12 +347,17 @@ def regularized_solve(
     batch-last transposes, and the elimination run as ONE kernel
     (``gauss_solve_reg_pallas``) — the separate diagonal-add pass re-wrote
     the whole Gram batch through HBM every chunk (round-3 profile).
+    ``fused=False`` (or the process default off) pins the split
+    ridge-add + dispatch schedule — the measurement baseline of
+    ``bench.py --fused-ab``.
     """
     from cfk_tpu.ops.pallas import gauss_solve_reg_pallas
     from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
 
     k = a.shape[-1]
-    if _resolve_solver(solver) == "pallas" and k <= _fused_reg_rank_cap():
+    if (resolve_fused_epilogue(fused)
+            and _resolve_solver(solver) == "pallas"
+            and k <= _fused_reg_rank_cap()):
         # The fused kernel bakes λ in as a compile-time constant; a traced
         # lam (e.g. a per-step tuned regularizer) cannot concretize, so it
         # takes the unfused path below — same math, one extra HBM pass —
@@ -354,19 +378,23 @@ def regularized_solve(
 
 
 def regularized_solve_matrix(
-    a: jax.Array, b: jax.Array, reg: jax.Array, solver: str = "cholesky"
+    a: jax.Array, b: jax.Array, reg: jax.Array, solver: str = "cholesky",
+    fused: bool | None = None,
 ) -> jax.Array:
     """Solve (A_e + R) x_e = b_e with one shared [k,k] SPD term R.
 
     The iALS half-steps' per-entity systems all add the same global
     YᵀY + λI (Hu et al. 2008); fusing the add into the pallas solve skips
-    an [E,k,k] HBM rewrite per chunk, exactly like ``regularized_solve``.
+    an [E,k,k] HBM rewrite per chunk, exactly like ``regularized_solve``
+    (and like it, ``fused=False`` pins the split schedule for A/B runs).
     """
     from cfk_tpu.ops.pallas import gauss_solve_reg_pallas
     from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
 
     k = a.shape[-1]
-    if _resolve_solver(solver) == "pallas" and k <= _fused_reg_rank_cap():
+    if (resolve_fused_epilogue(fused)
+            and _resolve_solver(solver) == "pallas"
+            and k <= _fused_reg_rank_cap()):
         return gauss_solve_reg_pallas(a, b, reg, reg_mode="matrix")
     return dispatch_spd_solve(a + reg[None], b, solver)
 
@@ -676,12 +704,15 @@ def init_factors(
     mask: jax.Array,  # [E, P]
     count: jax.Array,  # [E]
     rank: int,
+    *,
+    num_entities: int | None = None,
 ) -> jax.Array:
     """Zhou et al. initialization, matching ``processors/UFeatureInitializer.java:50-56``:
 
     f[0] = entity's average rating, f[1:] ~ U(0, 1).
     """
-    return init_factors_stats(key, jnp.sum(rating * mask, axis=1), count, rank)
+    return init_factors_stats(key, jnp.sum(rating * mask, axis=1), count, rank,
+                              num_entities=num_entities)
 
 
 def init_factors_stats(
@@ -689,12 +720,27 @@ def init_factors_stats(
     rating_sum: jax.Array,  # [E] per-entity rating sum
     count: jax.Array,  # [E]
     rank: int,
+    *,
+    num_entities: int | None = None,
 ) -> jax.Array:
     """Zhou et al. init from per-entity stats (the bucketed-layout entry:
-    bucketed blocks never materialize an [E, P] rectangle to sum over)."""
+    bucketed blocks never materialize an [E, P] rectangle to sum over).
+
+    ``num_entities`` (static) is the REAL entity count when the [E] arrays
+    carry shard-count padding: threefry output DEPENDS on the draw shape
+    (uniform(key, (2998, k)) and uniform(key, (3000, k)) share no values),
+    so drawing at the padded length made an N-way run's init — hence its
+    whole trajectory — a function of how E rounds against num_shards (the
+    4-shard tiled SPMD mismatch).  Drawing at the real count and zero-
+    padding keeps every shard count on the 1-way init exactly; pad rows
+    were zeroed by the count mask anyway.
+    """
     e = rating_sum.shape[0]
+    n = e if num_entities is None else int(num_entities)
     avg = rating_sum / jnp.maximum(count.astype(jnp.float32), 1.0)
-    rest = jax.random.uniform(key, (e, rank - 1), dtype=jnp.float32)
+    rest = jax.random.uniform(key, (n, rank - 1), dtype=jnp.float32)
+    if n != e:
+        rest = jnp.pad(rest, ((0, e - n), (0, 0)))
     f = jnp.concatenate([avg[:, None], rest], axis=1)
     # Zero all-padding rows (n = 0): nothing references them in explicit ALS,
     # but the implicit model's global Gram YᵀY sums *every* row, so garbage

@@ -106,26 +106,9 @@ def _entity_gram_chunk(
     the in-body gather.
     """
     k = fixed_slice.shape[-1]
+    g = _gathered_stream(fixed_slice, nb, wt, unit_weights, zero_appended,
+                         pregathered)
     ct, prec = _gram_compute_dtype(fixed_slice)
-    if pregathered is not None:
-        g = pregathered  # [C, k], already in ct
-    else:
-        if zero_appended:
-            fz = fixed_slice
-        else:
-            fz = jnp.concatenate([
-                fixed_slice,
-                _match_varying(
-                    jnp.zeros((1, k), fixed_slice.dtype), fixed_slice
-                ),
-            ])
-        g = fz[nb].astype(ct)  # [C, k]
-    if not unit_weights:
-        # Sqrt-weighted single stream (see docstring): the multiply fuses
-        # into the producing gather, and everything downstream — kernel
-        # operands, probes, both backends — sees one stream, exactly like
-        # the unit path.
-        g = g * wt.astype(ct)[:, None]
     if stage == "gather":
         # Measurement probe (``tiled_half_step(stage=...)``): stop after
         # the gather (+ the fused √aw multiply where weighted) and fold
@@ -176,9 +159,110 @@ def _entity_gram_chunk(
     return a, b
 
 
+def _gathered_stream(fixed_slice, nb, wt, unit_weights, zero_appended,
+                     pregathered):
+    """The gather prologue both chunk-Gram entries share: fetch the chunk's
+    neighbor factors (or accept the pipeline-prefetched stream) and apply
+    the sqrt-reparameterized weight — see ``_entity_gram_chunk``."""
+    k = fixed_slice.shape[-1]
+    ct, _ = _gram_compute_dtype(fixed_slice)
+    if pregathered is not None:
+        g = pregathered  # [C, k], already in ct
+    else:
+        if zero_appended:
+            fz = fixed_slice
+        else:
+            fz = jnp.concatenate([
+                fixed_slice,
+                _match_varying(
+                    jnp.zeros((1, k), fixed_slice.dtype), fixed_slice
+                ),
+            ])
+        g = fz[nb].astype(ct)  # [C, k]
+    if not unit_weights:
+        # Sqrt-weighted single stream (see _entity_gram_chunk): the
+        # multiply fuses into the producing gather, and everything
+        # downstream — kernel operands, probes, both backends — sees one
+        # stream, exactly like the unit path.
+        g = g * wt.astype(ct)[:, None]
+    return g
+
+
+def _entity_gram_solve_chunk(
+    fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, lseg, reg,
+    reg_mode, lam, unit_weights=False, zero_appended=False, carry=None,
+    pregathered=None,
+):
+    """Fused-epilogue twin of ``_entity_gram_chunk`` + the per-chunk solve.
+
+    Returns (x [num_segments, k], carry_a [k, k], carry_b [k]): the
+    chunk's (A, b) batch stays inside the Gram kernel's VMEM residency
+    (``gram_solve_tiles_pallas``) where the ridge and the lane-vectorized
+    elimination run in place — the split path's [Ec, k, k] HBM write +
+    readback for the separate batched solve never happens.  The carry pair
+    is the RAW (pre-ridge) partial of the boundary-straddling entity at
+    ``lseg`` — exactly the ``a[lseg]``/``b[lseg]`` rows the split scan
+    extracts.  Callers gate on ``resolve_fused_chunk_lam`` first (pallas
+    backend + pallas solver + rank within the fused elimination cap).
+    """
+    from cfk_tpu.ops.pallas.gram_kernel import gram_solve_tiles_pallas
+
+    g = _gathered_stream(fixed_slice, nb, wt, unit_weights, zero_appended,
+                         pregathered)
+    return gram_solve_tiles_pallas(
+        g, rt, seg, reg, lseg, num_segments=num_segments,
+        tile_rows=tile_rows, reg_mode=reg_mode, lam=lam, carry=carry,
+    )
+
+
+def _chunk_reg(cnt_c, implicit_reg):
+    """The fused epilogue's regularizer operand: per-row counts (ALS-WR
+    λ·n with the trash row floored at 1 — exactly the cnt_full the split
+    path's ``regularized_solve`` sees) or the shared YᵀY+λI matrix
+    (iALS).  One definition, so the stream and dense fused paths can
+    never diverge on the trash-row floor."""
+    if implicit_reg is None:
+        return jnp.concatenate([cnt_c, jnp.ones((1,), cnt_c.dtype)])
+    return implicit_reg
+
+
+def resolve_fused_chunk_lam(fused_epilogue, solver, k, num_segments,
+                            backend, lam, implicit):
+    """Static gating of the fused Gram+solve chunk path.
+
+    Returns the concretized λ (0.0 for the implicit/matrix mode, whose λ
+    rides inside the shared reg matrix) when the fused path is legal, or
+    None → the caller keeps the split Gram→HBM→solve schedule.  Gates:
+    the per-call/config/process fused knob, the pallas Gram backend (the
+    XLA A/B backend has no VMEM residency to exploit), the pallas solver
+    (cholesky callers asked for XLA's solve — honoring that means
+    splitting), the fused elimination's rank/VMEM caps, and a
+    concretizable λ (the kernel bakes it in as a compile-time constant;
+    a traced per-step λ falls back to the split path's unfused solve,
+    same math).
+    """
+    from cfk_tpu.ops.solve import _resolve_solver, resolve_fused_epilogue
+
+    if not resolve_fused_epilogue(fused_epilogue):
+        return None
+    if backend != "pallas" or _resolve_solver(solver) != "pallas":
+        return None
+    from cfk_tpu.ops.pallas.gram_kernel import fused_gram_solve_supported
+
+    if not fused_gram_solve_supported(num_segments, k):
+        return None
+    if implicit:
+        return 0.0
+    try:
+        return float(lam)
+    except (jax.errors.ConcretizationTypeError, TypeError):
+        return None
+
+
 def tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, *,
     solver="cholesky", implicit_reg=None, stage="full", overlap=None,
+    fused_epilogue=None,
 ):
     """Mode dispatch shared by the single-device and SPMD trainers.
 
@@ -202,7 +286,7 @@ def tiled_half_step(
             blk["tile_seg"], blk["chunk_base"], blk["chunk_entity"],
             blk["count"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
-            stage=stage, overlap=overlap,
+            stage=stage, overlap=overlap, fused_epilogue=fused_epilogue,
         )
     if mode == "dstream":
         return als_half_step_tiled_dense(
@@ -211,14 +295,14 @@ def tiled_half_step(
             blk["carry_in"], blk["last_seg"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
             aweight_dense=blk.get("aweight_dense"), stage=stage,
-            overlap=overlap,
+            overlap=overlap, fused_epilogue=fused_epilogue,
         )
     return als_half_step_tiled(
         fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
         blk["tile_seg"], blk["chunk_entity"], blk["chunk_count"],
         blk["carry_in"], blk["last_seg"], local_entities, lam,
         statics=st, solver=solver, implicit_reg=implicit_reg, stage=stage,
-        overlap=overlap,
+        overlap=overlap, fused_epilogue=fused_epilogue,
     )
 
 
@@ -229,6 +313,7 @@ _SQRT_WEIGHT_EPS = 1e-12  # clamp for α·r = 0 entries: their A-term becomes
 def ials_tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, alpha, *,
     gram=None, solver="cholesky", stage="full", overlap=None,
+    fused_epilogue=None,
 ):
     """Implicit-feedback (Hu et al. 2008) half-iteration on tiled blocks.
 
@@ -280,11 +365,13 @@ def ials_tiled_half_step(
         return tiled_half_step(
             fixed_factors, blk, chunks, local_entities, lam,
             solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
+            fused_epilogue=fused_epilogue,
         )
     blk["rating"], blk["weight"] = rt_scaled, aw_tile
     return tiled_half_step(
         fixed_factors, blk, chunks, local_entities, lam,
         solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
+        fused_epilogue=fused_epilogue,
     )
 
 
@@ -307,6 +394,7 @@ def als_half_step_tiled(
     gram_backend: str | None = None,
     stage: str = "full",
     overlap: bool | None = None,
+    fused_epilogue: bool | None = None,
 ) -> jax.Array:
     """Stream-mode tiled half-iteration (the many-entities side).
 
@@ -324,12 +412,24 @@ def als_half_step_tiled(
     consume the other buffer, so the gather engine and the MXU run
     concurrently instead of strictly alternating.  Same gathers, same
     per-chunk op order, bit-identical factors (``tests/test_overlap.py``).
+
+    ``fused_epilogue`` (default: on wherever legal — see
+    ``resolve_fused_chunk_lam``) solves each chunk's normal equations
+    INSIDE the Gram kernel's VMEM residency: the per-chunk [Ec, k, k]
+    A-batch never round-trips through HBM, and the scan body consumes
+    (x, carry) straight from the fused kernel.
     """
     backend = gram_backend or default_tiled_gram_backend()
     overlap = resolve_overlap(overlap)
     nc, cap, e_c, t = statics
     k = fixed_factors.shape[-1]
     nt = cap // t
+    fused_lam = (
+        resolve_fused_chunk_lam(
+            fused_epilogue, solver, k, e_c + 1, backend, lam,
+            implicit_reg is not None,
+        ) if stage == "full" else None
+    )
     chunks = (
         neighbor_idx.reshape(nc, cap), rating.reshape(nc, cap),
         weight.reshape(nc, cap), tile_seg.reshape(nc, nt),
@@ -369,13 +469,17 @@ def als_half_step_tiled(
 
     def solve_chunk_rows(a, b, cnt_c):
         # The whole batch is solved including the trash row — solving it
-        # beats slicing it away, which copied the batch again.
+        # beats slicing it away, which copied the batch again.  fused=True
+        # pins the reg+solve FUSION (one kernel pass, the pre-existing
+        # default): the fused_epilogue A/B toggles only the Gram→HBM→solve
+        # round-trip, so split and fused chunk factors stay bit-exact and
+        # a patched process default (perf_lab --fused off) cannot swap the
+        # elimination algorithm under the baseline.
         if implicit_reg is None:
-            cnt_full = jnp.concatenate(
-                [cnt_c, jnp.ones((1,), cnt_c.dtype)]
-            )
-            return regularized_solve(a, b, cnt_full, lam, solver)
-        return regularized_solve_matrix(a, b, implicit_reg, solver)
+            return regularized_solve(a, b, _chunk_reg(cnt_c, None), lam,
+                                     solver, fused=True)
+        return regularized_solve_matrix(a, b, implicit_reg, solver,
+                                        fused=True)
 
     def body(carry, chunk):
         a0, b0 = carry
@@ -388,6 +492,16 @@ def als_half_step_tiled(
         # (~0.1 ms/chunk at rank 128).  The non-default gram_backend="xla"
         # A/B path DOES still pay the at[0].add batch rewrite (see
         # _entity_gram_chunk) — acceptable for a measurement-only branch.
+        if fused_lam is not None:
+            # Fused epilogue: ridge + solve run on the VMEM-resident
+            # (A, b); only the solved rows and the raw carry row return.
+            x, a1, b1 = _entity_gram_solve_chunk(
+                fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, lseg_c,
+                _chunk_reg(cnt_c, implicit_reg),
+                "diag" if implicit_reg is None else "matrix", fused_lam,
+                unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+            )
+            return (a1, b1), x[:e_c]
         a, b = _entity_gram_chunk(
             fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
@@ -429,6 +543,15 @@ def als_half_step_tiled(
         def compute(carry, g_cur, x, _i):
             a0, b0 = carry
             rt_c, wt_c, ts_c, cnt_c, cin_c, lseg_c = x
+            if fused_lam is not None:
+                x_rows, a1, b1 = _entity_gram_solve_chunk(
+                    fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1,
+                    lseg_c, _chunk_reg(cnt_c, implicit_reg),
+                    "diag" if implicit_reg is None else "matrix", fused_lam,
+                    unit_weights=implicit_reg is None,
+                    carry=(a0, b0, cin_c), pregathered=g_cur,
+                )
+                return (a1, b1), x_rows[:e_c]
             a, b = _entity_gram_chunk(
                 fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1, backend,
                 unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
@@ -472,6 +595,7 @@ def als_half_step_tiled_dense(
     aweight_dense: jax.Array | None = None,  # [NC·C] per-entry A-weights
     stage: str = "full",
     overlap: bool | None = None,
+    fused_epilogue: bool | None = None,
 ) -> jax.Array:
     """Dense-stream tiled half-iteration (the many-entities side, unpadded).
 
@@ -496,6 +620,12 @@ def als_half_step_tiled_dense(
     overlap = resolve_overlap(overlap)
     nc, cap, e_c, t, nt, ng, bg = statics
     k = fixed_factors.shape[-1]
+    fused_lam = (
+        resolve_fused_chunk_lam(
+            fused_epilogue, solver, k, e_c + 1, backend, lam,
+            implicit_reg is not None,
+        ) if stage == "full" else None
+    )
     ct, _ = _gram_compute_dtype(fixed_factors)
     fz = jnp.concatenate([
         fixed_factors,
@@ -543,16 +673,34 @@ def als_half_step_tiled_dense(
         rt_c, meta_c, lseg_c, cin_c, cnt_c = x[:5]
         if implicit_reg is not None:  # sqrt-weighted single stream
             g = g * x[5].astype(ct)[:, None]
+        if fused_lam is not None:
+            # Fused epilogue: the dense kernel solves its VMEM-resident
+            # (A, b) in place — no [Ec, k, k] HBM round-trip per chunk.
+            from cfk_tpu.ops.pallas.gram_kernel import (
+                gram_solve_tiles_dense_pallas,
+            )
+
+            x_rows, a1, b1 = gram_solve_tiles_dense_pallas(
+                g, rt_c, meta_c, _chunk_reg(cnt_c, implicit_reg), lseg_c,
+                num_segments=e_c + 1,
+                tile_rows=t, num_tiles=nt, num_groups=ng, block_rows=bg,
+                reg_mode="diag" if implicit_reg is None else "matrix",
+                lam=fused_lam, carry=(a0, b0, cin_c),
+            )
+            return (a1, b1), x_rows[:e_c]
         a, b = gram_tiles_dense_pallas_dispatch(
             g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
             num_tiles=nt, num_groups=ng, block_rows=bg,
             carry=(a0, b0, cin_c), backend=backend,
         )
+        # fused=True: same rationale as the stream body's solve_chunk_rows
+        # — the A/B axis is the round-trip, not the reg+solve fusion.
         if implicit_reg is None:
-            cnt_full = jnp.concatenate([cnt_c, jnp.ones((1,), cnt_c.dtype)])
-            x_rows = regularized_solve(a, b, cnt_full, lam, solver)
+            x_rows = regularized_solve(a, b, _chunk_reg(cnt_c, None), lam,
+                                       solver, fused=True)
         else:
-            x_rows = regularized_solve_matrix(a, b, implicit_reg, solver)
+            x_rows = regularized_solve_matrix(a, b, implicit_reg, solver,
+                                              fused=True)
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
         b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
         return (a1, b1), x_rows[:e_c]
@@ -623,6 +771,7 @@ def als_half_step_tiled_accum(
     gram_backend: str | None = None,
     stage: str = "full",
     overlap: bool | None = None,
+    fused_epilogue: bool | None = None,
 ) -> jax.Array:
     """Accumulator-mode tiled half-iteration (the few-entities side).
 
@@ -808,7 +957,14 @@ def als_half_step_tiled_accum(
         (acc_a, acc_b), _ = lax.scan(body, init, chunks)
     if stage == "accum":  # everything but the final solve
         return (acc_a[0, 0, 0] + acc_b[0, 0]).reshape(1, 1)
+    # Accum mode's (A, b) lives in HBM ACROSS chunks by design (entities
+    # recur across table slices), so there is no per-chunk VMEM residency
+    # to solve inside; the fused knob here gates the one fused reg+solve
+    # pass over the final accumulator vs the split ridge-add + dispatch
+    # (the bench's fused/split A/B axis).
     a, b = acc_a[:local_entities], acc_b[:local_entities]
     if implicit_reg is None:
-        return regularized_solve(a, b, count, lam, solver)
-    return regularized_solve_matrix(a, b, implicit_reg, solver)
+        return regularized_solve(a, b, count, lam, solver,
+                                 fused=fused_epilogue)
+    return regularized_solve_matrix(a, b, implicit_reg, solver,
+                                    fused=fused_epilogue)

@@ -123,7 +123,7 @@ def _ring_rotate(blk, perm, compute, *, overlap):
 
 def half_step_ring(
     fixed_local, nb, rt, mk, cnt, *, lam, num_shards, solve_chunk=None,
-    solver="cholesky", overlap=None, probe=None,
+    solver="cholesky", overlap=None, probe=None, fused_epilogue=None,
 ):
     """Per-shard half-iteration accumulating Gram blocks around a ppermute ring.
 
@@ -185,7 +185,12 @@ def half_step_ring(
     b0 = _to_varying(jnp.zeros((e, k), jnp.float32), AXIS)
     a, b, blk = lax.fori_loop(0, num_shards - 1, body, (a0, b0, fixed_local))
     ap, bp = gram_at(blk, num_shards - 1)
-    return regularized_solve(a + ap, b + bp, cnt, lam, solver)
+    # The ring's (A, b) accumulates ACROSS ring steps, so there is no
+    # per-chunk VMEM residency to solve inside; ``fused_epilogue`` gates
+    # the one fused reg+solve pass over the final sums (the fused/split
+    # A/B axis).
+    return regularized_solve(a + ap, b + bp, cnt, lam, solver,
+                             fused=fused_epilogue)
 
 
 def _segment_to_tree(blocks: SegmentBlocks) -> dict[str, np.ndarray]:
@@ -335,6 +340,7 @@ def _tiled_to_tree(blocks: TiledBlocks, weighted: bool = False
 def half_step_tiled_ring(
     fixed_local, blk, chunks, local_entities, *, lam, num_shards,
     solver="cholesky", gram_backend=None, overlap=None, probe=None,
+    fused_epilogue=None,
 ):
     """Tiled-layout half-iteration over the ppermute ring (block-to-block
     join) — the reference's headline join strategy at the at-scale layout.
@@ -426,9 +432,12 @@ def half_step_tiled_ring(
     acc_a, acc_b = slice_grams(
         (acc_a, acc_b), factors, (my - (s - 1)) % s
     )
+    # Like accum mode, the ring's accumulator lives across steps in HBM;
+    # the fused knob gates the final fused reg+solve vs the split
+    # ridge-add + dispatch (bench.py --fused-ab measures the pair).
     return regularized_solve(
         acc_a[:local_entities], acc_b[:local_entities],
-        blk["count"], lam, solver,
+        blk["count"], lam, solver, fused=fused_epilogue,
     )
 
 
@@ -591,6 +600,7 @@ def make_training_step(
                     lam=config.lam, num_shards=config.num_shards,
                     solver=config.solver, overlap=config.overlap,
                     probe=ring_probe,
+                    fused_epilogue=config.fused_epilogue,
                 )
 
             return half
@@ -600,6 +610,7 @@ def make_training_step(
                 return tiled_half_step(
                     fixed_full, blk, chunks, local, config.lam,
                     solver=config.solver, overlap=config.overlap,
+                    fused_epilogue=config.fused_epilogue,
                 )
 
             return gathered_half(solve)
@@ -667,6 +678,7 @@ def make_training_step(
             solver=config.solver,
             overlap=config.overlap,
             probe=ring_probe,
+            fused_epilogue=config.fused_epilogue,
         )
 
     # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI bytes
@@ -778,23 +790,33 @@ def train_als_sharded(
         m = shard_rows(mesh, state.movie_factors.astype(dtype))
     else:
         start_iter = 0
-        # Init outside shard_map: threefry values per row are independent of
-        # the padded row count, so 1-way and N-way runs start identically.
+        # Init outside shard_map, drawn at the REAL entity count (threefry
+        # output depends on the draw shape, so drawing at the shard-count-
+        # padded length would make the init a function of num_shards — the
+        # old 4-shard tiled mismatch); pad rows are zero either way.
         key = jax.random.PRNGKey(config.seed)
+        init_kw = dict(
+            rank=config.rank,
+            num_entities=dataset.user_blocks.num_entities,
+        )
         if stats_init:
-            u = jax.jit(init_factors_stats, static_argnames="rank")(
+            u = jax.jit(
+                init_factors_stats, static_argnames=("rank", "num_entities")
+            )(
                 key,
                 jnp.asarray(dataset.user_blocks.rating_sum),
                 jnp.asarray(dataset.user_blocks.count),
-                rank=config.rank,
+                **init_kw,
             ).astype(dtype)
         else:
-            u = jax.jit(init_factors, static_argnames="rank")(
+            u = jax.jit(
+                init_factors, static_argnames=("rank", "num_entities")
+            )(
                 key,
                 jnp.asarray(dataset.user_blocks.rating),
                 jnp.asarray(dataset.user_blocks.mask),
                 jnp.asarray(dataset.user_blocks.count),
-                rank=config.rank,
+                **init_kw,
             ).astype(dtype)
         u = shard_rows(mesh, u)
         m = shard_rows(

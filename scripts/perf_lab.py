@@ -107,6 +107,15 @@ def make_parser() -> argparse.ArgumentParser:
                    help="tiled: separate chunk size for the accum (movie) "
                    "side — its per-chunk VMEM need is tiny, so bigger "
                    "chunks cut scan overheads")
+    p.add_argument("--fused", default="on", choices=["on", "off"],
+                   help="fused Gram+solve epilogue A/B axis: 'on' "
+                   "(default) = solve each chunk's normal equations inside "
+                   "the Gram kernel's VMEM residency, 'off' = the split "
+                   "Gram→HBM→solve schedule.  The stream/dense chunk "
+                   "scans stay bit-exact across the axis (their split "
+                   "solve pins the one-pass reg+solve kernel, so only the "
+                   "round-trip toggles); the accum/ring final solves swap "
+                   "to the split ridge-add + dispatch under 'off'")
     p.add_argument("--overlap", default="on", choices=["on", "off"],
                    help="comm/compute overlap A/B axis: 'on' (default) = "
                    "double-buffered chunk/ring pipelines "
@@ -149,16 +158,29 @@ def run_lab(args) -> dict:
         import cfk_tpu.ops.pipeline as pipeline_mod
 
         pipeline_mod.default_overlap = lambda: False
+    if args.fused == "off":
+        import cfk_tpu.ops.solve as solve_mod
+
+        solve_mod.default_fused_epilogue = lambda: False
     if args.group_tiles is not None:
+        # Patch BOTH the split and the fused grouped-Gram wrappers — with
+        # --fused on (the default) the hot chunk kernel is the fused one,
+        # and a split-only patch would make this sweep axis silently inert.
         import cfk_tpu.ops.pallas.gram_kernel as gk
 
         _orig = gk.gram_tiles_pallas
+        _orig_fused = gk.gram_solve_tiles_pallas
 
         def _patched(*a, **kw):
             kw.setdefault("group_tiles", args.group_tiles)
             return _orig(*a, **kw)
 
+        def _patched_fused(*a, **kw):
+            kw.setdefault("group_tiles", args.group_tiles)
+            return _orig_fused(*a, **kw)
+
         gk.gram_tiles_pallas = _patched
+        gk.gram_solve_tiles_pallas = _patched_fused
 
 
     segment = args.layout == "segment"
@@ -258,6 +280,7 @@ def run_lab(args) -> dict:
         "chunk_elems": args.chunk_elems, "dtype": dt,
         "gram_backend": args.gram_backend, "rank": args.rank,
         "iters_per_call": args.iters, "overlap": args.overlap,
+        "fused": args.fused,
     }
     print(json.dumps(row))
     return row

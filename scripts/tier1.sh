@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Tier-1 verify gate — the exact command ROADMAP.md specifies, wrapped so
+# builders and CI run one script instead of copying the incantation.
+#
+#   scripts/tier1.sh            # full tier-1 run (CPU backend, not-slow)
+#   scripts/tier1.sh tests/test_tiled.py   # extra pytest args pass through
+#
+# Runs the suite on the CPU backend with the `slow` marker excluded, under
+# the same timeout the driver enforces, tees the log to /tmp/_t1.log, and
+# prints DOTS_PASSED=<count> (the driver's pass-count accounting) before
+# exiting with pytest's status.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

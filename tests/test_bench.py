@@ -70,8 +70,21 @@ def test_final_summary_line_fits_driver_tail():
         "rmse_by_seed": {str(s): 0.7602 for s in (0, 1, 2, 3, 4, 38)},
         "s_per_iteration": 0.1404, "s_per_iteration_median": 0.1489,
     }
+    overlap_row = {
+        "metric": "synthetic_ml25m_ring_overlap_ab_s_per_iteration",
+        "value": 0.1488, "vs_baseline": 1.0162,
+        "exchange_s_per_iter": 0.0421, "compute_s_per_iter": 0.1067,
+        "layout": "tiled+ring",
+    }
+    fused_row = {
+        "metric": "synthetic_ml25m_fused_epilogue_ab_s_per_iteration",
+        "value": 0.1488, "vs_baseline": 0.9775,
+        "factors_bit_exact": True, "removed_bytes_per_chunk": 250240,
+        "layout": "tiled+all_gather",
+    }
     rows = {
         "medium": medium, "at_scale": dict(full_row),
+        "overlap_ring": overlap_row, "fused_epilogue": fused_row,
         "full_rank64": dict(full_row), "full_rank128": dict(full_row),
         "ials_ml25m": dict(full_row), "ialspp_ml25m": dict(full_row),
     }

@@ -24,7 +24,7 @@ def _args(**over):
         solver="cholesky", dtype="float32", gram_backend=None,
         tiled_gram_backend=None, group_tiles=None, reg_solve_algo=None,
         ials=False, alpha=40.0, accum_chunk_elems=None, dense_stream=False,
-        overlap="on", iters=2, repeats=3, profile_dir=None,
+        overlap="on", fused="on", iters=2, repeats=3, profile_dir=None,
     )
     base.update(over)
     import argparse
