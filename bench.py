@@ -117,6 +117,15 @@ def main() -> None:
             fa = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# fused_epilogue: " + json.dumps(fa))
         rows["fused_epilogue"] = fa
+    # Health-sentinel overhead A/B (in-carry probe at every-iteration
+    # cadence vs plain loop; < 2% budget).  CFK_BENCH_HEALTH=0 skips it.
+    if os.environ.get("CFK_BENCH_HEALTH", "1") != "0":
+        try:
+            ha = _health_ab_row()
+        except Exception as e:  # pragma: no cover - subprocess-dependent
+            ha = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# health_sentinel: " + json.dumps(ha))
+        rows["health_sentinel"] = ha
     if os.environ.get("CFK_BENCH_HEADLINE", "1") != "0":
         for name, fn in (
             ("full_rank64", full_rank64_row),
@@ -971,6 +980,93 @@ def run_fused_ab(args) -> dict:
     }
 
 
+def health_ab_main(args) -> None:
+    print(json.dumps(run_health_ab(args)))
+
+
+def _health_ab_row() -> dict:
+    """Default-run sentinel-overhead row (subprocess for a clean backend,
+    like the other A/B rows)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, __file__, "--health-ab"],
+        capture_output=True, text=True, timeout=3600,
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip()[-300:]
+        return {"error": f"health-ab subprocess failed: {tail}"}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_health_ab(args) -> dict:
+    """Resilience A/B: the health sentinel's in-carry probe (isfinite +
+    norm watchdogs folded into the fused fori_loop carry at
+    ``health_check_every=1`` — the worst-case cadence) vs the plain loop,
+    on the dense-stream tiled config.  The acceptance budget is < 2%
+    s/iter overhead; factors must be bit-identical (the probe reads the
+    carry, never writes it).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.models import als as als_mod
+
+    div = args.health_div
+    users, movies, nnz = 162_541 // div, 59_047 // div, 25_000_095 // div
+    rank, iters = args.health_rank, args.iterations
+    coo = synthetic_netflix_coo(users, movies, nnz, seed=args.seed)
+    ds = Dataset.from_coo(
+        coo, layout="tiled", chunk_elems=args.chunk_elems,
+        dense_stream=True,
+    )
+    mblocks, ublocks, u_stats, layout_kw = als_mod._tiled_device_setup(ds)
+    jax.block_until_ready((mblocks, ublocks))
+
+    def timed(health_every):
+        def run():
+            out = als_mod._train_loop(
+                jax.random.PRNGKey(0), mblocks, ublocks, u_stats,
+                rank=rank, num_iterations=iters, lam=0.05,
+                solve_chunk=None, dtype="float32", solver="cholesky",
+                health_every=health_every, health_norm_limit=1e6,
+                **layout_kw,
+            )
+            jax.block_until_ready(out)
+            return out
+        out = run()  # compile + warm
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.time()
+            out = run()
+            times.append((time.time() - t0) / iters)
+        return min(times), np.asarray(out[0], np.float32)
+
+    on_s, on_u = timed(1)
+    off_s, off_u = timed(None)
+    max_diff = float(np.abs(on_u - off_u).max())
+    return {
+        "metric": "synthetic_ml25m_health_sentinel_ab_s_per_iteration",
+        "value": round(on_s, 4),
+        "unit": "s/iteration",
+        # the acceptance number: sentinel-on / sentinel-off s/iter.
+        "vs_baseline": round(on_s / off_s, 4),
+        "overhead_frac": round(on_s / off_s - 1.0, 4),
+        "health_on_s_per_iter": round(on_s, 4),
+        "health_off_s_per_iter": round(off_s, 4),
+        "max_abs_factor_diff_health_vs_plain": max_diff,
+        "factors_bit_exact": bool(max_diff == 0.0),
+        "health_check_every": 1,
+        "users": users, "movies": movies, "ratings": nnz, "rank": rank,
+        "iterations": iters, "repeats": args.repeats,
+        "layout": "tiled dense-stream, single device",
+        "backend": jax.default_backend(),
+    }
+
+
 def compare_exchange_main(args) -> None:
     """The reference's headline experiment (its README.md:216-224): the
     block-to-block join (ring) vs the all-to-all join (all_gather), same
@@ -1141,9 +1237,21 @@ if __name__ == "__main__":
                         help="tiled chunk size for --overlap-ab (small "
                         "enough that each shard streams several chunks, "
                         "so the chunk pipeline is exercised too)")
+    parser.add_argument("--health-ab", action="store_true",
+                        help="A/B the health sentinel's in-carry probe "
+                        "(health_check_every=1) against the plain fused "
+                        "loop on the dense-stream tiled config; reports "
+                        "the s/iter overhead fraction (< 2%% budget) and "
+                        "checks factors stay bit-identical")
+    parser.add_argument("--health-div", type=int, default=64,
+                        help="shape divisor for --health-ab (ML-25M "
+                        "proportions scaled down)")
+    parser.add_argument("--health-rank", type=int, default=16)
     cli_args = parser.parse_args()
     run = (
-        (lambda: fused_ab_main(cli_args))
+        (lambda: health_ab_main(cli_args))
+        if cli_args.health_ab
+        else (lambda: fused_ab_main(cli_args))
         if cli_args.fused_ab
         else (lambda: overlap_ab_main(cli_args))
         if cli_args.overlap_ab

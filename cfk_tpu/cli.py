@@ -360,6 +360,11 @@ def _train(args) -> int:
         algorithm=args.algorithm,
         block_size=args.block_size,
         sweeps=args.sweeps,
+        health_check_every=args.health_check_every,
+        health_norm_limit=args.health_norm_limit,
+        max_recoveries=args.max_recoveries,
+        lam_escalation=args.lam_escalation,
+        on_unrecoverable=args.on_unrecoverable,
     )
     heldout = train_coo = None
     if args.eval_ranking:
@@ -893,6 +898,37 @@ def build_parser() -> argparse.ArgumentParser:
         "(ratings per scan chunk); padded derives entities per solve "
         "chunk from it at run time",
     )
+    t.add_argument(
+        "--health-check-every", type=int, default=None, metavar="N",
+        help="arm the numerical-health sentinel: probe the factor state "
+        "(isfinite + norm watchdogs, <2%% overhead at N=1) every N "
+        "iterations; a tripped probe rolls back to the last good "
+        "checkpoint and escalates (retry, then lam x LAM_ESCALATION, "
+        "then split epilogue, then GJ elimination).  Default: off",
+    )
+    t.add_argument(
+        "--health-norm-limit", type=float, default=1e6,
+        help="factor-row 2-norm above which the sentinel's watchdog trips "
+        "even while values are still finite (catches slow divergence "
+        "before overflow)",
+    )
+    t.add_argument(
+        "--max-recoveries", type=int, default=4,
+        help="total sentinel trips tolerated before the run stops "
+        "retrying (see --on-unrecoverable)",
+    )
+    t.add_argument(
+        "--lam-escalation", type=float, default=10.0,
+        help="multiplier applied to lam on the recovery ladder's "
+        "regularization rung",
+    )
+    t.add_argument(
+        "--on-unrecoverable", choices=["degrade", "raise"],
+        default="degrade",
+        help="after max-recoveries trips: 'degrade' returns the last-good "
+        "factors with a diagnostic report in the metrics (a stale model "
+        "beats no model); 'raise' fails the run",
+    )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)
     t.add_argument(
@@ -1005,11 +1041,13 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    from cfk_tpu.resilience.policy import TrainingDivergedError
     from cfk_tpu.transport.tcp import BrokerRequestError
 
     try:
         return args.fn(args)
-    except (ValueError, OSError, KeyError, BrokerRequestError) as e:
+    except (ValueError, OSError, KeyError, BrokerRequestError,
+            TrainingDivergedError) as e:
         # User-input errors get one clean line; CFK_TPU_TRACEBACK=1 re-raises
         # for debugging.
         import os

@@ -231,6 +231,29 @@ class ALSConfig:
     algorithm: str = "als"
     block_size: int = 32
     sweeps: int = 1
+    # --- self-healing (cfk_tpu.resilience) -------------------------------
+    # Numerical-health sentinel cadence: probe the factor state (isfinite
+    # reductions + max-row-norm watchdogs, O(E·k) — measured < 2% s/iter
+    # at health_check_every=1 on the bench dense-stream config) every N
+    # completed iterations.  None disables the sentinel entirely; the
+    # fused single-device loop then stays a pure fori_loop and the stepped
+    # loops skip the probe fetch.  Must be >= 1 when set.
+    health_check_every: int | None = None
+    # Factor-row 2-norm above which the watchdog trips even though every
+    # value is still finite — catches the slow blow-up that precedes
+    # overflow by several iterations (divergence is cheapest to fix early).
+    health_norm_limit: float = 1e6
+    # Recovery ladder bounds (cfk_tpu.resilience.policy): total sentinel
+    # trips tolerated before the run stops retrying; each trip rolls back
+    # to the last good checkpoint and climbs one escalation rung
+    # (retry → λ×lam_escalation → split epilogue → GJ elimination — the
+    # default of 4 makes the full ladder reachable before degrading).
+    max_recoveries: int = 4
+    lam_escalation: float = 10.0
+    # When retries are exhausted: "degrade" returns the last-good factors
+    # with a diagnostic report in the metrics (production default — a
+    # stale model beats no model), "raise" raises TrainingDivergedError.
+    on_unrecoverable: Literal["degrade", "raise"] = "degrade"
 
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "als++")
@@ -290,6 +313,31 @@ class ALSConfig:
                 "exchange='auto' (per-half ring/all_gather selection) "
                 f"applies to layout='tiled'; layout={self.layout!r} should "
                 "pick 'all_gather' or 'ring' explicitly"
+            )
+        if self.health_check_every is not None and self.health_check_every < 1:
+            raise ValueError(
+                f"health_check_every must be >= 1 (iterations between "
+                f"sentinel probes), got {self.health_check_every}; use "
+                "health_check_every=None to disable the health sentinel"
+            )
+        if self.health_norm_limit <= 0:
+            raise ValueError(
+                f"health_norm_limit must be > 0 (a factor-row 2-norm "
+                f"bound), got {self.health_norm_limit}"
+            )
+        if self.max_recoveries < 0:
+            raise ValueError(
+                f"max_recoveries must be >= 0, got {self.max_recoveries}"
+            )
+        if self.lam_escalation <= 1:
+            raise ValueError(
+                f"lam_escalation must be > 1 (it multiplies λ on "
+                f"escalation), got {self.lam_escalation}"
+            )
+        if self.on_unrecoverable not in ("degrade", "raise"):
+            raise ValueError(
+                f"on_unrecoverable must be 'degrade' or 'raise', got "
+                f"{self.on_unrecoverable!r}"
             )
         if self.hbm_chunk_elems is not None and self.hbm_chunk_elems < 1:
             raise ValueError(

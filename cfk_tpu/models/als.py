@@ -310,7 +310,8 @@ _ALG_STATICS = ("algorithm", "block_size", "sweeps", "overlap",
 
 @functools.partial(
     jax.jit,
-    static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype", "solver")
+    static_argnames=("rank", "num_iterations", "lam", "solve_chunk", "dtype",
+                     "solver", "health_every", "health_norm_limit")
     + _LAYOUT_STATICS + _ALG_STATICS,
 )
 def _train_loop(
@@ -330,11 +331,13 @@ def _train_loop(
     sweeps: int = 1,
     overlap: bool | None = None,
     fused_epilogue: bool | None = None,
+    health_every: int | None = None,
+    health_norm_limit: float = 0.0,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
     u_entities=None,
-) -> tuple[jax.Array, jax.Array]:
+):
     dt = jnp.dtype(dtype)
     if u_stats is not None:  # bucketed layout: init from per-entity stats
         u = init_factors_stats(key, u_stats["rating_sum"], u_stats["count"], rank)
@@ -347,8 +350,7 @@ def _train_loop(
     u = u.astype(dt)
     m0 = jnp.zeros((m_rows, rank), dtype=dt)
 
-    def one_iteration(_, carry):
-        u, m_prev = carry
+    def step(i, u, m_prev):
         return _iteration_body(
             u, movie_blocks, user_blocks,
             lam=lam, solve_chunk=solve_chunk, dt=dt, solver=solver,
@@ -358,10 +360,31 @@ def _train_loop(
             m_entities=m_entities, u_entities=u_entities,
         )
 
-    u_final, m_final = jax.lax.fori_loop(
-        0, num_iterations, one_iteration, (u, m0)
+    if health_every is None:
+        u_final, m_final = jax.lax.fori_loop(
+            0, num_iterations, lambda i, c: step(i, *c), (u, m0)
+        )
+        return u_final, m_final
+
+    # Health sentinel folded into the fori_loop carry: an int32
+    # [first_bad_iter, reasons] word updated (via lax.cond, so off-cadence
+    # iterations pay nothing) every ``health_every`` iterations — the host
+    # inspects it once after the loop and reruns through the resilient
+    # stepped loop only when it tripped (cfk_tpu.resilience.sentinel).
+    from cfk_tpu.resilience import sentinel
+
+    def probed(i, carry):
+        u, m_prev, hw = carry
+        u2, m2 = step(i, u, m_prev)
+        hw = sentinel.fold_probe(
+            hw, i, u2, m2, every=health_every,
+            norm_limit=health_norm_limit, total=num_iterations,
+        )
+        return u2, m2, hw
+
+    return jax.lax.fori_loop(
+        0, num_iterations, probed, (u, m0, sentinel.carry_init())
     )
-    return u_final, m_final
 
 
 def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
@@ -433,6 +456,7 @@ def train_als(
     checkpoint_manager=None,
     checkpoint_every: int = 1,
     metrics=None,
+    fault_injector=None,
 ) -> ALSModel:
     """Train ALS-WR on one device. Returns factors in ascending-id order.
 
@@ -441,9 +465,20 @@ def train_als(
     factors can be saved every ``checkpoint_every`` iterations and training
     resumes from the latest step.  ``metrics`` (a ``cfk_tpu.utils.metrics.
     Metrics``) records phase timings and iteration counters when provided.
+
+    ``config.health_check_every`` arms the numerical-health sentinel: the
+    fused loop folds the probe into its carry and, when it trips, the run
+    is replayed through the resilient stepped loop, which rolls back to the
+    last good state and climbs the escalation ladder
+    (``cfk_tpu.resilience``).  ``fault_injector`` (chaos testing only)
+    forces the stepped loop so faults can fire at step boundaries.
     """
+    from cfk_tpu.resilience.loop import validate_cadence
+    from cfk_tpu.resilience.sentinel import health_from_config
     from cfk_tpu.utils.metrics import Metrics
 
+    health = health_from_config(config)
+    validate_cadence(checkpoint_every, health)
     metrics = metrics if metrics is not None else Metrics()
     metrics.gauge("num_users", dataset.user_map.num_entities)
     metrics.gauge("num_movies", dataset.movie_map.num_entities)
@@ -474,9 +509,11 @@ def train_als(
             dataset.user_blocks.neighbor_idx.shape[1],
         )
         solve_chunk = config.padded_solve_chunk(width)
-    if checkpoint_manager is None:
+    stepped = checkpoint_manager is not None or fault_injector is not None
+    if not stepped:
+        train_s_before = metrics.phases.get("train", 0.0)
         with metrics.phase("train"):
-            u, m = _train_loop(
+            out = _train_loop(
                 key,
                 mblocks,
                 ublocks,
@@ -492,13 +529,40 @@ def train_als(
                 sweeps=config.sweeps,
                 overlap=config.overlap,
                 fused_epilogue=config.fused_epilogue,
+                health_every=None if health is None else health.every,
+                health_norm_limit=(
+                    0.0 if health is None else health.norm_limit
+                ),
                 **layout_kw,
             )
+            u, m = out[0], out[1]
             u.block_until_ready()
-        metrics.incr("iterations", config.num_iterations)
-    else:
-        from cfk_tpu.transport.checkpoint import checkpointed_train_loop
+        report = None
+        if health is not None:
+            from cfk_tpu.resilience.sentinel import report_from_carry
 
+            report = report_from_carry(out[2], u, m)
+        if report is None or report.healthy:
+            metrics.incr("iterations", config.num_iterations)
+        else:
+            import warnings
+
+            # The fused attempt is discarded and replayed below, so keep
+            # its accounting out of the headline counters: its wall time
+            # moves to "train_discarded" and its iterations are not
+            # counted (the stepped replay re-detects this divergence and
+            # does the health_trips / rollback accounting exactly once).
+            discarded = metrics.phases.get("train", 0.0) - train_s_before
+            metrics.phases["train"] = train_s_before
+            metrics.phases["train_discarded"] += discarded
+            metrics.note("fused_loop_trip", report.summary())
+            warnings.warn(
+                f"health sentinel tripped in the fused training loop "
+                f"({report.summary()}); replaying through the "
+                "resilient stepped loop"
+            )
+            stepped = True
+    if stepped:
         dt = jnp.dtype(config.dtype)
 
         def init_fn():
@@ -514,18 +578,24 @@ def train_als(
             m = jnp.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
             return u, m
 
-        def step_fn(u, m):
-            return _one_iteration(
-                u, m, mblocks, ublocks,
-                lam=config.lam, solve_chunk=solve_chunk,
-                dtype=config.dtype, solver=config.solver,
-                algorithm=config.algorithm, block_size=config.block_size,
-                sweeps=config.sweeps, overlap=config.overlap,
-                fused_epilogue=config.fused_epilogue,
-                **layout_kw,
-            )
+        def make_step(ov):
+            def step_fn(u, m):
+                return _one_iteration(
+                    u, m, mblocks, ublocks,
+                    lam=ov.lam, solve_chunk=solve_chunk,
+                    dtype=config.dtype, solver=config.solver,
+                    algorithm=config.algorithm, block_size=config.block_size,
+                    sweeps=config.sweeps, overlap=config.overlap,
+                    fused_epilogue=ov.fused_epilogue,
+                    **layout_kw,
+                )
 
-        u, m = checkpointed_train_loop(
+            return step_fn
+
+        from cfk_tpu.resilience.loop import resilient_train_loop
+        from cfk_tpu.resilience.policy import Overrides, policy_from_config
+
+        u, m = resilient_train_loop(
             checkpoint_manager,
             model="als",
             rank=config.rank,
@@ -534,9 +604,15 @@ def train_als(
             m_shape=(dataset.movie_blocks.padded_entities, config.rank),
             dtype=dt,
             init_fn=init_fn,
-            step_fn=step_fn,
+            make_step=make_step,
+            base_overrides=Overrides(
+                lam=config.lam, fused_epilogue=config.fused_epilogue
+            ),
             metrics=metrics,
             checkpoint_every=checkpoint_every,
+            health=health,
+            policy=policy_from_config(config),
+            fault_injector=fault_injector,
         )
     return ALSModel(
         user_factors=u,

@@ -126,6 +126,7 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     static_argnames=(
         "rank", "num_iterations", "lam", "alpha", "dtype", "solver",
         "algorithm", "block_size", "sweeps", "overlap", "fused_epilogue",
+        "health_every", "health_norm_limit",
         "m_chunks", "u_chunks", "m_entities", "u_entities",
     ),
 )
@@ -133,6 +134,7 @@ def _train_loop(
     key, movie_blocks, user_blocks, u_stats=None, *, rank, num_iterations, lam,
     alpha, dtype, solver="cholesky", algorithm="als", block_size=32, sweeps=1,
     overlap=None, fused_epilogue=None,
+    health_every=None, health_norm_limit=0.0,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     dt = jnp.dtype(dtype)
@@ -147,8 +149,7 @@ def _train_loop(
     u = u.astype(dt)
     m0 = jnp.zeros((m_rows, rank), dtype=dt)
 
-    def one_iteration(_, carry):
-        u, m_prev = carry
+    def step(u, m_prev):
         return _ials_iteration_body(
             u, m_prev, movie_blocks, user_blocks,
             lam=lam, alpha=alpha, dt=dt, solver=solver,
@@ -158,7 +159,26 @@ def _train_loop(
             m_entities=m_entities, u_entities=u_entities,
         )
 
-    return lax.fori_loop(0, num_iterations, one_iteration, (u, m0))
+    if health_every is None:
+        return lax.fori_loop(
+            0, num_iterations, lambda i, c: step(*c), (u, m0)
+        )
+
+    # In-carry health word, as in als._train_loop (see there).
+    from cfk_tpu.resilience import sentinel
+
+    def probed(i, carry):
+        u, m_prev, hw = carry
+        u2, m2 = step(u, m_prev)
+        hw = sentinel.fold_probe(
+            hw, i, u2, m2, every=health_every,
+            norm_limit=health_norm_limit, total=num_iterations,
+        )
+        return u2, m2, hw
+
+    return lax.fori_loop(
+        0, num_iterations, probed, (u, m0, sentinel.carry_init())
+    )
 
 
 def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
@@ -235,6 +255,7 @@ def train_ials(
     checkpoint_manager=None,
     checkpoint_every: int = 1,
     metrics=None,
+    fault_injector=None,
 ) -> ALSModel:
     """Single-device implicit ALS. Ratings in the dataset are interaction
     strengths (counts, play-time, explicit stars — anything ≥ 0).
@@ -243,10 +264,15 @@ def train_ials(
     runs as one fused ``fori_loop``; with one, iterations step from Python,
     factors are journaled every ``checkpoint_every`` iterations, and training
     resumes from the latest committed step (the reference's ``setup.sh:18-21``
-    journal applies to every model, so ours does too)."""
+    journal applies to every model, so ours does too).  Health sentinel /
+    recovery / ``fault_injector`` semantics also match ``train_als``."""
+    from cfk_tpu.resilience.loop import validate_cadence
+    from cfk_tpu.resilience.sentinel import health_from_config
     from cfk_tpu.utils.metrics import Metrics
 
     _check_nonnegative_strengths(dataset)
+    health = health_from_config(config)
+    validate_cadence(checkpoint_every, health)
     metrics = metrics if metrics is not None else Metrics()
     key = jax.random.PRNGKey(config.seed)
     if isinstance(dataset.movie_blocks, BucketedBlocks):
@@ -263,9 +289,11 @@ def train_ials(
         ublocks = _blocks_to_device(dataset.user_blocks)
         u_stats = None
         layout_kw = {}
-    if checkpoint_manager is None:
+    stepped = checkpoint_manager is not None or fault_injector is not None
+    if not stepped:
+        train_s_before = metrics.phases.get("train", 0.0)
         with metrics.phase("train"):
-            u, m = _train_loop(
+            out = _train_loop(
                 key,
                 mblocks,
                 ublocks,
@@ -281,13 +309,40 @@ def train_ials(
                 sweeps=config.sweeps,
                 overlap=config.overlap,
                 fused_epilogue=config.fused_epilogue,
+                health_every=None if health is None else health.every,
+                health_norm_limit=(
+                    0.0 if health is None else health.norm_limit
+                ),
                 **layout_kw,
             )
+            u, m = out[0], out[1]
             u.block_until_ready()
-        metrics.incr("iterations", config.num_iterations)
-    else:
-        from cfk_tpu.transport.checkpoint import checkpointed_train_loop
+        report = None
+        if health is not None:
+            from cfk_tpu.resilience.sentinel import report_from_carry
 
+            report = report_from_carry(out[2], u, m)
+        if report is None or report.healthy:
+            metrics.incr("iterations", config.num_iterations)
+        else:
+            import warnings
+
+            # The fused attempt is discarded and replayed below, so keep
+            # its accounting out of the headline counters: its wall time
+            # moves to "train_discarded" and its iterations are not
+            # counted (the stepped replay re-detects this divergence and
+            # does the health_trips / rollback accounting exactly once).
+            discarded = metrics.phases.get("train", 0.0) - train_s_before
+            metrics.phases["train"] = train_s_before
+            metrics.phases["train_discarded"] += discarded
+            metrics.note("fused_loop_trip", report.summary())
+            warnings.warn(
+                f"health sentinel tripped in the fused training loop "
+                f"({report.summary()}); replaying through the "
+                "resilient stepped loop"
+            )
+            stepped = True
+    if stepped:
         dt = jnp.dtype(config.dtype)
 
         def init_fn():
@@ -303,18 +358,24 @@ def train_ials(
             m = jnp.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
             return u, m
 
-        def step_fn(u, m):
-            return _one_iteration(
-                u, m, mblocks, ublocks,
-                lam=config.lam, alpha=config.alpha, dtype=config.dtype,
-                solver=config.solver, algorithm=config.algorithm,
-                block_size=config.block_size, sweeps=config.sweeps,
-                overlap=config.overlap,
-                fused_epilogue=config.fused_epilogue,
-                **layout_kw,
-            )
+        def make_step(ov):
+            def step_fn(u, m):
+                return _one_iteration(
+                    u, m, mblocks, ublocks,
+                    lam=ov.lam, alpha=config.alpha, dtype=config.dtype,
+                    solver=config.solver, algorithm=config.algorithm,
+                    block_size=config.block_size, sweeps=config.sweeps,
+                    overlap=config.overlap,
+                    fused_epilogue=ov.fused_epilogue,
+                    **layout_kw,
+                )
 
-        u, m = checkpointed_train_loop(
+            return step_fn
+
+        from cfk_tpu.resilience.loop import resilient_train_loop
+        from cfk_tpu.resilience.policy import Overrides, policy_from_config
+
+        u, m = resilient_train_loop(
             checkpoint_manager,
             model="ials",
             rank=config.rank,
@@ -323,9 +384,15 @@ def train_ials(
             m_shape=(dataset.movie_blocks.padded_entities, config.rank),
             dtype=dt,
             init_fn=init_fn,
-            step_fn=step_fn,
+            make_step=make_step,
+            base_overrides=Overrides(
+                lam=config.lam, fused_epilogue=config.fused_epilogue
+            ),
             metrics=metrics,
             checkpoint_every=checkpoint_every,
+            health=health,
+            policy=policy_from_config(config),
+            fault_injector=fault_injector,
         )
     return ALSModel(
         user_factors=u,
@@ -497,17 +564,26 @@ def train_ials_sharded(
     checkpoint_manager=None,
     checkpoint_every: int = 1,
     metrics=None,
+    fault_injector=None,
 ) -> ALSModel:
-    """Multi-device iALS over a 1-D mesh, with optional checkpoint/resume."""
+    """Multi-device iALS over a 1-D mesh, with optional checkpoint/resume.
+
+    Health sentinel / rollback+escalation / ``fault_injector`` semantics
+    match ``train_als_sharded`` (iALS is all_gather-only, so the probe is
+    the step-level factor word — there is no ring carry to instrument)."""
     from cfk_tpu.utils.metrics import Metrics
 
     from cfk_tpu.config import apply_overlap_xla_flags
+    from cfk_tpu.resilience.loop import validate_cadence
+    from cfk_tpu.resilience.sentinel import health_from_config
 
     _check_nonnegative_strengths(dataset)
+    health = health_from_config(config)
+    validate_cadence(checkpoint_every, health)
     apply_overlap_xla_flags(config)
     metrics = metrics if metrics is not None else Metrics()
     from cfk_tpu.parallel.spmd import validate_sharded_dataset
-    from cfk_tpu.transport.checkpoint import resume_state_synced, should_save
+    from cfk_tpu.transport.checkpoint import resume_state_synced
 
     validate_sharded_dataset(dataset, config, mesh)
 
@@ -539,20 +615,8 @@ def train_ials_sharded(
         utree = shard_rows(mesh, to_tree(dataset.user_blocks))
 
     dt = jnp.dtype(config.dtype)
-    state = resume_state_synced(
-        checkpoint_manager,
-        rank=config.rank,
-        model="ials",
-        num_iterations=config.num_iterations,
-        u_shape=(dataset.user_blocks.padded_entities, config.rank),
-        m_shape=(dataset.movie_blocks.padded_entities, config.rank),
-    )
-    if state is not None:
-        start_iter = state.iteration
-        u = shard_rows(mesh, state.user_factors.astype(dt))
-        m = shard_rows(mesh, state.movie_factors.astype(dt))
-    else:
-        start_iter = 0
+
+    def init_fn():
         # Draw at the REAL entity count so the init (hence the trajectory)
         # is independent of shard-count padding — see init_factors_stats.
         key = jax.random.PRNGKey(config.seed)
@@ -583,27 +647,37 @@ def train_ials_sharded(
         m = shard_rows(
             mesh, np.zeros((dataset.movie_blocks.padded_entities, config.rank), dt)
         )
+        return u, m
 
-    step = jax.jit(
-        make_ials_training_step(mesh, config, **step_kw), donate_argnums=(0, 1)
+    from cfk_tpu.parallel.spmd import _sharded_resilient_loop
+
+    u, m = _sharded_resilient_loop(
+        checkpoint_manager,
+        model="ials",
+        dataset=dataset,
+        config=config,
+        mesh=mesh,
+        dtype=dt,
+        init_fn=init_fn,
+        make_raw_step=lambda cfg: make_ials_training_step(
+            mesh, cfg, **step_kw
+        ),
+        mtree=mtree,
+        utree=utree,
+        metrics=metrics,
+        checkpoint_every=checkpoint_every,
+        health=health,
+        fault_injector=fault_injector,
+        resume_fn=lambda: resume_state_synced(
+            checkpoint_manager,
+            rank=config.rank,
+            model="ials",
+            num_iterations=config.num_iterations,
+            u_shape=(dataset.user_blocks.padded_entities, config.rank),
+            m_shape=(dataset.movie_blocks.padded_entities, config.rank),
+        ),
+        save_meta={"rank": config.rank, "model": "ials"},
     )
-    for i in range(start_iter, config.num_iterations):
-        with metrics.phase("train"):
-            u, m = step(u, m, mtree, utree)
-            u.block_until_ready()
-        metrics.incr("iterations")
-        done = i + 1
-        if checkpoint_manager is not None and should_save(
-            done, checkpoint_every, config.num_iterations
-        ):
-            with metrics.phase("checkpoint"):
-                uh, mh = to_host(u), to_host(m)
-                if jax.process_index() == 0:
-                    checkpoint_manager.save(
-                        done, uh, mh,
-                        meta={"rank": config.rank, "model": "ials"},
-                    )
-            metrics.incr("checkpoints")
 
     return ALSModel(
         user_factors=u,

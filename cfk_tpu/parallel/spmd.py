@@ -121,9 +121,18 @@ def _ring_rotate(blk, perm, compute, *, overlap):
     return out, nxt
 
 
+def _nonfinite_flag(x):
+    """int32 0/1: any NaN/Inf anywhere in ``x`` (ring-carry health probe)."""
+    return jnp.where(
+        jnp.all(jnp.isfinite(x.astype(jnp.float32))),
+        jnp.int32(0), jnp.int32(1),
+    )
+
+
 def half_step_ring(
     fixed_local, nb, rt, mk, cnt, *, lam, num_shards, solve_chunk=None,
     solver="cholesky", overlap=None, probe=None, fused_epilogue=None,
+    health=False,
 ):
     """Per-shard half-iteration accumulating Gram blocks around a ppermute ring.
 
@@ -139,9 +148,19 @@ def half_step_ring(
     transfers ("exchange") or just the Gram/solve with no transfers
     ("compute") — same op counts as the respective phase of the real
     half-iteration, numerically meaningless factors.
+
+    ``health=True`` (the resilience sentinel's ring-carry probe,
+    ``cfk_tpu.resilience``) folds an ``isfinite`` check of each
+    ring-rotated factor block into the loop carry and returns
+    ``(factors, bad)`` — ``bad`` is a per-shard int32 flag that localizes
+    in-flight exchange corruption to this half-iteration instead of
+    waiting for it to surface in the solved factors.  Incompatible with
+    the timing ``probe`` modes (which compute meaningless factors).
     """
     from cfk_tpu.ops.pipeline import resolve_overlap
 
+    if health and probe is not None:
+        raise ValueError("health probing and timing probes are exclusive")
     overlap = resolve_overlap(overlap)
     my = lax.axis_index(AXIS)
     e = nb.shape[0]
@@ -170,27 +189,35 @@ def half_step_ring(
         )
 
     def body(r, carry):
-        a, b, blk = carry
+        a, b, blk, bad = carry
+        if health:
+            bad = bad | _nonfinite_flag(blk)
         if probe == "compute":  # Gram/solve only: never rotate the block
             ap, bp = gram_at(blk, r)
-            return (a + ap, b + bp, blk)
+            return (a + ap, b + bp, blk, bad)
         (ap, bp), blk = _ring_rotate(
             blk, perm, lambda cur: gram_at(cur, r), overlap=overlap
         )
-        return (a + ap, b + bp, blk)
+        return (a + ap, b + bp, blk, bad)
 
     # Mark the zero accumulators device-varying so the fori_loop carry type
     # matches the (varying) per-shard partial Gram sums.
     a0 = _to_varying(jnp.zeros((e, k, k), jnp.float32), AXIS)
     b0 = _to_varying(jnp.zeros((e, k), jnp.float32), AXIS)
-    a, b, blk = lax.fori_loop(0, num_shards - 1, body, (a0, b0, fixed_local))
+    bad0 = _to_varying(jnp.zeros((), jnp.int32), AXIS)
+    a, b, blk, bad = lax.fori_loop(
+        0, num_shards - 1, body, (a0, b0, fixed_local, bad0)
+    )
+    if health:
+        bad = bad | _nonfinite_flag(blk)
     ap, bp = gram_at(blk, num_shards - 1)
     # The ring's (A, b) accumulates ACROSS ring steps, so there is no
     # per-chunk VMEM residency to solve inside; ``fused_epilogue`` gates
     # the one fused reg+solve pass over the final sums (the fused/split
     # A/B axis).
-    return regularized_solve(a + ap, b + bp, cnt, lam, solver,
-                             fused=fused_epilogue)
+    x = regularized_solve(a + ap, b + bp, cnt, lam, solver,
+                          fused=fused_epilogue)
+    return (x, bad) if health else x
 
 
 def _segment_to_tree(blocks: SegmentBlocks) -> dict[str, np.ndarray]:
@@ -244,7 +271,7 @@ _tree_specs = tree_specs  # back-compat alias
 
 
 def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs,
-              *, carry_prev=False):
+              *, carry_prev=False, ring_flags=False):
     """The one shard_map scaffold every training step shares.
 
     ``half_m``/``half_u`` map (fixed_local, local_block_tree) → new local
@@ -252,23 +279,34 @@ def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs,
     casts factors to the storage/exchange dtype, and binds the row shardings.
     With ``carry_prev`` (warm-started optimizers like iALS++) the halves get
     the side's previous local factors too: (fixed_local, prev_local, blk).
+
+    With ``ring_flags`` (the resilience sentinel's ring-carry probe) every
+    half returns ``(factors, bad)`` and the step emits a third, replicated
+    int32 output: the psum of both halves' per-shard exchange-corruption
+    flags — 0 means every ring-rotated block stayed finite on every shard.
     """
     dtype = jnp.dtype(config.dtype)
 
-    def iteration(u, m_prev, mblk, ublk):
-        if carry_prev:
-            m = half_m(u, m_prev, mblk).astype(dtype)
-            u_new = half_u(m, u, ublk).astype(dtype)
-        else:
-            m = half_m(u, mblk).astype(dtype)
-            u_new = half_u(m, ublk).astype(dtype)
-        return u_new, m
+    def solve_half(half, fixed, prev, blk):
+        out = half(fixed, prev, blk) if carry_prev else half(fixed, blk)
+        x, bad = out if ring_flags else (out, None)
+        return x.astype(dtype), bad
 
+    def iteration(u, m_prev, mblk, ublk):
+        m, bad_m = solve_half(half_m, u, m_prev, mblk)
+        u_new, bad_u = solve_half(half_u, m, u, ublk)
+        if not ring_flags:
+            return u_new, m
+        return u_new, m, lax.psum(bad_m + bad_u, AXIS)
+
+    out_specs = (P(AXIS, None), P(AXIS, None))
+    if ring_flags:
+        out_specs = out_specs + (P(),)
     return _compat_shard_map(
         iteration,
         mesh=mesh,
         in_specs=(P(AXIS, None), P(AXIS, None), mspecs, uspecs),
-        out_specs=(P(AXIS, None), P(AXIS, None)),
+        out_specs=out_specs,
         check=use_check_vma(config),
     )
 
@@ -340,7 +378,7 @@ def _tiled_to_tree(blocks: TiledBlocks, weighted: bool = False
 def half_step_tiled_ring(
     fixed_local, blk, chunks, local_entities, *, lam, num_shards,
     solver="cholesky", gram_backend=None, overlap=None, probe=None,
-    fused_epilogue=None,
+    fused_epilogue=None, health=False,
 ):
     """Tiled-layout half-iteration over the ppermute ring (block-to-block
     join) — the reference's headline join strategy at the at-scale layout.
@@ -360,11 +398,13 @@ def half_step_tiled_ring(
     Each ring step is double-buffered (``_ring_rotate``): the next block's
     ppermute is issued before the current block's chunk loop starts, so
     the ICI transfer hides behind the slice's Gram accumulation.
-    ``probe``/``overlap`` as in ``half_step_ring``.
+    ``probe``/``overlap``/``health`` as in ``half_step_ring``.
     """
     from cfk_tpu.ops.pipeline import resolve_overlap
     from cfk_tpu.ops.tiled import _entity_gram_chunk, default_tiled_gram_backend
 
+    if health and probe is not None:
+        raise ValueError("health probing and timing probes are exclusive")
     overlap = resolve_overlap(overlap)
     backend = gram_backend or default_tiled_gram_backend()
     _, _, nc, cap, t, h, e_c = chunks
@@ -412,33 +452,41 @@ def half_step_tiled_ring(
         ).astype(jnp.float32)
 
     def body(r, carry):
-        acc_a, acc_b, factors = carry
+        acc_a, acc_b, factors, bad = carry
         t_idx = (my - r) % s
+        if health:
+            bad = bad | _nonfinite_flag(factors)
         if probe == "compute":  # chunk loops only: never rotate the block
             acc_a, acc_b = slice_grams((acc_a, acc_b), factors, t_idx)
-            return acc_a, acc_b, factors
+            return acc_a, acc_b, factors, bad
         (acc_a, acc_b), factors = _ring_rotate(
             factors, perm,
             lambda cur: slice_grams((acc_a, acc_b), cur, t_idx),
             overlap=overlap,
         )
-        return acc_a, acc_b, factors
+        return acc_a, acc_b, factors, bad
 
     a0 = _to_varying(
         jnp.zeros((local_entities + 1, k, k), jnp.float32), AXIS
     )
     b0 = _to_varying(jnp.zeros((local_entities + 1, k), jnp.float32), AXIS)
-    acc_a, acc_b, factors = lax.fori_loop(0, s - 1, body, (a0, b0, fixed_local))
+    bad0 = _to_varying(jnp.zeros((), jnp.int32), AXIS)
+    acc_a, acc_b, factors, bad = lax.fori_loop(
+        0, s - 1, body, (a0, b0, fixed_local, bad0)
+    )
+    if health:
+        bad = bad | _nonfinite_flag(factors)
     acc_a, acc_b = slice_grams(
         (acc_a, acc_b), factors, (my - (s - 1)) % s
     )
     # Like accum mode, the ring's accumulator lives across steps in HBM;
     # the fused knob gates the final fused reg+solve vs the split
     # ridge-add + dispatch (bench.py --fused-ab measures the pair).
-    return regularized_solve(
+    x = regularized_solve(
         acc_a[:local_entities], acc_b[:local_entities],
         blk["count"], lam, solver, fused=fused_epilogue,
     )
+    return (x, bad) if health else x
 
 
 def gathered_layout_trees(dataset: Dataset, config: ALSConfig,
@@ -518,6 +566,21 @@ def use_check_vma(config: ALSConfig) -> bool:
     return config.solver != "pallas" or jax.default_backend() == "tpu"
 
 
+def _zero_flag(half, prev=False):
+    """Append an always-clean exchange flag to a non-ring half so every
+    half has the ``(factors, bad)`` shape ``wrap_step(ring_flags=True)``
+    expects (all_gather halves have no in-flight carry to corrupt; any
+    non-finite output is caught by the step-level factor probe)."""
+    if prev:
+        return lambda fixed, prev_local, blk: (
+            half(fixed, prev_local, blk),
+            _to_varying(jnp.zeros((), jnp.int32), AXIS),
+        )
+    return lambda fixed, blk: (
+        half(fixed, blk), _to_varying(jnp.zeros((), jnp.int32), AXIS)
+    )
+
+
 def make_training_step(
     mesh: Mesh,
     config: ALSConfig,
@@ -533,6 +596,7 @@ def make_training_step(
     m_ring=False,
     u_ring=False,
     ring_probe=None,
+    health_probe=False,
 ):
     """Build the jittable one-full-iteration SPMD step (solve M, then U).
 
@@ -547,10 +611,21 @@ def make_training_step(
     ring and chunk schedules — the default — or the serial reference
     schedule; ``ring_probe`` ("exchange"/"compute", timing-only) builds the
     split-measurement step the bench's overlap A/B uses.
+
+    ``health_probe=True`` (the resilience sentinel) makes the step return
+    ``(u, m, bad)``: ring halves fold per-rotation ``isfinite`` checks of
+    the in-flight factor block into their carry, non-ring halves
+    contribute an always-clean flag, and ``bad`` is the mesh-wide psum —
+    the resilient loop fetches it on the health cadence.
     """
     dtype = jnp.dtype(config.dtype)
+    if health_probe and ring_probe is not None:
+        raise ValueError("health probing and timing probes are exclusive")
     if uspecs is None:
         uspecs = mspecs
+
+    def flagged(half, prev=False):
+        return _zero_flag(half, prev) if health_probe else half
 
     if config.algorithm == "als++":
         from cfk_tpu.ops.subspace import (
@@ -574,9 +649,11 @@ def make_training_step(
 
             return wrap_step(
                 mesh, config,
-                gathered_half(pp_bkt(m_chunks, m_local), with_prev=True),
-                gathered_half(pp_bkt(u_chunks, u_local), with_prev=True),
-                mspecs, uspecs, carry_prev=True,
+                flagged(gathered_half(pp_bkt(m_chunks, m_local),
+                                      with_prev=True), prev=True),
+                flagged(gathered_half(pp_bkt(u_chunks, u_local),
+                                      with_prev=True), prev=True),
+                mspecs, uspecs, carry_prev=True, ring_flags=health_probe,
             )
 
         def pp_padded(fixed_full, prev_local, blk, _gram):
@@ -585,9 +662,9 @@ def make_training_step(
                 blk["mask"], blk["count"], config.lam, **alg,
             )
 
-        half = gathered_half(pp_padded, with_prev=True)
+        half = flagged(gathered_half(pp_padded, with_prev=True), prev=True)
         return wrap_step(mesh, config, half, half, mspecs, uspecs,
-                         carry_prev=True)
+                         carry_prev=True, ring_flags=health_probe)
 
     if tiled:  # tile-padded layout
 
@@ -601,6 +678,7 @@ def make_training_step(
                     solver=config.solver, overlap=config.overlap,
                     probe=ring_probe,
                     fused_epilogue=config.fused_epilogue,
+                    health=health_probe,
                 )
 
             return half
@@ -613,7 +691,7 @@ def make_training_step(
                     fused_epilogue=config.fused_epilogue,
                 )
 
-            return gathered_half(solve)
+            return flagged(gathered_half(solve))
 
         # Each half picks its exchange from how its blocks were built —
         # exchange="auto" mixes them (ring movie-half + all_gather
@@ -623,7 +701,7 @@ def make_training_step(
             mesh, config,
             (ring_half if m_ring else ag_half)(m_chunks, m_local),
             (ring_half if u_ring else ag_half)(u_chunks, u_local),
-            mspecs, uspecs,
+            mspecs, uspecs, ring_flags=health_probe,
         )
 
     if segment:  # flat segment layout, all_gather exchange
@@ -641,9 +719,9 @@ def make_training_step(
 
         return wrap_step(
             mesh, config,
-            gathered_half(seg_solve(m_chunks, m_local)),
-            gathered_half(seg_solve(u_chunks, u_local)),
-            mspecs, uspecs,
+            flagged(gathered_half(seg_solve(m_chunks, m_local))),
+            flagged(gathered_half(seg_solve(u_chunks, u_local))),
+            mspecs, uspecs, ring_flags=health_probe,
         )
 
     if m_chunks is not None:  # bucketed layout, all_gather exchange
@@ -659,9 +737,9 @@ def make_training_step(
 
         return wrap_step(
             mesh, config,
-            gathered_half(bkt_solve(m_chunks, m_local)),
-            gathered_half(bkt_solve(u_chunks, u_local)),
-            mspecs, uspecs,
+            flagged(gathered_half(bkt_solve(m_chunks, m_local))),
+            flagged(gathered_half(bkt_solve(u_chunks, u_local))),
+            mspecs, uspecs, ring_flags=health_probe,
         )
 
     if config.exchange == "all_gather":
@@ -679,6 +757,7 @@ def make_training_step(
             overlap=config.overlap,
             probe=ring_probe,
             fused_epilogue=config.fused_epilogue,
+            health=health_probe,
         )
 
     # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI bytes
@@ -694,7 +773,10 @@ def make_training_step(
             solve_chunk=config.padded_solve_chunk(blk["neighbor"].shape[-1]),
         )
 
-    return wrap_step(mesh, config, half, half, mspecs, uspecs)
+    if config.exchange == "all_gather":
+        half = flagged(half)
+    return wrap_step(mesh, config, half, half, mspecs, uspecs,
+                     ring_flags=health_probe)
 
 
 def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) -> None:
@@ -723,6 +805,73 @@ def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) ->
             )
 
 
+def _sharded_resilient_loop(
+    manager, *, model, dataset, config, mesh, dtype, init_fn, make_raw_step,
+    mtree, utree, metrics, checkpoint_every, health, fault_injector,
+    resume_fn, save_meta,
+):
+    """Bind the resilient loop's device↔host boundary to a 1-D mesh.
+
+    Shared by the explicit and implicit sharded trainers: snapshots
+    process_allgather to host, restores re-shard rows, saves are
+    process-0-gated, and escalation overrides rebuild the jitted step from
+    a ``dataclasses.replace``d config (λ bump / split epilogue are
+    jit-statics, so each rung re-traces).
+    """
+    import dataclasses as _dc
+
+    from cfk_tpu.resilience.loop import resilient_train_loop
+    from cfk_tpu.resilience.policy import Overrides, policy_from_config
+
+    def make_step(ov):
+        cfg = config
+        if (ov.lam, ov.fused_epilogue) != (config.lam, config.fused_epilogue):
+            cfg = _dc.replace(
+                config, lam=ov.lam, fused_epilogue=ov.fused_epilogue
+            )
+        step = jax.jit(make_raw_step(cfg), donate_argnums=(0, 1))
+        return lambda u, m: step(u, m, mtree, utree)
+
+    def restore_fn(hu, hm):
+        return (
+            shard_rows(mesh, np.asarray(hu).astype(dtype)),
+            shard_rows(mesh, np.asarray(hm).astype(dtype)),
+        )
+
+    def save_fn(done, u, m):
+        # Multi-process: every host gathers (cheap, factors are [E, k])
+        # but only process 0 writes the checkpoint dir.  The gathered
+        # pair doubles as the resilient loop's rollback anchor.
+        uh, mh = to_host(u), to_host(m)
+        if jax.process_index() == 0:
+            manager.save(done, uh, mh, meta=save_meta)
+        return uh, mh
+
+    return resilient_train_loop(
+        manager,
+        model=model,
+        rank=config.rank,
+        num_iterations=config.num_iterations,
+        u_shape=(dataset.user_blocks.padded_entities, config.rank),
+        m_shape=(dataset.movie_blocks.padded_entities, config.rank),
+        dtype=dtype,
+        init_fn=init_fn,
+        make_step=make_step,
+        base_overrides=Overrides(
+            lam=config.lam, fused_epilogue=config.fused_epilogue
+        ),
+        metrics=metrics,
+        checkpoint_every=checkpoint_every,
+        health=health,
+        policy=policy_from_config(config),
+        fault_injector=fault_injector,
+        snapshot_fn=lambda u, m: (to_host(u), to_host(m)),
+        restore_fn=restore_fn,
+        save_fn=save_fn,
+        resume_fn=resume_fn,
+    )
+
+
 def train_als_sharded(
     dataset: Dataset,
     config: ALSConfig,
@@ -731,17 +880,26 @@ def train_als_sharded(
     checkpoint_manager=None,
     checkpoint_every: int = 1,
     metrics=None,
+    fault_injector=None,
 ) -> ALSModel:
     """Multi-device ALS-WR over a 1-D mesh; semantics match ``train_als``.
 
     With a ``CheckpointManager``, factors are saved every ``checkpoint_every``
     completed iterations and training resumes from the latest step on restart
     (the explicit form of the reference's never-read per-iteration topic
-    journal — SURVEY.md §5 checkpoint/resume).
+    journal — SURVEY.md §5 checkpoint/resume).  ``config.health_check_every``
+    arms the sentinel: the factor probe is fetched on its cadence and the
+    ring half-steps fold per-rotation exchange checks into their carries
+    (``make_training_step(health_probe=True)``); a trip rolls back to the
+    last good checkpoint and escalates (``cfk_tpu.resilience``).
     """
     from cfk_tpu.config import apply_overlap_xla_flags
+    from cfk_tpu.resilience.loop import validate_cadence
+    from cfk_tpu.resilience.sentinel import health_from_config
 
     s = config.num_shards
+    health = health_from_config(config)
+    validate_cadence(checkpoint_every, health)
     apply_overlap_xla_flags(config)
     validate_sharded_dataset(dataset, config, mesh)
 
@@ -773,23 +931,11 @@ def train_als_sharded(
     mtree = shard_rows(mesh, mtree)
     utree = shard_rows(mesh, utree)
 
-    from cfk_tpu.transport.checkpoint import resume_state_synced, should_save
+    from cfk_tpu.transport.checkpoint import resume_state_synced
 
     dtype = jnp.dtype(config.dtype)
-    state = resume_state_synced(
-        checkpoint_manager,
-        rank=config.rank,
-        model="als",
-        num_iterations=config.num_iterations,
-        u_shape=(dataset.user_blocks.padded_entities, config.rank),
-        m_shape=(dataset.movie_blocks.padded_entities, config.rank),
-    )
-    if state is not None:
-        start_iter = state.iteration
-        u = shard_rows(mesh, state.user_factors.astype(dtype))
-        m = shard_rows(mesh, state.movie_factors.astype(dtype))
-    else:
-        start_iter = 0
+
+    def init_fn():
         # Init outside shard_map, drawn at the REAL entity count (threefry
         # output depends on the draw shape, so drawing at the shard-count-
         # padded length would make the init a function of num_shards — the
@@ -823,41 +969,43 @@ def train_als_sharded(
             mesh,
             np.zeros((dataset.movie_blocks.padded_entities, config.rank), dtype),
         )
+        return u, m
 
     from cfk_tpu.utils.metrics import Metrics
 
     metrics = metrics if metrics is not None else Metrics()
-    step = jax.jit(
-        make_training_step(
-            mesh, config, _tree_specs(mtree), _tree_specs(utree), **step_kw
+    u, m = _sharded_resilient_loop(
+        checkpoint_manager,
+        model="als",
+        dataset=dataset,
+        config=config,
+        mesh=mesh,
+        dtype=dtype,
+        init_fn=init_fn,
+        make_raw_step=lambda cfg: make_training_step(
+            mesh, cfg, _tree_specs(mtree), _tree_specs(utree),
+            health_probe=health is not None, **step_kw
         ),
-        donate_argnums=(0, 1),
+        mtree=mtree,
+        utree=utree,
+        metrics=metrics,
+        checkpoint_every=checkpoint_every,
+        health=health,
+        fault_injector=fault_injector,
+        resume_fn=lambda: resume_state_synced(
+            checkpoint_manager,
+            rank=config.rank,
+            model="als",
+            num_iterations=config.num_iterations,
+            u_shape=(dataset.user_blocks.padded_entities, config.rank),
+            m_shape=(dataset.movie_blocks.padded_entities, config.rank),
+        ),
+        save_meta={
+            "rank": config.rank,
+            "exchange": config.exchange,
+            "model": "als",
+        },
     )
-    for i in range(start_iter, config.num_iterations):
-        with metrics.phase("train"):
-            u, m = step(u, m, mtree, utree)
-            u.block_until_ready()
-        metrics.incr("iterations")
-        done = i + 1
-        if checkpoint_manager is not None and should_save(
-            done, checkpoint_every, config.num_iterations
-        ):
-            with metrics.phase("checkpoint"):
-                # Multi-process: every host gathers (cheap, factors are
-                # [E, k]) but only process 0 writes the checkpoint dir.
-                uh, mh = to_host(u), to_host(m)
-                if jax.process_index() == 0:
-                    checkpoint_manager.save(
-                        done,
-                        uh,
-                        mh,
-                        meta={
-                            "rank": config.rank,
-                            "exchange": config.exchange,
-                            "model": "als",
-                        },
-                    )
-            metrics.incr("checkpoints")
 
     return ALSModel(
         user_factors=u,

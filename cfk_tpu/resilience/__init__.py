@@ -1,0 +1,48 @@
+"""Self-healing training: health sentinel, recovery policy, fault injection.
+
+The reference prototype has zero fault tolerance — any crash triggers
+``streams.cleanUp()`` and a from-scratch restart (SURVEY.md §5), and dense
+float32 ALS can silently diverge with no in-loop detection.  This package
+adds the three coupled pieces production matrix factorization needs
+(ALX, PAPERS.md, treats them as table stakes):
+
+- ``sentinel`` — cheap on-device numerical-health probes (``isfinite``
+  reductions + factor-norm watchdogs) folded into the iteration carry or
+  evaluated on a cadence from the stepped training loops.
+- ``policy`` — the rollback/escalation recovery ladder: on a tripped probe,
+  roll back to the last good checkpoint and retry, then bump λ, then pin
+  the split Gram→solve epilogue, then swap the LU elimination for
+  Gauss-Jordan; bounded retries before gracefully degrading to
+  "last-good factors + diagnostic report".
+- ``loop`` — the resilient stepped training loop every trainer shares
+  (single-device and SPMD), wiring sentinel + policy + checkpoint
+  rollback together.
+- ``faults`` — seeded, deterministic fault injection (NaN/Inf factor
+  corruption, singular normal equations, torn checkpoint writes, flaky
+  broker connections) so recovery is *proved*, not assumed
+  (``tests/test_resilience.py``, ``scripts/chaos_lab.py``).
+- ``retry`` — exponential backoff + jitter helpers shared with the TCP
+  transport.
+"""
+
+from cfk_tpu.resilience.policy import (
+    Overrides,
+    RecoveryPolicy,
+    TrainingDivergedError,
+)
+from cfk_tpu.resilience.sentinel import (
+    HealthConfig,
+    HealthReport,
+    describe_word,
+    health_from_config,
+)
+
+__all__ = [
+    "HealthConfig",
+    "HealthReport",
+    "Overrides",
+    "RecoveryPolicy",
+    "TrainingDivergedError",
+    "describe_word",
+    "health_from_config",
+]

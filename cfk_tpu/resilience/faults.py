@@ -1,0 +1,300 @@
+"""Deterministic fault injection: prove recovery works, don't assume it.
+
+Every fault here is seeded and replayable — the chaos suite
+(``tests/test_resilience.py``, ``scripts/chaos_lab.py``) asserts that each
+injected fault class is *detected* by the sentinel, *recovered* by the
+rollback/escalation policy, and that the recovered run converges to the
+fault-free run's final RMSE within tolerance.  Four fault classes, each
+hitting a different layer:
+
+- ``FactorCorruption`` — NaN/Inf written into seeded rows of a factor
+  buffer just before iteration ``k`` (models an HBM bit-flip / bad DMA).
+- ``SingularChunk`` — zero out the factor rows feeding one solve chunk's
+  normal equations; with λ=0 the chunk's Gram is exactly singular and the
+  Cholesky emits NaN (models degenerate data; the policy's λ bump is the
+  designed fix).
+- ``TornCheckpointManager`` — a checkpoint store whose write for one
+  target iteration is torn mid-"rename" (payload truncated after commit),
+  exercising the crc32 manifest verification and previous-step fallback.
+- ``FlakyBrokerProxy`` — a TCP proxy in front of a real broker that drops
+  whole connections and delays frames per a seeded plan, exercising the
+  client's connect retry/backoff and read-timeout handling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+
+# --- factor-buffer faults --------------------------------------------------
+
+
+@dataclasses.dataclass
+class FactorCorruption:
+    """Write ``value`` into ``num_rows`` seeded rows of one side's factors
+    before iteration ``iteration`` (0-based).  ``persistent`` re-fires on
+    every pass through that iteration (a rollback replays into the same
+    fault — the escalation path must fix the math); one-shot faults model
+    transients that a plain rollback+retry clears."""
+
+    iteration: int
+    side: str = "u"  # "u" | "m"
+    value: float = float("nan")
+    num_rows: int = 4
+    seed: int = 0
+    persistent: bool = False
+    fired: int = 0
+
+    def apply(self, i: int, u, m):
+        if i != self.iteration or (self.fired and not self.persistent):
+            return u, m
+        self.fired += 1
+        import jax.numpy as jnp
+
+        target = u if self.side == "u" else m
+        rows = np.random.default_rng(self.seed).choice(
+            target.shape[0], size=min(self.num_rows, target.shape[0]),
+            replace=False,
+        )
+        target = target.at[jnp.asarray(rows)].set(self.value)
+        return (target, m) if self.side == "u" else (u, target)
+
+
+@dataclasses.dataclass
+class SingularChunk:
+    """Zero a contiguous slice of the fixed side's factor rows before
+    iteration ``iteration`` so the entities whose neighbor lists live
+    entirely in that slice assemble an exactly-singular A = Σ f·fᵀ (run
+    with λ=0 to remove the SPD repair term — the escalation ladder's λ
+    bump is then precisely the recovery).  ``rows=None`` zeroes the whole
+    side — every chunk's normal equations go singular at once."""
+
+    iteration: int
+    side: str = "u"
+    rows: tuple[int, int] | None = None
+    persistent: bool = True
+    fired: int = 0
+
+    def apply(self, i: int, u, m):
+        if i != self.iteration or (self.fired and not self.persistent):
+            return u, m
+        self.fired += 1
+        target = u if self.side == "u" else m
+        lo, hi = self.rows if self.rows is not None else (0, target.shape[0])
+        target = target.at[lo:hi].set(0.0)
+        return (target, m) if self.side == "u" else (u, target)
+
+
+class FaultInjector:
+    """The hook the resilient loop calls: a seeded plan of factor faults.
+
+    ``before_step(i, u, m)`` applies every armed fault due at iteration
+    ``i`` and returns the (possibly corrupted) pair.  Passing an injector
+    to a trainer forces the stepped (resilient) loop — faults fire at step
+    boundaries, which the fused ``fori_loop`` does not expose.
+    """
+
+    def __init__(self, *faults):
+        self.faults = list(faults)
+
+    def before_step(self, i: int, u, m):
+        for f in self.faults:
+            u, m = f.apply(i, u, m)
+        return u, m
+
+    @property
+    def fired(self) -> int:
+        return sum(f.fired for f in self.faults)
+
+
+# --- checkpoint faults -----------------------------------------------------
+
+
+class TornCheckpointManager:
+    """Wrap a ``CheckpointManager`` so the save at ``tear_at`` is torn.
+
+    ``mode="truncate"`` halves one npy payload after the step directory is
+    committed (a torn write that raced the rename); ``mode="scramble"``
+    flips bytes in place (silent media corruption); ``mode="manifest"``
+    truncates ``manifest.json`` itself.  All three must be caught by the
+    crc32 manifest verification on restore, which then falls back to the
+    previous complete step.
+    """
+
+    def __init__(self, inner, tear_at: int, mode: str = "truncate",
+                 victim: str = "user.npy"):
+        if mode not in ("truncate", "scramble", "manifest"):
+            raise ValueError(f"unknown tear mode {mode!r}")
+        self.inner = inner
+        self.tear_at = tear_at
+        self.mode = mode
+        self.victim = victim
+        self.torn: list[str] = []
+
+    def __getattr__(self, name):  # delegate everything else
+        return getattr(self.inner, name)
+
+    def save(self, iteration, user_factors, movie_factors, meta=None):
+        path = self.inner.save(iteration, user_factors, movie_factors,
+                               meta=meta)
+        if iteration == self.tear_at:
+            victim = os.path.join(
+                path, "manifest.json" if self.mode == "manifest"
+                else self.victim,
+            )
+            data = open(victim, "rb").read()
+            if self.mode == "scramble":
+                torn = bytes(b ^ 0xFF for b in data[: len(data) // 2])
+                torn += data[len(data) // 2:]
+            else:
+                torn = data[: max(1, len(data) // 2)]
+            with open(victim, "wb") as f:
+                f.write(torn)
+            self.torn.append(victim)
+        return path
+
+
+# --- broker transport faults ----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FlakyPlan:
+    """Deterministic misbehavior schedule for ``FlakyBrokerProxy``.
+
+    ``drop_first_connects`` — accept then immediately close that many
+    connections (a broker still binding its listener / a dying LB
+    backend); the client's connect/request retry must back off and win.
+    ``delay_frames`` — hold each forwarded chunk of the first surviving
+    connection for ``frame_delay`` seconds (congestion); the client's
+    read timeout must be patient enough or retry.
+    """
+
+    drop_first_connects: int = 0
+    delay_frames: int = 0
+    frame_delay: float = 0.05
+
+
+class FlakyBrokerProxy:
+    """A localhost TCP proxy in front of a real broker, misbehaving to plan.
+
+    Forwards bytes both ways once a connection survives the plan; every
+    drop/delay is counted so tests assert the fault actually happened
+    (a chaos test that passes without injecting anything proves nothing).
+    """
+
+    def __init__(self, upstream_port: int, plan: FlakyPlan):
+        self.upstream_port = upstream_port
+        self.plan = plan
+        self.dropped = 0
+        self.delayed = 0
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(8)
+        self.port = self._lsock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._accepted = 0
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            self._accepted += 1
+            if self._accepted <= self.plan.drop_first_connects:
+                self.dropped += 1
+                conn.close()
+                continue
+            up = socket.create_connection(("127.0.0.1", self.upstream_port))
+            for src, dst, slow in ((conn, up, False), (up, conn, True)):
+                t = threading.Thread(
+                    target=self._pump, args=(src, dst, slow), daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src, dst, slow):
+        frames = 0
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                if slow and frames < self.plan.delay_frames:
+                    frames += 1
+                    self.delayed += 1
+                    time.sleep(self.plan.frame_delay)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def close(self):
+        self._stop.set()
+        self._lsock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def blockstructured_coo(
+    num_users: int = 24,
+    num_movies: int = 16,
+    isolated_movies: int = 4,
+    isolated_users: int = 8,
+    seed: int = 0,
+):
+    """Small dense-ish COO where the first ``isolated_movies`` movies are
+    rated ONLY by the first ``isolated_users`` users (who also rate the
+    shared movies).  Zeroing those users' factor rows (``SingularChunk``)
+    then makes exactly the isolated movies' normal equations singular
+    under λ=0, while the rest of the problem stays healthy — the shaped
+    fixture the singular-chunk chaos tests train on.  Every entity has
+    plenty of neighbors, so the λ=0 *fault-free* run is generically
+    non-singular (unlike power-law synthetic data, where low-degree
+    entities are singular at λ=0 on their own).
+    """
+    from cfk_tpu.data.blocks import RatingsCOO
+
+    rng = np.random.default_rng(seed)
+    movies, users = [], []
+    for mv in range(num_movies):
+        raters = (
+            range(isolated_users) if mv < isolated_movies
+            else range(num_users)
+        )
+        for us in raters:
+            movies.append(mv)
+            users.append(us)
+    movies = np.asarray(movies, np.int64)
+    users = np.asarray(users, np.int64)
+    ratings = rng.integers(1, 6, size=movies.shape[0]).astype(np.float32)
+    return RatingsCOO(movie_raw=movies, user_raw=users, rating=ratings)
+
+
+def crc32_file(path: str) -> int:
+    """crc32 of a file's bytes — THE checkpoint manifest payload checksum
+    (one implementation; a drifted copy here would make the chaos tests
+    verify against a stale scheme)."""
+    from cfk_tpu.transport.checkpoint import _crc32_file
+
+    return _crc32_file(path)

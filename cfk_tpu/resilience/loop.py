@@ -1,0 +1,299 @@
+"""The resilient stepped training loop every trainer shares.
+
+One loop, four call sites (explicit/implicit × single-device/SPMD): step
+from Python, journal factors on the checkpoint cadence, evaluate the
+health sentinel on its cadence, and on a trip roll back to the last good
+state and climb the escalation ladder (``cfk_tpu.resilience.policy``)
+before retrying — bounded, then gracefully degrading to last-good factors
+plus a diagnostic report instead of crashing.
+
+With ``health=None``, no policy and no injector this reduces exactly to
+the pre-resilience checkpointed loop (``transport.checkpoint.
+checkpointed_train_loop`` delegates here), so save cadence / resume
+validation / metrics accounting stay identical across model families by
+construction.
+
+The SPMD trainers parameterize the device↔host boundary via
+``snapshot_fn``/``restore_fn``/``save_fn``/``resume_fn`` (host gather is a
+``process_allgather``, restore re-shards, saves are process-0-gated);
+single-device callers take the numpy defaults.  Under multi-process JAX
+the probe word is a fully-replicated scalar, so every process fetches the
+same value and takes the same rollback decision in lockstep — no extra
+broadcast needed.
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+import numpy as np
+
+from cfk_tpu.resilience import sentinel as _sentinel
+from cfk_tpu.resilience.policy import (
+    Overrides,
+    RecoveryPolicy,
+    TrainingDivergedError,
+)
+
+
+def validate_cadence(checkpoint_every: int, health=None) -> None:
+    """Actionable validation of the loop cadences (satellite of ISSUE 3).
+
+    ``checkpoint_every < 1`` used to surface only from ``should_save``
+    deep inside the first iteration; a non-positive health cadence would
+    silently never probe (``done % every`` can never hit 0 for every <= 0
+    before Python raises on the modulo by zero mid-run).
+    """
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1 (iterations between factor "
+            f"saves), got {checkpoint_every}; use checkpoint_every=1 for "
+            "per-iteration journaling or a larger value to save less often"
+        )
+    if health is not None and health.every < 1:
+        raise ValueError(
+            f"health_check_every must be >= 1 (iterations between sentinel "
+            f"probes), got {health.every}; use health_check_every=None to "
+            "disable the sentinel entirely"
+        )
+
+
+def resilient_train_loop(
+    manager,
+    *,
+    model: str,
+    rank: int,
+    num_iterations: int,
+    u_shape,
+    m_shape,
+    dtype,
+    init_fn,
+    metrics,
+    step_fn=None,
+    make_step=None,
+    base_overrides: Overrides | None = None,
+    checkpoint_every: int = 1,
+    health: "_sentinel.HealthConfig | None" = None,
+    policy: RecoveryPolicy | None = None,
+    fault_injector=None,
+    snapshot_fn=None,
+    restore_fn=None,
+    save_fn=None,
+    resume_fn=None,
+):
+    """Run the stepped loop; returns the final ``(u, m)`` device factors.
+
+    Exactly one of ``step_fn`` (a fixed ``(u, m) -> (u, m)`` step — no
+    escalation possible beyond plain rollback+retry) or ``make_step``
+    (``make_step(Overrides) -> step`` — the full ladder) must be given.
+    A step may also return ``(u, m, ring_bad)`` where ``ring_bad`` is the
+    in-carry ring-exchange probe flag the SPMD ring half-steps emit; it is
+    fetched on the health cadence and folded into the probe word.
+    """
+    import jax.numpy as jnp
+
+    from cfk_tpu.transport.checkpoint import resume_state
+
+    validate_cadence(checkpoint_every, health)
+    if (step_fn is None) == (make_step is None):
+        raise ValueError("pass exactly one of step_fn / make_step")
+    policy = policy or RecoveryPolicy()
+    if snapshot_fn is None:
+        snapshot_fn = lambda u, m: (np.asarray(u), np.asarray(m))
+    if restore_fn is None:
+        restore_fn = lambda hu, hm: (
+            jnp.asarray(hu, dtype=dtype), jnp.asarray(hm, dtype=dtype)
+        )
+    if save_fn is None:
+        def save_fn(done, u, m):
+            hu, hm = np.asarray(u), np.asarray(m)
+            manager.save(done, hu, hm, meta={"rank": rank, "model": model})
+            return hu, hm
+
+    if resume_fn is None:
+        resume_fn = functools.partial(
+            resume_state, manager, rank=rank, model=model,
+            num_iterations=num_iterations, u_shape=u_shape, m_shape=m_shape,
+        )
+    state = resume_fn()
+    if state is not None:
+        start_iter = state.iteration
+        u, m = restore_fn(state.user_factors, state.movie_factors)
+    else:
+        start_iter = 0
+        u, m = init_fn()
+
+    # The GJ escalation rung rides CFK_REG_SOLVE_ALGO (resolved at step
+    # trace time); restore the caller's value on exit so one escalated
+    # run cannot contaminate later trainings in the same process.
+    import os
+
+    _algo_env = "CFK_REG_SOLVE_ALGO"
+    _saved_algo = os.environ.get(_algo_env)
+
+    def _restore_algo_env():
+        if _saved_algo is None:
+            os.environ.pop(_algo_env, None)
+        else:
+            os.environ[_algo_env] = _saved_algo
+
+    overrides = base_overrides or Overrides(lam=0.0)
+    step = step_fn if make_step is None else make_step(overrides)
+    probe = None
+    if health is not None:
+        import jax
+
+        probe = jax.jit(
+            lambda u, m: _sentinel.probe_word(u, m, health.norm_limit)
+        )
+    try:
+        return _run_loop_body(
+            manager=manager, num_iterations=num_iterations,
+            start_iter=start_iter, u=u, m=m, step=step,
+            make_step=make_step, overrides=overrides, policy=policy,
+            health=health, probe=probe, metrics=metrics,
+            checkpoint_every=checkpoint_every,
+            fault_injector=fault_injector, snapshot_fn=snapshot_fn,
+            restore_fn=restore_fn, save_fn=save_fn, state=state,
+            init_fn=init_fn,
+        )
+    finally:
+        _restore_algo_env()
+
+
+def _run_loop_body(
+    *, manager, num_iterations, start_iter, u, m, step, make_step,
+    overrides, policy, health, probe, metrics, checkpoint_every,
+    fault_injector, snapshot_fn, restore_fn, save_fn, state, init_fn,
+):
+    from cfk_tpu.transport.checkpoint import should_save
+
+    # Last-good rollback anchor: (iteration, host snapshot).  Updated only
+    # at validated save points, so a committed checkpoint and the anchor
+    # can never disagree about what "good" means; a trip before the first
+    # save point rolls back to a deterministic re-init.
+    good: tuple[int, tuple] | None = None
+    trips = 0
+    reports: list[_sentinel.HealthReport] = []
+
+    def rollback():
+        if good is not None:
+            it, (hu, hm) = good
+            return it, restore_fn(hu, hm)
+        return start_iter, _resume_or_init(state, restore_fn, init_fn)
+
+    i = start_iter
+    ring_pending = False  # ring-exchange flags seen since the last probe
+    while i < num_iterations:
+        if fault_injector is not None:
+            u, m = fault_injector.before_step(i, u, m)
+        with metrics.phase("train"):
+            out = step(u, m)
+            u, m, ring_bad = out if len(out) == 3 else (*out, None)
+            u.block_until_ready()
+        if ring_bad is not None:
+            # Accumulate EVERY step's exchange flag (a ready int32 scalar
+            # — block_until_ready already synced) so a corrupt in-flight
+            # block between probes still gets its RING_EXCHANGE
+            # attribution at the next probe, at any health cadence.
+            ring_pending = ring_pending or int(np.asarray(ring_bad)) > 0
+        metrics.incr("iterations")
+        done = i + 1
+        # With no checkpoint store there is no commit to protect, so the
+        # save cadence must not drive probes or snapshots — the health
+        # cadence alone does (checkpoint_every defaults to 1, which would
+        # otherwise silently force per-iteration probes + full host
+        # snapshots on every manager-less health run).
+        saving = manager is not None and should_save(
+            done, checkpoint_every, num_iterations
+        )
+        probing = health is not None and (
+            done % health.every == 0 or done == num_iterations or saving
+        )
+        word = 0
+        if probing:
+            # Save points force a probe so a bad state is never committed.
+            with metrics.phase("health_check"):
+                word = int(np.asarray(probe(u, m)))
+                if ring_pending:
+                    word |= _sentinel.RING_EXCHANGE
+            ring_pending = False
+            metrics.incr("health_checks")
+        if word:
+            trips += 1
+            report = _sentinel.HealthReport(
+                iteration=done, word=word, stats={}
+            )
+            reports.append(report)
+            metrics.incr("health_trips")
+            metrics.note(f"health_trip_{trips}", report.summary())
+            if trips > policy.max_recoveries:
+                msg = (
+                    f"health sentinel tripped {trips} times "
+                    f"(> max_recoveries={policy.max_recoveries}); last: "
+                    f"{report.summary()}"
+                )
+                if policy.on_unrecoverable == "raise":
+                    raise TrainingDivergedError(msg, reports)
+                anchor, (u, m) = rollback()
+                metrics.gauge("degraded", 1)
+                metrics.gauge("trained_iterations", anchor)
+                metrics.note(
+                    "degraded",
+                    f"{msg}; returning last-good factors from iteration "
+                    f"{anchor}",
+                )
+                warnings.warn(
+                    f"training degraded: {msg}; returning last-good "
+                    f"factors from iteration {anchor}"
+                )
+                return u, m
+            i, (u, m) = rollback()
+            metrics.incr("rollbacks")
+            new_overrides = policy.escalate(overrides, trips)
+            if new_overrides != overrides:
+                overrides = new_overrides
+                overrides.apply_env()
+                metrics.gauge("escalation_level", trips)
+                metrics.note(
+                    f"escalation_{trips}",
+                    f"lam={overrides.lam:g} fused="
+                    f"{overrides.fused_epilogue} "
+                    f"algo={overrides.reg_solve_algo}",
+                )
+                if make_step is not None:
+                    step = make_step(overrides)
+                else:
+                    warnings.warn(
+                        "escalation requested but this loop was built with "
+                        "a fixed step_fn; retrying with unchanged settings"
+                    )
+            continue
+        host_pair = None
+        if saving:
+            with metrics.phase("checkpoint"):
+                # save_fn returns the host copies it gathered so the
+                # rollback anchor below reuses them instead of paying
+                # a second device→host gather per save point.
+                host_pair = save_fn(done, u, m)
+            metrics.incr("checkpoints")
+        if health is not None and (saving or (manager is None and probing)):
+            # Rollback anchor: mirrors every validated commit; with no
+            # checkpoint store it follows the health cadence instead (the
+            # snapshot is only ever taken at a probed-healthy iteration).
+            good = (
+                done,
+                host_pair if host_pair is not None else snapshot_fn(u, m),
+            )
+        i = done
+    return u, m
+
+
+def _resume_or_init(state, restore_fn, init_fn):
+    """Rollback target when no save point has been reached yet: the
+    resumed checkpoint if the run started from one, else a deterministic
+    re-init (jax PRNG keys make init replay exact)."""
+    if state is not None:
+        return restore_fn(state.user_factors, state.movie_factors)
+    return init_fn()

@@ -16,11 +16,17 @@ import json
 import os
 import shutil
 import tempfile
+import warnings
+import zlib
 
 import numpy as np
 
 _MANIFEST = "manifest.json"
 _STEP_PREFIX = "step_"
+
+
+class CheckpointCorruptError(ValueError):
+    """An explicitly requested step failed integrity verification."""
 
 
 def resume_state(
@@ -45,7 +51,15 @@ def resume_state(
     """
     if manager is None or manager.latest_iteration() is None:
         return None
-    state = manager.restore()
+    try:
+        state = manager.restore()
+    except FileNotFoundError as e:
+        # Steps exist but none passed integrity verification (all torn/
+        # corrupted): starting fresh beats crashing resume — the warning
+        # from latest_valid_iteration() already named each bad step.
+        warnings.warn(f"no intact checkpoint to resume from ({e}); "
+                      "starting from scratch")
+        return None
     if state.user_factors.shape[-1] != rank:
         raise ValueError(
             f"checkpoint at iteration {state.iteration} has rank "
@@ -91,34 +105,27 @@ def checkpointed_train_loop(
     ``checkpoint_every`` iterations under ``metrics`` phases.  Factoring
     this out keeps save cadence / resume validation / metrics accounting
     identical across model families by construction (ADVICE r3).
-    """
-    import jax.numpy as jnp
 
-    state = resume_state(
-        manager, rank=rank, model=model, num_iterations=num_iterations,
-        u_shape=u_shape, m_shape=m_shape,
+    This is the health-off special case of
+    ``cfk_tpu.resilience.loop.resilient_train_loop`` (which adds sentinel
+    probes, rollback and escalation); it delegates there so there is
+    exactly one stepped loop.
+    """
+    from cfk_tpu.resilience.loop import resilient_train_loop
+
+    return resilient_train_loop(
+        manager,
+        model=model,
+        rank=rank,
+        num_iterations=num_iterations,
+        u_shape=u_shape,
+        m_shape=m_shape,
+        dtype=dtype,
+        init_fn=init_fn,
+        step_fn=step_fn,
+        metrics=metrics,
+        checkpoint_every=checkpoint_every,
     )
-    if state is not None:
-        start_iter = state.iteration
-        u = jnp.asarray(state.user_factors, dtype=dtype)
-        m = jnp.asarray(state.movie_factors, dtype=dtype)
-    else:
-        start_iter = 0
-        u, m = init_fn()
-    for i in range(start_iter, num_iterations):
-        with metrics.phase("train"):
-            u, m = step_fn(u, m)
-            u.block_until_ready()
-        metrics.incr("iterations")
-        done = i + 1
-        if should_save(done, checkpoint_every, num_iterations):
-            with metrics.phase("checkpoint"):
-                manager.save(
-                    done, np.asarray(u), np.asarray(m),
-                    meta={"rank": rank, "model": model},
-                )
-            metrics.incr("checkpoints")
-    return u, m
 
 
 def resume_state_synced(
@@ -207,6 +214,16 @@ def _check_shapes(state: "CheckpointState", u_shape, m_shape) -> None:
         )
 
 
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
 def should_save(done: int, every: int, total: int) -> bool:
     """Save cadence: every ``every`` completed iterations, and always at the end."""
     if every < 1:
@@ -262,6 +279,15 @@ class CheckpointManager:
                 "user_shape": list(u.shape),
                 "movie_shape": list(m.shape),
                 "dtype": stored_dtype,
+                # Content checksums of the npy payloads: the atomic rename
+                # makes half-written step dirs impossible, but not silent
+                # corruption *after* commit (torn page on power loss, bad
+                # sector, an operator's stray truncate) — restore verifies
+                # these and falls back to the previous complete step.
+                "crc32": {
+                    name: _crc32_file(os.path.join(tmp, name))
+                    for name in ("user.npy", "movie.npy")
+                },
                 **(meta or {}),
             }
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
@@ -289,11 +315,63 @@ class CheckpointManager:
         steps = self.iterations()
         return steps[-1] if steps else None
 
+    def verify(self, iteration: int) -> None:
+        """Integrity-check one committed step; raises
+        ``CheckpointCorruptError`` on a torn/corrupted payload.
+
+        The manifest must parse and, when it carries ``crc32`` checksums
+        (every checkpoint written since they were introduced), each npy
+        payload must match byte-for-byte.  Checksum-less legacy steps
+        pass with only the parse check.
+        """
+        step = self._step_dir(iteration)
+        try:
+            with open(os.path.join(step, _MANIFEST)) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint step {iteration} in {self.directory} has an "
+                f"unreadable manifest ({e}); the write was torn — delete "
+                f"{step} or restore an earlier step"
+            ) from None
+        for name, want in (manifest.get("crc32") or {}).items():
+            path = os.path.join(step, name)
+            try:
+                got = _crc32_file(path)
+            except OSError as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {iteration} is missing payload "
+                    f"{name!r} ({e})"
+                ) from None
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {iteration} payload {name!r} fails "
+                    f"its manifest checksum (crc32 {got:#010x} != recorded "
+                    f"{want:#010x}); the file is torn or corrupted — "
+                    f"delete {step} or restore an earlier step"
+                )
+
+    def latest_valid_iteration(self) -> int | None:
+        """Newest step that passes integrity verification; corrupt steps
+        are skipped (with a warning) in favor of older complete ones."""
+        for it in reversed(self.iterations()):
+            try:
+                self.verify(it)
+            except CheckpointCorruptError as e:
+                warnings.warn(f"skipping corrupt checkpoint: {e}")
+                continue
+            return it
+        return None
+
     def restore(self, iteration: int | None = None) -> CheckpointState:
         if iteration is None:
-            iteration = self.latest_iteration()
+            iteration = self.latest_valid_iteration()
             if iteration is None:
-                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+                raise FileNotFoundError(
+                    f"no intact checkpoints in {self.directory}"
+                )
+        else:
+            self.verify(iteration)
         step = self._step_dir(iteration)
         with open(os.path.join(step, _MANIFEST)) as f:
             manifest = json.load(f)
@@ -308,7 +386,8 @@ class CheckpointManager:
         meta = {
             k: v
             for k, v in manifest.items()
-            if k not in ("iteration", "user_shape", "movie_shape", "dtype")
+            if k not in ("iteration", "user_shape", "movie_shape", "dtype",
+                         "crc32")
         }
         return CheckpointState(
             iteration=manifest["iteration"], user_factors=u, movie_factors=m, meta=meta

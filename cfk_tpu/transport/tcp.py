@@ -52,12 +52,26 @@ class BrokerRequestError(RuntimeError):
     """The broker rejected a request (unknown topic, bad partition, ...)."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, timeouts: int = 0) -> bytes:
+    """Read exactly ``n`` bytes; with a socket read timeout set, tolerate
+    up to ``timeouts`` CONSECUTIVE timeout windows (a congested broker
+    delaying frames is a delay, not a death — the bytes already read stay
+    accumulated, and any received chunk resets the window count, so a
+    large response making steady slow progress never fails) before
+    letting the timeout escape."""
     chunks = []
+    waits = 0
     while n > 0:
-        chunk = sock.recv(n)
+        try:
+            chunk = sock.recv(n)
+        except TimeoutError:
+            waits += 1
+            if waits > timeouts:
+                raise
+            continue
         if not chunk:
             raise ConnectionError("broker closed the connection")
+        waits = 0
         chunks.append(chunk)
         n -= len(chunk)
     return b"".join(chunks)
@@ -68,6 +82,16 @@ class TcpBrokerClient:
 
     Not thread-safe (one in-flight request per connection); open one client
     per thread/process, like one Kafka producer per thread.
+
+    Connection setup retries with exponential backoff + jitter
+    (``cfk_tpu.resilience.retry``): each attempt dials under
+    ``connect_timeout`` and then PINGs, so a listener whose accept loop is
+    dead or dying (the half-up broker a fixed-interval poll hammers
+    forever) is detected and retried instead of wedging the first real
+    request.  ``read_timeout`` bounds every response read; up to
+    ``read_retries`` consecutive timeout windows are tolerated per read
+    (delayed frames — congestion — are waited out, a closed connection
+    still fails fast).
     """
 
     def __init__(
@@ -79,9 +103,40 @@ class TcpBrokerClient:
         batch_bytes: int = 1 << 20,
         fetch_records: int = 8192,
         fetch_bytes: int = 4 << 20,
+        connect_timeout: float = 5.0,
+        connect_retries: int = 3,
+        retry_base: float = 0.05,
+        read_timeout: float | None = None,
+        read_retries: int = 3,
     ) -> None:
-        self._sock = socket.create_connection((host, port))
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from cfk_tpu.resilience.retry import retry_call
+
+        def dial() -> socket.socket:
+            sock = socket.create_connection(
+                (host, port), timeout=connect_timeout
+            )
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # Liveness handshake: a PING proves the broker's serving
+                # loop (not just its accept backlog) is up — a dropped
+                # connection surfaces here, inside the retry, instead of
+                # poisoning the caller's first real request.
+                sock.sendall(struct.pack(">I", 1) + bytes([_OP_PING]))
+                (blen,) = struct.unpack(">I", _recv_exact(sock, 4))
+                _recv_exact(sock, blen)
+                return sock
+            except BaseException:
+                sock.close()
+                raise
+        self._sock = retry_call(
+            dial,
+            retries=connect_retries,
+            retry_on=(OSError,),
+            base=retry_base,
+            describe=f"connect to broker {host}:{port}",
+        )
+        self._sock.settimeout(read_timeout)
+        self._read_retries = read_retries
         self._batch_records = batch_records
         self._batch_bytes = batch_bytes
         self._fetch_records = fetch_records
@@ -94,9 +149,20 @@ class TcpBrokerClient:
     # -- request plumbing ---------------------------------------------------
 
     def _request(self, body: bytes) -> bytes:
-        self._sock.sendall(struct.pack(">I", len(body)) + body)
-        (blen,) = struct.unpack(">I", _recv_exact(self._sock, 4))
-        resp = _recv_exact(self._sock, blen)
+        # A timeout or transport error that escapes mid-frame leaves the
+        # stream desynced (a later read would parse leftover payload
+        # bytes as a length header) — the connection is unusable, so
+        # close it and fail every subsequent request loudly instead of
+        # silently mis-framing.
+        try:
+            self._sock.sendall(struct.pack(">I", len(body)) + body)
+            (blen,) = struct.unpack(
+                ">I", _recv_exact(self._sock, 4, self._read_retries)
+            )
+            resp = _recv_exact(self._sock, blen, self._read_retries)
+        except (TimeoutError, ConnectionError, OSError):
+            self._sock.close()
+            raise
         if resp[0] == 0:
             return resp[1:]
         (mlen,) = struct.unpack(">H", resp[1:3])
@@ -330,6 +396,12 @@ class BrokerProcess:
         # and select() cannot see data already inside a stdio buffer.
         import select
 
+        from cfk_tpu.resilience.retry import backoff_delays
+
+        # EOF-while-alive poll cadence: jittered exponential backoff
+        # instead of the old fixed 0.05 s spin — many workers waiting on
+        # one broker no longer wake in lockstep.
+        delays = backoff_delays(base=0.02, max_delay=0.25)
         deadline = time.monotonic() + timeout
         fd = self.proc.stdout.fileno()
         os.set_blocking(fd, False)
@@ -361,7 +433,7 @@ class BrokerProcess:
                 else:
                     # EOF while still alive: don't spin on the always-ready
                     # fd; the poll() check above reports the exit.
-                    time.sleep(0.05)
+                    time.sleep(min(next(delays), max(0.0, remaining)))
 
     def connect(self, **kwargs) -> TcpBrokerClient:
         return TcpBrokerClient("127.0.0.1", self.port, **kwargs)

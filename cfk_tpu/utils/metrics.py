@@ -23,12 +23,19 @@ class Metrics:
         self.counters: dict[str, float] = defaultdict(float)
         self.gauges: dict[str, float] = {}
         self.phases: dict[str, float] = defaultdict(float)
+        self.notes: dict[str, str] = {}
 
     def incr(self, name: str, value: float = 1.0) -> None:
         self.counters[name] += value
 
     def gauge(self, name: str, value: float) -> None:
         self.gauges[name] = value
+
+    def note(self, name: str, text: str) -> None:
+        """Free-text diagnostic (health-sentinel trip reasons, escalation
+        decisions, degradation notices) — the report channel the resilience
+        loop writes so a degraded run's output says *why*."""
+        self.notes[name] = text
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -40,11 +47,14 @@ class Metrics:
             self.phases[name] += time.perf_counter() - t0
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "counters": dict(self.counters),
             "gauges": dict(self.gauges),
             "phase_seconds": {k: round(v, 6) for k, v in self.phases.items()},
         }
+        if self.notes:
+            d["notes"] = dict(self.notes)
+        return d
 
     def json_line(self) -> str:
         return json.dumps(self.to_dict(), sort_keys=True)
@@ -57,6 +67,8 @@ class Metrics:
             parts.append(f"g.{k}={v:g}")
         for k, v in sorted(self.phases.items()):
             parts.append(f"t.{k}={v:.3f}s")
+        for k, v in sorted(self.notes.items()):
+            parts.append(f"n.{k}={v!r}")
         return " ".join(parts)
 
 

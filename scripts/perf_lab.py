@@ -121,6 +121,14 @@ def make_parser() -> argparse.ArgumentParser:
                    "double-buffered chunk/ring pipelines "
                    "(cfk_tpu.ops.pipeline), 'off' = the serial reference "
                    "schedule — same math, bit-identical factors")
+    p.add_argument("--health", default="off", choices=["on", "off"],
+                   help="health-sentinel A/B axis: 'on' folds the "
+                   "resilience probe (isfinite + norm watchdogs, "
+                   "cfk_tpu.resilience.sentinel) into the fori_loop "
+                   "carry every iteration (health_check_every=1, the "
+                   "worst case) — the s/iter delta vs 'off' is the "
+                   "sentinel's overhead, budgeted < 2%")
+    p.add_argument("--health-norm-limit", type=float, default=1e6)
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -226,8 +234,7 @@ def run_lab(args) -> dict:
         # Blocks are jit ARGUMENTS, not closure captures — capturing them
         # would bake 2.4 GB of constants into the executable and blow up
         # compile time (exactly what the real trainers avoid).
-        def body(_, carry):
-            u, m_prev = carry
+        def one(i, u, m_prev):
             if args.ials:
                 from cfk_tpu.models.ials import _ials_iteration_body
 
@@ -242,7 +249,29 @@ def run_lab(args) -> dict:
                 lam=0.05, solve_chunk=None, dt=jax.numpy.dtype(dt),
                 solver=args.solver, m_prev=m_prev, **layout_kw,
             )
-        return jax.lax.fori_loop(0, args.iters, body, (u, m))
+
+        if args.health == "off":
+            return jax.lax.fori_loop(
+                0, args.iters, lambda i, c: one(i, *c), (u, m)
+            )
+
+        # Health on: the in-carry sentinel exactly as the fused trainer
+        # loops run it — probe every iteration, word rides the carry.
+        from cfk_tpu.resilience import sentinel
+
+        def probed(i, carry):
+            u, m_prev, hw = carry
+            u2, m2 = one(i, u, m_prev)
+            hw = sentinel.fold_probe(
+                hw, i, u2, m2, every=1,
+                norm_limit=args.health_norm_limit, total=args.iters,
+            )
+            return u2, m2, hw
+
+        u, m, _hw = jax.lax.fori_loop(
+            0, args.iters, probed, (u, m, sentinel.carry_init())
+        )
+        return u, m
 
     steps_bound = functools.partial(steps, mblk=mblocks, ublk=ublocks)
 
@@ -280,7 +309,7 @@ def run_lab(args) -> dict:
         "chunk_elems": args.chunk_elems, "dtype": dt,
         "gram_backend": args.gram_backend, "rank": args.rank,
         "iters_per_call": args.iters, "overlap": args.overlap,
-        "fused": args.fused,
+        "fused": args.fused, "health": args.health,
     }
     print(json.dumps(row))
     return row

@@ -9,6 +9,11 @@
 # the same timeout the driver enforces, tees the log to /tmp/_t1.log, and
 # prints DOTS_PASSED=<count> (the driver's pass-count accounting) before
 # exiting with pytest's status.
+#
+# The fault-injection suite (tests/test_resilience.py + the flaky-broker
+# cases in tests/test_tcp_broker.py) is deliberately fast/non-slow, so it
+# runs here on every tier-1 pass — recovery is re-proved on every commit,
+# not just when someone remembers to run scripts/chaos_lab.py.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log

@@ -24,7 +24,8 @@ def _args(**over):
         solver="cholesky", dtype="float32", gram_backend=None,
         tiled_gram_backend=None, group_tiles=None, reg_solve_algo=None,
         ials=False, alpha=40.0, accum_chunk_elems=None, dense_stream=False,
-        overlap="on", fused="on", iters=2, repeats=3, profile_dir=None,
+        overlap="on", fused="on", health="off", health_norm_limit=1e6,
+        iters=2, repeats=3, profile_dir=None,
     )
     base.update(over)
     import argparse
@@ -105,3 +106,20 @@ def test_measure_steps_min_median_math(capsys):
     per_iter = [t / 3 for t in times]
     np.testing.assert_allclose(min(per_iter), 0.1)
     np.testing.assert_allclose(sorted(per_iter)[1], 0.2)  # the reported median
+
+
+def test_health_axis_row(tmp_path, monkeypatch):
+    import contextlib
+    import io
+
+    # the sentinel axis rides the same row contract (ISSUE 3: the
+    # --health {on,off} pair is how its overhead is recorded)
+    perf_lab.CACHE_ROOT, old = str(tmp_path), perf_lab.CACHE_ROOT
+    try:
+        with contextlib.redirect_stdout(io.StringIO()):
+            on = perf_lab.run_lab(_args(health="on"))
+            off = perf_lab.run_lab(_args(health="off"))
+    finally:
+        perf_lab.CACHE_ROOT = old
+    assert on["health"] == "on" and off["health"] == "off"
+    assert on["s_per_iter_min"] >= 0

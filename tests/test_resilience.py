@@ -1,0 +1,690 @@
+"""Self-healing training: the fault-injection proof suite.
+
+Every fault class of ``cfk_tpu.resilience.faults`` is injected
+deterministically and must be (1) DETECTED by the health sentinel,
+(2) RECOVERED by the rollback/escalation policy, and (3) leave the run
+converged to the fault-free final factors/RMSE within tolerance.  All
+tests are fast (tiny datasets, CPU backend) — tier-1 by construction.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import Dataset
+from cfk_tpu.data.synthetic import synthetic_netflix_coo
+from cfk_tpu.eval.metrics import mse_rmse_from_model
+from cfk_tpu.models.als import train_als
+from cfk_tpu.resilience import sentinel
+from cfk_tpu.resilience.faults import (
+    FactorCorruption,
+    FaultInjector,
+    SingularChunk,
+    TornCheckpointManager,
+    blockstructured_coo,
+)
+from cfk_tpu.resilience.policy import (
+    Overrides,
+    RecoveryPolicy,
+    TrainingDivergedError,
+)
+from cfk_tpu.transport.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+)
+from cfk_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(scope="module")
+def small_dataset():
+    return Dataset.from_coo(synthetic_netflix_coo(40, 25, 500, seed=0))
+
+
+def assert_close(a, b):
+    """Cross-program factor equality: the fused fori_loop, the stepped
+    loop, and the health-probed variants are different XLA programs, so
+    allow fusion-order noise while still pinning recovery to the
+    fault-free trajectory."""
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def _quiet_train(*a, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return train_als(*a, **kw)
+
+
+# --- sentinel unit --------------------------------------------------------
+
+
+def test_probe_word_bits():
+    import jax.numpy as jnp
+
+    u = jnp.ones((4, 3))
+    m = jnp.ones((5, 3))
+    assert int(sentinel.probe_word(u, m, 1e6)) == 0
+    assert int(sentinel.probe_word(u.at[1, 2].set(np.nan), m, 1e6)) == (
+        sentinel.NONFINITE_U
+    )
+    assert int(sentinel.probe_word(u, m.at[0, 0].set(np.inf), 1e6)) & (
+        sentinel.NONFINITE_M
+    )
+    # finite but over the norm watchdog
+    w = int(sentinel.probe_word(u * 100.0, m, 10.0))
+    assert w == sentinel.NORM_U
+    assert sentinel.describe_word(w) == ["user_norm_watchdog"]
+
+
+def test_fold_probe_records_first_bad_iteration():
+    import jax.numpy as jnp
+
+    hw = sentinel.carry_init()
+    u, m = jnp.ones((3, 2)), jnp.ones((3, 2))
+    hw = sentinel.fold_probe(hw, 0, u, m, every=1, norm_limit=1e6)
+    assert int(hw[0]) == -1
+    bad_u = u.at[0, 0].set(np.nan)
+    hw = sentinel.fold_probe(hw, 1, bad_u, m, every=1, norm_limit=1e6)
+    assert (int(hw[0]), int(hw[1])) == (1, sentinel.NONFINITE_U)
+    # later probes never overwrite the first trip
+    hw = sentinel.fold_probe(hw, 2, u, m, every=1, norm_limit=1e6)
+    assert (int(hw[0]), int(hw[1])) == (1, sentinel.NONFINITE_U)
+    # off-cadence iterations are skipped entirely
+    hw2 = sentinel.fold_probe(
+        sentinel.carry_init(), 0, bad_u, m, every=2, norm_limit=1e6
+    )
+    assert int(hw2[0]) == -1
+
+
+# --- config validation ----------------------------------------------------
+
+
+def test_health_config_validation():
+    with pytest.raises(ValueError, match="health_check_every"):
+        ALSConfig(health_check_every=0)
+    with pytest.raises(ValueError, match="health_norm_limit"):
+        ALSConfig(health_norm_limit=0.0)
+    with pytest.raises(ValueError, match="lam_escalation"):
+        ALSConfig(lam_escalation=1.0)
+    with pytest.raises(ValueError, match="max_recoveries"):
+        ALSConfig(max_recoveries=-1)
+    with pytest.raises(ValueError, match="on_unrecoverable"):
+        ALSConfig(on_unrecoverable="explode")
+    assert ALSConfig(health_check_every=3).health_check_every == 3
+
+
+def test_checkpoint_every_validated_at_trainer_entry(small_dataset, tmp_path):
+    cfg = ALSConfig(rank=3, num_iterations=2)
+    with pytest.raises(ValueError, match="checkpoint_every must be >= 1"):
+        train_als(
+            small_dataset, cfg,
+            checkpoint_manager=CheckpointManager(str(tmp_path)),
+            checkpoint_every=0,
+        )
+
+
+def test_escalation_ladder():
+    pol = RecoveryPolicy(lam_factor=10.0)
+    ov = Overrides(lam=0.05)
+    assert pol.escalate(ov, 1) == ov  # plain retry
+    ov2 = pol.escalate(ov, 2)
+    assert ov2.lam == pytest.approx(0.5)
+    ov3 = pol.escalate(ov2, 3)
+    assert ov3.fused_epilogue is False and ov3.lam == pytest.approx(0.5)
+    ov4 = pol.escalate(ov3, 4)
+    assert ov4.reg_solve_algo == "gj" and ov4.lam == pytest.approx(5.0)
+    # λ=0 bumps from the floor, not 0×factor=0
+    assert pol.escalate(Overrides(lam=0.0), 2).lam == pol.lam_floor
+
+
+# --- factor-corruption faults ---------------------------------------------
+
+
+def test_nan_fault_detected_and_recovered_bitexact(small_dataset):
+    cfg = ALSConfig(rank=3, num_iterations=5, health_check_every=1)
+    base = train_als(small_dataset, cfg)
+    bu, bm = base.host_factors()
+
+    inj = FaultInjector(FactorCorruption(iteration=2, side="u"))
+    metrics = Metrics()
+    rec = _quiet_train(
+        small_dataset, cfg, metrics=metrics, fault_injector=inj
+    )
+    ru, rm = rec.host_factors()
+    assert inj.fired == 1
+    assert metrics.counters["health_trips"] == 1
+    assert metrics.counters["rollbacks"] == 1
+    # one-shot corruption + deterministic replay → bit-exact recovery
+    assert_close(bu, ru)
+    assert_close(bm, rm)
+
+
+def test_inf_fault_rolls_back_to_checkpoint(small_dataset, tmp_path):
+    cfg = ALSConfig(rank=3, num_iterations=5, health_check_every=1)
+    base = train_als(small_dataset, cfg)
+    bu, bm = base.host_factors()
+
+    inj = FaultInjector(
+        FactorCorruption(iteration=3, side="u", value=float("inf"))
+    )
+    metrics = Metrics()
+    rec = _quiet_train(
+        small_dataset, cfg,
+        checkpoint_manager=CheckpointManager(str(tmp_path)),
+        fault_injector=inj, metrics=metrics,
+    )
+    ru, rm = rec.host_factors()
+    assert metrics.counters["health_trips"] == 1
+    assert metrics.counters["checkpoints"] >= 5
+    assert_close(bu, ru)
+    assert_close(bm, rm)
+    # the committed latest checkpoint is the healthy final state
+    state = CheckpointManager(str(tmp_path)).restore()
+    assert state.iteration == 5
+    assert np.isfinite(state.movie_factors).all()
+
+
+def test_persistent_fault_exhausts_and_degrades(small_dataset):
+    cfg = ALSConfig(
+        rank=3, num_iterations=5, health_check_every=1, max_recoveries=2
+    )
+    # fires on EVERY pass through iteration 1 — unfixable by escalation
+    inj = FaultInjector(
+        FactorCorruption(iteration=1, side="u", persistent=True)
+    )
+    metrics = Metrics()
+    rec = _quiet_train(
+        small_dataset, cfg, metrics=metrics, fault_injector=inj
+    )
+    assert metrics.gauges["degraded"] == 1
+    assert metrics.counters["health_trips"] == 3  # max_recoveries + 1
+    assert any(k.startswith("health_trip") for k in metrics.notes)
+    # last-good factors are finite (never the corrupted state)
+    ru, rm = rec.host_factors()
+    assert np.isfinite(ru).all() and np.isfinite(rm).all()
+
+
+def test_persistent_fault_raises_when_configured(small_dataset):
+    cfg = ALSConfig(
+        rank=3, num_iterations=5, health_check_every=1, max_recoveries=1,
+        on_unrecoverable="raise",
+    )
+    inj = FaultInjector(
+        FactorCorruption(iteration=1, side="u", persistent=True)
+    )
+    with pytest.raises(TrainingDivergedError) as ei:
+        _quiet_train(small_dataset, cfg, fault_injector=inj)
+    assert ei.value.reports  # the diagnostic report rides the exception
+
+
+# --- singular normal equations --------------------------------------------
+
+
+def test_singular_chunk_recovers_via_lambda_escalation():
+    ds = Dataset.from_coo(blockstructured_coo(seed=0))
+    cfg = ALSConfig(
+        rank=3, num_iterations=6, lam=0.0, health_check_every=1
+    )
+    base = train_als(ds, cfg, metrics=(m0 := Metrics()))
+    assert "health_trips" not in m0.counters  # λ=0 fault-free run is clean
+    _, base_rmse = mse_rmse_from_model(base, ds)
+
+    # zero the isolated raters' factor rows every pass through iteration 2:
+    # the isolated movies' A = Σ f·fᵀ is exactly singular at λ=0, so the
+    # solve emits non-finite factors until the ladder bumps λ off zero.
+    inj = FaultInjector(
+        SingularChunk(iteration=2, side="u", rows=(0, 8), persistent=True)
+    )
+    metrics = Metrics()
+    rec = _quiet_train(ds, cfg, metrics=metrics, fault_injector=inj)
+    assert metrics.counters["health_trips"] >= 2  # retry alone cannot fix it
+    assert metrics.gauges["escalation_level"] >= 2  # λ got bumped
+    _, rec_rmse = mse_rmse_from_model(rec, ds)
+    ru, rm = rec.host_factors()
+    assert np.isfinite(ru).all() and np.isfinite(rm).all()
+    # recovered run converges to the fault-free quality (λ floor is 1e-4,
+    # and only one iteration saw zeroed rows before re-deriving them)
+    assert abs(rec_rmse - base_rmse) < 0.15 * max(base_rmse, 1e-9)
+
+
+def test_fused_loop_in_carry_trip_replays_and_recovers():
+    # λ=0 on power-law synthetic data is NATURALLY singular (low-degree
+    # entities), so the fused fori_loop's in-carry probe trips with no
+    # injector at all; the trainer must replay through the stepped loop
+    # and escalate λ until the run completes finite.
+    ds = Dataset.from_coo(synthetic_netflix_coo(40, 25, 300, seed=1))
+    cfg = ALSConfig(rank=5, num_iterations=4, lam=0.0, health_check_every=1)
+    metrics = Metrics()
+    with pytest.warns(UserWarning, match="fused training loop"):
+        model = train_als(ds, cfg, metrics=metrics)
+    assert metrics.counters["health_trips"] >= 1
+    assert "fused_loop_trip" in metrics.notes
+    u, m = model.host_factors()
+    assert np.isfinite(u).all() and np.isfinite(m).all()
+
+
+def test_norm_watchdog_trips_before_overflow(small_dataset):
+    cfg = ALSConfig(
+        rank=3, num_iterations=3, health_check_every=1,
+        health_norm_limit=1e-3, max_recoveries=0, on_unrecoverable="raise",
+    )
+    with pytest.raises(TrainingDivergedError) as ei:
+        _quiet_train(
+            small_dataset, cfg,
+            fault_injector=FaultInjector(),  # stepped loop, no faults
+        )
+    assert "norm_watchdog" in str(ei.value.reports[0].reasons)
+
+
+# --- health off == unchanged behavior -------------------------------------
+
+
+def test_health_on_matches_health_off_bitexact(small_dataset, tmp_path):
+    base = train_als(
+        small_dataset, ALSConfig(rank=3, num_iterations=4)
+    ).host_factors()
+    checked = train_als(
+        small_dataset,
+        ALSConfig(rank=3, num_iterations=4, health_check_every=2),
+    ).host_factors()
+    stepped = train_als(
+        small_dataset,
+        ALSConfig(rank=3, num_iterations=4, health_check_every=1),
+        checkpoint_manager=CheckpointManager(str(tmp_path)),
+    ).host_factors()
+    np.testing.assert_array_equal(base[0], checked[0])
+    np.testing.assert_array_equal(base[1], checked[1])
+    assert_close(base[0], stepped[0])
+    assert_close(base[1], stepped[1])
+
+
+# --- iALS ------------------------------------------------------------------
+
+
+def test_ials_nan_fault_recovers(small_dataset):
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+
+    cfg = IALSConfig(rank=3, num_iterations=4, health_check_every=1)
+    base = train_ials(small_dataset, cfg).host_factors()
+    inj = FaultInjector(FactorCorruption(iteration=1, side="u"))
+    metrics = Metrics()
+    rec = _quiet_train_ials(small_dataset, cfg, metrics, inj)
+    assert metrics.counters["health_trips"] == 1
+    assert_close(base[0], rec[0])
+    assert_close(base[1], rec[1])
+
+
+def _quiet_train_ials(ds, cfg, metrics, inj):
+    from cfk_tpu.models.ials import train_ials
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return train_ials(
+            ds, cfg, metrics=metrics, fault_injector=inj
+        ).host_factors()
+
+
+# --- sharded / ring -------------------------------------------------------
+
+
+def test_sharded_ring_fault_detected_and_recovered(tmp_path):
+    import jax
+
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import train_als_sharded
+
+    coo = synthetic_netflix_coo(48, 24, 500, seed=0)
+    ds = Dataset.from_coo(coo, num_shards=2)
+    cfg = ALSConfig(
+        rank=3, num_iterations=4, num_shards=2, exchange="ring",
+        health_check_every=1,
+    )
+    mesh = make_mesh(2)
+    base = train_als_sharded(ds, cfg, mesh).host_factors()
+
+    inj = FaultInjector(
+        FactorCorruption(iteration=2, side="u", value=float("inf"))
+    )
+    metrics = Metrics()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rec = train_als_sharded(
+            ds, cfg, mesh,
+            checkpoint_manager=CheckpointManager(str(tmp_path)),
+            metrics=metrics, fault_injector=inj,
+        ).host_factors()
+    assert metrics.counters["health_trips"] == 1
+    assert metrics.counters["rollbacks"] == 1
+    assert_close(base[0], rec[0])
+    assert_close(base[1], rec[1])
+
+
+def test_ring_carry_probe_flags_corrupt_exchange():
+    """The ring half-steps' in-carry probe sees a non-finite factor block
+    in flight (RING_EXCHANGE reason), not just the solved output."""
+    import jax
+    import jax.numpy as jnp
+
+    from cfk_tpu.parallel.mesh import make_mesh, shard_rows
+    from cfk_tpu.parallel.spmd import (
+        _padded_to_tree,
+        _ring_to_tree,
+        make_training_step,
+        tree_specs,
+    )
+    from cfk_tpu.data.blocks import build_ring_blocks
+
+    coo = synthetic_netflix_coo(48, 24, 500, seed=0)
+    ds = Dataset.from_coo(coo, num_shards=2)
+    cfg = ALSConfig(
+        rank=3, num_iterations=1, num_shards=2, exchange="ring",
+        health_check_every=1,
+    )
+    mesh = make_mesh(2)
+    d = ds.coo_dense
+    mtree = _ring_to_tree(build_ring_blocks(
+        d.movie_raw, d.user_raw, d.rating,
+        ds.movie_map.num_entities, ds.user_map.num_entities,
+        num_shards=2, pad_multiple=cfg.pad_multiple,
+    ))
+    utree = _ring_to_tree(build_ring_blocks(
+        d.user_raw, d.movie_raw, d.rating,
+        ds.user_map.num_entities, ds.movie_map.num_entities,
+        num_shards=2, pad_multiple=cfg.pad_multiple,
+    ))
+    mtree = shard_rows(mesh, mtree)
+    utree = shard_rows(mesh, utree)
+    step = jax.jit(make_training_step(
+        mesh, cfg, tree_specs(mtree), tree_specs(utree), health_probe=True
+    ))
+    e_u = ds.user_blocks.padded_entities
+    e_m = ds.movie_blocks.padded_entities
+    u0 = shard_rows(mesh, np.ones((e_u, 3), np.float32))
+    m0 = shard_rows(mesh, np.zeros((e_m, 3), np.float32))
+    u, m, bad = step(u0, m0, mtree, utree)
+    assert int(bad) == 0
+    u_bad = np.ones((e_u, 3), np.float32)
+    u_bad[0, 0] = np.nan
+    _, _, bad = step(shard_rows(mesh, u_bad), m0, mtree, utree)
+    assert int(bad) > 0
+
+
+# --- torn checkpoints / crc32 ---------------------------------------------
+
+
+def test_torn_checkpoint_skipped_on_resume(small_dataset, tmp_path):
+    cfg = ALSConfig(rank=3, num_iterations=4)
+    straight = train_als(small_dataset, cfg).host_factors()
+
+    # train to completion; the step-3 write is torn after commit
+    torn = TornCheckpointManager(
+        CheckpointManager(str(tmp_path)), tear_at=4, mode="truncate"
+    )
+    train_als(small_dataset, cfg, checkpoint_manager=torn)
+    assert torn.torn
+
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        state = mgr.restore()
+    assert state.iteration == 3  # fell back past the torn step 4
+    # resuming retrains 4 and lands exactly on the uninterrupted run
+    resumed = train_als(
+        small_dataset, cfg, checkpoint_manager=mgr
+    ).host_factors()
+    assert_close(straight[0], resumed[0])
+    assert_close(straight[1], resumed[1])
+
+
+@pytest.mark.parametrize("mode", ["scramble", "manifest"])
+def test_corrupt_step_verification(small_dataset, tmp_path, mode):
+    torn = TornCheckpointManager(
+        CheckpointManager(str(tmp_path)), tear_at=2, mode=mode
+    )
+    train_als(
+        small_dataset, ALSConfig(rank=3, num_iterations=3),
+        checkpoint_manager=torn,
+    )
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(CheckpointCorruptError):
+        mgr.verify(2)
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore(2)  # explicit restore of a corrupt step fails loudly
+    assert mgr.latest_valid_iteration() == 3  # newest intact step wins
+
+
+def test_all_checkpoints_corrupt_resumes_fresh(tmp_path):
+    from cfk_tpu.transport.checkpoint import resume_state
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, np.ones((4, 3), np.float32), np.ones((5, 3), np.float32))
+    with open(os.path.join(mgr._step_dir(1), "user.npy"), "wb") as f:
+        f.write(b"x")
+    with pytest.warns(UserWarning):
+        state = resume_state(mgr, rank=3, model="als", num_iterations=5)
+    assert state is None  # fresh start beats crashing resume
+
+
+def test_legacy_manifest_without_crc_still_restores(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    path = mgr.save(
+        2, np.ones((4, 3), np.float32), np.ones((5, 3), np.float32)
+    )
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    del manifest["crc32"]
+    json.dump(manifest, open(os.path.join(path, "manifest.json"), "w"))
+    state = mgr.restore()
+    assert state.iteration == 2
+
+
+def test_resume_state_shape_mismatch_rejected(tmp_path):
+    from cfk_tpu.transport.checkpoint import resume_state
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, np.ones((4, 3), np.float32), np.ones((5, 3), np.float32))
+    with pytest.raises(ValueError, match="factor shapes"):
+        resume_state(
+            mgr, rank=3, model="als", num_iterations=5,
+            u_shape=(8, 3), m_shape=(5, 3),
+        )
+
+
+# --- retry / backoff -------------------------------------------------------
+
+
+def test_backoff_delays_deterministic_and_capped():
+    import itertools
+    import random
+
+    from cfk_tpu.resilience.retry import backoff_delays
+
+    a = list(itertools.islice(
+        backoff_delays(base=0.1, max_delay=1.0, rng=random.Random(7)), 8
+    ))
+    b = list(itertools.islice(
+        backoff_delays(base=0.1, max_delay=1.0, rng=random.Random(7)), 8
+    ))
+    assert a == b  # seeded → deterministic
+    assert all(d <= 1.5 for d in a)  # cap × (1 + jitter)
+    nojit = list(itertools.islice(
+        backoff_delays(base=0.1, max_delay=1.0, jitter=0.0), 6
+    ))
+    assert nojit == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+
+
+def test_retry_call_retries_then_raises():
+    from cfk_tpu.resilience.retry import retry_call
+
+    calls = []
+    sleeps = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("nope")
+        return "ok"
+
+    assert retry_call(
+        flaky, retries=3, base=0.01, sleep=sleeps.append
+    ) == "ok"
+    assert len(calls) == 3 and len(sleeps) == 2
+
+    with pytest.raises(ConnectionRefusedError, match="after 2 attempts"):
+        retry_call(
+            lambda: (_ for _ in ()).throw(ConnectionRefusedError("down")),
+            retries=1, base=0.01, sleep=lambda s: None,
+        )
+
+
+def test_degraded_run_leaves_healthy_checkpoints_for_resume(
+    small_dataset, tmp_path
+):
+    """The production degrade story end-to-end: a persistent fault
+    exhausts recovery, the run returns last-good factors, every committed
+    checkpoint is healthy, and a later fault-free run resumes from the
+    last good step and lands on the uninterrupted trajectory."""
+    cfg = ALSConfig(
+        rank=3, num_iterations=6, health_check_every=1, max_recoveries=1
+    )
+    inj = FaultInjector(
+        FactorCorruption(iteration=4, side="u", persistent=True)
+    )
+    metrics = Metrics()
+    _quiet_train(
+        small_dataset, cfg,
+        checkpoint_manager=CheckpointManager(str(tmp_path)),
+        fault_injector=inj, metrics=metrics,
+    )
+    assert metrics.gauges["degraded"] == 1
+    assert metrics.gauges["trained_iterations"] == 4
+    state = CheckpointManager(str(tmp_path)).restore()
+    assert state.iteration == 4
+    assert np.isfinite(state.user_factors).all()
+    resumed = train_als(
+        small_dataset, cfg,
+        checkpoint_manager=CheckpointManager(str(tmp_path)),
+    ).host_factors()
+    base = train_als(
+        small_dataset, ALSConfig(rank=3, num_iterations=6)
+    ).host_factors()
+    assert_close(base[0], resumed[0])
+    assert_close(base[1], resumed[1])
+
+
+def test_escalation_skips_noop_split_rung_when_already_split():
+    # fused_epilogue already pinned False: rung 3 must not burn a bounded
+    # retry on an identical replay — it jumps straight to the GJ rung.
+    pol = RecoveryPolicy(lam_factor=10.0)
+    ov = Overrides(lam=0.05, fused_epilogue=False)
+    ov3 = pol.escalate(pol.escalate(ov, 2), 3)
+    assert ov3.reg_solve_algo == "gj"
+    assert ov3.lam == pytest.approx(5.0)
+
+
+def test_gj_escalation_env_var_restored_after_run(small_dataset):
+    # the GJ rung rides CFK_REG_SOLVE_ALGO; one escalated run must not
+    # contaminate later trainings in the same process.
+    assert os.environ.get("CFK_REG_SOLVE_ALGO") is None
+    cfg = ALSConfig(
+        rank=3, num_iterations=4, health_check_every=1, max_recoveries=5
+    )
+    inj = FaultInjector(
+        FactorCorruption(iteration=1, side="u", persistent=True)
+    )
+    metrics = Metrics()
+    _quiet_train(small_dataset, cfg, metrics=metrics, fault_injector=inj)
+    assert metrics.counters["health_trips"] >= 4  # reached the GJ rung
+    assert os.environ.get("CFK_REG_SOLVE_ALGO") is None  # restored
+
+
+def test_recv_exact_timeout_windows_are_consecutive():
+    from cfk_tpu.transport.tcp import _recv_exact
+
+    class Sock:
+        def __init__(self, script):
+            self.script = list(script)  # bytes to yield, or "t" = timeout
+
+        def recv(self, n):
+            ev = self.script.pop(0)
+            if ev == "t":
+                raise TimeoutError("timed out")
+            return ev[:n]
+
+    # steady slow progress: one timeout before every chunk, far more
+    # total timeouts than the per-read budget — must still succeed
+    # because any received chunk resets the window count
+    script = []
+    for _ in range(6):
+        script += ["t", b"x"]
+    assert _recv_exact(Sock(script), 6, timeouts=1) == b"xxxxxx"
+    # but consecutive timeouts over budget escape
+    with pytest.raises(TimeoutError):
+        _recv_exact(Sock(["t", "t", b"x"]), 1, timeouts=1)
+
+
+def test_request_poisons_connection_after_escaped_timeout(monkeypatch):
+    # a timeout escaping mid-frame desyncs the stream; the client must
+    # close the socket so later requests fail loudly, never mis-frame
+    from cfk_tpu.transport import tcp as tcp_mod
+
+    class DeadSock:
+        closed = False
+
+        def sendall(self, b):
+            pass
+
+        def recv(self, n):
+            raise TimeoutError("stalled broker")
+
+        def close(self):
+            self.closed = True
+
+    client = tcp_mod.TcpBrokerClient.__new__(tcp_mod.TcpBrokerClient)
+    client._sock = DeadSock()
+    client._read_retries = 0
+    with pytest.raises(TimeoutError):
+        client._request(b"\x07")
+    assert client._sock.closed
+
+
+def test_fold_probe_always_probes_final_iteration():
+    # num_iterations not a multiple of the cadence: the state that is
+    # RETURNED must never dodge the sentinel
+    import jax.numpy as jnp
+
+    u = jnp.ones((3, 2))
+    bad = u.at[0, 0].set(np.nan)
+    hw = sentinel.fold_probe(
+        sentinel.carry_init(), 4, u, bad, every=4, norm_limit=1e6, total=5
+    )
+    assert (int(hw[0]), int(hw[1])) == (4, sentinel.NONFINITE_M)
+
+
+def test_managerless_probe_follows_health_cadence(small_dataset):
+    # with no checkpoint store, checkpoint_every (default 1) must not
+    # force per-iteration probes/snapshots — the health cadence rules
+    cfg = ALSConfig(rank=3, num_iterations=5, health_check_every=2)
+    metrics = Metrics()
+    _quiet_train(
+        small_dataset, cfg, metrics=metrics, fault_injector=FaultInjector()
+    )
+    # probes at iterations 2, 4 and the forced final one at 5
+    assert metrics.counters["health_checks"] == 3
+
+
+def test_fused_trip_accounting_not_double_counted():
+    # the discarded fused attempt's time moves to train_discarded and its
+    # iterations are not counted toward the headline counter
+    ds = Dataset.from_coo(synthetic_netflix_coo(40, 25, 300, seed=1))
+    cfg = ALSConfig(rank=5, num_iterations=4, lam=0.0, health_check_every=1)
+    metrics = Metrics()
+    with pytest.warns(UserWarning, match="fused training loop"):
+        train_als(ds, cfg, metrics=metrics)
+    assert metrics.phases["train_discarded"] > 0
+    # only the stepped replay's executed iterations are counted (the
+    # replay includes rollback re-runs, so >= num_iterations, but the
+    # fused attempt's 4 are gone: strictly fewer than fused+replay)
+    assert metrics.counters["iterations"] >= cfg.num_iterations
+    assert metrics.counters["health_trips"] >= 1

@@ -441,3 +441,59 @@ def test_cli_topics_admin(server, capsys):
     assert "adm" not in capsys.readouterr().out
     # create without a topic segment is a clean one-line error
     assert main(["topics", "create", "--broker", base]) == 1
+
+
+# --- fault injection: flaky connections / delayed frames -------------------
+# (cfk_tpu.resilience.faults.FlakyBrokerProxy; ISSUE 3 chaos harness)
+
+
+def test_connect_retry_survives_dropped_connections(server):
+    from cfk_tpu.resilience.faults import FlakyBrokerProxy, FlakyPlan
+    from cfk_tpu.transport.tcp import TcpBrokerClient
+
+    plan = FlakyPlan(drop_first_connects=2)
+    with FlakyBrokerProxy(server.port, plan) as proxy:
+        with TcpBrokerClient(
+            "127.0.0.1", proxy.port, connect_retries=4, retry_base=0.01
+        ) as c:
+            c.create_topic("t-flaky", 2)
+            c.produce("t-flaky", key=0, value=b"survived")
+            assert [r.value for r in c.consume("t-flaky", 0)] == [b"survived"]
+            c.delete_topic("t-flaky")
+        assert proxy.dropped == 2  # the fault really fired
+
+
+def test_delayed_frames_waited_out_by_read_retries(server):
+    from cfk_tpu.resilience.faults import FlakyBrokerProxy, FlakyPlan
+    from cfk_tpu.transport.tcp import TcpBrokerClient
+
+    plan = FlakyPlan(delay_frames=3, frame_delay=0.12)
+    with FlakyBrokerProxy(server.port, plan) as proxy:
+        with TcpBrokerClient(
+            "127.0.0.1", proxy.port,
+            read_timeout=0.05, read_retries=20,
+        ) as c:
+            c.ping()
+            c.create_topic("t-slow", 1)
+            c.produce("t-slow", key=0, value=b"late but intact")
+            assert [r.value for r in c.consume("t-slow", 0)] == [
+                b"late but intact"
+            ]
+            c.delete_topic("t-slow")
+        assert proxy.delayed >= 1
+
+
+def test_connect_gives_up_after_bounded_retries():
+    import socket
+
+    from cfk_tpu.transport.tcp import TcpBrokerClient
+
+    # a bound-but-not-listening port refuses instantly
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    with pytest.raises(OSError, match="after 2 attempts"):
+        TcpBrokerClient(
+            "127.0.0.1", port, connect_retries=1, retry_base=0.01
+        )
