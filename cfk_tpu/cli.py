@@ -351,6 +351,11 @@ def _train(args) -> int:
         num_shards=args.shards,
         exchange=args.exchange,
         overlap=not args.no_overlap,
+        in_kernel_gather=(
+            None if args.in_kernel_gather == "auto"
+            else args.in_kernel_gather == "on"
+        ),
+        reg_solve_algo=args.reg_solve_algo,
         async_collective_permute=args.async_collective_permute,
         dtype=args.dtype,
         solver=args.solver,
@@ -860,6 +865,25 @@ def build_parser() -> argparse.ArgumentParser:
         "default double-buffered pipelines (A/B measurement; factors are "
         "bit-identical either way — see ARCHITECTURE.md 'Exchange/compute "
         "overlap')",
+    )
+    t.add_argument(
+        "--in-kernel-gather", choices=["auto", "on", "off"], default="auto",
+        help="fuse the per-chunk neighbor-factor gather into the pallas "
+        "Gram kernels (rows DMA'd straight from the HBM-resident factor "
+        "table into the kernel's VMEM double buffer — the materialized "
+        "[C, k] gathered stream disappears).  'auto' (default) gathers "
+        "in-kernel wherever the kernels' SMEM/alignment gates allow, "
+        "falling back to the XLA-gather schedule otherwise; 'off' pins "
+        "the XLA gather (A/B measurement; factors are bit-identical "
+        "either way — see ARCHITECTURE.md 'In-kernel neighbor gather')",
+    )
+    t.add_argument(
+        "--reg-solve-algo", choices=["auto", "lu", "gj"], default="auto",
+        help="elimination algorithm of the fused reg+solve kernels: "
+        "reverse no-pivot LU (rank cap 128) or Gauss-Jordan (cap 64); "
+        "'auto' keeps the process default (lu).  Threaded as a real "
+        "config parameter — the recovery ladder's GJ rung overrides it "
+        "per-step",
     )
     t.add_argument(
         "--async-collective-permute", choices=["auto", "on", "off"],

@@ -98,6 +98,41 @@ def to_varying(x, axis):
     return x
 
 
+def emulate_in_kernel_gather(table, nb, wt, ct):
+    """XLA twin of the gather-fused Gram kernels' in-kernel row fetch —
+    the interpret/old-jax route, so CPU CI exercises the same code shape
+    the Mosaic DMA gather runs.
+
+    The Mosaic kernels (``ops.pallas.gram_kernel`` ``*_gather_pallas``)
+    keep the RAW fixed table in HBM/ANY memory, DMA each tile's indexed
+    rows into VMEM (indices clamped to the last real row), and apply the
+    per-entry premultiply ``wt`` in-register — ``wt`` is the 0/1 validity
+    mask for unit-weight callers (which is what realizes the zero-appended
+    padding row without materializing it) or √aw·mask for the weighted
+    (iALS) stream.  This twin runs the numerically identical ops the
+    XLA-gather path runs: append the zero row, gather, cast to the
+    compute dtype, multiply — so fused-gather and XLA-gather factors are
+    BIT-IDENTICAL on this route (``tests/test_in_kernel_gather.py`` pins
+    it).  Index convention: ``nb == table.shape[0]`` is the virtual zero
+    row; larger indices are invalid.
+    """
+    import jax.numpy as jnp
+
+    k = table.shape[-1]
+    zrow = jnp.zeros((1, k), table.dtype)
+    try:  # mark the zero row varying like the table under shard_map
+        vma = jax.typeof(table).vma
+    except (AttributeError, TypeError):
+        vma = None
+    if vma:
+        zrow = to_varying(zrow, tuple(vma))
+    fz = jnp.concatenate([table, zrow])
+    g = fz[nb].astype(ct)
+    if wt is not None:
+        g = g * wt.astype(ct)[:, None]
+    return g
+
+
 def emulate_fused_gram_solve(a, b, reg, *, reg_mode, lam, lseg):
     """XLA twin of the fused Gram+solve epilogue — the interpret/old-jax
     route, so CPU CI exercises the same code shape the Mosaic kernel runs.

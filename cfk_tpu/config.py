@@ -160,6 +160,30 @@ class ALSConfig:
     # padded half-steps, whose solves follow the process default
     # (ops.solve.default_fused_epilogue) only.
     fused_epilogue: bool | None = None
+    # In-kernel neighbor gather: fuse the per-chunk neighbor-factor gather
+    # into the pallas Gram kernels — the fixed factor table stays in
+    # HBM/ANY memory and the kernel DMAs each tile's indexed rows straight
+    # into its VMEM double buffer, with the weighted (√aw) premultiply and
+    # the padding zero row applied in-register, so the materialized [C, k]
+    # gathered stream (HBM write + readback) disappears from the tiled
+    # stream/dense/accum/ring chunk bodies (cfk_tpu/ops/pallas/gram_kernel
+    # ``*_gather_pallas``; ARCHITECTURE.md "In-kernel neighbor gather").
+    # None = the process default (on wherever supported: pallas Gram
+    # backend + the kernels' SMEM/alignment gates, with automatic fallback
+    # to the XLA-gather path otherwise — interpret/old-jax runs use the
+    # emulation twin either way).  False pins the XLA-gather schedule (the
+    # bench.py --gather-ab baseline).  Factors are bit-identical across
+    # the knob (tests/test_in_kernel_gather.py).
+    in_kernel_gather: bool | None = None
+    # Elimination algorithm of the fused reg+solve kernels: "lu" (reverse
+    # no-pivot LU, rank cap 128) or "gj" (Gauss-Jordan, cap 64); "auto"
+    # defers to the process default (ops.pallas.solve_kernel.
+    # default_reg_solve_algo — the CFK_REG_SOLVE_ALGO env var / perf_lab
+    # --reg-solve-algo patch point).  This is a real threaded parameter
+    # (a jit-static on every half-step), which is how the recovery
+    # ladder's GJ rung flips it now (cfk_tpu.resilience.policy) — it used
+    # to ride the env var.
+    reg_solve_algo: Literal["auto", "lu", "gj"] = "auto"
     # Escape hatch for XLA's async collective-permute scheduling on TPU —
     # the compiler pass that actually hides the ring's ppermute behind the
     # double-buffered Gram compute.  "auto" leaves the compiler default
@@ -289,6 +313,16 @@ class ALSConfig:
             raise ValueError(
                 f"fused_epilogue must be None/True/False, got "
                 f"{self.fused_epilogue!r}"
+            )
+        if self.in_kernel_gather not in (None, True, False):
+            raise ValueError(
+                f"in_kernel_gather must be None/True/False, got "
+                f"{self.in_kernel_gather!r}"
+            )
+        if self.reg_solve_algo not in ("auto", "lu", "gj"):
+            raise ValueError(
+                f"reg_solve_algo must be 'auto', 'lu' or 'gj', got "
+                f"{self.reg_solve_algo!r}"
             )
         if self.rank < 1:
             raise ValueError(f"rank must be >= 1, got {self.rank}")

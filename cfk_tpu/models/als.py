@@ -239,7 +239,8 @@ def _segment_device_setup(dataset: Dataset):
 
 def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
           x_prev=None, algorithm="als", block_size=32, sweeps=1,
-          overlap=None, fused_epilogue=None):
+          overlap=None, fused_epilogue=None, in_kernel_gather=None,
+          reg_solve_algo=None):
     """Solve one side against fixed factors; dispatches on the block layout
     (tuple = width buckets, dict with segment ids = flat segment run,
     other dict = one padded rectangle).  ``algorithm="als++"`` runs
@@ -264,7 +265,8 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         )
     if isinstance(blk, tuple):
         return als_half_step_bucketed(
-            fixed, blk, chunks, entities, lam, solver=solver, overlap=overlap
+            fixed, blk, chunks, entities, lam, solver=solver,
+            overlap=overlap, reg_solve_algo=reg_solve_algo,
         )
     if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import tiled_half_step
@@ -272,6 +274,7 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         return tiled_half_step(
             fixed, blk, chunks, entities, lam, solver=solver,
             overlap=overlap, fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
         )
     if "seg_rel" in blk:
         return als_half_step_segment(
@@ -289,6 +292,7 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
             lam,
             statics=chunks,
             solver=solver,
+            reg_solve_algo=reg_solve_algo,
         )
     return als_half_step(
         fixed,
@@ -300,12 +304,13 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
         solve_chunk=solve_chunk,
         solver=solver,
         overlap=overlap,
+        reg_solve_algo=reg_solve_algo,
     )
 
 
 _LAYOUT_STATICS = ("m_chunks", "u_chunks", "m_entities", "u_entities")
 _ALG_STATICS = ("algorithm", "block_size", "sweeps", "overlap",
-                "fused_epilogue")
+                "fused_epilogue", "in_kernel_gather", "reg_solve_algo")
 
 
 @functools.partial(
@@ -331,6 +336,8 @@ def _train_loop(
     sweeps: int = 1,
     overlap: bool | None = None,
     fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    reg_solve_algo: str | None = None,
     health_every: int | None = None,
     health_norm_limit: float = 0.0,
     m_chunks=None,
@@ -355,7 +362,9 @@ def _train_loop(
             u, movie_blocks, user_blocks,
             lam=lam, solve_chunk=solve_chunk, dt=dt, solver=solver,
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-            overlap=overlap, fused_epilogue=fused_epilogue, m_prev=m_prev,
+            overlap=overlap, fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather,
+            reg_solve_algo=reg_solve_algo, m_prev=m_prev,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -390,6 +399,7 @@ def _train_loop(
 def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
                     solver="cholesky", algorithm="als", block_size=32,
                     sweeps=1, overlap=None, fused_epilogue=None,
+                    in_kernel_gather=None, reg_solve_algo=None,
                     m_prev=None, m_chunks=None,
                     u_chunks=None, m_entities=None, u_entities=None):
     """One full iteration (solve M from U, then U from M) — the single source
@@ -401,7 +411,9 @@ def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
     (``m_prev`` / the ``u`` carry) with subspace sweeps.
     """
     alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-               overlap=overlap, fused_epilogue=fused_epilogue)
+               overlap=overlap, fused_epilogue=fused_epilogue,
+               in_kernel_gather=in_kernel_gather,
+               reg_solve_algo=reg_solve_algo)
     m = _half(
         u, movie_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -434,6 +446,8 @@ def _one_iteration(
     sweeps: int = 1,
     overlap: bool | None = None,
     fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    reg_solve_algo: str | None = None,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -443,7 +457,9 @@ def _one_iteration(
         u, movie_blocks, user_blocks,
         lam=lam, solve_chunk=solve_chunk, dt=jnp.dtype(dtype), solver=solver,
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-        overlap=overlap, fused_epilogue=fused_epilogue, m_prev=m_prev,
+        overlap=overlap, fused_epilogue=fused_epilogue,
+        in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+        m_prev=m_prev,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -529,6 +545,8 @@ def train_als(
                 sweeps=config.sweeps,
                 overlap=config.overlap,
                 fused_epilogue=config.fused_epilogue,
+                in_kernel_gather=config.in_kernel_gather,
+                reg_solve_algo=config.reg_solve_algo,
                 health_every=None if health is None else health.every,
                 health_norm_limit=(
                     0.0 if health is None else health.norm_limit
@@ -587,6 +605,12 @@ def train_als(
                     algorithm=config.algorithm, block_size=config.block_size,
                     sweeps=config.sweeps, overlap=config.overlap,
                     fused_epilogue=ov.fused_epilogue,
+                    in_kernel_gather=config.in_kernel_gather,
+                    # The GJ escalation rung: a real jit-static now, so the
+                    # rebuilt step re-traces with the overridden elimination
+                    # (it used to ride the CFK_REG_SOLVE_ALGO env var).
+                    reg_solve_algo=(ov.reg_solve_algo
+                                    or config.reg_solve_algo),
                     **layout_kw,
                 )
 

@@ -71,7 +71,8 @@ class IALSConfig(ALSConfig):
 
 def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
                entities=None, x_prev=None, algorithm="als", block_size=32,
-               sweeps=1, overlap=None, fused_epilogue=None):
+               sweeps=1, overlap=None, fused_epilogue=None,
+               in_kernel_gather=None, reg_solve_algo=None):
     """Dispatch on block layout (tuple = buckets, dict with segment ids =
     flat segment run, other dict = padded rectangle).  ``algorithm="ials++"``
     runs warm-started subspace sweeps from ``x_prev`` instead of full
@@ -96,7 +97,7 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     if isinstance(blk, tuple):
         return ials_half_step_bucketed(
             fixed, blk, chunks, entities, lam, alpha, gram=gram,
-            solver=solver, overlap=overlap,
+            solver=solver, overlap=overlap, reg_solve_algo=reg_solve_algo,
         )
     if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import ials_tiled_half_step
@@ -107,6 +108,7 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
         return ials_tiled_half_step(
             fixed, blk, chunks, entities, lam, alpha, gram=gram,
             solver=solver, overlap=overlap, fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
         )
     if "seg_rel" in blk:
         return ials_half_step_segment(
@@ -114,10 +116,11 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
             blk["seg_rel"], blk["chunk_entity"], blk["group_sizes"],
             blk["carry_in"], blk["last_seg"], entities, lam, alpha,
             gram=gram, statics=chunks, solver=solver,
+            reg_solve_algo=reg_solve_algo,
         )
     return ials_half_step(
         fixed, blk["neighbor_idx"], blk["rating"], blk["mask"], lam, alpha,
-        gram=gram, solver=solver,
+        gram=gram, solver=solver, reg_solve_algo=reg_solve_algo,
     )
 
 
@@ -126,6 +129,7 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     static_argnames=(
         "rank", "num_iterations", "lam", "alpha", "dtype", "solver",
         "algorithm", "block_size", "sweeps", "overlap", "fused_epilogue",
+        "in_kernel_gather", "reg_solve_algo",
         "health_every", "health_norm_limit",
         "m_chunks", "u_chunks", "m_entities", "u_entities",
     ),
@@ -133,7 +137,8 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
 def _train_loop(
     key, movie_blocks, user_blocks, u_stats=None, *, rank, num_iterations, lam,
     alpha, dtype, solver="cholesky", algorithm="als", block_size=32, sweeps=1,
-    overlap=None, fused_epilogue=None,
+    overlap=None, fused_epilogue=None, in_kernel_gather=None,
+    reg_solve_algo=None,
     health_every=None, health_norm_limit=0.0,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
@@ -155,6 +160,8 @@ def _train_loop(
             lam=lam, alpha=alpha, dt=dt, solver=solver,
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
             overlap=overlap, fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather,
+            reg_solve_algo=reg_solve_algo,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -184,13 +191,16 @@ def _train_loop(
 def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
                          dt, solver, algorithm, block_size, sweeps,
                          overlap=None, fused_epilogue=None,
+                         in_kernel_gather=None, reg_solve_algo=None,
                          m_chunks=None, u_chunks=None,
                          m_entities=None, u_entities=None):
     """One full iALS iteration (movies from users, then users from movies) —
     the single source of the per-iteration math for the fused-loop and
     checkpointed paths (mirrors ``als._iteration_body``)."""
     alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
-               overlap=overlap, fused_epilogue=fused_epilogue)
+               overlap=overlap, fused_epilogue=fused_epilogue,
+               in_kernel_gather=in_kernel_gather,
+               reg_solve_algo=reg_solve_algo)
     m = _ials_half(
         u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -206,7 +216,8 @@ def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
     jax.jit,
     static_argnames=(
         "lam", "alpha", "dtype", "solver", "algorithm", "block_size",
-        "sweeps", "overlap", "fused_epilogue", "m_chunks", "u_chunks",
+        "sweeps", "overlap", "fused_epilogue", "in_kernel_gather",
+        "reg_solve_algo", "m_chunks", "u_chunks",
         "m_entities", "u_entities",
     ),
     donate_argnums=(0, 1),
@@ -214,7 +225,8 @@ def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
 def _one_iteration(
     u, m_prev, movie_blocks, user_blocks, *, lam, alpha, dtype,
     solver="cholesky", algorithm="als", block_size=32, sweeps=1,
-    overlap=None, fused_epilogue=None,
+    overlap=None, fused_epilogue=None, in_kernel_gather=None,
+    reg_solve_algo=None,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     return _ials_iteration_body(
@@ -222,6 +234,7 @@ def _one_iteration(
         lam=lam, alpha=alpha, dt=jnp.dtype(dtype), solver=solver,
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
         overlap=overlap, fused_epilogue=fused_epilogue,
+        in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -309,6 +322,8 @@ def train_ials(
                 sweeps=config.sweeps,
                 overlap=config.overlap,
                 fused_epilogue=config.fused_epilogue,
+                in_kernel_gather=config.in_kernel_gather,
+                reg_solve_algo=config.reg_solve_algo,
                 health_every=None if health is None else health.every,
                 health_norm_limit=(
                     0.0 if health is None else health.norm_limit
@@ -367,6 +382,11 @@ def train_ials(
                     block_size=config.block_size, sweeps=config.sweeps,
                     overlap=config.overlap,
                     fused_epilogue=ov.fused_epilogue,
+                    in_kernel_gather=config.in_kernel_gather,
+                    # GJ escalation rung as a threaded jit-static (see
+                    # als.train_als make_step).
+                    reg_solve_algo=(ov.reg_solve_algo
+                                    or config.reg_solve_algo),
                     **layout_kw,
                 )
 
@@ -491,6 +511,8 @@ def make_ials_training_step(
                     fixed_full, blk, chunks, local, config.lam, config.alpha,
                     gram=gram, solver=config.solver, overlap=config.overlap,
                     fused_epilogue=config.fused_epilogue,
+                    in_kernel_gather=config.in_kernel_gather,
+                    reg_solve_algo=config.reg_solve_algo,
                 )
 
             return solve
@@ -511,6 +533,7 @@ def make_ials_training_step(
                     blk["seg"], blk["entity"], blk["gsizes"], blk["cin"],
                     blk["lseg"], local, config.lam, config.alpha,
                     gram=gram, statics=statics, solver=config.solver,
+                    reg_solve_algo=config.reg_solve_algo,
                 )
 
             return solve
@@ -529,6 +552,7 @@ def make_ials_training_step(
                 return ials_half_step_bucketed(
                     fixed_full, blk, chunks, local, config.lam, config.alpha,
                     gram=gram, solver=config.solver, overlap=config.overlap,
+                    reg_solve_algo=config.reg_solve_algo,
                 )
 
             return solve
@@ -544,6 +568,7 @@ def make_ials_training_step(
         return ials_half_step(
             fixed_full, blk["neighbor"], blk["rating"], blk["mask"],
             config.lam, config.alpha, gram=gram, solver=config.solver,
+            reg_solve_algo=config.reg_solve_algo,
         )
 
     spec = {

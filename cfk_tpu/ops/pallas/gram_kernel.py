@@ -59,17 +59,24 @@ _SOLVE_LANES = 128  # lane width of the fused epilogue's solve tiles — the
 # same 128-system batching the standalone solve kernels use
 
 
-def _tile_grams(g_ref, rt_ref, *, m, t, k, precision):
+def _tile_grams(g_ref, rt_ref, *, m, t, k, precision, row_off=None):
     """The m tile Grams of one grid step's [m·t, k] factor block.
 
     All m are issued before the accumulation walk (they have no dependence
     on it), so the MXU pipelines them back-to-back.  Tiles are sliced
     statically — a [m·t, k] → [m, t, k] shape cast is not supported by
-    Mosaic's layout inference for every (t, k).
+    Mosaic's layout inference for every (t, k).  ``row_off`` (the
+    gather-fused kernels) offsets every tile into the double-buffered
+    VMEM gather scratch instead — a 16-aligned dynamic base (the gather
+    support gate requires t % 16 == 0, so every tile keeps the
+    alignment Mosaic's sublane slicing wants).
     """
     a_all, b_all = [], []
     for i in range(m):  # m is static → unrolled
-        g_i = g_ref[i * t:(i + 1) * t, :]  # [t, k]
+        if row_off is None:
+            g_i = g_ref[i * t:(i + 1) * t, :]  # [t, k]
+        else:
+            g_i = g_ref[pl.ds(pl.multiple_of(row_off + i * t, 16), t), :]
         r_i = rt_ref[:, i * t:(i + 1) * t]  # [1, t]
         a_all.append(jax.lax.dot_general(
             g_i, g_i, (((0,), (0,)), ((), ())),
@@ -83,14 +90,16 @@ def _tile_grams(g_ref, rt_ref, *, m, t, k, precision):
 
 
 def _tile_grams_dense(sc_ref, g_ref, rt_ref, *, m, t, k, base, ng, nt,
-                      precision):
+                      precision, row_off=None):
     """Dense-stream tile Grams: [t]-row WINDOWS into the gathered stream at
     16-aligned dynamic offsets (``pl.multiple_of`` — Mosaic rejects
     unhinted dynamic sublane slices of bf16 refs, and sub-(16,128)-tile
     offsets straddle two VMEM tiles per vreg load), with rows outside
     [lo, hi) masked out of ONE dot operand (zeroed rows contribute nothing
     to A; the tile-aligned rt carries zeros outside the window, so b needs
-    no mask)."""
+    no mask).  ``row_off`` (the gather-fused kernels) rebases the windows
+    into the double-buffered VMEM gather scratch — 16-aligned because the
+    gather gate requires block_rows % 16 == 0."""
     s_lb, s_lo, s_hi = ng, ng + nt, ng + 2 * nt
     # Row iota hoisted out of the unrolled loop; the window test
     # (rows >= lo) & (rows < hi) is ONE unsigned compare on (rows - lo)
@@ -99,7 +108,10 @@ def _tile_grams_dense(sc_ref, g_ref, rt_ref, *, m, t, k, base, ng, nt,
     a_all, b_all = [], []
     for i in range(m):
         ti = base + i
-        lb = pl.multiple_of(sc_ref[s_lb + ti], 16)
+        lb_val = sc_ref[s_lb + ti]
+        if row_off is not None:
+            lb_val = row_off + lb_val
+        lb = pl.multiple_of(lb_val, 16)
         lo = sc_ref[s_lo + ti]
         hi = sc_ref[s_hi + ti]
         keep = (rows - lo).astype(jnp.uint32) < (hi - lo).astype(jnp.uint32)
@@ -612,22 +624,25 @@ def _fused_scratch_bytes(s_pad: int, k: int) -> int:
     return (s_pad * k * (k + 1) + 4 * k * k * _SOLVE_LANES) * 4
 
 
-def fused_gram_solve_supported(num_segments: int, k: int) -> bool:
+def fused_gram_solve_supported(num_segments: int, k: int,
+                               algo: str | None = None) -> bool:
     """Can the fused Gram+solve epilogue handle this chunk shape?
 
     Two gates: the rank must fit the fused reg+solve elimination's cap
     (LU 128 / GJ 64 — past it the dispatcher's cholesky/Schur backends are
-    needed, which only exist as separate passes), and the lane-padded
-    (A, b) scratch (``_fused_scratch_bytes`` — same formula the compile
-    budget uses) must leave VMEM headroom for the double-buffered input
-    blocks under the ~124 MB scoped ceiling.  The 72 MB gate reserves
-    ≥ 50 MB for inputs (the gate cannot see the chunk's block size, so it
-    is conservative: a refused shape takes the split path — same math,
-    one extra round-trip — never a Mosaic compile failure).
+    needed, which only exist as separate passes; ``algo`` threads the
+    caller's elimination choice, None/'auto' = the process default), and
+    the lane-padded (A, b) scratch (``_fused_scratch_bytes`` — same
+    formula the compile budget uses) must leave VMEM headroom for the
+    double-buffered input blocks under the ~124 MB scoped ceiling.  The
+    72 MB gate reserves ≥ 50 MB for inputs (the gate cannot see the
+    chunk's block size, so it is conservative: a refused shape takes the
+    split path — same math, one extra round-trip — never a Mosaic compile
+    failure).
     """
     from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
 
-    if k > _fused_reg_rank_cap():
+    if k > _fused_reg_rank_cap(algo):
         return False
     s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
     return _fused_scratch_bytes(s_pad, k) <= (72 << 20)
@@ -811,10 +826,9 @@ def gram_solve_tiles_pallas(
     split regression tests pin.  Rank cap and VMEM sizing are gated by
     ``fused_gram_solve_supported``; callers fall back to split past it.
     """
-    if algo is None:
-        from cfk_tpu.ops.pallas.solve_kernel import default_reg_solve_algo
+    from cfk_tpu.ops.pallas.solve_kernel import resolve_reg_solve_algo
 
-        algo = default_reg_solve_algo()
+    algo = resolve_reg_solve_algo(algo)
     if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
         algo = "gj"
     return _gram_solve_tiles_pallas(
@@ -925,10 +939,9 @@ def gram_solve_tiles_dense_pallas(
     variant of ``gram_solve_tiles_pallas`` (same epilogue, dense windowed
     walk; see ``gram_tiles_dense_pallas`` for the stream/metadata
     contract)."""
-    if algo is None:
-        from cfk_tpu.ops.pallas.solve_kernel import default_reg_solve_algo
+    from cfk_tpu.ops.pallas.solve_kernel import resolve_reg_solve_algo
 
-        algo = default_reg_solve_algo()
+    algo = resolve_reg_solve_algo(algo)
     if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
         algo = "gj"
     return _gram_solve_tiles_dense_pallas(
@@ -1017,6 +1030,809 @@ def _gram_solve_tiles_dense_pallas(
         interpret=interpret,
         **kwargs,
     )(meta_plus, g, rt.reshape(1, nt * t), reg_op, *carry_ops)
+    return x[:num_segments], cao, cbo[0]
+
+
+# --------------------------------------------------------------------------
+# In-kernel neighbor gather (gather-fused kernel variants)
+#
+# Every half-iteration above consumes a PRE-GATHERED [C, k] stream: XLA
+# materializes fz[nb] in HBM and the kernel reads it straight back — the
+# same write+readback shape the fused epilogue removed for the [Ec, k, k]
+# A-batches, and the dominant measured roofline gap (BENCH_r05
+# vs_gather_roofline 1.88–9.94×).  The ``*_gather_pallas`` variants retire
+# that stream: the RAW fixed factor table stays in HBM/ANY memory, each
+# tile's neighbor indices ride the scalar prefetch, and the kernel DMAs
+# the indexed rows straight into a double-buffered VMEM block (group g+1's
+# row DMAs are in flight while group g's Gram walk runs).  The zero-
+# appended padding row is realized IN-REGISTER: indices are clamped to the
+# last real row for the DMA and the per-entry premultiply ``wt`` (the 0/1
+# validity mask for unit weights, √aw·mask for iALS) zeroes padding rows —
+# the [F+1, k] zero-row copy of the table is never built.  Dense-stream
+# padding needs no mask at all: pad slots sit outside every [lo, hi)
+# window, so the existing one-operand window mask annihilates them.
+# Failure-mode caveat (same class the walk's arithmetic select accepts —
+# see _walk_tiles): clamped-row × 0.0 is exactly 0 only for FINITE table
+# rows; a diverged table (Inf/NaN rows) turns padding slots into NaN via
+# 0·inf on the Mosaic route, where the XLA zero-row gather stayed 0.
+# Acceptable: non-finite factors are already a broken run — the health
+# sentinel (cfk_tpu.resilience) trips on the half-step's OUTPUT either
+# way — this only widens the blast radius within an already-lost
+# iteration, and only on real TPU (the emulation twin gathers true
+# zeros).
+#
+# Index convention (all gather variants): ``nb == table.shape[0]`` is the
+# virtual zero row; the clamp + wt/window masking makes its contribution
+# exactly 0.  Off-TPU and on old-jax installs the wrappers route to
+# ``compat.emulate_in_kernel_gather`` + the existing emulation twins,
+# which run the numerically identical append-zero-row + gather + multiply
+# the XLA-gather path runs — fused-gather vs XLA-gather factors are
+# BIT-IDENTICAL on that route (tests/test_in_kernel_gather.py).  The
+# Mosaic row-DMA path itself needs on-TPU validation (ROADMAP).
+# --------------------------------------------------------------------------
+
+# Scalar-prefetch budget for the gather variants: the whole index chunk
+# (plus seg/meta words) lives in SMEM.  512 KiB admits the production 64k-
+# entry chunks (64k indices + ~20k meta words ≈ 336 KiB); past it the
+# resolver keeps the XLA-gather path.  Needs on-TPU validation against the
+# real SMEM ceiling (ROADMAP) — a too-large cap fails at Mosaic compile
+# time, never silently.
+_GATHER_SMEM_BYTES_CAP = 512 << 10
+
+
+def in_kernel_gather_supported(entries: int, meta_words: int, tile_rows: int,
+                               block_rows: int | None = None) -> bool:
+    """Can the gather-fused kernels handle this chunk shape?
+
+    Gates: the scalar prefetch (indices + seg/meta + lseg) must fit the
+    SMEM budget, and tile/block row counts must be 16-aligned — the
+    double-buffered gather scratch is addressed at ``slot·rows + i·t``
+    dynamic offsets, which Mosaic's sublane slicing only lowers at
+    (16, 128)-tile alignment.  A refused shape keeps the XLA-gather path
+    (same math, the materialized stream) — never a compile failure.
+    """
+    if tile_rows % 16:
+        return False
+    if block_rows is not None and block_rows % 16:
+        return False
+    return (entries + meta_words + 1) * 4 <= _GATHER_SMEM_BYTES_CAP
+
+
+def _any_memory_space():
+    """The compiler-placed (HBM-resident for big operands) memory space
+    across pallas versions — where the gather variants keep the full
+    fixed table."""
+    if pltpu is not None:
+        ms = getattr(pltpu, "ANY", None)
+        if ms is None:
+            tms = getattr(pltpu, "TPUMemorySpace", None)
+            ms = getattr(tms, "ANY", None) if tms is not None else None
+        if ms is not None:
+            return ms
+    return getattr(pl, "ANY", None)  # pragma: no cover - exotic builds
+
+
+def _gather_dma(table_ref, g_buf, sem, sc_ref, nb_base, row0, rows, slot,
+                f_rows):
+    """Descriptor factory for one group's per-row gather DMAs: scratch row
+    ``slot·rows + r`` ← ``table[min(nb[row0 + r], F−1)]``.  Start and wait
+    recreate identical descriptors (the pallas DMA idiom); all of a
+    group's copies signal the slot's semaphore."""
+    def copy(r):
+        idx = sc_ref[nb_base + row0 + r]
+        src = jnp.minimum(idx, f_rows - 1)
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(src, 1)],
+            g_buf.at[pl.ds(slot * rows + r, 1)],
+            sem.at[slot],
+        )
+
+    return copy
+
+
+def _gather_double_buffer(g_buf, sem, table_ref, sc_ref, *, nb_base, rows,
+                          gi, ng, f_rows, group_row0):
+    """The gather variants' double buffer: issue group gi+1's row DMAs
+    (and group 0's at the prologue step) BEFORE waiting on group gi's, so
+    the next block's HBM row fetches run under this block's Gram walk —
+    the in-kernel analog of ``ops.pipeline.prefetch_scan``.  Slot parity
+    alternates; the slot being filled for gi+1 was last read at step
+    gi−1, which the sequential grid has already retired.  Returns the
+    VMEM row offset of group gi's ready block.  ``group_row0`` maps a
+    group index to its first index-stream position (``g·rows`` for the
+    tile stream, ``meta[g]·BG`` for the dense stream — dense groups may
+    revisit a block, in which case its rows are simply re-fetched)."""
+    def start(group):
+        slot = lax.rem(group, 2)
+        copy = _gather_dma(table_ref, g_buf, sem, sc_ref, nb_base,
+                           group_row0(group), rows, slot, f_rows)
+
+        def body(r, c):
+            copy(r).start()
+            return c
+
+        lax.fori_loop(0, rows, body, 0)
+
+    @pl.when(gi == 0)
+    def _prologue():
+        start(gi)
+
+    @pl.when(gi + 1 < ng)
+    def _prefetch():
+        start(gi + 1)
+
+    slot = lax.rem(gi, 2)
+    copy = _gather_dma(table_ref, g_buf, sem, sc_ref, nb_base,
+                       group_row0(gi), rows, slot, f_rows)
+
+    def wait_body(r, c):
+        copy(r).wait()
+        return c
+
+    lax.fori_loop(0, rows, wait_body, 0)
+    return slot * rows
+
+
+def _premultiply_rows(g_buf, off, rows, wt_ref):
+    """In-register per-entry premultiply on the gathered block: one
+    (1, rows) → (rows, 1) relayout per grid step (VMEM-local — the XLA
+    path's [C, 1] weight column relayout through HBM is what this
+    replaces), then a fused broadcast multiply.  The weight is cast to
+    the factor dtype first, matching the XLA path's ``wt.astype(ct)``
+    bit-for-bit.  ``wt`` is the 0/1 validity mask for unit-weight callers
+    — which is what zeroes the clamped padding rows in-register."""
+    base = pl.ds(pl.multiple_of(off, 16), rows)
+    blk = g_buf[base, :]
+    w = jnp.transpose(wt_ref[...], (1, 0)).astype(blk.dtype)
+    g_buf[base, :] = blk * w
+
+
+def _gram_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt, f_rows,
+                               precision, with_carry):
+    """Gather-fused twin of ``_gram_groups_kernel``: the [m·t, k] factor
+    block is row-DMA'd from the ANY-memory table instead of streamed as a
+    pipelined input.  Scalar layout: seg [NT] ‖ nb [NT·T]."""
+    refs = list(refs)
+    g_buf, sem = refs[-2], refs[-1]
+    del refs[-2:]
+    a_ref, b_ref = refs[-2:]
+    del refs[-2:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref, wt_ref = refs[0], refs[1]
+    gi = pl.program_id(0)
+    base = gi * m
+    rows = m * t
+    off = _gather_double_buffer(
+        g_buf, sem, table_ref, sc_ref, nb_base=nt, rows=rows, gi=gi,
+        ng=pl.num_programs(0), f_rows=f_rows,
+        group_row0=lambda g: g * rows,
+    )
+    _premultiply_rows(g_buf, off, rows, wt_ref)
+    a_all, b_all = _tile_grams(g_buf, rt_ref, m=m, t=t, k=k,
+                               precision=precision, row_off=off)
+    _walk_tiles(lambda i: sc_ref[i], a_all, b_all, gi=gi, base=base, m=m,
+                a_ref=a_ref, b_ref=b_ref, carry=carry)
+
+
+def _gram_solve_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt,
+                                     s_pad, f_rows, precision, with_carry,
+                                     reg_mode, lam, algo):
+    """Gather-fused twin of ``_gram_solve_groups_kernel`` (in-kernel
+    gather + scratch-resident walk + last-step ridge+solve epilogue).
+    Scalar layout: seg [NT] ‖ lseg ‖ nb [NT·T]."""
+    refs = list(refs)
+    g_buf, sem = refs[-2], refs[-1]
+    del refs[-2:]
+    if algo == "lu":
+        lu_scr = tuple(refs[-3:])
+        del refs[-3:]
+    else:
+        lu_scr = None
+    a_scr, b_scr = refs[-2:]
+    del refs[-2:]
+    x_ref, cao_ref, cbo_ref = refs[-3:]
+    del refs[-3:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref, wt_ref, reg_ref = refs[0], refs[1], refs[2]
+    gi = pl.program_id(0)
+    base = gi * m
+    rows = m * t
+    off = _gather_double_buffer(
+        g_buf, sem, table_ref, sc_ref, nb_base=nt + 1, rows=rows, gi=gi,
+        ng=pl.num_programs(0), f_rows=f_rows,
+        group_row0=lambda g: g * rows,
+    )
+    _premultiply_rows(g_buf, off, rows, wt_ref)
+    a_all, b_all = _tile_grams(g_buf, rt_ref, m=m, t=t, k=k,
+                               precision=precision, row_off=off)
+    _walk_tiles(lambda i: sc_ref[i], a_all, b_all, gi=gi, base=base, m=m,
+                a_ref=a_scr, b_ref=b_scr, carry=carry)
+
+    @pl.when(gi == pl.num_programs(0) - 1)
+    def _epilogue():
+        _solve_epilogue(
+            a_scr, b_scr, reg_ref, sc_ref[nt], x_ref, cao_ref, cbo_ref,
+            lu_scr, k=k, s_pad=s_pad, reg_mode=reg_mode, lam=lam, algo=algo,
+        )
+
+
+def _gram_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng, nt, bg,
+                              f_rows, precision, with_carry, weighted):
+    """Gather-fused twin of ``_gram_dense_kernel``: the [BG, k] stream
+    block is row-DMA'd by index instead of streamed.  Dense padding slots
+    need no premultiply mask — they sit outside every [lo, hi) window, so
+    the windowed walk's one-operand mask annihilates whatever the clamped
+    DMA fetched.  Scalar layout: meta [NG+4·NT] ‖ nb [C]."""
+    refs = list(refs)
+    g_buf, sem = refs[-2], refs[-1]
+    del refs[-2:]
+    a_ref, b_ref = refs[-2:]
+    del refs[-2:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref = refs[0]
+    wt_ref = refs[1] if weighted else None
+    gi = pl.program_id(0)
+    base = gi * m
+    meta_words = ng + 4 * nt
+    off = _gather_double_buffer(
+        g_buf, sem, table_ref, sc_ref, nb_base=meta_words, rows=bg, gi=gi,
+        ng=pl.num_programs(0), f_rows=f_rows,
+        group_row0=lambda g: sc_ref[g] * bg,
+    )
+    if weighted:
+        _premultiply_rows(g_buf, off, bg, wt_ref)
+    a_all, b_all = _tile_grams_dense(
+        sc_ref, g_buf, rt_ref, m=m, t=t, k=k, base=base, ng=ng, nt=nt,
+        precision=precision, row_off=off,
+    )
+    _walk_tiles(lambda i: sc_ref[ng + 3 * nt + i], a_all, b_all, gi=gi,
+                base=base, m=m, a_ref=a_ref, b_ref=b_ref, carry=carry)
+
+
+def _gram_solve_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng,
+                                    nt, bg, s_pad, f_rows, precision,
+                                    with_carry, weighted, reg_mode, lam,
+                                    algo):
+    """Gather-fused twin of ``_gram_solve_dense_kernel``.  Scalar layout:
+    meta [NG+4·NT] ‖ lseg ‖ nb [C]."""
+    refs = list(refs)
+    g_buf, sem = refs[-2], refs[-1]
+    del refs[-2:]
+    if algo == "lu":
+        lu_scr = tuple(refs[-3:])
+        del refs[-3:]
+    else:
+        lu_scr = None
+    a_scr, b_scr = refs[-2:]
+    del refs[-2:]
+    x_ref, cao_ref, cbo_ref = refs[-3:]
+    del refs[-3:]
+    carry = None
+    if with_carry:
+        carry = tuple(refs[-3:])
+        del refs[-3:]
+    rt_ref = refs[0]
+    wt_ref = refs[1] if weighted else None
+    reg_ref = refs[2] if weighted else refs[1]
+    gi = pl.program_id(0)
+    base = gi * m
+    meta_words = ng + 4 * nt
+    off = _gather_double_buffer(
+        g_buf, sem, table_ref, sc_ref, nb_base=meta_words + 1, rows=bg,
+        gi=gi, ng=pl.num_programs(0), f_rows=f_rows,
+        group_row0=lambda g: sc_ref[g] * bg,
+    )
+    if weighted:
+        _premultiply_rows(g_buf, off, bg, wt_ref)
+    a_all, b_all = _tile_grams_dense(
+        sc_ref, g_buf, rt_ref, m=m, t=t, k=k, base=base, ng=ng, nt=nt,
+        precision=precision, row_off=off,
+    )
+    _walk_tiles(lambda i: sc_ref[ng + 3 * nt + i], a_all, b_all, gi=gi,
+                base=base, m=m, a_ref=a_scr, b_ref=b_scr, carry=carry)
+
+    @pl.when(gi == pl.num_programs(0) - 1)
+    def _epilogue():
+        _solve_epilogue(
+            a_scr, b_scr, reg_ref, sc_ref[meta_words], x_ref, cao_ref,
+            cbo_ref, lu_scr, k=k, s_pad=s_pad, reg_mode=reg_mode, lam=lam,
+            algo=algo,
+        )
+
+
+def _emulate_gather(table, nb, wt):
+    """The wrappers' interpret/old-jax gather: the XLA twin of the DMA
+    fetch + in-register premultiply (``compat.emulate_in_kernel_gather``),
+    at the factor compute dtype the materialized-stream path uses."""
+    from cfk_tpu.compat import emulate_in_kernel_gather
+    from cfk_tpu.ops.solve import _gram_compute_dtype
+
+    ct, _ = _gram_compute_dtype(table)
+    return emulate_in_kernel_gather(table, nb, wt, ct)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "tile_rows", "group_tiles", "interpret"),
+)
+def gram_tiles_gather_pallas(
+    table: jax.Array,  # [F, k] RAW fixed factor table (no zero row)
+    nb: jax.Array,  # [C] int32 row indices; F = the virtual zero row
+    wt: jax.Array,  # [C] f32 premultiply (0/1 mask, or √aw·mask for iALS)
+    rt: jax.Array,  # [C] f32 b-side coefficients (0 at padding)
+    seg: jax.Array,  # [NT] int32 owner of each tile (sorted by the layout)
+    *,
+    num_segments: int,
+    tile_rows: int,
+    group_tiles: int = 64,
+    interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Gather-fused ``gram_tiles_pallas``: same (A, b) contract, but the
+    [C, k] neighbor stream is never materialized — the kernel DMAs the
+    indexed table rows into VMEM itself (see the section comment above).
+    ``wt`` is REQUIRED: it is both the weighted (√aw) premultiply and the
+    in-register realization of the zero-appended padding row (unit-weight
+    callers pass their 0/1 validity mask, e.g. the tiled layout's
+    ``weight`` channel)."""
+    c = nb.shape[0]
+    k = table.shape[-1]
+    t = tile_rows
+    if c % t != 0:
+        raise ValueError(f"entry count {c} not divisible by tile_rows {t}")
+    nt = c // t
+    if seg.shape != (nt,):
+        raise ValueError(f"seg shape {seg.shape} != ({nt},)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret or not has_vma_system():
+        return _emulate_gram_tiles(
+            _emulate_gather(table, nb, wt), rt, seg,
+            num_segments=num_segments, tile_rows=t, carry=carry,
+        )
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    m = group_tiles
+    while nt % m != 0:
+        m //= 2
+    rows = m * t
+    f_rows = table.shape[0]
+    vma = typeof_vma(table)
+    mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
+        lambda s, d: jax.ShapeDtypeStruct(s, d)
+    )
+    out_shape = (
+        mk((num_segments, k, k), jnp.float32),
+        mk((num_segments, 1, k), jnp.float32),
+    )
+    carry_specs = [] if carry is None else [
+        pl.BlockSpec((k, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, 1), lambda i, sc: (0, 0)),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt // m,),
+        in_specs=[
+            pl.BlockSpec(memory_space=_any_memory_space()),  # table
+            pl.BlockSpec((1, rows), lambda i, sc: (0, i)),   # rt
+            pl.BlockSpec((1, rows), lambda i, sc: (0, i)),   # wt
+        ] + carry_specs,
+        out_specs=[
+            pl.BlockSpec((num_segments, k, k), lambda i, sc: (0, 0, 0)),
+            pl.BlockSpec((num_segments, 1, k), lambda i, sc: (0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2 * rows, k), table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    precision = (
+        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
+    )
+    out_bytes = num_segments * k * (k + 1) * 4
+    g_bytes = 2 * rows * k * table.dtype.itemsize
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(2 * out_bytes + g_bytes + 4 * rows * 8
+                             + (12 << 20), 124 << 20)
+    )}
+    carry_ops = [] if carry is None else [
+        carry[0].astype(jnp.float32),
+        carry[1].reshape(1, k).astype(jnp.float32),
+        carry[2].reshape(1, 1).astype(jnp.float32),
+    ]
+    scalar = jnp.concatenate([seg.astype(jnp.int32), nb.astype(jnp.int32)])
+    a, b = pl.pallas_call(
+        functools.partial(
+            _gram_gather_groups_kernel, m=m, t=t, k=k, nt=nt, f_rows=f_rows,
+            precision=precision, with_carry=carry is not None,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(scalar, table, rt.reshape(1, c).astype(jnp.float32),
+      wt.reshape(1, c).astype(jnp.float32), *carry_ops)
+    return a, b[:, 0, :]
+
+
+def gram_solve_tiles_gather_pallas(
+    table: jax.Array,  # [F, k] RAW fixed factor table (no zero row)
+    nb: jax.Array,  # [C] int32 row indices; F = the virtual zero row
+    wt: jax.Array,  # [C] f32 premultiply (0/1 mask, or √aw·mask for iALS)
+    rt: jax.Array,  # [C] f32
+    seg: jax.Array,  # [NT] int32
+    reg: jax.Array,  # diag: [num_segments] counts; matrix: [k, k] YᵀY+λI
+    lseg: jax.Array,  # int32 scalar: the carry row to extract
+    *,
+    num_segments: int,
+    tile_rows: int,
+    group_tiles: int = 64,
+    reg_mode: str = "diag",
+    lam: float = 0.0,
+    interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+    algo: str | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Gather-fused ``gram_solve_tiles_pallas``: in-kernel neighbor gather
+    AND the in-VMEM ridge+solve epilogue — per chunk, neither the [C, k]
+    gathered stream nor the [Ec, k, k] A-batch ever touches HBM."""
+    from cfk_tpu.ops.pallas.solve_kernel import resolve_reg_solve_algo
+
+    algo = resolve_reg_solve_algo(algo)
+    if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
+        algo = "gj"
+    return _gram_solve_tiles_gather_pallas(
+        table, nb, wt, rt, seg, reg, lseg, num_segments=num_segments,
+        tile_rows=tile_rows, group_tiles=group_tiles, reg_mode=reg_mode,
+        lam=lam, interpret=interpret, carry=carry, algo=algo,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "tile_rows", "group_tiles", "reg_mode",
+                     "lam", "interpret", "algo"),
+)
+def _gram_solve_tiles_gather_pallas(
+    table, nb, wt, rt, seg, reg, lseg, *, num_segments, tile_rows,
+    group_tiles, reg_mode, lam, interpret, carry, algo,
+):
+    c = nb.shape[0]
+    k = table.shape[-1]
+    t = tile_rows
+    if c % t != 0:
+        raise ValueError(f"entry count {c} not divisible by tile_rows {t}")
+    nt = c // t
+    if seg.shape != (nt,):
+        raise ValueError(f"seg shape {seg.shape} != ({nt},)")
+    _check_reg_shape(reg, reg_mode, num_segments, k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret or not has_vma_system():
+        from cfk_tpu.compat import emulate_fused_gram_solve
+
+        a, b = _emulate_gram_tiles(
+            _emulate_gather(table, nb, wt), rt, seg,
+            num_segments=num_segments, tile_rows=t, carry=carry,
+        )
+        return emulate_fused_gram_solve(
+            a, b, reg, reg_mode=reg_mode, lam=lam, lseg=lseg,
+        )
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    m = group_tiles
+    while nt % m != 0:
+        m //= 2
+    rows = m * t
+    f_rows = table.shape[0]
+    s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
+    vma = typeof_vma(table)
+    (reg_op, reg_spec, carry_ops, carry_specs, out_shape, out_specs,
+     scratch, scratch_bytes) = _fused_call_pieces(
+        k, s_pad, num_segments, reg, reg_mode, carry, vma, algo)
+    scratch = scratch + [
+        pltpu.VMEM((2 * rows, k), table.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    scalar = jnp.concatenate([
+        seg.astype(jnp.int32),
+        jnp.asarray(lseg, jnp.int32).reshape(1),
+        nb.astype(jnp.int32),
+    ])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt // m,),
+        in_specs=[
+            pl.BlockSpec(memory_space=_any_memory_space()),  # table
+            pl.BlockSpec((1, rows), lambda i, sc: (0, i)),   # rt
+            pl.BlockSpec((1, rows), lambda i, sc: (0, i)),   # wt
+            reg_spec,
+        ] + carry_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    precision = (
+        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
+    )
+    g_bytes = 2 * rows * k * table.dtype.itemsize
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(scratch_bytes + g_bytes + 4 * rows * 8
+                             + (12 << 20), 124 << 20)
+    )}
+    x, cao, cbo = pl.pallas_call(
+        functools.partial(
+            _gram_solve_gather_groups_kernel, m=m, t=t, k=k, nt=nt,
+            s_pad=s_pad, f_rows=f_rows, precision=precision,
+            with_carry=carry is not None, reg_mode=reg_mode, lam=lam,
+            algo=algo,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(scalar, table, rt.reshape(1, c).astype(jnp.float32),
+      wt.reshape(1, c).astype(jnp.float32), reg_op, *carry_ops)
+    return x[:num_segments], cao, cbo[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "tile_rows", "num_tiles", "num_groups",
+                     "block_rows", "interpret"),
+)
+def gram_tiles_dense_gather_pallas(
+    table: jax.Array,  # [F, k] RAW fixed factor table (no zero row)
+    nb: jax.Array,  # [C] int32 dense-stream row indices (pad8 → F)
+    wt: jax.Array | None,  # [C] f32 √aw stream (iALS) or None (unit)
+    rt: jax.Array,  # [NT·T] f32 TILE-ALIGNED b coefficients
+    meta: jax.Array,  # [NG + 4·NT] int32: g_blk ‖ lb ‖ lo ‖ hi ‖ seg
+    *,
+    num_segments: int,
+    tile_rows: int,
+    num_tiles: int,
+    num_groups: int,
+    block_rows: int,
+    interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Gather-fused ``gram_tiles_dense_pallas``: the dense [C, k] stream
+    is never materialized — each grid step row-DMAs its [BG, k] block by
+    index.  Unit-weight callers pass ``wt=None``: dense padding slots sit
+    outside every window, so the walk's one-operand mask annihilates the
+    clamped rows without a premultiply."""
+    c = nb.shape[0]
+    k = table.shape[-1]
+    t = tile_rows
+    nt, ng, bg = num_tiles, num_groups, block_rows
+    if nt % ng != 0:
+        raise ValueError(f"num_tiles {nt} not divisible by num_groups {ng}")
+    m = nt // ng
+    if rt.shape != (nt * t,):
+        raise ValueError(f"rt shape {rt.shape} != ({nt * t},)")
+    if meta.shape != (ng + 4 * nt,):
+        raise ValueError(f"meta shape {meta.shape} != ({ng + 4 * nt},)")
+    if c % bg != 0 or bg < t:
+        raise ValueError(f"stream length {c} not a multiple of block_rows "
+                         f"{bg} >= tile_rows {t}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret or not has_vma_system():
+        return _emulate_gram_dense(
+            _emulate_gather(table, nb, wt), rt, meta,
+            num_segments=num_segments, tile_rows=t, num_tiles=nt,
+            num_groups=ng, block_rows=bg, carry=carry,
+        )
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    f_rows = table.shape[0]
+    weighted = wt is not None
+    vma = typeof_vma(table)
+    mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
+        lambda s, d: jax.ShapeDtypeStruct(s, d)
+    )
+    out_shape = (
+        mk((num_segments, k, k), jnp.float32),
+        mk((num_segments, 1, k), jnp.float32),
+    )
+    carry_specs = [] if carry is None else [
+        pl.BlockSpec((k, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, k), lambda i, sc: (0, 0)),
+        pl.BlockSpec((1, 1), lambda i, sc: (0, 0)),
+    ]
+    wt_specs = ([pl.BlockSpec((1, bg), lambda i, sc: (0, sc[i]))]
+                if weighted else [])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ng,),
+        in_specs=[
+            pl.BlockSpec(memory_space=_any_memory_space()),  # table
+            pl.BlockSpec((1, m * t), lambda i, sc: (0, i)),  # rt
+        ] + wt_specs + carry_specs,
+        out_specs=[
+            pl.BlockSpec((num_segments, k, k), lambda i, sc: (0, 0, 0)),
+            pl.BlockSpec((num_segments, 1, k), lambda i, sc: (0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2 * bg, k), table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    precision = (
+        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
+    )
+    out_bytes = num_segments * k * (k + 1) * 4
+    g_bytes = 2 * bg * k * table.dtype.itemsize
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(2 * out_bytes + g_bytes + 4 * bg * 8
+                             + (10 << 20), 124 << 20)
+    )}
+    carry_ops = [] if carry is None else [
+        carry[0].astype(jnp.float32),
+        carry[1].reshape(1, k).astype(jnp.float32),
+        carry[2].reshape(1, 1).astype(jnp.float32),
+    ]
+    wt_ops = ([wt.reshape(1, c).astype(jnp.float32)] if weighted else [])
+    scalar = jnp.concatenate([meta.astype(jnp.int32), nb.astype(jnp.int32)])
+    a, b = pl.pallas_call(
+        functools.partial(
+            _gram_gather_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt, bg=bg,
+            f_rows=f_rows, precision=precision,
+            with_carry=carry is not None, weighted=weighted,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(scalar, table, rt.reshape(1, nt * t), *wt_ops, *carry_ops)
+    return a, b[:, 0, :]
+
+
+def gram_solve_tiles_dense_gather_pallas(
+    table: jax.Array,  # [F, k] RAW fixed factor table (no zero row)
+    nb: jax.Array,  # [C] int32 dense-stream row indices (pad8 → F)
+    wt: jax.Array | None,  # [C] f32 √aw stream (iALS) or None (unit)
+    rt: jax.Array,  # [NT·T] f32 TILE-ALIGNED b coefficients
+    meta: jax.Array,  # [NG + 4·NT] int32
+    reg: jax.Array,  # diag: [num_segments] counts; matrix: [k, k]
+    lseg: jax.Array,  # int32 scalar
+    *,
+    num_segments: int,
+    tile_rows: int,
+    num_tiles: int,
+    num_groups: int,
+    block_rows: int,
+    reg_mode: str = "diag",
+    lam: float = 0.0,
+    interpret: bool | None = None,
+    carry: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+    algo: str | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Gather-fused ``gram_solve_tiles_dense_pallas``: in-kernel dense
+    gather AND the in-VMEM ridge+solve epilogue."""
+    from cfk_tpu.ops.pallas.solve_kernel import resolve_reg_solve_algo
+
+    algo = resolve_reg_solve_algo(algo)
+    if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
+        algo = "gj"
+    return _gram_solve_tiles_dense_gather_pallas(
+        table, nb, wt, rt, meta, reg, lseg, num_segments=num_segments,
+        tile_rows=tile_rows, num_tiles=num_tiles, num_groups=num_groups,
+        block_rows=block_rows, reg_mode=reg_mode, lam=lam,
+        interpret=interpret, carry=carry, algo=algo,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "tile_rows", "num_tiles", "num_groups",
+                     "block_rows", "reg_mode", "lam", "interpret", "algo"),
+)
+def _gram_solve_tiles_dense_gather_pallas(
+    table, nb, wt, rt, meta, reg, lseg, *, num_segments, tile_rows,
+    num_tiles, num_groups, block_rows, reg_mode, lam, interpret, carry,
+    algo,
+):
+    c = nb.shape[0]
+    k = table.shape[-1]
+    t = tile_rows
+    nt, ng, bg = num_tiles, num_groups, block_rows
+    if nt % ng != 0:
+        raise ValueError(f"num_tiles {nt} not divisible by num_groups {ng}")
+    m = nt // ng
+    if rt.shape != (nt * t,):
+        raise ValueError(f"rt shape {rt.shape} != ({nt * t},)")
+    if meta.shape != (ng + 4 * nt,):
+        raise ValueError(f"meta shape {meta.shape} != ({ng + 4 * nt},)")
+    if c % bg != 0 or bg < t:
+        raise ValueError(f"stream length {c} not a multiple of block_rows "
+                         f"{bg} >= tile_rows {t}")
+    _check_reg_shape(reg, reg_mode, num_segments, k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret or not has_vma_system():
+        from cfk_tpu.compat import emulate_fused_gram_solve
+
+        a, b = _emulate_gram_dense(
+            _emulate_gather(table, nb, wt), rt, meta,
+            num_segments=num_segments, tile_rows=t, num_tiles=nt,
+            num_groups=ng, block_rows=bg, carry=carry,
+        )
+        return emulate_fused_gram_solve(
+            a, b, reg, reg_mode=reg_mode, lam=lam, lseg=lseg,
+        )
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    f_rows = table.shape[0]
+    weighted = wt is not None
+    s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
+    vma = typeof_vma(table)
+    (reg_op, reg_spec, carry_ops, carry_specs, out_shape, out_specs,
+     scratch, scratch_bytes) = _fused_call_pieces(
+        k, s_pad, num_segments, reg, reg_mode, carry, vma, algo)
+    scratch = scratch + [
+        pltpu.VMEM((2 * bg, k), table.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    wt_specs = ([pl.BlockSpec((1, bg), lambda i, sc: (0, sc[i]))]
+                if weighted else [])
+    scalar = jnp.concatenate([
+        meta.astype(jnp.int32),
+        jnp.asarray(lseg, jnp.int32).reshape(1),
+        nb.astype(jnp.int32),
+    ])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ng,),
+        in_specs=[
+            pl.BlockSpec(memory_space=_any_memory_space()),  # table
+            pl.BlockSpec((1, m * t), lambda i, sc: (0, i)),  # rt
+        ] + wt_specs + [reg_spec] + carry_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    precision = (
+        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
+    )
+    g_bytes = 2 * bg * k * table.dtype.itemsize
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(scratch_bytes + g_bytes + 4 * bg * 8
+                             + (10 << 20), 124 << 20)
+    )}
+    wt_ops = ([wt.reshape(1, c).astype(jnp.float32)] if weighted else [])
+    x, cao, cbo = pl.pallas_call(
+        functools.partial(
+            _gram_solve_gather_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt,
+            bg=bg, s_pad=s_pad, f_rows=f_rows, precision=precision,
+            with_carry=carry is not None, weighted=weighted,
+            reg_mode=reg_mode, lam=lam, algo=algo,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **kwargs,
+    )(scalar, table, rt.reshape(1, nt * t), *wt_ops, reg_op, *carry_ops)
     return x[:num_segments], cao, cbo[0]
 
 

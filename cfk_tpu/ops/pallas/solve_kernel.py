@@ -207,15 +207,21 @@ def _gauss_reg_kernel(a_ref, b_ref, r_ref, x_ref, *, k: int, reg_mode: str,
 
 
 def default_reg_solve_algo() -> str:
-    """Elimination algorithm for the fused reg+solve kernel: ``"lu"``
-    (reverse-order no-pivot LU, k³/3 VPU work, rank cap 128) vs ``"gj"``
-    (Gauss-Jordan, k³, cap 64).  At k=64 they measure identically in the
-    production chunk scan (the kernel is issue-rate-bound, not FLOP-bound);
-    LU is the default because it extends the fused path to k=128 — one
-    direct solve instead of the blocked Schur composition.  gj kept for
-    A/B measurement (`perf_lab --reg-solve-algo` or the
-    ``CFK_REG_SOLVE_ALGO`` env var, which also flips every bench.py
-    path).  ``gauss_solve_reg_pallas`` resolves this default BEFORE its
+    """PROCESS-DEFAULT elimination algorithm for the fused reg+solve
+    kernel: ``"lu"`` (reverse-order no-pivot LU, k³/3 VPU work, rank cap
+    128) vs ``"gj"`` (Gauss-Jordan, k³, cap 64).  At k=64 they measure
+    identically in the production chunk scan (the kernel is
+    issue-rate-bound, not FLOP-bound); LU is the default because it
+    extends the fused path to k=128 — one direct solve instead of the
+    blocked Schur composition.  gj kept for A/B measurement (`perf_lab
+    --reg-solve-algo` or the ``CFK_REG_SOLVE_ALGO`` env var, which also
+    flips every bench.py path).
+
+    This is only the DEFAULT: callers that thread an explicit algorithm
+    (``ALSConfig.reg_solve_algo`` → the half-step dispatchers → the
+    ``algo=`` kwargs here) bypass it — which is how the recovery ladder's
+    GJ rung works now (``resilience.policy``; it used to ride the env
+    var).  ``gauss_solve_reg_pallas`` resolves this default BEFORE its
     jit boundary, so the concrete algorithm is part of the jit cache key
     and flipping the env var (or monkeypatching this function) between
     calls compiles the right kernel instead of silently reusing the
@@ -231,11 +237,24 @@ def default_reg_solve_algo() -> str:
     return algo
 
 
-def _fused_reg_rank_cap() -> int:
-    """Largest rank the fused reg+solve path handles with the DEFAULT
-    algorithm — what the dispatchers in ``ops.solve`` route on."""
+def resolve_reg_solve_algo(algo: str | None) -> str:
+    """The threaded elimination algorithm if given, else the process
+    default.  ``None`` and ``"auto"`` both defer (``"auto"`` is the
+    ``ALSConfig.reg_solve_algo`` spelling of "no opinion", so configs
+    stay env-var/perf_lab patchable by default)."""
+    if algo is None or algo == "auto":
+        return default_reg_solve_algo()
+    if algo not in ("lu", "gj"):
+        raise ValueError(f"reg_solve_algo must be 'lu' or 'gj', got {algo!r}")
+    return algo
+
+
+def _fused_reg_rank_cap(algo: str | None = None) -> int:
+    """Largest rank the fused reg+solve path handles with the given (or
+    default) algorithm — what the dispatchers in ``ops.solve`` route on."""
     return (
-        LU_MAX_RANK if default_reg_solve_algo() == "lu" and pltpu is not None
+        LU_MAX_RANK
+        if resolve_reg_solve_algo(algo) == "lu" and pltpu is not None
         else PALLAS_MAX_RANK
     )
 
@@ -259,13 +278,12 @@ def gauss_solve_reg_pallas(
     so callers no longer pay the [E,k,k] HBM transpose or a separate
     regularization pass.
 
-    ``algo=None`` is resolved HERE, outside the jit boundary, so the jit
-    cache key always carries the concrete 'lu'/'gj' — flipping the
-    default between calls (env var or monkeypatch) recompiles instead of
-    silently reusing the previously traced kernel.
+    ``algo=None``/``"auto"`` is resolved HERE, outside the jit boundary,
+    so the jit cache key always carries the concrete 'lu'/'gj' — flipping
+    the default between calls (env var or monkeypatch) recompiles instead
+    of silently reusing the previously traced kernel.
     """
-    if algo is None:
-        algo = default_reg_solve_algo()
+    algo = resolve_reg_solve_algo(algo)
     if algo == "lu" and pltpu is None:  # pragma: no cover - non-TPU build
         algo = "gj"
     return _gauss_solve_reg_pallas(

@@ -40,6 +40,24 @@ def resolve_overlap(overlap) -> bool:
     return default_overlap() if overlap is None else bool(overlap)
 
 
+def index_fetch(flat, cap):
+    """A ``prefetch_scan`` fetch that slices chunk ``i``'s [cap] window
+    out of a flat array — the in-kernel-gather pipelines' fetch phase.
+
+    When the neighbor gather is fused into the Gram kernels
+    (``ops.tiled`` ``in_kernel_gather``), the expensive memory phase the
+    pipeline used to hide (the [cap, k] factor gather) moves inside the
+    kernel's own DMA double buffer; what the scan prefetches is just the
+    index chunk.  Keeping the prefetch_scan structure (rather than
+    collapsing to a plain lax.scan) preserves the overlap on/off
+    bit-equality contract and keeps the slice itself off the compute
+    phase's critical path."""
+    def fetch(i):
+        return lax.dynamic_slice(flat, (i * cap,), (cap,))
+
+    return fetch
+
+
 def prefetch_scan(fetch, compute, num_chunks, init, xs=None):
     """Software-pipelined chunk scan with a one-chunk prefetch distance.
 

@@ -139,6 +139,7 @@ def ials_half_step(
     *,
     gram: jax.Array | None = None,  # precomputed YᵀY (pass psum'd under SPMD)
     solver: str = "cholesky",
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """Solve all entities of one side for implicit feedback.
 
@@ -150,7 +151,8 @@ def ials_half_step(
         gram = global_gram(fixed_factors)
     a_obs, b = gather_gram_implicit(fixed_factors, neighbor_idx, alpha * rating, mask)
     reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
-    return regularized_solve_matrix(a_obs, b, reg, solver)
+    return regularized_solve_matrix(a_obs, b, reg, solver,
+                                    algo=reg_solve_algo)
 
 
 def walk_buckets(buckets, chunk_rows, arrays_of, piece, out, overlap=None):
@@ -201,6 +203,7 @@ def ials_half_step_bucketed(
     gram: jax.Array | None = None,
     solver: str = "cholesky",
     overlap: bool | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """Implicit-feedback half-iteration over width-bucketed InBlocks.
 
@@ -215,7 +218,8 @@ def ials_half_step_bucketed(
 
     def solve_piece(ni, rt, mk):
         a_obs, b = gather_gram_implicit(fixed_factors, ni, alpha * rt, mk)
-        return regularized_solve_matrix(a_obs, b, gram + reg, solver)
+        return regularized_solve_matrix(a_obs, b, gram + reg, solver,
+                                        algo=reg_solve_algo)
 
     out = walk_buckets(
         buckets, chunk_rows,
@@ -336,6 +340,7 @@ def resolve_fused_epilogue(fused) -> bool:
 def regularized_solve(
     a: jax.Array, b: jax.Array, count: jax.Array, lam: float,
     solver: str = "cholesky", fused: bool | None = None,
+    algo: str | None = None,
 ) -> jax.Array:
     """Apply ALS-WR regularization λ·n_ratings·I and solve.
 
@@ -349,7 +354,9 @@ def regularized_solve(
     the whole Gram batch through HBM every chunk (round-3 profile).
     ``fused=False`` (or the process default off) pins the split
     ridge-add + dispatch schedule — the measurement baseline of
-    ``bench.py --fused-ab``.
+    ``bench.py --fused-ab``.  ``algo`` threads the fused elimination
+    choice ('lu'/'gj'; None/'auto' = the process default) — the knob the
+    recovery ladder's GJ rung flips (``ALSConfig.reg_solve_algo``).
     """
     from cfk_tpu.ops.pallas import gauss_solve_reg_pallas
     from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
@@ -357,7 +364,7 @@ def regularized_solve(
     k = a.shape[-1]
     if (resolve_fused_epilogue(fused)
             and _resolve_solver(solver) == "pallas"
-            and k <= _fused_reg_rank_cap()):
+            and k <= _fused_reg_rank_cap(algo)):
         # The fused kernel bakes λ in as a compile-time constant; a traced
         # lam (e.g. a per-step tuned regularizer) cannot concretize, so it
         # takes the unfused path below — same math, one extra HBM pass —
@@ -370,7 +377,7 @@ def regularized_solve(
             lam_static = None
         if lam_static is not None:
             return gauss_solve_reg_pallas(
-                a, b, count, reg_mode="diag", lam=lam_static
+                a, b, count, reg_mode="diag", lam=lam_static, algo=algo
             )
     reg = lam * jnp.maximum(count.astype(jnp.float32), 1.0)
     a = a + reg[:, None, None] * jnp.eye(k, dtype=a.dtype)
@@ -379,14 +386,15 @@ def regularized_solve(
 
 def regularized_solve_matrix(
     a: jax.Array, b: jax.Array, reg: jax.Array, solver: str = "cholesky",
-    fused: bool | None = None,
+    fused: bool | None = None, algo: str | None = None,
 ) -> jax.Array:
     """Solve (A_e + R) x_e = b_e with one shared [k,k] SPD term R.
 
     The iALS half-steps' per-entity systems all add the same global
     YᵀY + λI (Hu et al. 2008); fusing the add into the pallas solve skips
     an [E,k,k] HBM rewrite per chunk, exactly like ``regularized_solve``
-    (and like it, ``fused=False`` pins the split schedule for A/B runs).
+    (and like it, ``fused=False`` pins the split schedule for A/B runs
+    and ``algo`` threads the elimination choice).
     """
     from cfk_tpu.ops.pallas import gauss_solve_reg_pallas
     from cfk_tpu.ops.pallas.solve_kernel import _fused_reg_rank_cap
@@ -394,8 +402,8 @@ def regularized_solve_matrix(
     k = a.shape[-1]
     if (resolve_fused_epilogue(fused)
             and _resolve_solver(solver) == "pallas"
-            and k <= _fused_reg_rank_cap()):
-        return gauss_solve_reg_pallas(a, b, reg, reg_mode="matrix")
+            and k <= _fused_reg_rank_cap(algo)):
+        return gauss_solve_reg_pallas(a, b, reg, reg_mode="matrix", algo=algo)
     return dispatch_spd_solve(a + reg[None], b, solver)
 
 
@@ -422,9 +430,10 @@ def _solve_chunk(
     mask: jax.Array,
     count: jax.Array,
     solver: str = "cholesky",
+    algo: str | None = None,
 ) -> jax.Array:
     a, b = gather_gram(fixed_factors, neighbor_idx, rating, mask)
-    return regularized_solve(a, b, count, lam, solver)
+    return regularized_solve(a, b, count, lam, solver, algo=algo)
 
 
 def als_half_step(
@@ -438,6 +447,7 @@ def als_half_step(
     solve_chunk: Optional[int] = None,
     solver: str = "cholesky",
     overlap: bool | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """One ALS half-iteration: solve all [E] entities against fixed factors.
 
@@ -449,7 +459,8 @@ def als_half_step(
     """
     if solve_chunk is None or solve_chunk >= neighbor_idx.shape[0]:
         return _solve_chunk(
-            fixed_factors, lam, neighbor_idx, rating, mask, count, solver
+            fixed_factors, lam, neighbor_idx, rating, mask, count, solver,
+            reg_solve_algo,
         )
     from cfk_tpu.ops.pipeline import chunk_map
 
@@ -462,7 +473,7 @@ def als_half_step(
     reshape = lambda x: x.reshape((n_chunks, solve_chunk) + x.shape[1:])
     out = chunk_map(
         lambda ni, r, m, c: _solve_chunk(fixed_factors, lam, ni, r, m, c,
-                                         solver),
+                                         solver, reg_solve_algo),
         (reshape(neighbor_idx), reshape(rating), reshape(mask),
          reshape(count)),
         n_chunks, overlap=overlap,
@@ -617,6 +628,7 @@ def als_half_step_segment(
     statics: tuple[int, int, int],
     solver: str = "cholesky",
     gram_backend: str | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """One explicit ALS-WR half-iteration over the packed segment layout.
 
@@ -636,7 +648,8 @@ def als_half_step_segment(
         )
 
     def solve_rows(a, b, cnt_c):
-        return regularized_solve(a, b, cnt_c, lam, solver)
+        return regularized_solve(a, b, cnt_c, lam, solver,
+                                 algo=reg_solve_algo)
 
     return _segment_scan(
         fixed_factors, chunk_gram, solve_rows,
@@ -664,6 +677,7 @@ def ials_half_step_segment(
     gram: jax.Array | None = None,  # precomputed YᵀY (pass psum'd under SPMD)
     solver: str = "cholesky",
     gram_backend: str | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """Implicit-feedback half-iteration over the packed segment layout.
 
@@ -688,7 +702,8 @@ def ials_half_step_segment(
         )
 
     def solve_rows(a_obs, b, _cnt):
-        return regularized_solve_matrix(a_obs, b, reg, solver)
+        return regularized_solve_matrix(a_obs, b, reg, solver,
+                                        algo=reg_solve_algo)
 
     return _segment_scan(
         fixed_factors, chunk_gram, solve_rows,
@@ -757,6 +772,7 @@ def als_half_step_bucketed(
     *,
     solver: str = "cholesky",
     overlap: bool | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """One ALS half-iteration over width-bucketed InBlocks.
 
@@ -774,7 +790,7 @@ def als_half_step_bucketed(
             blk["neighbor"], blk["rating"], blk["mask"], blk["count"]
         ),
         lambda ni, rt, mk, cnt: _solve_chunk(
-            fixed_factors, lam, ni, rt, mk, cnt, solver
+            fixed_factors, lam, ni, rt, mk, cnt, solver, reg_solve_algo
         ),
         jnp.zeros((local_entities + 1, k), jnp.float32),
         overlap=overlap,

@@ -55,6 +55,54 @@ _GZ_HOISTED_BUDGET_BYTES = 2 << 30  # accum-mode hoisted gather windows:
 # [E+1, k, k] accumulator and the per-chunk dynamic_slice path takes over
 
 
+def default_in_kernel_gather() -> bool:
+    """Process-wide default for the in-kernel neighbor gather: fuse the
+    per-chunk neighbor-factor gather into the Pallas Gram kernels (the
+    ``*_gather_pallas`` variants DMA the indexed table rows straight into
+    VMEM), retiring the materialized [C, k] gathered stream — the largest
+    measured roofline gap in BENCH_r05 (``vs_gather_roofline``
+    1.88–9.94×).  True = gather in-kernel wherever the gates allow
+    (``resolve_gather_mode``).  Patchable for A/B measurement
+    (``scripts/perf_lab.py --gather xla``, ``bench.py --gather-ab``)
+    exactly like ``default_tiled_gram_backend``; per-call
+    ``in_kernel_gather=`` and ``ALSConfig.in_kernel_gather`` override it
+    explicitly."""
+    return True
+
+
+def resolve_in_kernel_gather(in_kernel_gather) -> bool:
+    """Per-call override if given, else the process default."""
+    if in_kernel_gather is None:
+        return default_in_kernel_gather()
+    return bool(in_kernel_gather)
+
+
+def resolve_gather_mode(in_kernel_gather, backend, stage, entries,
+                        meta_words, tile_rows, num_segments, k,
+                        block_rows=None) -> str:
+    """Static gating of the in-kernel gather: ``"fused"`` (the kernel DMAs
+    the indexed rows itself) or ``"xla"`` (the materialized-stream
+    schedule).  Gates: the knob, the pallas Gram backend (the XLA A/B
+    backend has no kernel to gather inside), production stage only (the
+    decompose probes time the XLA gather as its own phase), the kernels'
+    SMEM/alignment support gate, and the same resident-output VMEM cap
+    the split kernels fall back on.  A refused shape keeps the XLA-gather
+    path — same math via the same emulation twins, so the two modes stay
+    bit-identical (tests/test_in_kernel_gather.py)."""
+    if stage != "full" or backend != "pallas":
+        return "xla"
+    if not resolve_in_kernel_gather(in_kernel_gather):
+        return "xla"
+    if 2 * num_segments * k * (k + 1) * 4 > (96 << 20):
+        return "xla"  # mirrors _entity_gram_chunk's resident-output cap
+    from cfk_tpu.ops.pallas.gram_kernel import in_kernel_gather_supported
+
+    if not in_kernel_gather_supported(entries, meta_words, tile_rows,
+                                      block_rows):
+        return "xla"
+    return "fused"
+
+
 def default_tiled_gram_backend() -> str:
     """Tile-Gram backend: the fused pallas grouped-Gram kernel.
 
@@ -73,7 +121,7 @@ def default_tiled_gram_backend() -> str:
 def _entity_gram_chunk(
     fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, backend,
     unit_weights=False, zero_appended=False, carry=None, stage="full",
-    pregathered=None,
+    pregathered=None, gather="xla",
 ):
     """One chunk's per-entity Gram/RHS: (A [num_segments, k, k], b [.., k]).
 
@@ -104,10 +152,26 @@ def _entity_gram_chunk(
     (``ops.pipeline.prefetch_scan``); the weight multiply and everything
     downstream run here unchanged, so the pipelined result is bit-equal to
     the in-body gather.
+
+    ``gather="fused"`` (gated upstream by ``resolve_gather_mode``;
+    stage="full" + pallas backend only) retires the materialized stream
+    entirely: ``fixed_slice`` must then be the RAW table (no zero row)
+    and ``nb`` indexes it with ``table_rows`` as the virtual zero row;
+    the kernel DMAs the rows itself and applies ``wt`` in-register —
+    which is also what realizes the padding zero row, so ``wt`` (the 0/1
+    mask for the unit-weight path, √aw·mask for iALS) is consumed even
+    when ``unit_weights=True``.
     """
     k = fixed_slice.shape[-1]
     g = _gathered_stream(fixed_slice, nb, wt, unit_weights, zero_appended,
-                         pregathered)
+                         pregathered, gather=gather)
+    if g is None:  # gather == "fused": the kernel DMAs the rows itself
+        from cfk_tpu.ops.pallas.gram_kernel import gram_tiles_gather_pallas
+
+        return gram_tiles_gather_pallas(
+            fixed_slice, nb, wt, rt, seg, num_segments=num_segments,
+            tile_rows=tile_rows, carry=carry,
+        )
     ct, prec = _gram_compute_dtype(fixed_slice)
     if stage == "gather":
         # Measurement probe (``tiled_half_step(stage=...)``): stop after
@@ -160,10 +224,18 @@ def _entity_gram_chunk(
 
 
 def _gathered_stream(fixed_slice, nb, wt, unit_weights, zero_appended,
-                     pregathered):
+                     pregathered, gather="xla"):
     """The gather prologue both chunk-Gram entries share: fetch the chunk's
     neighbor factors (or accept the pipeline-prefetched stream) and apply
-    the sqrt-reparameterized weight — see ``_entity_gram_chunk``."""
+    the sqrt-reparameterized weight — see ``_entity_gram_chunk``.
+
+    ``gather="fused"`` returns None: there is no host-side stream to
+    build — the gather-fused Pallas kernels DMA the indexed table rows
+    into VMEM themselves (``ops.pallas.gram_kernel`` ``*_gather_pallas``)
+    and apply the premultiply in-register; chunk bodies pass the index
+    (and weight) chunks through instead of gathered rows."""
+    if gather == "fused":
+        return None
     k = fixed_slice.shape[-1]
     ct, _ = _gram_compute_dtype(fixed_slice)
     if pregathered is not None:
@@ -191,7 +263,7 @@ def _gathered_stream(fixed_slice, nb, wt, unit_weights, zero_appended,
 def _entity_gram_solve_chunk(
     fixed_slice, nb, wt, rt, seg, tile_rows, num_segments, lseg, reg,
     reg_mode, lam, unit_weights=False, zero_appended=False, carry=None,
-    pregathered=None,
+    pregathered=None, gather="xla", algo=None,
 ):
     """Fused-epilogue twin of ``_entity_gram_chunk`` + the per-chunk solve.
 
@@ -204,14 +276,28 @@ def _entity_gram_solve_chunk(
     ``lseg`` — exactly the ``a[lseg]``/``b[lseg]`` rows the split scan
     extracts.  Callers gate on ``resolve_fused_chunk_lam`` first (pallas
     backend + pallas solver + rank within the fused elimination cap).
+
+    ``gather="fused"`` additionally keeps the [C, k] neighbor stream out
+    of HBM (``gram_solve_tiles_gather_pallas`` — in-kernel DMA gather;
+    see ``_entity_gram_chunk``); ``algo`` threads the elimination choice.
     """
-    from cfk_tpu.ops.pallas.gram_kernel import gram_solve_tiles_pallas
+    from cfk_tpu.ops.pallas.gram_kernel import (
+        gram_solve_tiles_gather_pallas,
+        gram_solve_tiles_pallas,
+    )
 
     g = _gathered_stream(fixed_slice, nb, wt, unit_weights, zero_appended,
-                         pregathered)
+                         pregathered, gather=gather)
+    if g is None:  # gather == "fused"
+        return gram_solve_tiles_gather_pallas(
+            fixed_slice, nb, wt, rt, seg, reg, lseg,
+            num_segments=num_segments, tile_rows=tile_rows,
+            reg_mode=reg_mode, lam=lam, carry=carry, algo=algo,
+        )
     return gram_solve_tiles_pallas(
         g, rt, seg, reg, lseg, num_segments=num_segments,
         tile_rows=tile_rows, reg_mode=reg_mode, lam=lam, carry=carry,
+        algo=algo,
     )
 
 
@@ -227,7 +313,7 @@ def _chunk_reg(cnt_c, implicit_reg):
 
 
 def resolve_fused_chunk_lam(fused_epilogue, solver, k, num_segments,
-                            backend, lam, implicit):
+                            backend, lam, implicit, algo=None):
     """Static gating of the fused Gram+solve chunk path.
 
     Returns the concretized λ (0.0 for the implicit/matrix mode, whose λ
@@ -236,10 +322,11 @@ def resolve_fused_chunk_lam(fused_epilogue, solver, k, num_segments,
     the per-call/config/process fused knob, the pallas Gram backend (the
     XLA A/B backend has no VMEM residency to exploit), the pallas solver
     (cholesky callers asked for XLA's solve — honoring that means
-    splitting), the fused elimination's rank/VMEM caps, and a
-    concretizable λ (the kernel bakes it in as a compile-time constant;
-    a traced per-step λ falls back to the split path's unfused solve,
-    same math).
+    splitting), the fused elimination's rank/VMEM caps (for the
+    elimination ``algo`` the caller threads — GJ caps at 64 where LU
+    reaches 128), and a concretizable λ (the kernel bakes it in as a
+    compile-time constant; a traced per-step λ falls back to the split
+    path's unfused solve, same math).
     """
     from cfk_tpu.ops.solve import _resolve_solver, resolve_fused_epilogue
 
@@ -249,7 +336,7 @@ def resolve_fused_chunk_lam(fused_epilogue, solver, k, num_segments,
         return None
     from cfk_tpu.ops.pallas.gram_kernel import fused_gram_solve_supported
 
-    if not fused_gram_solve_supported(num_segments, k):
+    if not fused_gram_solve_supported(num_segments, k, algo):
         return None
     if implicit:
         return 0.0
@@ -262,7 +349,7 @@ def resolve_fused_chunk_lam(fused_epilogue, solver, k, num_segments,
 def tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, *,
     solver="cholesky", implicit_reg=None, stage="full", overlap=None,
-    fused_epilogue=None,
+    fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
 ):
     """Mode dispatch shared by the single-device and SPMD trainers.
 
@@ -287,6 +374,7 @@ def tiled_half_step(
             blk["count"], local_entities, lam,
             statics=st, solver=solver, implicit_reg=implicit_reg,
             stage=stage, overlap=overlap, fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
         )
     if mode == "dstream":
         return als_half_step_tiled_dense(
@@ -296,6 +384,7 @@ def tiled_half_step(
             statics=st, solver=solver, implicit_reg=implicit_reg,
             aweight_dense=blk.get("aweight_dense"), stage=stage,
             overlap=overlap, fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
         )
     return als_half_step_tiled(
         fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
@@ -303,6 +392,7 @@ def tiled_half_step(
         blk["carry_in"], blk["last_seg"], local_entities, lam,
         statics=st, solver=solver, implicit_reg=implicit_reg, stage=stage,
         overlap=overlap, fused_epilogue=fused_epilogue,
+        in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
     )
 
 
@@ -313,7 +403,7 @@ _SQRT_WEIGHT_EPS = 1e-12  # clamp for α·r = 0 entries: their A-term becomes
 def ials_tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, alpha, *,
     gram=None, solver="cholesky", stage="full", overlap=None,
-    fused_epilogue=None,
+    fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
 ):
     """Implicit-feedback (Hu et al. 2008) half-iteration on tiled blocks.
 
@@ -366,12 +456,20 @@ def ials_tiled_half_step(
             fixed_factors, blk, chunks, local_entities, lam,
             solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
             fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
         )
-    blk["rating"], blk["weight"] = rt_scaled, aw_tile
+    # The ε-clamped √aw is re-masked by the original 0/1 weight channel:
+    # at valid entries ×1.0 is exact, and at padding the XLA path's
+    # zero-row gather made ×√ε indistinguishable from ×0 anyway (0·√ε =
+    # 0·0 = 0, bit-equal) — but the in-kernel gather path uses this
+    # weight AS the padding mask (the DMA'd rows are clamped table rows,
+    # not zeros), so the mask must survive the reparameterization.
+    blk["rating"], blk["weight"] = rt_scaled, aw_tile * blk["weight"]
     return tiled_half_step(
         fixed_factors, blk, chunks, local_entities, lam,
         solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
         fused_epilogue=fused_epilogue,
+        in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
     )
 
 
@@ -395,6 +493,8 @@ def als_half_step_tiled(
     stage: str = "full",
     overlap: bool | None = None,
     fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """Stream-mode tiled half-iteration (the many-entities side).
 
@@ -418,6 +518,14 @@ def als_half_step_tiled(
     INSIDE the Gram kernel's VMEM residency: the per-chunk [Ec, k, k]
     A-batch never round-trips through HBM, and the scan body consumes
     (x, carry) straight from the fused kernel.
+
+    ``in_kernel_gather`` (default: on wherever legal — see
+    ``resolve_gather_mode``) additionally retires the materialized [C, k]
+    neighbor stream: the chunk bodies pass the index/weight chunks and
+    the kernel DMAs the table rows itself; with overlap the pipelines
+    then prefetch the INDEX chunk instead of the gathered one (the
+    double-buffering moves inside the kernel).  Factors are bit-identical
+    across the knob (tests/test_in_kernel_gather.py).
     """
     backend = gram_backend or default_tiled_gram_backend()
     overlap = resolve_overlap(overlap)
@@ -427,8 +535,11 @@ def als_half_step_tiled(
     fused_lam = (
         resolve_fused_chunk_lam(
             fused_epilogue, solver, k, e_c + 1, backend, lam,
-            implicit_reg is not None,
+            implicit_reg is not None, reg_solve_algo,
         ) if stage == "full" else None
+    )
+    gather = resolve_gather_mode(
+        in_kernel_gather, backend, stage, cap, nt + 1, t, e_c + 1, k,
     )
     chunks = (
         neighbor_idx.reshape(nc, cap), rating.reshape(nc, cap),
@@ -477,9 +588,10 @@ def als_half_step_tiled(
         # elimination algorithm under the baseline.
         if implicit_reg is None:
             return regularized_solve(a, b, _chunk_reg(cnt_c, None), lam,
-                                     solver, fused=True)
+                                     solver, fused=True,
+                                     algo=reg_solve_algo)
         return regularized_solve_matrix(a, b, implicit_reg, solver,
-                                        fused=True)
+                                        fused=True, algo=reg_solve_algo)
 
     def body(carry, chunk):
         a0, b0 = carry
@@ -500,11 +612,13 @@ def als_half_step_tiled(
                 _chunk_reg(cnt_c, implicit_reg),
                 "diag" if implicit_reg is None else "matrix", fused_lam,
                 unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+                gather=gather, algo=reg_solve_algo,
             )
             return (a1, b1), x[:e_c]
         a, b = _entity_gram_chunk(
             fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
             unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+            gather=gather,
         )
         x = solve_chunk_rows(a, b, cnt_c)
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
@@ -527,35 +641,47 @@ def als_half_step_tiled(
         # Double-buffered: the [cap, k] gather for chunk c+1 is issued
         # before chunk c's Gram/solve; the zero row is appended to the
         # fixed table ONCE (the serial body re-concatenates per chunk —
-        # same values either way).
+        # same values either way).  With the in-kernel gather the
+        # pipeline prefetches the INDEX chunk instead — the gather itself
+        # (and its double-buffering) now lives inside the kernel, so the
+        # fetch is one cheap dynamic_slice and on/off stay bit-equal by
+        # construction.
         ct, _ = _gram_compute_dtype(fixed_factors)
-        fz = jnp.concatenate([
-            fixed_factors,
-            _match_varying(
-                jnp.zeros((k,), fixed_factors.dtype)[None], fixed_factors
-            ),
-        ])
+        if gather == "fused":
+            from cfk_tpu.ops.pipeline import index_fetch
 
-        def fetch(i):
-            nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
-            return fz[nb_c].astype(ct)
+            fetch = index_fetch(neighbor_idx, cap)
+        else:
+            fz = jnp.concatenate([
+                fixed_factors,
+                _match_varying(
+                    jnp.zeros((k,), fixed_factors.dtype)[None], fixed_factors
+                ),
+            ])
 
-        def compute(carry, g_cur, x, _i):
+            def fetch(i):
+                nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
+                return fz[nb_c].astype(ct)
+
+        def compute(carry, buf, x, _i):
             a0, b0 = carry
             rt_c, wt_c, ts_c, cnt_c, cin_c, lseg_c = x
+            nb_c = buf if gather == "fused" else None
+            g_cur = None if gather == "fused" else buf
             if fused_lam is not None:
                 x_rows, a1, b1 = _entity_gram_solve_chunk(
-                    fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1,
+                    fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
                     lseg_c, _chunk_reg(cnt_c, implicit_reg),
                     "diag" if implicit_reg is None else "matrix", fused_lam,
                     unit_weights=implicit_reg is None,
-                    carry=(a0, b0, cin_c), pregathered=g_cur,
+                    carry=(a0, b0, cin_c), pregathered=g_cur, gather=gather,
+                    algo=reg_solve_algo,
                 )
                 return (a1, b1), x_rows[:e_c]
             a, b = _entity_gram_chunk(
-                fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
                 unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
-                pregathered=g_cur,
+                pregathered=g_cur, gather=gather,
             )
             x_rows = solve_chunk_rows(a, b, cnt_c)
             a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
@@ -596,6 +722,8 @@ def als_half_step_tiled_dense(
     stage: str = "full",
     overlap: bool | None = None,
     fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """Dense-stream tiled half-iteration (the many-entities side, unpadded).
 
@@ -623,14 +751,23 @@ def als_half_step_tiled_dense(
     fused_lam = (
         resolve_fused_chunk_lam(
             fused_epilogue, solver, k, e_c + 1, backend, lam,
-            implicit_reg is not None,
+            implicit_reg is not None, reg_solve_algo,
         ) if stage == "full" else None
     )
+    gather = resolve_gather_mode(
+        in_kernel_gather, backend, stage, cap, ng + 4 * nt + 1, t,
+        e_c + 1, k, block_rows=bg,
+    )
     ct, _ = _gram_compute_dtype(fixed_factors)
-    fz = jnp.concatenate([
-        fixed_factors,
-        _match_varying(jnp.zeros((1, k), fixed_factors.dtype), fixed_factors),
-    ])
+    if gather != "fused" or stage != "full":
+        # The zero-row-appended table only exists for the XLA-gather
+        # schedule; the in-kernel gather realizes the zero row in-register
+        # (clamp + window mask) and never builds this copy.
+        fz = jnp.concatenate([
+            fixed_factors,
+            _match_varying(jnp.zeros((1, k), fixed_factors.dtype),
+                           fixed_factors),
+        ])
     chunks = (
         neighbor_idx.reshape(nc, cap), rating.reshape(nc, nt * t),
         tile_meta.reshape(nc, ng + 4 * nt), last_seg.reshape(nc),
@@ -668,39 +805,67 @@ def als_half_step_tiled_dense(
         (acc, _, _), _ = lax.scan(probe, init, chunks)
         return acc.reshape(1, 1)
 
-    def gram_solve(carry, g, x):
+    def gram_solve(carry, g, x, nb_c=None):
+        # ``g`` is the gathered stream on the XLA-gather schedule; with
+        # the in-kernel gather it is None and ``nb_c`` carries the index
+        # chunk instead — the kernel DMAs the rows and applies the √aw
+        # premultiply (the stream-aligned weight channel) in-register.
         a0, b0 = carry
         rt_c, meta_c, lseg_c, cin_c, cnt_c = x[:5]
-        if implicit_reg is not None:  # sqrt-weighted single stream
-            g = g * x[5].astype(ct)[:, None]
+        wt_c = x[5] if implicit_reg is not None else None
+        if gather != "fused" and wt_c is not None:
+            g = g * wt_c.astype(ct)[:, None]  # sqrt-weighted single stream
         if fused_lam is not None:
             # Fused epilogue: the dense kernel solves its VMEM-resident
             # (A, b) in place — no [Ec, k, k] HBM round-trip per chunk.
             from cfk_tpu.ops.pallas.gram_kernel import (
+                gram_solve_tiles_dense_gather_pallas,
                 gram_solve_tiles_dense_pallas,
             )
 
-            x_rows, a1, b1 = gram_solve_tiles_dense_pallas(
-                g, rt_c, meta_c, _chunk_reg(cnt_c, implicit_reg), lseg_c,
-                num_segments=e_c + 1,
-                tile_rows=t, num_tiles=nt, num_groups=ng, block_rows=bg,
+            reg_kw = dict(
+                num_segments=e_c + 1, tile_rows=t, num_tiles=nt,
+                num_groups=ng, block_rows=bg,
                 reg_mode="diag" if implicit_reg is None else "matrix",
-                lam=fused_lam, carry=(a0, b0, cin_c),
+                lam=fused_lam, carry=(a0, b0, cin_c), algo=reg_solve_algo,
             )
+            if gather == "fused":
+                x_rows, a1, b1 = gram_solve_tiles_dense_gather_pallas(
+                    fixed_factors, nb_c, wt_c, rt_c, meta_c,
+                    _chunk_reg(cnt_c, implicit_reg), lseg_c, **reg_kw,
+                )
+            else:
+                x_rows, a1, b1 = gram_solve_tiles_dense_pallas(
+                    g, rt_c, meta_c, _chunk_reg(cnt_c, implicit_reg),
+                    lseg_c, **reg_kw,
+                )
             return (a1, b1), x_rows[:e_c]
-        a, b = gram_tiles_dense_pallas_dispatch(
-            g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
-            num_tiles=nt, num_groups=ng, block_rows=bg,
-            carry=(a0, b0, cin_c), backend=backend,
-        )
+        if gather == "fused":
+            from cfk_tpu.ops.pallas.gram_kernel import (
+                gram_tiles_dense_gather_pallas,
+            )
+
+            a, b = gram_tiles_dense_gather_pallas(
+                fixed_factors, nb_c, wt_c, rt_c, meta_c,
+                num_segments=e_c + 1, tile_rows=t, num_tiles=nt,
+                num_groups=ng, block_rows=bg, carry=(a0, b0, cin_c),
+            )
+        else:
+            a, b = gram_tiles_dense_pallas_dispatch(
+                g, rt_c, meta_c, num_segments=e_c + 1, tile_rows=t,
+                num_tiles=nt, num_groups=ng, block_rows=bg,
+                carry=(a0, b0, cin_c), backend=backend,
+            )
         # fused=True: same rationale as the stream body's solve_chunk_rows
         # — the A/B axis is the round-trip, not the reg+solve fusion.
         if implicit_reg is None:
             x_rows = regularized_solve(a, b, _chunk_reg(cnt_c, None), lam,
-                                       solver, fused=True)
+                                       solver, fused=True,
+                                       algo=reg_solve_algo)
         else:
             x_rows = regularized_solve_matrix(a, b, implicit_reg, solver,
-                                              fused=True)
+                                              fused=True,
+                                              algo=reg_solve_algo)
         a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
         b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
         return (a1, b1), x_rows[:e_c]
@@ -716,15 +881,31 @@ def als_half_step_tiled_dense(
         # Double-buffered: chunk c+1's dense gather (the iteration's
         # binding resource — see the layout rationale above) is issued
         # before chunk c's Gram/solve; the √aw premultiply stays at
-        # compute time so the fetch is a pure gather.
-        def fetch(i):
-            nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
-            return fz[nb_c].astype(ct)
+        # compute time so the fetch is a pure gather.  With the in-kernel
+        # gather the pipeline prefetches the index chunk instead — the
+        # gather (and its double buffer) lives inside the kernel.
+        if gather == "fused":
+            from cfk_tpu.ops.pipeline import index_fetch
 
-        _, xs = prefetch_scan(
-            fetch,
-            lambda carry, g, x, _i: gram_solve(carry, g, x),
-            nc, init, xs=chunks[1:],
+            fetch = index_fetch(neighbor_idx, cap)
+
+            def compute(carry, buf, x, _i):
+                return gram_solve(carry, None, x, nb_c=buf)
+        else:
+            def fetch(i):
+                nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
+                return fz[nb_c].astype(ct)
+
+            def compute(carry, buf, x, _i):
+                return gram_solve(carry, buf, x)
+
+        _, xs = prefetch_scan(fetch, compute, nc, init, xs=chunks[1:])
+    elif gather == "fused":
+        _, xs = lax.scan(
+            lambda carry, chunk: gram_solve(
+                carry, None, chunk[1:], nb_c=chunk[0]
+            ),
+            init, chunks,
         )
     else:
         _, xs = lax.scan(
@@ -772,6 +953,8 @@ def als_half_step_tiled_accum(
     stage: str = "full",
     overlap: bool | None = None,
     fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """Accumulator-mode tiled half-iteration (the few-entities side).
 
@@ -794,12 +977,24 @@ def als_half_step_tiled_accum(
 
     ``overlap`` double-buffers the chunk scan: chunk c+1's window select +
     gather is issued before chunk c's Gram + accumulator scatter-add.
+
+    ``in_kernel_gather`` (default on where legal) retires accum mode's
+    whole window machinery for the production stage: slice-local indices
+    are rebased to ABSOLUTE table rows (a cheap [C] int32 map — the
+    clamped window base comes along as data) and the gather-fused kernel
+    DMAs the rows straight from the full table, so neither the hoisted
+    duplicate window stack (``gz``, a second resident copy of the fixed
+    table) nor the per-chunk window copy is built — in-kernel DMA has no
+    analog of XLA's operand-size gather cliff that forced them.
     """
     backend = gram_backend or default_tiled_gram_backend()
     overlap = resolve_overlap(overlap)
     nc, cap, t, h, e_c = statics
     k = fixed_factors.shape[-1]
     nt = cap // t
+    gather = resolve_gather_mode(
+        in_kernel_gather, backend, stage, cap, nt, t, e_c + 1, k,
+    )
     chunks = (
         neighbor_idx.reshape(nc, cap), rating.reshape(nc, cap),
         weight.reshape(nc, cap), tile_seg.reshape(nc, nt),
@@ -822,17 +1017,21 @@ def als_half_step_tiled_accum(
     f_rows = fixed_factors.shape[0]
     n_slices = max(1, -(-f_rows // h))
     bases = [min(s * h, max(f_rows - h, 0)) for s in range(n_slices)]
-    zrow = _match_varying(
-        jnp.zeros((1, k), fixed_factors.dtype), fixed_factors
-    )
     # The hoisted window stack is a second resident copy of the fixed
     # table (~61 MB bf16 at full Netflix — fine next to the ~290 MB
     # accumulator).  On corpora where it would stop being a rounding
     # error (> _GZ_HOISTED_BUDGET_BYTES), degrade to the per-chunk
     # dynamic_slice + concat path instead of OOMing: same math, pays the
     # in-body slice copy the hoist was measured to save (~25 ms/iter).
+    # The in-kernel gather (gather == "fused", production stage) never
+    # builds the windows at all — absolute indices go straight to the
+    # kernel's DMA, which has no operand-size gather cliff to dodge.
     gz_bytes = n_slices * (h + 1) * k * fixed_factors.dtype.itemsize
-    hoist = gz_bytes <= _GZ_HOISTED_BUDGET_BYTES
+    hoist = gz_bytes <= _GZ_HOISTED_BUDGET_BYTES and gather != "fused"
+    if gather != "fused":
+        zrow = _match_varying(
+            jnp.zeros((1, k), fixed_factors.dtype), fixed_factors
+        )
     if hoist:
         gz = jnp.stack([
             jnp.concatenate([
@@ -843,6 +1042,13 @@ def als_half_step_tiled_accum(
     bases_arr = _match_varying(
         jnp.asarray(bases, jnp.int32), fixed_factors
     )
+
+    def abs_idx(nb_c, base_c):
+        # Slice-local → absolute (gather == "fused"): valid rows offset
+        # by the chunk's clamped window base; the slice-local zero row
+        # (index h) maps to the table-level virtual zero row (index F)
+        # the gather kernels realize in-register.
+        return jnp.where(nb_c < h, base_c + nb_c, f_rows)
 
     def select_window(base_c):
         if hoist:
@@ -911,11 +1117,18 @@ def als_half_step_tiled_accum(
 
     def body(carry, chunk):
         nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
-        fixed_slice = select_window(base_c)
-        a, b = _entity_gram_chunk(
-            fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-            unit_weights=implicit_reg is None, zero_appended=True,
-        )
+        if gather == "fused":
+            a, b = _entity_gram_chunk(
+                fixed_factors, abs_idx(nb_c, base_c), wt_c, rt_c, ts_c, t,
+                e_c + 1, backend, unit_weights=implicit_reg is None,
+                gather=gather,
+            )
+        else:
+            fixed_slice = select_window(base_c)
+            a, b = _entity_gram_chunk(
+                fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                unit_weights=implicit_reg is None, zero_appended=True,
+            )
         return accumulate(carry, a, b, ent_c), None
 
     init = jax.tree.map(
@@ -929,7 +1142,9 @@ def als_half_step_tiled_accum(
         # Double-buffered: chunk c+1's window select + slice-local gather
         # runs under chunk c's Gram + accumulator scatter-add.  The window
         # bases come from the raw [NC] chunk_base array so the fetch needs
-        # no chunk tuple.
+        # no chunk tuple.  With the in-kernel gather the fetch is the
+        # absolute-index rebase only (the DMA gather moved into the
+        # kernel).
         ct, _ = _gram_compute_dtype(fixed_factors)
         base_flat = chunk_base.reshape(nc)
 
@@ -938,15 +1153,24 @@ def als_half_step_tiled_accum(
                 base_flat, i, 0, keepdims=False
             )
             nb_c = lax.dynamic_slice(neighbor_idx, (i * cap,), (cap,))
+            if gather == "fused":
+                return abs_idx(nb_c, base_c)
             return select_window(base_c)[nb_c].astype(ct)
 
-        def compute(carry, g_cur, x, _i):
+        def compute(carry, buf, x, _i):
             rt_c, wt_c, ts_c, ent_c = x
-            a, b = _entity_gram_chunk(
-                fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-                unit_weights=implicit_reg is None, zero_appended=True,
-                pregathered=g_cur,
-            )
+            if gather == "fused":
+                a, b = _entity_gram_chunk(
+                    fixed_factors, buf, wt_c, rt_c, ts_c, t, e_c + 1,
+                    backend, unit_weights=implicit_reg is None,
+                    gather=gather,
+                )
+            else:
+                a, b = _entity_gram_chunk(
+                    fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1,
+                    backend, unit_weights=implicit_reg is None,
+                    zero_appended=True, pregathered=buf,
+                )
             return accumulate(carry, a, b, ent_c), None
 
         (acc_a, acc_b), _ = prefetch_scan(
@@ -965,6 +1189,7 @@ def als_half_step_tiled_accum(
     a, b = acc_a[:local_entities], acc_b[:local_entities]
     if implicit_reg is None:
         return regularized_solve(a, b, count, lam, solver,
-                                 fused=fused_epilogue)
+                                 fused=fused_epilogue, algo=reg_solve_algo)
     return regularized_solve_matrix(a, b, implicit_reg, solver,
-                                    fused=fused_epilogue)
+                                    fused=fused_epilogue,
+                                    algo=reg_solve_algo)

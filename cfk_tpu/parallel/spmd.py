@@ -132,7 +132,7 @@ def _nonfinite_flag(x):
 def half_step_ring(
     fixed_local, nb, rt, mk, cnt, *, lam, num_shards, solve_chunk=None,
     solver="cholesky", overlap=None, probe=None, fused_epilogue=None,
-    health=False,
+    health=False, reg_solve_algo=None,
 ):
     """Per-shard half-iteration accumulating Gram blocks around a ppermute ring.
 
@@ -216,7 +216,7 @@ def half_step_ring(
     # the one fused reg+solve pass over the final sums (the fused/split
     # A/B axis).
     x = regularized_solve(a + ap, b + bp, cnt, lam, solver,
-                          fused=fused_epilogue)
+                          fused=fused_epilogue, algo=reg_solve_algo)
     return (x, bad) if health else x
 
 
@@ -378,7 +378,8 @@ def _tiled_to_tree(blocks: TiledBlocks, weighted: bool = False
 def half_step_tiled_ring(
     fixed_local, blk, chunks, local_entities, *, lam, num_shards,
     solver="cholesky", gram_backend=None, overlap=None, probe=None,
-    fused_epilogue=None, health=False,
+    fused_epilogue=None, health=False, in_kernel_gather=None,
+    reg_solve_algo=None,
 ):
     """Tiled-layout half-iteration over the ppermute ring (block-to-block
     join) — the reference's headline join strategy at the at-scale layout.
@@ -399,9 +400,18 @@ def half_step_tiled_ring(
     ppermute is issued before the current block's chunk loop starts, so
     the ICI transfer hides behind the slice's Gram accumulation.
     ``probe``/``overlap``/``health`` as in ``half_step_ring``.
+
+    ``in_kernel_gather`` (default on where legal) fuses each chunk's
+    neighbor gather into the Gram kernel (``ops.tiled`` ``gather="fused"``
+    — the rotated factor block is the kernel's DMA source), which also
+    retires the per-ring-step zero-row append of the whole block.
     """
     from cfk_tpu.ops.pipeline import resolve_overlap
-    from cfk_tpu.ops.tiled import _entity_gram_chunk, default_tiled_gram_backend
+    from cfk_tpu.ops.tiled import (
+        _entity_gram_chunk,
+        default_tiled_gram_backend,
+        resolve_gather_mode,
+    )
 
     if health and probe is not None:
         raise ValueError("health probing and timing probes are exclusive")
@@ -411,6 +421,9 @@ def half_step_tiled_ring(
     s = num_shards
     nt = cap // t
     k = fixed_local.shape[-1]
+    gather = resolve_gather_mode(
+        in_kernel_gather, backend, "full", cap, nt, t, e_c + 1, k,
+    )
     my = lax.axis_index(AXIS)
     perm = [(i, (i + 1) % s) for i in range(s)]
     nb, rt, wt = blk["neighbor_idx"], blk["rating"], blk["weight"]
@@ -419,11 +432,16 @@ def half_step_tiled_ring(
 
     def slice_grams(acc, factors, t_idx):
         # One zero-row append per ring step, not per chunk (the chunk-scan
-        # body would otherwise re-copy the whole block every chunk).
-        fz = jnp.concatenate([
-            factors,
-            _match_varying(jnp.zeros((1, k), factors.dtype), factors),
-        ])
+        # body would otherwise re-copy the whole block every chunk); the
+        # in-kernel gather skips even that — the kernel DMAs from the raw
+        # rotated block and the weight channel masks the padding rows.
+        if gather == "fused":
+            fz = factors
+        else:
+            fz = jnp.concatenate([
+                factors,
+                _match_varying(jnp.zeros((1, k), factors.dtype), factors),
+            ])
 
         def chunk_body(i, acc):
             acc_a, acc_b = acc
@@ -435,7 +453,7 @@ def half_step_tiled_ring(
             a, b = _entity_gram_chunk(
                 fz, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
                 unit_weights=True,  # the ring is explicit-ALS only
-                zero_appended=True,
+                zero_appended=gather != "fused", gather=gather,
             )
             return (acc_a.at[ent_c].add(a[:e_c]), acc_b.at[ent_c].add(b[:e_c]))
 
@@ -485,6 +503,7 @@ def half_step_tiled_ring(
     x = regularized_solve(
         acc_a[:local_entities], acc_b[:local_entities],
         blk["count"], lam, solver, fused=fused_epilogue,
+        algo=reg_solve_algo,
     )
     return (x, bad) if health else x
 
@@ -679,6 +698,8 @@ def make_training_step(
                     probe=ring_probe,
                     fused_epilogue=config.fused_epilogue,
                     health=health_probe,
+                    in_kernel_gather=config.in_kernel_gather,
+                    reg_solve_algo=config.reg_solve_algo,
                 )
 
             return half
@@ -689,6 +710,8 @@ def make_training_step(
                     fixed_full, blk, chunks, local, config.lam,
                     solver=config.solver, overlap=config.overlap,
                     fused_epilogue=config.fused_epilogue,
+                    in_kernel_gather=config.in_kernel_gather,
+                    reg_solve_algo=config.reg_solve_algo,
                 )
 
             return flagged(gathered_half(solve))
@@ -713,6 +736,7 @@ def make_training_step(
                     blk["seg"], blk["entity"], blk["ecount"], blk["gsizes"],
                     blk["cin"], blk["lseg"], local,
                     config.lam, statics=statics, solver=config.solver,
+                    reg_solve_algo=config.reg_solve_algo,
                 )
 
             return solve
@@ -731,6 +755,7 @@ def make_training_step(
                 return als_half_step_bucketed(
                     fixed_full, blk, chunks, local, config.lam,
                     solver=config.solver, overlap=config.overlap,
+                    reg_solve_algo=config.reg_solve_algo,
                 )
 
             return solve
@@ -758,6 +783,7 @@ def make_training_step(
             probe=ring_probe,
             fused_epilogue=config.fused_epilogue,
             health=health_probe,
+            reg_solve_algo=config.reg_solve_algo,
         )
 
     # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI bytes
@@ -825,9 +851,13 @@ def _sharded_resilient_loop(
 
     def make_step(ov):
         cfg = config
-        if (ov.lam, ov.fused_epilogue) != (config.lam, config.fused_epilogue):
+        want = (ov.lam, ov.fused_epilogue,
+                ov.reg_solve_algo or config.reg_solve_algo)
+        if want != (config.lam, config.fused_epilogue,
+                    config.reg_solve_algo):
             cfg = _dc.replace(
-                config, lam=ov.lam, fused_epilogue=ov.fused_epilogue
+                config, lam=ov.lam, fused_epilogue=ov.fused_epilogue,
+                reg_solve_algo=ov.reg_solve_algo or config.reg_solve_algo,
             )
         step = jax.jit(make_raw_step(cfg), donate_argnums=(0, 1))
         return lambda u, m: step(u, m, mtree, utree)

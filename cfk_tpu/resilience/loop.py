@@ -124,20 +124,10 @@ def resilient_train_loop(
         start_iter = 0
         u, m = init_fn()
 
-    # The GJ escalation rung rides CFK_REG_SOLVE_ALGO (resolved at step
-    # trace time); restore the caller's value on exit so one escalated
-    # run cannot contaminate later trainings in the same process.
-    import os
-
-    _algo_env = "CFK_REG_SOLVE_ALGO"
-    _saved_algo = os.environ.get(_algo_env)
-
-    def _restore_algo_env():
-        if _saved_algo is None:
-            os.environ.pop(_algo_env, None)
-        else:
-            os.environ[_algo_env] = _saved_algo
-
+    # The GJ escalation rung is a threaded step-build parameter
+    # (Overrides.reg_solve_algo → make_step → the half-steps' algo
+    # jit-static), so escalation leaves no process state behind — the
+    # CFK_REG_SOLVE_ALGO env var save/restore dance is gone.
     overrides = base_overrides or Overrides(lam=0.0)
     step = step_fn if make_step is None else make_step(overrides)
     probe = None
@@ -147,19 +137,16 @@ def resilient_train_loop(
         probe = jax.jit(
             lambda u, m: _sentinel.probe_word(u, m, health.norm_limit)
         )
-    try:
-        return _run_loop_body(
-            manager=manager, num_iterations=num_iterations,
-            start_iter=start_iter, u=u, m=m, step=step,
-            make_step=make_step, overrides=overrides, policy=policy,
-            health=health, probe=probe, metrics=metrics,
-            checkpoint_every=checkpoint_every,
-            fault_injector=fault_injector, snapshot_fn=snapshot_fn,
-            restore_fn=restore_fn, save_fn=save_fn, state=state,
-            init_fn=init_fn,
-        )
-    finally:
-        _restore_algo_env()
+    return _run_loop_body(
+        manager=manager, num_iterations=num_iterations,
+        start_iter=start_iter, u=u, m=m, step=step,
+        make_step=make_step, overrides=overrides, policy=policy,
+        health=health, probe=probe, metrics=metrics,
+        checkpoint_every=checkpoint_every,
+        fault_injector=fault_injector, snapshot_fn=snapshot_fn,
+        restore_fn=restore_fn, save_fn=save_fn, state=state,
+        init_fn=init_fn,
+    )
 
 
 def _run_loop_body(
@@ -254,7 +241,6 @@ def _run_loop_body(
             new_overrides = policy.escalate(overrides, trips)
             if new_overrides != overrides:
                 overrides = new_overrides
-                overrides.apply_env()
                 metrics.gauge("escalation_level", trips)
                 metrics.note(
                     f"escalation_{trips}",

@@ -16,12 +16,16 @@ ladder before retrying:
      in-VMEM Gram+solve kernel steps aside for the split Gram→HBM→solve
      schedule (the simpler, longest-soaked code path), and λ stays
      bumped.
-  4. **GJ elimination** — swap the fused reg+solve kernel's reverse-LU
-     for Gauss-Jordan (``CFK_REG_SOLVE_ALGO=gj``) and bump λ once more.
-     The extra bump is not cosmetic: each rung must change a jit-static
-     so the rebuilt step re-traces and the elimination override is
-     actually picked up (``ops.pallas.solve_kernel.default_reg_solve_algo``
-     is resolved at trace time).
+  4. **GJ elimination** — swap the fused reg+solve kernels' reverse-LU
+     for Gauss-Jordan.  ``reg_solve_algo`` is a REAL threaded parameter
+     now (``ALSConfig.reg_solve_algo`` → the half-step dispatchers'
+     ``algo=`` kwargs, a jit-static), so the rebuilt step re-traces with
+     the override by construction — it no longer rides the
+     ``CFK_REG_SOLVE_ALGO`` env var, whose trace-time read made the rung
+     depend on a paired λ bump to force the re-trace (and leaked process
+     state the loop had to save/restore).  λ is still bumped here: GJ is
+     reached when the systems are badly conditioned, and the extra ridge
+     is the actual SPD repair.
 
 Rungs are cumulative, and settings stay escalated for the rest of the run
 (a run that needed λ·10 to stay SPD will need it again).  After
@@ -33,7 +37,6 @@ of crashing (``on_unrecoverable="raise"`` opts into the crash).
 from __future__ import annotations
 
 import dataclasses
-import os
 
 
 class TrainingDivergedError(RuntimeError):
@@ -48,19 +51,15 @@ class TrainingDivergedError(RuntimeError):
 class Overrides:
     """The step-build knobs one escalation rung may change.
 
-    ``reg_solve_algo`` rides the ``CFK_REG_SOLVE_ALGO`` env var (applied by
-    ``apply_env``) because the elimination choice is resolved inside the
-    kernel wrappers at trace time; the paired λ bump guarantees the
-    re-trace that makes it stick.
+    All three are threaded step-build parameters: ``make_step(Overrides)``
+    rebuilds the jitted step with them as jit-statics, so every rung
+    re-traces with its override picked up (``reg_solve_algo`` included —
+    the env-var indirection is gone).
     """
 
     lam: float
     fused_epilogue: bool | None = None
-    reg_solve_algo: str | None = None  # None = leave the process default
-
-    def apply_env(self) -> None:
-        if self.reg_solve_algo is not None:
-            os.environ["CFK_REG_SOLVE_ALGO"] = self.reg_solve_algo
+    reg_solve_algo: str | None = None  # None = leave the config/process default
 
 
 @dataclasses.dataclass(frozen=True)

@@ -116,6 +116,13 @@ def make_parser() -> argparse.ArgumentParser:
                    "solve pins the one-pass reg+solve kernel, so only the "
                    "round-trip toggles); the accum/ring final solves swap "
                    "to the split ridge-add + dispatch under 'off'")
+    p.add_argument("--gather", default="fused", choices=["fused", "xla"],
+                   help="neighbor-gather A/B axis: 'fused' (default) = "
+                   "in-kernel DMA gather (the pallas Gram kernels fetch "
+                   "the indexed factor rows themselves — no materialized "
+                   "[C, k] stream), 'xla' = the XLA gather that "
+                   "materializes the stream in HBM.  Factors are "
+                   "bit-identical across the axis")
     p.add_argument("--overlap", default="on", choices=["on", "off"],
                    help="comm/compute overlap A/B axis: 'on' (default) = "
                    "double-buffered chunk/ring pipelines "
@@ -166,29 +173,34 @@ def run_lab(args) -> dict:
         import cfk_tpu.ops.pipeline as pipeline_mod
 
         pipeline_mod.default_overlap = lambda: False
+    if args.gather == "xla":
+        import cfk_tpu.ops.tiled as tiled_mod
+
+        tiled_mod.default_in_kernel_gather = lambda: False
     if args.fused == "off":
         import cfk_tpu.ops.solve as solve_mod
 
         solve_mod.default_fused_epilogue = lambda: False
     if args.group_tiles is not None:
-        # Patch BOTH the split and the fused grouped-Gram wrappers — with
-        # --fused on (the default) the hot chunk kernel is the fused one,
-        # and a split-only patch would make this sweep axis silently inert.
+        # Patch EVERY grouped-Gram wrapper — split, fused-solve, and the
+        # gather-fused twins: with --fused and --gather on (the defaults)
+        # the hot chunk kernel is the gather-fused one, and a partial
+        # patch would make this sweep axis silently inert.
         import cfk_tpu.ops.pallas.gram_kernel as gk
 
-        _orig = gk.gram_tiles_pallas
-        _orig_fused = gk.gram_solve_tiles_pallas
+        def _with_group(fn):
+            def patched(*a, **kw):
+                kw.setdefault("group_tiles", args.group_tiles)
+                return fn(*a, **kw)
 
-        def _patched(*a, **kw):
-            kw.setdefault("group_tiles", args.group_tiles)
-            return _orig(*a, **kw)
+            return patched
 
-        def _patched_fused(*a, **kw):
-            kw.setdefault("group_tiles", args.group_tiles)
-            return _orig_fused(*a, **kw)
-
-        gk.gram_tiles_pallas = _patched
-        gk.gram_solve_tiles_pallas = _patched_fused
+        gk.gram_tiles_pallas = _with_group(gk.gram_tiles_pallas)
+        gk.gram_solve_tiles_pallas = _with_group(gk.gram_solve_tiles_pallas)
+        gk.gram_tiles_gather_pallas = _with_group(gk.gram_tiles_gather_pallas)
+        gk.gram_solve_tiles_gather_pallas = _with_group(
+            gk.gram_solve_tiles_gather_pallas
+        )
 
 
     segment = args.layout == "segment"
@@ -310,6 +322,7 @@ def run_lab(args) -> dict:
         "gram_backend": args.gram_backend, "rank": args.rank,
         "iters_per_call": args.iters, "overlap": args.overlap,
         "fused": args.fused, "health": args.health,
+        "gather": args.gather,
     }
     print(json.dumps(row))
     return row

@@ -82,9 +82,16 @@ def test_final_summary_line_fits_driver_tail():
         "factors_bit_exact": True, "removed_bytes_per_chunk": 250240,
         "layout": "tiled+all_gather",
     }
+    gather_row = {
+        "metric": "synthetic_ml25m_gather_ab_s_per_iteration",
+        "value": 0.1488, "vs_baseline": 0.9912,
+        "factors_bit_exact": True, "removed_bytes_per_chunk": 4194304,
+        "layout": "tiled+all_gather",
+    }
     rows = {
         "medium": medium, "at_scale": dict(full_row),
         "overlap_ring": overlap_row, "fused_epilogue": fused_row,
+        "gather_ab": gather_row,
         "full_rank64": dict(full_row), "full_rank128": dict(full_row),
         "ials_ml25m": dict(full_row), "ialspp_ml25m": dict(full_row),
     }
