@@ -402,7 +402,20 @@ def _train(args) -> int:
         return manager
     ck = dict(checkpoint_manager=manager, checkpoint_every=args.checkpoint_every)
 
-    with maybe_profile(args.profile_dir):
+    # Preemption tolerance is on whenever a checkpoint store exists: an
+    # eviction SIGTERM (or Ctrl-C) drains the async writer, commits one
+    # final checkpoint, and the process exits resumable — re-run the same
+    # command to continue (cfk_tpu.resilience.preempt).
+    import contextlib
+
+    guard_cm = contextlib.nullcontext(None)
+    if manager is not None and not getattr(args, "no_preempt_save", False):
+        from cfk_tpu.resilience.preempt import PreemptionGuard
+
+        guard_cm = PreemptionGuard()
+
+    with maybe_profile(args.profile_dir), guard_cm as guard:
+        ck["preemption_guard"] = guard
         if args.implicit:
             config = IALSConfig(alpha=args.alpha, **common)
             if args.shards > 1:
@@ -424,6 +437,20 @@ def _train(args) -> int:
                 )
             else:
                 model = train_als(ds, config, metrics=metrics, **ck)
+
+    if guard is not None and guard.triggered:
+        # Exit inside the platform's SIGTERM grace window: the checkpoint
+        # is committed and drained, so evaluation / ranking / the CSV dump
+        # on the partial model would only risk a SIGKILL mid-eval.  The
+        # metrics line still goes out — it carries the "preempted" note.
+        _eprint(
+            f"preempted ({guard.signal_name}): a final checkpoint was "
+            "committed — re-run this command to resume; skipping "
+            "evaluation and output for the partial run"
+        )
+        print(metrics.json_line() if args.metrics == "json"
+              else metrics.logfmt())
+        return 0
 
     # Both evals stream from the factors (never materializing U·Mᵀ), so they
     # run at scales where the dense matrix cannot exist; only the CSV dump
@@ -496,7 +523,10 @@ def _make_checkpoint_manager(args):
     if args.checkpoint_dir:
         from cfk_tpu.transport.checkpoint import CheckpointManager
 
-        return CheckpointManager(args.checkpoint_dir)
+        return CheckpointManager(
+            args.checkpoint_dir,
+            keep_last_n=getattr(args, "keep_last_n", None),
+        )
     if journal:
         from cfk_tpu.transport.journal import JournalCheckpointManager
 
@@ -955,6 +985,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument("--checkpoint-dir", default=None)
     t.add_argument("--checkpoint-every", type=int, default=1)
+    t.add_argument(
+        "--keep-last-n", type=int, default=None,
+        help="garbage-collect checkpoint steps beyond the newest N after "
+        "each save (the last verified-good step the recovery ladder "
+        "points at is always pinned); default keeps every step",
+    )
+    t.add_argument(
+        "--no-preempt-save", action="store_true",
+        help="disable the SIGTERM/SIGINT preemption guard that is armed "
+        "whenever --checkpoint-dir is set: by default an eviction signal "
+        "drains the async checkpoint writer, commits one final "
+        "checkpoint, and exits resumable instead of dying mid-iteration",
+    )
     t.add_argument(
         "--checkpoint-journal", default=None,
         help="journal factor checkpoints through the transport instead of "

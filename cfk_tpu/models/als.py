@@ -473,6 +473,8 @@ def train_als(
     checkpoint_every: int = 1,
     metrics=None,
     fault_injector=None,
+    preemption_guard=None,
+    watchdog=None,
 ) -> ALSModel:
     """Train ALS-WR on one device. Returns factors in ascending-id order.
 
@@ -488,6 +490,13 @@ def train_als(
     last good state and climbs the escalation ladder
     (``cfk_tpu.resilience``).  ``fault_injector`` (chaos testing only)
     forces the stepped loop so faults can fire at step boundaries.
+
+    ``preemption_guard``/``watchdog`` (``cfk_tpu.resilience.preempt``) arm
+    preemption tolerance: they also force the stepped loop (the fused
+    ``fori_loop`` exposes no iteration boundary to poll), which polls the
+    guard between iterations — on SIGTERM/SIGINT it drains the async
+    checkpoint writer, commits a final checkpoint, and returns resumable —
+    and ticks the watchdog per completed iteration.
     """
     from cfk_tpu.resilience.loop import validate_cadence
     from cfk_tpu.resilience.sentinel import health_from_config
@@ -525,7 +534,8 @@ def train_als(
             dataset.user_blocks.neighbor_idx.shape[1],
         )
         solve_chunk = config.padded_solve_chunk(width)
-    stepped = checkpoint_manager is not None or fault_injector is not None
+    stepped = (checkpoint_manager is not None or fault_injector is not None
+               or preemption_guard is not None or watchdog is not None)
     if not stepped:
         train_s_before = metrics.phases.get("train", 0.0)
         with metrics.phase("train"):
@@ -637,6 +647,8 @@ def train_als(
             health=health,
             policy=policy_from_config(config),
             fault_injector=fault_injector,
+            preemption_guard=preemption_guard,
+            watchdog=watchdog,
         )
     return ALSModel(
         user_factors=u,

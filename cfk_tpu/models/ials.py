@@ -269,6 +269,8 @@ def train_ials(
     checkpoint_every: int = 1,
     metrics=None,
     fault_injector=None,
+    preemption_guard=None,
+    watchdog=None,
 ) -> ALSModel:
     """Single-device implicit ALS. Ratings in the dataset are interaction
     strengths (counts, play-time, explicit stars — anything ≥ 0).
@@ -278,7 +280,8 @@ def train_ials(
     factors are journaled every ``checkpoint_every`` iterations, and training
     resumes from the latest committed step (the reference's ``setup.sh:18-21``
     journal applies to every model, so ours does too).  Health sentinel /
-    recovery / ``fault_injector`` semantics also match ``train_als``."""
+    recovery / ``fault_injector`` / ``preemption_guard`` / ``watchdog``
+    semantics also match ``train_als``."""
     from cfk_tpu.resilience.loop import validate_cadence
     from cfk_tpu.resilience.sentinel import health_from_config
     from cfk_tpu.utils.metrics import Metrics
@@ -302,7 +305,8 @@ def train_ials(
         ublocks = _blocks_to_device(dataset.user_blocks)
         u_stats = None
         layout_kw = {}
-    stepped = checkpoint_manager is not None or fault_injector is not None
+    stepped = (checkpoint_manager is not None or fault_injector is not None
+               or preemption_guard is not None or watchdog is not None)
     if not stepped:
         train_s_before = metrics.phases.get("train", 0.0)
         with metrics.phase("train"):
@@ -413,6 +417,8 @@ def train_ials(
             health=health,
             policy=policy_from_config(config),
             fault_injector=fault_injector,
+            preemption_guard=preemption_guard,
+            watchdog=watchdog,
         )
     return ALSModel(
         user_factors=u,
@@ -590,12 +596,15 @@ def train_ials_sharded(
     checkpoint_every: int = 1,
     metrics=None,
     fault_injector=None,
+    preemption_guard=None,
+    watchdog=None,
 ) -> ALSModel:
     """Multi-device iALS over a 1-D mesh, with optional checkpoint/resume.
 
-    Health sentinel / rollback+escalation / ``fault_injector`` semantics
-    match ``train_als_sharded`` (iALS is all_gather-only, so the probe is
-    the step-level factor word — there is no ring carry to instrument)."""
+    Health sentinel / rollback+escalation / ``fault_injector`` /
+    ``preemption_guard`` / ``watchdog`` semantics match
+    ``train_als_sharded`` (iALS is all_gather-only, so the probe is the
+    step-level factor word — there is no ring carry to instrument)."""
     from cfk_tpu.utils.metrics import Metrics
 
     from cfk_tpu.config import apply_overlap_xla_flags
@@ -693,6 +702,8 @@ def train_ials_sharded(
         checkpoint_every=checkpoint_every,
         health=health,
         fault_injector=fault_injector,
+        preemption_guard=preemption_guard,
+        watchdog=watchdog,
         resume_fn=lambda: resume_state_synced(
             checkpoint_manager,
             rank=config.rank,
@@ -700,8 +711,10 @@ def train_ials_sharded(
             num_iterations=config.num_iterations,
             u_shape=(dataset.user_blocks.padded_entities, config.rank),
             m_shape=(dataset.movie_blocks.padded_entities, config.rank),
+            num_shards=config.num_shards,
         ),
-        save_meta={"rank": config.rank, "model": "ials"},
+        save_meta={"rank": config.rank, "model": "ials",
+                   "num_shards": config.num_shards},
     )
 
     return ALSModel(

@@ -29,10 +29,44 @@ def make_mesh(num_shards: int, devices: list | None = None) -> Mesh:
     return Mesh(np.array(devices[:num_shards]), (AXIS,))
 
 
+# Exit status of the init-timeout watchdog below: "the fleet never
+# assembled within the bound" — distinct from crash codes so supervisors
+# and the test drills can tell it from a wreck.
+INIT_TIMEOUT_EXIT_CODE = 18
+
+
+def _looks_like_init_timeout(e: BaseException) -> bool:
+    # ONLY the init-barrier deadline signature (measured: "absl::Status:
+    # DEADLINE_EXCEEDED ... RegisterTask").  A generic "timeout" substring
+    # match would rewrite unrelated coordination errors (heartbeat/barrier
+    # failures, a second initialize call) into a misleading "fleet never
+    # assembled" diagnosis and bypass the topology check below.
+    msg = str(e).lower()
+    return "deadline_exceeded" in msg or "deadline exceeded" in msg
+
+
+def _init_timeout_message(coordinator_address, num_processes, process_id,
+                          timeout_s) -> str:
+    missing = (
+        sorted(set(range(num_processes)) - {process_id})
+        if num_processes is not None and process_id is not None
+        else "unknown"
+    )
+    return (
+        f"initialize_distributed timed out after {timeout_s}s: process "
+        f"{process_id} waited at coordinator {coordinator_address} but the "
+        f"runtime never assembled all {num_processes} processes — the "
+        f"missing peer(s) are among process ids {missing}; check that every "
+        "process was launched, is still alive, and can reach the "
+        "coordinator"
+    )
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    init_timeout_s: float | None = None,
 ) -> int:
     """Idempotent ``jax.distributed.initialize`` wrapper for multi-host runs.
 
@@ -49,20 +83,75 @@ def initialize_distributed(
     exists (even ``jax.devices()`` initializes one).  Calling again after a
     successful multi-process init is a no-op; calling too late with a
     mismatching topology raises.
+
+    ``init_timeout_s`` bounds how long this process waits at the startup
+    barrier for its peers (the runtime default is 300 s of silent hanging).
+    The installed runtime never surfaces that expiry as a catchable Python
+    exception — XLA's distributed client ABORTS the process from an error
+    callback (``client.h:80 F ... DEADLINE_EXCEEDED``, measured on jax
+    0.4.37) with a message that names no peer.  So the bound is enforced
+    here: a watchdog thread fires ``init_timeout_s`` BEFORE the runtime's
+    own (longer) deadline, prints an actionable diagnosis naming this
+    process, the coordinator address, and the candidate missing process
+    ids, and exits ``INIT_TIMEOUT_EXIT_CODE``.  On runtimes that do raise
+    a catchable deadline error, the same diagnosis rides a ``TimeoutError``
+    instead.
     """
     if coordinator_address is None:
         return jax.process_count()
+    kw = {}
+    watchdog_done = None
+    if init_timeout_s is not None:
+        import os as _os
+        import sys as _sys
+        import threading
+
+        # Give the runtime's own deadline headroom past ours so the
+        # actionable watchdog always wins the race against the bare
+        # absl-fatal abort.
+        kw["initialization_timeout"] = int(max(1, init_timeout_s)) + 15
+        watchdog_done = threading.Event()
+
+        def _watch():
+            if watchdog_done.wait(init_timeout_s):
+                return
+            print(
+                _init_timeout_message(
+                    coordinator_address, num_processes, process_id,
+                    init_timeout_s,
+                ),
+                file=_sys.stderr,
+                flush=True,
+            )
+            _os._exit(INIT_TIMEOUT_EXIT_CODE)
+
+        threading.Thread(
+            target=_watch, name="cfk-init-timeout", daemon=True
+        ).start()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **kw,
         )
-    except RuntimeError:
+    except RuntimeError as e:
+        if watchdog_done is not None:
+            watchdog_done.set()
+        if _looks_like_init_timeout(e):
+            raise TimeoutError(
+                _init_timeout_message(
+                    coordinator_address, num_processes, process_id,
+                    init_timeout_s if init_timeout_s is not None else 300,
+                )
+            ) from e
         # Backend already up (or initialize called twice).  Fine iff the
         # runtime already has the topology the caller asked for.
         if num_processes is not None and jax.process_count() != num_processes:
             raise
+    finally:
+        if watchdog_done is not None:
+            watchdog_done.set()
     return jax.process_count()
 
 

@@ -834,19 +834,24 @@ def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) ->
 def _sharded_resilient_loop(
     manager, *, model, dataset, config, mesh, dtype, init_fn, make_raw_step,
     mtree, utree, metrics, checkpoint_every, health, fault_injector,
-    resume_fn, save_meta,
+    resume_fn, save_meta, preemption_guard=None, watchdog=None,
 ):
     """Bind the resilient loop's device↔host boundary to a 1-D mesh.
 
     Shared by the explicit and implicit sharded trainers: snapshots
     process_allgather to host, restores re-shard rows, saves are
-    process-0-gated, and escalation overrides rebuild the jitted step from
-    a ``dataclasses.replace``d config (λ bump / split epilogue are
-    jit-statics, so each rung re-traces).
+    process-0-gated (the gather runs on every process — the collectives
+    must pair up — but only rank 0 touches the store, async via the
+    manager's writer thread), and escalation overrides rebuild the jitted
+    step from a ``dataclasses.replace``d config (λ bump / split epilogue
+    are jit-statics, so each rung re-traces).  ``preemption_guard`` /
+    ``watchdog`` thread straight into the resilient loop: every process
+    polls the guard at the same iteration boundary so the emergency save's
+    gather collectives stay in lockstep, and rank 0 writes the manifest.
     """
     import dataclasses as _dc
 
-    from cfk_tpu.resilience.loop import resilient_train_loop
+    from cfk_tpu.resilience.loop import resilient_train_loop, save_checkpoint
     from cfk_tpu.resilience.policy import Overrides, policy_from_config
 
     def make_step(ov):
@@ -870,12 +875,29 @@ def _sharded_resilient_loop(
 
     def save_fn(done, u, m):
         # Multi-process: every host gathers (cheap, factors are [E, k])
-        # but only process 0 writes the checkpoint dir.  The gathered
+        # but only process 0 writes the checkpoint dir — async, so the
+        # step loop never waits for serialize+fsync+rename.  The gathered
         # pair doubles as the resilient loop's rollback anchor.
         uh, mh = to_host(u), to_host(m)
         if jax.process_index() == 0:
-            manager.save(done, uh, mh, meta=save_meta)
+            save_checkpoint(manager, done, uh, mh, meta=save_meta)
         return uh, mh
+
+    # Eviction must be a fleet-wide agreement: SIGTERM delivery is racy
+    # against iteration boundaries, so each boundary allgather-maxes the
+    # per-process flags — any signalled process makes EVERY process run
+    # the emergency save at that same boundary.  Only armed (and only a
+    # collective) when a guard exists; symmetric across processes because
+    # every worker passes the same arguments.
+    evict_sync_fn = None
+    if preemption_guard is not None and jax.process_count() > 1:
+        from jax.experimental import multihost_utils as _mh
+
+        def evict_sync_fn(local: bool) -> bool:
+            flags = _mh.process_allgather(
+                np.asarray(1 if local else 0, np.int32)
+            )
+            return bool(np.max(np.asarray(flags)) > 0)
 
     return resilient_train_loop(
         manager,
@@ -899,6 +921,10 @@ def _sharded_resilient_loop(
         restore_fn=restore_fn,
         save_fn=save_fn,
         resume_fn=resume_fn,
+        num_shards=config.num_shards,
+        preemption_guard=preemption_guard,
+        watchdog=watchdog,
+        evict_sync_fn=evict_sync_fn,
     )
 
 
@@ -911,6 +937,8 @@ def train_als_sharded(
     checkpoint_every: int = 1,
     metrics=None,
     fault_injector=None,
+    preemption_guard=None,
+    watchdog=None,
 ) -> ALSModel:
     """Multi-device ALS-WR over a 1-D mesh; semantics match ``train_als``.
 
@@ -1022,6 +1050,8 @@ def train_als_sharded(
         checkpoint_every=checkpoint_every,
         health=health,
         fault_injector=fault_injector,
+        preemption_guard=preemption_guard,
+        watchdog=watchdog,
         resume_fn=lambda: resume_state_synced(
             checkpoint_manager,
             rank=config.rank,
@@ -1029,11 +1059,13 @@ def train_als_sharded(
             num_iterations=config.num_iterations,
             u_shape=(dataset.user_blocks.padded_entities, config.rank),
             m_shape=(dataset.movie_blocks.padded_entities, config.rank),
+            num_shards=config.num_shards,
         ),
         save_meta={
             "rank": config.rank,
             "exchange": config.exchange,
             "model": "als",
+            "num_shards": config.num_shards,
         },
     )
 

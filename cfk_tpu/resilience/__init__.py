@@ -21,10 +21,19 @@ adds the three coupled pieces production matrix factorization needs
   corruption, singular normal equations, torn checkpoint writes, flaky
   broker connections) so recovery is *proved*, not assumed
   (``tests/test_resilience.py``, ``scripts/chaos_lab.py``).
+- ``preempt`` — infrastructure-fault tolerance: ``PreemptionGuard``
+  (SIGTERM/SIGINT → drain the async checkpoint writer, commit one final
+  checkpoint, exit resumable) and ``StallWatchdog`` (bounded exit with an
+  intact checkpoint store when a dead peer wedges a collective).
 - ``retry`` — exponential backoff + jitter helpers shared with the TCP
   transport.
 """
 
+from cfk_tpu.resilience.preempt import (
+    STALL_EXIT_CODE,
+    PreemptionGuard,
+    StallWatchdog,
+)
 from cfk_tpu.resilience.policy import (
     Overrides,
     RecoveryPolicy,
@@ -41,7 +50,10 @@ __all__ = [
     "HealthConfig",
     "HealthReport",
     "Overrides",
+    "PreemptionGuard",
     "RecoveryPolicy",
+    "STALL_EXIT_CODE",
+    "StallWatchdog",
     "TrainingDivergedError",
     "describe_word",
     "health_from_config",

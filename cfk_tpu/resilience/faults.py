@@ -31,6 +31,10 @@ import time
 
 import numpy as np
 
+from cfk_tpu.transport.checkpoint import (
+    CheckpointManager as _BaseCheckpointManager,
+)
+
 
 # --- factor-buffer faults --------------------------------------------------
 
@@ -41,7 +45,11 @@ class FactorCorruption:
     before iteration ``iteration`` (0-based).  ``persistent`` re-fires on
     every pass through that iteration (a rollback replays into the same
     fault — the escalation path must fix the math); one-shot faults model
-    transients that a plain rollback+retry clears."""
+    transients that a plain rollback+retry clears.  ``rows=(lo, hi)``
+    corrupts that contiguous slice instead of seeded random rows — the
+    multi-process lockstep drill uses it to land the corruption entirely
+    inside ONE process's shard (entity rows are contiguously
+    block-sharded), proving detection is global while the fault is local."""
 
     iteration: int
     side: str = "u"  # "u" | "m"
@@ -49,6 +57,7 @@ class FactorCorruption:
     num_rows: int = 4
     seed: int = 0
     persistent: bool = False
+    rows: tuple[int, int] | None = None
     fired: int = 0
 
     def apply(self, i: int, u, m):
@@ -58,11 +67,15 @@ class FactorCorruption:
         import jax.numpy as jnp
 
         target = u if self.side == "u" else m
-        rows = np.random.default_rng(self.seed).choice(
-            target.shape[0], size=min(self.num_rows, target.shape[0]),
-            replace=False,
-        )
-        target = target.at[jnp.asarray(rows)].set(self.value)
+        if self.rows is not None:
+            lo, hi = self.rows
+            target = target.at[lo:hi].set(self.value)
+        else:
+            rows = np.random.default_rng(self.seed).choice(
+                target.shape[0], size=min(self.num_rows, target.shape[0]),
+                replace=False,
+            )
+            target = target.at[jnp.asarray(rows)].set(self.value)
         return (target, m) if self.side == "u" else (u, target)
 
 
@@ -140,6 +153,13 @@ class TornCheckpointManager:
     def __getattr__(self, name):  # delegate everything else
         return getattr(self.inner, name)
 
+    def save_async(self, iteration, user_factors, movie_factors, meta=None):
+        # Pin the SYNC path: delegating to the inner writer thread would
+        # route around this wrapper's tear (the thread calls inner.save),
+        # and the fault must land deterministically before training moves
+        # on.  The loop's drain barriers are no-ops against this store.
+        self.save(iteration, user_factors, movie_factors, meta=meta)
+
     def save(self, iteration, user_factors, movie_factors, meta=None):
         path = self.inner.save(iteration, user_factors, movie_factors,
                                meta=meta)
@@ -158,6 +178,60 @@ class TornCheckpointManager:
                 f.write(torn)
             self.torn.append(victim)
         return path
+
+
+class SlowDiskCheckpointManager(_BaseCheckpointManager):
+    """Checkpoint store on a pathologically slow disk: every step write
+    sleeps ``delay_s`` before touching the filesystem.
+
+    A *subclass* of ``CheckpointManager`` (not a delegating wrapper) so the
+    inherited ``save_async`` hands THIS slow ``save`` to the background
+    writer thread — the chaos scenario that proves the step loop never
+    stalls behind the writer, and that back-pressure (``max_pending``)
+    throttles the producer instead of growing an unbounded snapshot queue.
+    ``writes``/``max_pending_seen`` record that the fault actually fired.
+    """
+
+    def __init__(self, directory, *, delay_s=0.05, **kw):
+        super().__init__(directory, **kw)
+        self.delay_s = delay_s
+        self.writes = 0
+        self.max_pending_seen = 0
+
+    def save(self, iteration, user_factors, movie_factors, meta=None):
+        self.max_pending_seen = max(self.max_pending_seen,
+                                    self.pending_count)
+        time.sleep(self.delay_s)
+        self.writes += 1
+        return super().save(iteration, user_factors, movie_factors,
+                            meta=meta)
+
+
+@dataclasses.dataclass
+class PreemptAt:
+    """Deliver ``signum`` (default SIGTERM) to this very process before
+    iteration ``iteration`` — the eviction notice a preempted VM gets.  A
+    ``PreemptionGuard`` must be armed: its handler turns the signal into
+    the graceful save-and-exit the loop polls for.  ``only_process``
+    restricts delivery under multi-process JAX (e.g. kill exactly one
+    worker with ``signal.SIGKILL`` for the dead-collective drill)."""
+
+    iteration: int
+    signum: int = 15  # signal.SIGTERM
+    only_process: int | None = None
+    fired: int = 0
+
+    def apply(self, i: int, u, m):
+        if i != self.iteration or self.fired:
+            return u, m
+        if self.only_process is not None:
+            import jax
+
+            if jax.process_index() != self.only_process:
+                return u, m
+        self.fired += 1
+        os.kill(os.getpid(), self.signum)
+        return u, m
 
 
 # --- broker transport faults ----------------------------------------------

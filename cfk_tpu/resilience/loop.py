@@ -59,6 +59,32 @@ def validate_cadence(checkpoint_every: int, health=None) -> None:
         )
 
 
+def save_checkpoint(manager, done, hu, hm, *, meta=None):
+    """One save-point write: async when the manager supports it.
+
+    ``CheckpointManager.save_async`` backgrounds the serialize + fsync +
+    atomic rename on its writer thread so the step loop never idles behind
+    disk; stores without an async writer (``JournalCheckpointManager``,
+    chaos wrappers that pin the sync path) fall back to a blocking save.
+    """
+    if hasattr(manager, "save_async"):
+        manager.save_async(done, hu, hm, meta=meta)
+    else:
+        manager.save(done, hu, hm, meta=meta)
+
+
+def drain_checkpoints(manager) -> None:
+    """Barrier on the async checkpoint writer (no-op for sync stores).
+
+    Called before every rollback read and at every loop exit so readers —
+    the rollback path, the caller's post-training ``restore()``, the next
+    process after a preemption — only ever observe committed steps; the
+    crc32/torn-step verification contract is unchanged by async writes.
+    """
+    if manager is not None and hasattr(manager, "wait_pending"):
+        manager.wait_pending()
+
+
 def resilient_train_loop(
     manager,
     *,
@@ -81,6 +107,10 @@ def resilient_train_loop(
     restore_fn=None,
     save_fn=None,
     resume_fn=None,
+    num_shards: int = 1,
+    preemption_guard=None,
+    watchdog=None,
+    evict_sync_fn=None,
 ):
     """Run the stepped loop; returns the final ``(u, m)`` device factors.
 
@@ -90,6 +120,25 @@ def resilient_train_loop(
     A step may also return ``(u, m, ring_bad)`` where ``ring_bad`` is the
     in-carry ring-exchange probe flag the SPMD ring half-steps emit; it is
     fetched on the health cadence and folded into the probe word.
+
+    ``preemption_guard`` (``cfk_tpu.resilience.preempt.PreemptionGuard``)
+    is polled between iterations: once triggered, the loop drains the
+    async checkpoint writer, commits a final checkpoint (unless the state
+    just failed its health probe) and returns resumable.  ``watchdog``
+    (``StallWatchdog``) is armed around the loop and ticked per completed
+    iteration — a peer death that wedges a collective then bounds this
+    process's exit instead of hanging it forever.
+
+    ``evict_sync_fn(local: bool) -> bool`` makes the eviction decision a
+    fleet-wide AGREEMENT under multi-process JAX: signal delivery is
+    per-process and racy against iteration boundaries, so acting on the
+    local flag alone could have one process run the emergency-save
+    collectives at a boundary its peers already left (desync → the
+    graceful preemption degrades into a watchdog stall exit).  The
+    sharded trainers inject an allgather-max so every process evicts at
+    the same boundary as soon as ANY process was signalled; it is a
+    collective, so the loop calls it on every iteration whenever it is
+    set (the single-process default is the plain local flag).
     """
     import jax.numpy as jnp
 
@@ -108,13 +157,18 @@ def resilient_train_loop(
     if save_fn is None:
         def save_fn(done, u, m):
             hu, hm = np.asarray(u), np.asarray(m)
-            manager.save(done, hu, hm, meta={"rank": rank, "model": model})
+            save_checkpoint(
+                manager, done, hu, hm,
+                meta={"rank": rank, "model": model,
+                      "num_shards": num_shards},
+            )
             return hu, hm
 
     if resume_fn is None:
         resume_fn = functools.partial(
             resume_state, manager, rank=rank, model=model,
             num_iterations=num_iterations, u_shape=u_shape, m_shape=m_shape,
+            num_shards=num_shards,
         )
     state = resume_fn()
     if state is not None:
@@ -137,22 +191,34 @@ def resilient_train_loop(
         probe = jax.jit(
             lambda u, m: _sentinel.probe_word(u, m, health.norm_limit)
         )
-    return _run_loop_body(
-        manager=manager, num_iterations=num_iterations,
-        start_iter=start_iter, u=u, m=m, step=step,
-        make_step=make_step, overrides=overrides, policy=policy,
-        health=health, probe=probe, metrics=metrics,
-        checkpoint_every=checkpoint_every,
-        fault_injector=fault_injector, snapshot_fn=snapshot_fn,
-        restore_fn=restore_fn, save_fn=save_fn, state=state,
-        init_fn=init_fn,
-    )
+    if watchdog is not None:
+        watchdog.arm()
+    try:
+        return _run_loop_body(
+            manager=manager, num_iterations=num_iterations,
+            start_iter=start_iter, u=u, m=m, step=step,
+            make_step=make_step, overrides=overrides, policy=policy,
+            health=health, probe=probe, metrics=metrics,
+            checkpoint_every=checkpoint_every,
+            fault_injector=fault_injector, snapshot_fn=snapshot_fn,
+            restore_fn=restore_fn, save_fn=save_fn, state=state,
+            init_fn=init_fn, guard=preemption_guard, watchdog=watchdog,
+            evict_sync_fn=evict_sync_fn,
+        )
+    finally:
+        if watchdog is not None:
+            watchdog.disarm()
+        # Loop-exit barrier: every return path (completion, degrade,
+        # preemption, or an exception unwinding) leaves only committed
+        # steps behind before the caller can read the store.
+        drain_checkpoints(manager)
 
 
 def _run_loop_body(
     *, manager, num_iterations, start_iter, u, m, step, make_step,
     overrides, policy, health, probe, metrics, checkpoint_every,
     fault_injector, snapshot_fn, restore_fn, save_fn, state, init_fn,
+    guard=None, watchdog=None, evict_sync_fn=None,
 ):
     from cfk_tpu.transport.checkpoint import should_save
 
@@ -187,13 +253,24 @@ def _run_loop_body(
             ring_pending = ring_pending or int(np.asarray(ring_bad)) > 0
         metrics.incr("iterations")
         done = i + 1
+        if watchdog is not None:
+            watchdog.tick(done)
+        # Eviction poll.  Signal delivery is per-process and racy against
+        # iteration boundaries, so multi-process runs AGREE on the flag
+        # via evict_sync_fn (an allgather-max the sharded trainers
+        # inject): every process then runs the emergency save's
+        # host-gather collectives at the same boundary, even when only
+        # one process was signalled.
+        evicting = guard is not None and guard.triggered
+        if evict_sync_fn is not None:
+            evicting = bool(evict_sync_fn(evicting))
         # With no checkpoint store there is no commit to protect, so the
         # save cadence must not drive probes or snapshots — the health
         # cadence alone does (checkpoint_every defaults to 1, which would
         # otherwise silently force per-iteration probes + full host
         # snapshots on every manager-less health run).
-        saving = manager is not None and should_save(
-            done, checkpoint_every, num_iterations
+        saving = manager is not None and (
+            should_save(done, checkpoint_every, num_iterations) or evicting
         )
         probing = health is not None and (
             done % health.every == 0 or done == num_iterations or saving
@@ -207,6 +284,25 @@ def _run_loop_body(
                     word |= _sentinel.RING_EXCHANGE
             ring_pending = False
             metrics.incr("health_checks")
+        evict_reason = (
+            guard.signal_name if guard is not None and guard.triggered
+            else "peer process signalled"
+        )
+        if word and evicting:
+            # Evicted at an unhealthy iteration: there is no time to climb
+            # the recovery ladder, and a bad state must never be committed
+            # — return the last-good factors and leave the store's newest
+            # committed (healthy) step as the resume point.
+            anchor, (u, m) = rollback()
+            metrics.gauge("preempted", 1)
+            metrics.gauge("trained_iterations", anchor)
+            metrics.note(
+                "preempted",
+                f"{evict_reason} at iteration {done} with a tripped "
+                f"health probe ({_sentinel.HealthReport(done, word, {}).summary()}); "
+                f"returning last-good factors from iteration {anchor}",
+            )
+            return u, m
         if word:
             trips += 1
             report = _sentinel.HealthReport(
@@ -236,6 +332,10 @@ def _run_loop_body(
                     f"factors from iteration {anchor}"
                 )
                 return u, m
+            # Write-order barrier: the replay below re-saves the same step
+            # numbers; an async write for step N still in flight racing the
+            # replay's fresh step-N write could commit old bytes over new.
+            drain_checkpoints(manager)
             i, (u, m) = rollback()
             metrics.incr("rollbacks")
             new_overrides = policy.escalate(overrides, trips)
@@ -250,6 +350,11 @@ def _run_loop_body(
                 )
                 if make_step is not None:
                     step = make_step(overrides)
+                    if watchdog is not None:
+                        # The rebuilt step re-traces on its next call —
+                        # minutes of tickless compile that must not read
+                        # as a dead peer.
+                        watchdog.extend_grace()
                 else:
                     warnings.warn(
                         "escalation requested but this loop was built with "
@@ -272,6 +377,30 @@ def _run_loop_body(
                 done,
                 host_pair if host_pair is not None else snapshot_fn(u, m),
             )
+            if manager is not None and hasattr(manager, "pin"):
+                # The last verified-good step is what the recovery ladder
+                # rolls back to; keep_last_n retention must never collect
+                # it, however long a recovery excursion takes.
+                manager.pin(done)
+        if evicting:
+            # Emergency save committed above (the final checkpoint rode
+            # the forced save point); drain the writer so it is on disk
+            # before this process dies, then exit resumable.
+            drain_checkpoints(manager)
+            metrics.gauge("preempted", 1)
+            metrics.gauge("trained_iterations", done)
+            metrics.note(
+                "preempted",
+                f"{evict_reason} at iteration {done}/"
+                f"{num_iterations}; final checkpoint "
+                f"{'committed' if saving else 'skipped (no manager)'} — "
+                "resume from the same checkpoint directory to continue",
+            )
+            warnings.warn(
+                f"training preempted ({evict_reason}) at iteration "
+                f"{done}/{num_iterations}; exiting resumable"
+            )
+            return u, m
         i = done
     return u, m
 

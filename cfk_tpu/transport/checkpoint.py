@@ -11,18 +11,43 @@ rename, and training resumes from the latest complete step.
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import json
 import os
 import shutil
 import tempfile
+import threading
+import time
 import warnings
+import weakref
 import zlib
+from collections import deque
 
 import numpy as np
 
 _MANIFEST = "manifest.json"
 _STEP_PREFIX = "step_"
+
+# Managers with a live background writer, drained at interpreter exit so a
+# process that finishes (or is SIGTERM'd into a clean shutdown) never leaves
+# an enqueued checkpoint unwritten.  Weak references: a manager that is
+# garbage-collected drains in __del__/wait_pending before it disappears from
+# this set, and the atexit hook must not keep dead managers alive.
+_LIVE_MANAGERS: "weakref.WeakSet[CheckpointManager]" = weakref.WeakSet()
+
+
+def _drain_writers_at_exit() -> None:  # pragma: no cover - exit path
+    for mgr in list(_LIVE_MANAGERS):
+        try:
+            mgr.wait_pending()
+        except Exception as e:
+            # Exit-time best effort: a failed background write must not turn
+            # a clean shutdown into a crash loop; the warning names the loss.
+            warnings.warn(f"checkpoint write pending at exit failed: {e}")
+
+
+atexit.register(_drain_writers_at_exit)
 
 
 class CheckpointCorruptError(ValueError):
@@ -37,17 +62,21 @@ def resume_state(
     num_iterations: int,
     u_shape: tuple[int, int] | None = None,
     m_shape: tuple[int, int] | None = None,
+    num_shards: int | None = None,
 ) -> "CheckpointState | None":
     """Shared resume validation for every trainer.
 
     Returns the latest state, or None when there is nothing to resume.
     Rejects checkpoints whose rank or model family differs from the config,
     runs already past ``num_iterations`` (silently returning over-trained
-    factors as an N-iteration model would corrupt experiments), and — when
-    the expected ``u_shape``/``m_shape`` are given — stale checkpoints whose
-    padded row counts don't match this run (different pad_multiple/
-    num_shards), which would otherwise surface as an opaque shape error deep
-    inside the jitted iteration.
+    factors as an N-iteration model would corrupt experiments), checkpoints
+    whose recorded ``num_shards`` differs from this run's (shard-local
+    block indices and padded row counts are shard-count-dependent, and the
+    shapes can coincide by accident), and — when the expected
+    ``u_shape``/``m_shape`` are given — stale checkpoints whose padded row
+    counts don't match this run (different pad_multiple/num_shards), which
+    would otherwise surface as an opaque shape error deep inside the jitted
+    iteration.
     """
     if manager is None or manager.latest_iteration() is None:
         return None
@@ -71,6 +100,19 @@ def resume_state(
         raise ValueError(
             f"checkpoint was written by model family {saved_model!r}, "
             f"resuming as {model!r}; use a fresh checkpoint directory"
+        )
+    saved_shards = state.meta.get("num_shards")
+    if (num_shards is not None and saved_shards is not None
+            and int(saved_shards) != int(num_shards)):
+        # The u_shape check below only catches this when the shard-count
+        # padding happens to change the padded row counts; equal shapes
+        # with different shard-local block layouts would train garbage.
+        raise ValueError(
+            f"checkpoint at iteration {state.iteration} was written by a "
+            f"num_shards={int(saved_shards)} run, but this config has "
+            f"num_shards={int(num_shards)}; shard-count padding and "
+            "shard-local indices are not portable — use a fresh checkpoint "
+            "directory (or restore() and re-shard the factors by hand)"
         )
     if state.iteration > num_iterations:
         raise ValueError(
@@ -96,6 +138,9 @@ def checkpointed_train_loop(
     step_fn,
     metrics,
     checkpoint_every: int = 1,
+    num_shards: int = 1,
+    preemption_guard=None,
+    watchdog=None,
 ):
     """The single-process checkpointed training loop every trainer shares.
 
@@ -125,6 +170,9 @@ def checkpointed_train_loop(
         step_fn=step_fn,
         metrics=metrics,
         checkpoint_every=checkpoint_every,
+        num_shards=num_shards,
+        preemption_guard=preemption_guard,
+        watchdog=watchdog,
     )
 
 
@@ -136,6 +184,7 @@ def resume_state_synced(
     num_iterations: int,
     u_shape: tuple[int, int],
     m_shape: tuple[int, int],
+    num_shards: int | None = None,
 ) -> "CheckpointState | None":
     """``resume_state`` with the decision broadcast from process 0.
 
@@ -151,7 +200,7 @@ def resume_state_synced(
     if jax.process_count() == 1:
         return resume_state(
             manager, rank=rank, model=model, num_iterations=num_iterations,
-            u_shape=u_shape, m_shape=m_shape,
+            u_shape=u_shape, m_shape=m_shape, num_shards=num_shards,
         )
     from jax.experimental import multihost_utils as mh
 
@@ -167,7 +216,7 @@ def resume_state_synced(
         try:
             state = resume_state(
                 manager, rank=rank, model=model, num_iterations=num_iterations,
-                u_shape=u_shape, m_shape=m_shape,
+                u_shape=u_shape, m_shape=m_shape, num_shards=num_shards,
             )
         except Exception as e:
             err = e
@@ -214,6 +263,43 @@ def _check_shapes(state: "CheckpointState", u_shape, m_shape) -> None:
         )
 
 
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - fsync on dirs unsupported
+        pass
+    finally:
+        os.close(fd)
+
+
+def _host_snapshot(x) -> np.ndarray:
+    """Host copy of a factor array, issued non-blocking when possible.
+
+    jax arrays get their device→host DMA started via ``copy_to_host_async``
+    before the materializing ``np.asarray`` (which must block, but now only
+    for the tail of an already-running transfer); numpy inputs are copied so
+    the enqueued write can never observe caller-side mutation."""
+    copy_async = getattr(x, "copy_to_host_async", None)
+    if copy_async is not None:
+        try:
+            copy_async()
+        except Exception:  # pragma: no cover - non-addressable shards
+            pass
+    return np.array(x, copy=True)
+
+
 def _crc32_file(path: str) -> int:
     crc = 0
     with open(path, "rb") as f:
@@ -243,17 +329,170 @@ class CheckpointManager:
     """Directory-of-steps checkpoint store with atomic per-step commits.
 
     Layout: ``<dir>/step_0000007/{manifest.json,user.npy,movie.npy}``.
-    A step directory appears atomically (written to a temp dir, then renamed),
-    so a crash mid-write can never yield a half checkpoint — the property the
-    reference's in-memory, changelog-disabled stores lack (``apps/ALSApp.java:53-83``).
+    A step directory appears atomically (written to a temp dir, fsync'd, then
+    renamed), so a crash mid-write can never yield a half checkpoint — the
+    property the reference's in-memory, changelog-disabled stores lack
+    (``apps/ALSApp.java:53-83``).
+
+    ``save_async`` hands the serialize + fsync + atomic-rename to ONE
+    background writer thread so the training loop never idles behind disk;
+    ``wait_pending()`` is the barrier (the resilient loop drains before any
+    rollback read and at loop exit, so the crc32/torn-step verification
+    contract is unchanged — readers only ever see committed steps).  When
+    more than ``max_pending`` saves are queued, ``save_async`` blocks (slow
+    disk must throttle the producer, not grow an unbounded host-snapshot
+    queue).  A writer error is sticky: it re-raises at the next
+    ``save_async``/``wait_pending`` instead of vanishing on a daemon thread.
+
+    ``keep_last_n`` garbage-collects old steps after each successful save,
+    always keeping the newest N plus any ``pin()``ned step — the resilient
+    loop pins its last verified-good rollback anchor, so the step the
+    recovery ladder points at can never be collected out from under it.
     """
 
-    def __init__(self, directory: str) -> None:
+    def __init__(
+        self,
+        directory: str,
+        *,
+        keep_last_n: int | None = None,
+        async_write: bool = True,
+        max_pending: int = 2,
+    ) -> None:
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(
+                f"keep_last_n must be >= 1 (checkpoints retained after each "
+                f"save), got {keep_last_n}; use keep_last_n=None to retain "
+                "every step"
+            )
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         self.directory = directory
+        self.keep_last_n = keep_last_n
+        self.async_write = async_write
+        self.max_pending = max_pending
+        self._pinned: int | None = None
+        self._lock = threading.Lock()
+        self._queue_nonfull = threading.Condition(self._lock)
+        self._queue_empty = threading.Condition(self._lock)
+        self._jobs: deque = deque()
+        self._inflight = 0
+        self._writer_thread: threading.Thread | None = None
+        self._writer_error: BaseException | None = None
         os.makedirs(directory, exist_ok=True)
 
     def _step_dir(self, iteration: int) -> str:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{iteration:07d}")
+
+    # --- background writer -------------------------------------------------
+
+    @property
+    def pending_count(self) -> int:
+        """Queued + in-flight async saves not yet committed to disk."""
+        with self._lock:
+            return len(self._jobs) + self._inflight
+
+    def pin(self, iteration: int | None) -> None:
+        """Protect one step from ``keep_last_n`` garbage collection — the
+        resilient loop pins its last verified-good rollback anchor."""
+        with self._lock:
+            self._pinned = iteration
+
+    def save_async(
+        self,
+        iteration: int,
+        user_factors,
+        movie_factors,
+        meta: dict | None = None,
+    ) -> None:
+        """Snapshot the factors to host and enqueue the disk write.
+
+        The snapshot happens here (device arrays are fetched via a
+        non-blocking ``copy_to_host_async`` issue, then materialized) so
+        the caller may mutate/donate its buffers immediately; only the
+        serialize + fsync + atomic rename runs on the writer thread.
+        Blocks while more than ``max_pending`` saves are queued
+        (back-pressure) and re-raises any earlier writer failure.  With
+        ``async_write=False`` (the A/B baseline) this is exactly ``save``.
+        """
+        hu, hm = _host_snapshot(user_factors), _host_snapshot(movie_factors)
+        if not self.async_write:
+            self.save(iteration, hu, hm, meta=meta)
+            return
+        _LIVE_MANAGERS.add(self)
+        with self._lock:
+            self._raise_writer_error_locked()
+            while len(self._jobs) + self._inflight >= self.max_pending:
+                self._queue_nonfull.wait()
+                self._raise_writer_error_locked()
+            self._jobs.append((iteration, hu, hm, dict(meta or {})))
+            if self._writer_thread is None or not self._writer_thread.is_alive():
+                self._writer_thread = threading.Thread(
+                    target=self._writer_loop,
+                    name="cfk-checkpoint-writer",
+                    daemon=True,
+                )
+                self._writer_thread.start()
+
+    def wait_pending(self, timeout: float | None = None) -> bool:
+        """Barrier: block until every queued async save is committed.
+
+        Returns True when drained (False on timeout) and re-raises the
+        first writer error.  Safe to call with no writer running."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._jobs or self._inflight:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._queue_empty.wait(remaining)
+            self._raise_writer_error_locked()
+        return True
+
+    def _raise_writer_error_locked(self) -> None:
+        if self._writer_error is not None:
+            err, self._writer_error = self._writer_error, None
+            raise err
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._lock:
+                if not self._jobs:
+                    self._queue_empty.notify_all()
+                    # Park the thread: it dies when idle and is respawned by
+                    # the next save_async (no join-at-shutdown bookkeeping).
+                    self._writer_thread = None
+                    return
+                iteration, hu, hm, meta = self._jobs.popleft()
+                self._inflight += 1
+                self._queue_nonfull.notify_all()
+            try:
+                self.save(iteration, hu, hm, meta=meta)
+            except BaseException as e:
+                with self._lock:
+                    if self._writer_error is None:
+                        self._writer_error = e
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self._queue_nonfull.notify_all()
+                    if not self._jobs and not self._inflight:
+                        self._queue_empty.notify_all()
+
+    def _retain(self, just_saved: int) -> None:
+        """Apply the ``keep_last_n`` retention policy after a commit."""
+        if self.keep_last_n is None:
+            return
+        steps = self.iterations()
+        keep = set(steps[-self.keep_last_n:])
+        keep.add(just_saved)
+        with self._lock:
+            if self._pinned is not None:
+                keep.add(self._pinned)
+        for it in steps:
+            if it not in keep:
+                shutil.rmtree(self._step_dir(it), ignore_errors=True)
 
     def save(
         self,
@@ -292,10 +531,21 @@ class CheckpointManager:
             }
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            # fsync payloads + the directories on both sides of the rename:
+            # the emergency (preemption) save path relies on a committed
+            # step surviving an immediately-following power-off/kill, not
+            # just an orderly process exit.
+            for name in ("user.npy", "movie.npy"):
+                _fsync_file(os.path.join(tmp, name))
+            _fsync_dir(tmp)
             final = self._step_dir(iteration)
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)
+            _fsync_dir(self.directory)
+            self._retain(iteration)
             return final
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)

@@ -136,6 +136,15 @@ def make_parser() -> argparse.ArgumentParser:
                    "worst case) — the s/iter delta vs 'off' is the "
                    "sentinel's overhead, budgeted < 2%")
     p.add_argument("--health-norm-limit", type=float, default=1e6)
+    p.add_argument("--ckpt", default=None, choices=[None, "sync", "async"],
+                   help="checkpoint-writer A/B axis: step per-iteration "
+                   "from the host with a save after every iteration — "
+                   "'sync' serializes+fsyncs in the step loop, 'async' "
+                   "hands the disk work to CheckpointManager's background "
+                   "writer (cfk_tpu.transport.checkpoint.save_async).  The "
+                   "timed call includes the in-loop save stalls, so the "
+                   "sync−async s/iter delta is the save stall removed from "
+                   "the step loop; bytes on disk are identical")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -241,26 +250,29 @@ def run_lab(args) -> dict:
 
     import functools
 
+    def _iteration(u, m_prev, mblk, ublk):
+        if args.ials:
+            from cfk_tpu.models.ials import _ials_iteration_body
+
+            return _ials_iteration_body(
+                u, m_prev, mblk, ublk,
+                lam=0.05, alpha=args.alpha, dt=jax.numpy.dtype(dt),
+                solver=args.solver, algorithm="als", block_size=32,
+                sweeps=1, **layout_kw,
+            )
+        return als_mod._iteration_body(
+            u, mblk, ublk,
+            lam=0.05, solve_chunk=None, dt=jax.numpy.dtype(dt),
+            solver=args.solver, m_prev=m_prev, **layout_kw,
+        )
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def steps(u, m, mblk, ublk):
         # Blocks are jit ARGUMENTS, not closure captures — capturing them
         # would bake 2.4 GB of constants into the executable and blow up
         # compile time (exactly what the real trainers avoid).
         def one(i, u, m_prev):
-            if args.ials:
-                from cfk_tpu.models.ials import _ials_iteration_body
-
-                return _ials_iteration_body(
-                    u, m_prev, mblk, ublk,
-                    lam=0.05, alpha=args.alpha, dt=jax.numpy.dtype(dt),
-                    solver=args.solver, algorithm="als", block_size=32,
-                    sweeps=1, **layout_kw,
-                )
-            return als_mod._iteration_body(
-                u, mblk, ublk,
-                lam=0.05, solve_chunk=None, dt=jax.numpy.dtype(dt),
-                solver=args.solver, m_prev=m_prev, **layout_kw,
-            )
+            return _iteration(u, m_prev, mblk, ublk)
 
         if args.health == "off":
             return jax.lax.fori_loop(
@@ -286,6 +298,48 @@ def run_lab(args) -> dict:
         return u, m
 
     steps_bound = functools.partial(steps, mblk=mblocks, ublk=ublocks)
+
+    ckpt_mgr = None
+    ckpt_save_s = [0.0]
+    ckpt_saves = [0]
+    if args.ckpt:
+        # Checkpoint axis: per-iteration host stepping (the save cadence
+        # needs the host between iterations, exactly like the resilient
+        # trainer loops) with a save after every iteration.  The timed
+        # call therefore INCLUDES the in-loop save stalls — the quantity
+        # the sync/async writer axis moves.
+        import tempfile
+
+        from cfk_tpu.transport.checkpoint import CheckpointManager
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def one_step(u, m, mblk, ublk):
+            return _iteration(u, m, mblk, ublk)
+
+        one_bound = functools.partial(one_step, mblk=mblocks, ublk=ublocks)
+        ckpt_dir = tempfile.mkdtemp(prefix="cfk_perf_ckpt_")
+        # keep_last_n bounds the disk this sweep burns at full shape
+        ckpt_mgr = CheckpointManager(
+            ckpt_dir, async_write=args.ckpt == "async", keep_last_n=4,
+        )
+
+        def ckpt_steps(u, m):
+            for _ in range(args.iters):
+                u, m = one_bound(u, m)
+                # Drain the device BEFORE the save timer so the per-save
+                # stall attributes only host-side checkpoint work, not the
+                # async-dispatched compute it would otherwise wait on.
+                u.block_until_ready()
+                ckpt_saves[0] += 1
+                t0 = time.time()
+                if args.ckpt == "async":
+                    ckpt_mgr.save_async(ckpt_saves[0], u, m)
+                else:
+                    ckpt_mgr.save(ckpt_saves[0], u, m)
+                ckpt_save_s[0] += time.time() - t0
+            return u, m
+
+        steps_bound = ckpt_steps
 
     t0 = time.time()
     u, m = steps_bound(u0, m0)
@@ -322,8 +376,18 @@ def run_lab(args) -> dict:
         "gram_backend": args.gram_backend, "rank": args.rank,
         "iters_per_call": args.iters, "overlap": args.overlap,
         "fused": args.fused, "health": args.health,
-        "gather": args.gather,
+        "gather": args.gather, "ckpt": args.ckpt,
     }
+    if ckpt_mgr is not None:
+        import shutil
+
+        t0 = time.time()
+        ckpt_mgr.wait_pending()
+        row["ckpt_drain_s"] = round(time.time() - t0, 4)
+        row["ckpt_save_stall_s_per_save"] = round(
+            ckpt_save_s[0] / max(ckpt_saves[0], 1), 5
+        )
+        shutil.rmtree(ckpt_mgr.directory, ignore_errors=True)
     print(json.dumps(row))
     return row
 

@@ -1,44 +1,313 @@
-"""Worker process for the multi-host integration test (not a pytest module).
+"""Worker process for the multi-host integration tests (not a pytest module).
 
-Usage: python tests/multihost_worker.py PROCESS_ID NUM_PROCESSES PORT
+Usage: python tests/multihost_worker.py PROCESS_ID NUM_PROCESSES PORT \
+           [CKDIR] [--drill MODE] [...]
 
 Each process owns 4 virtual CPU devices (XLA_FLAGS set by the spawner);
 ``initialize_distributed`` wires them into one runtime, Gloo carries the
 cross-process collectives (the DCN stand-in), and the full sharded trainer
 runs over a ``make_multihost_mesh``.  Process 0 prints the resulting RMSE
 for the driver to compare with a single-process run.
+
+``--drill`` selects the preemption-tolerance drills (ISSUE 5):
+
+- ``lockstep`` — inject a ``FactorCorruption`` whose rows live entirely in
+  process 1's shard and assert (driver-side) that BOTH processes take the
+  identical rollback/escalation path: the psum'd probe word is fully
+  replicated, so detection is global even though the fault is local.  Every
+  process prints its recovery trace + a factor crc32 per phase.
+- ``kill`` — process 1 SIGKILLs itself mid-run (no warning, like a hard
+  preemption); the survivor must detect the dead collective (Gloo error or
+  the ``StallWatchdog`` timeout) within a bound and exit
+  ``STALL_EXIT_CODE`` with the checkpoint store intact.
+- ``resume`` — restart both workers after ``kill``: training resumes from
+  the surviving checkpoints and must reach the uninterrupted run's RMSE.
+- ``init-timeout`` — start ONE process of a declared 2-process fleet and
+  assert ``initialize_distributed(init_timeout_s=...)`` raises the
+  actionable missing-peer error instead of hanging for the 300 s default.
 """
 
+import argparse
+import json
+import os
+import signal
+import subprocess
 import sys
+import warnings
+import zlib
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def main() -> None:
-    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+def spawn_workers(port, nprocs=2, ckdir=None, *extra, pids=None):
+    """Spawn worker processes — the ONE launch harness shared by the
+    pytest drills (tests/test_multihost.py) and the operator chaos runner
+    (scripts/chaos_lab.py), so env/argv/Popen wiring cannot diverge."""
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    procs = []
+    for pid in (range(nprocs) if pids is None else pids):
+        argv = [sys.executable,
+                os.path.join(_ROOT, "tests", "multihost_worker.py"),
+                str(pid), str(nprocs), str(port)]
+        if ckdir is not None:
+            argv.append(ckdir)
+        argv += list(extra)
+        procs.append(subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, cwd=_ROOT,
+        ))
+    return procs
+
+
+def communicate_all(procs, timeout=540):
+    """Bounded wait on a worker fleet (the 540 s pattern); always kills
+    leftovers so a wedged drill fails instead of hanging the suite."""
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out.decode())
+    finally:
+        for p in procs:
+            p.kill()
+    return outs
+
+
+def _crc(u, m) -> str:
+    import numpy as np
+
+    crc = zlib.crc32(np.ascontiguousarray(u, np.float32).tobytes())
+    crc = zlib.crc32(np.ascontiguousarray(m, np.float32).tobytes(), crc)
+    return f"{crc:08x}"
+
+
+def _recovery_trace(metrics) -> dict:
+    """The recovery decisions one process took, in a canonical shape the
+    driver compares byte-for-byte across processes."""
+    return {
+        "trips": int(metrics.counters.get("health_trips", 0)),
+        "rollbacks": int(metrics.counters.get("rollbacks", 0)),
+        "escalation_level": int(metrics.gauges.get("escalation_level", 0)),
+        "degraded": int(metrics.gauges.get("degraded", 0)),
+        # rung-by-rung ladder decisions, in order
+        "rungs": [v for k, v in sorted(metrics.notes.items())
+                  if k.startswith("escalation_")],
+        "trip_reasons": [v for k, v in sorted(metrics.notes.items())
+                         if k.startswith("health_trip_")],
+    }
+
+
+def _drill_dataset(n):
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+
+    # Synthetic: the drills must run where the reference sample files are
+    # absent, and the shape keeps a 2-process Gloo run under a minute.
+    return Dataset.from_coo(
+        synthetic_netflix_coo(64, 32, 900, seed=0), num_shards=n
+    )
+
+
+def drill_lockstep(pid: int, mesh, n: int) -> None:
+    import dataclasses
+
+    import jax
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.parallel.spmd import train_als_sharded
+    from cfk_tpu.resilience.faults import FactorCorruption, FaultInjector
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = _drill_dataset(n)
+    cfg = ALSConfig(rank=4, lam=0.05, num_iterations=5, seed=0,
+                    num_shards=n, health_check_every=1, max_recoveries=3)
+    e_pad = ds.user_blocks.padded_entities
+    # Rows entirely inside process 1's shard: entity rows are contiguously
+    # block-sharded in ring_order, so the second half of the padded range
+    # lives on process 1's four devices.
+    lo = e_pad // 2 + e_pad // 8
+    fault_rows = (lo, min(lo + 4, e_pad))
+    assert jax.process_index() == pid
+
+    def run(phase, fault):
+        inj = FaultInjector(*([] if fault is None else [fault]))
+        metrics = Metrics()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = train_als_sharded(
+                ds, cfg, mesh, metrics=metrics, fault_injector=inj
+            )
+        u, m = model.host_factors()
+        trace = _recovery_trace(metrics)
+        trace["fired"] = int(inj.fired)
+        print("DRILL_LOCKSTEP " + json.dumps(
+            {"pid": pid, "phase": phase, "crc": _crc(u, m), **trace},
+            sort_keys=True,
+        ), flush=True)
+
+    run("faultfree", None)
+    # One-shot local corruption: both processes must detect via the
+    # replicated probe word, roll back once, and land bit-identical on the
+    # fault-free trajectory.
+    run("oneshot", FactorCorruption(
+        iteration=2, side="u", rows=fault_rows, persistent=False,
+    ))
+    # Persistent local corruption: unfixable by escalation — both processes
+    # must climb the SAME ladder rung sequence and degrade to the same
+    # last-good factors.
+    run("persistent", FactorCorruption(
+        iteration=2, side="u", rows=fault_rows, persistent=True,
+    ))
+
+
+def drill_kill(pid: int, mesh, n: int, ckdir: str, kill_iteration: int,
+               stall_timeout: float, resume: bool) -> None:
+    import jax
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.eval.metrics import mse_rmse_from_blocks
+    from cfk_tpu.parallel.spmd import train_als_sharded
+    from cfk_tpu.resilience.faults import FaultInjector, PreemptAt
+    from cfk_tpu.resilience.preempt import STALL_EXIT_CODE, StallWatchdog
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = _drill_dataset(n)
+    cfg = ALSConfig(rank=4, lam=0.05, num_iterations=8, seed=0,
+                    num_shards=n, health_check_every=1)
+    manager = CheckpointManager(ckdir)
+
+    if resume:
+        metrics = Metrics()
+        model = train_als_sharded(
+            ds, cfg, mesh, checkpoint_manager=manager, metrics=metrics
+        )
+        mse, rmse = mse_rmse_from_blocks(model.predict_dense(), ds)
+        if jax.process_index() == 0:
+            print(f"DRILL_RESUME mse={mse:.6f} rmse={rmse:.6f} "
+                  f"resumed_from={metrics.counters.get('iterations', 0)}",
+                  flush=True)
+        return
+
+    class _ReportingWatchdog(StallWatchdog):
+        def tick(self, done=None):
+            super().tick(done)
+            print(f"DRILL_ITER pid={pid} done={done}", flush=True)
+
+    wd = _ReportingWatchdog(stall_timeout, manager=manager)
+    # Process 1 is SIGKILL'd before iteration ``kill_iteration`` — a hard
+    # preemption with no grace signal.  The survivor's next collective has
+    # a dead peer: either Gloo errors out (caught below) or nothing
+    # progresses and the watchdog expires; both paths drain the async
+    # writer and exit STALL_EXIT_CODE with only committed steps on disk.
+    inj = FaultInjector(PreemptAt(
+        iteration=kill_iteration, signum=signal.SIGKILL, only_process=1,
+    ))
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            train_als_sharded(
+                ds, cfg, mesh, checkpoint_manager=manager,
+                fault_injector=inj, watchdog=wd,
+            )
+    except Exception as e:
+        wd.disarm()
+        try:
+            manager.wait_pending(timeout=30.0)
+        except Exception:
+            pass
+        print(f"DRILL_COLLECTIVE_ERROR pid={pid} "
+              f"error={type(e).__name__}", flush=True)
+        # os._exit, NOT sys.exit: the interpreter's atexit would run jax's
+        # distributed shutdown, whose coordination barrier fails against
+        # the dead peer and ABORTS the process (client.h:80, measured) —
+        # clobbering the deliberate exit status.  The async checkpoint
+        # writer is already drained above, so skipping atexit loses
+        # nothing.
+        sys.stdout.flush()
+        os._exit(STALL_EXIT_CODE)
+    print(f"DRILL_KILL_COMPLETED pid={pid}", flush=True)
+
+
+def drill_preempt(pid: int, mesh, n: int, ckdir: str,
+                  preempt_iteration: int) -> None:
+    """SIGTERM exactly ONE process: the evict_sync allgather must make
+    BOTH processes agree on the eviction boundary, run the emergency
+    save's collectives in lockstep, and exit resumable."""
+    import jax
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.parallel.spmd import train_als_sharded
+    from cfk_tpu.resilience.faults import FaultInjector, PreemptAt
+    from cfk_tpu.resilience.preempt import PreemptionGuard
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = _drill_dataset(n)
+    cfg = ALSConfig(rank=4, lam=0.05, num_iterations=8, seed=0,
+                    num_shards=n, health_check_every=1)
+    manager = CheckpointManager(ckdir)
+    inj = FaultInjector(PreemptAt(
+        iteration=preempt_iteration, signum=signal.SIGTERM, only_process=1,
+    ))
+    metrics = Metrics()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with PreemptionGuard() as guard:
+            train_als_sharded(
+                ds, cfg, mesh, checkpoint_manager=manager,
+                fault_injector=inj, metrics=metrics,
+                preemption_guard=guard,
+            )
+    print("DRILL_PREEMPT " + json.dumps({
+        "pid": pid,
+        "locally_signalled": bool(guard.triggered),
+        "preempted": int(metrics.gauges.get("preempted", 0)),
+        "trained_iterations": int(
+            metrics.gauges.get("trained_iterations", -1)
+        ),
+        "note": metrics.notes.get("preempted", ""),
+    }, sort_keys=True), flush=True)
+
+
+def drill_init_timeout(pid: int, nprocs: int, port: int,
+                       timeout_s: float) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from cfk_tpu.parallel.mesh import initialize_distributed
 
-    from cfk_tpu.parallel.mesh import initialize_distributed, make_multihost_mesh
+    try:
+        initialize_distributed(
+            f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid,
+            init_timeout_s=timeout_s,
+        )
+    except TimeoutError as e:
+        print(f"DRILL_INIT_TIMEOUT actionable={'missing peer' in str(e)} "
+              f"msg={e}", flush=True)
+        return
+    print("DRILL_INIT_TIMEOUT actionable=False msg=no timeout raised",
+          flush=True)
+    sys.exit(1)
 
-    got = initialize_distributed(
-        f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
-    )
-    assert got == nprocs, (got, nprocs)
+
+def legacy_main(pid, nprocs, mesh, n, ckdir) -> None:
+    import jax
 
     from cfk_tpu import ALSConfig, parse_netflix
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.eval.metrics import mse_rmse_from_blocks
     from cfk_tpu.parallel.spmd import train_als_sharded
-
     from cfk_tpu.transport.checkpoint import CheckpointManager
 
-    n = jax.device_count()
     coo = parse_netflix("/root/reference/data/data_sample_tiny.txt")
     dataset = Dataset.from_coo(coo, num_shards=n)
     config = ALSConfig(rank=5, lam=0.05, num_iterations=7, seed=0, num_shards=n)
-    mesh = make_multihost_mesh()
-    ckdir = sys.argv[4] if len(sys.argv) > 4 else None
     manager = CheckpointManager(ckdir) if ckdir else None
     model = train_als_sharded(
         dataset, config, mesh, checkpoint_manager=manager
@@ -83,6 +352,55 @@ def main() -> None:
     if jax.process_index() == 0:
         print(f"MULTIHOST_RESULT mse={mse:.6f} rmse={rmse:.6f} devices={n}")
         print(f"MULTIHOST_TILED mse_auto={mse_t:.6f} mse_dense={mse_d:.6f}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("pid", type=int)
+    p.add_argument("nprocs", type=int)
+    p.add_argument("port", type=int)
+    p.add_argument("ckdir", nargs="?", default=None)
+    p.add_argument("--drill", default=None,
+                   choices=["lockstep", "kill", "resume", "preempt",
+                            "init-timeout"])
+    p.add_argument("--kill-iteration", type=int, default=4)
+    p.add_argument("--preempt-iteration", type=int, default=3)
+    p.add_argument("--stall-timeout", type=float, default=10.0)
+    p.add_argument("--init-timeout", type=float, default=6.0)
+    args = p.parse_args()
+
+    if args.drill == "init-timeout":
+        drill_init_timeout(args.pid, args.nprocs, args.port,
+                           args.init_timeout)
+        return
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from cfk_tpu.parallel.mesh import initialize_distributed, make_multihost_mesh
+
+    got = initialize_distributed(
+        f"127.0.0.1:{args.port}", num_processes=args.nprocs,
+        process_id=args.pid, init_timeout_s=120,
+    )
+    assert got == args.nprocs, (got, args.nprocs)
+    mesh = make_multihost_mesh()
+    n = jax.device_count()
+
+    if args.drill == "lockstep":
+        drill_lockstep(args.pid, mesh, n)
+    elif args.drill == "preempt":
+        assert args.ckdir, "preempt drill needs a checkpoint dir"
+        drill_preempt(args.pid, mesh, n, args.ckdir,
+                      args.preempt_iteration)
+    elif args.drill in ("kill", "resume"):
+        assert args.ckdir, "kill/resume drills need a checkpoint dir"
+        drill_kill(args.pid, mesh, n, args.ckdir, args.kill_iteration,
+                   args.stall_timeout, resume=args.drill == "resume")
+    else:
+        legacy_main(args.pid, args.nprocs, mesh, n, args.ckdir)
 
 
 if __name__ == "__main__":

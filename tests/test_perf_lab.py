@@ -25,7 +25,7 @@ def _args(**over):
         tiled_gram_backend=None, group_tiles=None, reg_solve_algo=None,
         ials=False, alpha=40.0, accum_chunk_elems=None, dense_stream=False,
         overlap="on", fused="on", gather="fused", health="off",
-        health_norm_limit=1e6,
+        health_norm_limit=1e6, ckpt=None,
         iters=2, repeats=3, profile_dir=None,
     )
     base.update(over)
@@ -124,3 +124,27 @@ def test_health_axis_row(tmp_path, monkeypatch):
         perf_lab.CACHE_ROOT = old
     assert on["health"] == "on" and off["health"] == "off"
     assert on["s_per_iter_min"] >= 0
+
+
+def test_ckpt_axis_row(tmp_path, monkeypatch):
+    import contextlib
+    import io
+
+    # the checkpoint-writer axis (ISSUE 5): per-iteration saves ride the
+    # timed call, and the row records the in-loop save stall + drain
+    perf_lab.CACHE_ROOT, old = str(tmp_path), perf_lab.CACHE_ROOT
+    try:
+        with contextlib.redirect_stdout(io.StringIO()):
+            a = perf_lab.run_lab(_args(ckpt="async"))
+            s = perf_lab.run_lab(_args(ckpt="sync"))
+    finally:
+        perf_lab.CACHE_ROOT = old
+    assert a["ckpt"] == "async" and s["ckpt"] == "sync"
+    for row in (a, s):
+        assert row["ckpt_save_stall_s_per_save"] >= 0
+        assert row["ckpt_drain_s"] >= 0
+        assert row["s_per_iter_min"] >= 0
+    # NO relative sync-vs-async timing assert here: at this toy shape the
+    # steps are ~ms while fsync dominates, so back-pressure makes the two
+    # writers near-equal and noise flips the sign — the measured win lives
+    # in bench.py --ckpt-ab at a real shape, where compute hides the disk.

@@ -679,6 +679,244 @@ def test_managerless_probe_follows_health_cadence(small_dataset):
     assert metrics.counters["health_checks"] == 3
 
 
+# --- async checkpoint writer / preemption (ISSUE 5) ------------------------
+
+
+def test_save_async_commits_identical_bytes(tmp_path):
+    u = np.arange(12, dtype=np.float32).reshape(4, 3)
+    m = np.arange(15, dtype=np.float32).reshape(5, 3)
+    sync_mgr = CheckpointManager(str(tmp_path / "sync"), async_write=False)
+    async_mgr = CheckpointManager(str(tmp_path / "async"))
+    sync_mgr.save(1, u, m, meta={"model": "als"})
+    async_mgr.save_async(1, u, m, meta={"model": "als"})
+    assert async_mgr.wait_pending()
+    a = async_mgr.restore()
+    s = sync_mgr.restore()
+    np.testing.assert_array_equal(a.user_factors, s.user_factors)
+    np.testing.assert_array_equal(a.movie_factors, s.movie_factors)
+    # crc-verified commit, same integrity contract as the sync path
+    async_mgr.verify(1)
+
+
+def test_save_async_snapshot_isolated_from_caller_mutation(tmp_path):
+    from cfk_tpu.resilience.faults import SlowDiskCheckpointManager
+
+    mgr = SlowDiskCheckpointManager(str(tmp_path), delay_s=0.1)
+    u = np.ones((4, 3), np.float32)
+    m = np.ones((5, 3), np.float32)
+    mgr.save_async(1, u, m)
+    u[:] = -1.0  # mutate while the write is still queued/sleeping
+    mgr.wait_pending()
+    assert np.all(mgr.restore().user_factors == 1.0)
+
+
+def test_slow_writer_back_pressure_bounds_pending(tmp_path):
+    import time
+
+    from cfk_tpu.resilience.faults import SlowDiskCheckpointManager
+
+    delay = 0.1
+    mgr = SlowDiskCheckpointManager(
+        str(tmp_path), delay_s=delay, max_pending=2
+    )
+    u = np.ones((4, 3), np.float32)
+    m = np.ones((5, 3), np.float32)
+    t0 = time.monotonic()
+    for it in range(1, 6):
+        mgr.save_async(it, u, m)
+        assert mgr.pending_count <= 2  # never more queued+in-flight than cap
+    enqueue_s = time.monotonic() - t0
+    # 5 saves against a cap of 2: the producer must have blocked for ~3
+    # write slots (back-pressure), not returned instantly
+    assert enqueue_s >= 2.5 * delay, enqueue_s
+    assert mgr.wait_pending()
+    assert mgr.iterations() == [1, 2, 3, 4, 5]
+    assert mgr.writes == 5
+
+
+def test_process_exit_with_pending_write_drains_not_tears(tmp_path):
+    from cfk_tpu.resilience.faults import SlowDiskCheckpointManager
+    from cfk_tpu.transport import checkpoint as ckpt_mod
+
+    mgr = SlowDiskCheckpointManager(str(tmp_path), delay_s=0.15)
+    mgr.save_async(1, np.ones((4, 3), np.float32),
+                   np.ones((5, 3), np.float32))
+    assert mgr.pending_count >= 1
+    # the registered atexit hook drains every live writer: the enqueued
+    # step must be committed (and crc-intact), never lost or torn
+    ckpt_mod._drain_writers_at_exit()
+    assert mgr.pending_count == 0
+    assert mgr.iterations() == [1]
+    mgr.verify(1)
+
+
+def test_async_writer_error_is_sticky_not_silent(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+
+    def boom(*a, **kw):
+        raise OSError("disk full")
+
+    mgr.save = boom
+    mgr.save_async(1, np.ones((2, 2), np.float32),
+                   np.ones((2, 2), np.float32))
+    with pytest.raises(OSError, match="disk full"):
+        mgr.wait_pending()
+    # the error is consumed once surfaced; the writer stays usable
+    assert mgr.wait_pending()
+
+
+def test_save_async_racing_rollback_stays_intact(small_dataset, tmp_path):
+    """A trip while async writes are in flight: the loop's drain barrier
+    runs before the rollback replay re-saves the same step numbers, so the
+    store can never commit old bytes over new — recovery lands bit-exact
+    on the fault-free trajectory with every step verifying."""
+    from cfk_tpu.resilience.faults import SlowDiskCheckpointManager
+
+    cfg = ALSConfig(rank=3, num_iterations=5, health_check_every=1)
+    base = train_als(small_dataset, cfg).host_factors()
+    mgr = SlowDiskCheckpointManager(str(tmp_path), delay_s=0.05)
+    inj = FaultInjector(FactorCorruption(iteration=2, side="u"))
+    metrics = Metrics()
+    rec = _quiet_train(
+        small_dataset, cfg, checkpoint_manager=mgr,
+        fault_injector=inj, metrics=metrics,
+    ).host_factors()
+    assert metrics.counters["rollbacks"] == 1
+    assert_close(base[0], rec[0])
+    assert_close(base[1], rec[1])
+    reader = CheckpointManager(str(tmp_path))
+    for it in reader.iterations():
+        reader.verify(it)
+    assert reader.restore().iteration == 5
+
+
+def test_sigterm_during_pending_save_drains_then_exits(
+    small_dataset, tmp_path
+):
+    """SIGTERM lands while the async writer still holds queued saves: the
+    loop must drain them AND commit the final emergency checkpoint before
+    returning — resume then completes onto the uninterrupted trajectory."""
+    from cfk_tpu.resilience.faults import (
+        PreemptAt,
+        SlowDiskCheckpointManager,
+    )
+    from cfk_tpu.resilience.preempt import PreemptionGuard
+
+    cfg = ALSConfig(rank=3, num_iterations=6, health_check_every=1)
+    base = train_als(small_dataset, cfg).host_factors()
+    mgr = SlowDiskCheckpointManager(str(tmp_path), delay_s=0.05)
+    inj = FaultInjector(PreemptAt(iteration=3))
+    metrics = Metrics()
+    with PreemptionGuard() as guard:
+        _quiet_train(
+            small_dataset, cfg, checkpoint_manager=mgr,
+            fault_injector=inj, metrics=metrics, preemption_guard=guard,
+        )
+    assert guard.triggered and guard.signal_name == "SIGTERM"
+    assert metrics.gauges["preempted"] == 1
+    assert "preempted" in metrics.notes
+    assert mgr.pending_count == 0  # drained before the loop returned
+    reader = CheckpointManager(str(tmp_path))
+    assert reader.restore().iteration == 4  # the emergency save committed
+    for it in reader.iterations():
+        reader.verify(it)
+    resumed = train_als(
+        small_dataset, cfg, checkpoint_manager=CheckpointManager(str(tmp_path)),
+    ).host_factors()
+    assert_close(base[0], resumed[0])
+    assert_close(base[1], resumed[1])
+
+
+def test_keep_last_n_retention_pins_anchor(tmp_path):
+    u = np.ones((4, 3), np.float32)
+    m = np.ones((5, 3), np.float32)
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2, async_write=False)
+    for it in range(1, 5):
+        mgr.save(it, u, m)
+    assert mgr.iterations() == [3, 4]  # old steps collected
+    mgr.pin(3)
+    mgr.save(5, u, m)
+    mgr.save(6, u, m)
+    # newest two plus the pinned recovery anchor survive
+    assert mgr.iterations() == [3, 5, 6]
+    with pytest.raises(ValueError, match="keep_last_n"):
+        CheckpointManager(str(tmp_path), keep_last_n=0)
+
+
+def test_retention_during_training_keeps_resume_point(
+    small_dataset, tmp_path
+):
+    cfg = ALSConfig(rank=3, num_iterations=6)
+    base = train_als(small_dataset, cfg).host_factors()
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    train_als(small_dataset, cfg, checkpoint_manager=mgr)
+    steps = CheckpointManager(str(tmp_path)).iterations()
+    assert len(steps) <= 3 and max(steps) == 6  # disk bounded, latest kept
+    resumed = train_als(
+        small_dataset, cfg,
+        checkpoint_manager=CheckpointManager(str(tmp_path)),
+    ).host_factors()
+    assert_close(base[0], resumed[0])
+
+
+def test_resume_num_shards_mismatch_rejected(tmp_path):
+    from cfk_tpu.transport.checkpoint import resume_state
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, np.ones((8, 3), np.float32), np.ones((8, 3), np.float32),
+             meta={"model": "als", "num_shards": 4})
+    # SAME shapes — only the recorded shard count differs; the shape check
+    # alone would wave this stale-padded checkpoint through
+    with pytest.raises(ValueError, match="num_shards=4"):
+        resume_state(
+            mgr, rank=3, model="als", num_iterations=5,
+            u_shape=(8, 3), m_shape=(8, 3), num_shards=2,
+        )
+    # matching shard count passes; legacy checkpoints without the field too
+    state = resume_state(
+        mgr, rank=3, model="als", num_iterations=5,
+        u_shape=(8, 3), m_shape=(8, 3), num_shards=4,
+    )
+    assert state is not None and state.iteration == 1
+
+
+def test_preemption_guard_restores_handlers_and_chains():
+    import signal as _signal
+
+    prev = _signal.getsignal(_signal.SIGTERM)
+    from cfk_tpu.resilience.preempt import PreemptionGuard
+
+    with PreemptionGuard() as g:
+        assert not g.triggered
+        g.trigger()
+        assert g.triggered and g.signal_name == "manual"
+    assert _signal.getsignal(_signal.SIGTERM) == prev
+
+
+def test_stall_watchdog_tick_keeps_alive_and_stall_fires():
+    import time
+
+    from cfk_tpu.resilience.preempt import StallWatchdog
+
+    fired = []
+
+    class Probe(StallWatchdog):
+        def _stall_exit(self):  # never os._exit in a test process
+            fired.append(self.last_done)
+
+    wd = Probe(0.3)
+    wd.arm()
+    for i in range(4):  # steady ticks outlive several timeout windows
+        time.sleep(0.15)
+        wd.tick(i)
+    assert not wd.stalled
+    time.sleep(0.8)  # no ticks: the watchdog must fire
+    assert wd.stalled and fired == [3]
+    wd.disarm()
+    with pytest.raises(ValueError, match="timeout_s"):
+        StallWatchdog(0)
+
+
 def test_fused_trip_accounting_not_double_counted():
     # the discarded fused attempt's time moves to train_discarded and its
     # iterations are not counted toward the headline counter
