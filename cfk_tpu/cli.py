@@ -1,6 +1,6 @@
 """Command-line interface.
 
-Three subcommands:
+Subcommands:
 
 - ``run`` — reference-compatible positional form, mirroring
   ``apps/ALSAppRunner.java:16-28`` / README.md:35 of the reference:
@@ -20,6 +20,11 @@ Three subcommands:
   ratings file into it; ``train --data tcp://HOST:PORT[/TOPIC]`` then
   ingests from the broker (the reference's producer → Kafka → app split,
   ``apps/ALSAppRunner.java:30-33``, as separate processes).
+- ``stream`` — exactly-once streaming fold-in: consume rating updates
+  from a durable topic and fold them into live factors, committing
+  factors + offset cursor atomically per micro-batch; ``--produce-csv``
+  is the producer side (``cfk_tpu.streaming``; ARCHITECTURE.md
+  "Streaming ingest & incremental fold-in").
 """
 
 from __future__ import annotations
@@ -835,6 +840,185 @@ def _produce(args) -> int:
     return 0
 
 
+def _updates_transport(updates: str, *, fsync: bool = True):
+    """Transport for --updates: tcp://HOST:PORT broker or a FileBroker
+    directory (the durable default — the updates topic is the system of
+    record the crash replay consumes)."""
+    if updates.startswith("tcp://"):
+        from cfk_tpu.transport.tcp import TcpBrokerClient
+
+        host, port, _ = _parse_tcp_url(updates, topic_optional=True)
+        return TcpBrokerClient(host, port)
+    from cfk_tpu.transport.filelog import FileBroker
+
+    return FileBroker(updates, fsync=fsync)
+
+
+def _stream(args) -> int:
+    """Streaming fold-in: consume rating updates, fold them into live
+    factors, commit factors + offset cursor atomically per micro-batch.
+
+    Bootstrap: with no resumable state in --stream-dir, a base model is
+    trained from --data first (same config), then streaming starts from
+    offset 0.  Re-running the identical command resumes from the committed
+    cursor — including after a crash or an eviction SIGTERM.
+    ``--produce-csv`` instead appends "user,movie,rating" lines to the
+    updates topic and exits (the producer side of the loop).
+    """
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.utils.metrics import Metrics
+
+    try:
+        transport = _updates_transport(args.updates)
+    except (ValueError, OSError) as e:
+        _eprint(f"error: {e}")
+        return 2
+    if args.produce_csv:
+        from cfk_tpu.streaming import StreamProducer
+
+        prod = StreamProducer(
+            transport, num_partitions=args.partitions
+        )
+        # Parse the whole file first, then one bulk append per partition
+        # (send_many → FileBroker.produce_frames): per-line send() pays
+        # one fsync'd append each — minutes for a 100k-line file — and
+        # parse-before-produce also makes a malformed line all-or-nothing
+        # instead of leaving a half-produced file in the log.
+        users: list[int] = []
+        movies: list[int] = []
+        ratings: list[float] = []
+        with open(args.produce_csv) as f:
+            for lineno, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    user_s, movie_s, rating_s = line.split(",", 2)
+                    users.append(int(user_s))
+                    movies.append(int(movie_s))
+                    ratings.append(float(rating_s))
+                except ValueError as e:
+                    _eprint(
+                        f"error: {args.produce_csv}:{lineno}: malformed "
+                        f"update {line!r} ({e})"
+                    )
+                    return 1
+        prod.send_many(users, movies, ratings)
+        n = len(users)
+        if hasattr(transport, "flush"):
+            transport.flush()
+        _eprint(f"produced {n} updates (next seq {prod.next_seq})")
+        return 0
+
+    from cfk_tpu.streaming import StreamConfig, StreamSession
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    metrics = Metrics()
+    config = ALSConfig(
+        rank=args.rank,
+        lam=args.lam,
+        num_iterations=args.iterations,
+        seed=args.seed,
+        layout=args.layout,
+        solver=args.solver,
+        dtype=args.dtype,
+        # threaded so retrain()'s merged-dataset rebuild honors the same
+        # HBM chunk budget as the base dataset built below
+        hbm_chunk_elems=args.chunk_elems,
+        health_check_every=args.health_check_every,
+        health_norm_limit=args.health_norm_limit,
+        max_recoveries=args.max_recoveries,
+        lam_escalation=args.lam_escalation,
+        on_unrecoverable=args.on_unrecoverable,
+    )
+    # Ensure the topic BEFORE the (possibly hours-long) base train: a
+    # fresh topic is created empty and followed, instead of training a
+    # base model only to crash on an unknown-topic lookup afterwards.
+    from cfk_tpu.streaming import ensure_updates_topic
+
+    ensure_updates_topic(transport, num_partitions=args.partitions)
+    with metrics.phase("ingest"):
+        ds = _load_dataset(
+            args.data, args.format, args.min_rating, 1, 8,
+            args.layout, args.chunk_elems,
+            cache_dir=args.dataset_cache,
+            dense_stream=args.layout == "tiled",
+        )
+    manager = CheckpointManager(
+        args.stream_dir, keep_last_n=args.keep_last_n
+    )
+    base_model = None
+    if manager.latest_valid_iteration() is None:
+        _eprint("no stream state yet: training the base model first")
+        from cfk_tpu.models.als import train_als
+
+        with metrics.phase("base_train"):
+            base_model = train_als(ds, config, metrics=metrics)
+    stream = StreamConfig(
+        batch_records=args.batch_records,
+        foldin_layout=args.foldin_layout,
+        retrain_every=args.retrain_every,
+    )
+    import contextlib
+
+    guard_cm = contextlib.nullcontext(None)
+    if not args.no_preempt_save:
+        from cfk_tpu.resilience.preempt import PreemptionGuard
+
+        guard_cm = PreemptionGuard()
+    with guard_cm as guard:
+        session = StreamSession(
+            ds, config, transport, manager, stream=stream,
+            base_model=base_model, metrics=metrics,
+            preemption_guard=guard,
+        )
+        model = session.run(
+            max_batches=args.max_batches, follow=args.follow
+        )
+    metrics.gauge("stream_step", session.stream_step)
+    metrics.gauge("users", session.state.num_users)
+    metrics.gauge("backlog", session.backlog())
+    if guard is not None and guard.triggered:
+        _eprint(
+            f"preempted ({guard.signal_name}): factor+cursor step "
+            f"{session.stream_step} is committed — re-run to resume"
+        )
+    elif not args.no_eval:
+        import dataclasses
+
+        from cfk_tpu.eval.metrics import mse_rmse_from_model
+
+        with metrics.phase("eval_mse"):
+            # against the merged (base + committed upserts) rating state;
+            # the merged dataset re-sorts ALL users ascending by raw id
+            # while session rows are base-ascending THEN appended new
+            # users, so the factors must be permuted into the merged row
+            # order (same perm the warm retrain applies) or every user
+            # past a new user's insertion point scores against the wrong
+            # row
+            from cfk_tpu.data.blocks import Dataset as _DS
+
+            merged = _DS.from_coo(session.state.to_coo())
+            perm = merged.user_map.to_dense(session.state.user_raw_ids())
+            u_sess = np.asarray(model.user_factors)
+            u_eval = np.zeros(
+                (merged.user_blocks.padded_entities, u_sess.shape[1]),
+                u_sess.dtype,
+            )
+            u_eval[perm] = u_sess[: session.state.num_users]
+            eval_model = dataclasses.replace(
+                model, user_factors=u_eval,
+                num_users=merged.user_map.num_entities,
+            )
+            mse, rmse = mse_rmse_from_model(eval_model, merged)
+        metrics.gauge("mse", round(mse, 6))
+        metrics.gauge("rmse", round(rmse, 6))
+        _eprint(f"merged-state MSE={mse:.4f} RMSE={rmse:.4f}")
+    print(metrics.json_line() if args.metrics == "json"
+          else metrics.logfmt())
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cfk_tpu", description=__doc__)
     p.add_argument(
@@ -1097,6 +1281,77 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the EOF fan-out, leaving the topic open for "
                     "more files; the final produce must omit this flag")
     pr.set_defaults(fn=_produce)
+
+    st = sub.add_parser(
+        "stream",
+        help="exactly-once streaming fold-in: consume rating updates and "
+        "fold them into live factors (rate → fold-in → resume)",
+    )
+    st.add_argument("--data", required=True,
+                    help="base ratings (the training corpus the stream "
+                    "updates; also the crash replay's state seed)")
+    st.add_argument("--format", choices=["netflix", "movielens"],
+                    default="netflix")
+    st.add_argument("--min-rating", type=float, default=0.0)
+    st.add_argument("--updates", required=True,
+                    help="the durable updates topic's home: a FileBroker "
+                    "directory or tcp://HOST:PORT (cfk_broker server)")
+    st.add_argument("--stream-dir", required=True,
+                    help="checkpoint store for the atomic factor+cursor "
+                    "commits; re-run with the same dir to resume")
+    st.add_argument("--produce-csv", default=None, metavar="FILE",
+                    help="producer mode: append 'user,movie,rating' lines "
+                    "from FILE to the updates topic and exit")
+    st.add_argument("--partitions", type=int, default=1,
+                    help="updates-topic partitions when creating it "
+                    "(--produce-csv on a fresh topic)")
+    st.add_argument("--rank", type=int, default=5)
+    st.add_argument("--lam", type=float, default=0.05)
+    st.add_argument("--iterations", type=int, default=7,
+                    help="base-train / warm-retrain iteration count")
+    st.add_argument("--seed", type=int, default=42)
+    st.add_argument("--layout", choices=["padded", "tiled"],
+                    default="padded",
+                    help="base dataset layout; also the fold-in default "
+                    "(tiled runs the at-scale fused kernels)")
+    st.add_argument("--foldin-layout", choices=["auto", "padded", "tiled"],
+                    default="auto",
+                    help="fold-in solve layout ('auto' follows --layout)")
+    st.add_argument("--solver", choices=["auto", "cholesky", "pallas"],
+                    default="auto")
+    st.add_argument("--dtype", choices=["float32", "bfloat16"],
+                    default="float32")
+    st.add_argument("--chunk-elems", type=int, default=1 << 20)
+    st.add_argument("--batch-records", type=int, default=256,
+                    help="log records per partition per micro-batch; part "
+                    "of the replay contract (committed with the cursor)")
+    st.add_argument("--max-batches", type=int, default=None,
+                    help="stop after N micro-batches (default: drain)")
+    st.add_argument("--follow", action="store_true",
+                    help="keep polling an idle topic instead of exiting "
+                    "when caught up")
+    st.add_argument("--retrain-every", type=int, default=None, metavar="N",
+                    help="warm full retrain (movie side included) every N "
+                    "stream commits, current factors as the seed")
+    st.add_argument("--health-check-every", type=int, default=1,
+                    help="probe every fold-in batch before commit "
+                    "(default 1; the ladder escalates on trips and "
+                    "quarantines batches that defeat it)")
+    st.add_argument("--health-norm-limit", type=float, default=1e6)
+    st.add_argument("--max-recoveries", type=int, default=4)
+    st.add_argument("--lam-escalation", type=float, default=10.0)
+    st.add_argument("--on-unrecoverable", choices=["degrade", "raise"],
+                    default="degrade")
+    st.add_argument("--keep-last-n", type=int, default=8,
+                    help="stream commits retained (per-batch commits grow "
+                    "fast; default 8, None-like large values keep more)")
+    st.add_argument("--no-preempt-save", action="store_true")
+    st.add_argument("--no-eval", action="store_true",
+                    help="skip the merged-state RMSE evaluation at exit")
+    st.add_argument("--dataset-cache", default=None)
+    st.add_argument("--metrics", choices=["json", "logfmt"],
+                    default="logfmt")
+    st.set_defaults(fn=_stream)
     return p
 
 

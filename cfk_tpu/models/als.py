@@ -475,6 +475,7 @@ def train_als(
     fault_injector=None,
     preemption_guard=None,
     watchdog=None,
+    warm_start=None,
 ) -> ALSModel:
     """Train ALS-WR on one device. Returns factors in ascending-id order.
 
@@ -497,6 +498,15 @@ def train_als(
     guard between iterations — on SIGTERM/SIGINT it drains the async
     checkpoint writer, commits a final checkpoint, and returns resumable —
     and ticks the watchdog per completed iteration.
+
+    ``warm_start=(u0, m0)`` seeds the factors instead of the reference's
+    avg-rating + U(0,1) init — the streaming fold-in path's periodic full
+    retrains pass the live factors here (``cfk_tpu.streaming.session``).
+    Rows are host arrays in this dataset's ascending-id order; shorter
+    matrices are zero-padded to the padded entity counts, longer ones
+    refused.  Forces the stepped (resilient) loop; a resumable checkpoint
+    in ``checkpoint_manager`` still wins over the seed (resume semantics
+    are unchanged — the warm start only defines iteration 0).
     """
     from cfk_tpu.resilience.loop import validate_cadence
     from cfk_tpu.resilience.sentinel import health_from_config
@@ -535,7 +545,8 @@ def train_als(
         )
         solve_chunk = config.padded_solve_chunk(width)
     stepped = (checkpoint_manager is not None or fault_injector is not None
-               or preemption_guard is not None or watchdog is not None)
+               or preemption_guard is not None or watchdog is not None
+               or warm_start is not None)
     if not stepped:
         train_s_before = metrics.phases.get("train", 0.0)
         with metrics.phase("train"):
@@ -593,7 +604,26 @@ def train_als(
     if stepped:
         dt = jnp.dtype(config.dtype)
 
+        def _padded_seed(x, rows, what):
+            x = np.asarray(x)
+            if x.shape[0] > rows or x.shape[1:] != (config.rank,):
+                raise ValueError(
+                    f"warm_start {what} factors have shape {x.shape}; this "
+                    f"dataset solves [{rows}, {config.rank}] (padded rows) — "
+                    "rebuild the seed against the same entity universe"
+                )
+            out = jnp.zeros((rows, config.rank), dt)
+            return out.at[: x.shape[0]].set(jnp.asarray(x, dtype=dt))
+
         def init_fn():
+            if warm_start is not None:
+                wu, wm = warm_start
+                return (
+                    _padded_seed(
+                        wu, dataset.user_blocks.padded_entities, "user"),
+                    _padded_seed(
+                        wm, dataset.movie_blocks.padded_entities, "movie"),
+                )
             if u_stats is not None:
                 u = init_factors_stats(
                     key, u_stats["rating_sum"], u_stats["count"], config.rank

@@ -1,0 +1,43 @@
+"""Exactly-once streaming fold-in: the rate → fold-in → resume loop.
+
+The durable updates topic (``producer``), the offset-cursor consumer with
+exactly-once micro-batch assembly (``consumer``), the idempotent
+deduplicated rating state (``state``), the restricted-half-iteration solve
+(``foldin``), and the session that ties them to the resilience stack and
+commits factors atomically with the cursor (``session``).  See
+ARCHITECTURE.md "Streaming ingest & incremental fold-in".
+"""
+
+from cfk_tpu.streaming.consumer import (
+    StreamBatch,
+    StreamConsumer,
+    StreamGapError,
+)
+from cfk_tpu.streaming.foldin import fold_in_rows
+from cfk_tpu.streaming.producer import (
+    UPDATES_TOPIC,
+    StreamProducer,
+    ensure_updates_topic,
+)
+from cfk_tpu.streaming.session import (
+    PoisonedBatchError,
+    StreamConfig,
+    StreamSession,
+)
+from cfk_tpu.streaming.state import ApplyStats, PendingApply, StreamState
+
+__all__ = [
+    "ApplyStats",
+    "PendingApply",
+    "PoisonedBatchError",
+    "StreamBatch",
+    "StreamConfig",
+    "StreamConsumer",
+    "StreamGapError",
+    "StreamProducer",
+    "StreamSession",
+    "StreamState",
+    "UPDATES_TOPIC",
+    "ensure_updates_topic",
+    "fold_in_rows",
+]

@@ -1,0 +1,158 @@
+"""Incremental fold-in: solve ONLY the touched users against fixed movies.
+
+Exactly one ALS half-iteration restricted to the touched rows — the math
+the ROADMAP names: each touched user's normal equations
+
+    (Σ m mᵀ + λ·n·I) u = Σ r·m        over that user's CURRENT ratings
+
+solved against the fixed movie factors, so the existing chunked Gram+solve
+machinery applies verbatim on a tiny entity set.  Two layouts:
+
+- ``"padded"`` — one [T, P] rectangle built directly from the touched
+  users' neighbor lists and solved by ``ops.solve.als_half_step`` (the
+  single-rectangle reference path; the default for micro-batches, whose
+  rectangles are tiny).
+- ``"tiled"`` — ``data.blocks.build_tiled_blocks`` over the touched set,
+  solved by ``ops.tiled.tiled_half_step`` — the same kernels the at-scale
+  trainer runs, fused Gram+solve epilogue and in-kernel gather included
+  (they engage under the identical gates; on CPU CI both route through
+  their bit-exact XLA emulation twins).
+
+Shapes are bucketed to powers of two (entity count and rectangle width) so
+a long-running stream converges onto a handful of compiled programs
+instead of re-tracing every batch.
+
+Determinism contract: the solved rows are a deterministic function of
+(neighbor lists, movie factors, solve configuration) — neighbor lists
+arrive sorted by movie row (``StreamState.neighbors``), so the same batch
+always produces bit-identical rows.  Rows ARE sensitive at the last-ulp
+level to the batch's composition (co-members set the padded width and the
+batch GEMM shapes), which is why the exactly-once pipeline pins batch
+boundaries to log offsets: replayed and fault-injected deliveries re-cut
+bit-identical batches (``cfk_tpu.streaming.consumer``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cfk_tpu.ops.solve import als_half_step
+from cfk_tpu.ops.tiled import tiled_half_step
+
+
+def _pow2_ceil(x: int, floor: int) -> int:
+    out = floor
+    while out < x:
+        out *= 2
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lam", "solver", "reg_solve_algo"),
+)
+def _padded_fold(fixed, neighbor_idx, rating, mask, count, *, lam, solver,
+                 reg_solve_algo):
+    return als_half_step(
+        fixed, neighbor_idx, rating, mask, count, lam,
+        solver=solver, reg_solve_algo=reg_solve_algo,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunks", "entities", "lam", "solver", "fused_epilogue",
+                     "in_kernel_gather", "reg_solve_algo"),
+)
+def _tiled_fold(fixed, blk, *, chunks, entities, lam, solver, fused_epilogue,
+                in_kernel_gather, reg_solve_algo):
+    return tiled_half_step(
+        fixed, blk, chunks, entities, lam, solver=solver,
+        fused_epilogue=fused_epilogue, in_kernel_gather=in_kernel_gather,
+        reg_solve_algo=reg_solve_algo,
+    )
+
+
+def fold_in_rows(
+    movie_factors,
+    neighbor_data,
+    *,
+    lam: float,
+    solver: str = "auto",
+    layout: str = "padded",
+    pad_multiple: int = 8,
+    fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    reg_solve_algo: str | None = None,
+) -> np.ndarray:
+    """Solve the touched users' rows against fixed ``movie_factors``.
+
+    ``neighbor_data`` is a sequence of ``(movie_rows int32, ratings f32)``
+    pairs, one per touched user, each sorted by movie row.  Returns the
+    solved float32 rows ``[len(neighbor_data), k]`` in the same order.
+    """
+    t = len(neighbor_data)
+    if t == 0:
+        return np.zeros((0, movie_factors.shape[-1]), np.float32)
+    if layout == "tiled":
+        return _fold_tiled(
+            movie_factors, neighbor_data, lam=lam, solver=solver,
+            fused_epilogue=fused_epilogue, in_kernel_gather=in_kernel_gather,
+            reg_solve_algo=reg_solve_algo,
+        )
+    if layout != "padded":
+        raise ValueError(
+            f"fold-in layout must be 'padded' or 'tiled', got {layout!r}"
+        )
+    width = max(int(mv.shape[0]) for mv, _ in neighbor_data)
+    p = _pow2_ceil(max(width, 1), max(pad_multiple, 1))
+    e = _pow2_ceil(t, 8)
+    neighbor_idx = np.zeros((e, p), np.int32)
+    rating = np.zeros((e, p), np.float32)
+    mask = np.zeros((e, p), np.float32)
+    count = np.zeros((e,), np.float32)
+    for i, (mv, rt) in enumerate(neighbor_data):
+        n = mv.shape[0]
+        neighbor_idx[i, :n] = mv
+        rating[i, :n] = rt
+        mask[i, :n] = 1.0
+        count[i] = n
+    out = _padded_fold(
+        movie_factors, jnp.asarray(neighbor_idx), jnp.asarray(rating),
+        jnp.asarray(mask), jnp.asarray(count),
+        lam=float(lam), solver=solver, reg_solve_algo=reg_solve_algo,
+    )
+    return np.asarray(out[:t], np.float32)
+
+
+def _fold_tiled(movie_factors, neighbor_data, *, lam, solver, fused_epilogue,
+                in_kernel_gather, reg_solve_algo):
+    from cfk_tpu.data.blocks import build_tiled_blocks
+    from cfk_tpu.models.als import _tiled_to_device
+
+    t = len(neighbor_data)
+    solve_dense = np.concatenate([
+        np.full(mv.shape[0], i, np.int64)
+        for i, (mv, _) in enumerate(neighbor_data)
+    ])
+    fixed_dense = np.concatenate(
+        [mv.astype(np.int64) for mv, _ in neighbor_data]
+    )
+    rating = np.concatenate([rt for _, rt in neighbor_data])
+    blocks = build_tiled_blocks(
+        solve_dense, fixed_dense, rating, t,
+        int(movie_factors.shape[0]),
+    )
+    blk = _tiled_to_device(blocks)
+    out = _tiled_fold(
+        movie_factors, blk,
+        chunks=("tiled", blocks.mode) + blocks.statics,
+        entities=blocks.padded_entities,
+        lam=float(lam), solver=solver, fused_epilogue=fused_epilogue,
+        in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+    )
+    return np.asarray(out[:t], np.float32)

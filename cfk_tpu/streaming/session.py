@@ -1,0 +1,652 @@
+"""The rate → fold-in loop: exactly-once streaming updates into live factors.
+
+``StreamSession`` closes the loop the reference only sketched: ratings
+arrive continuously on a durable updates topic, micro-batches of touched
+users are folded into the live factor state by one restricted ALS
+half-iteration, and every commit persists the factors ATOMICALLY WITH the
+consumer's offset cursor — the cursor rides the checkpoint manifest
+(``CheckpointManager.save(meta=...)``), whose atomic directory rename plus
+crc32 verification the PR 3/5 machinery already proves out.  There is no
+instant at which the factors and the cursor can disagree on disk; a crash
+replays exactly the uncommitted log suffix, and because micro-batch
+boundaries are log offsets (``StreamConsumer``), the replayed batches —
+and therefore the recovered factors — are bit-identical to an
+uninterrupted run.
+
+Delivery semantics, layer by layer:
+
+- **transport** may drop / duplicate / reorder (at-least-once):
+  ``StreamConsumer`` heals all three by offset — a batch is a pure
+  function of the log.
+- **log** may hold retried appends and re-rates: ``StreamState`` dedups by
+  (user, movie) seq, last-seq-wins — application is idempotent.
+- **math** may be poisoned (singular systems at λ=0, NaN ratings): every
+  fold-in is probed by the PR 3 health sentinel BEFORE commit; a tripped
+  batch is rolled back (staged state discarded, factors untouched) and the
+  recovery ladder escalates (λ bump → split epilogue → GJ) on retry;
+  a batch that defeats the whole ladder is quarantined — its offsets are
+  consumed (poison must not wedge the stream) but its writes never reach
+  the served factors or the state.
+- **process** may be evicted: the ``PreemptionGuard`` is polled at batch
+  boundaries; eviction drains the async checkpoint writer so the last
+  factor+cursor commit is durably on disk, then returns resumable.
+
+Periodic warm-started full retrains (``retrain_every``) rebuild the full
+dataset from the merged state and run the resilient stepped training loop
+with the CURRENT factors as the starting checkpoint, folding the movie
+side's staleness back in without ever serving a cold model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cfk_tpu.resilience import sentinel as _sentinel
+from cfk_tpu.resilience.loop import drain_checkpoints, save_checkpoint
+from cfk_tpu.resilience.policy import Overrides, RecoveryPolicy, policy_from_config
+from cfk_tpu.streaming.consumer import StreamConsumer
+from cfk_tpu.streaming.foldin import fold_in_rows
+from cfk_tpu.streaming.producer import UPDATES_TOPIC
+from cfk_tpu.streaming.state import StreamState
+
+_STREAM_MODEL = "als-stream"
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Knobs of the streaming loop (model/solver knobs stay on ALSConfig)."""
+
+    topic: str = UPDATES_TOPIC
+    # Log records consumed per partition per micro-batch.  Batch boundaries
+    # are offsets, so this value is part of the replay contract: it is
+    # recorded in every commit and the committed value wins on resume (a
+    # changed setting applies only to batches past the committed cursor).
+    batch_records: int = 256
+    # Fold-in solve layout: "padded" | "tiled" | "auto" (= tiled when the
+    # training config's layout is tiled — the same kernels as training —
+    # else padded).
+    foldin_layout: str = "auto"
+    # Warm full retrain every N stream commits (None = never): rebuild the
+    # dataset from the merged state and run the resilient training loop
+    # warm-started from the current factors.
+    retrain_every: int | None = None
+    # Re-poll budget for delivery gaps (dropped records must be redelivered
+    # by the at-least-once transport; after this many re-polls the session
+    # fails loudly instead of hanging like the reference).
+    gap_retries: int = 20
+    gap_wait_s: float = 0.05
+    # Sleep between polls while following an idle topic.
+    poll_wait_s: float = 0.05
+    # User-table growth quantum: new streamed-in users extend the factor
+    # table in chunks of this many rows (bounds re-jits and reallocations).
+    grow_multiple: int = 64
+
+    def __post_init__(self) -> None:
+        if self.batch_records < 1:
+            raise ValueError(
+                f"batch_records must be >= 1, got {self.batch_records}"
+            )
+        if self.foldin_layout not in ("auto", "padded", "tiled"):
+            raise ValueError(
+                f"foldin_layout must be auto/padded/tiled, got "
+                f"{self.foldin_layout!r}"
+            )
+        if self.retrain_every is not None and self.retrain_every < 1:
+            raise ValueError(
+                f"retrain_every must be >= 1, got {self.retrain_every}"
+            )
+        if self.grow_multiple < 1:
+            raise ValueError(
+                f"grow_multiple must be >= 1, got {self.grow_multiple}"
+            )
+
+
+class PoisonedBatchError(RuntimeError):
+    """Raised when ``on_unrecoverable='raise'`` and a batch defeats the
+    whole recovery ladder."""
+
+
+class StreamSession:
+    """Consume rating updates and fold them into live ALS factors.
+
+    ``manager`` (a ``CheckpointManager``-shaped store) is the session's
+    system of record: factors + offset cursor + stream metadata commit as
+    one atomic step per micro-batch.  On construction the session either
+    resumes from the store's newest intact step (rebuilding the rating
+    state by replaying the log below the committed cursor) or bootstraps
+    from ``base_model`` (committing step 0 with a zero cursor).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        config,
+        transport,
+        manager,
+        *,
+        stream: StreamConfig | None = None,
+        base_model=None,
+        metrics=None,
+        preemption_guard=None,
+        policy: RecoveryPolicy | None = None,
+    ) -> None:
+        from cfk_tpu.utils.metrics import Metrics
+
+        if manager is None:
+            raise ValueError(
+                "StreamSession needs a checkpoint manager: the offset "
+                "cursor commits atomically with the factors, so a durable "
+                "store is not optional"
+            )
+        self.dataset = dataset
+        self.config = config
+        self.transport = transport
+        self.manager = manager
+        self.stream = stream or StreamConfig()
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.guard = preemption_guard
+        self.policy = policy or policy_from_config(config)
+        self.health = _sentinel.health_from_config(config)
+        self._layout = (
+            self.stream.foldin_layout if self.stream.foldin_layout != "auto"
+            else ("tiled" if config.layout == "tiled" else "padded")
+        )
+        self._overrides = Overrides(
+            lam=config.lam, fused_epilogue=config.fused_epilogue,
+            reg_solve_algo=(None if config.reg_solve_algo == "auto"
+                            else config.reg_solve_algo),
+        )
+        self.state = StreamState(dataset)
+        self.stream_step = 0
+        self.quarantined: list[dict] = []
+        self._m = None  # jnp [M_pad, k], fixed between retrains
+        self._u = None  # np [U_pad, k], row-mutated by fold-ins
+        resumed = self._try_resume()
+        if not resumed:
+            self._bootstrap(base_model)
+
+    # -- bootstrap / resume --------------------------------------------------
+
+    def _factor_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.config.dtype)
+
+    def _bootstrap(self, base_model) -> None:
+        import jax.numpy as jnp
+
+        if base_model is None:
+            raise ValueError(
+                "no resumable stream state in the checkpoint store and no "
+                "base_model given — train a base model first (train_als) "
+                "or point the session at its existing stream directory"
+            )
+        dt = self._factor_dtype()
+        self._u = np.asarray(base_model.user_factors).astype(dt)
+        self._m = jnp.asarray(np.asarray(base_model.movie_factors), dtype=dt)
+        nparts = self.transport.num_partitions(self.stream.topic)
+        self.consumer = StreamConsumer(
+            self.transport, topic=self.stream.topic,
+            cursors={p: 0 for p in range(nparts)},
+            gap_retries=self.stream.gap_retries,
+            gap_wait_s=self.stream.gap_wait_s,
+        )
+        # Step 0 pins the zero cursor atomically with the base factors, so
+        # even a crash before the first batch resumes cleanly.
+        self._commit(note="bootstrap")
+
+    def _try_resume(self) -> bool:
+        import jax.numpy as jnp
+
+        latest = self.manager.latest_valid_iteration()
+        if latest is None:
+            return False
+        st = self.manager.restore(latest)
+        meta = st.meta
+        if meta.get("model") != _STREAM_MODEL:
+            raise ValueError(
+                f"checkpoint store holds model={meta.get('model')!r}, not a "
+                f"{_STREAM_MODEL} session — point the stream at its own "
+                "directory"
+            )
+        if int(meta.get("rank", -1)) != self.config.rank:
+            raise ValueError(
+                f"stream checkpoint has rank {meta.get('rank')}, config "
+                f"wants {self.config.rank}"
+            )
+        if int(meta.get("base_users", -1)) != self.state.num_base_users:
+            raise ValueError(
+                "stream checkpoint was committed against a base dataset "
+                f"with {meta.get('base_users')} users; this dataset has "
+                f"{self.state.num_base_users} — same --data required to "
+                "resume (the rating state replays from it)"
+            )
+        dt = self._factor_dtype()
+        self._u = np.asarray(st.user_factors).astype(dt)
+        self._m = jnp.asarray(np.asarray(st.movie_factors), dtype=dt)
+        self.stream_step = int(meta.get("stream_step", latest))
+        self.quarantined = list(meta.get("quarantined", []))
+        ov = meta.get("overrides")
+        if ov is not None:
+            # restore the sticky escalation ladder state committed with
+            # the factors — resuming at the config's un-escalated knobs
+            # would solve post-crash batches differently from the
+            # uninterrupted run (bit-exact replay contract)
+            self._overrides = Overrides(
+                lam=float(ov["lam"]),
+                fused_epilogue=ov.get("fused_epilogue"),
+                reg_solve_algo=ov.get("reg_solve_algo"),
+            )
+        # Batch boundaries are part of the replay contract: the committed
+        # batch_records wins over this session's setting, so post-cursor
+        # batches are re-cut exactly as an uninterrupted run would have
+        # cut them (batch composition moves the solved rows at the ulp
+        # level — foldin.py's determinism contract).
+        committed_br = int(meta.get("batch_records",
+                                    self.stream.batch_records))
+        if committed_br != self.stream.batch_records:
+            self.metrics.note(
+                "batch_records_override",
+                f"resume uses the committed batch_records={committed_br} "
+                f"(this session asked for {self.stream.batch_records}; the "
+                "replay contract pins the committed value)",
+            )
+            self.stream = dataclasses.replace(
+                self.stream, batch_records=committed_br
+            )
+        cursors = {int(p): int(o) for p, o in meta.get("offsets", {}).items()}
+        self.consumer = StreamConsumer(
+            self.transport, topic=self.stream.topic, cursors=cursors,
+            gap_retries=self.stream.gap_retries,
+            gap_wait_s=self.stream.gap_wait_s,
+        )
+        self._replay_state(cursors, meta)
+        self.metrics.note(
+            "stream_resumed",
+            f"step {self.stream_step}, cursor {cursors}, "
+            f"{len(meta.get('new_users', []))} streamed-in users",
+        )
+        return True
+
+    def _replay_state(self, cursors: dict[int, int], meta: dict) -> None:
+        """Rebuild the rating state = base + log[0, committed cursor).
+
+        Only the STATE is replayed (dedup + upserts) — no solving; the
+        factors came from the checkpoint.  New-user rows are pre-assigned
+        from the committed order, so the rebuilt rows line up with the
+        checkpointed factor rows regardless of how this replay chunks the
+        log (live runs interleave partitions batch by batch; the replay
+        need not re-cut those boundaries just to rebuild a
+        composition-independent state).  QUARANTINED offset ranges (poison
+        batches whose offsets were consumed but whose writes never reached
+        the state) are recorded in every commit and skipped here — the
+        state must stay a pure function of the log MINUS the quarantine,
+        or resume would re-apply the very writes the ladder rejected.
+        """
+        for i, raw in enumerate(meta.get("new_users", [])):
+            self.state._new_user_rows[int(raw)] = self.state.num_base_users + i
+            self.state._new_user_raw.append(int(raw))
+        skip: dict[int, list[tuple[int, int]]] = {}
+        for q in self.quarantined:
+            for p, (qlo, qhi) in q.get("offsets", {}).items():
+                skip.setdefault(int(p), []).append((int(qlo), int(qhi)))
+        replay = StreamConsumer(
+            self.transport, topic=self.stream.topic,
+            cursors={p: 0 for p in cursors},
+            gap_retries=self.stream.gap_retries,
+            gap_wait_s=self.stream.gap_wait_s,
+        )
+        applied = 0
+        for p, hi in sorted(cursors.items()):
+            lo = 0
+            while lo < hi:
+                take = min(hi - lo, 1 << 14)
+                values, _, _ = replay._collect_range(p, lo, lo + take)
+                ranges = skip.get(p, ())
+                values = [
+                    v for i, v in enumerate(values)
+                    if not any(qlo <= lo + i < qhi for qlo, qhi in ranges)
+                ]
+                from cfk_tpu.transport.serdes import decode_rating_update
+
+                pending = self.state.stage(
+                    [decode_rating_update(v) for v in values]
+                )
+                if pending.new_user_raw:
+                    raise ValueError(
+                        "stream checkpoint's new-user list does not cover "
+                        f"raw ids {pending.new_user_raw[:4]} found below "
+                        "the committed cursor — store and log disagree"
+                    )
+                self.state.commit(pending)
+                applied += pending.stats.fresh
+                lo += take
+        if self.state.num_users != int(meta.get("users",
+                                                self.state.num_users)):
+            raise ValueError(
+                f"replayed state has {self.state.num_users} users, commit "
+                f"recorded {meta.get('users')} — store and log disagree"
+            )
+        self.metrics.incr("replayed_updates", applied)
+
+    # -- the loop ------------------------------------------------------------
+
+    @property
+    def user_factors(self) -> np.ndarray:
+        return self._u
+
+    @property
+    def movie_factors(self):
+        return self._m
+
+    def model(self):
+        """Current live factors as an ``ALSModel`` (serving view)."""
+        import jax.numpy as jnp
+
+        from cfk_tpu.models.als import ALSModel
+
+        return ALSModel(
+            user_factors=jnp.asarray(self._u),
+            movie_factors=self._m,
+            num_users=self.state.num_users,
+            num_movies=self.state.num_movies,
+        )
+
+    def backlog(self) -> int:
+        return self.consumer.backlog()
+
+    def _grow_users(self, num_users: int) -> None:
+        """Extend the user factor table for streamed-in new users."""
+        need = num_users
+        have = self._u.shape[0]
+        if need <= have:
+            return
+        quantum = self.stream.grow_multiple
+        target = ((need + quantum - 1) // quantum) * quantum
+        grown = np.zeros((target, self._u.shape[1]), dtype=self._u.dtype)
+        grown[:have] = self._u
+        self._u = grown
+
+    def _solve_pending(self, pending, overrides: Overrides):
+        """Fold-in solve of one staged batch under the given overrides;
+        returns (rows [T, k] f32, probe word int)."""
+        import jax.numpy as jnp
+
+        neighbor_data = [
+            self.state.neighbors(row, pending.cell_writes.get(row))
+            for row in pending.touched_rows
+        ]
+        with self.metrics.phase("foldin_solve"):
+            rows = fold_in_rows(
+                self._m, neighbor_data,
+                lam=overrides.lam,
+                solver=self.config.solver,
+                layout=self._layout,
+                pad_multiple=self.config.pad_multiple,
+                fused_epilogue=overrides.fused_epilogue,
+                in_kernel_gather=self.config.in_kernel_gather,
+                reg_solve_algo=overrides.reg_solve_algo,
+            )
+        word = 0
+        if self.health is not None and rows.shape[0]:
+            with self.metrics.phase("health_check"):
+                word = int(np.asarray(_sentinel.probe_word(
+                    jnp.asarray(rows), self._m, self.health.norm_limit
+                )))
+            self.metrics.incr("health_checks")
+        return rows, word
+
+    def _commit(self, note: str | None = None) -> None:
+        meta = {
+            "model": _STREAM_MODEL,
+            "rank": int(self.config.rank),
+            "num_shards": 1,
+            "stream_step": self.stream_step,
+            "offsets": {str(p): int(o)
+                        for p, o in self.consumer.cursors.items()},
+            "batch_records": self.stream.batch_records,
+            "seq_high": int(self.state.applied_seq_high),
+            "base_users": self.state.num_base_users,
+            "users": self.state.num_users,
+            "new_users": [int(r) for r in self.state._new_user_raw],
+            # poison ranges whose offsets are consumed but whose writes
+            # must never be re-applied — crash replay skips them
+            "quarantined": self.quarantined,
+            # the sticky escalation state: post-resume batches must solve
+            # under the same overrides an uninterrupted run would have
+            # used, or replay is no longer bit-identical (a stream that
+            # needed λ·10 once needs it after the crash too)
+            "overrides": {
+                "lam": float(self._overrides.lam),
+                "fused_epilogue": self._overrides.fused_epilogue,
+                "reg_solve_algo": self._overrides.reg_solve_algo,
+            },
+        }
+        if note:
+            meta["note"] = note
+        with self.metrics.phase("commit"):
+            save_checkpoint(
+                self.manager, self.stream_step, self._u,
+                np.asarray(self._m), meta=meta,
+            )
+        self.metrics.incr("stream_commits")
+
+    def step(self) -> dict | None:
+        """Process ONE micro-batch; returns its summary, or None when
+        caught up with the log."""
+        batch = self.consumer.poll(self.stream.batch_records)
+        if batch is None:
+            return None
+        with self.metrics.phase("stage"):
+            pending = self.state.stage(batch.updates)
+        self.metrics.incr("updates_fresh", pending.stats.fresh)
+        self.metrics.incr("updates_stale", pending.stats.stale)
+        self.metrics.incr("updates_unknown_movie", pending.stats.unknown_movie)
+        if batch.duplicates_dropped:
+            self.metrics.incr("delivery_duplicates", batch.duplicates_dropped)
+        if batch.gap_repolls:
+            self.metrics.incr("delivery_gap_repolls", batch.gap_repolls)
+        summary = {
+            "records": batch.num_records,
+            "fresh": pending.stats.fresh,
+            "stale": pending.stats.stale,
+            "touched_users": len(pending.touched_rows),
+            "new_users": pending.stats.new_users,
+            "quarantined": False,
+            "trips": 0,
+        }
+        if pending.touched_rows:
+            overrides = self._overrides
+            trips = 0
+            while True:
+                rows, word = self._solve_pending(pending, overrides)
+                if not word:
+                    break
+                trips += 1
+                summary["trips"] = trips
+                self.metrics.incr("health_trips")
+                report = _sentinel.HealthReport(
+                    iteration=self.stream_step + 1, word=word, stats={}
+                )
+                self.metrics.note(
+                    f"stream_trip_{self.stream_step + 1}_{trips}",
+                    report.summary(),
+                )
+                if trips > self.policy.max_recoveries:
+                    # The whole ladder lost: quarantine the batch — its
+                    # offsets are consumed (a poison pill must not wedge
+                    # the stream) but neither the factors nor the rating
+                    # state ever see its writes.
+                    msg = (
+                        f"stream batch at step {self.stream_step + 1} "
+                        f"defeated the recovery ladder ({report.summary()}); "
+                        f"offsets {batch.cursors_before} → "
+                        f"{batch.cursors_after} quarantined"
+                    )
+                    if self.policy.on_unrecoverable == "raise":
+                        raise PoisonedBatchError(msg)
+                    self.quarantined.append({
+                        "stream_step": self.stream_step + 1,
+                        "offsets": {str(p): [batch.cursors_before[p],
+                                             batch.cursors_after[p]]
+                                    for p in batch.cursors_after},
+                        "reasons": report.reasons,
+                    })
+                    self.metrics.incr("quarantined_batches")
+                    self.metrics.note("quarantined", msg)
+                    import warnings
+
+                    warnings.warn(msg)
+                    summary["quarantined"] = True
+                    pending = None
+                    break
+                # Rollback is free — nothing was committed — so a retry is
+                # one escalation rung up (λ bump → split epilogue → GJ),
+                # sticky for the rest of the session exactly like the
+                # training ladder (a stream that needed λ·10 once will
+                # need it again).
+                new_overrides = self.policy.escalate(self._overrides,
+                                                     trips + 1)
+                if new_overrides != overrides:
+                    overrides = new_overrides
+                    self._overrides = new_overrides
+                    self.metrics.gauge("stream_escalation_level", trips)
+                    self.metrics.note(
+                        f"stream_escalation_{trips}",
+                        f"lam={overrides.lam:g} "
+                        f"fused={overrides.fused_epilogue} "
+                        f"algo={overrides.reg_solve_algo}",
+                    )
+            if pending is not None:
+                self.state.commit(pending)
+                self._grow_users(self.state.num_users)
+                if pending.touched_rows:
+                    self._u[np.asarray(pending.touched_rows)] = (
+                        rows.astype(self._u.dtype)
+                    )
+        self.stream_step += 1
+        self._commit()
+        summary["stream_step"] = self.stream_step
+        if (self.stream.retrain_every is not None
+                and self.stream_step % self.stream.retrain_every == 0):
+            self.retrain()
+        return summary
+
+    def run(self, *, max_batches: int | None = None, follow: bool = False,
+            before_batch=None):
+        """Drain (or follow) the updates topic; returns the live model.
+
+        ``follow=True`` keeps polling an idle topic until ``max_batches``
+        or eviction; the default drains until caught up.  ``before_batch``
+        (chaos/testing hook) is called with the upcoming stream step before
+        every poll — fault injectors deliver signals or kill the process
+        there, the boundary at which a real eviction lands.
+        """
+        import time as _time
+
+        batches = 0
+        try:
+            while True:
+                if self.guard is not None and self.guard.triggered:
+                    self._evict()
+                    break
+                if max_batches is not None and batches >= max_batches:
+                    break
+                if before_batch is not None:
+                    before_batch(self.stream_step)
+                    if self.guard is not None and self.guard.triggered:
+                        self._evict()
+                        break
+                got = self.step()
+                if got is None:
+                    if not follow:
+                        break
+                    _time.sleep(self.stream.poll_wait_s)
+                    continue
+                batches += 1
+        finally:
+            # Same exit contract as the training loop: only committed
+            # steps are left behind for the next reader.
+            drain_checkpoints(self.manager)
+        return self.model()
+
+    def _evict(self) -> None:
+        """Eviction: the last commit already carries the cursor — drain
+        the writer so it is durably on disk, then return resumable."""
+        drain_checkpoints(self.manager)
+        self.metrics.gauge("preempted", 1)
+        self.metrics.note(
+            "preempted",
+            f"{self.guard.signal_name} at stream step {self.stream_step}; "
+            "offset cursor committed and drained — re-run to resume",
+        )
+
+    # -- warm retrain --------------------------------------------------------
+
+    def retrain(self, num_iterations: int | None = None) -> None:
+        """Warm full retrain on the merged state, current factors as seed.
+
+        Rebuilds the dataset from base + every committed upsert and runs
+        the resilient stepped training loop (``train_als(warm_start=...)``)
+        — the movie side finally sees the streamed ratings.  The retrained
+        factors are permuted back into the session's row order (streamed-in
+        users keep their appended rows, so crash replay still lines up)
+        and committed with the unchanged cursor.
+        """
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        from cfk_tpu.data.blocks import Dataset
+        from cfk_tpu.models.als import train_als
+
+        with self.metrics.phase("retrain_build"):
+            coo = self.state.to_coo()
+            ds2 = Dataset.from_coo(
+                coo,
+                num_shards=1,
+                pad_multiple=self.config.pad_multiple,
+                layout=self.config.layout,
+                chunk_elems=self.config.chunk_cells(),
+                dense_stream=self.config.layout == "tiled",
+            )
+        if not np.array_equal(ds2.movie_map.raw_ids,
+                              self.dataset.movie_map.raw_ids):
+            raise RuntimeError(
+                "merged state changed the movie universe — unknown movies "
+                "are supposed to be rejected at apply time"
+            )
+        raw_users = self.state.user_raw_ids()
+        perm = ds2.user_map.to_dense(raw_users)  # ds2 row per session row
+        # Seed ds2's row order from the live factors.
+        k = self.config.rank
+        u_seed = np.zeros((ds2.user_blocks.padded_entities, k),
+                          dtype=self._u.dtype)
+        u_seed[perm] = self._u[: self.state.num_users]
+        m_seed = np.asarray(self._m)[: ds2.movie_blocks.padded_entities]
+        if m_seed.shape[0] < ds2.movie_blocks.padded_entities:
+            m_seed = np.concatenate([
+                m_seed,
+                np.zeros((ds2.movie_blocks.padded_entities - m_seed.shape[0],
+                          k), m_seed.dtype),
+            ])
+        cfg = self.config
+        if num_iterations is not None:
+            cfg = _dc.replace(cfg, num_iterations=num_iterations)
+        with self.metrics.phase("retrain"):
+            model = train_als(
+                ds2, cfg, metrics=self.metrics,
+                warm_start=(u_seed, m_seed),
+                preemption_guard=self.guard,
+            )
+        # Back into session row order; new users keep their appended rows.
+        u2 = np.asarray(model.user_factors)
+        u_sess = np.zeros_like(self._u)
+        u_sess[: self.state.num_users] = u2[perm]
+        self._u = u_sess
+        self._m = jnp.asarray(np.asarray(model.movie_factors),
+                              dtype=self._factor_dtype())
+        self.metrics.incr("stream_retrains")
+        self._commit(note=f"warm retrain at step {self.stream_step}")

@@ -1,0 +1,263 @@
+"""Deduplicated per-user rating state: the idempotency layer of fold-in.
+
+The fold-in solve is stateless per user — it re-derives a touched user's
+factor row from that user's COMPLETE current ratings against the fixed
+movie factors — so applying the same logical update twice, or applying two
+updates to the same cell in either order, must converge to the same state.
+``StreamState`` provides exactly that: the merge of the base dataset's
+ratings and every applied ``(user, movie, rating, seq)`` upsert, with
+last-seq-wins per (user, movie) cell (equal seq = a retried append,
+dropped).
+
+Nothing here is persisted: the state is a deterministic function of (base
+dataset, the updates-log prefix below the committed cursor), so crash
+recovery rebuilds it by replaying the log — the factors + cursor commit
+(``cfk_tpu.streaming.session``) is the only durable artifact.
+
+Application is TRANSACTIONAL: ``stage()`` computes the post-batch view
+without mutating anything, the session solves and probes against it, and
+only a healthy solve ``commit()``s — a poisoned micro-batch is discarded
+wholesale, leaving both the served factors and the state they were solved
+from untouched.
+
+Base ratings carry seq −1 (every streamed update outranks the batch file);
+new users grow the user table in first-appearance order within the
+canonical batch order, which makes row assignment replay-deterministic.
+Updates naming a movie the model has never seen have no factor column to
+solve against — they are counted and dropped (``unknown_movie``), to be
+picked up when the operator retrains from base + log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cfk_tpu.transport.serdes import RatingUpdate
+
+_BASE_SEQ = -1
+
+
+@dataclasses.dataclass
+class ApplyStats:
+    """What one batch application did — chaos tests assert these fired."""
+
+    fresh: int = 0          # state-changing upserts applied
+    stale: int = 0          # outranked by an already-applied seq (dup/reorder)
+    unknown_movie: int = 0  # no factor column for this movie — dropped
+    new_users: int = 0      # rows grown for first-seen users
+
+
+@dataclasses.dataclass(frozen=True)
+class PendingApply:
+    """A staged (not yet committed) batch application."""
+
+    touched_rows: tuple[int, ...]          # sorted dense user rows to re-solve
+    new_user_raw: tuple[int, ...]          # raw ids of rows grown, in order
+    cell_writes: dict                      # row -> {movie_row: (rating, seq)}
+    stats: ApplyStats
+
+
+class StreamState:
+    """Merged base + streamed rating state, queryable per user row."""
+
+    def __init__(self, dataset) -> None:
+        coo = dataset.coo_dense  # dense-index COO
+        self._movie_raw = dataset.movie_map.raw_ids
+        self.num_movies = dataset.movie_map.num_entities
+        self._base_user_raw = dataset.user_map.raw_ids
+        # Per-user CSR over the base ratings (built once, never mutated):
+        # streamed deltas overlay it per touched user.
+        order = np.argsort(coo.user_raw, kind="stable")
+        self._base_movies = coo.movie_raw[order].astype(np.int32)
+        self._base_ratings = coo.rating[order].astype(np.float32)
+        counts = np.bincount(
+            coo.user_raw.astype(np.int64),
+            minlength=dataset.user_map.num_entities,
+        )
+        self._base_indptr = np.zeros(
+            dataset.user_map.num_entities + 1, np.int64
+        )
+        np.cumsum(counts, out=self._base_indptr[1:])
+        # Streamed overlay: row -> {movie_row: (rating, seq)}; rows past the
+        # base user count are streamed-in new users.
+        self._delta: dict[int, dict[int, tuple[float, int]]] = {}
+        self._new_user_raw: list[int] = []
+        self._new_user_rows: dict[int, int] = {}
+        self.applied_seq_high = _BASE_SEQ
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def num_base_users(self) -> int:
+        return int(self._base_user_raw.shape[0])
+
+    @property
+    def num_users(self) -> int:
+        return self.num_base_users + len(self._new_user_raw)
+
+    def user_row(self, raw: int) -> int | None:
+        """Dense row of a raw user id, or None if never seen."""
+        got = self._new_user_rows.get(int(raw))
+        if got is not None:
+            return got
+        i = int(np.searchsorted(self._base_user_raw, raw))
+        if i < self.num_base_users and int(self._base_user_raw[i]) == int(raw):
+            return i
+        return None
+
+    def user_raw_ids(self) -> np.ndarray:
+        """Raw ids in row order (base ascending, then streamed new users)."""
+        return np.concatenate([
+            self._base_user_raw,
+            np.asarray(self._new_user_raw, np.int64),
+        ]) if self._new_user_raw else self._base_user_raw
+
+    def movie_row(self, raw: int) -> int | None:
+        i = int(np.searchsorted(self._movie_raw, raw))
+        if i < self.num_movies and int(self._movie_raw[i]) == int(raw):
+            return i
+        return None
+
+    # -- queries -------------------------------------------------------------
+
+    def _cells(self, row: int, overlay: dict | None = None
+               ) -> dict[int, tuple[float, int]]:
+        """row's full (movie_row -> (rating, seq)) map, base + delta
+        (+ an optional staged overlay for that row)."""
+        cells: dict[int, tuple[float, int]] = {}
+        if row < self.num_base_users:
+            lo, hi = self._base_indptr[row], self._base_indptr[row + 1]
+            for mv, rt in zip(self._base_movies[lo:hi],
+                              self._base_ratings[lo:hi]):
+                cells[int(mv)] = (float(rt), _BASE_SEQ)
+        cells.update(self._delta.get(row, {}))
+        if overlay:
+            cells.update(overlay)
+        return cells
+
+    def neighbors(self, row: int, overlay: dict | None = None
+                  ) -> tuple[np.ndarray, np.ndarray]:
+        """(movie rows int32 ascending, ratings f32) for one user row.
+
+        Sorted by movie row — the canonical neighbor order, so the solve
+        input (and therefore its bits) depends only on the state, never on
+        arrival order.
+        """
+        cells = self._cells(row, overlay)
+        if not cells:
+            return (np.zeros(0, np.int32), np.zeros(0, np.float32))
+        movies = np.fromiter(cells.keys(), np.int32, len(cells))
+        ratings = np.fromiter(
+            (cells[int(m)][0] for m in movies), np.float32, len(cells)
+        )
+        order = np.argsort(movies, kind="stable")
+        return movies[order], ratings[order]
+
+    def to_coo(self):
+        """The merged rating state as a raw-id COO (for warm full retrains:
+        base + every committed upsert, exactly what the factors model).
+
+        Rows the stream never touched pass through vectorized (deduped to
+        last-occurrence per cell, matching ``_cells``'s dict semantics for
+        repeated base observations); only delta rows pay the per-row merge
+        — O(touched) Python work, not O(all users), so ML-25M-scale exits
+        and periodic retrains don't stall on an interpreter loop."""
+        from cfk_tpu.data.blocks import RatingsCOO
+
+        raw_users = self.user_raw_ids()
+        counts = np.diff(self._base_indptr)
+        base_rows = np.repeat(
+            np.arange(self.num_base_users, dtype=np.int64), counts
+        )
+        # last-occurrence dedup per (row, movie) cell: stable sort keeps
+        # original order within equal keys, so each group's tail is the
+        # entry _cells would have kept
+        key = base_rows * np.int64(self.num_movies) + self._base_movies
+        order = np.argsort(key, kind="stable")
+        ks = key[order]
+        last = np.ones(ks.shape[0], bool)
+        last[:-1] = ks[1:] != ks[:-1]
+        sel = order[last]
+        untouched = ~np.isin(base_rows[sel],
+                             np.fromiter(self._delta, np.int64,
+                                         len(self._delta)))
+        sel = sel[untouched]
+        users = [self._base_user_raw[base_rows[sel]]]
+        movies = [self._movie_raw[self._base_movies[sel]].astype(np.int64)]
+        ratings = [self._base_ratings[sel]]
+        for row in sorted(self._delta):
+            mv, rt = self.neighbors(row)
+            users.append(np.full(mv.shape[0], raw_users[row], np.int64))
+            movies.append(self._movie_raw[mv].astype(np.int64))
+            ratings.append(rt)
+        return RatingsCOO(
+            movie_raw=np.concatenate(movies),
+            user_raw=np.concatenate(users),
+            rating=np.concatenate(ratings).astype(np.float32),
+        )
+
+    # -- transactional application -------------------------------------------
+
+    def stage(self, updates: tuple[RatingUpdate, ...] | list[RatingUpdate]
+              ) -> PendingApply:
+        """Dedup a batch against the applied state WITHOUT mutating it.
+
+        Updates must already be in canonical order (the consumer's
+        (partition, offset) order).  Within the batch the same cell may be
+        written repeatedly — the highest seq wins; against the applied
+        state, only upserts whose seq outranks the cell's current seq are
+        fresh.  A user whose batch records are ALL stale is not touched
+        (no re-solve — the idempotent no-op for retried appends).
+        """
+        stats = ApplyStats()
+        writes: dict[int, dict[int, tuple[float, int]]] = {}
+        cells_cache: dict[int, dict] = {}  # applied view, one build per row
+        new_raw: list[int] = []
+        new_rows: dict[int, int] = {}
+        next_row = self.num_users
+        for upd in updates:
+            mv = self.movie_row(upd.movie)
+            if mv is None:
+                stats.unknown_movie += 1
+                continue
+            row = self.user_row(upd.user)
+            if row is None:
+                row = new_rows.get(int(upd.user))
+            if row is None:
+                row = next_row
+                new_rows[int(upd.user)] = row
+                new_raw.append(int(upd.user))
+                next_row += 1
+                stats.new_users += 1
+            current = writes.get(row, {}).get(mv)
+            if current is None:
+                cells = cells_cache.get(row)
+                if cells is None:
+                    cells = cells_cache[row] = (
+                        self._cells(row) if row < self.num_users else {}
+                    )
+                current = cells.get(mv)
+            if current is not None and upd.seq <= current[1]:
+                stats.stale += 1
+                continue
+            writes.setdefault(row, {})[mv] = (float(upd.rating), int(upd.seq))
+            stats.fresh += 1
+        return PendingApply(
+            touched_rows=tuple(sorted(writes)),
+            new_user_raw=tuple(new_raw),
+            cell_writes=writes,
+            stats=stats,
+        )
+
+    def commit(self, pending: PendingApply) -> None:
+        """Fold a staged batch into the applied state."""
+        for raw in pending.new_user_raw:
+            self._new_user_rows[raw] = self.num_users
+            self._new_user_raw.append(raw)
+        for row, cells in pending.cell_writes.items():
+            self._delta.setdefault(row, {}).update(cells)
+            self.applied_seq_high = max(
+                self.applied_seq_high, max(s for _, s in cells.values())
+            )

@@ -12,14 +12,17 @@ from cfk_tpu.transport.serdes import (
     EOF_ID,
     FeatureRecord,
     IdRatingPair,
+    RatingUpdate,
     decode_feature,
     decode_float_array,
     decode_id_rating,
     decode_int_list,
+    decode_rating_update,
     encode_feature,
     encode_float_array,
     encode_id_rating,
     encode_int_list,
+    encode_rating_update,
 )
 
 __all__ = [
@@ -40,6 +43,9 @@ __all__ = [
     "EOF_ID",
     "FeatureRecord",
     "IdRatingPair",
+    "RatingUpdate",
+    "decode_rating_update",
+    "encode_rating_update",
     "decode_feature",
     "decode_float_array",
     "decode_id_rating",

@@ -30,6 +30,12 @@ EOF_ID = -1
 
 _ID_RATING = struct.Struct(">ih")  # int32 id, int16 rating
 _I32 = struct.Struct(">i")
+# RatingUpdate: int64 seq | int64 user | int64 movie | float32 rating.
+# A superset of IdRatingPair for the streaming fold-in path: the rating is
+# float (re-rates and synthetic streams are not star-quantized) and the
+# producer-assigned sequence number is what makes replayed/duplicated
+# delivery idempotent (last-seq-wins per (user, movie) cell).
+_RATING_UPDATE = struct.Struct(">qqqf")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +60,39 @@ def decode_id_rating(data: bytes) -> IdRatingPair:
         raise ValueError(f"IdRatingPair frame must be 6 bytes, got {len(data)}")
     id_, rating = _ID_RATING.unpack(data)
     return IdRatingPair(id=id_, rating=rating)
+
+
+@dataclasses.dataclass(frozen=True)
+class RatingUpdate:
+    """One streaming rating upsert: user re-/rates movie.
+
+    ``seq`` is assigned by the producer, strictly increasing per logical
+    update (``cfk_tpu.streaming.StreamProducer``): when the same (user,
+    movie) cell is written twice, the higher ``seq`` wins regardless of
+    delivery order, and a retried append (same seq twice in the log) is a
+    no-op on the second application — the idempotency key of the fold-in
+    pipeline.  Ids are RAW external ids (the partition key is the user id,
+    mod-N — same ``PureModPartitioner`` rule as ingest).
+    """
+
+    seq: int
+    user: int
+    movie: int
+    rating: float
+
+
+def encode_rating_update(msg: RatingUpdate) -> bytes:
+    return _RATING_UPDATE.pack(msg.seq, msg.user, msg.movie, msg.rating)
+
+
+def decode_rating_update(data: bytes) -> RatingUpdate:
+    if len(data) != _RATING_UPDATE.size:
+        raise ValueError(
+            f"RatingUpdate frame must be {_RATING_UPDATE.size} bytes, "
+            f"got {len(data)}"
+        )
+    seq, user, movie, rating = _RATING_UPDATE.unpack(data)
+    return RatingUpdate(seq=seq, user=user, movie=movie, rating=rating)
 
 
 @dataclasses.dataclass(frozen=True)

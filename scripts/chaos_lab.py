@@ -392,6 +392,208 @@ def scenario_worker_kill() -> dict:
     }
 
 
+def _stream_fixture(parts=2, n=60, new_users=(4242,)):
+    """(dataset, config, base model, broker-with-produced-stream)."""
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.streaming import StreamProducer
+    from cfk_tpu.transport import InMemoryBroker
+
+    ds = _dataset()
+    cfg = ALSConfig(rank=4, num_iterations=4, health_check_every=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        base = train_als(ds, cfg)
+    broker = InMemoryBroker()
+    prod = StreamProducer(broker, num_partitions=parts)
+    rng = np.random.default_rng(11)
+    prod.send_many(
+        rng.choice(ds.user_map.raw_ids, n),
+        rng.choice(ds.movie_map.raw_ids, n),
+        rng.integers(1, 6, n).astype(np.float32),
+    )
+    for raw in new_users:
+        prod.send(raw, int(ds.movie_map.raw_ids[0]), 4.0)
+    return ds, cfg, base, broker
+
+
+def _stream_run(ds, cfg, transport, mgr_dir, base=None, batch_records=8,
+                max_batches=None):
+    import zlib
+
+    from cfk_tpu.streaming import StreamConfig, StreamSession
+    from cfk_tpu.transport import CheckpointManager
+
+    sess = StreamSession(
+        ds, cfg, transport, CheckpointManager(mgr_dir),
+        stream=StreamConfig(batch_records=batch_records), base_model=base,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = sess.run(max_batches=max_batches)
+    crc = zlib.crc32(np.asarray(model.user_factors).tobytes())
+    return sess, crc
+
+
+def scenario_stream_duplicates() -> dict:
+    """Duplicated + reordered + dropped delivery of the SAME updates log
+    must fold in to factors bit-identical (crc32) to clean delivery — the
+    exactly-once assembly (dedup by offset, offset sort, gap re-poll) plus
+    seq dedup make misdelivery invisible to the math."""
+    import tempfile
+
+    from cfk_tpu.resilience.faults import FlakyPlan, FlakyTransport
+
+    ds, cfg, base, broker = _stream_fixture()
+    with tempfile.TemporaryDirectory() as da, \
+            tempfile.TemporaryDirectory() as db:
+        _, crc_clean = _stream_run(ds, cfg, broker, da, base=base)
+        flaky = FlakyTransport(
+            broker, FlakyPlan(duplicate=3, reorder=5, drop=7, seed=1)
+        )
+        sess, crc_flaky = _stream_run(ds, cfg, flaky, db, base=base)
+    fired = bool(flaky.duplicated and flaky.reordered and flaky.dropped)
+    bit_exact = crc_clean == crc_flaky
+    return {
+        "scenario": "stream_duplicates",
+        "fault_fired": fired,
+        "duplicated": flaky.duplicated,
+        "reordered": flaky.reordered,
+        "dropped": flaky.dropped,
+        # detection = the consumer's dedup/gap counters saw the faults
+        "detected": bool(
+            sess.metrics.counters.get("delivery_duplicates", 0) > 0
+            and sess.metrics.counters.get("delivery_gap_repolls", 0) > 0
+        ),
+        "recovered": bit_exact,
+        "factors_bit_exact": bit_exact,
+        "clean_crc32": crc_clean,
+        "faulty_crc32": crc_flaky,
+        "ok": bool(fired and bit_exact),
+    }
+
+
+def scenario_stream_crash_replay() -> dict:
+    """Crash mid-stream (process dies between commits): a fresh session
+    resumes from the atomically-committed factor+cursor step, replays
+    exactly the uncommitted log suffix, and converges to factors
+    bit-identical to an uninterrupted run.  The final commit of the
+    crashed run is ALSO torn (factors written, 'cursor write' lost —
+    atomicity's worst case), which crc verification rejects wholesale."""
+    import tempfile
+
+    from cfk_tpu.resilience.faults import TornCheckpointManager
+    from cfk_tpu.streaming import StreamConfig, StreamSession
+    from cfk_tpu.transport import CheckpointManager
+
+    ds, cfg, base, broker = _stream_fixture()
+    with tempfile.TemporaryDirectory() as da, \
+            tempfile.TemporaryDirectory() as db:
+        _, crc_clean = _stream_run(ds, cfg, broker, da, base=base)
+        # crashed run: 2 batches commit, then the 3rd commit is torn and
+        # the process "dies" (session abandoned)
+        torn = TornCheckpointManager(CheckpointManager(db), tear_at=3)
+        s_crash = StreamSession(
+            ds, cfg, broker, torn,
+            stream=StreamConfig(batch_records=8), base_model=base,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            s_crash.run(max_batches=3)
+        tear_fired = bool(torn.torn)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            s_resume = StreamSession(
+                ds, cfg, broker, CheckpointManager(db),
+                stream=StreamConfig(batch_records=8),
+            )
+            resumed_from = s_resume.stream_step
+            import zlib
+
+            model = s_resume.run()
+            crc_replayed = zlib.crc32(
+                np.asarray(model.user_factors).tobytes()
+            )
+    bit_exact = crc_clean == crc_replayed
+    return {
+        "scenario": "stream_crash_replay",
+        "fault_fired": tear_fired,
+        "detected": bool(resumed_from == 2),  # torn step 3 was rejected
+        "recovered": bit_exact,
+        "resumed_from_step": resumed_from,
+        "replayed_updates": s_resume.metrics.counters.get(
+            "replayed_updates", 0),
+        "factors_bit_exact": bit_exact,
+        "clean_crc32": crc_clean,
+        "replayed_crc32": crc_replayed,
+        "ok": bool(tear_fired and resumed_from == 2 and bit_exact),
+    }
+
+
+def scenario_stream_poison_batch() -> dict:
+    """Two poison classes in one stream: a singular micro-batch (λ=0, a
+    new one-rating user) that the ladder's λ bump FIXES, then a NaN-rating
+    batch that defeats every rung and must be QUARANTINED — rolled back
+    without corrupting the served factors, offsets consumed so the stream
+    never wedges, and good batches after the poison still apply."""
+    import tempfile
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.resilience.faults import blockstructured_coo
+    from cfk_tpu.streaming import StreamConfig, StreamProducer, StreamSession
+    from cfk_tpu.transport import CheckpointManager, InMemoryBroker
+
+    ds = Dataset.from_coo(blockstructured_coo(seed=0))
+    cfg = ALSConfig(rank=4, num_iterations=4, lam=0.0, health_check_every=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        base = train_als(ds, cfg)
+    broker = InMemoryBroker()
+    prod = StreamProducer(broker)
+    victim = int(ds.user_map.raw_ids[0])
+    good_user = int(ds.user_map.raw_ids[1])
+    prod.send(777, int(ds.movie_map.raw_ids[0]), 5.0)       # singular batch
+    prod.send(victim, int(ds.movie_map.raw_ids[1]), float("nan"))  # poison
+    prod.send(good_user, int(ds.movie_map.raw_ids[2]), 4.0)  # good after
+    with tempfile.TemporaryDirectory() as d:
+        sess = StreamSession(
+            ds, cfg, broker, CheckpointManager(d),
+            stream=StreamConfig(batch_records=1), base_model=base,
+        )
+        u_before = np.array(sess.user_factors)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = sess.run()
+    u_after = np.asarray(model.user_factors)
+    vrow = sess.state.user_row(victim)
+    grow = sess.state.user_row(good_user)
+    trips = sess.metrics.counters.get("health_trips", 0)
+    escalated = sess.metrics.gauges.get("stream_escalation_level", 0) >= 1
+    quarantined = len(sess.quarantined) == 1
+    victim_intact = bool(np.array_equal(u_after[vrow], u_before[vrow]))
+    good_applied = not np.array_equal(u_after[grow], u_before[grow])
+    finite = bool(np.all(np.isfinite(u_after)))
+    drained = sess.backlog() == 0
+    return {
+        "scenario": "stream_poison_batch",
+        "fault_fired": True,  # both poisons are injected by construction
+        "detected": bool(trips >= 2),  # sentinel tripped on both batches
+        "recovered": bool(escalated and quarantined and victim_intact
+                          and finite),
+        "health_trips": int(trips),
+        "lambda_escalated": bool(escalated),
+        "quarantined_batches": sess.quarantined,
+        "served_factors_intact": victim_intact,
+        "good_batch_after_poison_applied": bool(good_applied),
+        "stream_drained": bool(drained),
+        "ok": bool(trips >= 2 and escalated and quarantined
+                   and victim_intact and good_applied and finite
+                   and drained),
+    }
+
+
 SCENARIOS = {
     "nan": scenario_nan,
     "inf": scenario_inf,
@@ -401,6 +603,9 @@ SCENARIOS = {
     "preemption": scenario_preemption,
     "slow_disk": scenario_slow_disk,
     "worker_kill": scenario_worker_kill,
+    "stream_duplicates": scenario_stream_duplicates,
+    "stream_crash_replay": scenario_stream_crash_replay,
+    "stream_poison_batch": scenario_stream_poison_batch,
 }
 
 

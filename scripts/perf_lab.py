@@ -145,6 +145,17 @@ def make_parser() -> argparse.ArgumentParser:
                    "timed call includes the in-loop save stalls, so the "
                    "sync−async s/iter delta is the save stall removed from "
                    "the step loop; bytes on disk are identical")
+    p.add_argument("--foldin", default="off", choices=["off", "on"],
+                   help="streaming fold-in throughput axis: instead of the "
+                   "step timing, drain a synthetic rating-update stream "
+                   "through StreamSession (in-memory broker, per-batch "
+                   "atomic factor+cursor commits, health probe per batch) "
+                   "and report updates/sec absorbed with the stage/solve/"
+                   "commit split (cfk_tpu.streaming; ISSUE 6)")
+    p.add_argument("--foldin-updates", type=int, default=4096,
+                   help="synthetic stream size for --foldin on")
+    p.add_argument("--foldin-batch-records", type=int, default=256,
+                   help="log records per micro-batch for --foldin on")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -154,10 +165,84 @@ def make_parser() -> argparse.ArgumentParser:
     return p
 
 
+def run_foldin_lab(args) -> dict:
+    """The --foldin axis: streaming fold-in throughput on this dataset.
+
+    Drains a synthetic rating-update stream (drawn from the dataset's own
+    id universe — same Zipf-hot users, so neighbor-list widths are
+    realistic) through the full ``StreamSession`` loop: exactly-once batch
+    assembly, staged dedup, restricted half-iteration solve, health probe,
+    and the per-batch atomic factor+cursor commit.  The row reports
+    updates/sec absorbed and the stage/solve/commit wall split — the
+    stream-freshness counterpart of the step-timing rows.  The base model
+    is one training iteration: fold-in cost is independent of factor
+    VALUES, and the quality contract lives in ``bench.py --foldin``.
+    """
+    import tempfile
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.streaming import StreamConfig, StreamProducer, StreamSession
+    from cfk_tpu.transport import InMemoryBroker
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = get_dataset(args)
+    cfg = ALSConfig(
+        rank=args.rank, lam=0.05, num_iterations=1, seed=args.seed,
+        layout=args.layout, solver=args.solver, dtype=args.dtype,
+        health_check_every=1,
+    )
+    t0 = time.time()
+    base = train_als(ds, cfg)
+    base_s = time.time() - t0
+    n = args.foldin_updates
+    rng = np.random.default_rng(args.seed + 1)
+    broker = InMemoryBroker()
+    prod = StreamProducer(broker)
+    prod.send_many(
+        rng.choice(ds.user_map.raw_ids, n),
+        rng.choice(ds.movie_map.raw_ids, n),
+        rng.integers(1, 6, n).astype(np.float32),
+    )
+    metrics = Metrics()
+    with tempfile.TemporaryDirectory() as d:
+        sess = StreamSession(
+            ds, cfg, broker, CheckpointManager(d, async_write=True),
+            stream=StreamConfig(batch_records=args.foldin_batch_records),
+            base_model=base, metrics=metrics,
+        )
+        t0 = time.time()
+        sess.run()
+        wall = time.time() - t0
+    row = {
+        "foldin": "on",
+        "updates_per_s": round(n / wall, 1),
+        "updates": n,
+        "updates_fresh": int(metrics.counters.get("updates_fresh", 0)),
+        "batches": int(sess.stream_step),
+        "batch_records": args.foldin_batch_records,
+        "absorb_wall_s": round(wall, 4),
+        "stage_s": round(metrics.phases.get("stage", 0.0), 4),
+        "foldin_solve_s": round(metrics.phases.get("foldin_solve", 0.0), 4),
+        "health_check_s": round(metrics.phases.get("health_check", 0.0), 4),
+        "commit_s": round(metrics.phases.get("commit", 0.0), 4),
+        "base_train_s": round(base_s, 4),
+        "layout": args.layout, "solver": args.solver, "dtype": args.dtype,
+        "rank": args.rank,
+        "users": args.users, "movies": args.movies, "nnz": args.nnz,
+    }
+    print(json.dumps(row))
+    return row
+
+
 def run_lab(args) -> dict:
     """Measure and return the result row (also printed as the last JSON
     line — the scoreboard contract ``tests/test_perf_lab.py`` pins)."""
     import jax
+
+    if args.foldin == "on":
+        return run_foldin_lab(args)
 
     ds = get_dataset(args)
 

@@ -132,6 +132,55 @@ def test_consume_start_offset_across_index_boundaries(tmp_path):
         assert got == list(range(start, n))
 
 
+def test_sparse_index_seek_after_torn_indexed_record(tmp_path):
+    """Regression (ISSUE 6): a torn write that truncates away an INDEXED
+    record (record #_INDEX_EVERY here) must leave every seek landing on a
+    frame boundary — at the boundary, just before it, and after the next
+    append re-occupies the truncated record number.  Pins the interplay of
+    ``_scan_log``'s index rebuild (which must NOT emit an entry for the
+    torn record) with ``consume``'s ``min(start // _INDEX_EVERY,
+    len(index) - 1)`` clamp and ``produce``'s post-truncation index append
+    (the new record #_INDEX_EVERY must be indexed at the truncated byte
+    position, not the pre-tear one).  No off-by-one was found when this
+    was written — the test is the pin that keeps it that way."""
+    from cfk_tpu.transport.filelog import _HEADER, _INDEX_EVERY
+
+    rec_bytes = _HEADER.size + 4
+    with FileBroker(str(tmp_path), fsync=False) as b:
+        b.create_topic("t", 1)
+        for k in range(_INDEX_EVERY + 1):  # records 0.._INDEX_EVERY
+            b.produce("t", key=k, value=k.to_bytes(4, "big"), partition=0)
+    log = tmp_path / "t" / "p00000.log"
+    # tear mid-frame INTO record #_INDEX_EVERY — the record whose byte
+    # position the sparse index would have held
+    with open(log, "r+b") as f:
+        f.truncate(os.path.getsize(log) - 3)
+    with FileBroker(str(tmp_path), fsync=False) as b2:
+        assert b2.end_offset("t", 0) == _INDEX_EVERY
+        # the rebuilt index must not point past the valid region
+        assert b2._index[("t", 0)] == [0]
+        # seeks around the truncated boundary land on frame boundaries
+        assert [r.key for r in b2.consume("t", 0, start_offset=_INDEX_EVERY)] == []
+        got = list(b2.consume("t", 0, start_offset=_INDEX_EVERY - 1))
+        assert [(r.key, r.offset) for r in got] == [
+            (_INDEX_EVERY - 1, _INDEX_EVERY - 1)
+        ]
+        # a fresh append re-occupies record #_INDEX_EVERY at the truncated
+        # byte position — and must be indexed there
+        b2.produce("t", key=99999, value=(99999).to_bytes(4, "big"),
+                   partition=0)
+        assert b2._index[("t", 0)] == [0, _INDEX_EVERY * rec_bytes]
+        assert [r.key for r in
+                b2.consume("t", 0, start_offset=_INDEX_EVERY)] == [99999]
+    # a reopen's from-disk rescan agrees with the in-session index
+    with FileBroker(str(tmp_path), fsync=False) as b3:
+        assert b3._index[("t", 0)] == [0, _INDEX_EVERY * rec_bytes]
+        assert [(r.key, r.offset) for r in
+                b3.consume("t", 0, start_offset=_INDEX_EVERY - 1)] == [
+            (_INDEX_EVERY - 1, _INDEX_EVERY - 1), (99999, _INDEX_EVERY),
+        ]
+
+
 def test_create_existing_and_unknown_topics(tmp_path):
     with FileBroker(str(tmp_path)) as b:
         b.create_topic("t", 1)

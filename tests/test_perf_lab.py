@@ -26,6 +26,7 @@ def _args(**over):
         ials=False, alpha=40.0, accum_chunk_elems=None, dense_stream=False,
         overlap="on", fused="on", gather="fused", health="off",
         health_norm_limit=1e6, ckpt=None,
+        foldin="off", foldin_updates=4096, foldin_batch_records=256,
         iters=2, repeats=3, profile_dir=None,
     )
     base.update(over)
@@ -124,6 +125,25 @@ def test_health_axis_row(tmp_path, monkeypatch):
         perf_lab.CACHE_ROOT = old
     assert on["health"] == "on" and off["health"] == "off"
     assert on["s_per_iter_min"] >= 0
+
+
+def test_foldin_axis_row(tmp_path, monkeypatch, capsys):
+    # the streaming fold-in axis (ISSUE 6): the tier-1 smoke path for the
+    # whole streaming loop — in-memory broker, tiny synthetic stream,
+    # through StreamSession's exactly-once batch/solve/probe/commit cycle
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    row = perf_lab.run_lab(_args(
+        foldin="on", foldin_updates=48, foldin_batch_records=16,
+        layout="padded",
+    ))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == row  # scoreboard contract holds here too
+    assert row["foldin"] == "on"
+    assert row["updates"] == 48
+    assert row["updates_per_s"] > 0
+    assert row["batches"] >= 1
+    for key in ("stage_s", "foldin_solve_s", "health_check_s", "commit_s"):
+        assert row[key] >= 0, key
 
 
 def test_ckpt_axis_row(tmp_path, monkeypatch):
