@@ -154,6 +154,15 @@ def main() -> None:
             fi = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# foldin: " + json.dumps(fi))
         rows["foldin"] = fi
+    # Quantized-gather-table A/B: RMSE per table dtype on the planted
+    # split + the analytic bytes removed.  CFK_BENCH_QUANT=0 skips it.
+    if os.environ.get("CFK_BENCH_QUANT", "1") != "0":
+        try:
+            qa = _quant_ab_row()
+        except Exception as e:  # pragma: no cover - device-dependent
+            qa = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# quant_table: " + json.dumps(qa))
+        rows["quant_table"] = qa
     if os.environ.get("CFK_BENCH_HEADLINE", "1") != "0":
         for name, fn in (
             ("full_rank64", full_rank64_row),
@@ -274,13 +283,21 @@ def _steady_state(ds, *, rank, iters=3, repeats=4, lam=0.05,
 
 def _headline_row(metric, *, users, movies, nnz, rank, layout_tag,
                   steady, dtype="bfloat16", implicit=False,
-                  prep_s=0.0) -> dict:
+                  prep_s=0.0, table_dtype="float32", gather_rows=None,
+                  sweeps=1) -> dict:
+    """``table_dtype`` is recorded in every row (the quantized-table knob
+    of ``ops.quant`` — "float32" = the identity), and the byte model is
+    layout-aware: ``gather_rows`` overrides the 2·nnz default (the
+    bucketed layout gathers every padded cell of every width class —
+    ``roofline.bucketed_gather_rows``) and ``sweeps`` multiplies it (each
+    subspace sweep re-gathers its rectangle)."""
     from cfk_tpu.utils.roofline import als_iteration_cost, roofline_row
 
     s = steady["s_per_iter_min"]
     cost = als_iteration_cost(
         nnz, users, movies, rank,
         factor_bytes=2 if dtype == "bfloat16" else 4, implicit=implicit,
+        table_dtype=table_dtype, gather_rows=gather_rows, sweeps=sweeps,
     )
     return {
         "metric": metric,
@@ -289,7 +306,7 @@ def _headline_row(metric, *, users, movies, nnz, rank, layout_tag,
         # BASELINE.json bar: < 60 s/iteration at full Netflix scale.
         "vs_baseline": round(s / 60.0, 4),
         "ratings_per_sec_per_chip": int(nnz * 2 / s),
-        **roofline_row(cost, s),
+        **roofline_row(cost, s, table_dtype=table_dtype),
         **steady,
         "users": users, "movies": movies, "ratings": nnz, "rank": rank,
         "layout": layout_tag, "dtype": dtype,
@@ -401,10 +418,17 @@ def ialspp_row() -> dict:
         ds, rank=128, iters=3, repeats=4, lam=0.1, model="ials++",
         alpha=40.0, block_size=32, sweeps=1,
     )
+    from cfk_tpu.utils.roofline import bucketed_gather_rows
+
     return _headline_row(
         "synthetic_ml25m_ialspp_steady_s_per_iteration",
         users=users, movies=movies, nnz=nnz, rank=128,
         layout_tag="bucketed", steady=steady, implicit=True, prep_s=prep,
+        # Honest bucketed floor: every padded cell of every width class
+        # fetches a row (BENCH_r05's 2·nnz floor understated it by the
+        # padding ratio, part of the recorded 9.94×).
+        gather_rows=bucketed_gather_rows(ds.movie_blocks, ds.user_blocks),
+        sweeps=1,
     )
 
 
@@ -454,7 +478,7 @@ def at_scale_quick() -> dict:
         "unit": "s/iteration",
         "vs_baseline": round(s_per_iter / (60.0 * nnz / FULL_NETFLIX_NNZ), 4),
         "ratings_per_sec_per_chip": int(nnz * 2 / s_per_iter),
-        **roofline_row(cost, s_per_iter),
+        **roofline_row(cost, s_per_iter, table_dtype="float32"),
         # Ground truth for the full shape is the driver-captured
         # full_rank64 row (no more linear-in-nnz extrapolation — the two
         # disagreed by 13% in BENCH_r03 and the measured one wins).
@@ -647,12 +671,19 @@ def run_scale(args) -> dict:
             "planted_heldout_cells": pn,
         }
 
-    from cfk_tpu.utils.roofline import als_iteration_cost
+    from cfk_tpu.utils.roofline import als_iteration_cost, bucketed_gather_rows
 
     cost = als_iteration_cost(
         nnz, users, movies, args.rank,
         factor_bytes=2 if args.dtype == "bfloat16" else 4,
         implicit=args.ials,
+        table_dtype=config.table_dtype,
+        # Same honest per-width-class floor the default-main ialspp row
+        # uses — 2·nnz undercounts the padded cells the bucketed walk
+        # actually fetches (measured 1.57× at the ML-25M build).
+        gather_rows=(bucketed_gather_rows(ds.movie_blocks, ds.user_blocks)
+                     if args.layout == "bucketed" else None),
+        sweeps=args.sweeps if (args.ialspp or args.alspp) else 1,
     )
     from cfk_tpu.utils.roofline import FULL_NETFLIX_NNZ, roofline_row
 
@@ -689,7 +720,7 @@ def run_scale(args) -> dict:
         # hbm_roofline_s is the min-traffic floor, and gather_roofline_s
         # the measured row-gather-engine floor — the binding resource for
         # ALS on this chip (see cfk_tpu/utils/roofline.py).
-        **roofline_row(cost, s_per_iter),
+        **roofline_row(cost, s_per_iter, table_dtype=config.table_dtype),
         **extrapolated,
         "timing_degenerate": timing_degenerate,
         "repeats": args.repeats,
@@ -1171,6 +1202,153 @@ def run_gather_ab(args) -> dict:
         "layout": "tiled+all_gather", "gather_div": div,
         "backend": "cpu-virtual-mesh (relative timings; HBM bytes analytic)",
     }
+
+
+def _quant_sweep(args, dtypes=("float32", "bfloat16", "int8")) -> dict:
+    """Shared worker for --quant-ab / --quality-bytes: train the planted
+    split once per table dtype (single device, tiled dense-stream — the
+    at-scale stack) and report per-dtype wall time, held-out RMSE, factor
+    delta vs the f32 run, and the analytic gather bytes per row.
+
+    The f32 run is the exact pre-quantization path (bit-identical by the
+    ``quant`` contract), so its RMSE is the quality baseline and its
+    factors the delta reference."""
+    import dataclasses as dc
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import planted_factor_coo
+    from cfk_tpu.eval.metrics import mse_rmse_heldout
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.utils.roofline import table_gather_bytes_per_row
+
+    div = args.quant_div
+    users, movies, nnz = 162_541 // div, 59_047 // div, 25_000_095 // div
+    rank = args.quant_rank
+    coo, held = planted_factor_coo(
+        users, movies, nnz, rank=rank, noise=args.planted_noise,
+        heldout=max(nnz // 5, 2_000), seed=args.seed,
+    )
+    ds = Dataset.from_coo(
+        coo, layout="tiled", chunk_elems=args.quant_chunk_elems,
+        dense_stream=True, accum_max_entities=0,
+    )
+    base = ALSConfig(
+        rank=rank, lam=0.05, num_iterations=args.iterations, seed=0,
+        layout="tiled", solver="pallas",
+    )
+    per = {}
+    f32_u = None
+    for td in dtypes:
+        cfg = dc.replace(base, table_dtype=td)
+        model = train_als(ds, cfg)  # compile + warm
+        model.user_factors.block_until_ready()
+        t0 = time.time()
+        model = train_als(ds, cfg)
+        model.user_factors.block_until_ready()
+        train_s = time.time() - t0
+        _, rmse, ncells = mse_rmse_heldout(model, ds, held)
+        uf = np.asarray(model.user_factors, np.float32)
+        if f32_u is None:
+            f32_u = uf
+        per[td] = {
+            "train_s": round(train_s, 4),
+            "s_per_iteration": round(train_s / args.iterations, 4),
+            "heldout_rmse": round(rmse, 5),
+            "max_abs_factor_delta_vs_f32": round(
+                float(np.abs(uf - f32_u).max()), 6
+            ),
+            "gather_bytes_per_row": table_gather_bytes_per_row(rank, td),
+        }
+    shape = {
+        "users": users, "movies": movies, "ratings": nnz, "rank": rank,
+        "iterations": args.iterations, "layout": "tiled+dense-stream",
+        "planted_noise_floor": args.planted_noise,
+        "heldout_cells": int(held.num_ratings),
+    }
+    return {"per_dtype": per, "shape": shape}
+
+
+def run_quant_ab(args) -> dict:
+    """Tentpole (b) A/B: quantized HBM gather tables (``ops.quant``) —
+    f32 vs bf16 vs int8+scale, factor delta + held-out RMSE + the
+    analytic gather bytes removed from the roofline floor.  CPU timings
+    are relative only (the emulation route upcasts either way); the
+    portable quantities are the quality contract (bf16 RMSE ≤ 1.01× f32,
+    the recorded int8 ratio) and the bytes arithmetic (bf16 halves the
+    f32 row, int8+scale quarters it at rank ≥ 32)."""
+    sweep = _quant_sweep(args)
+    per, shape = sweep["per_dtype"], sweep["shape"]
+    f32 = per["float32"]
+    row = {
+        "metric": "planted_quant_table_ab",
+        "value": per["bfloat16"]["heldout_rmse"],
+        "unit": "rmse(bf16 table)",
+        # ≤ 1.01 = the bf16-table quality contract on the planted split.
+        "vs_baseline": round(
+            per["bfloat16"]["heldout_rmse"] / f32["heldout_rmse"], 4
+        ),
+        "int8_rmse_vs_f32": round(
+            per["int8"]["heldout_rmse"] / f32["heldout_rmse"], 4
+        ),
+        "bytes_removed_per_row_bf16": (
+            f32["gather_bytes_per_row"]
+            - per["bfloat16"]["gather_bytes_per_row"]
+        ),
+        "bytes_removed_per_row_int8": (
+            f32["gather_bytes_per_row"] - per["int8"]["gather_bytes_per_row"]
+        ),
+        **{f"{td}_{k}": v for td, d in per.items() for k, v in d.items()},
+        **shape,
+        "backend": "cpu (relative timings; bytes analytic)",
+    }
+    return row
+
+
+def run_quality_bytes(args) -> dict:
+    """The RMSE-vs-table-dtype curve on the planted split: quality as a
+    function of gather bytes per row — the measured side of the
+    approximate-computing trade (arXiv 1808.03843)."""
+    sweep = _quant_sweep(args)
+    per, shape = sweep["per_dtype"], sweep["shape"]
+    f32 = per["float32"]["heldout_rmse"]
+    curve = [
+        {
+            "table_dtype": td,
+            "gather_bytes_per_row": d["gather_bytes_per_row"],
+            "heldout_rmse": d["heldout_rmse"],
+            "rmse_vs_f32": round(d["heldout_rmse"] / f32, 4),
+        }
+        for td, d in per.items()
+    ]
+    return {
+        "metric": "planted_quality_vs_table_bytes",
+        "value": curve[-1]["rmse_vs_f32"],
+        "unit": "rmse_ratio(int8)",
+        "vs_baseline": curve[1]["rmse_vs_f32"],
+        "curve": curve,
+        **shape,
+    }
+
+
+def quant_ab_main(args) -> None:
+    print(json.dumps(run_quant_ab(args)))
+
+
+def quality_bytes_main(args) -> None:
+    print(json.dumps(run_quality_bytes(args)))
+
+
+def _quant_ab_row() -> dict:
+    """Default-run quant A/B row — in-process (single device, no virtual
+    mesh to pre-configure, unlike the sharded A/B rows)."""
+    import argparse as _ap
+
+    args = _ap.Namespace(
+        quant_div=256, quant_rank=16, quant_chunk_elems=16_384,
+        iterations=3, planted_noise=0.2, seed=0,
+    )
+    return run_quant_ab(args)
 
 
 def health_ab_main(args) -> None:
@@ -1716,9 +1894,28 @@ if __name__ == "__main__":
     parser.add_argument("--foldin-batch-records", type=int, default=256,
                         help="log records per micro-batch (the offset-"
                         "committed replay quantum)")
+    parser.add_argument("--quant-ab", action="store_true",
+                        help="quantized-gather-table A/B (ops.quant): f32 "
+                        "vs bf16 vs int8+scale on the planted split — "
+                        "held-out RMSE per table dtype (bf16 <= 1.01x f32 "
+                        "is the contract), factor delta vs f32, and the "
+                        "analytic gather bytes removed per row")
+    parser.add_argument("--quality-bytes", action="store_true",
+                        help="emit the RMSE-vs-table-dtype curve on the "
+                        "planted split (quality as a function of gather "
+                        "bytes per row)")
+    parser.add_argument("--quant-div", type=int, default=256,
+                        help="shape divisor for --quant-ab/--quality-bytes "
+                        "(ML-25M proportions scaled down)")
+    parser.add_argument("--quant-rank", type=int, default=16)
+    parser.add_argument("--quant-chunk-elems", type=int, default=16_384)
     cli_args = parser.parse_args()
     run = (
-        (lambda: foldin_main(cli_args))
+        (lambda: quant_ab_main(cli_args))
+        if cli_args.quant_ab
+        else (lambda: quality_bytes_main(cli_args))
+        if cli_args.quality_bytes
+        else (lambda: foldin_main(cli_args))
         if cli_args.foldin
         else (lambda: ckpt_ab_main(cli_args))
         if cli_args.ckpt_ab

@@ -361,6 +361,7 @@ def _train(args) -> int:
             else args.in_kernel_gather == "on"
         ),
         reg_solve_algo=args.reg_solve_algo,
+        table_dtype=args.table_dtype,
         async_collective_permute=args.async_collective_permute,
         dtype=args.dtype,
         solver=args.solver,
@@ -1090,6 +1091,17 @@ def build_parser() -> argparse.ArgumentParser:
         "falling back to the XLA-gather schedule otherwise; 'off' pins "
         "the XLA gather (A/B measurement; factors are bit-identical "
         "either way — see ARCHITECTURE.md 'In-kernel neighbor gather')",
+    )
+    t.add_argument(
+        "--table-dtype", choices=["float32", "bfloat16", "int8"],
+        default="float32",
+        help="HBM gather-table dtype (cfk_tpu.ops.quant): quantize the "
+        "fixed-side table each half-iteration gathers from — bfloat16 "
+        "halves the gather bytes, int8 (+ one f32 scale per row, folded "
+        "into the kernels' premultiply) quarters them; Gram/solve "
+        "accumulation stays float32 and the solved factors keep --dtype. "
+        "float32 (default) is bit-identical to pre-quantization behavior. "
+        "int8 needs the tiled/bucketed layouts' weight streams",
     )
     t.add_argument(
         "--reg-solve-algo", choices=["auto", "lu", "gj"], default="auto",

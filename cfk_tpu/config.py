@@ -175,6 +175,21 @@ class ALSConfig:
     # bench.py --gather-ab baseline).  Factors are bit-identical across
     # the knob (tests/test_in_kernel_gather.py).
     in_kernel_gather: bool | None = None
+    # HBM gather-table dtype (cfk_tpu.ops.quant; approximate-computing MF,
+    # arXiv 1808.03843): the RAW fixed-side table each half-iteration
+    # gathers from is stored "float32" (identity — bit-identical to
+    # pre-quantization behavior), "bfloat16" (half the gather bytes), or
+    # "int8" (a quarter, plus one f32 scale per row — symmetric per-row
+    # quantization, the scale folded into the kernels' premultiply weight
+    # so the dequantize rides the existing √aw/mask pass).  Gram/solve
+    # accumulation stays float32 in-register for every choice, and the
+    # SOLVED (master) factors keep ``dtype`` — this knob only shrinks the
+    # gather operand, which is what the bytes-bound gather roofline
+    # charges.  int8 needs the per-row scale threaded through a weight
+    # stream, which the tiled and bucketed layouts have; padded/segment
+    # support float32/bfloat16 only.  Ring exchanges rotate the quantized
+    # payload (bf16 on both rings, int8+scale on the tiled ring).
+    table_dtype: Literal["float32", "bfloat16", "int8"] = "float32"
     # Elimination algorithm of the fused reg+solve kernels: "lu" (reverse
     # no-pivot LU, rank cap 128) or "gj" (Gauss-Jordan, cap 64); "auto"
     # defers to the process default (ops.pallas.solve_kernel.
@@ -318,6 +333,23 @@ class ALSConfig:
             raise ValueError(
                 f"in_kernel_gather must be None/True/False, got "
                 f"{self.in_kernel_gather!r}"
+            )
+        if self.table_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"table_dtype must be 'float32', 'bfloat16' or 'int8', "
+                f"got {self.table_dtype!r}"
+            )
+        if self.table_dtype == "int8" and self.layout not in (
+            "tiled", "bucketed"
+        ):
+            # Mirrors ops.quant.validate_table_dtype_layout (kept inline so
+            # config stays importable without jax): int8 needs the per-row
+            # dequant scale folded into a weight stream, which only the
+            # tiled/bucketed formulations carry.
+            raise ValueError(
+                f"table_dtype='int8' supports layout='tiled'/'bucketed' "
+                f"(the per-row scale rides their weight streams); "
+                f"layout={self.layout!r} should use 'bfloat16' or 'float32'"
             )
         if self.reg_solve_algo not in ("auto", "lu", "gj"):
             raise ValueError(

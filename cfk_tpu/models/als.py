@@ -240,33 +240,42 @@ def _segment_device_setup(dataset: Dataset):
 def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
           x_prev=None, algorithm="als", block_size=32, sweeps=1,
           overlap=None, fused_epilogue=None, in_kernel_gather=None,
-          reg_solve_algo=None):
+          reg_solve_algo=None, table_dtype=None):
     """Solve one side against fixed factors; dispatches on the block layout
     (tuple = width buckets, dict with segment ids = flat segment run,
     other dict = one padded rectangle).  ``algorithm="als++"`` runs
     warm-started subspace sweeps from ``x_prev`` instead of full solves
-    (padded/bucketed layouts)."""
+    (padded/bucketed layouts).  ``table_dtype`` quantizes the gather table
+    (``ops.quant``) — the tiled/bucketed/subspace entries quantize and
+    fold internally; the padded/segment paths take the bf16 cast here
+    (config validation refuses int8 for them)."""
     if algorithm == "als++":
         from cfk_tpu.ops.subspace import (
             als_pp_half_step,
             als_pp_half_step_bucketed,
         )
 
+        pp_kw = dict(
+            block_size=block_size, sweeps=sweeps, solver=solver,
+            in_kernel_gather=in_kernel_gather,
+            fused_epilogue=fused_epilogue, reg_solve_algo=reg_solve_algo,
+            table_dtype=table_dtype,
+        )
         if isinstance(blk, tuple):
             return als_pp_half_step_bucketed(
                 fixed, x_prev, blk, chunks, entities, lam,
-                block_size=block_size, sweeps=sweeps, solver=solver,
-                overlap=overlap,
+                overlap=overlap, **pp_kw,
             )
         return als_pp_half_step(
             fixed, x_prev, blk["neighbor_idx"], blk["rating"], blk["mask"],
-            blk["count"], lam,
-            block_size=block_size, sweeps=sweeps, solver=solver,
+            blk["count"], lam, **pp_kw,
         )
     if isinstance(blk, tuple):
         return als_half_step_bucketed(
             fixed, blk, chunks, entities, lam, solver=solver,
             overlap=overlap, reg_solve_algo=reg_solve_algo,
+            fused_epilogue=fused_epilogue, in_kernel_gather=in_kernel_gather,
+            table_dtype=table_dtype,
         )
     if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import tiled_half_step
@@ -275,7 +284,11 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
             fixed, blk, chunks, entities, lam, solver=solver,
             overlap=overlap, fused_epilogue=fused_epilogue,
             in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+            table_dtype=table_dtype,
         )
+    from cfk_tpu.ops import quant
+
+    fixed = quant.gather_operand_view(fixed, table_dtype)
     if "seg_rel" in blk:
         return als_half_step_segment(
             fixed,
@@ -310,7 +323,8 @@ def _half(fixed, blk, *, lam, solve_chunk, solver, chunks=None, entities=None,
 
 _LAYOUT_STATICS = ("m_chunks", "u_chunks", "m_entities", "u_entities")
 _ALG_STATICS = ("algorithm", "block_size", "sweeps", "overlap",
-                "fused_epilogue", "in_kernel_gather", "reg_solve_algo")
+                "fused_epilogue", "in_kernel_gather", "reg_solve_algo",
+                "table_dtype")
 
 
 @functools.partial(
@@ -338,6 +352,7 @@ def _train_loop(
     fused_epilogue: bool | None = None,
     in_kernel_gather: bool | None = None,
     reg_solve_algo: str | None = None,
+    table_dtype: str | None = None,
     health_every: int | None = None,
     health_norm_limit: float = 0.0,
     m_chunks=None,
@@ -364,7 +379,8 @@ def _train_loop(
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
             overlap=overlap, fused_epilogue=fused_epilogue,
             in_kernel_gather=in_kernel_gather,
-            reg_solve_algo=reg_solve_algo, m_prev=m_prev,
+            reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
+            m_prev=m_prev,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -400,7 +416,7 @@ def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
                     solver="cholesky", algorithm="als", block_size=32,
                     sweeps=1, overlap=None, fused_epilogue=None,
                     in_kernel_gather=None, reg_solve_algo=None,
-                    m_prev=None, m_chunks=None,
+                    table_dtype=None, m_prev=None, m_chunks=None,
                     u_chunks=None, m_entities=None, u_entities=None):
     """One full iteration (solve M from U, then U from M) — the single source
     of the per-iteration math for both the fused-loop and checkpointed paths.
@@ -413,7 +429,7 @@ def _iteration_body(u, movie_blocks, user_blocks, *, lam, solve_chunk, dt,
     alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
                overlap=overlap, fused_epilogue=fused_epilogue,
                in_kernel_gather=in_kernel_gather,
-               reg_solve_algo=reg_solve_algo)
+               reg_solve_algo=reg_solve_algo, table_dtype=table_dtype)
     m = _half(
         u, movie_blocks, lam=lam, solve_chunk=solve_chunk, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -448,6 +464,7 @@ def _one_iteration(
     fused_epilogue: bool | None = None,
     in_kernel_gather: bool | None = None,
     reg_solve_algo: str | None = None,
+    table_dtype: str | None = None,
     m_chunks=None,
     u_chunks=None,
     m_entities=None,
@@ -459,7 +476,7 @@ def _one_iteration(
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
         overlap=overlap, fused_epilogue=fused_epilogue,
         in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
-        m_prev=m_prev,
+        table_dtype=table_dtype, m_prev=m_prev,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -568,6 +585,7 @@ def train_als(
                 fused_epilogue=config.fused_epilogue,
                 in_kernel_gather=config.in_kernel_gather,
                 reg_solve_algo=config.reg_solve_algo,
+                table_dtype=config.table_dtype,
                 health_every=None if health is None else health.every,
                 health_norm_limit=(
                     0.0 if health is None else health.norm_limit
@@ -651,6 +669,7 @@ def train_als(
                     # (it used to ride the CFK_REG_SOLVE_ALGO env var).
                     reg_solve_algo=(ov.reg_solve_algo
                                     or config.reg_solve_algo),
+                    table_dtype=config.table_dtype,
                     **layout_kw,
                 )
 

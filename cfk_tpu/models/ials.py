@@ -72,7 +72,7 @@ class IALSConfig(ALSConfig):
 def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
                entities=None, x_prev=None, algorithm="als", block_size=32,
                sweeps=1, overlap=None, fused_epilogue=None,
-               in_kernel_gather=None, reg_solve_algo=None):
+               in_kernel_gather=None, reg_solve_algo=None, table_dtype=None):
     """Dispatch on block layout (tuple = buckets, dict with segment ids =
     flat segment run, other dict = padded rectangle).  ``algorithm="ials++"``
     runs warm-started subspace sweeps from ``x_prev`` instead of full
@@ -83,21 +83,27 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
             ials_pp_half_step_bucketed,
         )
 
+        pp_kw = dict(
+            gram=gram, block_size=block_size, sweeps=sweeps, solver=solver,
+            in_kernel_gather=in_kernel_gather,
+            fused_epilogue=fused_epilogue, reg_solve_algo=reg_solve_algo,
+            table_dtype=table_dtype,
+        )
         if isinstance(blk, tuple):
             return ials_pp_half_step_bucketed(
-                fixed, x_prev, blk, chunks, entities, lam, alpha, gram=gram,
-                block_size=block_size, sweeps=sweeps, solver=solver,
-                overlap=overlap,
+                fixed, x_prev, blk, chunks, entities, lam, alpha,
+                overlap=overlap, **pp_kw,
             )
         return ials_pp_half_step(
             fixed, x_prev, blk["neighbor_idx"], blk["rating"], blk["mask"],
-            lam, alpha, gram=gram, block_size=block_size, sweeps=sweeps,
-            solver=solver,
+            lam, alpha, **pp_kw,
         )
     if isinstance(blk, tuple):
         return ials_half_step_bucketed(
             fixed, blk, chunks, entities, lam, alpha, gram=gram,
             solver=solver, overlap=overlap, reg_solve_algo=reg_solve_algo,
+            fused_epilogue=fused_epilogue, in_kernel_gather=in_kernel_gather,
+            table_dtype=table_dtype,
         )
     if "weight" in blk or "tile_meta" in blk:  # tiled layout
         from cfk_tpu.ops.tiled import ials_tiled_half_step
@@ -109,7 +115,11 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
             fixed, blk, chunks, entities, lam, alpha, gram=gram,
             solver=solver, overlap=overlap, fused_epilogue=fused_epilogue,
             in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+            table_dtype=table_dtype,
         )
+    from cfk_tpu.ops import quant
+
+    fixed = quant.gather_operand_view(fixed, table_dtype)
     if "seg_rel" in blk:
         return ials_half_step_segment(
             fixed, blk["neighbor_idx"], blk["rating"], blk["mask"],
@@ -129,7 +139,7 @@ def _ials_half(fixed, blk, *, lam, alpha, solver, gram=None, chunks=None,
     static_argnames=(
         "rank", "num_iterations", "lam", "alpha", "dtype", "solver",
         "algorithm", "block_size", "sweeps", "overlap", "fused_epilogue",
-        "in_kernel_gather", "reg_solve_algo",
+        "in_kernel_gather", "reg_solve_algo", "table_dtype",
         "health_every", "health_norm_limit",
         "m_chunks", "u_chunks", "m_entities", "u_entities",
     ),
@@ -138,7 +148,7 @@ def _train_loop(
     key, movie_blocks, user_blocks, u_stats=None, *, rank, num_iterations, lam,
     alpha, dtype, solver="cholesky", algorithm="als", block_size=32, sweeps=1,
     overlap=None, fused_epilogue=None, in_kernel_gather=None,
-    reg_solve_algo=None,
+    reg_solve_algo=None, table_dtype=None,
     health_every=None, health_norm_limit=0.0,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
@@ -161,7 +171,7 @@ def _train_loop(
             algorithm=algorithm, block_size=block_size, sweeps=sweeps,
             overlap=overlap, fused_epilogue=fused_epilogue,
             in_kernel_gather=in_kernel_gather,
-            reg_solve_algo=reg_solve_algo,
+            reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
             m_chunks=m_chunks, u_chunks=u_chunks,
             m_entities=m_entities, u_entities=u_entities,
         )
@@ -192,6 +202,7 @@ def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
                          dt, solver, algorithm, block_size, sweeps,
                          overlap=None, fused_epilogue=None,
                          in_kernel_gather=None, reg_solve_algo=None,
+                         table_dtype=None,
                          m_chunks=None, u_chunks=None,
                          m_entities=None, u_entities=None):
     """One full iALS iteration (movies from users, then users from movies) —
@@ -200,7 +211,7 @@ def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
     alg = dict(algorithm=algorithm, block_size=block_size, sweeps=sweeps,
                overlap=overlap, fused_epilogue=fused_epilogue,
                in_kernel_gather=in_kernel_gather,
-               reg_solve_algo=reg_solve_algo)
+               reg_solve_algo=reg_solve_algo, table_dtype=table_dtype)
     m = _ials_half(
         u, movie_blocks, lam=lam, alpha=alpha, solver=solver,
         chunks=m_chunks, entities=m_entities, x_prev=m_prev, **alg,
@@ -217,7 +228,7 @@ def _ials_iteration_body(u, m_prev, movie_blocks, user_blocks, *, lam, alpha,
     static_argnames=(
         "lam", "alpha", "dtype", "solver", "algorithm", "block_size",
         "sweeps", "overlap", "fused_epilogue", "in_kernel_gather",
-        "reg_solve_algo", "m_chunks", "u_chunks",
+        "reg_solve_algo", "table_dtype", "m_chunks", "u_chunks",
         "m_entities", "u_entities",
     ),
     donate_argnums=(0, 1),
@@ -226,7 +237,7 @@ def _one_iteration(
     u, m_prev, movie_blocks, user_blocks, *, lam, alpha, dtype,
     solver="cholesky", algorithm="als", block_size=32, sweeps=1,
     overlap=None, fused_epilogue=None, in_kernel_gather=None,
-    reg_solve_algo=None,
+    reg_solve_algo=None, table_dtype=None,
     m_chunks=None, u_chunks=None, m_entities=None, u_entities=None,
 ):
     return _ials_iteration_body(
@@ -235,6 +246,7 @@ def _one_iteration(
         algorithm=algorithm, block_size=block_size, sweeps=sweeps,
         overlap=overlap, fused_epilogue=fused_epilogue,
         in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+        table_dtype=table_dtype,
         m_chunks=m_chunks, u_chunks=u_chunks,
         m_entities=m_entities, u_entities=u_entities,
     )
@@ -328,6 +340,7 @@ def train_ials(
                 fused_epilogue=config.fused_epilogue,
                 in_kernel_gather=config.in_kernel_gather,
                 reg_solve_algo=config.reg_solve_algo,
+                table_dtype=config.table_dtype,
                 health_every=None if health is None else health.every,
                 health_norm_limit=(
                     0.0 if health is None else health.norm_limit
@@ -391,6 +404,7 @@ def train_ials(
                     # als.train_als make_step).
                     reg_solve_algo=(ov.reg_solve_algo
                                     or config.reg_solve_algo),
+                    table_dtype=config.table_dtype,
                     **layout_kw,
                 )
 
@@ -468,7 +482,11 @@ def make_ials_training_step(
         )
 
         alg = dict(block_size=config.block_size, sweeps=config.sweeps,
-                   solver=config.solver)
+                   solver=config.solver,
+                   in_kernel_gather=config.in_kernel_gather,
+                   fused_epilogue=config.fused_epilogue,
+                   reg_solve_algo=config.reg_solve_algo,
+                   table_dtype=config.table_dtype)
 
         if m_chunks is not None:  # bucketed layout
 
@@ -485,9 +503,11 @@ def make_ials_training_step(
             return wrap_step(
                 mesh, config,
                 gathered_half(pp_bkt(m_chunks, m_local), with_gram=True,
-                              with_prev=True),
+                              with_prev=True,
+                              table_dtype=config.table_dtype),
                 gathered_half(pp_bkt(u_chunks, u_local), with_gram=True,
-                              with_prev=True),
+                              with_prev=True,
+                              table_dtype=config.table_dtype),
                 mspecs, uspecs, carry_prev=True,
             )
 
@@ -503,7 +523,8 @@ def make_ials_training_step(
             "mask": P(AXIS, None),
             "count": P(AXIS),
         }
-        half = gathered_half(pp_padded, with_gram=True, with_prev=True)
+        half = gathered_half(pp_padded, with_gram=True, with_prev=True,
+                             table_dtype=config.table_dtype)
         return wrap_step(mesh, config, half, half, spec, spec,
                          carry_prev=True)
 
@@ -519,14 +540,17 @@ def make_ials_training_step(
                     fused_epilogue=config.fused_epilogue,
                     in_kernel_gather=config.in_kernel_gather,
                     reg_solve_algo=config.reg_solve_algo,
+                    table_dtype=config.table_dtype,
                 )
 
             return solve
 
         return wrap_step(
             mesh, config,
-            gathered_half(tl_solve(m_chunks, m_local), with_gram=True),
-            gathered_half(tl_solve(u_chunks, u_local), with_gram=True),
+            gathered_half(tl_solve(m_chunks, m_local), with_gram=True,
+                          table_dtype=config.table_dtype),
+            gathered_half(tl_solve(u_chunks, u_local), with_gram=True,
+                          table_dtype=config.table_dtype),
             mspecs, uspecs,
         )
 
@@ -546,8 +570,10 @@ def make_ials_training_step(
 
         return wrap_step(
             mesh, config,
-            gathered_half(seg_solve(m_chunks, m_local), with_gram=True),
-            gathered_half(seg_solve(u_chunks, u_local), with_gram=True),
+            gathered_half(seg_solve(m_chunks, m_local), with_gram=True,
+                          table_dtype=config.table_dtype),
+            gathered_half(seg_solve(u_chunks, u_local), with_gram=True,
+                          table_dtype=config.table_dtype),
             mspecs, uspecs,
         )
 
@@ -559,14 +585,19 @@ def make_ials_training_step(
                     fixed_full, blk, chunks, local, config.lam, config.alpha,
                     gram=gram, solver=config.solver, overlap=config.overlap,
                     reg_solve_algo=config.reg_solve_algo,
+                    fused_epilogue=config.fused_epilogue,
+                    in_kernel_gather=config.in_kernel_gather,
+                    table_dtype=config.table_dtype,
                 )
 
             return solve
 
         return wrap_step(
             mesh, config,
-            gathered_half(bkt_solve(m_chunks, m_local), with_gram=True),
-            gathered_half(bkt_solve(u_chunks, u_local), with_gram=True),
+            gathered_half(bkt_solve(m_chunks, m_local), with_gram=True,
+                          table_dtype=config.table_dtype),
+            gathered_half(bkt_solve(u_chunks, u_local), with_gram=True,
+                          table_dtype=config.table_dtype),
             mspecs, uspecs,
         )
 
@@ -583,7 +614,8 @@ def make_ials_training_step(
         "mask": P(AXIS, None),
         "count": P(AXIS),
     }
-    half = gathered_half(padded_solve, with_gram=True)
+    half = gathered_half(padded_solve, with_gram=True,
+                         table_dtype=config.table_dtype)
     return wrap_step(mesh, config, half, half, spec, spec)
 
 

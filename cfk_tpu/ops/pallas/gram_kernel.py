@@ -1173,28 +1173,52 @@ def _gather_double_buffer(g_buf, sem, table_ref, sc_ref, *, nb_base, rows,
     return slot * rows
 
 
-def _premultiply_rows(g_buf, off, rows, wt_ref):
+def _premultiply_rows(g_buf, off, rows, wt_ref, out_buf=None):
     """In-register per-entry premultiply on the gathered block: one
     (1, rows) → (rows, 1) relayout per grid step (VMEM-local — the XLA
     path's [C, 1] weight column relayout through HBM is what this
     replaces), then a fused broadcast multiply.  The weight is cast to
     the factor dtype first, matching the XLA path's ``wt.astype(ct)``
     bit-for-bit.  ``wt`` is the 0/1 validity mask for unit-weight callers
-    — which is what zeroes the clamped padding rows in-register."""
+    — which is what zeroes the clamped padding rows in-register.
+
+    ``out_buf`` (int8 quantized tables — ``ops.quant``) redirects the
+    product into a separate f32 compute scratch instead of multiplying in
+    place: the DMA'd int8 rows cannot hold the dequantized product, and
+    the per-row dequant scale is already folded into ``wt`` upstream
+    (``quant.fold_scale`` — the canonical order), so THIS multiply is
+    also the dequantize.  One pass either way."""
     base = pl.ds(pl.multiple_of(off, 16), rows)
     blk = g_buf[base, :]
-    w = jnp.transpose(wt_ref[...], (1, 0)).astype(blk.dtype)
-    g_buf[base, :] = blk * w
+    if out_buf is None:
+        w = jnp.transpose(wt_ref[...], (1, 0)).astype(blk.dtype)
+        g_buf[base, :] = blk * w
+    else:
+        w = jnp.transpose(wt_ref[...], (1, 0)).astype(out_buf.dtype)
+        out_buf[base, :] = blk.astype(out_buf.dtype) * w
+
+
+def _pop_gather_scratch(refs, int8_table):
+    """Pop the gather scratch tail (``… g_buf, sem[, dq_buf]``) off a
+    kernel's ref list: returns (g_buf, sem, dq_buf-or-None).  ``dq_buf``
+    (int8 tables only) is the f32 dequant compute buffer appended LAST in
+    the scratch list."""
+    dq_buf = None
+    if int8_table:
+        dq_buf = refs[-1]
+        del refs[-1]
+    g_buf, sem = refs[-2], refs[-1]
+    del refs[-2:]
+    return g_buf, sem, dq_buf
 
 
 def _gram_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt, f_rows,
-                               precision, with_carry):
+                               precision, with_carry, int8_table=False):
     """Gather-fused twin of ``_gram_groups_kernel``: the [m·t, k] factor
     block is row-DMA'd from the ANY-memory table instead of streamed as a
     pipelined input.  Scalar layout: seg [NT] ‖ nb [NT·T]."""
     refs = list(refs)
-    g_buf, sem = refs[-2], refs[-1]
-    del refs[-2:]
+    g_buf, sem, dq_buf = _pop_gather_scratch(refs, int8_table)
     a_ref, b_ref = refs[-2:]
     del refs[-2:]
     carry = None
@@ -1210,8 +1234,9 @@ def _gram_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt, f_rows,
         ng=pl.num_programs(0), f_rows=f_rows,
         group_row0=lambda g: g * rows,
     )
-    _premultiply_rows(g_buf, off, rows, wt_ref)
-    a_all, b_all = _tile_grams(g_buf, rt_ref, m=m, t=t, k=k,
+    _premultiply_rows(g_buf, off, rows, wt_ref, out_buf=dq_buf)
+    a_all, b_all = _tile_grams(dq_buf if int8_table else g_buf, rt_ref,
+                               m=m, t=t, k=k,
                                precision=precision, row_off=off)
     _walk_tiles(lambda i: sc_ref[i], a_all, b_all, gi=gi, base=base, m=m,
                 a_ref=a_ref, b_ref=b_ref, carry=carry)
@@ -1219,13 +1244,12 @@ def _gram_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt, f_rows,
 
 def _gram_solve_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt,
                                      s_pad, f_rows, precision, with_carry,
-                                     reg_mode, lam, algo):
+                                     reg_mode, lam, algo, int8_table=False):
     """Gather-fused twin of ``_gram_solve_groups_kernel`` (in-kernel
     gather + scratch-resident walk + last-step ridge+solve epilogue).
     Scalar layout: seg [NT] ‖ lseg ‖ nb [NT·T]."""
     refs = list(refs)
-    g_buf, sem = refs[-2], refs[-1]
-    del refs[-2:]
+    g_buf, sem, dq_buf = _pop_gather_scratch(refs, int8_table)
     if algo == "lu":
         lu_scr = tuple(refs[-3:])
         del refs[-3:]
@@ -1248,8 +1272,9 @@ def _gram_solve_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt,
         ng=pl.num_programs(0), f_rows=f_rows,
         group_row0=lambda g: g * rows,
     )
-    _premultiply_rows(g_buf, off, rows, wt_ref)
-    a_all, b_all = _tile_grams(g_buf, rt_ref, m=m, t=t, k=k,
+    _premultiply_rows(g_buf, off, rows, wt_ref, out_buf=dq_buf)
+    a_all, b_all = _tile_grams(dq_buf if int8_table else g_buf, rt_ref,
+                               m=m, t=t, k=k,
                                precision=precision, row_off=off)
     _walk_tiles(lambda i: sc_ref[i], a_all, b_all, gi=gi, base=base, m=m,
                 a_ref=a_scr, b_ref=b_scr, carry=carry)
@@ -1263,15 +1288,15 @@ def _gram_solve_gather_groups_kernel(sc_ref, table_ref, *refs, m, t, k, nt,
 
 
 def _gram_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng, nt, bg,
-                              f_rows, precision, with_carry, weighted):
+                              f_rows, precision, with_carry, weighted,
+                              int8_table=False):
     """Gather-fused twin of ``_gram_dense_kernel``: the [BG, k] stream
     block is row-DMA'd by index instead of streamed.  Dense padding slots
     need no premultiply mask — they sit outside every [lo, hi) window, so
     the windowed walk's one-operand mask annihilates whatever the clamped
     DMA fetched.  Scalar layout: meta [NG+4·NT] ‖ nb [C]."""
     refs = list(refs)
-    g_buf, sem = refs[-2], refs[-1]
-    del refs[-2:]
+    g_buf, sem, dq_buf = _pop_gather_scratch(refs, int8_table)
     a_ref, b_ref = refs[-2:]
     del refs[-2:]
     carry = None
@@ -1289,9 +1314,10 @@ def _gram_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng, nt, bg,
         group_row0=lambda g: sc_ref[g] * bg,
     )
     if weighted:
-        _premultiply_rows(g_buf, off, bg, wt_ref)
+        _premultiply_rows(g_buf, off, bg, wt_ref, out_buf=dq_buf)
     a_all, b_all = _tile_grams_dense(
-        sc_ref, g_buf, rt_ref, m=m, t=t, k=k, base=base, ng=ng, nt=nt,
+        sc_ref, dq_buf if int8_table else g_buf, rt_ref, m=m, t=t, k=k,
+        base=base, ng=ng, nt=nt,
         precision=precision, row_off=off,
     )
     _walk_tiles(lambda i: sc_ref[ng + 3 * nt + i], a_all, b_all, gi=gi,
@@ -1301,12 +1327,11 @@ def _gram_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng, nt, bg,
 def _gram_solve_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng,
                                     nt, bg, s_pad, f_rows, precision,
                                     with_carry, weighted, reg_mode, lam,
-                                    algo):
+                                    algo, int8_table=False):
     """Gather-fused twin of ``_gram_solve_dense_kernel``.  Scalar layout:
     meta [NG+4·NT] ‖ lseg ‖ nb [C]."""
     refs = list(refs)
-    g_buf, sem = refs[-2], refs[-1]
-    del refs[-2:]
+    g_buf, sem, dq_buf = _pop_gather_scratch(refs, int8_table)
     if algo == "lu":
         lu_scr = tuple(refs[-3:])
         del refs[-3:]
@@ -1332,9 +1357,10 @@ def _gram_solve_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng,
         group_row0=lambda g: sc_ref[g] * bg,
     )
     if weighted:
-        _premultiply_rows(g_buf, off, bg, wt_ref)
+        _premultiply_rows(g_buf, off, bg, wt_ref, out_buf=dq_buf)
     a_all, b_all = _tile_grams_dense(
-        sc_ref, g_buf, rt_ref, m=m, t=t, k=k, base=base, ng=ng, nt=nt,
+        sc_ref, dq_buf if int8_table else g_buf, rt_ref, m=m, t=t, k=k,
+        base=base, ng=ng, nt=nt,
         precision=precision, row_off=off,
     )
     _walk_tiles(lambda i: sc_ref[ng + 3 * nt + i], a_all, b_all, gi=gi,
@@ -1349,10 +1375,39 @@ def _gram_solve_gather_dense_kernel(sc_ref, table_ref, *refs, m, t, k, ng,
         )
 
 
+def _int8_gather_pieces(table, rows, k, weighted=True):
+    """int8-quantized-table extras for the gather wrappers (``ops.quant``):
+    the f32 dequant compute scratch (appended LAST in the scratch list —
+    the convention ``_pop_gather_scratch`` reverses) and its VMEM bytes.
+    int8 rows REQUIRE a weight stream — the per-row dequant scale rides it
+    (folded upstream by ``quant.fold_scale``, which is also what makes the
+    single premultiply the dequantize) — so an unweighted int8 call is
+    refused rather than silently accumulating raw quantized codes."""
+    if table.dtype != jnp.int8:
+        return False, [], 0
+    if not weighted:
+        raise ValueError(
+            "int8 gather tables need a weight stream (quant.fold_scale "
+            "folds the per-row dequant scale into wt); got wt=None"
+        )
+    return True, [pltpu.VMEM((2 * rows, k), jnp.float32)], 2 * rows * k * 4
+
+
+def _gather_precision(table):
+    """Einsum precision for the gather kernels' Gram walk: full-f32 MXU
+    passes for f32 tables AND int8 tables (whose compute buffer is the f32
+    dequant scratch); the bf16 stream keeps the fast default passes."""
+    return (
+        jax.lax.Precision.HIGHEST
+        if table.dtype in (jnp.float32, jnp.int8) else None
+    )
+
+
 def _emulate_gather(table, nb, wt):
     """The wrappers' interpret/old-jax gather: the XLA twin of the DMA
     fetch + in-register premultiply (``compat.emulate_in_kernel_gather``),
-    at the factor compute dtype the materialized-stream path uses."""
+    at the factor compute dtype the materialized-stream path uses (f32
+    for int8 tables — the dequant scratch dtype)."""
     from cfk_tpu.compat import emulate_in_kernel_gather
     from cfk_tpu.ops.solve import _gram_compute_dtype
 
@@ -1406,6 +1461,7 @@ def gram_tiles_gather_pallas(
         m //= 2
     rows = m * t
     f_rows = table.shape[0]
+    int8_table, dq_scratch, dq_bytes = _int8_gather_pieces(table, rows, k)
     vma = typeof_vma(table)
     mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
         lambda s, d: jax.ShapeDtypeStruct(s, d)
@@ -1434,13 +1490,11 @@ def gram_tiles_gather_pallas(
         scratch_shapes=[
             pltpu.VMEM((2 * rows, k), table.dtype),
             pltpu.SemaphoreType.DMA((2,)),
-        ],
+        ] + dq_scratch,
     )
-    precision = (
-        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
-    )
+    precision = _gather_precision(table)
     out_bytes = num_segments * k * (k + 1) * 4
-    g_bytes = 2 * rows * k * table.dtype.itemsize
+    g_bytes = 2 * rows * k * table.dtype.itemsize + dq_bytes
     params = getattr(pltpu, "CompilerParams", None) or getattr(
         pltpu, "TPUCompilerParams"
     )
@@ -1458,6 +1512,7 @@ def gram_tiles_gather_pallas(
         functools.partial(
             _gram_gather_groups_kernel, m=m, t=t, k=k, nt=nt, f_rows=f_rows,
             precision=precision, with_carry=carry is not None,
+            int8_table=int8_table,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -1538,6 +1593,7 @@ def _gram_solve_tiles_gather_pallas(
         m //= 2
     rows = m * t
     f_rows = table.shape[0]
+    int8_table, dq_scratch, dq_bytes = _int8_gather_pieces(table, rows, k)
     s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
     vma = typeof_vma(table)
     (reg_op, reg_spec, carry_ops, carry_specs, out_shape, out_specs,
@@ -1546,7 +1602,7 @@ def _gram_solve_tiles_gather_pallas(
     scratch = scratch + [
         pltpu.VMEM((2 * rows, k), table.dtype),
         pltpu.SemaphoreType.DMA((2,)),
-    ]
+    ] + dq_scratch
     scalar = jnp.concatenate([
         seg.astype(jnp.int32),
         jnp.asarray(lseg, jnp.int32).reshape(1),
@@ -1564,10 +1620,8 @@ def _gram_solve_tiles_gather_pallas(
         out_specs=out_specs,
         scratch_shapes=scratch,
     )
-    precision = (
-        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
-    )
-    g_bytes = 2 * rows * k * table.dtype.itemsize
+    precision = _gather_precision(table)
+    g_bytes = 2 * rows * k * table.dtype.itemsize + dq_bytes
     params = getattr(pltpu, "CompilerParams", None) or getattr(
         pltpu, "TPUCompilerParams"
     )
@@ -1580,7 +1634,7 @@ def _gram_solve_tiles_gather_pallas(
             _gram_solve_gather_groups_kernel, m=m, t=t, k=k, nt=nt,
             s_pad=s_pad, f_rows=f_rows, precision=precision,
             with_carry=carry is not None, reg_mode=reg_mode, lam=lam,
-            algo=algo,
+            algo=algo, int8_table=int8_table,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -1642,6 +1696,8 @@ def gram_tiles_dense_gather_pallas(
         raise RuntimeError("pallas TPU extensions unavailable")
     f_rows = table.shape[0]
     weighted = wt is not None
+    int8_table, dq_scratch, dq_bytes = _int8_gather_pieces(
+        table, bg, k, weighted=weighted)
     vma = typeof_vma(table)
     mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
         lambda s, d: jax.ShapeDtypeStruct(s, d)
@@ -1671,13 +1727,11 @@ def gram_tiles_dense_gather_pallas(
         scratch_shapes=[
             pltpu.VMEM((2 * bg, k), table.dtype),
             pltpu.SemaphoreType.DMA((2,)),
-        ],
+        ] + dq_scratch,
     )
-    precision = (
-        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
-    )
+    precision = _gather_precision(table)
     out_bytes = num_segments * k * (k + 1) * 4
-    g_bytes = 2 * bg * k * table.dtype.itemsize
+    g_bytes = 2 * bg * k * table.dtype.itemsize + dq_bytes
     params = getattr(pltpu, "CompilerParams", None) or getattr(
         pltpu, "TPUCompilerParams"
     )
@@ -1697,6 +1751,7 @@ def gram_tiles_dense_gather_pallas(
             _gram_gather_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt, bg=bg,
             f_rows=f_rows, precision=precision,
             with_carry=carry is not None, weighted=weighted,
+            int8_table=int8_table,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -1783,6 +1838,8 @@ def _gram_solve_tiles_dense_gather_pallas(
         raise RuntimeError("pallas TPU extensions unavailable")
     f_rows = table.shape[0]
     weighted = wt is not None
+    int8_table, dq_scratch, dq_bytes = _int8_gather_pieces(
+        table, bg, k, weighted=weighted)
     s_pad = -(-num_segments // _SOLVE_LANES) * _SOLVE_LANES
     vma = typeof_vma(table)
     (reg_op, reg_spec, carry_ops, carry_specs, out_shape, out_specs,
@@ -1791,7 +1848,7 @@ def _gram_solve_tiles_dense_gather_pallas(
     scratch = scratch + [
         pltpu.VMEM((2 * bg, k), table.dtype),
         pltpu.SemaphoreType.DMA((2,)),
-    ]
+    ] + dq_scratch
     wt_specs = ([pl.BlockSpec((1, bg), lambda i, sc: (0, sc[i]))]
                 if weighted else [])
     scalar = jnp.concatenate([
@@ -1809,10 +1866,8 @@ def _gram_solve_tiles_dense_gather_pallas(
         out_specs=out_specs,
         scratch_shapes=scratch,
     )
-    precision = (
-        jax.lax.Precision.HIGHEST if table.dtype == jnp.float32 else None
-    )
-    g_bytes = 2 * bg * k * table.dtype.itemsize
+    precision = _gather_precision(table)
+    g_bytes = 2 * bg * k * table.dtype.itemsize + dq_bytes
     params = getattr(pltpu, "CompilerParams", None) or getattr(
         pltpu, "TPUCompilerParams"
     )
@@ -1826,7 +1881,7 @@ def _gram_solve_tiles_dense_gather_pallas(
             _gram_solve_gather_dense_kernel, m=m, t=t, k=k, ng=ng, nt=nt,
             bg=bg, s_pad=s_pad, f_rows=f_rows, precision=precision,
             with_carry=carry is not None, weighted=weighted,
-            reg_mode=reg_mode, lam=lam, algo=algo,
+            reg_mode=reg_mode, lam=lam, algo=algo, int8_table=int8_table,
         ),
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -1834,6 +1889,130 @@ def _gram_solve_tiles_dense_gather_pallas(
         **kwargs,
     )(scalar, table, rt.reshape(1, nt * t), *wt_ops, reg_op, *carry_ops)
     return x[:num_segments], cao, cbo[0]
+
+
+def _gather_rows_kernel(sc_ref, table_ref, *refs, bg, k, f_rows, weighted,
+                        sep_buf):
+    """Row-DMA stream producer: each grid step fetches its [BG] indexed
+    rows into the double-buffered scratch (next group's copies in flight
+    under this group's write-out), applies the premultiply (which is also
+    the dequantize for quantized tables — scale folded into ``wt``
+    upstream), and writes the [BG, k] block to the output stream.  The
+    bucketed half-steps and the subspace sweeps use this where their
+    consumer needs the whole gathered rectangle resident (the b×b sweeps
+    rank-update a score stream across blocks, so the stream must exist) —
+    it replaces XLA's operand-size-cliffed gather with per-row DMA, not
+    the stream itself."""
+    refs = list(refs)
+    g_buf, sem, dq_buf = _pop_gather_scratch(refs, sep_buf)
+    out_ref = refs[-1]
+    wt_ref = refs[0] if weighted else None
+    gi = pl.program_id(0)
+    off = _gather_double_buffer(
+        g_buf, sem, table_ref, sc_ref, nb_base=0, rows=bg, gi=gi,
+        ng=pl.num_programs(0), f_rows=f_rows,
+        group_row0=lambda g: g * bg,
+    )
+    base = pl.ds(pl.multiple_of(off, 16), bg)
+    if weighted:
+        _premultiply_rows(g_buf, off, bg, wt_ref, out_buf=dq_buf)
+        src = dq_buf if sep_buf else g_buf
+        out_ref[...] = src[base, :].astype(out_ref.dtype)
+    else:
+        out_ref[...] = g_buf[base, :].astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("out_dtype", "block_rows", "interpret"),
+)
+def gather_rows_pallas(
+    table: jax.Array,  # [F, k] RAW table (f32 / bf16 / int8 — no zero row)
+    nb: jax.Array,  # [C] int32 row indices; F = the virtual zero row
+    wt: jax.Array | None,  # [C] premultiply (mask / √aw·mask, scale folded)
+    *,
+    out_dtype=None,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Materialized gathered stream via in-kernel row DMA:
+    ``out[i] = table[nb[i]].astype(out_dtype) · wt[i]`` with the virtual
+    zero row realized by clamp + the ``wt`` mask (``wt=None`` skips the
+    multiply — callers whose padding is annihilated downstream).
+
+    Off-TPU / old-jax / refused shapes route through the bit-identical
+    XLA twin (``compat.emulate_in_kernel_gather``), so CPU CI pins the
+    same numbers the Mosaic DMA path produces on hardware."""
+    from cfk_tpu.ops.solve import _gram_compute_dtype
+
+    c = nb.shape[0]
+    k = table.shape[-1]
+    if table.dtype == jnp.int8 and wt is None:
+        # Same loud refusal as the gram kernels (_int8_gather_pieces):
+        # the per-row dequant scale rides ONLY in wt (quant.fold_scale),
+        # so a scale-less int8 gather would return raw codes as numbers.
+        raise ValueError(
+            "gather_rows_pallas: an int8 table needs the per-row dequant "
+            "scale folded into wt (ops.quant.fold_scale); wt=None would "
+            "return raw quantized codes"
+        )
+    if out_dtype is None:
+        out_dtype, _ = _gram_compute_dtype(table)
+    out_dtype = jnp.dtype(out_dtype)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bg = block_rows or min(c, 1024)
+    while bg > 16 and c % bg:
+        bg //= 2
+    supported = (
+        not interpret and has_vma_system() and pltpu is not None
+        and c % bg == 0 and bg % 16 == 0
+        and in_kernel_gather_supported(c, 0, 16)
+    )
+    if not supported:
+        from cfk_tpu.compat import emulate_in_kernel_gather
+
+        return emulate_in_kernel_gather(table, nb, wt, out_dtype)
+    f_rows = table.shape[0]
+    weighted = wt is not None
+    sep_buf = weighted and out_dtype != table.dtype
+    vma = typeof_vma(table)
+    mk = (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma else (
+        lambda s, d: jax.ShapeDtypeStruct(s, d)
+    )
+    wt_specs = ([pl.BlockSpec((1, bg), lambda i, sc: (0, i))]
+                if weighted else [])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(c // bg,),
+        in_specs=[pl.BlockSpec(memory_space=_any_memory_space())] + wt_specs,
+        out_specs=[pl.BlockSpec((bg, k), lambda i, sc: (i, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((2 * bg, k), table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ] + ([pltpu.VMEM((2 * bg, k), out_dtype)] if sep_buf else []),
+    )
+    params = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    g_bytes = 2 * bg * k * (table.dtype.itemsize
+                            + (out_dtype.itemsize if sep_buf else 0))
+    kwargs = {"compiler_params": params(
+        vmem_limit_bytes=min(g_bytes + 2 * bg * k * out_dtype.itemsize
+                             + 4 * bg * 8 + (8 << 20), 124 << 20)
+    )}
+    wt_ops = ([wt.reshape(1, c).astype(jnp.float32)] if weighted else [])
+    (out,) = pl.pallas_call(
+        functools.partial(
+            _gather_rows_kernel, bg=bg, k=k, f_rows=f_rows,
+            weighted=weighted, sep_buf=sep_buf,
+        ),
+        grid_spec=grid_spec,
+        out_shape=(mk((c, k), out_dtype),),
+        interpret=interpret,
+        **kwargs,
+    )(nb.astype(jnp.int32), table, *wt_ops)
+    return out
 
 
 def _check_reg_shape(reg, reg_mode, num_segments, k):

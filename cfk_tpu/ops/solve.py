@@ -204,22 +204,48 @@ def ials_half_step_bucketed(
     solver: str = "cholesky",
     overlap: bool | None = None,
     reg_solve_algo: str | None = None,
+    fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    table_dtype: str | None = None,
 ) -> jax.Array:
     """Implicit-feedback half-iteration over width-bucketed InBlocks.
 
     Same bucket walk as ``als_half_step_bucketed``; per entity the normal
     matrix is YᵀY + Σ_obs (c−1)·f fᵀ + λI.  Zero-interaction rows stay 0,
     identical to the padded path's (YᵀY + λI)x = 0 solve.
+
+    Width classes that pass the port gates run the tiled gather kernels
+    via ``ops.bucketed`` (in-kernel DMA gather + fused b-batch epilogue,
+    sqrt-reparameterized single weighted stream — the tiled iALS trick);
+    refused classes keep this legacy schedule.  ``table_dtype`` quantizes
+    the gather table (``ops.quant``); the legacy fallback and the global
+    Gram consume the dequantized view so every route sees the same values.
     """
+    from cfk_tpu.ops import bucketed as bport, quant
+
     k = fixed_factors.shape[-1]
+    data, scale = quant.quantize_table(fixed_factors, table_dtype)
+    view = quant.dequantize_table(data, scale)
     if gram is None:
-        gram = global_gram(fixed_factors)
-    reg = lam * jnp.eye(k, dtype=jnp.float32)
+        gram = global_gram(view)
+    reg_m = gram + lam * jnp.eye(k, dtype=jnp.float32)
 
     def solve_piece(ni, rt, mk):
-        a_obs, b = gather_gram_implicit(fixed_factors, ni, alpha * rt, mk)
-        return regularized_solve_matrix(a_obs, b, gram + reg, solver,
-                                        algo=reg_solve_algo)
+        rows, width = ni.shape
+        modes = bport.resolve_bucket_modes(
+            fused_epilogue, in_kernel_gather, solver, rows, width, k,
+            None, reg_solve_algo,
+        )
+        if modes is None:
+            a_obs, b = gather_gram_implicit(view, ni, alpha * rt, mk)
+            return regularized_solve_matrix(a_obs, b, reg_m, solver,
+                                            algo=reg_solve_algo)
+        fused, gather = modes
+        wt, rt_b = bport.ials_reparam(rt, mk, alpha)
+        return bport.bucket_gram_solve(
+            data, scale, ni, wt, rt_b, reg_m, lam=0.0, reg_mode="matrix",
+            solver=solver, fused=fused, gather=gather, algo=reg_solve_algo,
+        )
 
     out = walk_buckets(
         buckets, chunk_rows,
@@ -773,25 +799,50 @@ def als_half_step_bucketed(
     solver: str = "cholesky",
     overlap: bool | None = None,
     reg_solve_algo: str | None = None,
+    fused_epilogue: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    table_dtype: str | None = None,
 ) -> jax.Array:
     """One ALS half-iteration over width-bucketed InBlocks.
 
-    Each bucket is solved as its own gather + einsum + Cholesky batch (the
-    Python loop unrolls into one XLA program — bucket count is static and
-    O(log max_nnz)); results scatter into the entity-order factor matrix.
-    Rows absent from every bucket (zero ratings) stay exactly 0, matching the
-    padded path's λ·I-floor solve of an all-zero system.  ``chunk_rows``
-    streams oversized buckets through HBM in [chunk, width, k] pieces.
+    Width classes that pass the port gates (``ops.bucketed``) run the
+    tiled gather kernels — in-kernel row DMA (``in_kernel_gather``) and
+    the in-VMEM ridge+solve epilogue (``fused_epilogue``), one tile per
+    entity, so the ported f32 path is bit-identical to this legacy
+    schedule on the emulation route.  Refused classes (width < 16, SMEM
+    overflow) keep the legacy gather + einsum + solve batch.  Rows absent
+    from every bucket (zero ratings) stay exactly 0, matching the padded
+    path's λ·I-floor solve of an all-zero system.  ``chunk_rows`` streams
+    oversized buckets through HBM in [chunk, width, k] pieces.
+    ``table_dtype`` quantizes the gather table (``ops.quant``).
     """
+    from cfk_tpu.ops import bucketed as bport, quant
+
     k = fixed_factors.shape[-1]
+    data, scale = quant.quantize_table(fixed_factors, table_dtype)
+    view = quant.dequantize_table(data, scale)
+
+    def solve_piece(ni, rt, mk, cnt):
+        rows, width = ni.shape
+        modes = bport.resolve_bucket_modes(
+            fused_epilogue, in_kernel_gather, solver, rows, width, k,
+            lam, reg_solve_algo,
+        )
+        if modes is None:
+            return _solve_chunk(view, lam, ni, rt, mk, cnt, solver,
+                                reg_solve_algo)
+        fused, gather = modes
+        return bport.bucket_gram_solve(
+            data, scale, ni, mk, rt, cnt, lam=lam, reg_mode="diag",
+            solver=solver, fused=fused, gather=gather, algo=reg_solve_algo,
+        )
+
     out = walk_buckets(
         buckets, chunk_rows,
         lambda blk, _out: (
             blk["neighbor"], blk["rating"], blk["mask"], blk["count"]
         ),
-        lambda ni, rt, mk, cnt: _solve_chunk(
-            fixed_factors, lam, ni, rt, mk, cnt, solver, reg_solve_algo
-        ),
+        solve_piece,
         jnp.zeros((local_entities + 1, k), jnp.float32),
         overlap=overlap,
     )

@@ -41,11 +41,47 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from cfk_tpu.ops.solve import dispatch_spd_solve
+from cfk_tpu.ops.solve import (
+    regularized_solve,
+    regularized_solve_matrix,
+)
+
+
+def _sweep_gather(fixed, scale, neighbor_idx, maskf, in_kernel_gather):
+    """The sweep's gathered rectangle ``[E, P, k]`` — the ONE place the
+    fixed-side rows enter the sweep, so the Gram blocks, the b-side, AND
+    the per-interaction score stream all read the same values.
+
+    With ``in_kernel_gather`` (default on) the rows are row-DMA'd by the
+    Pallas stream producer (``gather_rows_pallas`` — scalar-prefetched
+    indices, double-buffered VMEM scratch; interpret/old-jax routes run
+    the bit-identical XLA twin), retiring the operand-size-cliffed XLA
+    gather; off, the same canonical ops run as plain XLA.  For quantized
+    tables (``ops.quant``) the per-row dequant scale is folded into the
+    mask weight FIRST, so the single premultiply is also the dequantize —
+    the score stream therefore sees exactly the dequantized values the
+    kernels read (recomputing scores from the f32 master factors would
+    make the fallback and kernel paths disagree bit-for-bit).
+    """
+    from cfk_tpu.ops import quant
+    from cfk_tpu.ops.tiled import resolve_in_kernel_gather
+
+    e, p = neighbor_idx.shape
+    k = fixed.shape[-1]
+    wt = quant.fold_scale(maskf, scale, neighbor_idx)
+    if resolve_in_kernel_gather(in_kernel_gather):
+        from cfk_tpu.ops.pallas.gram_kernel import gather_rows_pallas
+
+        g = gather_rows_pallas(
+            fixed, neighbor_idx.reshape(-1), wt.reshape(-1),
+            out_dtype=jnp.float32,
+        )
+        return g.reshape(e, p, k)
+    return fixed[neighbor_idx].astype(jnp.float32) * wt[..., None]
 
 
 def _sweep_rect(
-    fixed: jax.Array,  # [F, k] fixed-side factors
+    fixed: jax.Array,  # [F, k] fixed-side gather table (f32/bf16/int8)
     x: jax.Array,  # [E, k] current own-side iterate (float32)
     neighbor_idx: jax.Array,  # [E, P]
     rating: jax.Array,  # [E, P] raw interaction strengths
@@ -56,6 +92,10 @@ def _sweep_rect(
     block_size: int,
     solver: str,
     count: jax.Array | None = None,  # [E] rating counts (explicit: λ·n·I reg)
+    scale: jax.Array | None = None,  # [F] int8 per-row dequant scales
+    in_kernel_gather: bool | None = None,
+    fused_epilogue: bool | None = None,
+    reg_solve_algo: str | None = None,
 ) -> jax.Array:
     """One full sweep over all k/block_size coordinate blocks of a rectangle.
 
@@ -65,6 +105,16 @@ def _sweep_rect(
     don't enter the explicit objective).  Either way the block update is
     A[B,B]δ = −g[B], g = A·x − b, with the per-interaction scores s = fᵀx
     computed once and rank-b updated after every block.
+
+    The b×b subsystems route through the fused reg+solve dispatchers
+    (``regularized_solve{,_matrix}``): the shared regularizer block
+    (G[B,B]+λI, or λ·n·I diag) is applied INSIDE the lane-vectorized
+    elimination kernel where the pallas solver is active — the b×b blocks
+    sit far below the elimination's rank cap (LU 128 / GJ 64), which is
+    what makes iALS++ an even better fit for the fused epilogue than the
+    full-rank solves.  On the cholesky backend the dispatcher's split
+    add + solve is the bit-identical pre-port computation (f32 adds
+    commute), so the default CPU path is unchanged.
     """
     implicit = gram is not None
     if implicit == (count is not None):
@@ -75,7 +125,8 @@ def _sweep_rect(
     f32 = jnp.float32
     x = x.astype(f32)
     maskf = mask.astype(f32)
-    gathered = fixed[neighbor_idx].astype(f32) * maskf[..., None]
+    gathered = _sweep_gather(fixed, scale, neighbor_idx, maskf,
+                             in_kernel_gather)
     if implicit:
         conf_m1 = (alpha * rating).astype(f32) * maskf  # c−1 obs, 0 pad
         c_obs = conf_m1 + maskf  # c at observed, 0 at pad
@@ -101,11 +152,12 @@ def _sweep_rect(
                 + jnp.einsum("epb,ep->eb", f_b, w,
                              preferred_element_type=f32, precision="highest")
             )
-            a_bb = (
-                gram[cols, cols]
-                + lam * eye_b
-                + jnp.einsum("ep,epb,epc->ebc", conf_m1, f_b, f_b,
-                             preferred_element_type=f32, precision="highest")
+            a_obs = jnp.einsum("ep,epb,epc->ebc", conf_m1, f_b, f_b,
+                               preferred_element_type=f32,
+                               precision="highest")
+            delta = regularized_solve_matrix(
+                a_obs, -g_b, gram[cols, cols] + lam * eye_b, solver,
+                fused=fused_epilogue, algo=reg_solve_algo,
             )
         else:
             w = (s - rating.astype(f32)) * maskf  # residual at observed
@@ -114,12 +166,13 @@ def _sweep_rect(
                 + jnp.einsum("epb,ep->eb", f_b, w,
                              preferred_element_type=f32, precision="highest")
             )
-            a_bb = (
-                reg_n[:, None, None] * eye_b
-                + jnp.einsum("epb,epc->ebc", f_b, f_b,
-                             preferred_element_type=f32, precision="highest")
+            a_obs = jnp.einsum("epb,epc->ebc", f_b, f_b,
+                               preferred_element_type=f32,
+                               precision="highest")
+            delta = regularized_solve(
+                a_obs, -g_b, count, lam, solver,
+                fused=fused_epilogue, algo=reg_solve_algo,
             )
-        delta = dispatch_spd_solve(a_bb, -g_b, solver)
         x = x.at[:, cols].add(delta)
         s = s + jnp.einsum("epb,eb->ep", f_b, delta,
                            preferred_element_type=f32, precision="highest")
@@ -138,12 +191,21 @@ def als_pp_half_step(
     block_size: int = 32,
     sweeps: int = 1,
     solver: str = "cholesky",
+    in_kernel_gather: bool | None = None,
+    fused_epilogue: bool | None = None,
+    reg_solve_algo: str | None = None,
+    table_dtype: str | None = None,
 ) -> jax.Array:
     """Explicit ALS-WR half-iteration by subspace sweeps (padded layout)."""
+    from cfk_tpu.ops import quant
+
+    data, scale = quant.quantize_table(fixed, table_dtype)
     for _ in range(sweeps):
         x_prev = _sweep_rect(
-            fixed, x_prev, neighbor_idx, rating, mask, lam, 0.0, None,
-            block_size, solver, count=count,
+            data, x_prev, neighbor_idx, rating, mask, lam, 0.0, None,
+            block_size, solver, count=count, scale=scale,
+            in_kernel_gather=in_kernel_gather, fused_epilogue=fused_epilogue,
+            reg_solve_algo=reg_solve_algo,
         )
     return x_prev
 
@@ -190,14 +252,22 @@ def als_pp_half_step_bucketed(
     sweeps: int = 1,
     solver: str = "cholesky",
     overlap: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    fused_epilogue: bool | None = None,
+    reg_solve_algo: str | None = None,
+    table_dtype: str | None = None,
 ) -> jax.Array:
     """Explicit ALS-WR half-iteration by subspace sweeps over width buckets."""
+    from cfk_tpu.ops import quant
+
+    data, scale = quant.quantize_table(fixed, table_dtype)
 
     def sweep_piece(xb, ni, rt, mk, cnt):
         for _ in range(sweeps):
             xb = _sweep_rect(
-                fixed, xb, ni, rt, mk, lam, 0.0, None, block_size, solver,
-                count=cnt,
+                data, xb, ni, rt, mk, lam, 0.0, None, block_size, solver,
+                count=cnt, scale=scale, in_kernel_gather=in_kernel_gather,
+                fused_epilogue=fused_epilogue, reg_solve_algo=reg_solve_algo,
             )
         return xb
 
@@ -221,16 +291,26 @@ def ials_pp_half_step(
     block_size: int = 32,
     sweeps: int = 1,
     solver: str = "cholesky",
+    in_kernel_gather: bool | None = None,
+    fused_epilogue: bool | None = None,
+    reg_solve_algo: str | None = None,
+    table_dtype: str | None = None,
 ) -> jax.Array:
     """iALS++ half-iteration over the padded rectangle layout."""
+    from cfk_tpu.ops import quant
     from cfk_tpu.ops.solve import global_gram
 
+    data, scale = quant.quantize_table(fixed, table_dtype)
     if gram is None:
-        gram = global_gram(fixed)
+        # YᵀY over the SAME dequantized rows the sweep gathers — see
+        # quant.gather_operand_view.
+        gram = global_gram(quant.dequantize_table(data, scale))
     for _ in range(sweeps):
         x_prev = _sweep_rect(
-            fixed, x_prev, neighbor_idx, rating, mask, lam, alpha, gram,
-            block_size, solver,
+            data, x_prev, neighbor_idx, rating, mask, lam, alpha, gram,
+            block_size, solver, scale=scale,
+            in_kernel_gather=in_kernel_gather, fused_epilogue=fused_epilogue,
+            reg_solve_algo=reg_solve_algo,
         )
     return x_prev
 
@@ -249,23 +329,34 @@ def ials_pp_half_step_bucketed(
     sweeps: int = 1,
     solver: str = "cholesky",
     overlap: bool | None = None,
+    in_kernel_gather: bool | None = None,
+    fused_epilogue: bool | None = None,
+    reg_solve_algo: str | None = None,
+    table_dtype: str | None = None,
 ) -> jax.Array:
     """iALS++ half-iteration over width-bucketed InBlocks.
 
     Buckets partition the entities (each rated entity lives in exactly one
     bucket), so the sweep runs independently per bucket rectangle and
     scatters back; ``chunk_rows`` streams oversized buckets through HBM like
-    the plain bucketed half-step does.
+    the plain bucketed half-step does.  The per-width-class sweeps gather
+    by in-kernel row DMA and solve their b×b subsystems through the fused
+    reg+solve dispatchers (see ``_sweep_rect``); ``table_dtype`` quantizes
+    the HBM gather table (``ops.quant``).
     """
+    from cfk_tpu.ops import quant
     from cfk_tpu.ops.solve import global_gram
 
+    data, scale = quant.quantize_table(fixed, table_dtype)
     if gram is None:
-        gram = global_gram(fixed)
+        gram = global_gram(quant.dequantize_table(data, scale))
 
     def sweep_piece(xb, ni, rt, mk):
         for _ in range(sweeps):
             xb = _sweep_rect(
-                fixed, xb, ni, rt, mk, lam, alpha, gram, block_size, solver
+                data, xb, ni, rt, mk, lam, alpha, gram, block_size, solver,
+                scale=scale, in_kernel_gather=in_kernel_gather,
+                fused_epilogue=fused_epilogue, reg_solve_algo=reg_solve_algo,
             )
         return xb
 

@@ -346,10 +346,60 @@ def resolve_fused_chunk_lam(fused_epilogue, solver, k, num_segments,
         return None
 
 
+def quantize_tiled_operand(fixed_factors, blk, chunks, table_dtype):
+    """Quantize a tiled half-step's gather operand (``ops.quant``).
+
+    Returns (table, blk): the HBM-resident table the chunk bodies gather
+    from (f32 identity / bf16 cast / int8 codes) and the block dict with
+    the int8 per-row dequant scale FOLDED into the mode's per-entry weight
+    stream — the canonical order (``quant.fold_scale`` first, then the one
+    ``g = data[nb]·wt`` multiply) every gather path shares, which is what
+    keeps the XLA gather, the Mosaic DMA gather, and the emulation twins
+    bit-identical for any table dtype.  Mode specifics:
+
+    - stream: the tile-aligned ``weight`` channel (0/1 mask, or √aw·mask
+      for iALS) absorbs the scale; ``nb`` already indexes the table with
+      F as the zero row.
+    - dstream: the stream-aligned ``aweight_dense`` channel absorbs it —
+      synthesized as the bare scale stream for explicit ALS, which has no
+      weight channel of its own (dense padding indexes the zero row, whose
+      appended scale is 0).
+    - accum: slice-local indices are rebased to absolute table rows via
+      the chunk's clamped window base (the same map ``abs_idx`` applies on
+      the fused-gather route), so the fold indexes the true row's scale.
+    """
+    from cfk_tpu.ops import quant
+
+    td = quant.resolve_table_dtype(table_dtype)
+    if td == "float32":
+        return fixed_factors, blk
+    if td == "bfloat16":
+        return fixed_factors.astype(jnp.bfloat16), blk
+    data, scale = quant.quantize_table(fixed_factors, "int8")
+    blk = dict(blk)
+    mode = chunks[1]
+    nb = blk["neighbor_idx"]
+    if mode == "accum":
+        nc, cap, t, h, e_c = tuple(chunks[2:])
+        f_rows = fixed_factors.shape[0]
+        base = jnp.repeat(blk["chunk_base"].reshape(nc), cap)
+        abs_nb = jnp.where(nb < h, base + nb, f_rows)
+        blk["weight"] = quant.fold_scale(blk["weight"], scale, abs_nb)
+    elif mode == "dstream":
+        wt = blk.get("aweight_dense")
+        if wt is None:
+            wt = jnp.ones(nb.shape, jnp.float32)
+        blk["aweight_dense"] = quant.fold_scale(wt, scale, nb)
+    else:
+        blk["weight"] = quant.fold_scale(blk["weight"], scale, nb)
+    return data, blk
+
+
 def tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, *,
     solver="cholesky", implicit_reg=None, stage="full", overlap=None,
     fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
+    table_dtype=None,
 ):
     """Mode dispatch shared by the single-device and SPMD trainers.
 
@@ -364,9 +414,18 @@ def tiled_half_step(
     production path pays it), ``"gram"`` = gather + the fused Gram kernel
     with carry threading, ``"accum"`` (accum mode only) = everything but
     the final solve.  ``"full"`` (default) is the unchanged production path.
+
+    ``table_dtype`` quantizes the gather operand for this half-step
+    (``ops.quant``; the solved factors keep the storage dtype): bf16
+    halves the gather bytes, int8+per-row-scale quarters them, Gram/solve
+    accumulation stays float32 either way.  ``None``/"float32" is
+    bit-identical to the pre-quantization path.
     """
     mode = chunks[1]
     st = tuple(chunks[2:])
+    fixed_factors, blk = quantize_tiled_operand(
+        fixed_factors, blk, chunks, table_dtype
+    )
     if mode == "accum":
         return als_half_step_tiled_accum(
             fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
@@ -404,6 +463,7 @@ def ials_tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, alpha, *,
     gram=None, solver="cholesky", stage="full", overlap=None,
     fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
+    table_dtype=None,
 ):
     """Implicit-feedback (Hu et al. 2008) half-iteration on tiled blocks.
 
@@ -427,9 +487,17 @@ def ials_tiled_half_step(
     """
     k = fixed_factors.shape[-1]
     if gram is None:
+        from cfk_tpu.ops import quant
         from cfk_tpu.ops.solve import global_gram
 
-        gram = global_gram(fixed_factors)
+        # YᵀY must sum the SAME dequantized rows the Gram kernels gather
+        # (ops.quant.gather_operand_view), or the shared implicit_reg term
+        # and the per-entity observed Grams would disagree on what the
+        # fixed factors ARE — the quantized-table analog of the subspace
+        # score-stream consistency rule.
+        gram = global_gram(
+            quant.gather_operand_view(fixed_factors, table_dtype)
+        )
     reg = gram + lam * jnp.eye(k, dtype=jnp.float32)
     blk = dict(blk)
     if chunks[1] == "dstream" and ("rating_dense" not in blk
@@ -457,6 +525,7 @@ def ials_tiled_half_step(
             solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
             fused_epilogue=fused_epilogue,
             in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+            table_dtype=table_dtype,
         )
     # The ε-clamped √aw is re-masked by the original 0/1 weight channel:
     # at valid entries ×1.0 is exact, and at padding the XLA path's
@@ -470,6 +539,7 @@ def ials_tiled_half_step(
         solver=solver, implicit_reg=reg, stage=stage, overlap=overlap,
         fused_epilogue=fused_epilogue,
         in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+        table_dtype=table_dtype,
     )
 
 
@@ -532,6 +602,11 @@ def als_half_step_tiled(
     nc, cap, e_c, t = statics
     k = fixed_factors.shape[-1]
     nt = cap // t
+    # int8 tables (ops.quant) carry the per-row dequant scale folded into
+    # the weight channel, so the single premultiply that realizes the
+    # padding zero row is ALSO the dequantize — the unit-weight shortcut
+    # (which skips that multiply on the XLA route) must not fire.
+    unit = implicit_reg is None and fixed_factors.dtype != jnp.int8
     fused_lam = (
         resolve_fused_chunk_lam(
             fused_epilogue, solver, k, e_c + 1, backend, lam,
@@ -558,13 +633,13 @@ def als_half_step_tiled(
             if stage == "gather":
                 s, _ = _entity_gram_chunk(
                     fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
-                    backend, unit_weights=implicit_reg is None,
+                    backend, unit_weights=unit,
                     stage="gather",
                 )
                 return (acc + s, a0, b0), None
             a, b = _entity_gram_chunk(
                 fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-                unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+                unit_weights=unit, carry=(a0, b0, cin_c),
             )
             a1 = lax.dynamic_index_in_dim(a, lseg_c, 0, keepdims=False)
             b1 = lax.dynamic_index_in_dim(b, lseg_c, 0, keepdims=False)
@@ -611,13 +686,13 @@ def als_half_step_tiled(
                 fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, lseg_c,
                 _chunk_reg(cnt_c, implicit_reg),
                 "diag" if implicit_reg is None else "matrix", fused_lam,
-                unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+                unit_weights=unit, carry=(a0, b0, cin_c),
                 gather=gather, algo=reg_solve_algo,
             )
             return (a1, b1), x[:e_c]
         a, b = _entity_gram_chunk(
             fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-            unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+            unit_weights=unit, carry=(a0, b0, cin_c),
             gather=gather,
         )
         x = solve_chunk_rows(a, b, cnt_c)
@@ -673,14 +748,14 @@ def als_half_step_tiled(
                     fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
                     lseg_c, _chunk_reg(cnt_c, implicit_reg),
                     "diag" if implicit_reg is None else "matrix", fused_lam,
-                    unit_weights=implicit_reg is None,
+                    unit_weights=unit,
                     carry=(a0, b0, cin_c), pregathered=g_cur, gather=gather,
                     algo=reg_solve_algo,
                 )
                 return (a1, b1), x_rows[:e_c]
             a, b = _entity_gram_chunk(
                 fixed_factors, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-                unit_weights=implicit_reg is None, carry=(a0, b0, cin_c),
+                unit_weights=unit, carry=(a0, b0, cin_c),
                 pregathered=g_cur, gather=gather,
             )
             x_rows = solve_chunk_rows(a, b, cnt_c)
@@ -773,7 +848,10 @@ def als_half_step_tiled_dense(
         tile_meta.reshape(nc, ng + 4 * nt), last_seg.reshape(nc),
         carry_in.reshape(nc), chunk_count.reshape(nc, e_c),
     )
-    if implicit_reg is not None:
+    # The weighted stream channel exists whenever aweight_dense is staged —
+    # iALS (√aw), or explicit ALS on an int8 table (the synthesized dequant
+    # scale stream, quantize_tiled_operand) — not only under implicit_reg.
+    if aweight_dense is not None:
         chunks = chunks + (aweight_dense.reshape(nc, cap),)
 
     if stage != "full":
@@ -784,7 +862,7 @@ def als_half_step_tiled_dense(
             acc, a0, b0 = carry
             nb_c, rt_c, meta_c, lseg_c, cin_c, cnt_c = chunk[:6]
             g = fz[nb_c].astype(ct)
-            if implicit_reg is not None:  # sqrt-weighted single stream
+            if aweight_dense is not None:  # sqrt-weighted single stream
                 g = g * chunk[6].astype(ct)[:, None]
             if stage == "gather":
                 return (acc + jnp.sum(g.astype(jnp.float32)), a0, b0), None
@@ -812,7 +890,7 @@ def als_half_step_tiled_dense(
         # premultiply (the stream-aligned weight channel) in-register.
         a0, b0 = carry
         rt_c, meta_c, lseg_c, cin_c, cnt_c = x[:5]
-        wt_c = x[5] if implicit_reg is not None else None
+        wt_c = x[5] if aweight_dense is not None else None
         if gather != "fused" and wt_c is not None:
             g = g * wt_c.astype(ct)[:, None]  # sqrt-weighted single stream
         if fused_lam is not None:
@@ -992,6 +1070,10 @@ def als_half_step_tiled_accum(
     nc, cap, t, h, e_c = statics
     k = fixed_factors.shape[-1]
     nt = cap // t
+    # int8 tables: the dequant scale rides the (absolute-index-folded)
+    # weight channel, so the weighted multiply must run (see the stream
+    # body / quantize_tiled_operand).
+    unit = implicit_reg is None and fixed_factors.dtype != jnp.int8
     gather = resolve_gather_mode(
         in_kernel_gather, backend, stage, cap, nt, t, e_c + 1, k,
     )
@@ -1074,7 +1156,7 @@ def als_half_step_tiled_accum(
             nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
             s, _ = _entity_gram_chunk(
                 select_window(base_c), nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
-                backend, unit_weights=implicit_reg is None,
+                backend, unit_weights=unit,
                 zero_appended=True, stage="gather",
             )
             return acc + s, None
@@ -1087,7 +1169,7 @@ def als_half_step_tiled_accum(
             nb_c, rt_c, wt_c, ts_c, base_c, ent_c = chunk
             a, b = _entity_gram_chunk(
                 select_window(base_c), nb_c, wt_c, rt_c, ts_c, t, e_c + 1,
-                backend, unit_weights=implicit_reg is None,
+                backend, unit_weights=unit,
                 zero_appended=True,
             )
             # Sink a row the pallas kernel is GUARANTEED to have written:
@@ -1120,14 +1202,14 @@ def als_half_step_tiled_accum(
         if gather == "fused":
             a, b = _entity_gram_chunk(
                 fixed_factors, abs_idx(nb_c, base_c), wt_c, rt_c, ts_c, t,
-                e_c + 1, backend, unit_weights=implicit_reg is None,
+                e_c + 1, backend, unit_weights=unit,
                 gather=gather,
             )
         else:
             fixed_slice = select_window(base_c)
             a, b = _entity_gram_chunk(
                 fixed_slice, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-                unit_weights=implicit_reg is None, zero_appended=True,
+                unit_weights=unit, zero_appended=True,
             )
         return accumulate(carry, a, b, ent_c), None
 
@@ -1162,13 +1244,13 @@ def als_half_step_tiled_accum(
             if gather == "fused":
                 a, b = _entity_gram_chunk(
                     fixed_factors, buf, wt_c, rt_c, ts_c, t, e_c + 1,
-                    backend, unit_weights=implicit_reg is None,
+                    backend, unit_weights=unit,
                     gather=gather,
                 )
             else:
                 a, b = _entity_gram_chunk(
                     fixed_factors, None, wt_c, rt_c, ts_c, t, e_c + 1,
-                    backend, unit_weights=implicit_reg is None,
+                    backend, unit_weights=unit,
                     zero_appended=True, pregathered=buf,
                 )
             return accumulate(carry, a, b, ent_c), None

@@ -62,13 +62,22 @@ _to_varying = to_varying  # compat: pcast / pvary / identity by jax version
 
 
 def half_step_allgather(
-    fixed_local, nb, rt, mk, cnt, *, lam, solve_chunk=None, solver="cholesky"
+    fixed_local, nb, rt, mk, cnt, *, lam, solve_chunk=None, solver="cholesky",
+    table_dtype=None,
 ):
     """Per-shard half-iteration with all_gather'd fixed factors.
 
     Runs inside shard_map: all args are local shards (entity axis 0).
+    ``table_dtype="bfloat16"`` quantizes the exchange payload BEFORE the
+    all_gather (half the ICI bytes), which is also the gather-table cast
+    downstream — per-row quantization commutes with row sharding.
     """
-    fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+    from cfk_tpu.ops import quant
+
+    fixed_full = lax.all_gather(
+        quant.gather_operand_view(fixed_local, table_dtype),
+        AXIS, axis=0, tiled=True,
+    )
     return als_half_step(
         fixed_full, nb, rt, mk, cnt, lam, solve_chunk=solve_chunk, solver=solver
     )
@@ -111,13 +120,16 @@ def _ring_rotate(blk, perm, compute, *, overlap):
     ``bench.py --overlap-ab`` measures.  Returns (compute result, next
     block); both orders run identical ops on identical values, so factors
     are bit-equal either way (``tests/test_overlap.py``)."""
+    permute = lambda b: jax.tree.map(
+        lambda x: lax.ppermute(x, AXIS, perm), b
+    )  # blk may be a (data, scale) tuple — quantized tables rotate both
     if overlap:
-        nxt = lax.ppermute(blk, AXIS, perm)
+        nxt = permute(blk)
         out = compute(blk)
     else:
         out = compute(blk)
         out, blk = lax.optimization_barrier((out, blk))
-        nxt = lax.ppermute(blk, AXIS, perm)
+        nxt = permute(blk)
     return out, nxt
 
 
@@ -129,10 +141,19 @@ def _nonfinite_flag(x):
     )
 
 
+def _payload_nonfinite_flag(tbl):
+    """Ring-payload probe over the LAST leaf: the f32/bf16 factor block
+    itself, or the int8 pair's f32 per-row scales.  The int8 codes are
+    finite by construction, so probing them would miss every corruption;
+    ``quant.quantize_table`` propagates a corrupt row's NaN/Inf into its
+    scale, making the scales the one int8 leaf that can trip."""
+    return _nonfinite_flag(tbl[-1])
+
+
 def half_step_ring(
     fixed_local, nb, rt, mk, cnt, *, lam, num_shards, solve_chunk=None,
     solver="cholesky", overlap=None, probe=None, fused_epilogue=None,
-    health=False, reg_solve_algo=None,
+    health=False, reg_solve_algo=None, table_dtype=None,
 ):
     """Per-shard half-iteration accumulating Gram blocks around a ppermute ring.
 
@@ -157,11 +178,17 @@ def half_step_ring(
     waiting for it to surface in the solved factors.  Incompatible with
     the timing ``probe`` modes (which compute meaningless factors).
     """
+    from cfk_tpu.ops import quant
     from cfk_tpu.ops.pipeline import resolve_overlap
 
     if health and probe is not None:
         raise ValueError("health probing and timing probes are exclusive")
     overlap = resolve_overlap(overlap)
+    # Quantize the ROTATING payload once, before the ring: every ppermute
+    # then moves the bf16 block (half the ICI bytes) and every Gram
+    # consumes the same quantized rows — the padded layout's weight-free
+    # Gram admits bf16 only (config validation refuses int8 here).
+    fixed_local = quant.gather_operand_view(fixed_local, table_dtype)
     my = lax.axis_index(AXIS)
     e = nb.shape[0]
     k = fixed_local.shape[-1]
@@ -311,7 +338,8 @@ def wrap_step(mesh, config: ALSConfig, half_m, half_u, mspecs, uspecs,
     )
 
 
-def gathered_half(solve, *, with_gram=False, with_prev=False):
+def gathered_half(solve, *, with_gram=False, with_prev=False,
+                  table_dtype=None):
     """The all_gather exchange pattern every gathered layout shares.
 
     ``solve(fixed_full, blk, gram) -> factors`` gets the full fixed-side
@@ -322,16 +350,39 @@ def gathered_half(solve, *, with_gram=False, with_prev=False):
     start; the sweep is per-entity so prev stays shard-local, no extra
     collective).  Used by the explicit and implicit SPMD steps so the
     exchange is written exactly once.
+
+    ``table_dtype="bfloat16"`` casts the exchange payload BEFORE the
+    all_gather (half the ICI bytes; per-row quantization commutes with
+    row sharding, so the gathered table equals the single-device cast and
+    the downstream half-step's own cast is idempotent).  int8 payloads
+    are NOT pre-quantized here — the (codes, scales) pair would double
+    the collective count for a path whose bytes win is in the HBM
+    gathers; the downstream half-step quantizes the gathered table
+    instead.  The iALS gram is computed over the DEQUANTIZED local view
+    either way, so YᵀY matches what the kernels gather.
     """
+    from cfk_tpu.ops import quant
+
+    def _prep(fixed_local):
+        gram = None
+        if with_gram:
+            gram = lax.psum(
+                global_gram(
+                    quant.gather_operand_view(fixed_local, table_dtype)
+                ),
+                AXIS,
+            )
+        payload = fixed_local
+        if quant.resolve_table_dtype(table_dtype) == "bfloat16":
+            payload = payload.astype(jnp.bfloat16)
+        return lax.all_gather(payload, AXIS, axis=0, tiled=True), gram
 
     def half(fixed_local, blk):
-        gram = lax.psum(global_gram(fixed_local), AXIS) if with_gram else None
-        fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        fixed_full, gram = _prep(fixed_local)
         return solve(fixed_full, blk, gram)
 
     def half_prev(fixed_local, prev_local, blk):
-        gram = lax.psum(global_gram(fixed_local), AXIS) if with_gram else None
-        fixed_full = lax.all_gather(fixed_local, AXIS, axis=0, tiled=True)
+        fixed_full, gram = _prep(fixed_local)
         return solve(fixed_full, prev_local, blk, gram)
 
     return half_prev if with_prev else half
@@ -379,7 +430,7 @@ def half_step_tiled_ring(
     fixed_local, blk, chunks, local_entities, *, lam, num_shards,
     solver="cholesky", gram_backend=None, overlap=None, probe=None,
     fused_epilogue=None, health=False, in_kernel_gather=None,
-    reg_solve_algo=None,
+    reg_solve_algo=None, table_dtype=None,
 ):
     """Tiled-layout half-iteration over the ppermute ring (block-to-block
     join) — the reference's headline join strategy at the at-scale layout.
@@ -406,6 +457,7 @@ def half_step_tiled_ring(
     — the rotated factor block is the kernel's DMA source), which also
     retires the per-ring-step zero-row append of the whole block.
     """
+    from cfk_tpu.ops import quant
     from cfk_tpu.ops.pipeline import resolve_overlap
     from cfk_tpu.ops.tiled import (
         _entity_gram_chunk,
@@ -424,13 +476,24 @@ def half_step_tiled_ring(
     gather = resolve_gather_mode(
         in_kernel_gather, backend, "full", cap, nt, t, e_c + 1, k,
     )
+    # Quantize the ROTATING payload once, before the ring (ops.quant):
+    # every ppermute then moves the bf16 block — or the (int8 codes,
+    # f32 per-row scales) pair, a quarter of the bytes — and every Gram
+    # consumes the quantized rows.  The int8 scale travels WITH its block
+    # (indices are local to whichever block this shard currently holds),
+    # folded into the weight channel per chunk — the canonical order.
+    data, scale = quant.quantize_table(fixed_local, table_dtype)
+    tbl0 = (data,) if scale is None else (data, scale)
+    int8 = scale is not None
     my = lax.axis_index(AXIS)
     perm = [(i, (i + 1) % s) for i in range(s)]
     nb, rt, wt = blk["neighbor_idx"], blk["rating"], blk["weight"]
     ts, ent = blk["tile_seg"], blk["chunk_entity"]
     starts = blk["slice_starts"]  # [S+1]
 
-    def slice_grams(acc, factors, t_idx):
+    def slice_grams(acc, tbl, t_idx):
+        factors = tbl[0]
+        scale_blk = tbl[1] if int8 else None
         # One zero-row append per ring step, not per chunk (the chunk-scan
         # body would otherwise re-copy the whole block every chunk); the
         # in-kernel gather skips even that — the kernel DMAs from the raw
@@ -440,7 +503,9 @@ def half_step_tiled_ring(
         else:
             fz = jnp.concatenate([
                 factors,
-                _match_varying(jnp.zeros((1, k), factors.dtype), factors),
+                _match_varying(
+                    jnp.zeros((1, k), factors.dtype), factors
+                ),
             ])
 
         def chunk_body(i, acc):
@@ -450,9 +515,15 @@ def half_step_tiled_ring(
             wt_c = lax.dynamic_slice(wt, (i * cap,), (cap,))
             ts_c = lax.dynamic_slice(ts, (i * nt,), (nt,))
             ent_c = lax.dynamic_slice(ent, (i * e_c,), (e_c,))
+            # int8: fold this block's per-row dequant scale into the 0/1
+            # weight channel (nb is local to the rotated block; the
+            # block-local virtual zero row gets the appended 0 scale).
+            wt_c = quant.fold_scale(wt_c, scale_blk, nb_c)
             a, b = _entity_gram_chunk(
                 fz, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-                unit_weights=True,  # the ring is explicit-ALS only
+                # the ring is explicit-ALS only; int8 must premultiply
+                # (the fold above IS the dequantize)
+                unit_weights=not int8,
                 zero_appended=gather != "fused", gather=gather,
             )
             return (acc_a.at[ent_c].add(a[:e_c]), acc_b.at[ent_c].add(b[:e_c]))
@@ -460,42 +531,44 @@ def half_step_tiled_ring(
         return lax.fori_loop(starts[t_idx], starts[t_idx + 1], chunk_body, acc)
 
     if probe == "exchange":  # transfers only; factors are a timing sink
-        factors = lax.fori_loop(
+        tbl = lax.fori_loop(
             0, s - 1,
-            lambda r, f: lax.ppermute(f, AXIS, perm),
-            fixed_local,
+            lambda r, f: jax.tree.map(
+                lambda x: lax.ppermute(x, AXIS, perm), f
+            ),
+            tbl0,
         )
         return jnp.zeros((local_entities, k), jnp.float32) + jnp.sum(
-            factors
-        ).astype(jnp.float32)
+            tbl[0].astype(jnp.float32)
+        )
 
     def body(r, carry):
-        acc_a, acc_b, factors, bad = carry
+        acc_a, acc_b, tbl, bad = carry
         t_idx = (my - r) % s
         if health:
-            bad = bad | _nonfinite_flag(factors)
+            bad = bad | _payload_nonfinite_flag(tbl)
         if probe == "compute":  # chunk loops only: never rotate the block
-            acc_a, acc_b = slice_grams((acc_a, acc_b), factors, t_idx)
-            return acc_a, acc_b, factors, bad
-        (acc_a, acc_b), factors = _ring_rotate(
-            factors, perm,
+            acc_a, acc_b = slice_grams((acc_a, acc_b), tbl, t_idx)
+            return acc_a, acc_b, tbl, bad
+        (acc_a, acc_b), tbl = _ring_rotate(
+            tbl, perm,
             lambda cur: slice_grams((acc_a, acc_b), cur, t_idx),
             overlap=overlap,
         )
-        return acc_a, acc_b, factors, bad
+        return acc_a, acc_b, tbl, bad
 
     a0 = _to_varying(
         jnp.zeros((local_entities + 1, k, k), jnp.float32), AXIS
     )
     b0 = _to_varying(jnp.zeros((local_entities + 1, k), jnp.float32), AXIS)
     bad0 = _to_varying(jnp.zeros((), jnp.int32), AXIS)
-    acc_a, acc_b, factors, bad = lax.fori_loop(
-        0, s - 1, body, (a0, b0, fixed_local, bad0)
+    acc_a, acc_b, tbl, bad = lax.fori_loop(
+        0, s - 1, body, (a0, b0, tbl0, bad0)
     )
     if health:
-        bad = bad | _nonfinite_flag(factors)
+        bad = bad | _payload_nonfinite_flag(tbl)
     acc_a, acc_b = slice_grams(
-        (acc_a, acc_b), factors, (my - (s - 1)) % s
+        (acc_a, acc_b), tbl, (my - (s - 1)) % s
     )
     # Like accum mode, the ring's accumulator lives across steps in HBM;
     # the fused knob gates the final fused reg+solve vs the split
@@ -653,7 +726,11 @@ def make_training_step(
         )
 
         alg = dict(block_size=config.block_size, sweeps=config.sweeps,
-                   solver=config.solver)
+                   solver=config.solver,
+                   in_kernel_gather=config.in_kernel_gather,
+                   fused_epilogue=config.fused_epilogue,
+                   reg_solve_algo=config.reg_solve_algo,
+                   table_dtype=config.table_dtype)
 
         if m_chunks is not None:  # bucketed layout
 
@@ -669,9 +746,13 @@ def make_training_step(
             return wrap_step(
                 mesh, config,
                 flagged(gathered_half(pp_bkt(m_chunks, m_local),
-                                      with_prev=True), prev=True),
+                                      with_prev=True,
+                                      table_dtype=config.table_dtype),
+                        prev=True),
                 flagged(gathered_half(pp_bkt(u_chunks, u_local),
-                                      with_prev=True), prev=True),
+                                      with_prev=True,
+                                      table_dtype=config.table_dtype),
+                        prev=True),
                 mspecs, uspecs, carry_prev=True, ring_flags=health_probe,
             )
 
@@ -681,7 +762,9 @@ def make_training_step(
                 blk["mask"], blk["count"], config.lam, **alg,
             )
 
-        half = flagged(gathered_half(pp_padded, with_prev=True), prev=True)
+        half = flagged(gathered_half(pp_padded, with_prev=True,
+                                     table_dtype=config.table_dtype),
+                       prev=True)
         return wrap_step(mesh, config, half, half, mspecs, uspecs,
                          carry_prev=True, ring_flags=health_probe)
 
@@ -700,6 +783,7 @@ def make_training_step(
                     health=health_probe,
                     in_kernel_gather=config.in_kernel_gather,
                     reg_solve_algo=config.reg_solve_algo,
+                    table_dtype=config.table_dtype,
                 )
 
             return half
@@ -712,9 +796,11 @@ def make_training_step(
                     fused_epilogue=config.fused_epilogue,
                     in_kernel_gather=config.in_kernel_gather,
                     reg_solve_algo=config.reg_solve_algo,
+                    table_dtype=config.table_dtype,
                 )
 
-            return flagged(gathered_half(solve))
+            return flagged(gathered_half(
+                solve, table_dtype=config.table_dtype))
 
         # Each half picks its exchange from how its blocks were built —
         # exchange="auto" mixes them (ring movie-half + all_gather
@@ -743,8 +829,10 @@ def make_training_step(
 
         return wrap_step(
             mesh, config,
-            flagged(gathered_half(seg_solve(m_chunks, m_local))),
-            flagged(gathered_half(seg_solve(u_chunks, u_local))),
+            flagged(gathered_half(seg_solve(m_chunks, m_local),
+                                  table_dtype=config.table_dtype)),
+            flagged(gathered_half(seg_solve(u_chunks, u_local),
+                                  table_dtype=config.table_dtype)),
             mspecs, uspecs, ring_flags=health_probe,
         )
 
@@ -756,14 +844,19 @@ def make_training_step(
                     fixed_full, blk, chunks, local, config.lam,
                     solver=config.solver, overlap=config.overlap,
                     reg_solve_algo=config.reg_solve_algo,
+                    fused_epilogue=config.fused_epilogue,
+                    in_kernel_gather=config.in_kernel_gather,
+                    table_dtype=config.table_dtype,
                 )
 
             return solve
 
         return wrap_step(
             mesh, config,
-            flagged(gathered_half(bkt_solve(m_chunks, m_local))),
-            flagged(gathered_half(bkt_solve(u_chunks, u_local))),
+            flagged(gathered_half(bkt_solve(m_chunks, m_local),
+                                  table_dtype=config.table_dtype)),
+            flagged(gathered_half(bkt_solve(u_chunks, u_local),
+                                  table_dtype=config.table_dtype)),
             mspecs, uspecs, ring_flags=health_probe,
         )
 
@@ -772,6 +865,7 @@ def make_training_step(
             half_step_allgather,
             lam=config.lam,
             solver=config.solver,
+            table_dtype=config.table_dtype,
         )
     else:
         half_rect = functools.partial(
@@ -784,6 +878,7 @@ def make_training_step(
             fused_epilogue=config.fused_epilogue,
             health=health_probe,
             reg_solve_algo=config.reg_solve_algo,
+            table_dtype=config.table_dtype,
         )
 
     # Factors are exchanged/stored in config.dtype (bfloat16 halves ICI bytes

@@ -47,6 +47,7 @@ class IterationCost:
     model_flops: float
     min_hbm_bytes: float
     gather_rows: float  # factor rows fetched by index per iteration
+    gather_bytes: float = 0.0  # bytes those row fetches move (table dtype)
 
     def achieved_tflops(self, seconds: float) -> float:
         return self.model_flops / seconds / 1e12
@@ -59,26 +60,40 @@ class IterationCost:
         return self.min_hbm_bytes / bandwidth
 
     def gather_bound_s(
-        self, rows_per_s: float = V5E_GATHER_ROWS_PER_S
+        self, rows_per_s: float = V5E_GATHER_ROWS_PER_S,
+        bandwidth: float = V5E_HBM_BYTES_PER_S,
     ) -> float:
-        """Gather-engine floor: the binding resource for ALS on this chip.
+        """Gather floor: the binding resource for ALS on this chip.
 
         Every rating needs its neighbor's factor row on each side every
-        iteration, and the measured engine rate is per ROW, independent of
-        row bytes — so 2·nnz rows / rate bounds the iteration from below
-        more tightly than HBM bandwidth does (6.7× at full Netflix)."""
-        return self.gather_rows / rows_per_s
+        iteration.  Two sub-floors, the floor is their max:
+
+        - row-slot: the measured engine rate is per ROW, independent of
+          row bytes (XLA's gather engine; the in-kernel DMA gather issues
+          one descriptor per row, so rows/s bounds it the same way), and
+        - bytes: the rows must still physically cross HBM —
+          ``gather_bytes / bandwidth``.  This is the sub-floor the table
+          dtype moves (bf16 halves it, int8+scale quarters it); the
+          row-slot sub-floor is dtype-independent, which is exactly why
+          ``vs_gather_roofline`` must model both or quantized runs would
+          be compared against a floor they can no longer touch.
+        """
+        return max(self.gather_rows / rows_per_s,
+                   self.gather_bytes / bandwidth)
 
 
 FULL_NETFLIX_NNZ = 100_480_507
 
 
-def roofline_row(cost: IterationCost, s_per_iter: float) -> dict:
+def roofline_row(cost: IterationCost, s_per_iter: float,
+                 table_dtype: str | None = None) -> dict:
     """The efficiency fields every recorded benchmark row carries.
 
     One definition so bench.py's rows and scripts/perf_lab.py can never
-    drift on which metrics exist or how they're computed."""
-    return {
+    drift on which metrics exist or how they're computed.  ``table_dtype``
+    records the gather-table quantization the run used (None → float32
+    pre-quantization semantics are NOT implied — pass what the run ran)."""
+    row = {
         "model_tflops_per_iter": round(cost.model_flops / 1e12, 4),
         "achieved_tflops": round(cost.achieved_tflops(s_per_iter), 4),
         "mfu": round(cost.mfu(s_per_iter), 5),
@@ -87,7 +102,38 @@ def roofline_row(cost: IterationCost, s_per_iter: float) -> dict:
         "vs_hbm_roofline": round(s_per_iter / cost.hbm_bound_s(), 2),
         "gather_roofline_s": round(cost.gather_bound_s(), 4),
         "vs_gather_roofline": round(s_per_iter / cost.gather_bound_s(), 2),
+        "gather_gb_per_iter": round(cost.gather_bytes / 1e9, 3),
     }
+    if table_dtype is not None:
+        row["table_dtype"] = table_dtype
+    return row
+
+
+def table_gather_bytes_per_row(rank: int, table_dtype: str | None,
+                               factor_bytes: int = 4) -> float:
+    """Bytes one gathered factor row moves under the given table dtype —
+    k cells at the table itemsize, plus the int8 scheme's one f32 scale
+    per row (``ops.quant``).  ``table_dtype="float32"`` is the quant
+    IDENTITY — the table stays at the storage dtype — so the effective
+    cell size is min(table, storage): a bf16-stored f32-table run still
+    gathers 2-byte cells."""
+    from cfk_tpu.ops.quant import resolve_table_dtype, table_itemsize
+
+    per_row = rank * min(table_itemsize(table_dtype), factor_bytes)
+    if resolve_table_dtype(table_dtype) == "int8":
+        per_row += 4  # the per-row f32 dequant scale rides along
+    return float(per_row)
+
+
+def bucketed_gather_rows(movie_blocks, user_blocks) -> float:
+    """Honest gather-row count for the bucketed layout: every PADDED cell
+    of every width class fetches a row (padding slots gather the clamped /
+    zero row like any other — the engine charges the slot), so the floor
+    is Σ rows·width per class per side, not 2·nnz.  BENCH_r05's bucketed
+    rows were computed at 2·nnz, which understated the floor by the
+    padding ratio (~1.3–2× on power-law data) — part of why
+    ``ialspp_ml25m`` read as 9.94× its roofline."""
+    return float(movie_blocks.padded_cells + user_blocks.padded_cells)
 
 
 def als_iteration_cost(
@@ -98,6 +144,9 @@ def als_iteration_cost(
     *,
     factor_bytes: int = 2,  # bf16 storage
     implicit: bool = False,
+    table_dtype: str | None = None,  # gather-table quantization (ops.quant)
+    gather_rows: float | None = None,  # layout-aware row count override
+    sweeps: int = 1,  # subspace sweeps per half-iteration (iALS++/ALS++)
 ) -> IterationCost:
     """Model FLOPs + minimum HBM bytes for one full ALS(-WR / iALS) iteration.
 
@@ -110,7 +159,12 @@ def als_iteration_cost(
       - iALS adds the global Gram YᵀY: 2 · (U+M) · k² per iteration.
 
     Bytes (minimum):
-      - neighbor-factor gathers: nnz · k · factor_bytes per side,
+      - neighbor-factor gathers: gather_rows · bytes/row — the table dtype
+        sets the bytes (``table_gather_bytes_per_row``; bf16 halves the
+        f32 rows, int8+scale quarters them), and ``gather_rows`` defaults
+        to 2·nnz (one row per rating per side) with layout-aware
+        overrides (``bucketed_gather_rows`` counts padded cells per width
+        class; ``sweeps`` > 1 multiplies — each subspace sweep re-gathers),
       - block arrays read once: neighbor idx (4 B) + rating (4 B) per rating
         per side (the mask is derivable and the segment metadata is O(E)),
       - Gram/RHS intermediates cross the matmul→solve op boundary:
@@ -125,12 +179,20 @@ def als_iteration_cost(
     if implicit:
         flops += 2.0 * entities * k * k  # global YᵀY
 
-    gather = 2.0 * nnz * k * factor_bytes
+    if gather_rows is None:
+        gather_rows = 2.0 * nnz
+    gather_rows = gather_rows * max(sweeps, 1)
+    if table_dtype is None:
+        row_bytes = float(k * factor_bytes)
+    else:
+        row_bytes = table_gather_bytes_per_row(k, table_dtype, factor_bytes)
+    gather = gather_rows * row_bytes
     blocks = 2.0 * nnz * 8
     gram_io = entities * (k * k + k) * 4.0 * 2
     factors_out = entities * k * factor_bytes
     return IterationCost(
         model_flops=flops,
         min_hbm_bytes=gather + blocks + gram_io + factors_out,
-        gather_rows=2.0 * nnz,
+        gather_rows=gather_rows,
+        gather_bytes=gather,
     )

@@ -594,6 +594,47 @@ def scenario_stream_poison_batch() -> dict:
     }
 
 
+
+def scenario_quantized_table() -> dict:
+    """ISSUE 7: the recovery ladder's split-epilogue and GJ rungs must
+    work with a QUANTIZED gather table (table_dtype=bfloat16, the tiled
+    pallas stack).  Four one-shot NaN corruptions on consecutive
+    iterations force the ladder through every rung — retry, λ bump,
+    split epilogue, GJ elimination — so the run finishes with the split
+    schedule AND the GJ kernels pinned while every half-step gathers from
+    the bf16 table; recovered RMSE parity proves those rungs solve
+    correctly under quantization."""
+    import dataclasses as _dc
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.resilience.faults import FactorCorruption, FaultInjector
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(60, 30, 900, seed=0), layout="tiled",
+        chunk_elems=512, tile_rows=16,
+    )
+    # lam_escalation=1.5 keeps the two λ bumps the full ladder applies
+    # (rungs 2 and 4) inside the RMSE-parity budget — the scenario proves
+    # the RUNGS execute under quantization, not λ×100 robustness.
+    cfg = _dc.replace(
+        _base_cfg(), layout="tiled", solver="pallas",
+        table_dtype="bfloat16", max_recoveries=5, lam_escalation=1.5,
+    )
+    base_rmse = _rmse(_train(ds, cfg), ds)
+    inj = FaultInjector(*[
+        FactorCorruption(iteration=i, side="u") for i in (1, 2, 3, 4)
+    ])
+    metrics = Metrics()
+    rec = _train(ds, cfg, metrics=metrics, fault_injector=inj)
+    # level 4 = the GJ rung was reached (3 = split epilogue); both must
+    # have executed for this scenario to prove anything
+    return _row("quantized_table", fired=inj.fired, metrics=metrics,
+                base_rmse=base_rmse, rec_rmse=_rmse(rec, ds),
+                ok_extra=metrics.gauges.get("escalation_level", 0) >= 4)
+
+
 SCENARIOS = {
     "nan": scenario_nan,
     "inf": scenario_inf,
@@ -606,6 +647,7 @@ SCENARIOS = {
     "stream_duplicates": scenario_stream_duplicates,
     "stream_crash_replay": scenario_stream_crash_replay,
     "stream_poison_batch": scenario_stream_poison_batch,
+    "quantized_table": scenario_quantized_table,
 }
 
 

@@ -96,6 +96,14 @@ def make_parser() -> argparse.ArgumentParser:
                    help="pallas tiled-gram group size override")
     p.add_argument("--reg-solve-algo", default=None, choices=[None, "gj", "lu"],
                    help="fused reg+solve elimination algorithm override")
+    p.add_argument("--table-dtype", default="float32",
+                   choices=["float32", "bfloat16", "int8"],
+                   help="HBM gather-table dtype axis (cfk_tpu.ops.quant): "
+                   "quantize the fixed-side table the half-steps gather "
+                   "from — bf16 halves the gather bytes, int8+per-row-"
+                   "scale quarters them; accumulation stays f32 and the "
+                   "solved factors keep --dtype.  float32 = the identity "
+                   "(bit-identical to pre-quantization)")
     p.add_argument("--ials", action="store_true",
                    help="time the implicit-feedback (iALS) iteration body")
     p.add_argument("--alpha", type=float, default=40.0)
@@ -122,7 +130,9 @@ def make_parser() -> argparse.ArgumentParser:
                    "the indexed factor rows themselves — no materialized "
                    "[C, k] stream), 'xla' = the XLA gather that "
                    "materializes the stream in HBM.  Factors are "
-                   "bit-identical across the axis")
+                   "bit-identical across the axis.  Covers the tiled "
+                   "chunk bodies AND the bucketed/subspace ports (same "
+                   "process default, ops.tiled.default_in_kernel_gather)")
     p.add_argument("--overlap", default="on", choices=["on", "off"],
                    help="comm/compute overlap A/B axis: 'on' (default) = "
                    "double-buffered chunk/ring pipelines "
@@ -297,6 +307,14 @@ def run_lab(args) -> dict:
         )
 
 
+    from cfk_tpu.ops import quant
+
+    # Same refusal ALSConfig enforces: int8 on padded/segment would
+    # dequantize the whole table up front while the roofline row still
+    # charged 1-byte cells — the dishonest-floor artifact this axis
+    # exists to measure away.
+    quant.validate_table_dtype_layout(args.table_dtype, args.layout)
+
     segment = args.layout == "segment"
     bucketed = args.layout == "bucketed"
     t0 = time.time()
@@ -343,12 +361,13 @@ def run_lab(args) -> dict:
                 u, m_prev, mblk, ublk,
                 lam=0.05, alpha=args.alpha, dt=jax.numpy.dtype(dt),
                 solver=args.solver, algorithm="als", block_size=32,
-                sweeps=1, **layout_kw,
+                sweeps=1, table_dtype=args.table_dtype, **layout_kw,
             )
         return als_mod._iteration_body(
             u, mblk, ublk,
             lam=0.05, solve_chunk=None, dt=jax.numpy.dtype(dt),
-            solver=args.solver, m_prev=m_prev, **layout_kw,
+            solver=args.solver, m_prev=m_prev,
+            table_dtype=args.table_dtype, **layout_kw,
         )
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -445,9 +464,17 @@ def run_lab(args) -> dict:
         on_call=profile_hook,
     )
     per_iter = [t / args.iters for t in times]
+    gather_rows = None
+    if bucketed:
+        # Honest bucketed floor: every padded cell of every width class
+        # fetches a row (roofline.bucketed_gather_rows).
+        from cfk_tpu.utils.roofline import bucketed_gather_rows
+
+        gather_rows = bucketed_gather_rows(ds.movie_blocks, ds.user_blocks)
     cost = als_iteration_cost(
         args.nnz, args.users, args.movies, args.rank,
         factor_bytes=2 if dt == "bfloat16" else 4,
+        table_dtype=args.table_dtype, gather_rows=gather_rows,
     )
     best = min(per_iter)
     from cfk_tpu.utils.roofline import roofline_row
@@ -455,7 +482,7 @@ def run_lab(args) -> dict:
     row = {
         "s_per_iter_min": round(best, 4),
         "s_per_iter_median": round(sorted(per_iter)[len(per_iter) // 2], 4),
-        **roofline_row(cost, best),
+        **roofline_row(cost, best, table_dtype=args.table_dtype),
         "layout": args.layout, "solver": args.solver,
         "chunk_elems": args.chunk_elems, "dtype": dt,
         "gram_backend": args.gram_backend, "rank": args.rank,

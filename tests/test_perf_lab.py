@@ -24,7 +24,8 @@ def _args(**over):
         solver="cholesky", dtype="float32", gram_backend=None,
         tiled_gram_backend=None, group_tiles=None, reg_solve_algo=None,
         ials=False, alpha=40.0, accum_chunk_elems=None, dense_stream=False,
-        overlap="on", fused="on", gather="fused", health="off",
+        overlap="on", fused="on", gather="fused", table_dtype="float32",
+        health="off",
         health_norm_limit=1e6, ckpt=None,
         foldin="off", foldin_updates=4096, foldin_batch_records=256,
         iters=2, repeats=3, profile_dir=None,
