@@ -57,7 +57,8 @@ def _compact_row(row: dict) -> dict:
             "s_per_iteration_median", "rmse_best_seed", "layout",
             "exchange_s_per_iter", "compute_s_per_iter",
             "factors_bit_exact", "removed_bytes_per_chunk",
-            "save_stall_removed_s_per_save", "foldin_rmse_over_retrain")
+            "save_stall_removed_s_per_save", "foldin_rmse_over_retrain",
+            "p50_ms", "p99_ms", "vs_roofline", "best_batch")
     return {k: row[k] for k in keep if k in row}
 
 
@@ -154,6 +155,15 @@ def main() -> None:
             fi = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# foldin: " + json.dumps(fi))
         rows["foldin"] = fi
+    # Top-K serving QPS/p50/p99 at ML-25M scale (ISSUE 8).
+    # CFK_BENCH_SERVE=0 skips it.
+    if os.environ.get("CFK_BENCH_SERVE", "1") != "0":
+        try:
+            sv = _serve_row()
+        except Exception as e:  # pragma: no cover - subprocess-dependent
+            sv = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# serve: " + json.dumps(sv))
+        rows["serve"] = sv
     # Quantized-gather-table A/B: RMSE per table dtype on the planted
     # split + the analytic bytes removed.  CFK_BENCH_QUANT=0 skips it.
     if os.environ.get("CFK_BENCH_QUANT", "1") != "0":
@@ -1675,6 +1685,173 @@ def run_foldin(args) -> dict:
     }
 
 
+def serve_main(args) -> None:
+    print(json.dumps(run_serve(args)))
+
+
+def _serve_row() -> dict:
+    """Default-run top-K serving row (subprocess: the shard sweep needs
+    the virtual-mesh flag before jax init, like the other A/B rows)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, __file__, "--serve"],
+        capture_output=True, text=True, timeout=3600,
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip()[-300:]
+        return {"error": f"serve subprocess failed: {tail}"}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh):
+    """Engine + synthetic serving state at the requested shape.
+
+    Factors are random — serving cost is independent of factor VALUES
+    (the same rationale as perf_lab's fold-in base model); the seen-CSR
+    is built only for the loadgen's user pool (the rows traffic will
+    touch), at the ML-25M mean ratings/user, so exclusion masking is
+    exercised at realistic widths without materializing 25M seen cells.
+    """
+    import numpy as np
+
+    from cfk_tpu.serving.engine import ServeEngine
+
+    k = args.serve_rank
+    u = (rng.standard_normal((args.serve_users, k), dtype=np.float32)
+         * 0.1)
+    m = (rng.standard_normal((args.serve_movies, k), dtype=np.float32)
+         * 0.1)
+    mean_seen = max(1, args.serve_nnz // args.serve_users)
+    pool = np.unique(jnp_users)
+    counts = np.zeros(args.serve_users, np.int64)
+    counts[pool] = rng.poisson(mean_seen, pool.shape[0]).clip(1)
+    indptr = np.zeros(args.serve_users + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    seen = np.empty(indptr[-1], np.int32)
+    for row in pool:
+        lo, hi = indptr[row], indptr[row + 1]
+        seen[lo:hi] = np.sort(rng.choice(
+            args.serve_movies, size=hi - lo, replace=False
+        )).astype(np.int32)
+    return ServeEngine(
+        u, m, num_users=args.serve_users, num_movies=args.serve_movies,
+        seen_movies=seen, seen_indptr=indptr, table_dtype=table_dtype,
+        tile_m=args.serve_tile_m, mesh=mesh,
+    )
+
+
+def run_serve(args) -> dict:
+    """Top-K serving at ML-25M scale (ISSUE 8 / ROADMAP item 1): QPS and
+    p50/p99 latency across batch size, table dtype, and shard count.
+
+    Each row: (1) the engine's steady-state batch time at that config
+    (direct ``topk`` calls, min over repeats — the ``vs_roofline``
+    denominator comes from ``serve_batch_cost``'s table-scan floor), and
+    (2) an open-loop run through the full request path (InMemory log →
+    ``RecommendServer`` batch coalescing → engine → response log) at 70%
+    of the measured capacity, reporting achieved QPS and p50/p99 — the
+    repo's first latency-axis bench rows.  Multi-shard rows run the
+    item-sharded path on a virtual CPU mesh (equality with single-shard
+    is pinned by tier-1 tests; rows here measure the merge overhead).
+    """
+    import numpy as np
+
+    shard_list = [int(s) for s in args.serve_shards.split(",") if s]
+    jx = _virtual_cpu_mesh(max(max(shard_list), 1))
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        ensure_serve_topics,
+        run_open_loop,
+        warm_serve_programs,
+        zipf_user_rows,
+    )
+    from cfk_tpu.transport import InMemoryBroker
+    from cfk_tpu.utils.roofline import serve_batch_cost, serve_roofline_row
+
+    rng = np.random.default_rng(args.seed)
+    # ONE user pool feeds the seen-CSR build, the warm-up/calibration
+    # batches, AND the open-loop traffic — traffic rows outside the CSR
+    # pool would score with empty exclusion masks and flatter the row.
+    traffic = zipf_user_rows(
+        args.serve_users, args.serve_requests, seed=args.seed + 3
+    )
+    pool = np.concatenate([
+        zipf_user_rows(args.serve_users, 4096, seed=args.seed + 1),
+        traffic,
+    ])
+    batch_list = [int(b) for b in args.serve_batches.split(",") if b]
+    dtype_list = [d for d in args.serve_dtypes.split(",") if d]
+    sweeps = [(b, "float32", 1) for b in batch_list]
+    sweeps += [(batch_list[-1], d, 1) for d in dtype_list
+               if d != "float32"]
+    sweeps += [(batch_list[-1], "float32", s) for s in shard_list if s > 1]
+    rows = []
+    engines: dict = {}
+    for batch, td, shards in sweeps:
+        key = (td, shards)
+        if key not in engines:
+            mesh = make_mesh(shards) if shards > 1 else None
+            engines[key] = _serve_engine(
+                args, pool, np.random.default_rng(args.seed + 2),
+                table_dtype=td, shards=shards, mesh=mesh,
+            )
+        eng = engines[key]
+        qrows = pool[:batch]
+        eng.topk(qrows, args.serve_k)  # warmup / compile
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.time()
+            eng.topk(qrows, args.serve_k)
+            times.append(time.time() - t0)
+        batch_s = min(times)
+        capacity = batch / batch_s
+        broker = InMemoryBroker()
+        ensure_serve_topics(broker)
+        server = RecommendServer(eng, broker, max_batch=batch)
+        client = ServeClient(broker)
+        warm_serve_programs(client, server, pool, args.serve_k, batch)
+        rate = max(capacity * 0.7, 1.0)
+        report = run_open_loop(
+            client, rate_qps=rate, num_requests=args.serve_requests,
+            user_rows=traffic,
+            k=args.serve_k, server=server, drive_server=True,
+        )
+        cost = serve_batch_cost(
+            args.serve_movies, args.serve_rank, batch, args.serve_k,
+            table_dtype=td, m_pad=eng.table_rows,
+        )
+        row = {
+            "batch": batch,
+            "table_dtype": td,
+            "shards": shards,
+            "k": args.serve_k,
+            "batch_s": round(batch_s, 5),
+            "capacity_qps": round(capacity, 1),
+            **report.as_row(),
+            **serve_roofline_row(cost, batch_s, table_dtype=td),
+            "users": args.serve_users, "movies": args.serve_movies,
+            "rank": args.serve_rank, "tile_m": args.serve_tile_m,
+            "backend": jx.default_backend(),
+        }
+        print("# serve: " + json.dumps(row), flush=True)
+        rows.append(row)
+    best = max(rows, key=lambda r: r["qps"])
+    return {
+        "metric": "serve_topk_ml25m",
+        "unit": "qps",
+        "value": best["qps"],
+        "p50_ms": best["p50_ms"],
+        "p99_ms": best["p99_ms"],
+        "best_batch": best["batch"],
+        "vs_roofline": best["vs_roofline"],
+        "rows": rows,
+    }
+
+
 def compare_exchange_main(args) -> None:
     """The reference's headline experiment (its README.md:216-224): the
     block-to-block join (ring) vs the all-to-all join (all_gather), same
@@ -1909,9 +2086,36 @@ if __name__ == "__main__":
                         "(ML-25M proportions scaled down)")
     parser.add_argument("--quant-rank", type=int, default=16)
     parser.add_argument("--quant-chunk-elems", type=int, default=16_384)
+    parser.add_argument("--serve", action="store_true",
+                        help="top-K serving bench (ISSUE 8): QPS + p50/p99 "
+                        "at ML-25M scale through the full request path "
+                        "(log → batch coalescing → score+top-K kernel → "
+                        "response log), swept over batch size, table "
+                        "dtype, and shard count, each row with its "
+                        "table-scan vs_roofline")
+    parser.add_argument("--serve-users", type=int, default=162_541)
+    parser.add_argument("--serve-movies", type=int, default=59_047)
+    parser.add_argument("--serve-nnz", type=int, default=25_000_095,
+                        help="implied ratings count — sets the synthetic "
+                        "seen-list widths (ML-25M mean ~154/user)")
+    parser.add_argument("--serve-rank", type=int, default=128)
+    parser.add_argument("--serve-k", type=int, default=100)
+    parser.add_argument("--serve-tile-m", type=int, default=2048)
+    parser.add_argument("--serve-batches", default="16,64,256",
+                        help="comma list of coalesced batch sizes to sweep")
+    parser.add_argument("--serve-dtypes", default="float32,bfloat16,int8",
+                        help="comma list of table dtypes to sweep (at the "
+                        "largest batch)")
+    parser.add_argument("--serve-shards", default="1,4",
+                        help="comma list of item-axis shard counts (>1 "
+                        "rows run the sharded merge on a virtual mesh)")
+    parser.add_argument("--serve-requests", type=int, default=256,
+                        help="open-loop requests per row")
     cli_args = parser.parse_args()
     run = (
-        (lambda: quant_ab_main(cli_args))
+        (lambda: serve_main(cli_args))
+        if cli_args.serve
+        else (lambda: quant_ab_main(cli_args))
         if cli_args.quant_ab
         else (lambda: quality_bytes_main(cli_args))
         if cli_args.quality_bytes

@@ -754,6 +754,107 @@ def _recommend(args) -> int:
     return 0
 
 
+def _serve(args) -> int:
+    """Top-K request server over the transport log (ISSUE 8).
+
+    Restores factors from the checkpoint store, builds the serving engine
+    (quantized table per --table-dtype, exclude-seen from --data's rating
+    lists), and serves score requests:
+
+    - with --broker tcp://HOST:PORT, joins the native broker's
+      serve-requests/serve-responses topics and answers until killed —
+      the cross-process deployment form;
+    - without --broker, runs the built-in open-loop load generator
+      against an in-memory log (--loadgen-qps/--loadgen-requests) and
+      prints the measured QPS/p50/p99 row — the self-contained smoke
+      (the recorded-at-scale numbers live in ``bench.py --serve``).
+    """
+    import numpy as np
+
+    from cfk_tpu.data.blocks import RatingsIndex
+    from cfk_tpu.data.movielens import parse_movielens_csv
+    from cfk_tpu.data.netflix import parse_netflix
+    from cfk_tpu.models.als import ALSModel
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        engine_from_model,
+        ensure_serve_topics,
+        run_open_loop,
+        warm_serve_programs,
+        zipf_user_rows,
+    )
+
+    if args.format == "netflix":
+        coo = parse_netflix(args.data)
+    else:
+        coo = parse_movielens_csv(args.data, min_rating=args.min_rating)
+    ds = RatingsIndex.from_coo(coo)
+    state = _serving_state(args)
+    if state is None:
+        return 2
+    model = ALSModel(
+        user_factors=state.user_factors,
+        movie_factors=state.movie_factors,
+        num_users=ds.user_map.num_entities,
+        num_movies=ds.movie_map.num_entities,
+    )
+    engine = engine_from_model(
+        model, None if args.include_seen else ds,
+        table_dtype=args.table_dtype, tile_m=args.tile_m,
+    )
+    if args.broker:
+        host, port, _ = _parse_tcp_url(args.broker, topic_optional=True)
+        from cfk_tpu.transport.tcp import TcpBrokerClient
+
+        transport = TcpBrokerClient(host, port)
+        ensure_serve_topics(
+            transport, request_partitions=args.request_partitions,
+            response_partitions=args.response_partitions,
+        )
+        server = RecommendServer(engine, transport,
+                                 max_batch=args.max_batch)
+        _eprint(
+            f"serving {ds.user_map.num_entities} users × "
+            f"{ds.movie_map.num_entities} movies (rank "
+            f"{state.user_factors.shape[-1]}, table {engine.table_dtype}) "
+            f"from broker {host}:{port}; ^C to stop"
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:  # pragma: no cover - interactive
+            pass
+        _eprint(f"served {server.requests_served} requests "
+                f"in {server.batches} batches")
+        return 0
+    from cfk_tpu.transport import InMemoryBroker
+
+    transport = InMemoryBroker()
+    ensure_serve_topics(transport)
+    server = RecommendServer(engine, transport, max_batch=args.max_batch)
+    client = ServeClient(transport)
+    pool = zipf_user_rows(
+        ds.user_map.num_entities, args.loadgen_requests, seed=args.seed
+    )
+    warm_serve_programs(client, server, pool, args.k,
+                        min(args.max_batch, pool.shape[0]))
+    report = run_open_loop(
+        client, rate_qps=args.loadgen_qps,
+        num_requests=args.loadgen_requests, user_rows=pool, k=args.k,
+        server=server, drive_server=True,
+    )
+    import json
+
+    print(json.dumps({
+        "users": ds.user_map.num_entities,
+        "movies": ds.movie_map.num_entities,
+        "k": args.k,
+        "table_dtype": engine.table_dtype,
+        **report.as_row(),
+    }))
+    return 0
+
+
 def _broker(args) -> int:
     """Run the native broker server in the foreground."""
     import subprocess
@@ -1239,6 +1340,45 @@ def build_parser() -> argparse.ArgumentParser:
     rc.add_argument("--include-seen", action="store_true",
                     help="do not exclude already-rated movies")
     rc.set_defaults(fn=_recommend)
+
+    sv = sub.add_parser(
+        "serve",
+        help="top-K request server: score+top-K kernel over the transport "
+        "log, batching/coalescing, hot-user cache (ISSUE 8)",
+    )
+    sv.add_argument("--checkpoint-dir", default=None)
+    sv.add_argument("--checkpoint-journal", default=None,
+                    help="serve from a transport journal instead "
+                    "(directory or tcp://HOST:PORT)")
+    sv.add_argument("--data", required=True,
+                    help="training data file (raw-id mapping + exclude-seen)")
+    sv.add_argument("--format", choices=["netflix", "movielens"],
+                    default="netflix")
+    sv.add_argument("--min-rating", type=float, default=0.0)
+    sv.add_argument("--broker", default=None, metavar="tcp://HOST:PORT",
+                    help="join this native broker's serve topics and "
+                    "answer until killed; omit for the built-in "
+                    "open-loop loadgen against an in-memory log")
+    sv.add_argument("-k", type=int, default=10,
+                    help="loadgen-mode top-K per request")
+    sv.add_argument("--include-seen", action="store_true",
+                    help="do not exclude already-rated movies")
+    sv.add_argument("--table-dtype",
+                    choices=["float32", "bfloat16", "int8"],
+                    default="float32",
+                    help="item-table quantization (ops.quant): bf16 "
+                    "halves the per-batch table scan, int8+scale "
+                    "quarters it")
+    sv.add_argument("--tile-m", type=int, default=2048,
+                    help="movie-axis tile rows streamed through VMEM")
+    sv.add_argument("--max-batch", type=int, default=256,
+                    help="max requests coalesced into one scoring batch")
+    sv.add_argument("--request-partitions", type=int, default=1)
+    sv.add_argument("--response-partitions", type=int, default=1)
+    sv.add_argument("--loadgen-qps", type=float, default=100.0)
+    sv.add_argument("--loadgen-requests", type=int, default=256)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.set_defaults(fn=_serve)
 
     pd = sub.add_parser(
         "predict",

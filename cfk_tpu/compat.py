@@ -133,6 +133,53 @@ def emulate_in_kernel_gather(table, nb, wt, ct):
     return g
 
 
+def emulate_topk_scores(u, table, scale, seen_tiles, *, k_top, num_movies,
+                        tile_m, row_offset=0):
+    """XLA twin of the serving score+top-K kernel — the interpret/old-jax
+    route, so CPU CI exercises the same code shape the Mosaic kernel runs.
+
+    Scans the SAME per-tile fold the kernel body runs
+    (``serving.topk_kernel._score_tile_fold`` — one shared function, the
+    same twin discipline as the Gram kernels) over the same movie tiles in
+    the same order, carrying the same [B, K] selection — so kernel and
+    twin are BIT-IDENTICAL on this route (``tests/test_serving.py`` pins
+    it).  Crucially the scan's per-step block is [B, tile_m]: no
+    [B, num_movies] score matrix is ever materialized here either (the
+    emulation-path memory check in the tests compiles this and bounds its
+    temp memory below B·M·4 bytes).
+    """
+    import jax.numpy as jnp
+
+    from cfk_tpu.serving.topk_kernel import _score_tile_fold
+
+    b = u.shape[0]
+    m_pad = table.shape[0]
+    nt = m_pad // tile_m
+    tbl = table.reshape(nt, tile_m, -1)
+    sc = (None if scale is None
+          else scale.reshape(nt, tile_m, 1).astype(jnp.float32))
+    carry0 = (
+        jnp.full((b, k_top), -jnp.inf, jnp.float32),
+        jnp.full((b, k_top), -1, jnp.int32),
+    )
+
+    off = jnp.asarray(row_offset, jnp.int32)
+
+    def step(carry, i):
+        idx = lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+        v, ids = _score_tile_fold(
+            carry[0], carry[1], u, idx(tbl),
+            None if sc is None else idx(sc),
+            None if seen_tiles is None else idx(seen_tiles),
+            off + i * tile_m,
+            num_movies=num_movies, k_top=k_top,
+        )
+        return (v, ids), None
+
+    (vals, ids), _ = lax.scan(step, carry0, jnp.arange(nt, dtype=jnp.int32))
+    return vals, ids
+
+
 def emulate_fused_gram_solve(a, b, reg, *, reg_mode, lam, lseg):
     """XLA twin of the fused Gram+solve epilogue — the interpret/old-jax
     route, so CPU CI exercises the same code shape the Mosaic kernel runs.

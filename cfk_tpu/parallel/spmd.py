@@ -1170,3 +1170,87 @@ def train_als_sharded(
         num_users=dataset.user_map.num_entities,
         num_movies=dataset.movie_map.num_entities,
     )
+
+
+# -- item-axis sharded top-K serving (ISSUE 8) -------------------------------
+
+def serve_topk_sharded(
+    mesh: Mesh,
+    u,  # [B, k] user-factor batch (replicated)
+    table,  # [M_pad, k] item table, M_pad a multiple of shards·tile_m
+    scale,  # [M_pad] f32 int8 per-row scales, or None
+    seen_tiles,  # [NT, B, W] int32 (serving.topk_kernel.build_seen_tiles)
+    *,
+    k_top: int,
+    num_movies: int,
+    tile_m: int = 512,
+):
+    """Item-axis sharded score+top-K: (scores [B, K], movie rows [B, K]).
+
+    The serving analog of the half-steps' exchange, with the direction
+    reversed: the ITEM table is row-sharded over the mesh, the [B, k]
+    request batch is replicated, each shard runs the streaming score+top-K
+    kernel over its own table slice (its global row base rides the
+    kernel's scalar-prefetched ``row_offset``), and ONE all_gather of the
+    per-shard [B, K] selections — [B, shards·K] — feeds a final
+    ``lax.top_k`` merge.  No dense score block ever crosses a shard
+    boundary; the exchange is O(B·shards·K), independent of num_movies.
+
+    Bit-equality with the single-shard kernel holds by construction:
+    per-element score dots are identical (same k-order contraction), and
+    the merge concatenates shards in ring order = ascending global tile
+    order, which is exactly the order the single-shard carry folds tiles —
+    so ties resolve identically (``tests/test_serving.py`` pins
+    multi-shard == single-shard bit-exactly).
+    """
+    shards = mesh.devices.size
+    m_pad = table.shape[0]
+    if m_pad % (shards * tile_m) != 0:
+        raise ValueError(
+            f"table rows {m_pad} not divisible by shards×tile_m "
+            f"({shards}×{tile_m}); pad with serving.engine.pad_table"
+        )
+    nt = m_pad // tile_m
+    if nt % shards != 0:  # pragma: no cover - implied by the check above
+        raise ValueError(f"{nt} tiles not divisible by {shards} shards")
+
+    # int8 scales / seen rectangles shard with the table rows / tiles; a
+    # zero placeholder keeps the spec arity fixed when absent.
+    sc_op = (jnp.zeros((m_pad,), jnp.float32) if scale is None
+             else scale.astype(jnp.float32))
+    seen_op = (jnp.zeros((nt, u.shape[0], 1), jnp.int32)
+               if seen_tiles is None else seen_tiles)
+    fn = _serve_topk_sharded_fn(
+        mesh, m_pad // shards, scale is not None, seen_tiles is not None,
+        k_top, num_movies, tile_m,
+    )
+    return fn(u, table, sc_op, seen_op)
+
+
+@functools.lru_cache(maxsize=64)
+def _serve_topk_sharded_fn(mesh, rows_per_shard, has_scale, has_seen,
+                           k_top, num_movies, tile_m):
+    """Jitted shard_map for one (mesh, shapes-class, K) serving config —
+    cached so a live server's request stream reuses compiled programs
+    instead of re-tracing the shard_map per call (the engine's pow2
+    bucketing keeps the distinct key count small)."""
+    from cfk_tpu.serving.topk_kernel import topk_scores_pallas
+
+    def shard_fn(u_rep, tbl, sc, seen):
+        off = lax.axis_index(AXIS).astype(jnp.int32) * rows_per_shard
+        v, ids = topk_scores_pallas(
+            u_rep, tbl, sc if has_scale else None,
+            seen if has_seen else None,
+            k_top=k_top, num_movies=num_movies, tile_m=tile_m,
+            row_offset=off,
+        )
+        cat_v = lax.all_gather(v, AXIS, axis=1, tiled=True)
+        cat_i = lax.all_gather(ids, AXIS, axis=1, tiled=True)
+        mv, pos = lax.top_k(cat_v, k_top)
+        return mv, jnp.take_along_axis(cat_i, pos, axis=1)
+
+    return jax.jit(_compat_shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(), P()),
+    ))

@@ -1,0 +1,304 @@
+"""ServeEngine: live factors + seen lists behind the score+top-K kernel.
+
+The stateful core of the request server — everything between "a batch of
+user rows" and "[B, K] ids+scores":
+
+- the item factor table, padded to the kernel's tile grid, quantized per
+  ``ALSConfig.table_dtype`` (``ops.quant``) and kept device-resident (it
+  is read every request; re-uploading 30 MB per query would dominate),
+- the user factor source: a base snapshot taken at attach time plus a
+  HOT-ROW OVERLAY — the factor rows most recently re-solved by streaming
+  fold-in commits.  ``StreamSession`` publishes every commit through
+  ``attach_session``'s listener; the event carries COPIES of the solved
+  rows, applied under the engine lock, so a concurrently-scoring batch
+  reads either the old or the new row, never a torn half-write (the
+  serving side never reaches into the session's mutable arrays),
+- the seen-list CSR for exclusion, with the same overlay treatment: a
+  commit's (user, movie) cells append to the overlay so a just-rated
+  movie disappears from that user's recommendations at the next request,
+- pow2 request-batch bucketing: batches pad to a power of two (and the
+  seen rectangle width is pow2 from ``build_seen_tiles``), so live
+  traffic converges onto a handful of compiled programs instead of
+  re-tracing per batch — the same trick PR 6 used for fold-in shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import numpy as np
+
+from cfk_tpu.serving.topk_kernel import (
+    _pow2_ceil,
+    build_seen_tiles,
+    topk_scores_pallas,
+)
+
+
+def pad_table(table: np.ndarray, tile_m: int, shards: int = 1) -> np.ndarray:
+    """Zero-pad item rows to a multiple of ``shards × tile_m`` (the padding
+    rows are masked by the kernel's global ``num_movies`` bound)."""
+    quantum = tile_m * max(shards, 1)
+    m_pad = -(-table.shape[0] // quantum) * quantum
+    if m_pad == table.shape[0]:
+        return table
+    out = np.zeros((m_pad, table.shape[1]), table.dtype)
+    out[: table.shape[0]] = table
+    return out
+
+
+class ServeEngine:
+    """Score top-K requests against live factors.
+
+    ``seen_movies``/``seen_indptr`` (per-user-row CSR of rated movie rows,
+    sorted ascending per user — ``Dataset.coo_dense`` order after a stable
+    user sort) enables exclude-seen; None serves without exclusion.
+    """
+
+    def __init__(
+        self,
+        user_factors,  # [U, k] (np or jax; snapshot is taken)
+        movie_factors,  # [M_pad0, k]
+        *,
+        num_users: int,
+        num_movies: int,
+        seen_movies=None,
+        seen_indptr=None,
+        table_dtype: str | None = None,
+        tile_m: int = 512,
+        batch_quantum: int = 8,
+        mesh=None,
+    ) -> None:
+        from cfk_tpu.ops.quant import resolve_table_dtype
+
+        self.num_movies = int(num_movies)
+        self.num_users = int(num_users)
+        self.table_dtype = resolve_table_dtype(table_dtype)
+        self.tile_m = int(tile_m)
+        self.batch_quantum = int(batch_quantum)
+        self.mesh = mesh
+        self._shards = 1 if mesh is None else int(mesh.devices.size)
+        self._lock = threading.RLock()
+        self._u_base = np.asarray(user_factors, np.float32)[:num_users]
+        self._u_hot: dict[int, np.ndarray] = {}
+        if (seen_movies is None) != (seen_indptr is None):
+            raise ValueError(
+                "pass both of seen_movies/seen_indptr or neither"
+            )
+        self._seen_movies = (
+            None if seen_movies is None
+            else np.asarray(seen_movies, np.int32)
+        )
+        self._seen_indptr = (
+            None if seen_indptr is None
+            else np.asarray(seen_indptr, np.int64)
+        )
+        self._seen_hot: dict[int, list[int]] = {}
+        m_host = np.asarray(movie_factors, np.float32)[:num_movies]
+        self._set_table(m_host)
+        self.invalidations = 0
+        self.table_swaps = 0
+
+    # -- table ---------------------------------------------------------------
+
+    def _set_table(self, movie_factors_host: np.ndarray) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from cfk_tpu.ops.quant import quantize_table
+
+        padded = pad_table(
+            movie_factors_host.astype(np.float32), self.tile_m, self._shards
+        )
+        data, scale = quantize_table(jnp.asarray(padded), self.table_dtype)
+        # one atomic reference swap: a batch in flight keeps the table it
+        # captured; the next batch sees the new one
+        self._table = (jax.device_put(data),
+                       None if scale is None else jax.device_put(scale))
+
+    @property
+    def table_rows(self) -> int:
+        return int(self._table[0].shape[0])
+
+    # -- live-update listener ------------------------------------------------
+
+    def attach_session(self, session) -> None:
+        """Subscribe to a ``StreamSession``'s commits: fold-in rows refresh
+        the hot-row overlay, rated cells extend the seen overlay, retrains
+        swap the whole table.  Fired AFTER each durable commit, so a
+        request served after the commit returns reflects it."""
+        session.add_commit_listener(self.on_commit)
+
+    def on_commit(self, event: dict) -> None:
+        """Apply one commit event (see ``StreamSession._fire_commit``)."""
+        with self._lock:
+            rows = event.get("rows")
+            touched = event.get("touched_rows") or ()
+            if rows is not None:
+                for i, row in enumerate(touched):
+                    self._u_hot[int(row)] = np.array(rows[i], np.float32)
+                self.invalidations += len(touched)
+            for row, movie in event.get("cells") or ():
+                self._seen_hot.setdefault(int(row), []).append(int(movie))
+            self.num_users = max(self.num_users,
+                                 int(event.get("num_users", self.num_users)))
+            if event.get("retrain"):
+                # a warm retrain re-solves EVERY row: drop the overlay and
+                # re-snapshot both sides
+                self._u_base = np.asarray(
+                    event["user_factors"], np.float32
+                )[: self.num_users]
+                self._u_hot.clear()
+                self._set_table(
+                    np.asarray(event["movie_factors"],
+                               np.float32)[: self.num_movies]
+                )
+                self.table_swaps += 1
+
+    # -- request path --------------------------------------------------------
+
+    def _gather_users(self, user_rows: np.ndarray) -> np.ndarray:
+        u = np.zeros((user_rows.shape[0], self._u_base.shape[1]), np.float32)
+        base_n = self._u_base.shape[0]
+        for i, row in enumerate(user_rows):
+            hot = self._u_hot.get(int(row))
+            if hot is not None:
+                u[i] = hot
+            elif row < base_n:
+                u[i] = self._u_base[row]
+            # else: streamed-in user with no commit yet → zero row
+        return u
+
+    def _batch_seen(self, user_rows: np.ndarray):
+        """Per-batch CSR = base slice ⊕ hot overlay, sorted per user."""
+        if self._seen_movies is None and not self._seen_hot:
+            return None
+        per_user = []
+        base_n = (0 if self._seen_indptr is None
+                  else self._seen_indptr.shape[0] - 1)
+        for row in user_rows:
+            row = int(row)
+            if self._seen_movies is not None and row < base_n:
+                base = self._seen_movies[
+                    self._seen_indptr[row]: self._seen_indptr[row + 1]
+                ]
+            else:
+                base = np.zeros(0, np.int32)
+            extra = self._seen_hot.get(row)
+            if extra:
+                base = np.unique(np.concatenate(
+                    [base, np.asarray(extra, np.int32)]
+                ))
+            per_user.append(base)
+        indptr = np.zeros(len(per_user) + 1, np.int64)
+        indptr[1:] = np.cumsum([a.size for a in per_user])
+        movies = (np.concatenate(per_user) if indptr[-1]
+                  else np.zeros(0, np.int32))
+        return movies, indptr
+
+    def topk(self, user_rows, k: int, *, exclude_seen: bool = True):
+        """(scores [n, k] f32, movie rows [n, k] int32) for the requested
+        user rows.  The batch is padded to the pow2 quantum (padding rows
+        score with a zero factor vector and are sliced off), so request
+        coalescing shares compiled programs across batch sizes."""
+        import jax.numpy as jnp
+
+        user_rows = np.asarray(user_rows, dtype=np.int64)
+        n = user_rows.shape[0]
+        if n == 0:
+            return (np.zeros((0, k), np.float32),
+                    np.zeros((0, k), np.int32))
+        if np.any((user_rows < 0) | (user_rows >= self.num_users)):
+            bad = user_rows[(user_rows < 0)
+                            | (user_rows >= self.num_users)][:5]
+            raise ValueError(
+                f"user rows out of range [0, {self.num_users}): {bad}"
+            )
+        if not 1 <= k <= self.num_movies:
+            raise ValueError(f"k must be in [1, {self.num_movies}], got {k}")
+        b = _pow2_ceil(n, self.batch_quantum)
+        with self._lock:
+            table, scale = self._table
+            u = np.zeros((b, self._u_base.shape[1]), np.float32)
+            u[:n] = self._gather_users(user_rows)
+            seen = self._batch_seen(user_rows) if exclude_seen else None
+        nt = self.table_rows // self.tile_m
+        seen_tiles = None
+        if seen is not None:
+            movies, indptr = seen
+            # padding slots carry EMPTY seen lists (repeat the last indptr
+            # entry), not user 0's — aliasing the heaviest user into every
+            # pad slot would inflate the seen-rectangle width for rows
+            # whose output is sliced off anyway
+            indptr_pad = np.concatenate(
+                [indptr, np.full(b - n, indptr[-1], np.int64)]
+            )
+            seen_tiles = jnp.asarray(build_seen_tiles(
+                movies, indptr_pad, np.arange(b),
+                num_movies=self.num_movies,
+                tile_m=self.tile_m, num_tiles=nt,
+            ))
+        if self.mesh is not None:
+            from cfk_tpu.parallel.spmd import serve_topk_sharded
+
+            vals, ids = serve_topk_sharded(
+                self.mesh, jnp.asarray(u), table, scale, seen_tiles,
+                k_top=k, num_movies=self.num_movies, tile_m=self.tile_m,
+            )
+        else:
+            vals, ids = _topk_jit_fn()(
+                jnp.asarray(u), table, scale, seen_tiles,
+                k_top=k, num_movies=self.num_movies, tile_m=self.tile_m,
+            )
+        return np.asarray(vals)[:n], np.asarray(ids)[:n]
+
+
+def _topk_call(u, table, scale, seen_tiles, *, k_top, num_movies, tile_m):
+    return topk_scores_pallas(
+        u, table, scale, seen_tiles, k_top=k_top, num_movies=num_movies,
+        tile_m=tile_m,
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def _topk_jit_fn():
+    """Jitted single-device entry — with pow2 batch/width bucketing, live
+    traffic converges onto a handful of (B, W, K) program variants."""
+    import jax
+
+    return jax.jit(
+        _topk_call, static_argnames=("k_top", "num_movies", "tile_m")
+    )
+
+
+def engine_from_model(model, dataset=None, *, table_dtype=None, tile_m=512,
+                      mesh=None, batch_quantum=8) -> ServeEngine:
+    """Build an engine from an ``ALSModel`` (+ optional dataset/index whose
+    ``coo_dense`` provides the exclude-seen lists)."""
+    seen_movies = seen_indptr = None
+    if dataset is not None:
+        coo = dataset.coo_dense
+        order = np.argsort(
+            coo.user_raw * (dataset.movie_map.num_entities + 1)
+            + coo.movie_raw, kind="stable",
+        )
+        seen_movies = coo.movie_raw[order].astype(np.int32)
+        counts = np.bincount(
+            coo.user_raw.astype(np.int64),
+            minlength=dataset.user_map.num_entities,
+        )
+        seen_indptr = np.zeros(dataset.user_map.num_entities + 1, np.int64)
+        np.cumsum(counts, out=seen_indptr[1:])
+    u, m = model.user_factors, model.movie_factors
+    if not getattr(u, "is_fully_addressable", True):
+        from cfk_tpu.parallel.mesh import to_host
+
+        u, m = to_host(u), to_host(m)
+    return ServeEngine(
+        np.asarray(u), np.asarray(m),
+        num_users=model.num_users, num_movies=model.num_movies,
+        seen_movies=seen_movies, seen_indptr=seen_indptr,
+        table_dtype=table_dtype, tile_m=tile_m, mesh=mesh,
+        batch_quantum=batch_quantum,
+    )

@@ -1,0 +1,277 @@
+"""Request server: top-K queries over the transport's partitioned log.
+
+The serving analog of the streaming consumer — requests arrive on a
+``serve-requests`` topic (any Transport: InMemory for tests, FileBroker,
+or the native TCP broker for cross-process serving), the server coalesces
+everything currently pending into ONE scoring batch (bounded by
+``max_batch``), runs it through the ``ServeEngine`` (whose pow2 batch
+bucketing turns the coalesced sizes into a handful of compiled programs),
+and answers on a ``serve-responses`` topic partition chosen by the client
+(one partition per client — responses need no routing logic beyond the
+partition, the same PureModPartitioner spirit as everything else).
+
+Batching is the throughput lever, exactly as it was for the reference's
+Kafka producer and PR 6's fold-in micro-batches: under open-loop load the
+natural batch size self-tunes — a busy server finds more requests pending
+per poll, amortizing the per-batch dispatch over more queries, which is
+what makes the QPS-vs-latency trade measurable (``bench.py --serve``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from cfk_tpu.serving.topk_kernel import _pow2_ceil
+from cfk_tpu.transport.serdes import (
+    ScoreRequest,
+    ScoreResponse,
+    decode_score_request,
+    decode_score_response,
+    encode_score_request,
+    encode_score_response,
+)
+
+REQUESTS_TOPIC = "serve-requests"
+RESPONSES_TOPIC = "serve-responses"
+
+
+def ensure_serve_topics(transport, *, requests_topic: str = REQUESTS_TOPIC,
+                        responses_topic: str = RESPONSES_TOPIC,
+                        request_partitions: int = 1,
+                        response_partitions: int = 1) -> None:
+    """Create the serve topics if absent (existing ones keep their own
+    partition counts, like the updates topic)."""
+    for name, parts in ((requests_topic, request_partitions),
+                        (responses_topic, response_partitions)):
+        try:
+            transport.num_partitions(name)
+        except KeyError:
+            transport.create_topic(name, parts)
+
+
+class RecommendServer:
+    """Drain score requests from the log, answer in coalesced batches."""
+
+    def __init__(
+        self,
+        engine,
+        transport,
+        *,
+        requests_topic: str = REQUESTS_TOPIC,
+        responses_topic: str = RESPONSES_TOPIC,
+        max_batch: int = 256,
+        poll_wait_s: float = 0.002,
+        metrics=None,
+    ) -> None:
+        from cfk_tpu.utils.metrics import Metrics
+
+        self.engine = engine
+        self.transport = transport
+        self.requests_topic = requests_topic
+        self.responses_topic = responses_topic
+        self.max_batch = int(max_batch)
+        self.poll_wait_s = poll_wait_s
+        self.metrics = metrics if metrics is not None else Metrics()
+        nparts = transport.num_partitions(requests_topic)
+        self._cursors = {p: 0 for p in range(nparts)}
+        self.requests_served = 0
+        self.batches = 0
+        self.malformed_requests = 0
+
+    def _poll_requests(self) -> list[ScoreRequest]:
+        """Everything currently pending, up to ``max_batch``, in
+        (partition, offset) order — the same deterministic order the
+        streaming consumer uses."""
+        out: list[ScoreRequest] = []
+        for p in sorted(self._cursors):
+            if len(out) >= self.max_batch:
+                break
+            take = self.max_batch - len(out)
+            got = 0
+            for rec in self.transport.consume(
+                self.requests_topic, p, self._cursors[p]
+            ):
+                got += 1  # cursor advances past the frame either way: a
+                # malformed frame must be SKIPPED, not re-read forever —
+                # re-raising before the cursor moved would wedge every
+                # restart on the same poison offset
+                try:
+                    out.append(decode_score_request(rec.value))
+                except ValueError:
+                    self.malformed_requests += 1
+                    self.metrics.incr("serve_malformed_requests")
+                if got >= take:
+                    break
+            self._cursors[p] += got
+        return out
+
+    def step(self) -> int:
+        """Serve ONE coalesced batch; returns the number of requests
+        answered (0 = nothing pending)."""
+        reqs = self._poll_requests()
+        if not reqs:
+            return 0
+        with self.metrics.phase("serve_batch"):
+            # Refuse out-of-range rows per REQUEST (an error response),
+            # never per batch — one bad query must not poison its
+            # co-batched neighbors.
+            valid: list[ScoreRequest] = []
+            errors: list[ScoreRequest] = []
+            for r in reqs:
+                ok = (0 <= r.user < self.engine.num_users
+                      and 1 <= r.k <= self.engine.num_movies)
+                (valid if ok else errors).append(r)
+            responses: list[tuple[int, ScoreResponse]] = []
+            if valid:
+                k_pad = _pow2_ceil(
+                    max(r.k for r in valid),
+                    min(8, self.engine.num_movies),
+                )
+                k_pad = min(k_pad, self.engine.num_movies)
+                rows = np.asarray([r.user for r in valid], np.int64)
+                scores, ids = self.engine.topk(rows, k_pad)
+                for i, r in enumerate(valid):
+                    responses.append((r.reply_partition, ScoreResponse(
+                        req_id=r.req_id,
+                        movie_rows=ids[i, : r.k],
+                        scores=scores[i, : r.k],
+                    )))
+            for r in errors:
+                responses.append((r.reply_partition, ScoreResponse(
+                    req_id=r.req_id,
+                    movie_rows=np.zeros(0, np.int32),
+                    scores=np.zeros(0, np.float32),
+                    error=(f"user row {r.user} out of range "
+                           f"[0, {self.engine.num_users}) or k {r.k} "
+                           f"outside [1, {self.engine.num_movies}]"),
+                )))
+            for part, resp in responses:
+                self.transport.produce(
+                    self.responses_topic, key=int(resp.req_id % (1 << 31)),
+                    value=encode_score_response(resp), partition=part,
+                )
+            flush = getattr(self.transport, "flush", None)
+            if flush is not None:
+                flush()
+        self.requests_served += len(reqs)
+        self.batches += 1
+        self.metrics.incr("serve_requests", len(reqs))
+        self.metrics.incr("serve_batches")
+        return len(reqs)
+
+    def serve_forever(self, *, max_requests: int | None = None,
+                      idle_timeout_s: float | None = None,
+                      stop=None) -> int:
+        """Poll-and-serve loop; returns requests served.  Stops when
+        ``stop()`` goes true, after ``max_requests``, or once the topic
+        has been idle ``idle_timeout_s`` (None = keep polling)."""
+        served = 0
+        idle_since = time.monotonic()
+        while True:
+            if stop is not None and stop():
+                return served
+            if max_requests is not None and served >= max_requests:
+                return served
+            got = self.step()
+            if got:
+                served += got
+                idle_since = time.monotonic()
+                continue
+            if (idle_timeout_s is not None
+                    and time.monotonic() - idle_since >= idle_timeout_s):
+                return served
+            time.sleep(self.poll_wait_s)
+
+
+class ServeClient:
+    """Produce score requests, consume this client's response partition."""
+
+    def __init__(
+        self,
+        transport,
+        *,
+        reply_partition: int = 0,
+        requests_topic: str = REQUESTS_TOPIC,
+        responses_topic: str = RESPONSES_TOPIC,
+    ) -> None:
+        import os
+
+        self.transport = transport
+        self.requests_topic = requests_topic
+        self.responses_topic = responses_topic
+        self.reply_partition = int(reply_partition)
+        self._req_parts = transport.num_partitions(requests_topic)
+        # req_ids start at a random 40-bit base: the response partition is
+        # supposed to be one-per-client, but if two clients DO share one
+        # (misconfiguration), colliding id sequences would silently
+        # mis-attribute answers — a random base makes that astronomically
+        # unlikely instead of guaranteed.
+        self._next_req = int.from_bytes(os.urandom(5), "big") << 16
+        self._cursor = transport.end_offset(responses_topic, reply_partition)
+        self.malformed_responses = 0
+
+    def request(self, user: int, k: int) -> int:
+        """Send one query; returns its req_id (the response's echo key)."""
+        req_id = self._next_req
+        self._next_req += 1
+        self.transport.produce(
+            self.requests_topic,
+            key=int(user) % (1 << 31),
+            value=encode_score_request(ScoreRequest(
+                req_id=req_id, user=int(user), k=int(k),
+                reply_partition=self.reply_partition,
+            )),
+            partition=req_id % self._req_parts,
+        )
+        return req_id
+
+    def flush(self) -> None:
+        flush = getattr(self.transport, "flush", None)
+        if flush is not None:
+            flush()
+
+    def poll_responses(self) -> list[ScoreResponse]:
+        """All responses that arrived since the last poll.  A malformed
+        frame is counted and skipped with the cursor advanced — the same
+        no-wedge rule as the server's request poll."""
+        out = []
+        seen = 0
+        for rec in self.transport.consume(
+            self.responses_topic, self.reply_partition, self._cursor
+        ):
+            seen += 1
+            try:
+                out.append(decode_score_response(rec.value))
+            except ValueError:
+                self.malformed_responses += 1
+        self._cursor += seen
+        return out
+
+    def ask(self, users, k: int, *, server=None, timeout_s: float = 30.0,
+            poll_wait_s: float = 0.002) -> dict[int, ScoreResponse]:
+        """Blocking convenience: send, then poll until every response is
+        back — driving ``server.step()`` inline when one is given (the
+        single-threaded test mode; with a live server thread/process pass
+        None).  Returns {req_id: response}."""
+        self.flush()
+        ids = [self.request(int(u), k) for u in users]
+        self.flush()
+        want = set(ids)
+        got: dict[int, ScoreResponse] = {}
+        deadline = time.monotonic() + timeout_s
+        while want - set(got):
+            if server is not None:
+                server.step()
+            for resp in self.poll_responses():
+                got[resp.req_id] = resp
+            if want - set(got):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{len(want - set(got))} of {len(ids)} responses "
+                        f"missing after {timeout_s}s"
+                    )
+                if server is None:
+                    time.sleep(poll_wait_s)
+        return got

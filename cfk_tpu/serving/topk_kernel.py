@@ -1,0 +1,328 @@
+"""Pallas TPU kernel: batched score + streaming top-K over movie tiles.
+
+The reference's only serving artifact is the dense U·Mᵀ CSV dump
+(``processors/FeatureCollector.java:90-109``) — O(users × movies) memory
+for any query, the one part of its design that cannot reach
+millions-of-users traffic.  This kernel is the serving analog of the
+training stack's chunked half-steps: for a [B, k] batch of user factors it
+streams [T, k] movie-axis tiles of the (optionally quantized,
+``ops.quant``) item factor table through VMEM, computes each [B, T] score
+block on the MXU, and folds it into a running per-user K-selection carried
+in VMEM — so the only thing that ever reaches HBM is the [B, K] result.
+No [B, num_movies] score matrix exists anywhere, on-chip or off.
+
+Per grid step (one movie tile):
+
+- score block  S = U · tileᵀ on the MXU (f32 accumulation; an int8 tile is
+  dequantized in-register by its per-row scale — the same canonical
+  dequant placement as the Gram kernels, ``ops.quant``),
+- padding mask: global column ≥ ``num_movies`` → −inf (the table is padded
+  to a tile multiple),
+- exclusion mask: already-rated items are −inf'd in-register from the
+  batch's per-user CSR slice, re-bucketed per tile on the host
+  (``build_seen_tiles``: ``seen[b]``'s movie rows, already sorted, split
+  at tile boundaries into a [NT, B, W] rectangle of in-tile columns — W is
+  the pow2-bucketed max per-(user, tile) seen count, so the kernel's mask
+  pass is W comparisons against the tile's column iota, not a [B, S×T]
+  blow-up),
+- K-selection merge: the tile's masked scores are concatenated onto the
+  [B, K] carry and one ``lax.top_k`` re-selects — equal scores resolve to
+  the earlier tile (carry first), making tie order deterministic.
+
+The merge step (``_score_tile_fold``) is ONE function shared by the Mosaic
+kernel body and the XLA emulation twin (``compat.emulate_topk_scores``
+scans it over the same tiles), so the two routes are bit-identical on the
+interpret path — the same twin discipline as the Gram kernels.  On real
+hardware the open questions are whether the [B, K+T] top_k lowers
+efficiently in Mosaic or the K-selection carry should spill to a VMEM
+scratch merge-sort, and the score tile's MXU utilization at small B — both
+recorded in the ROADMAP on-TPU backlog.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cfk_tpu.compat import has_vma_system, typeof_vma
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific extensions; absent on some builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+import numpy as np
+
+# Exclusion-mask compare chunk: W seen slots are checked against the tile's
+# column iota in slices of this many slots, bounding both the trace length
+# and the [B, chunk, T] boolean intermediate (≤ ~1 MB at the default tile).
+_SEEN_CHUNK = 16
+
+
+def _pow2_ceil(x: int, floor: int = 1) -> int:
+    out = max(floor, 1)
+    while out < x:
+        out *= 2
+    return out
+
+
+def serve_compute_dtype(table_dtype):
+    """(compute dtype, matmul precision) for the score block — the serving
+    analog of ``ops.solve._gram_compute_dtype``: f32 operands keep the
+    full-precision MXU pass (bit-parity with the dense oracle), bf16 tables
+    feed the MXU bf16 with f32 accumulation, int8 tables dequantize to f32
+    in-register first (the int8×f32-scale product is exact in f32)."""
+    if table_dtype == jnp.bfloat16:
+        return jnp.bfloat16, None
+    return jnp.float32, lax.Precision.HIGHEST
+
+
+def _score_tile_fold(carry_v, carry_i, u, tile, scale, seen, tile_base,
+                     *, num_movies, k_top):
+    """Fold one movie tile into the running top-K carry.
+
+    The ONE copy of the per-tile math — the Mosaic kernel body and the XLA
+    emulation twin both call exactly this, which is what makes the two
+    routes bit-identical on the interpret path.
+
+    carry_v [B, K] f32, carry_i [B, K] int32 (−1 empty), u [B, k],
+    tile [T, k] (f32/bf16/int8), scale [T, 1] f32 or None, seen [B, W]
+    int32 in-tile columns (T = padding), tile_base scalar int32.
+    """
+    t = tile.shape[0]
+    b = u.shape[0]
+    ct, prec = serve_compute_dtype(tile.dtype)
+    if tile.dtype == jnp.int8:
+        # canonical dequant placement (ops.quant): codes → f32 × per-row
+        # scale, before the single matmul
+        tile_f = tile.astype(jnp.float32) * scale
+    else:
+        tile_f = tile.astype(ct)
+    scores = jax.lax.dot_general(
+        u.astype(ct), tile_f,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )  # [B, T]
+    col = lax.broadcasted_iota(jnp.int32, (1, t), 1)  # [1, T] in-tile column
+    gid = tile_base + col  # [1, T] global movie row
+    neg = jnp.float32(-jnp.inf)
+    scores = jnp.where(gid < num_movies, scores, neg)
+    if seen is not None:
+        w = seen.shape[1]
+
+        def mask_chunk(j, sc):
+            chunk = lax.dynamic_slice(seen, (0, j * _SEEN_CHUNK),
+                                      (b, _SEEN_CHUNK))  # [B, C]
+            hit = (chunk[:, :, None] == col[None, :, :]).any(axis=1)
+            return jnp.where(hit, neg, sc)
+
+        scores = lax.fori_loop(0, w // _SEEN_CHUNK, mask_chunk, scores)
+    cat_v = jnp.concatenate([carry_v, scores], axis=1)  # [B, K+T]
+    cat_i = jnp.concatenate(
+        [carry_i, jnp.broadcast_to(gid, (b, t))], axis=1
+    )
+    new_v, pos = lax.top_k(cat_v, k_top)
+    new_i = jnp.take_along_axis(cat_i, pos, axis=1)
+    return new_v, new_i
+
+
+def build_seen_tiles(seen_movies, seen_indptr, batch_rows, *, num_movies,
+                     tile_m, num_tiles: int | None = None,
+                     min_width: int = _SEEN_CHUNK):
+    """[NT, B, W] per-tile exclusion rectangle from a per-user CSR.
+
+    ``seen_movies``/``seen_indptr`` is the CSR of already-rated movie rows
+    by user row (movie rows sorted ascending within each user — the
+    ``StreamState.neighbors`` / ``eval.ranking`` convention);
+    ``batch_rows`` [B] selects the batch.  Entry [t, b, w] is the w-th
+    in-tile column of batch user b's seen movies inside movie tile t,
+    padded with ``tile_m`` (which no in-tile column equals).  W is the
+    pow2-bucketed max per-(user, tile) count — pow2 so the rectangle
+    shape, which is jit-static in the kernel, converges onto a handful of
+    compiled programs under live traffic (the PR 6 fold-in trick).
+    """
+    nt = -(-num_movies // tile_m) if num_tiles is None else num_tiles
+    b = len(batch_rows)
+    batch_rows = np.asarray(batch_rows, dtype=np.int64)
+    counts = (seen_indptr[batch_rows + 1] - seen_indptr[batch_rows]).astype(
+        np.int64
+    )
+    rows = np.repeat(np.arange(b, dtype=np.int64), counts)
+    flat = np.concatenate([
+        np.arange(seen_indptr[r], seen_indptr[r + 1], dtype=np.int64)
+        for r in batch_rows
+    ]) if counts.sum() else np.zeros(0, np.int64)
+    mv = seen_movies[flat].astype(np.int64)
+    keep = mv < num_movies
+    rows, mv = rows[keep], mv[keep]
+    tile_of = mv // tile_m
+    local = (mv % tile_m).astype(np.int32)
+    # mv is sorted within each row, so (row, tile) groups are contiguous;
+    # position within group = running index − group start.
+    key = rows * nt + tile_of
+    if key.size:
+        starts = np.flatnonzero(np.concatenate(([True], key[1:] != key[:-1])))
+        group_sizes = np.diff(np.concatenate((starts, [key.size])))
+        pos = np.arange(key.size) - np.repeat(starts, group_sizes)
+        width = int(group_sizes.max())
+    else:
+        pos = np.zeros(0, np.int64)
+        width = 0
+    w = _pow2_ceil(max(width, 1), min_width)
+    out = np.full((nt, b, w), tile_m, dtype=np.int32)
+    out[tile_of, rows, pos] = local
+    return out
+
+
+def _topk_kernel(off_ref, u_ref, tbl_ref, *refs, t, k_top, num_movies, b,
+                 with_scale, with_seen):
+    """Grid step i: fold movie tile i into the resident [B, K] carry.
+
+    The outputs are the carry (constant-index resident blocks, the Gram
+    kernels' accumulation idiom): step 0 initializes them, every step
+    merges its tile, the final state IS the result.  ``off_ref`` (scalar-
+    prefetched, [1] int32) is the shard's global row offset — 0 on a
+    single device; under item-axis sharding each shard's tile i covers
+    global movie rows [off + i·T, off + (i+1)·T).
+    """
+    refs = list(refs)
+    scale_ref = refs.pop(0) if with_scale else None
+    seen_ref = refs.pop(0) if with_seen else None
+    vals_ref, ids_ref = refs
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        vals_ref[...] = jnp.full((b, k_top), -jnp.inf, jnp.float32)
+        ids_ref[...] = jnp.full((b, k_top), -1, jnp.int32)
+
+    new_v, new_i = _score_tile_fold(
+        vals_ref[...], ids_ref[...], u_ref[...], tbl_ref[...],
+        scale_ref[...] if scale_ref is not None else None,
+        seen_ref[0] if seen_ref is not None else None,
+        off_ref[0] + i * t,
+        num_movies=num_movies, k_top=k_top,
+    )
+    vals_ref[...] = new_v
+    ids_ref[...] = new_i
+
+
+def topk_scores_pallas(
+    u: jax.Array,  # [B, k] user-factor batch (f32 or bf16)
+    table: jax.Array,  # [M_pad, k] item table (f32 / bf16 / int8 codes)
+    scale: jax.Array | None,  # [M_pad] f32 per-row int8 scales, else None
+    seen_tiles: jax.Array | None,  # [NT, B, W] int32 (build_seen_tiles)
+    *,
+    k_top: int,
+    num_movies: int,
+    tile_m: int = 512,
+    row_offset=0,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(scores [B, K] f32 descending, movie rows [B, K] int32).
+
+    Only the [B, K] selection reaches HBM — the out_specs below ARE the
+    no-dense-score-matrix guarantee (``tests/test_serving.py`` additionally
+    pins the emulation route's compiled temp memory below B·M).  Excluded
+    and padding columns score −inf; when fewer than K candidates exist the
+    tail ids are −1.  ``row_offset`` (python int or traced scalar) maps
+    this table slice's rows to global movie rows — the item-axis sharded
+    path (``parallel.spmd.serve_topk_sharded``) passes each shard's base
+    row; ids come back global and ``num_movies`` stays the GLOBAL count.
+    """
+    b, k = u.shape
+    m_pad = table.shape[0]
+    if m_pad % tile_m != 0:
+        raise ValueError(
+            f"table rows {m_pad} not divisible by tile_m {tile_m}; pad the "
+            "table (serving.engine.pad_table does)"
+        )
+    if not 1 <= k_top:
+        raise ValueError(f"k_top must be >= 1, got {k_top}")
+    nt = m_pad // tile_m
+    if seen_tiles is not None and seen_tiles.shape[:2] != (nt, b):
+        raise ValueError(
+            f"seen_tiles shape {seen_tiles.shape} != ({nt}, {b}, W)"
+        )
+    if seen_tiles is not None and seen_tiles.shape[2] % _SEEN_CHUNK != 0:
+        raise ValueError(
+            f"seen_tiles width {seen_tiles.shape[2]} must be a multiple of "
+            f"{_SEEN_CHUNK} (build_seen_tiles pads it)"
+        )
+    if (scale is None) != (table.dtype != jnp.int8):
+        raise ValueError(
+            "per-row scale required exactly when the table is int8 "
+            "(ops.quant.quantize_table provides it)"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret and (typeof_vma(u) or not has_vma_system()):
+        # Same routing rule as the Gram kernels: sharded-interpret and
+        # old-jax runs take the bit-exact XLA twin.
+        from cfk_tpu.compat import emulate_topk_scores
+
+        return emulate_topk_scores(
+            u, table, scale, seen_tiles, k_top=k_top,
+            num_movies=num_movies, tile_m=tile_m, row_offset=row_offset,
+        )
+    if pltpu is None:  # pragma: no cover - non-TPU pallas build
+        raise RuntimeError("pallas TPU extensions unavailable")
+    in_specs = [
+        pl.BlockSpec((b, k), lambda i, off: (0, 0)),  # u: resident
+        pl.BlockSpec((tile_m, k), lambda i, off: (i, 0)),  # table: streamed
+    ]
+    ops = [u, table]
+    if scale is not None:
+        in_specs.append(pl.BlockSpec((tile_m, 1), lambda i, off: (i, 0)))
+        ops.append(scale.reshape(m_pad, 1).astype(jnp.float32))
+    if seen_tiles is not None:
+        w = seen_tiles.shape[2]
+        in_specs.append(pl.BlockSpec((1, b, w), lambda i, off: (i, 0, 0)))
+        ops.append(seen_tiles)
+    kwargs = {}
+    if not interpret:
+        # resident carry (2× for Mosaic's output double-buffer) + one
+        # streamed tile double-buffered + the seen rectangle + headroom
+        out_bytes = 2 * b * k_top * 8
+        tile_bytes = 2 * tile_m * (k + 1) * 4
+        seen_bytes = (0 if seen_tiles is None
+                      else 2 * b * seen_tiles.shape[2] * 4)
+        params = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams"
+        )
+        kwargs["compiler_params"] = params(
+            vmem_limit_bytes=min(
+                2 * out_bytes + 2 * tile_bytes + seen_bytes + (16 << 20),
+                110 << 20,
+            )
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((b, k_top), lambda i, off: (0, 0)),
+            pl.BlockSpec((b, k_top), lambda i, off: (0, 0)),
+        ],
+    )
+    off = jnp.asarray(row_offset, jnp.int32).reshape(1)
+    vals, ids = pl.pallas_call(
+        functools.partial(
+            _topk_kernel, t=tile_m, k_top=k_top, num_movies=num_movies,
+            b=b, with_scale=scale is not None,
+            with_seen=seen_tiles is not None,
+        ),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, k_top), jnp.float32),
+            jax.ShapeDtypeStruct((b, k_top), jnp.int32),
+        ),
+        interpret=interpret,
+        **kwargs,
+    )(off, *ops)
+    return vals, ids

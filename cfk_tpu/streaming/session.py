@@ -163,6 +163,11 @@ class StreamSession:
         self.quarantined: list[dict] = []
         self._m = None  # jnp [M_pad, k], fixed between retrains
         self._u = None  # np [U_pad, k], row-mutated by fold-ins
+        # Serving-side subscribers (ISSUE 8): fired AFTER each durable
+        # commit with copies of the solved rows, so a hot-user factor
+        # cache (serving.ServeEngine.attach_session) re-serves fold-in
+        # updates without ever reading this session's mutable arrays.
+        self._commit_listeners: list = []
         resumed = self._try_resume()
         if not resumed:
             self._bootstrap(base_model)
@@ -433,6 +438,25 @@ class StreamSession:
             )
         self.metrics.incr("stream_commits")
 
+    def add_commit_listener(self, fn) -> None:
+        """Subscribe ``fn(event: dict)`` to every durable commit.
+
+        The event carries COPIES (never views of this session's mutable
+        state): ``touched_rows`` + ``rows`` [T, k] f32 (the freshly solved
+        factor rows), ``cells`` [(user_row, movie_row), ...] (the rated
+        cells the batch applied), ``num_users``, ``stream_step``; a warm
+        retrain instead fires ``retrain=True`` with full ``user_factors``/
+        ``movie_factors`` snapshots.  Fired AFTER the factor+cursor commit
+        is handed to the (async) writer — a request served after the
+        listener returns reflects the folded-in factors."""
+        self._commit_listeners.append(fn)
+
+    def _fire_commit(self, event: dict) -> None:
+        event.setdefault("stream_step", self.stream_step)
+        event.setdefault("num_users", self.state.num_users)
+        for fn in self._commit_listeners:
+            fn(event)
+
     def step(self) -> dict | None:
         """Process ONE micro-batch; returns its summary, or None when
         caught up with the log."""
@@ -528,6 +552,22 @@ class StreamSession:
                     )
         self.stream_step += 1
         self._commit()
+        if pending is not None and pending.touched_rows:
+            # publish the COMMITTED representation — read back from the
+            # factor table AFTER the dtype cast, so a bf16-dtype session's
+            # listeners cache exactly what a post-crash engine would
+            # restore from the checkpoint (not the pre-cast f32 solve)
+            touched_idx = np.asarray(pending.touched_rows)
+            self._fire_commit({
+                "touched_rows": [int(r) for r in pending.touched_rows],
+                "rows": np.array(self._u[touched_idx], np.float32),
+                "cells": [
+                    (int(row), int(mv))
+                    for row, overlay in pending.cell_writes.items()
+                    for mv in overlay
+                ],
+                "retrain": False,
+            })
         summary["stream_step"] = self.stream_step
         if (self.stream.retrain_every is not None
                 and self.stream_step % self.stream.retrain_every == 0):
@@ -650,3 +690,8 @@ class StreamSession:
                               dtype=self._factor_dtype())
         self.metrics.incr("stream_retrains")
         self._commit(note=f"warm retrain at step {self.stream_step}")
+        self._fire_commit({
+            "retrain": True,
+            "user_factors": np.array(self._u, np.float32),
+            "movie_factors": np.array(np.asarray(self._m), np.float32),
+        })

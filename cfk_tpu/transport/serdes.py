@@ -95,6 +95,84 @@ def decode_rating_update(data: bytes) -> RatingUpdate:
     return RatingUpdate(seq=seq, user=user, movie=movie, rating=rating)
 
 
+# ScoreRequest: int64 req_id | int64 user | int32 k | int32 reply_partition.
+# The serving path's query frame (ISSUE 8): ``user`` is a user id in the
+# server's id space (dense row for the in-process engine; the CLI resolves
+# raw ids before producing), ``k`` the requested top-K, ``reply_partition``
+# the response-topic partition this client consumes (one partition per
+# client, so responses need no broker-side routing beyond the partition).
+_SCORE_REQUEST = struct.Struct(">qqii")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreRequest:
+    """One top-K query in flight: ``req_id`` is client-assigned and echoed
+    on the response — the client's latency clock and dedup key."""
+
+    req_id: int
+    user: int
+    k: int
+    reply_partition: int = 0
+
+
+def encode_score_request(msg: ScoreRequest) -> bytes:
+    return _SCORE_REQUEST.pack(msg.req_id, msg.user, msg.k,
+                               msg.reply_partition)
+
+
+def decode_score_request(data: bytes) -> ScoreRequest:
+    if len(data) != _SCORE_REQUEST.size:
+        raise ValueError(
+            f"ScoreRequest frame must be {_SCORE_REQUEST.size} bytes, "
+            f"got {len(data)}"
+        )
+    req_id, user, k, reply = _SCORE_REQUEST.unpack(data)
+    return ScoreRequest(req_id=req_id, user=user, k=k, reply_partition=reply)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreResponse:
+    """Top-K answer: parallel (movie row, score) arrays, ids −1-padded when
+    fewer than K candidates exist (the kernel's empty-slot convention).
+    ``error`` non-empty marks a refused request (unknown user, bad k) —
+    ids/scores are then empty."""
+
+    req_id: int
+    movie_rows: np.ndarray  # int32 [k]
+    scores: np.ndarray  # float32 [k]
+    error: str = ""
+
+
+def encode_score_response(msg: ScoreResponse) -> bytes:
+    ids = np.ascontiguousarray(msg.movie_rows, dtype=">i4")
+    sc = np.ascontiguousarray(msg.scores, dtype=">f4")
+    if ids.shape != sc.shape or ids.ndim != 1:
+        raise ValueError(
+            f"parallel 1-D arrays required, got {ids.shape}/{sc.shape}"
+        )
+    err = msg.error.encode()
+    return (struct.pack(">qiH", msg.req_id, ids.shape[0], len(err))
+            + err + ids.tobytes() + sc.tobytes())
+
+
+def decode_score_response(data: bytes) -> ScoreResponse:
+    if len(data) < 14:
+        raise ValueError(f"ScoreResponse frame truncated at {len(data)} bytes")
+    req_id, n, elen = struct.unpack_from(">qiH", data, 0)
+    off = 14
+    if n < 0 or off + elen + 8 * n != len(data):
+        raise ValueError(
+            f"corrupt ScoreResponse frame: count {n}, error len {elen}, "
+            f"{len(data)} bytes"
+        )
+    err = data[off : off + elen].decode("utf-8", "replace")
+    off += elen
+    ids = np.frombuffer(data, dtype=">i4", count=n, offset=off).astype(np.int32)
+    off += 4 * n
+    sc = np.frombuffer(data, dtype=">f4", count=n, offset=off).astype(np.float32)
+    return ScoreResponse(req_id=req_id, movie_rows=ids, scores=sc, error=err)
+
+
 @dataclasses.dataclass(frozen=True)
 class FeatureRecord:
     """A factor vector in flight, tagged with destination-side dependent rows

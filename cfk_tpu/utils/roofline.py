@@ -136,6 +136,75 @@ def bucketed_gather_rows(movie_blocks, user_blocks) -> float:
     return float(movie_blocks.padded_cells + user_blocks.padded_cells)
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeBatchCost:
+    """Per-scoring-batch model cost of the top-K serve path (ISSUE 8).
+
+    The serve kernel's traffic model is simple and strict: every batch
+    scans the ENTIRE item factor table exactly once (movie-axis tiles
+    streamed through VMEM — there is no reuse across batches to model,
+    and no dense [B, M] score matrix to charge because none exists), plus
+    the [B, k] batch in and the [B, K] selection out.  The table scan is
+    what the quantized-table dtypes shrink — bf16 halves it, int8+scale
+    quarters it — which is why ``vs_roofline`` must be computed against
+    the dtype-aware floor or quantized rows would be compared against a
+    floor they can no longer touch (the same honesty rule as the gather
+    roofline)."""
+
+    model_flops: float  # 2·B·M_pad·k score MACs (the merge is negligible)
+    hbm_bytes: float  # table scan + batch in + [B, K] out
+
+    def flops_bound_s(self, peak=V5E_PEAK_BF16_FLOPS) -> float:
+        return self.model_flops / peak
+
+    def bytes_bound_s(self, bandwidth=V5E_HBM_BYTES_PER_S) -> float:
+        return self.hbm_bytes / bandwidth
+
+    def batch_bound_s(self, peak=V5E_PEAK_BF16_FLOPS,
+                      bandwidth=V5E_HBM_BYTES_PER_S) -> float:
+        """The floor is max(compute, bytes): at serving batch sizes the
+        table scan dominates (B ≪ M), so the roofline QPS is essentially
+        batch · bandwidth / table_bytes — bigger batches and smaller
+        table dtypes are THE two levers."""
+        return max(self.flops_bound_s(peak), self.bytes_bound_s(bandwidth))
+
+
+def serve_batch_cost(num_movies: int, rank: int, batch: int, k_top: int,
+                     *, table_dtype: str | None = None,
+                     m_pad: int | None = None) -> ServeBatchCost:
+    """Model cost of one [batch, k_top] top-K scoring batch.
+
+    ``m_pad`` is the padded table row count actually scanned (tile/shard
+    padding scans too — charge what the kernel reads); the per-row bytes
+    follow the table dtype exactly like the gather floor
+    (``table_gather_bytes_per_row``)."""
+    rows = float(m_pad if m_pad is not None else num_movies)
+    row_bytes = table_gather_bytes_per_row(rank, table_dtype)
+    flops = 2.0 * batch * rows * rank
+    table_bytes = rows * row_bytes
+    io_bytes = batch * rank * 4.0 + batch * k_top * 8.0
+    return ServeBatchCost(
+        model_flops=flops, hbm_bytes=table_bytes + io_bytes
+    )
+
+
+def serve_roofline_row(cost: ServeBatchCost, s_per_batch: float,
+                       table_dtype: str | None = None) -> dict:
+    """The efficiency fields every ``bench.py --serve`` row carries — one
+    definition shared with ``perf_lab --serve`` (the same no-drift rule as
+    ``roofline_row``)."""
+    floor = cost.batch_bound_s()
+    row = {
+        "serve_batch_tflops": round(cost.model_flops / 1e12, 6),
+        "serve_batch_mb": round(cost.hbm_bytes / 1e6, 3),
+        "serve_roofline_s": round(floor, 6),
+        "vs_roofline": round(s_per_batch / floor, 2),
+    }
+    if table_dtype is not None:
+        row["table_dtype"] = table_dtype
+    return row
+
+
 def als_iteration_cost(
     nnz: int,
     num_users: int,

@@ -635,6 +635,162 @@ def scenario_quantized_table() -> dict:
                 ok_extra=metrics.gauges.get("escalation_level", 0) >= 4)
 
 
+def scenario_serve_under_foldin() -> dict:
+    """ISSUE 8: serving stays correct while streaming fold-in commits land
+    concurrently.  A RecommendServer thread answers a continuous request
+    stream for a victim user while the main thread drains fold-in batches
+    that re-solve that user's factor row; the serve engine's hot-row cache
+    is invalidated through the session's commit listener.  Contract:
+    (1) FRESHNESS — a request issued after a commit returns scores
+    bit-identical to scoring the committed factors (and excludes the
+    just-rated movie); (2) NO TORN READS — every response the hammering
+    thread observed matches EXACTLY one committed snapshot of the victim's
+    row (base or post-commit-N), never a mixture or a half-written row."""
+    import tempfile
+    import threading
+
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        ServeEngine,
+        engine_from_model,
+        ensure_serve_topics,
+    )
+    from cfk_tpu.streaming import StreamConfig, StreamProducer, StreamSession
+    from cfk_tpu.transport import CheckpointManager, InMemoryBroker
+
+    ds, cfg, base, broker = _stream_fixture(parts=1, n=24, new_users=())
+    victim = int(ds.user_map.raw_ids[0])
+    prod = StreamProducer(broker)
+    rated = [int(m) for m in ds.movie_map.raw_ids[3:6]]
+    for mv in rated:  # three extra batches each re-solving the victim
+        prod.send(victim, mv, 5.0)
+    k = 5
+    eng = engine_from_model(base, ds)
+    vrow = int(ds.user_map.to_dense(np.asarray([victim]))[0])
+    ensure_serve_topics(broker, response_partitions=2)
+    server = RecommendServer(eng, broker, poll_wait_s=0.001)
+    main_cli = ServeClient(broker, reply_partition=0)
+
+    # committed snapshots of the victim's (factor row, seen set) — base
+    # first, then one per commit event, captured through the SAME listener
+    # channel the engine uses
+    snapshots = [(np.array(eng._gather_users(np.asarray([vrow]))[0]),
+                  tuple())]
+
+    def snap_listener(event):
+        if event.get("retrain") or vrow not in (event.get("touched_rows")
+                                                or ()):
+            return
+        i = event["touched_rows"].index(vrow)
+        extra = tuple(mv for row, mv in event["cells"] if row == vrow)
+        prev = snapshots[-1][1]
+        snapshots.append((np.array(event["rows"][i]), prev + extra))
+
+    with tempfile.TemporaryDirectory() as d:
+        sess = StreamSession(
+            ds, cfg, broker, CheckpointManager(d),
+            stream=StreamConfig(batch_records=1), base_model=base,
+        )
+        sess.add_commit_listener(snap_listener)
+        eng.attach_session(sess)
+        main_cli.ask([vrow], k, server=server)  # warm the serve path
+        stop = threading.Event()
+        hammered: list = []
+
+        def hammer():
+            import time as _t
+
+            cli = ServeClient(broker, reply_partition=1)
+            while not stop.is_set():
+                rid = cli.request(vrow, k)
+                deadline = _t.monotonic() + 5.0
+                got = None
+                while got is None:
+                    for resp in cli.poll_responses():
+                        if resp.req_id == rid:
+                            got = resp
+                    if _t.monotonic() > deadline:
+                        return
+                    _t.sleep(0.0005)
+                hammered.append(got)
+
+        srv_thread = threading.Thread(
+            target=server.serve_forever, kwargs={"stop": stop.is_set},
+            daemon=True,
+        )
+        ham_thread = threading.Thread(target=hammer, daemon=True)
+        srv_thread.start()
+        ham_thread.start()
+        post = []
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            while sess.step() is not None:
+                # a request issued strictly AFTER this commit returned
+                post.append(next(iter(
+                    main_cli.ask([vrow], k).values()
+                )))
+        stop.set()
+        srv_thread.join(timeout=10)
+        ham_thread.join(timeout=10)
+        # same exit contract as sess.run(): drain the async checkpoint
+        # writer before the directory goes away
+        from cfk_tpu.resilience.loop import drain_checkpoints
+
+        drain_checkpoints(sess.manager)
+    commits = len(snapshots) - 1
+
+    def expected_for(u_row, extra_seen):
+        # a throwaway 1-row engine scoring exactly this committed snapshot
+        # (same table, the victim's base CSR remapped onto row 0)
+        lo, hi = int(eng._seen_indptr[vrow]), int(eng._seen_indptr[vrow + 1])
+        e2 = ServeEngine(
+            u_row[None, :], np.asarray(base.movie_factors),
+            num_users=1, num_movies=eng.num_movies,
+            seen_movies=eng._seen_movies[lo:hi],
+            seen_indptr=np.asarray([0, hi - lo], np.int64),
+        )
+        if extra_seen:
+            e2._seen_hot[0] = list(extra_seen)
+        sc, ids_ = e2.topk(np.asarray([0]), k)
+        return sc[0], ids_[0]
+
+    expected = [expected_for(u, seen) for u, seen in snapshots]
+    final_scores, final_ids = expected[-1]
+    fresh = bool(
+        post
+        and np.array_equal(np.asarray(post[-1].scores), final_scores)
+        and np.array_equal(np.asarray(post[-1].movie_rows), final_ids)
+    )
+    rated_rows = set(int(ds.movie_map.to_dense(np.asarray([m]))[0])
+                     for m in rated)
+    excluded = bool(post) and not (
+        set(int(x) for x in np.asarray(post[-1].movie_rows)) & rated_rows
+    )
+    torn = [
+        resp.req_id for resp in hammered
+        if not any(
+            np.array_equal(np.asarray(resp.scores), ev)
+            and np.array_equal(np.asarray(resp.movie_rows), ei)
+            for ev, ei in expected
+        )
+    ]
+    return {
+        "scenario": "serve_under_foldin",
+        "fault_fired": bool(commits >= 3 and hammered),
+        "detected": bool(eng.invalidations >= 3),  # cache saw every commit
+        "recovered": bool(fresh and excluded and not torn),
+        "commits": commits,
+        "cache_invalidations": int(eng.invalidations),
+        "concurrent_responses": len(hammered),
+        "post_commit_fresh": fresh,
+        "just_rated_excluded": excluded,
+        "torn_responses": torn,
+        "ok": bool(commits >= 3 and hammered and eng.invalidations >= 3
+                   and fresh and excluded and not torn),
+    }
+
+
 SCENARIOS = {
     "nan": scenario_nan,
     "inf": scenario_inf,
@@ -648,6 +804,7 @@ SCENARIOS = {
     "stream_crash_replay": scenario_stream_crash_replay,
     "stream_poison_batch": scenario_stream_poison_batch,
     "quantized_table": scenario_quantized_table,
+    "serve_under_foldin": scenario_serve_under_foldin,
 }
 
 

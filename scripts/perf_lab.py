@@ -166,6 +166,23 @@ def make_parser() -> argparse.ArgumentParser:
                    help="synthetic stream size for --foldin on")
     p.add_argument("--foldin-batch-records", type=int, default=256,
                    help="log records per micro-batch for --foldin on")
+    p.add_argument("--serve", default="off", choices=["off", "on"],
+                   help="top-K serving axis (ISSUE 8): drive an open-loop "
+                   "synthetic request stream through the full request→"
+                   "score→top-K→respond loop (in-memory log, "
+                   "RecommendServer batch coalescing, the score+top-K "
+                   "kernel with exclude-seen from this dataset's rating "
+                   "lists) and report QPS + p50/p99 with the table-scan "
+                   "vs_roofline — sweep --serve-batch × --table-dtype × "
+                   "--serve-k")
+    p.add_argument("--serve-batch", type=int, default=64,
+                   help="server max coalesced batch for --serve on")
+    p.add_argument("--serve-k", type=int, default=10,
+                   help="top-K per request for --serve on")
+    p.add_argument("--serve-requests", type=int, default=512,
+                   help="open-loop request count for --serve on")
+    p.add_argument("--serve-tile-m", type=int, default=512,
+                   help="movie-axis tile rows of the serve kernel")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -246,11 +263,103 @@ def run_foldin_lab(args) -> dict:
     return row
 
 
+def run_serve_lab(args) -> dict:
+    """The --serve axis: top-K serving QPS/latency on this dataset.
+
+    The tier-1 in-memory smoke of the WHOLE serve loop (mirroring
+    ``--foldin``'s role for streaming): synthetic factors at the dataset's
+    entity counts (serving cost is independent of factor values), the
+    dataset's real rating lists as the exclude-seen CSR, requests through
+    the transport log, ``RecommendServer`` coalescing, the score+top-K
+    kernel, responses polled back by the open-loop generator.  The row
+    reports achieved QPS, p50/p99, the direct-engine batch floor, and the
+    table-scan ``vs_roofline`` (``utils.roofline.serve_batch_cost``).
+    """
+    import jax
+
+    from cfk_tpu.ops import quant
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        engine_from_model,
+        ensure_serve_topics,
+        run_open_loop,
+        warm_serve_programs,
+        zipf_user_rows,
+    )
+    from cfk_tpu.transport import InMemoryBroker
+    from cfk_tpu.utils.roofline import serve_batch_cost, serve_roofline_row
+
+    quant.resolve_table_dtype(args.table_dtype)
+    ds = get_dataset(args)
+    num_users = ds.user_map.num_entities
+    num_movies = ds.movie_map.num_entities
+    rng = np.random.default_rng(args.seed)
+    # synthetic factors (serving cost is value-independent); the seen-CSR
+    # comes from the dataset's real rating lists via the ONE builder the
+    # served path uses (engine_from_model)
+    from cfk_tpu.models.als import ALSModel
+
+    model = ALSModel(
+        user_factors=rng.standard_normal(
+            (num_users, args.rank)).astype(np.float32) * 0.1,
+        movie_factors=rng.standard_normal(
+            (num_movies, args.rank)).astype(np.float32) * 0.1,
+        num_users=num_users, num_movies=num_movies,
+    )
+    eng = engine_from_model(
+        model, ds, table_dtype=args.table_dtype, tile_m=args.serve_tile_m,
+    )
+    k = min(args.serve_k, num_movies)
+    batch = args.serve_batch
+    qrows = zipf_user_rows(num_users, batch, seed=args.seed + 1)
+    eng.topk(qrows, k)  # warmup / compile
+    times = []
+    for _ in range(args.repeats):
+        t0 = time.time()
+        eng.topk(qrows, k)
+        times.append(time.time() - t0)
+    batch_s = min(times)
+    broker = InMemoryBroker()
+    ensure_serve_topics(broker)
+    server = RecommendServer(eng, broker, max_batch=batch)
+    client = ServeClient(broker)
+    warm_serve_programs(client, server, qrows, k, batch)
+    rate = max(batch / batch_s * 0.7, 1.0)
+    report = run_open_loop(
+        client, rate_qps=rate, num_requests=args.serve_requests,
+        user_rows=zipf_user_rows(num_users, args.serve_requests,
+                                 seed=args.seed + 2),
+        k=k, server=server, drive_server=True,
+    )
+    cost = serve_batch_cost(
+        num_movies, args.rank, batch, k,
+        table_dtype=args.table_dtype, m_pad=eng.table_rows,
+    )
+    row = {
+        "serve": "on",
+        "serve_batch": batch,
+        "serve_k": k,
+        "batch_s": round(batch_s, 5),
+        "capacity_qps": round(batch / batch_s, 1),
+        **report.as_row(),
+        **serve_roofline_row(cost, batch_s, table_dtype=args.table_dtype),
+        "layout": args.layout, "rank": args.rank, "dtype": args.dtype,
+        "users": args.users, "movies": args.movies, "nnz": args.nnz,
+        "tile_m": args.serve_tile_m,
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(row))
+    return row
+
+
 def run_lab(args) -> dict:
     """Measure and return the result row (also printed as the last JSON
     line — the scoreboard contract ``tests/test_perf_lab.py`` pins)."""
     import jax
 
+    if args.serve == "on":
+        return run_serve_lab(args)
     if args.foldin == "on":
         return run_foldin_lab(args)
 

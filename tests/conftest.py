@@ -35,9 +35,38 @@ TINY = "/root/reference/data/data_sample_tiny.txt"
 SMALL = "/root/reference/data/data_sample_small.txt"
 MEDIUM = "/root/reference/data/data_sample_medium.txt"
 
+# The reference repo's sample data is an OPTIONAL fixture set: present
+# where /root/reference is mounted, absent in bare containers.  Tests that
+# need it skip cleanly (ISSUE 8 satellite: the tier-1 failure set must be
+# EMPTY without it, not "identical to seed") — via the session fixtures
+# below, or via @pytest.mark.reference_data for tests that reach the
+# files through the CLI/examples rather than a fixture.
+HAS_REFERENCE_DATA = os.path.exists(TINY)
+_REFERENCE_SKIP_REASON = (
+    "/root/reference sample data not present in this container"
+)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "reference_data: needs the /root/reference sample data files",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if HAS_REFERENCE_DATA:
+        return
+    skip = pytest.mark.skip(reason=_REFERENCE_SKIP_REASON)
+    for item in items:
+        if item.get_closest_marker("reference_data"):
+            item.add_marker(skip)
+
 
 @pytest.fixture(scope="session")
 def tiny_coo():
+    if not HAS_REFERENCE_DATA:
+        pytest.skip(_REFERENCE_SKIP_REASON)
     from cfk_tpu.data.netflix import parse_netflix_python
 
     return parse_netflix_python(TINY)

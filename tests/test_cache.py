@@ -65,6 +65,7 @@ def test_version_mismatch_rejected(tmp_path):
         Dataset.load(str(tmp_path / "c"))
 
 
+@pytest.mark.reference_data
 def test_cli_train_uses_cache(tmp_path, capsys):
     from cfk_tpu.cli import main
 
@@ -88,6 +89,7 @@ def test_cli_train_uses_cache(tmp_path, capsys):
     assert rmse(first) == rmse(second)
 
 
+@pytest.mark.reference_data
 def test_cli_cache_rebuilt_on_flag_change(tmp_path, capsys):
     """A cache built under different layout flags is rebuilt, not reused:
     silently loading SegmentBlocks into a padded-layout run would crash deep
@@ -195,6 +197,7 @@ def test_v1_layout_still_loads(tmp_path):
     assert_trees_equal(ds, Dataset.load(str(c)))
 
 
+@pytest.mark.reference_data
 def test_cli_cache_survives_deleted_source_file(tmp_path, capsys):
     """Archiving/deleting the ratings file after caching must not break
     cached training (the file fingerprint is skipped with a warning), but a

@@ -10,6 +10,7 @@ from cfk_tpu.cli import main
 TINY = "/root/reference/data/data_sample_tiny.txt"
 
 
+@pytest.mark.reference_data
 def test_run_reference_form(capsys, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)  # predictions/ lands under tmp
     rc = main(["run", "4", "5", "0.05", "7", TINY, "426", "302"])
@@ -20,6 +21,7 @@ def test_run_reference_form(capsys, tmp_path, monkeypatch):
     assert mse <= 0.30
 
 
+@pytest.mark.reference_data
 def test_run_warns_on_wrong_counts(capsys, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     rc = main(["run", "4", "3", "0.05", "1", TINY, "9999", "1"])
@@ -29,6 +31,7 @@ def test_run_warns_on_wrong_counts(capsys, tmp_path, monkeypatch):
     assert "warning: NUM_USERS=1" in err
 
 
+@pytest.mark.reference_data
 def test_train_and_evaluate_roundtrip(capsys, tmp_path):
     pred = str(tmp_path / "pred.csv")
     rc = main([
@@ -50,6 +53,7 @@ def test_train_and_evaluate_roundtrip(capsys, tmp_path):
     assert mse <= 0.27
 
 
+@pytest.mark.reference_data
 def test_auto_layout_resolution(capsys, monkeypatch):
     """--layout auto (the default): padded below the threshold, tiled
     above, and ring/auto exchanges force tiled up front."""
@@ -73,6 +77,7 @@ def test_auto_layout_resolution(capsys, monkeypatch):
     assert rc == 0
 
 
+@pytest.mark.reference_data
 def test_train_survives_unmaterializable_dense_preds(capsys, tmp_path, monkeypatch):
     """At BASELINE scales the dense U·Mᵀ cannot exist; training must still
     finish, report factored train MSE, and only skip the CSV dump."""
@@ -95,6 +100,7 @@ def test_train_survives_unmaterializable_dense_preds(capsys, tmp_path, monkeypat
     assert "mse" in metrics["gauges"]
 
 
+@pytest.mark.reference_data
 def test_checkpoint_journal_bad_tcp_url(capsys, tmp_path):
     """A malformed tcp journal target must be a clean flag error, not a
     traceback deep in training."""
@@ -106,6 +112,7 @@ def test_checkpoint_journal_bad_tcp_url(capsys, tmp_path):
     assert "bad broker url" in capsys.readouterr().err
 
 
+@pytest.mark.reference_data
 def test_checkpoint_journal_conflicts_with_dir(capsys, tmp_path):
     rc = main([
         "train", "--data", TINY, "--rank", "3", "--iterations", "1",
@@ -116,6 +123,7 @@ def test_checkpoint_journal_conflicts_with_dir(capsys, tmp_path):
     assert "mutually exclusive" in capsys.readouterr().err
 
 
+@pytest.mark.reference_data
 def test_evaluate_shape_mismatch(capsys, tmp_path):
     bad = tmp_path / "bad.csv"
     bad.write_text("2 3 real\n1 2 3\n4 5 6\n")
@@ -124,6 +132,7 @@ def test_evaluate_shape_mismatch(capsys, tmp_path):
     assert "prediction matrix is" in capsys.readouterr().err
 
 
+@pytest.mark.reference_data
 def test_predict_from_checkpoint(capsys, tmp_path):
     """train --checkpoint-dir, then predict + evaluate without retraining:
     the standalone dump must score identically to the train-time metrics."""
@@ -152,6 +161,7 @@ def test_predict_from_checkpoint(capsys, tmp_path):
     assert "smaller than the data implies" in capsys.readouterr().err
 
 
+@pytest.mark.reference_data
 def test_train_implicit_eval_ranking(capsys, tmp_path):
     from cfk_tpu.cli import main
 
@@ -174,6 +184,7 @@ def test_train_implicit_eval_ranking(capsys, tmp_path):
     assert "requires --implicit" in capsys.readouterr().err
 
 
+@pytest.mark.reference_data
 def test_train_implicit(capsys, tmp_path):
     rc = main([
         "train", "--data", TINY, "--implicit", "--rank", "4",
@@ -183,6 +194,7 @@ def test_train_implicit(capsys, tmp_path):
     assert rc == 0
 
 
+@pytest.mark.reference_data
 def test_train_with_checkpointing(capsys, tmp_path):
     ck = str(tmp_path / "ck")
     args = [

@@ -5,6 +5,7 @@ platform) on the tiny reference sample.
 """
 
 import os
+import pytest
 import runpy
 import sys
 
@@ -20,18 +21,21 @@ def _run(path, argv):
         sys.argv = old
 
 
+@pytest.mark.reference_data
 def test_quickstart_explicit(capsys):
     _run("examples/quickstart_explicit.py", ["quickstart_explicit.py"])
     out = capsys.readouterr().out
     assert "RMSE=" in out and "top-5 for user" in out
 
 
+@pytest.mark.reference_data
 def test_quickstart_implicit(capsys):
     _run("examples/quickstart_implicit.py", ["quickstart_implicit.py"])
     out = capsys.readouterr().out
     assert "iALS   :" in out and "iALS++ :" in out
 
 
+@pytest.mark.reference_data
 def test_sharded_training(capsys):
     _run("examples/sharded_training.py", ["sharded_training.py"])
     assert "resumed from" in capsys.readouterr().out

@@ -72,6 +72,7 @@ def test_torn_tail_truncated_on_reopen(tmp_path):
         assert [r.key for r in b3.consume("t", 0)] == [1, 2, 3]
 
 
+@pytest.mark.reference_data
 def test_ingest_eof_barrier_over_filelog(tmp_path):
     """The full reference ingest protocol runs unchanged on the durable log."""
     from cfk_tpu.data.netflix import parse_netflix_python
@@ -89,6 +90,7 @@ def test_ingest_eof_barrier_over_filelog(tmp_path):
     np.testing.assert_array_equal(coo.rating[order], want.rating[worder])
 
 
+@pytest.mark.reference_data
 def test_ingest_missing_eof_fails_loudly_after_reopen(tmp_path):
     with FileBroker(str(tmp_path), fsync=False) as b:
         b.create_topic(RATINGS_TOPIC, 4)

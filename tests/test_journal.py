@@ -214,6 +214,7 @@ def test_ials_train_kill_resume_through_journal(tiny_dataset, tmp_path):
     np.testing.assert_allclose(resumed, straight, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.reference_data
 def test_cli_serving_from_journal(tmp_path, capsys):
     """predict/recommend serve straight from the transport journal — the
     full topics-as-durable-checkpoint loop: train → journal → serve."""

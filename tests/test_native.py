@@ -19,6 +19,7 @@ def native_lib():
 TINY = "/root/reference/data/data_sample_tiny.txt"
 
 
+@pytest.mark.reference_data
 def test_netflix_parity():
     py = parse_netflix_python(TINY)
     nat = _native.parse_netflix(TINY)
@@ -119,6 +120,7 @@ def test_batch_decode_rejects_ragged():
         _native.decode_id_rating_batch(b"\x00" * 7)
 
 
+@pytest.mark.reference_data
 def test_dispatchers_use_native():
     from cfk_tpu.data.netflix import parse_netflix
 

@@ -28,6 +28,8 @@ def _args(**over):
         health="off",
         health_norm_limit=1e6, ckpt=None,
         foldin="off", foldin_updates=4096, foldin_batch_records=256,
+        serve="off", serve_batch=64, serve_k=10, serve_requests=512,
+        serve_tile_m=512,
         iters=2, repeats=3, profile_dir=None,
     )
     base.update(over)
@@ -169,3 +171,27 @@ def test_ckpt_axis_row(tmp_path, monkeypatch):
     # steps are ~ms while fsync dominates, so back-pressure makes the two
     # writers near-equal and noise flips the sign — the measured win lives
     # in bench.py --ckpt-ab at a real shape, where compute hides the disk.
+
+
+def test_serve_axis_row(tmp_path, monkeypatch, capsys):
+    # the top-K serving axis (ISSUE 8): the tier-1 smoke of the whole
+    # request→score→top-K→respond loop — in-memory log, RecommendServer
+    # coalescing, the score+top-K kernel with exclude-seen, open-loop
+    # latency accounting — mirroring test_foldin_axis_row's role
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    row = perf_lab.run_lab(_args(
+        serve="on", serve_requests=24, serve_batch=8, serve_k=3,
+        serve_tile_m=16, repeats=2,
+    ))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == row  # scoreboard contract holds here too
+    assert row["serve"] == "on"
+    assert row["answered"] == 24
+    assert row["qps"] > 0
+    assert row["serve_k"] == 3
+    assert row["vs_roofline"] > 0
+    assert row["batches"] >= 1
+    for key in ("p50_ms", "p99_ms", "batch_s", "capacity_qps",
+                "serve_roofline_s"):
+        assert row[key] >= 0, key
+    assert row["p50_ms"] <= row["p99_ms"]

@@ -75,6 +75,7 @@ def test_input_validation(tiny_model):
         model.recommend_top_k(np.array([[0]]), k=3)
 
 
+@pytest.mark.reference_data
 def test_cli_recommend_roundtrip(tmp_path, capsys):
     from cfk_tpu.cli import main
 

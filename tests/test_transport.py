@@ -120,6 +120,7 @@ def test_broker_errors():
 TINY = "/root/reference/data/data_sample_tiny.txt"
 
 
+@pytest.mark.reference_data
 def test_ingest_roundtrip_matches_parser(tiny_coo):
     b = InMemoryBroker()
     b.create_topic(RATINGS_TOPIC, 4)
@@ -135,6 +136,7 @@ def test_ingest_roundtrip_matches_parser(tiny_coo):
     np.testing.assert_array_equal(ds.movie_blocks.count.sum(), produced)
 
 
+@pytest.mark.reference_data
 def test_eof_barrier_fault_injection():
     b = InMemoryBroker()
     b.create_topic(RATINGS_TOPIC, 4)
@@ -143,6 +145,7 @@ def test_eof_barrier_fault_injection():
         collect_ratings(b)
 
 
+@pytest.mark.reference_data
 def test_record_after_eof_detected():
     b = InMemoryBroker()
     b.create_topic(RATINGS_TOPIC, 2)
