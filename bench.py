@@ -164,6 +164,16 @@ def main() -> None:
             sv = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# serve: " + json.dumps(sv))
         rows["serve"] = sv
+    # Execution-planner A/B (ISSUE 9): resolver's serve plan vs the
+    # static defaults, measured per request-slot with provenance.
+    # CFK_BENCH_PLAN=0 skips it.
+    if os.environ.get("CFK_BENCH_PLAN", "1") != "0":
+        try:
+            pa = run_plan_ab(_plan_ab_args())
+        except Exception as e:  # pragma: no cover - device-dependent
+            pa = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# plan_ab: " + json.dumps(pa))
+        rows["plan_ab"] = pa
     # Quantized-gather-table A/B: RMSE per table dtype on the planted
     # split + the analytic bytes removed.  CFK_BENCH_QUANT=0 skips it.
     if os.environ.get("CFK_BENCH_QUANT", "1") != "0":
@@ -567,6 +577,21 @@ def scale_main(args) -> None:
     print(json.dumps(run_scale(args)))
 
 
+def _plan_provenance_row(config, users, movies, nnz, *, implicit=False,
+                         ) -> dict:
+    """The provenance columns a config-driven row carries (ISSUE 9)."""
+    from cfk_tpu.plan import plan_for_config
+
+    try:
+        prov = plan_for_config(
+            config, num_users=users, num_movies=movies, nnz=max(nnz, 1),
+            implicit=implicit,
+        )[1]
+    except Exception as e:  # pragma: no cover - never fail a bench row
+        return {"plan": f"unresolved: {e}", "plan_source": "error"}
+    return prov.as_row()
+
+
 def run_scale(args) -> dict:
     from cfk_tpu.config import ALSConfig
     from cfk_tpu.data.blocks import Dataset
@@ -731,6 +756,12 @@ def run_scale(args) -> dict:
         # the measured row-gather-engine floor — the binding resource for
         # ALS on this chip (see cfk_tpu/utils/roofline.py).
         **roofline_row(cost, s_per_iter, table_dtype=config.table_dtype),
+        # Plan provenance (ISSUE 9): which ExecutionPlan this config
+        # resolves to and why — regressions are attributable to the
+        # DECISION (model mis-ranking, stale autotune cache, forced
+        # fallback), not just the symptom.
+        **_plan_provenance_row(config, users, movies, nnz,
+                               implicit=args.ials),
         **extrapolated,
         "timing_degenerate": timing_degenerate,
         "repeats": args.repeats,
@@ -1705,7 +1736,8 @@ def _serve_row() -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
-def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh):
+def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh,
+                  plan=None):
     """Engine + synthetic serving state at the requested shape.
 
     Factors are random — serving cost is independent of factor VALUES
@@ -1738,7 +1770,7 @@ def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh):
     return ServeEngine(
         u, m, num_users=args.serve_users, num_movies=args.serve_movies,
         seen_movies=seen, seen_indptr=indptr, table_dtype=table_dtype,
-        tile_m=args.serve_tile_m, mesh=mesh,
+        tile_m=args.serve_tile_m, mesh=mesh, plan=plan,
     )
 
 
@@ -1850,6 +1882,102 @@ def run_serve(args) -> dict:
         "vs_roofline": best["vs_roofline"],
         "rows": rows,
     }
+
+
+def _plan_ab_args():
+    """The default-main --plan-ab arg surface (parser defaults)."""
+    import argparse
+
+    return argparse.Namespace(
+        seed=0, repeats=3, serve_users=162_541, serve_movies=59_047,
+        serve_nnz=25_000_095, serve_rank=128, serve_k=100,
+        serve_tile_m=2048,
+    )
+
+
+def plan_ab_main(args) -> None:
+    print(json.dumps(run_plan_ab(args)))
+
+
+def run_plan_ab(args) -> dict:
+    """ISSUE 9 acceptance row: the execution planner's serve plan vs the
+    static pre-planner defaults, measured.
+
+    The resolver is given the ML-25M serve shape (rank 128, K=100 — a
+    non-default shape) with table dtype and batch quantum FREE; the
+    table-scan byte model picks the quantized table and a large quantum.
+    Both configurations are then measured on THIS host as per-request
+    service time (batch time / batch), so the row shows the resolver
+    choosing a measurably cheaper plan than the static defaults (f32
+    table, the engine's default batch quantum of 8) with the provenance
+    — chosen plan + model-estimated + measured cost — in the row.  The
+    measured-vs-estimated pair per config is the model-calibration
+    record ROADMAP item 5 asks for.
+    """
+    import numpy as np
+
+    from cfk_tpu.plan import DeviceSpec, ProblemShape, plan_cost
+    from cfk_tpu.serving import plan_for_serving, zipf_user_rows
+
+    rng = np.random.default_rng(args.seed)
+    pool = zipf_user_rows(args.serve_users, 4096, seed=args.seed + 1)
+    ep, prov = plan_for_serving(
+        args.serve_users, args.serve_movies, args.serve_rank,
+        k_top=args.serve_k,
+    )
+    device = DeviceSpec.detect()
+    shape = ProblemShape(
+        num_users=args.serve_users, num_movies=args.serve_movies,
+        nnz=max(args.serve_users, args.serve_movies),
+        rank=args.serve_rank, kind="serve", serve_k=args.serve_k,
+    )
+
+    def measure(table_dtype, batch, plan=None):
+        # The plan arm's engine CONSUMES the plan (ServeEngine(plan=...)
+        # — batch quantum + movie tile rows + dtype from the plan), so
+        # the measured configuration is the resolved plan, not a
+        # lookalike; the static arm keeps the engine's own defaults.
+        eng = _serve_engine(
+            args, pool, np.random.default_rng(args.seed + 2),
+            table_dtype=table_dtype, shards=1, mesh=None, plan=plan,
+        )
+        qrows = pool[:batch]
+        eng.topk(qrows, args.serve_k)  # warmup / compile
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.time()
+            eng.topk(qrows, args.serve_k)
+            times.append(time.time() - t0)
+        return min(times) / batch  # per request-slot
+
+    import dataclasses as _dc
+
+    static_plan = _dc.replace(
+        ep, table_dtype="float32", serve_batch_quantum=8,
+    )
+    static_s = measure("float32", 8)
+    plan_s = measure(ep.table_dtype, ep.serve_batch_quantum, plan=ep)
+    prov.measured_s = plan_s
+    row = {
+        "metric": "plan_ab_serve_per_request_s",
+        "unit": "s/request",
+        "value": round(plan_s, 6),
+        "static_per_request_s": round(static_s, 6),
+        "plan_speedup_vs_static": round(static_s / max(plan_s, 1e-12), 2),
+        "static_plan": static_plan.summary(),
+        "static_est_s": round(
+            plan_cost(shape, device, static_plan).seconds, 6
+        ),
+        "plan_est_s_measured_ratio": round(
+            plan_s / max(prov.est_cost_s or plan_s, 1e-12), 2
+        ),
+        **prov.as_row(),
+        "users": args.serve_users, "movies": args.serve_movies,
+        "rank": args.serve_rank, "k": args.serve_k,
+        "static_tile_m": args.serve_tile_m,
+        "plan_tile_m": ep.serve_tile_m,
+    }
+    return row
 
 
 def compare_exchange_main(args) -> None:
@@ -2111,9 +2239,17 @@ if __name__ == "__main__":
                         "rows run the sharded merge on a virtual mesh)")
     parser.add_argument("--serve-requests", type=int, default=256,
                         help="open-loop requests per row")
+    parser.add_argument("--plan-ab", action="store_true",
+                        help="execution-planner A/B (ISSUE 9): the "
+                        "resolver's serve plan (free table dtype + batch "
+                        "quantum at the ML-25M rank-128 shape) vs the "
+                        "static pre-planner defaults, measured per "
+                        "request-slot, provenance in the row")
     cli_args = parser.parse_args()
     run = (
-        (lambda: serve_main(cli_args))
+        (lambda: plan_ab_main(cli_args))
+        if cli_args.plan_ab
+        else (lambda: serve_main(cli_args))
         if cli_args.serve
         else (lambda: quant_ab_main(cli_args))
         if cli_args.quant_ab

@@ -1121,6 +1121,105 @@ def _stream(args) -> int:
     return 0
 
 
+def _plan_cmd(args) -> int:
+    """``cfk_tpu plan``: resolve + print an ExecutionPlan (ISSUE 9).
+
+    The shape/device come from flags (no dataset needed — this is the
+    offline side of the planner), 'auto' flags stay free for the
+    resolver, anything concrete pins.  ``--explain`` prints the winner's
+    cost terms and, per free knob, the estimated cost of flipping it —
+    the "why this and not that" record.  ``--autotune`` measures the
+    model's top candidates on a trimmed synthetic workload and caches
+    the winner keyed by (shape-class, device fingerprint, version).
+    """
+    import json as _json
+
+    from cfk_tpu.plan import (
+        DeviceSpec,
+        PlanConstraints,
+        ProblemShape,
+        plan as resolve_plan,
+        plan_cost,
+        rank_plans,
+    )
+
+    shape = ProblemShape(
+        num_users=args.users, num_movies=args.movies,
+        nnz=args.ratings, rank=args.rank, num_shards=args.shards,
+        implicit=args.implicit, dtype=args.storage_dtype,
+        kind="serve" if args.serve else "train", serve_k=args.serve_k,
+    )
+    tri = {"auto": None, "on": True, "off": False}
+    cons = PlanConstraints(
+        layout=None if args.layout == "auto" else args.layout,
+        exchange=None if args.exchange == "auto" else args.exchange,
+        table_dtype=(None if args.table_dtype == "auto"
+                     else args.table_dtype),
+        fused_epilogue=tri[args.fused],
+        in_kernel_gather={"auto": None, "fused": True,
+                          "xla": False}[args.gather],
+        overlap=tri[args.overlap],
+        reg_solve_algo=(None if args.reg_solve_algo == "auto"
+                        else args.reg_solve_algo),
+        solver=None if args.solver == "auto" else args.solver,
+        chunk_elems=args.chunk_elems,
+    )
+    if args.device == "auto":
+        device = DeviceSpec.detect()
+    elif args.device == "v5e":
+        device = DeviceSpec.nominal("tpu", name="v5e")
+    else:
+        device = DeviceSpec.nominal("cpu")
+    mode = "autotune" if args.autotune else args.mode
+    measure = None
+    if args.autotune:
+        if args.serve:
+            raise ValueError(
+                "--autotune measures the training iteration; warm the "
+                "serve cache with perf_lab --serve --plan autotune"
+            )
+        from cfk_tpu.plan.autotune import measure_with_training
+
+        measure = measure_with_training(shape)
+    ep, prov = resolve_plan(shape, device, cons, mode=mode,
+                            cache_path=args.cache_path, measure=measure)
+    print(f"# shape  {shape.shape_class()}")
+    print(f"# device {device.fingerprint()}")
+    print(f"# plan   {prov.summary()}")
+    if args.explain:
+        cost = plan_cost(shape, device, ep)
+        print("# cost terms:")
+        for line in cost.explain_lines():
+            print(f"#   {line}")
+        for field, value, reason in prov.explain:
+            if reason == "cost term (s)":
+                continue  # already printed via explain_lines above
+            print(f"#   {field}: {value} — {reason}")
+        # Per-knob deltas: what would flipping each FREE knob cost?
+        try:
+            ranked = rank_plans(shape, device, cons)
+        except Exception:  # pragma: no cover - pinned-everything case
+            ranked = []
+        best_knobs = ep.knob_dict()
+        seen: set[str] = set()
+        for s, alt in ranked[1:]:
+            diff = {f: v for f, v in alt.knob_dict().items()
+                    if v != best_knobs.get(f)}
+            if len(diff) != 1:
+                continue
+            (f, v), = diff.items()
+            tag = f"{f}={v}"
+            if tag in seen:
+                continue
+            seen.add(tag)
+            print(f"#   flip {tag}: {s:.6f} s "
+                  f"(+{s - (prov.est_cost_s or s):.6f})")
+    print(_json.dumps(
+        {**ep.as_dict(), **prov.as_row()}, sort_keys=True
+    ))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cfk_tpu", description=__doc__)
     p.add_argument(
@@ -1504,6 +1603,67 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--metrics", choices=["json", "logfmt"],
                     default="logfmt")
     st.set_defaults(fn=_stream)
+
+    pl = sub.add_parser(
+        "plan",
+        help="resolve the execution plan for a shape/device: print the "
+        "cost-model choice with per-knob explanations (--explain) or "
+        "warm the autotune cache offline (--autotune)",
+    )
+    pl.add_argument("--users", type=int, default=480_189)
+    pl.add_argument("--movies", type=int, default=17_770)
+    pl.add_argument("--ratings", type=int, default=100_480_507,
+                    help="nnz of the training corpus")
+    pl.add_argument("--rank", type=int, default=64)
+    pl.add_argument("--shards", type=int, default=1)
+    pl.add_argument("--implicit", action="store_true",
+                    help="iALS shape (adds the global Gram term)")
+    pl.add_argument("--storage-dtype", choices=["float32", "bfloat16"],
+                    default="float32",
+                    help="factor storage dtype of the run being planned")
+    pl.add_argument("--serve", action="store_true",
+                    help="plan the top-K serving path instead of training "
+                    "(batch quantum + table dtype from the table-scan "
+                    "byte model)")
+    pl.add_argument("--serve-k", type=int, default=100)
+    # Constraint pins — 'auto' leaves the knob to the resolver; anything
+    # else pins it exactly like the matching ALSConfig/train flag would.
+    pl.add_argument("--layout", default="auto",
+                    choices=["auto", "padded", "bucketed", "segment",
+                             "tiled"])
+    pl.add_argument("--exchange", default="auto",
+                    choices=["auto", "all_gather", "ring"])
+    pl.add_argument("--table-dtype", default="auto",
+                    choices=["auto", "float32", "bfloat16", "int8"])
+    pl.add_argument("--fused", default="auto",
+                    choices=["auto", "on", "off"])
+    pl.add_argument("--gather", default="auto",
+                    choices=["auto", "fused", "xla"])
+    pl.add_argument("--overlap", default="auto",
+                    choices=["auto", "on", "off"])
+    pl.add_argument("--reg-solve-algo", default="auto",
+                    choices=["auto", "lu", "gj"])
+    pl.add_argument("--solver", default="auto",
+                    choices=["auto", "cholesky", "pallas"])
+    pl.add_argument("--chunk-elems", type=int, default=None)
+    pl.add_argument("--device", default="auto",
+                    choices=["auto", "v5e", "cpu"],
+                    help="'auto' detects the current jax backend; 'v5e' "
+                    "plans for the reference TPU without one attached")
+    pl.add_argument("--mode", default="model",
+                    choices=["model", "pinned", "autotune"])
+    pl.add_argument("--explain", action="store_true",
+                    help="per-knob cost-model explanation: every cost "
+                    "term plus the estimated delta of flipping each free "
+                    "knob away from the chosen value")
+    pl.add_argument("--autotune", action="store_true",
+                    help="measure the top candidates on a trimmed "
+                    "synthetic workload and cache the winner (implies "
+                    "--mode autotune)")
+    pl.add_argument("--cache-path", default=None,
+                    help="autotune cache file (default "
+                    "~/.cache/cfk_tpu/plan_cache.json)")
+    pl.set_defaults(fn=_plan_cmd)
     return p
 
 

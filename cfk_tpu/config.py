@@ -293,6 +293,21 @@ class ALSConfig:
     # with a diagnostic report in the metrics (production default — a
     # stale model beats no model), "raise" raises TrainingDivergedError.
     on_unrecoverable: Literal["degrade", "raise"] = "degrade"
+    # --- execution planner (cfk_tpu.plan, ISSUE 9) -----------------------
+    # How the trainers resolve their ExecutionPlan.  Every CONCRETE knob
+    # above becomes a pinned constraint (plan.constraints_from_config), so
+    # the CLI surface is unchanged and the default config's execution is
+    # bit-identical across modes; the planner prices the knobs the config
+    # left deferred (None/"auto") and records provenance either way.
+    #   "model"    — cost-model resolution of the free knobs (default;
+    #                today's free knobs are bit-exact across choices).
+    #   "pinned"   — no optimization: pins + legacy process defaults (the
+    #                pre-planner behavior, still recorded as a plan).
+    #   "autotune" — consult the measured-winner cache (warmed offline by
+    #                `cfk_tpu plan --autotune` / perf_lab); model fallback
+    #                with cache=miss provenance when cold.  Trainers never
+    #                measure inline.
+    plan: Literal["model", "pinned", "autotune"] = "model"
 
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "als++")
@@ -404,6 +419,11 @@ class ALSConfig:
             raise ValueError(
                 f"on_unrecoverable must be 'degrade' or 'raise', got "
                 f"{self.on_unrecoverable!r}"
+            )
+        if self.plan not in ("model", "pinned", "autotune"):
+            raise ValueError(
+                f"plan must be 'model', 'pinned' or 'autotune', got "
+                f"{self.plan!r}"
             )
         if self.hbm_chunk_elems is not None and self.hbm_chunk_elems < 1:
             raise ValueError(

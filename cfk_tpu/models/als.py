@@ -529,12 +529,29 @@ def train_als(
     from cfk_tpu.resilience.sentinel import health_from_config
     from cfk_tpu.utils.metrics import Metrics
 
+    from cfk_tpu.plan import plan_for_config
+
     health = health_from_config(config)
     validate_cadence(checkpoint_every, health)
     metrics = metrics if metrics is not None else Metrics()
+    num_ratings = int(dataset.movie_blocks.count.sum())
     metrics.gauge("num_users", dataset.user_map.num_entities)
     metrics.gauge("num_movies", dataset.movie_map.num_entities)
-    metrics.gauge("num_ratings", int(dataset.movie_blocks.count.sum()))
+    metrics.gauge("num_ratings", num_ratings)
+    # Resolve the execution plan (cfk_tpu.plan): the config's concrete
+    # knobs arrive as pinned constraints, the deferred ones are priced by
+    # the cost model, and the trainer reads the knob values through the
+    # plan seam below — bit-identical routing for pinned/default configs,
+    # with provenance (chosen plan + estimated cost + cache hit/miss)
+    # recorded in the metrics and in every checkpoint manifest.
+    exec_plan, plan_prov = plan_for_config(
+        config,
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+        nnz=max(num_ratings, 1),
+    )
+    knobs = exec_plan.half_step_kwargs(config)
+    metrics.note("plan", plan_prov.summary())
     key = jax.random.PRNGKey(config.seed)
     bucketed = isinstance(dataset.movie_blocks, BucketedBlocks)
     segment = isinstance(dataset.movie_blocks, SegmentBlocks)
@@ -577,15 +594,15 @@ def train_als(
                 lam=config.lam,
                 solve_chunk=solve_chunk,
                 dtype=config.dtype,
-                solver=config.solver,
+                solver=knobs["solver"],
                 algorithm=config.algorithm,
                 block_size=config.block_size,
                 sweeps=config.sweeps,
-                overlap=config.overlap,
-                fused_epilogue=config.fused_epilogue,
-                in_kernel_gather=config.in_kernel_gather,
-                reg_solve_algo=config.reg_solve_algo,
-                table_dtype=config.table_dtype,
+                overlap=knobs["overlap"],
+                fused_epilogue=knobs["fused_epilogue"],
+                in_kernel_gather=knobs["in_kernel_gather"],
+                reg_solve_algo=knobs["reg_solve_algo"],
+                table_dtype=knobs["table_dtype"],
                 health_every=None if health is None else health.every,
                 health_norm_limit=(
                     0.0 if health is None else health.norm_limit
@@ -659,17 +676,17 @@ def train_als(
                 return _one_iteration(
                     u, m, mblocks, ublocks,
                     lam=ov.lam, solve_chunk=solve_chunk,
-                    dtype=config.dtype, solver=config.solver,
+                    dtype=config.dtype, solver=knobs["solver"],
                     algorithm=config.algorithm, block_size=config.block_size,
-                    sweeps=config.sweeps, overlap=config.overlap,
+                    sweeps=config.sweeps, overlap=knobs["overlap"],
                     fused_epilogue=ov.fused_epilogue,
-                    in_kernel_gather=config.in_kernel_gather,
+                    in_kernel_gather=knobs["in_kernel_gather"],
                     # The GJ escalation rung: a real jit-static now, so the
                     # rebuilt step re-traces with the overridden elimination
                     # (it used to ride the CFK_REG_SOLVE_ALGO env var).
                     reg_solve_algo=(ov.reg_solve_algo
-                                    or config.reg_solve_algo),
-                    table_dtype=config.table_dtype,
+                                    or knobs["reg_solve_algo"]),
+                    table_dtype=knobs["table_dtype"],
                     **layout_kw,
                 )
 
@@ -689,7 +706,7 @@ def train_als(
             init_fn=init_fn,
             make_step=make_step,
             base_overrides=Overrides(
-                lam=config.lam, fused_epilogue=config.fused_epilogue
+                lam=config.lam, fused_epilogue=knobs["fused_epilogue"]
             ),
             metrics=metrics,
             checkpoint_every=checkpoint_every,
@@ -698,6 +715,7 @@ def train_als(
             fault_injector=fault_injector,
             preemption_guard=preemption_guard,
             watchdog=watchdog,
+            plan_provenance=plan_prov,
         )
     return ALSModel(
         user_factors=u,

@@ -298,10 +298,24 @@ def train_ials(
     from cfk_tpu.resilience.sentinel import health_from_config
     from cfk_tpu.utils.metrics import Metrics
 
+    from cfk_tpu.plan import plan_for_config
+
     _check_nonnegative_strengths(dataset)
     health = health_from_config(config)
     validate_cadence(checkpoint_every, health)
     metrics = metrics if metrics is not None else Metrics()
+    # Execution plan + provenance (cfk_tpu.plan) — the same seam as
+    # als.train_als: pinned config knobs pass through bit-identically,
+    # deferred knobs are priced, provenance rides metrics + manifests.
+    exec_plan, plan_prov = plan_for_config(
+        config,
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+        nnz=max(int(dataset.movie_blocks.count.sum()), 1),
+        implicit=True,
+    )
+    knobs = exec_plan.half_step_kwargs(config)
+    metrics.note("plan", plan_prov.summary())
     key = jax.random.PRNGKey(config.seed)
     if isinstance(dataset.movie_blocks, BucketedBlocks):
         mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)
@@ -332,15 +346,15 @@ def train_ials(
                 lam=config.lam,
                 alpha=config.alpha,
                 dtype=config.dtype,
-                solver=config.solver,
+                solver=knobs["solver"],
                 algorithm=config.algorithm,
                 block_size=config.block_size,
                 sweeps=config.sweeps,
-                overlap=config.overlap,
-                fused_epilogue=config.fused_epilogue,
-                in_kernel_gather=config.in_kernel_gather,
-                reg_solve_algo=config.reg_solve_algo,
-                table_dtype=config.table_dtype,
+                overlap=knobs["overlap"],
+                fused_epilogue=knobs["fused_epilogue"],
+                in_kernel_gather=knobs["in_kernel_gather"],
+                reg_solve_algo=knobs["reg_solve_algo"],
+                table_dtype=knobs["table_dtype"],
                 health_every=None if health is None else health.every,
                 health_norm_limit=(
                     0.0 if health is None else health.norm_limit
@@ -395,16 +409,16 @@ def train_ials(
                 return _one_iteration(
                     u, m, mblocks, ublocks,
                     lam=ov.lam, alpha=config.alpha, dtype=config.dtype,
-                    solver=config.solver, algorithm=config.algorithm,
+                    solver=knobs["solver"], algorithm=config.algorithm,
                     block_size=config.block_size, sweeps=config.sweeps,
-                    overlap=config.overlap,
+                    overlap=knobs["overlap"],
                     fused_epilogue=ov.fused_epilogue,
-                    in_kernel_gather=config.in_kernel_gather,
+                    in_kernel_gather=knobs["in_kernel_gather"],
                     # GJ escalation rung as a threaded jit-static (see
                     # als.train_als make_step).
                     reg_solve_algo=(ov.reg_solve_algo
-                                    or config.reg_solve_algo),
-                    table_dtype=config.table_dtype,
+                                    or knobs["reg_solve_algo"]),
+                    table_dtype=knobs["table_dtype"],
                     **layout_kw,
                 )
 
@@ -424,7 +438,7 @@ def train_ials(
             init_fn=init_fn,
             make_step=make_step,
             base_overrides=Overrides(
-                lam=config.lam, fused_epilogue=config.fused_epilogue
+                lam=config.lam, fused_epilogue=knobs["fused_epilogue"]
             ),
             metrics=metrics,
             checkpoint_every=checkpoint_every,
@@ -433,6 +447,7 @@ def train_ials(
             fault_injector=fault_injector,
             preemption_guard=preemption_guard,
             watchdog=watchdog,
+            plan_provenance=plan_prov,
         )
     return ALSModel(
         user_factors=u,
@@ -643,6 +658,8 @@ def train_ials_sharded(
     from cfk_tpu.resilience.loop import validate_cadence
     from cfk_tpu.resilience.sentinel import health_from_config
 
+    from cfk_tpu.plan import plan_for_config
+
     _check_nonnegative_strengths(dataset)
     health = health_from_config(config)
     validate_cadence(checkpoint_every, health)
@@ -652,6 +669,21 @@ def train_ials_sharded(
     from cfk_tpu.transport.checkpoint import resume_state_synced
 
     validate_sharded_dataset(dataset, config, mesh)
+    exec_plan, plan_prov = plan_for_config(
+        config,
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+        nnz=max(int(dataset.movie_blocks.count.sum()), 1),
+        implicit=True,
+    )
+    metrics.note("plan", plan_prov.summary())
+    from cfk_tpu.parallel.spmd import _config_under_plan
+
+    # Same seam as train_als_sharded: the sharded step builder reads its
+    # knobs off the config, so execute the plan by writing its
+    # half_step_kwargs back over the knob fields (identity for
+    # pinned/default configs).
+    config = _config_under_plan(config, exec_plan)
 
     def to_tree(blocks):
         return {
@@ -747,6 +779,7 @@ def train_ials_sharded(
         ),
         save_meta={"rank": config.rank, "model": "ials",
                    "num_shards": config.num_shards},
+        plan_provenance=plan_prov,
     )
 
     return ALSModel(

@@ -104,33 +104,31 @@ def resolve_bucket_modes(fused_epilogue, in_kernel_gather, solver,
     """Static gating of the ported bucket piece.
 
     Returns (fused, gather) — ``None`` keeps the legacy XLA schedule.
-    Mirrors ``ops.tiled.resolve_fused_chunk_lam`` / ``resolve_gather_mode``:
-    the gather knob picks who fetches the rows (kernel DMA vs XLA stream),
-    the fused knob picks whether the ridge+solve runs inside the Gram
-    kernel's VMEM residency (needs the pallas solver and a concretizable
-    λ — both gates identical to the tiled chunk bodies').
+    Delegates to the ONE shared mode resolver in ``cfk_tpu.plan.registry``
+    (``resolve_gather_mode``/``resolve_fused_chunk_lam`` — the same gates
+    the tiled chunk bodies and both ring half-steps run, including the
+    kernel registry's backend-availability consult): the gather knob picks
+    who fetches the rows (kernel DMA vs XLA stream), the fused knob
+    whether the ridge+solve runs inside the Gram kernel's VMEM residency
+    (pallas solver + a concretizable λ; ``lam=None`` is the iALS matrix
+    mode, whose λ rides inside the shared reg matrix).  The duplicated
+    copy of these gates this function used to carry is gone (ISSUE 9).
     """
-    from cfk_tpu.ops.solve import _resolve_solver, resolve_fused_epilogue
-    from cfk_tpu.ops.tiled import resolve_in_kernel_gather
+    from cfk_tpu.plan.registry import (
+        resolve_fused_chunk_lam,
+        resolve_gather_mode,
+    )
 
     if not bucket_port_supported(rows, width, k):
         return None
-    gather = "fused" if resolve_in_kernel_gather(in_kernel_gather) else "xla"
-    fused = (
-        resolve_fused_epilogue(fused_epilogue)
-        and _resolve_solver(solver) == "pallas"
+    gather = resolve_gather_mode(
+        in_kernel_gather, "pallas", "full", width, 3, width, 2, k,
     )
-    if fused:
-        from cfk_tpu.ops.pallas.gram_kernel import fused_gram_solve_supported
-
-        if not fused_gram_solve_supported(1, k, algo):
-            fused = False
-    if fused and lam is not None:
-        try:
-            float(lam)
-        except (jax.errors.ConcretizationTypeError, TypeError):
-            fused = False
-    return fused, gather
+    lam_f = resolve_fused_chunk_lam(
+        fused_epilogue, solver, k, 1, "pallas",
+        0.0 if lam is None else lam, implicit=lam is None, algo=algo,
+    )
+    return lam_f is not None, gather
 
 
 def _xla_stream(table, nb_flat, wt_flat):

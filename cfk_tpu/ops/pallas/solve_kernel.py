@@ -223,18 +223,43 @@ def default_reg_solve_algo() -> str:
     GJ rung works now (``resilience.policy``; it used to ride the env
     var).  ``gauss_solve_reg_pallas`` resolves this default BEFORE its
     jit boundary, so the concrete algorithm is part of the jit cache key
-    and flipping the env var (or monkeypatching this function) between
+    and flipping the default (or monkeypatching this function) between
     calls compiles the right kernel instead of silently reusing the
     previous one.  Programs that jit a whole training step still bake the
-    value in at THEIR trace time."""
+    value in at THEIR trace time.
+
+    The ``CFK_REG_SOLVE_ALGO`` env var is a DEPRECATED alias (ISSUE 9):
+    the process default is a plan concern now — pin it with
+    ``ALSConfig.reg_solve_algo`` / a ``PlanConstraints(reg_solve_algo=)``
+    pin / ``perf_lab --reg-solve-algo``.  A set env var still wins (so
+    old scripts keep working) but warns ONCE per process."""
     import os
 
-    algo = os.environ.get("CFK_REG_SOLVE_ALGO", "lu")
+    algo = os.environ.get("CFK_REG_SOLVE_ALGO")
+    if algo is None:
+        return "lu"
     if algo not in ("lu", "gj"):
         raise ValueError(
             f"CFK_REG_SOLVE_ALGO must be 'lu' or 'gj', got {algo!r}"
         )
+    global _ENV_ALGO_WARNED
+    if not _ENV_ALGO_WARNED:
+        _ENV_ALGO_WARNED = True
+        import warnings
+
+        warnings.warn(
+            "CFK_REG_SOLVE_ALGO is deprecated: pin the elimination "
+            "algorithm through the execution planner instead "
+            "(ALSConfig.reg_solve_algo, a PlanConstraints pin, or "
+            "perf_lab --reg-solve-algo); the env var still wins this "
+            "process but will be removed",
+            DeprecationWarning,
+            stacklevel=2,
+        )
     return algo
+
+
+_ENV_ALGO_WARNED = False
 
 
 def resolve_reg_solve_algo(algo: str | None) -> str:

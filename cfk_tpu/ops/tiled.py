@@ -80,27 +80,19 @@ def resolve_in_kernel_gather(in_kernel_gather) -> bool:
 def resolve_gather_mode(in_kernel_gather, backend, stage, entries,
                         meta_words, tile_rows, num_segments, k,
                         block_rows=None) -> str:
-    """Static gating of the in-kernel gather: ``"fused"`` (the kernel DMAs
-    the indexed rows itself) or ``"xla"`` (the materialized-stream
-    schedule).  Gates: the knob, the pallas Gram backend (the XLA A/B
-    backend has no kernel to gather inside), production stage only (the
-    decompose probes time the XLA gather as its own phase), the kernels'
-    SMEM/alignment support gate, and the same resident-output VMEM cap
-    the split kernels fall back on.  A refused shape keeps the XLA-gather
-    path — same math via the same emulation twins, so the two modes stay
-    bit-identical (tests/test_in_kernel_gather.py)."""
-    if stage != "full" or backend != "pallas":
-        return "xla"
-    if not resolve_in_kernel_gather(in_kernel_gather):
-        return "xla"
-    if 2 * num_segments * k * (k + 1) * 4 > (96 << 20):
-        return "xla"  # mirrors _entity_gram_chunk's resident-output cap
-    from cfk_tpu.ops.pallas.gram_kernel import in_kernel_gather_supported
+    """Static gating of the in-kernel gather — ``"fused"`` or ``"xla"``.
 
-    if not in_kernel_gather_supported(entries, meta_words, tile_rows,
-                                      block_rows):
-        return "xla"
-    return "fused"
+    The logic lives in ``cfk_tpu.plan.registry`` now (ISSUE 9): ONE
+    resolver shared by the tiled chunk bodies, the bucketed port, both
+    SPMD ring half-steps, and the plan resolver's feasibility gates — and
+    it consults the kernel registry's backend availability, so a forced
+    ``mosaic_tpu`` outage reroutes the next trace to the emulation
+    schedule (same math, bit-identical factors).  This alias keeps every
+    existing call site and test import working."""
+    from cfk_tpu.plan.registry import resolve_gather_mode as _resolve
+
+    return _resolve(in_kernel_gather, backend, stage, entries, meta_words,
+                    tile_rows, num_segments, k, block_rows)
 
 
 def default_tiled_gram_backend() -> str:
@@ -314,36 +306,18 @@ def _chunk_reg(cnt_c, implicit_reg):
 
 def resolve_fused_chunk_lam(fused_epilogue, solver, k, num_segments,
                             backend, lam, implicit, algo=None):
-    """Static gating of the fused Gram+solve chunk path.
+    """Static gating of the fused Gram+solve chunk path — the concretized
+    λ when legal, None → the split Gram→HBM→solve schedule.
 
-    Returns the concretized λ (0.0 for the implicit/matrix mode, whose λ
-    rides inside the shared reg matrix) when the fused path is legal, or
-    None → the caller keeps the split Gram→HBM→solve schedule.  Gates:
-    the per-call/config/process fused knob, the pallas Gram backend (the
-    XLA A/B backend has no VMEM residency to exploit), the pallas solver
-    (cholesky callers asked for XLA's solve — honoring that means
-    splitting), the fused elimination's rank/VMEM caps (for the
-    elimination ``algo`` the caller threads — GJ caps at 64 where LU
-    reaches 128), and a concretizable λ (the kernel bakes it in as a
-    compile-time constant; a traced per-step λ falls back to the split
-    path's unfused solve, same math).
-    """
-    from cfk_tpu.ops.solve import _resolve_solver, resolve_fused_epilogue
+    Like ``resolve_gather_mode``, the logic lives in
+    ``cfk_tpu.plan.registry`` (one resolver for the tiled bodies, the
+    bucketed port, both ring half-steps, and the plan resolver's gates,
+    with kernel-backend availability consulted); this alias keeps the
+    existing import surface."""
+    from cfk_tpu.plan.registry import resolve_fused_chunk_lam as _resolve
 
-    if not resolve_fused_epilogue(fused_epilogue):
-        return None
-    if backend != "pallas" or _resolve_solver(solver) != "pallas":
-        return None
-    from cfk_tpu.ops.pallas.gram_kernel import fused_gram_solve_supported
-
-    if not fused_gram_solve_supported(num_segments, k, algo):
-        return None
-    if implicit:
-        return 0.0
-    try:
-        return float(lam)
-    except (jax.errors.ConcretizationTypeError, TypeError):
-        return None
+    return _resolve(fused_epilogue, solver, k, num_segments, backend, lam,
+                    implicit, algo)
 
 
 def quantize_tiled_operand(fixed_factors, blk, chunks, table_dtype):

@@ -926,10 +926,32 @@ def validate_sharded_dataset(dataset: Dataset, config: ALSConfig, mesh: Mesh) ->
             )
 
 
+def _config_under_plan(config, exec_plan):
+    """The config the sharded step builders should execute: the plan's
+    ``half_step_kwargs`` written back over the knob fields.  For
+    pinned/default configs the sentinels round-trip to the exact same
+    values (bit-identical routing); a cache-hit autotune plan's free-knob
+    choices thread like the single-device trainers' seam."""
+    import dataclasses as _dc
+
+    kn = exec_plan.half_step_kwargs(config)
+    return _dc.replace(
+        config,
+        overlap=(config.overlap if kn["overlap"] is None
+                 else bool(kn["overlap"])),
+        fused_epilogue=kn["fused_epilogue"],
+        in_kernel_gather=kn["in_kernel_gather"],
+        reg_solve_algo=kn["reg_solve_algo"],
+        solver=kn["solver"],
+        table_dtype=kn["table_dtype"],
+    )
+
+
 def _sharded_resilient_loop(
     manager, *, model, dataset, config, mesh, dtype, init_fn, make_raw_step,
     mtree, utree, metrics, checkpoint_every, health, fault_injector,
     resume_fn, save_meta, preemption_guard=None, watchdog=None,
+    plan_provenance=None,
 ):
     """Bind the resilient loop's device↔host boundary to a 1-D mesh.
 
@@ -975,7 +997,12 @@ def _sharded_resilient_loop(
         # pair doubles as the resilient loop's rollback anchor.
         uh, mh = to_host(u), to_host(m)
         if jax.process_index() == 0:
-            save_checkpoint(manager, done, uh, mh, meta=save_meta)
+            meta = save_meta
+            if plan_provenance is not None:
+                # Re-read per save so mid-run plan transitions (rungs,
+                # backend outages) appear in subsequent manifests.
+                meta = dict(save_meta, **plan_provenance.as_meta())
+            save_checkpoint(manager, done, uh, mh, meta=meta)
         return uh, mh
 
     # Eviction must be a fleet-wide agreement: SIGTERM delivery is racy
@@ -1020,6 +1047,7 @@ def _sharded_resilient_loop(
         preemption_guard=preemption_guard,
         watchdog=watchdog,
         evict_sync_fn=evict_sync_fn,
+        plan_provenance=plan_provenance,
     )
 
 
@@ -1050,11 +1078,25 @@ def train_als_sharded(
     from cfk_tpu.resilience.loop import validate_cadence
     from cfk_tpu.resilience.sentinel import health_from_config
 
+    from cfk_tpu.plan import plan_for_config
+
     s = config.num_shards
     health = health_from_config(config)
     validate_cadence(checkpoint_every, health)
     apply_overlap_xla_flags(config)
     validate_sharded_dataset(dataset, config, mesh)
+    exec_plan, plan_prov = plan_for_config(
+        config,
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+        nnz=max(int(dataset.movie_blocks.count.sum()), 1),
+    )
+    # The sharded step builders read knobs off the config object, so the
+    # plan seam is applied by rebuilding the config from the plan's
+    # half_step_kwargs — identical for pinned/default configs (the
+    # sentinels round-trip), and the manifest provenance can never attest
+    # to a plan the execution ignored.
+    config = _config_under_plan(config, exec_plan)
 
     gathered = gathered_layout_trees(dataset, config)
     stats_init = gathered is not None  # bucketed/segment: init from stats
@@ -1127,6 +1169,7 @@ def train_als_sharded(
     from cfk_tpu.utils.metrics import Metrics
 
     metrics = metrics if metrics is not None else Metrics()
+    metrics.note("plan", plan_prov.summary())
     u, m = _sharded_resilient_loop(
         checkpoint_manager,
         model="als",
@@ -1162,6 +1205,7 @@ def train_als_sharded(
             "model": "als",
             "num_shards": config.num_shards,
         },
+        plan_provenance=plan_prov,
     )
 
     return ALSModel(

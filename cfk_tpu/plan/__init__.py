@@ -1,0 +1,59 @@
+"""Unified execution planner (ROADMAP item 5).
+
+``plan(problem_shape, device_spec, constraints) -> ExecutionPlan``: one
+cost-model-driven resolution of every execution-affecting knob, an opt-in
+autotune mode with a (shape-class, device fingerprint, version)-keyed
+winner cache, and a backend-pluggable ``KernelRegistry`` behind which the
+Pallas kernels and their XLA-emulation twins live (``mosaic_tpu`` /
+``xla_emulation`` today; a Mosaic-GPU backend is a registry entry, not a
+rewrite).  See ARCHITECTURE.md "Execution planner & kernel registry".
+"""
+
+from cfk_tpu.plan.autotune import PlanCache, autotune, cache_key
+from cfk_tpu.plan.cost import PlanCost, plan_cost
+from cfk_tpu.plan.registry import (
+    KERNEL_BACKENDS,
+    KERNEL_SLOTS,
+    REGISTRY,
+    KernelRegistry,
+    KernelSpec,
+)
+from cfk_tpu.plan.resolver import (
+    plan,
+    plan_for_config,
+    rank_plans,
+    shape_for_config,
+)
+from cfk_tpu.plan.spec import (
+    DeviceSpec,
+    ExecutionPlan,
+    PlanConstraintError,
+    PlanConstraints,
+    PlanProvenance,
+    ProblemShape,
+    constraints_from_config,
+)
+
+__all__ = [
+    "KERNEL_BACKENDS",
+    "KERNEL_SLOTS",
+    "REGISTRY",
+    "DeviceSpec",
+    "ExecutionPlan",
+    "KernelRegistry",
+    "KernelSpec",
+    "PlanCache",
+    "PlanConstraintError",
+    "PlanConstraints",
+    "PlanCost",
+    "PlanProvenance",
+    "ProblemShape",
+    "autotune",
+    "cache_key",
+    "constraints_from_config",
+    "plan",
+    "plan_cost",
+    "plan_for_config",
+    "rank_plans",
+    "shape_for_config",
+]

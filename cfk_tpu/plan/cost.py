@@ -1,0 +1,208 @@
+"""Per-plan cost estimation — ``utils/roofline``'s byte/flop accounting
+extended to price CANDIDATE plans, not just the plan that ran.
+
+``utils.roofline`` answers "how far is this measured iteration from the
+hardware floor?".  The planner needs the prospective version: "what would
+this iteration cost under THAT knob setting?" — so each term the roofline
+charges (gather bytes per table dtype, per-width-class padded cells, the
+fused epilogue's removed A-batch round trip, the materialized gather
+stream, ring payload bytes, the serve table scan) appears here as a
+per-plan delta.  The total is an ESTIMATE for ranking plans (and for the
+autotune mode's "measure the 2–3 nearest the optimum" trim); absolute
+accuracy is neither promised nor needed — monotonicity in each knob is
+(the matrix test in tests/test_plan.py pins the orderings that matter).
+
+All terms are seconds on the given ``DeviceSpec``.  The breakdown dict is
+what ``cfk_tpu plan --explain`` prints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from cfk_tpu.plan.spec import DeviceSpec, ExecutionPlan, ProblemShape
+
+# Gather-slot inflation per layout when no measured ``gather_rows`` is
+# available: padding slots fetch rows like real slots (the engine charges
+# the slot).  tiled ≈ 1.26 (the measured tile-padding share at the full
+# Netflix build), bucketed ≈ 1.57 (measured at the ML-25M build, ROADMAP
+# item 4), segment = exact O(nnz), padded = the rectangle pads every
+# entity to the max degree — unknowable without the data, call it 3×
+# (power-law data routinely exceeds it; the pin exists so the model
+# PENALIZES padded at scale, which is the decision that matters).
+_GATHER_PAD_FACTOR = {
+    "tiled": 1.26,
+    "bucketed": 1.57,
+    "segment": 1.0,
+    "padded": 3.0,
+}
+
+# Interpret-mode pallas off-TPU is a test-only path, orders of magnitude
+# slow — the model must never pick it on a cpu/gpu device.
+_OFFCHIP_PALLAS_SOLVER_PENALTY = 50.0
+# XLA's batched-Cholesky custom calls measured ~1.7× the fused pallas
+# solve end-to-end on TPU (BASELINE round 2).
+_TPU_CHOLESKY_PENALTY = 1.7
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanCost:
+    """Estimated seconds for one unit of work (a full train iteration, or
+    one serve batch at the plan's quantum) plus the term breakdown."""
+
+    seconds: float
+    unit: str  # "s/iter" | "s/batch"
+    terms: dict
+
+    def explain_lines(self) -> list[str]:
+        out = []
+        for name, val in sorted(self.terms.items(), key=lambda t: -t[1]):
+            out.append(f"{name:28s} {val:.6f} s")
+        out.append(f"{'TOTAL (' + self.unit + ')':28s} {self.seconds:.6f} s")
+        return out
+
+
+def gather_rows_for(shape: ProblemShape, plan: ExecutionPlan) -> float:
+    """Layout-aware gather-slot count per iteration (both sides), before
+    the sweeps multiplier — the measured count when the shape carries one
+    (real blocks exist), the per-layout heuristic otherwise."""
+    if shape.gather_rows is not None:
+        return float(shape.gather_rows)
+    return 2.0 * shape.nnz * _GATHER_PAD_FACTOR[plan.layout]
+
+
+def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
+                         plan: ExecutionPlan) -> PlanCost:
+    """One full ALS iteration (both half-steps) under ``plan``."""
+    from cfk_tpu.utils.roofline import (
+        als_iteration_cost,
+        table_gather_bytes_per_row,
+    )
+
+    k = shape.rank
+    factor_bytes = 2 if shape.dtype == "bfloat16" else 4
+    rows = gather_rows_for(shape, plan) * max(shape.sweeps, 1)
+    base = als_iteration_cost(
+        shape.nnz, shape.num_users, shape.num_movies, k,
+        factor_bytes=factor_bytes, implicit=shape.implicit,
+        table_dtype=plan.table_dtype,
+        gather_rows=gather_rows_for(shape, plan), sweeps=shape.sweeps,
+    )
+    shards = max(shape.num_shards, 1)
+    bw = device.hbm_bytes_per_s
+    terms: dict[str, float] = {}
+
+    # The three floors, per shard (work divides; the roofline model's
+    # min-bytes already include the gather bytes).
+    compute_s = base.model_flops / shards / device.peak_flops
+    if plan.solver == "cholesky":
+        # the solve share of the flops pays the latency-bound custom call
+        solve_flops = (shape.num_users + shape.num_movies) * (
+            k**3 / 3.0 + 2.0 * k**2
+        )
+        penalty = (_TPU_CHOLESKY_PENALTY if device.kind == "tpu" else 1.0)
+        compute_s += solve_flops * (penalty - 1.0) / shards / device.peak_flops
+    if plan.solver == "pallas" and device.kind != "tpu":
+        compute_s *= _OFFCHIP_PALLAS_SOLVER_PENALTY
+    if plan.reg_solve_algo == "gj":
+        # GJ's k³ elimination vs LU's k³/3 — only the solve term triples.
+        solve_flops = (shape.num_users + shape.num_movies) * (k**3 / 3.0)
+        compute_s += 2.0 * solve_flops / shards / device.peak_flops
+    terms["compute"] = compute_s
+    terms["hbm_min_bytes"] = base.min_hbm_bytes / shards / bw
+    terms["gather_floor"] = base.gather_bound_s(
+        rows_per_s=device.gather_rows_per_s, bandwidth=bw,
+    ) / shards
+
+    floor = max(terms["compute"], terms["hbm_min_bytes"],
+                terms["gather_floor"])
+    total = floor
+
+    extra = 0.0
+    if not plan.in_kernel_gather or plan.gram_backend != "pallas":
+        # The materialized [C, k] stream: every gathered row is written to
+        # HBM and read back once per side.
+        stream_bytes = 2.0 * rows * k * factor_bytes
+        extra += stream_bytes / shards / bw
+        terms["xla_gather_stream"] = stream_bytes / shards / bw
+    if not plan.fused_epilogue or plan.gram_backend != "pallas":
+        # The per-chunk [Ec, k, k] A-batch round trip the fusion deletes.
+        ents = shape.num_users + shape.num_movies
+        abatch_bytes = ents * (k * k + k) * 4.0 * 2
+        extra += abatch_bytes / shards / bw
+        terms["split_epilogue_abatch"] = abatch_bytes / shards / bw
+
+    # Exchange: bytes every half-iteration moves between shards.  The
+    # ring rotates (S-1)/S of the fixed table through each device; the
+    # all_gather replicates (S-1)/S of it inbound.  Payload cells follow
+    # the TABLE dtype (quantized ring payloads, PR 7).
+    if shards > 1:
+        row_bytes = table_gather_bytes_per_row(
+            k, plan.table_dtype, factor_bytes
+        )
+        table_rows = shape.num_users + shape.num_movies  # both halves
+        wire = table_rows * row_bytes * (shards - 1) / shards
+        # ICI modeled at HBM bandwidth order; overlap hides the exchange
+        # behind compute up to the floor, serial schedules expose it.
+        exch = wire / bw
+        if plan.overlap:
+            exposed = max(0.0, exch - floor * 0.5)
+        else:
+            exposed = exch
+        terms["exchange_exposed"] = exposed
+        extra += exposed
+
+    # Chunking overhead: each chunk pays a fixed dispatch cost (scan step
+    # + DMA setup), so tiny chunks are overhead-bound; oversized chunks
+    # pay transient-gather HBM pressure (the measured r4 knee — gather
+    # rate falls as the per-chunk working set grows past ~256 MB).
+    chunks = max(1.0, rows / max(plan.chunk_elems, 1))
+    dispatch = chunks * 20e-6
+    terms["chunk_dispatch"] = dispatch
+    extra += dispatch
+    chunk_bytes = plan.chunk_elems * k * factor_bytes
+    if chunk_bytes > 256 << 20:
+        pressure = terms["gather_floor"] * 0.25
+        terms["chunk_gather_pressure"] = pressure
+        extra += pressure
+
+    return PlanCost(seconds=total + extra, unit="s/iter", terms=terms)
+
+
+def serve_batch_cost_for(shape: ProblemShape, device: DeviceSpec,
+                         plan: ExecutionPlan) -> PlanCost:
+    """One coalesced serve batch at the plan's quantum — reported per
+    REQUEST-slot second so quanta are comparable: the table scan amortizes
+    over the batch, which is exactly the lever the quantum moves."""
+    from cfk_tpu.utils.roofline import serve_batch_cost
+
+    b = plan.serve_batch_quantum
+    cost = serve_batch_cost(
+        shape.num_movies, shape.rank, b, shape.serve_k,
+        table_dtype=plan.table_dtype,
+    )
+    shards = max(shape.num_shards, 1)
+    flops_s = cost.model_flops / shards / device.peak_flops
+    bytes_s = cost.hbm_bytes / shards / device.hbm_bytes_per_s
+    batch_s = max(flops_s, bytes_s)
+    # Coalescing wait: a batch cannot dispatch before it fills (or the
+    # server's poll quantum passes); model half a batch service time of
+    # queueing so unbounded quanta do not look free.
+    wait_s = batch_s * 0.5
+    per_request = (batch_s + wait_s) / b
+    terms = {
+        "score_flops": flops_s,
+        "table_scan_bytes": bytes_s,
+        "coalesce_wait": wait_s,
+    }
+    # Ranked PER REQUEST-SLOT: quanta are only comparable on what one
+    # request costs — per batch, a bigger quantum always looks worse even
+    # though it amortizes the table scan, which is the whole lever.
+    return PlanCost(seconds=per_request, unit="s/request", terms=terms)
+
+
+def plan_cost(shape: ProblemShape, device: DeviceSpec,
+              plan: ExecutionPlan) -> PlanCost:
+    if shape.kind == "serve":
+        return serve_batch_cost_for(shape, device, plan)
+    return train_iteration_cost(shape, device, plan)

@@ -1,0 +1,349 @@
+"""Plan resolution: enumerate feasible candidates, cost them, pick cheapest.
+
+``plan(shape, device, constraints)`` is the one entry point: constraints
+pin fields (an ``ALSConfig``'s explicit knobs arrive as pins via
+``spec.constraints_from_config``), the resolver enumerates the free
+fields' candidates in legacy-preference order, drops candidates any
+feasibility gate refuses — the SAME gates the half-steps execute under
+(``quant.validate_table_dtype_layout``, the config layout/exchange/
+algorithm rules, the kernel registry's ``supported`` predicates, the
+device's VMEM/SMEM budgets) — and returns the cost-model minimum.  Ties
+resolve to the first-enumerated candidate, i.e. the pre-planner default.
+
+Pinned-but-impossible combinations split two ways, mirroring today's
+behavior exactly:
+
+- HARD conflicts (the ones ``ALSConfig.__post_init__`` itself refuses:
+  int8 × padded/segment, ring × bucketed/segment, als++ × tiled/segment…)
+  raise ``PlanConstraintError`` with both pins named.
+- SOFT fallbacks (fused epilogue pinned on past the rank cap, in-kernel
+  gather pinned on for an unsupported tile shape…) resolve to the
+  effective execution — the pin is RELEASED (recorded in ``explain``) so
+  the trainers thread the same deferred sentinel as before and the
+  downstream gates do what they always did.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from cfk_tpu.plan import registry as _registry
+from cfk_tpu.plan.cost import plan_cost
+from cfk_tpu.plan.spec import (
+    PLAN_FIELDS,
+    DeviceSpec,
+    ExecutionPlan,
+    PlanConstraintError,
+    PlanConstraints,
+    PlanProvenance,
+    ProblemShape,
+    constraints_from_config,
+)
+
+_TRAIN_FIELDS = ("layout", "exchange", "chunk_elems", "fused_epilogue",
+                 "in_kernel_gather", "overlap", "reg_solve_algo",
+                 "table_dtype", "solver", "gram_backend")
+_SERVE_FIELDS = ("table_dtype", "serve_batch_quantum", "serve_tile_m")
+
+
+def hard_conflict(shape: ProblemShape, pins: dict) -> str | None:
+    """A pinned combination today's config layer REFUSES (vs silently
+    falls back from).  Returns the conflict message, or None."""
+    layout = pins.get("layout")
+    if pins.get("table_dtype") == "int8" and layout not in (
+        None, "tiled", "bucketed"
+    ):
+        return (f"table_dtype='int8' needs layout 'tiled'/'bucketed' (the "
+                f"per-row scale rides their weight streams); pinned "
+                f"layout={layout!r}")
+    if pins.get("exchange") == "ring" and layout in ("bucketed", "segment"):
+        return (f"exchange='ring' supports the padded/tiled layouts; "
+                f"pinned layout={layout!r}")
+    if shape.algorithm != "als":
+        if layout in ("segment", "tiled"):
+            return (f"algorithm={shape.algorithm!r} supports padded/"
+                    f"bucketed layouts; pinned layout={layout!r}")
+        if pins.get("exchange") == "ring":
+            return (f"algorithm={shape.algorithm!r} supports "
+                    "exchange='all_gather' only; pinned exchange='ring'")
+    return None
+
+
+def _feasible(shape: ProblemShape, device: DeviceSpec, cand: dict,
+              ) -> str | None:
+    """Reason this fully-assigned candidate cannot execute, or None.
+    These mirror the execution-time gates one-for-one."""
+    layout = cand["layout"]
+    if cand["table_dtype"] == "int8" and layout not in ("tiled", "bucketed"):
+        return "int8 table needs a weight stream (tiled/bucketed)"
+    if cand["exchange"] == "ring" and layout not in ("padded", "tiled"):
+        return "ring exchange needs the padded/tiled layouts"
+    if shape.num_shards == 1 and cand["exchange"] == "ring":
+        return "ring exchange is a multi-shard schedule"
+    if shape.algorithm != "als" and layout in ("segment", "tiled"):
+        return "subspace optimizers need padded/bucketed"
+    if shape.algorithm != "als" and cand["exchange"] != "all_gather":
+        return "subspace optimizers are all_gather only"
+    mosaic = _registry.backend_available("mosaic_tpu")
+    if cand["gram_backend"] == "pallas" and not mosaic:
+        return "mosaic_tpu backend unavailable"
+    if cand["fused_epilogue"]:
+        if cand["gram_backend"] != "pallas" or cand["solver"] != "pallas":
+            return "fused epilogue needs the pallas gram backend + solver"
+        gate = _registry.REGISTRY.get("gram_solve", "mosaic_tpu").supported
+        if not gate(num_segments=1, k=shape.rank,
+                    algo=cand["reg_solve_algo"]):
+            return (f"rank {shape.rank} exceeds the fused "
+                    f"{cand['reg_solve_algo']} elimination cap")
+    if cand["in_kernel_gather"]:
+        if cand["gram_backend"] != "pallas":
+            return "in-kernel gather lives inside the pallas gram kernel"
+        tr = shape.tile_rows
+        entries = min(cand["chunk_elems"], 2 * shape.nnz)
+        gate = _registry.REGISTRY.get("gram_gather", "mosaic_tpu").supported
+        if not gate(entries=entries, meta_words=entries // max(tr, 1) + 2,
+                    tile_rows=tr, block_rows=None):
+            return "chunk shape refused by the gather SMEM/alignment gate"
+    if cand["solver"] == "pallas":
+        from cfk_tpu.ops.pallas import PALLAS_MAX_RANK
+
+        if shape.rank > 2 * PALLAS_MAX_RANK:
+            return (f"rank {shape.rank} exceeds the pallas solver's "
+                    f"blocked cap {2 * PALLAS_MAX_RANK}")
+    return None
+
+
+# (knob, pinned value that may be infeasible, minimal-dependency probe
+# overrides).  Each is a pin today's EXECUTION silently falls back from,
+# so the resolver must release it (recording why) rather than raise —
+# `ops.solve.dispatch_spd_solve` quietly takes cholesky past the pallas
+# rank cap, the chunk resolvers quietly split/XLA-gather, and a
+# single-device trainer never consults the exchange knob.  The probe
+# overrides disable DEPENDENT knobs so the trial's refusal reason is
+# about this pin, not a knock-on (fused needs the pallas solver, so a
+# solver probe must not fail on the fused gate).
+_SOFT_PINS = (
+    ("gram_backend", "pallas",
+     dict(fused_epilogue=False, in_kernel_gather=False)),
+    ("solver", "pallas",
+     dict(fused_epilogue=False, in_kernel_gather=False)),
+    ("fused_epilogue", True, {}),
+    ("in_kernel_gather", True, dict(fused_epilogue=False)),
+    ("exchange", "ring", dict(fused_epilogue=False,
+                              in_kernel_gather=False)),
+)
+
+
+def _soft_release(shape, device, pins, explain):
+    """Release pins whose execution would silently fall back today
+    (``_SOFT_PINS``), so the resolved plan reports the EFFECTIVE
+    execution instead of raising on a config that has always trained.
+    The released knob goes back to the resolver (which re-derives the
+    fallback the gates would take) and the release is recorded in
+    ``explain``."""
+    pins = dict(pins)
+    for knob, value, overrides in _SOFT_PINS:
+        if pins.get(knob) != value:
+            continue
+        trial = dict(pins)
+        for f in PLAN_FIELDS:
+            trial.setdefault(f, PLAN_FIELDS[f][0])
+        trial.update(overrides)
+        trial[knob] = value
+        reason = _feasible(shape, device, trial)
+        if reason is not None:
+            explain.append((knob, None,
+                            f"pinned {value!r} but infeasible ({reason}); "
+                            "released to the execution-time fallback"))
+            pins.pop(knob)
+    return pins
+
+
+def candidates(shape: ProblemShape, constraints: PlanConstraints,
+               ) -> "itertools.product":
+    """(field order, value tuples) for the free-field product."""
+    fields = _SERVE_FIELDS if shape.kind == "serve" else _TRAIN_FIELDS
+    pins = constraints.pinned()
+    axes = []
+    for f in fields:
+        if f in pins:
+            axes.append((f, (pins[f],)))
+        else:
+            vals = PLAN_FIELDS[f]
+            if f == "exchange" and shape.num_shards == 1:
+                vals = ("all_gather",)
+            axes.append((f, vals))
+    names = [f for f, _ in axes]
+    return names, itertools.product(*[v for _, v in axes])
+
+
+def _assemble(shape: ProblemShape, cand: dict, pinned: frozenset,
+              pins: dict | None = None) -> ExecutionPlan:
+    """Fill non-enumerated fields with pins, then defaults, and name the
+    kernel backend per slot from the resolved knobs (a serve-kind resolve
+    enumerates only the serve fields, but pinned train fields must still
+    appear in the plan verbatim)."""
+    full = {f: PLAN_FIELDS[f][0] for f in PLAN_FIELDS}
+    full.update(pins or {})
+    full.update(cand)
+    mosaic = (_registry.backend_available("mosaic_tpu")
+              and full["gram_backend"] == "pallas")
+    emu = "xla_emulation"
+    moz = "mosaic_tpu"
+    fused = full["fused_epilogue"] and full["solver"] == "pallas" and mosaic
+    gather = full["in_kernel_gather"] and mosaic
+    kernels = (
+        ("gram", moz if mosaic else emu),
+        ("gram_gather", moz if gather else emu),
+        ("gram_solve", moz if fused else emu),
+        ("gram_solve_gather", moz if (fused and gather) else emu),
+        ("reg_solve",
+         moz if (full["solver"] == "pallas"
+                 and _registry.backend_available(moz)) else emu),
+        ("topk", moz if _registry.backend_available(moz) else emu),
+    )
+    return ExecutionPlan(**full, kernels=kernels, pinned=pinned)
+
+
+def _rank_plans(shape: ProblemShape, device: DeviceSpec,
+                constraints: PlanConstraints | None = None,
+                ) -> tuple[list[tuple[float, "ExecutionPlan"]], tuple]:
+    """(ranked candidates cheapest-first, soft-release explain rows).
+    Stable: enumeration order — legacy defaults first — breaks ties."""
+    constraints = constraints or PlanConstraints()
+    explain: list = []
+    pins = constraints.pinned()
+    conflict = hard_conflict(shape, pins)
+    if conflict is not None:
+        raise PlanConstraintError(conflict)
+    pins = _soft_release(shape, device, pins, explain)
+    constraints = PlanConstraints(**pins)
+    names, prod = candidates(shape, constraints)
+    pinned = frozenset(pins)
+    ranked = []
+    for idx, values in enumerate(prod):
+        cand = dict(zip(names, values))
+        reason = (None if shape.kind == "serve"
+                  else _feasible(shape, device, _with_defaults(cand)))
+        if reason is not None:
+            continue
+        ep = _assemble(shape, cand, pinned, pins)
+        cost = plan_cost(shape, device, ep)
+        ranked.append((cost.seconds, idx, ep, cost))
+    if not ranked:
+        raise PlanConstraintError(
+            f"no feasible plan for {shape.shape_class()} under pins "
+            f"{sorted(pins.items())} — every candidate was refused"
+        )
+    ranked.sort(key=lambda t: (t[0], t[1]))
+    return [(s, ep) for s, _, ep, _ in ranked], tuple(explain)
+
+
+def rank_plans(shape: ProblemShape, device: DeviceSpec,
+               constraints: PlanConstraints | None = None,
+               ) -> list[tuple[float, ExecutionPlan]]:
+    """All feasible candidates, cheapest first."""
+    return _rank_plans(shape, device, constraints)[0]
+
+
+def _with_defaults(cand: dict) -> dict:
+    full = {f: PLAN_FIELDS[f][0] for f in PLAN_FIELDS}
+    full.update(cand)
+    return full
+
+
+def plan(shape: ProblemShape, device: DeviceSpec | None = None,
+         constraints: PlanConstraints | None = None, *,
+         mode: str = "model", cache_path: str | None = None,
+         measure=None) -> tuple[ExecutionPlan, PlanProvenance]:
+    """Resolve an execution plan.
+
+    ``mode="model"``    — cost-model minimum over the feasible set.
+    ``mode="pinned"``   — no optimization: pins + legacy defaults (the
+                          pre-planner behavior, as a plan object).
+    ``mode="autotune"`` — consult the JSON cache; on a miss, measure the
+                          top candidates when a ``measure`` callable is
+                          given (``autotune.autotune``), else fall back
+                          to the model choice with cache="miss".
+    """
+    device = device or DeviceSpec.detect()
+    constraints = constraints or PlanConstraints()
+    if mode == "autotune":
+        from cfk_tpu.plan.autotune import autotune
+
+        return autotune(shape, device, constraints,
+                        cache_path=cache_path, measure=measure)
+    if mode not in ("model", "pinned"):
+        raise ValueError(f"unknown plan mode {mode!r}")
+    ranked, explain = _rank_plans(shape, device, constraints)
+    if mode == "pinned":
+        # First-enumerated feasible candidate == pins + preference-order
+        # defaults; rank_plans sorts by cost, so re-derive by index order.
+        best = min(
+            ((s, ep) for s, ep in ranked),
+            key=lambda t: _preference_index(t[1], device),
+        )[1]
+        cost = plan_cost(shape, device, best)
+        prov = PlanProvenance(plan=best, source="pinned",
+                              est_cost_s=cost.seconds, explain=explain)
+        return best, prov
+    est, best = ranked[0]
+    cost = plan_cost(shape, device, best)
+    explain = explain + tuple(
+        (name, round(val, 6), "cost term (s)")
+        for name, val in sorted(cost.terms.items(), key=lambda t: -t[1])
+    )
+    source = "model" if len(ranked) > 1 else "pinned"
+    prov = PlanProvenance(plan=best, source=source, est_cost_s=est,
+                          explain=explain)
+    return best, prov
+
+
+def _preference_index(ep: ExecutionPlan, device: DeviceSpec) -> tuple:
+    """Lexicographic position of a plan in legacy-preference order.
+
+    The solver's legacy default is device-dependent (``"auto"`` resolves
+    pallas on TPU, cholesky elsewhere — ``ops.solve._resolve_solver``),
+    so the preference order flips with the device kind; every other
+    field's preference is the candidate-tuple order."""
+    idx = []
+    for f, vals in PLAN_FIELDS.items():
+        if f == "solver" and device.kind != "tpu":
+            vals = tuple(reversed(vals))
+        v = getattr(ep, f)
+        idx.append(vals.index(v) if v in vals else len(vals))
+    return tuple(idx)
+
+
+def shape_for_config(config, *, num_users: int, num_movies: int, nnz: int,
+                     implicit: bool = False,
+                     gather_rows: float | None = None) -> ProblemShape:
+    """The ``ProblemShape`` a trainer resolves its plan for."""
+    return ProblemShape(
+        num_users=max(num_users, 1), num_movies=max(num_movies, 1),
+        nnz=max(nnz, 1), rank=config.rank, num_shards=config.num_shards,
+        implicit=implicit, algorithm=config.algorithm,
+        sweeps=config.sweeps if config.algorithm != "als" else 1,
+        dtype=config.dtype, gather_rows=gather_rows,
+    )
+
+
+def plan_for_config(config, *, num_users: int, num_movies: int, nnz: int,
+                    implicit: bool = False,
+                    gather_rows: float | None = None,
+                    device: DeviceSpec | None = None,
+                    cache_path: str | None = None,
+                    ) -> tuple[ExecutionPlan, PlanProvenance]:
+    """The trainer entry: shape from the dataset's counts, pins from the
+    config's explicit knobs, mode from ``config.plan``.  Trainer-side
+    autotune NEVER measures (that belongs offline — ``cfk_tpu plan
+    --autotune`` / ``perf_lab --plan autotune``); it consults the cache
+    and falls back to the model on a miss, recording hit/miss."""
+    shape = shape_for_config(
+        config, num_users=num_users, num_movies=num_movies, nnz=nnz,
+        implicit=implicit, gather_rows=gather_rows,
+    )
+    constraints = constraints_from_config(config)
+    mode = getattr(config, "plan", "model")
+    return plan(shape, device, constraints, mode=mode,
+                cache_path=cache_path)

@@ -104,6 +104,51 @@ class SingularChunk:
         return (target, m) if self.side == "u" else (u, target)
 
 
+@dataclasses.dataclass
+class BackendOutage:
+    """Force a kernel backend unavailable mid-run (ISSUE 9 plan_fallback).
+
+    At iteration ``iteration``: (a) mark ``backend`` unavailable in the
+    kernel registry — every mode resolver consults availability at trace
+    time, so the next step REBUILD resolves to the ``xla_emulation``
+    degradation floor — and (b) corrupt a few factor rows to NaN, the
+    observable symptom of a backend failing under the feet of an
+    already-compiled program.  The sentinel trips, the resilient loop
+    rolls back, sees the registry generation moved, rebuilds the step
+    (a plan transition at unchanged escalation overrides), and the replay
+    runs on the emulation backend — bit-exact factors, because the
+    gather/fused knob routes are bit-identical by contract.
+
+    The caller restores availability (``restore()`` or a try/finally);
+    the fault only breaks things.
+    """
+
+    iteration: int
+    backend: str = "mosaic_tpu"
+    num_rows: int = 4
+    seed: int = 0
+    fired: int = 0
+
+    def apply(self, i: int, u, m):
+        if i != self.iteration or self.fired:
+            return u, m
+        self.fired += 1
+        from cfk_tpu.plan.registry import REGISTRY
+
+        REGISTRY.force_unavailable(self.backend, True)
+        import jax.numpy as jnp
+
+        rows = np.random.default_rng(self.seed).choice(
+            u.shape[0], size=min(self.num_rows, u.shape[0]), replace=False,
+        )
+        return u.at[jnp.asarray(rows)].set(float("nan")), m
+
+    def restore(self) -> None:
+        from cfk_tpu.plan.registry import REGISTRY
+
+        REGISTRY.force_unavailable(self.backend, False)
+
+
 class FaultInjector:
     """The hook the resilient loop calls: a seeded plan of factor faults.
 

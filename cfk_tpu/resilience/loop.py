@@ -111,6 +111,7 @@ def resilient_train_loop(
     preemption_guard=None,
     watchdog=None,
     evict_sync_fn=None,
+    plan_provenance=None,
 ):
     """Run the stepped loop; returns the final ``(u, m)`` device factors.
 
@@ -157,11 +158,16 @@ def resilient_train_loop(
     if save_fn is None:
         def save_fn(done, u, m):
             hu, hm = np.asarray(u), np.asarray(m)
-            save_checkpoint(
-                manager, done, hu, hm,
-                meta={"rank": rank, "model": model,
-                      "num_shards": num_shards},
-            )
+            meta = {"rank": rank, "model": model,
+                    "num_shards": num_shards}
+            if plan_provenance is not None:
+                # Plan provenance rides every manifest (ISSUE 9): which
+                # plan trained these factors, why it was chosen, and any
+                # mid-run transitions (escalation rungs, backend
+                # outages) — re-read at transition time so later rungs
+                # appear in later manifests.
+                meta.update(plan_provenance.as_meta())
+            save_checkpoint(manager, done, hu, hm, meta=meta)
             return hu, hm
 
     if resume_fn is None:
@@ -203,7 +209,7 @@ def resilient_train_loop(
             fault_injector=fault_injector, snapshot_fn=snapshot_fn,
             restore_fn=restore_fn, save_fn=save_fn, state=state,
             init_fn=init_fn, guard=preemption_guard, watchdog=watchdog,
-            evict_sync_fn=evict_sync_fn,
+            evict_sync_fn=evict_sync_fn, plan_provenance=plan_provenance,
         )
     finally:
         if watchdog is not None:
@@ -218,9 +224,18 @@ def _run_loop_body(
     *, manager, num_iterations, start_iter, u, m, step, make_step,
     overrides, policy, health, probe, metrics, checkpoint_every,
     fault_injector, snapshot_fn, restore_fn, save_fn, state, init_fn,
-    guard=None, watchdog=None, evict_sync_fn=None,
+    guard=None, watchdog=None, evict_sync_fn=None, plan_provenance=None,
 ):
+    from cfk_tpu.plan import registry as _plan_registry
     from cfk_tpu.transport.checkpoint import should_save
+
+    # Kernel-backend availability generation the current step was BUILT
+    # under: if it moves (a backend forced unavailable mid-run — an
+    # outage, a chaos drill), the step must be rebuilt on rollback even at
+    # escalation rung 1 (plain retry), because a rebuild NOW resolves to
+    # different kernels — that rebuild is a plan transition, recorded with
+    # the same provenance vocabulary as an escalation rung.
+    registry_gen = _plan_registry.generation()
 
     # Last-good rollback anchor: (iteration, host snapshot).  Updated only
     # at validated save points, so a committed checkpoint and the anchor
@@ -339,17 +354,41 @@ def _run_loop_body(
             i, (u, m) = rollback()
             metrics.incr("rollbacks")
             new_overrides = policy.escalate(overrides, trips)
-            if new_overrides != overrides:
-                overrides = new_overrides
-                metrics.gauge("escalation_level", trips)
-                metrics.note(
-                    f"escalation_{trips}",
-                    f"lam={overrides.lam:g} fused="
-                    f"{overrides.fused_epilogue} "
-                    f"algo={overrides.reg_solve_algo}",
+            backend_moved = _plan_registry.generation() != registry_gen
+            escalated = new_overrides != overrides
+            if escalated or backend_moved:
+                detail = (
+                    f"lam={new_overrides.lam:g} fused="
+                    f"{new_overrides.fused_epilogue} "
+                    f"algo={new_overrides.reg_solve_algo}"
                 )
+                if backend_moved:
+                    detail += (
+                        "; " + _plan_registry.REGISTRY.availability_summary()
+                    )
+                overrides = new_overrides
+                if escalated:
+                    # escalation_* accounting means "a recovery rung
+                    # changed the numerics knobs" — a pure backend outage
+                    # reroutes kernels at UNCHANGED overrides and must
+                    # not read as a λ/GJ escalation on dashboards.
+                    metrics.gauge("escalation_level", trips)
+                    metrics.note(f"escalation_{trips}", detail)
+                # Every rung (and every backend-availability change) is a
+                # PLAN TRANSITION: recorded in the provenance object the
+                # checkpoint manifests and bench rows carry, so "why did
+                # iteration N run on different kernels/knobs" is always
+                # answerable from the artifacts.
+                metrics.note(f"plan_transition_{trips}", detail)
+                if plan_provenance is not None:
+                    plan_provenance.record_transition(
+                        "recovery_escalation" if escalated
+                        else "backend_outage",
+                        detail,
+                    )
                 if make_step is not None:
                     step = make_step(overrides)
+                    registry_gen = _plan_registry.generation()
                     if watchdog is not None:
                         # The rebuilt step re-traces on its next call —
                         # minutes of tickless compile that must not read

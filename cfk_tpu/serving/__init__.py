@@ -12,7 +12,12 @@ commits (``engine``); and an open-loop generator measures QPS/p50/p99
 honestly (``loadgen``; ``bench.py --serve`` for the recorded rows).
 """
 
-from cfk_tpu.serving.engine import ServeEngine, engine_from_model, pad_table
+from cfk_tpu.serving.engine import (
+    ServeEngine,
+    engine_from_model,
+    pad_table,
+    plan_for_serving,
+)
 from cfk_tpu.serving.loadgen import (
     LoadReport,
     run_open_loop,
@@ -34,6 +39,7 @@ from cfk_tpu.serving.topk_kernel import (
 __all__ = [
     "ServeEngine",
     "engine_from_model",
+    "plan_for_serving",
     "pad_table",
     "LoadReport",
     "run_open_loop",

@@ -69,9 +69,23 @@ class ServeEngine:
         tile_m: int = 512,
         batch_quantum: int = 8,
         mesh=None,
+        plan=None,  # cfk_tpu.plan.ExecutionPlan (serve knobs)
+        plan_provenance=None,
     ) -> None:
         from cfk_tpu.ops.quant import resolve_table_dtype
 
+        # Opt-in plan consumption (cfk_tpu.plan): when a plan is given its
+        # serve knobs (batch quantum, movie tile rows, and — unless passed
+        # explicitly — the table dtype) configure the engine, and the
+        # provenance rides along for the bench rows.  No plan → the
+        # pre-planner defaults, unchanged.
+        self.plan = plan
+        self.plan_provenance = plan_provenance
+        if plan is not None:
+            if table_dtype is None:
+                table_dtype = plan.table_dtype
+            batch_quantum = plan.serve_batch_quantum
+            tile_m = plan.serve_tile_m
         self.num_movies = int(num_movies)
         self.num_users = int(num_users)
         self.table_dtype = resolve_table_dtype(table_dtype)
@@ -272,10 +286,30 @@ def _topk_jit_fn():
     )
 
 
+def plan_for_serving(num_users: int, num_movies: int, rank: int, *,
+                     k_top: int = 100, table_dtype: str | None = None,
+                     mode: str = "model", cache_path: str | None = None):
+    """Resolve a serve-side ExecutionPlan: the batch quantum and table
+    dtype chosen from the table-scan byte model (``cost.serve_batch_cost_
+    for``), with an explicit ``table_dtype`` arriving as a pin.  Returns
+    ``(plan, provenance)`` — hand both to ``ServeEngine(plan=...)``."""
+    from cfk_tpu.plan import PlanConstraints, ProblemShape, plan
+
+    shape = ProblemShape(
+        num_users=num_users, num_movies=num_movies,
+        nnz=max(num_users, num_movies), rank=rank, kind="serve",
+        serve_k=k_top,
+    )
+    cons = PlanConstraints(table_dtype=table_dtype)
+    return plan(shape, None, cons, mode=mode, cache_path=cache_path)
+
+
 def engine_from_model(model, dataset=None, *, table_dtype=None, tile_m=512,
-                      mesh=None, batch_quantum=8) -> ServeEngine:
+                      mesh=None, batch_quantum=8, plan=None,
+                      plan_provenance=None) -> ServeEngine:
     """Build an engine from an ``ALSModel`` (+ optional dataset/index whose
-    ``coo_dense`` provides the exclude-seen lists)."""
+    ``coo_dense`` provides the exclude-seen lists).  ``plan`` (see
+    ``plan_for_serving``) optionally supplies the serve knobs."""
     seen_movies = seen_indptr = None
     if dataset is not None:
         coo = dataset.coo_dense
@@ -300,5 +334,6 @@ def engine_from_model(model, dataset=None, *, table_dtype=None, tile_m=512,
         num_users=model.num_users, num_movies=model.num_movies,
         seen_movies=seen_movies, seen_indptr=seen_indptr,
         table_dtype=table_dtype, tile_m=tile_m, mesh=mesh,
-        batch_quantum=batch_quantum,
+        batch_quantum=batch_quantum, plan=plan,
+        plan_provenance=plan_provenance,
     )

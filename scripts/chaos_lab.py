@@ -635,6 +635,67 @@ def scenario_quantized_table() -> dict:
                 ok_extra=metrics.gauges.get("escalation_level", 0) >= 4)
 
 
+def scenario_plan_fallback() -> dict:
+    """ISSUE 9: a plan whose preferred kernel backend goes away MID-RUN
+    degrades to the xla_emulation backend through the recovery ladder,
+    with BIT-EXACT factors and the transition in provenance.
+
+    The ``BackendOutage`` fault marks ``mosaic_tpu`` unavailable in the
+    kernel registry at iteration 2 and NaNs a few factor rows (the
+    symptom of kernels failing under a compiled program).  The sentinel
+    trips; the resilient loop rolls back and — seeing the registry
+    generation moved — rebuilds the step even at escalation rung 1, so
+    the replay traces through ``resolve_gather_mode``/``resolve_fused_
+    chunk_lam`` with mosaic down and lands on the emulation schedule.
+    Escalation overrides are UNCHANGED (λ untouched), and the gather/
+    fused knob routes are bit-identical by contract, so the recovered
+    factors must equal the fault-free run's crc32 exactly — a far
+    stronger check than RMSE parity.  The plan transition (reason
+    ``backend_outage``) must appear in the metrics notes AND in the
+    checkpoint-manifest provenance vocabulary."""
+    import dataclasses as _dc
+    import zlib
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.resilience.faults import BackendOutage, FaultInjector
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(60, 30, 900, seed=0), layout="tiled",
+        chunk_elems=512, tile_rows=16,
+    )
+    cfg = _dc.replace(_base_cfg(), layout="tiled", solver="pallas")
+
+    def crc(model):
+        return zlib.crc32(np.asarray(
+            model.user_factors, np.float32
+        ).tobytes())
+
+    # Fault-free reference THROUGH THE SAME stepped loop (a no-op
+    # injector), so loop structure cannot explain a crc difference.
+    base = _train(ds, cfg, fault_injector=FaultInjector())
+    base_rmse, base_crc = _rmse(base, ds), crc(base)
+    outage = BackendOutage(iteration=2)
+    metrics = Metrics()
+    try:
+        rec = _train(ds, cfg, metrics=metrics,
+                     fault_injector=FaultInjector(outage))
+    finally:
+        outage.restore()
+    rec_rmse, rec_crc = _rmse(rec, ds), crc(rec)
+    transition = any(
+        k.startswith("plan_transition") and "unavailable" in v
+        for k, v in metrics.notes.items()
+    )
+    row = _row("plan_fallback", fired=outage.fired, metrics=metrics,
+               base_rmse=base_rmse, rec_rmse=rec_rmse,
+               ok_extra=transition and rec_crc == base_crc)
+    row["bit_exact"] = bool(rec_crc == base_crc)
+    row["transition_recorded"] = bool(transition)
+    return row
+
+
 def scenario_serve_under_foldin() -> dict:
     """ISSUE 8: serving stays correct while streaming fold-in commits land
     concurrently.  A RecommendServer thread answers a continuous request
@@ -805,6 +866,7 @@ SCENARIOS = {
     "stream_poison_batch": scenario_stream_poison_batch,
     "quantized_table": scenario_quantized_table,
     "serve_under_foldin": scenario_serve_under_foldin,
+    "plan_fallback": scenario_plan_fallback,
 }
 
 

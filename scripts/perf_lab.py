@@ -183,6 +183,21 @@ def make_parser() -> argparse.ArgumentParser:
                    help="open-loop request count for --serve on")
     p.add_argument("--serve-tile-m", type=int, default=512,
                    help="movie-axis tile rows of the serve kernel")
+    p.add_argument("--plan", default=None,
+                   choices=[None, "model", "autotune", "pinned"],
+                   help="execution-planner axis (cfk_tpu.plan, ISSUE 9): "
+                   "'pinned' runs this lab's explicit --fused/--gather/"
+                   "--overlap/--reg-solve-algo/--table-dtype flags AS a "
+                   "pinned plan (today's behavior, with provenance "
+                   "recorded); 'model' FREES those knobs and runs the "
+                   "cost-model optimum; 'autotune' measures the model's "
+                   "top candidates on this lab's own step timing and "
+                   "caches the winner per (shape-class, device, version)."
+                   "  The row gains plan/plan_source/plan_est_s/"
+                   "plan_cache provenance columns either way")
+    p.add_argument("--plan-cache", default=None,
+                   help="autotune cache path for --plan autotune "
+                   "(default ~/.cache/cfk_tpu/plan_cache.json)")
     p.add_argument("--iters", type=int, default=3,
                    help="steps per timed call (fused per-call overhead "
                    "amortizes over these)")
@@ -353,6 +368,91 @@ def run_serve_lab(args) -> dict:
     return row
 
 
+def _resolve_plan_axis(args, make_steps, mblocks, ublocks, u0, m0):
+    """The --plan axis (ISSUE 9): resolve an ExecutionPlan for this lab's
+    shape and return (provenance, knobs-for-make_steps).
+
+    'pinned' records provenance for the lab's explicit flags and leaves
+    the knob threading EXACTLY as without the axis (bit-identical rows);
+    'model' threads the cost-model optimum's knobs concretely; 'autotune'
+    measures the model's top candidates with this lab's own steps timing
+    (1 timed call after a compile call, per candidate) and caches the
+    winner.  Layout/solver/chunk stay pinned to the flags in every mode —
+    they are physical properties of the already-built dataset."""
+    import functools
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from cfk_tpu.plan import (
+        DeviceSpec,
+        PlanConstraints,
+        ProblemShape,
+        plan as resolve_plan,
+    )
+
+    shape = ProblemShape(
+        num_users=args.users, num_movies=args.movies, nnz=args.nnz,
+        rank=args.rank, implicit=args.ials, dtype=args.dtype,
+        tile_rows=args.tile_rows if args.layout == "tiled" else 16,
+    )
+    pin = dict(
+        layout=args.layout,
+        solver=None if args.solver == "auto" else args.solver,
+        chunk_elems=args.chunk_elems,
+    )
+    if args.plan == "pinned":
+        pin.update(
+            table_dtype=args.table_dtype,
+            fused_epilogue=args.fused == "on",
+            in_kernel_gather=args.gather == "fused",
+            overlap=args.overlap == "on",
+            reg_solve_algo=(args.reg_solve_algo
+                            if args.reg_solve_algo else None),
+        )
+    cons = PlanConstraints(**pin)
+    device = DeviceSpec.detect()
+
+    def knobs_for(ep):
+        return dict(
+            overlap=ep.overlap, fused_epilogue=ep.fused_epilogue,
+            in_kernel_gather=ep.in_kernel_gather,
+            reg_solve_algo=ep.reg_solve_algo,
+            table_dtype=ep.table_dtype,
+        )
+
+    measure = None
+    if args.plan == "autotune":
+        def measure(ep):
+            steps = make_steps(knobs_for(ep))
+            bound = functools.partial(steps, mblk=mblocks, ublk=ublocks)
+            uu = jnp.array(u0, copy=True)
+            mm = jnp.array(m0, copy=True)
+            uu, mm = bound(uu, mm)  # compile + warmup
+            sync(uu)
+            t0 = _time.time()
+            uu, mm = bound(uu, mm)
+            sync(uu)
+            s = (_time.time() - t0) / args.iters
+            print(f"# autotune candidate {ep.summary()}: {s:.4f} s/iter",
+                  flush=True)
+            return s
+
+    ep, prov = resolve_plan(
+        shape, device, cons, mode=args.plan,
+        cache_path=args.plan_cache, measure=measure,
+    )
+    print(f"# plan: {prov.summary()}", flush=True)
+    if args.plan == "pinned":
+        # Provenance only — the knob threading stays the legacy deferred
+        # form, so the row is bit-identical to a --plan-less run.
+        return prov, dict(
+            overlap=None, fused_epilogue=None, in_kernel_gather=None,
+            reg_solve_algo=None, table_dtype=args.table_dtype,
+        )
+    return prov, knobs_for(ep)
+
+
 def run_lab(args) -> dict:
     """Measure and return the result row (also printed as the last JSON
     line — the scoreboard contract ``tests/test_perf_lab.py`` pins)."""
@@ -462,7 +562,13 @@ def run_lab(args) -> dict:
 
     import functools
 
-    def _iteration(u, m_prev, mblk, ublk):
+    # The lab's legacy knob threading: explicit flags pin table_dtype, the
+    # other knobs ride the patched process defaults (None = deferred).
+    base_knobs = dict(overlap=None, fused_epilogue=None,
+                      in_kernel_gather=None, reg_solve_algo=None,
+                      table_dtype=args.table_dtype)
+
+    def _iteration(u, m_prev, mblk, ublk, knobs):
         if args.ials:
             from cfk_tpu.models.ials import _ials_iteration_body
 
@@ -470,46 +576,56 @@ def run_lab(args) -> dict:
                 u, m_prev, mblk, ublk,
                 lam=0.05, alpha=args.alpha, dt=jax.numpy.dtype(dt),
                 solver=args.solver, algorithm="als", block_size=32,
-                sweeps=1, table_dtype=args.table_dtype, **layout_kw,
+                sweeps=1, **knobs, **layout_kw,
             )
         return als_mod._iteration_body(
             u, mblk, ublk,
             lam=0.05, solve_chunk=None, dt=jax.numpy.dtype(dt),
-            solver=args.solver, m_prev=m_prev,
-            table_dtype=args.table_dtype, **layout_kw,
+            solver=args.solver, m_prev=m_prev, **knobs, **layout_kw,
         )
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def steps(u, m, mblk, ublk):
-        # Blocks are jit ARGUMENTS, not closure captures — capturing them
-        # would bake 2.4 GB of constants into the executable and blow up
-        # compile time (exactly what the real trainers avoid).
-        def one(i, u, m_prev):
-            return _iteration(u, m_prev, mblk, ublk)
+    def make_steps(knobs):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def steps(u, m, mblk, ublk):
+            # Blocks are jit ARGUMENTS, not closure captures — capturing
+            # them would bake 2.4 GB of constants into the executable and
+            # blow up compile time (exactly what the real trainers avoid).
+            def one(i, u, m_prev):
+                return _iteration(u, m_prev, mblk, ublk, knobs)
 
-        if args.health == "off":
-            return jax.lax.fori_loop(
-                0, args.iters, lambda i, c: one(i, *c), (u, m)
+            if args.health == "off":
+                return jax.lax.fori_loop(
+                    0, args.iters, lambda i, c: one(i, *c), (u, m)
+                )
+
+            # Health on: the in-carry sentinel exactly as the fused
+            # trainer loops run it — probe every iteration, word rides
+            # the carry.
+            from cfk_tpu.resilience import sentinel
+
+            def probed(i, carry):
+                u, m_prev, hw = carry
+                u2, m2 = one(i, u, m_prev)
+                hw = sentinel.fold_probe(
+                    hw, i, u2, m2, every=1,
+                    norm_limit=args.health_norm_limit, total=args.iters,
+                )
+                return u2, m2, hw
+
+            u, m, _hw = jax.lax.fori_loop(
+                0, args.iters, probed, (u, m, sentinel.carry_init())
             )
+            return u, m
 
-        # Health on: the in-carry sentinel exactly as the fused trainer
-        # loops run it — probe every iteration, word rides the carry.
-        from cfk_tpu.resilience import sentinel
+        return steps
 
-        def probed(i, carry):
-            u, m_prev, hw = carry
-            u2, m2 = one(i, u, m_prev)
-            hw = sentinel.fold_probe(
-                hw, i, u2, m2, every=1,
-                norm_limit=args.health_norm_limit, total=args.iters,
-            )
-            return u2, m2, hw
-
-        u, m, _hw = jax.lax.fori_loop(
-            0, args.iters, probed, (u, m, sentinel.carry_init())
+    plan_prov = None
+    if args.plan:
+        plan_prov, base_knobs = _resolve_plan_axis(
+            args, make_steps, mblocks, ublocks, u0, m0,
         )
-        return u, m
 
+    steps = make_steps(base_knobs)
     steps_bound = functools.partial(steps, mblk=mblocks, ublk=ublocks)
 
     ckpt_mgr = None
@@ -527,7 +643,7 @@ def run_lab(args) -> dict:
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def one_step(u, m, mblk, ublk):
-            return _iteration(u, m, mblk, ublk)
+            return _iteration(u, m, mblk, ublk, base_knobs)
 
         one_bound = functools.partial(one_step, mblk=mblocks, ublk=ublocks)
         ckpt_dir = tempfile.mkdtemp(prefix="cfk_perf_ckpt_")
@@ -580,10 +696,13 @@ def run_lab(args) -> dict:
         from cfk_tpu.utils.roofline import bucketed_gather_rows
 
         gather_rows = bucketed_gather_rows(ds.movie_blocks, ds.user_blocks)
+    # Under --plan model/autotune the EXECUTED table dtype is the plan's
+    # choice, and the roofline row must charge what actually ran.
+    eff_table_dtype = base_knobs["table_dtype"] or "float32"
     cost = als_iteration_cost(
         args.nnz, args.users, args.movies, args.rank,
         factor_bytes=2 if dt == "bfloat16" else 4,
-        table_dtype=args.table_dtype, gather_rows=gather_rows,
+        table_dtype=eff_table_dtype, gather_rows=gather_rows,
     )
     best = min(per_iter)
     from cfk_tpu.utils.roofline import roofline_row
@@ -591,7 +710,7 @@ def run_lab(args) -> dict:
     row = {
         "s_per_iter_min": round(best, 4),
         "s_per_iter_median": round(sorted(per_iter)[len(per_iter) // 2], 4),
-        **roofline_row(cost, best, table_dtype=args.table_dtype),
+        **roofline_row(cost, best, table_dtype=eff_table_dtype),
         "layout": args.layout, "solver": args.solver,
         "chunk_elems": args.chunk_elems, "dtype": dt,
         "gram_backend": args.gram_backend, "rank": args.rank,
@@ -599,6 +718,9 @@ def run_lab(args) -> dict:
         "fused": args.fused, "health": args.health,
         "gather": args.gather, "ckpt": args.ckpt,
     }
+    if plan_prov is not None:
+        row["plan_axis"] = args.plan
+        row.update(plan_prov.as_row())
     if ckpt_mgr is not None:
         import shutil
 

@@ -30,6 +30,7 @@ def _args(**over):
         foldin="off", foldin_updates=4096, foldin_batch_records=256,
         serve="off", serve_batch=64, serve_k=10, serve_requests=512,
         serve_tile_m=512,
+        plan=None, plan_cache=None,
         iters=2, repeats=3, profile_dir=None,
     )
     base.update(over)
@@ -171,6 +172,50 @@ def test_ckpt_axis_row(tmp_path, monkeypatch):
     # steps are ~ms while fsync dominates, so back-pressure makes the two
     # writers near-equal and noise flips the sign — the measured win lives
     # in bench.py --ckpt-ab at a real shape, where compute hides the disk.
+
+
+def test_plan_axis_row(tmp_path, monkeypatch, capsys):
+    # the execution-planner axis (ISSUE 9): the tier-1 smoke of the whole
+    # resolve→thread-knobs→measure→provenance loop, mirroring
+    # test_serve_axis_row's role for serving.  'model' resolves the free
+    # knobs through the cost model and the row carries the provenance
+    # columns; 'autotune' measures candidates with the lab's own step
+    # timing and caches the winner (second run must hit).
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    cache = str(tmp_path / "plan_cache.json")
+    row = perf_lab.run_lab(_args(
+        plan="model", layout="tiled", chunk_elems=512, tile_rows=16,
+    ))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == row  # scoreboard contract holds here too
+    assert row["plan_axis"] == "model"
+    assert row["plan_source"] in ("model", "pinned")
+    assert row["plan_est_s"] >= 0
+    assert "plan" in row and "table=" in row["plan"]
+    # the roofline column charges the EXECUTED dtype, i.e. the plan's
+    assert row["table_dtype"] in ("float32", "bfloat16", "int8")
+
+    miss = perf_lab.run_lab(_args(
+        plan="autotune", plan_cache=cache, layout="tiled",
+        chunk_elems=512, tile_rows=16, repeats=2,
+    ))
+    assert miss["plan_cache"] == "miss"
+    assert miss["plan_source"] == "autotune"
+    assert miss["plan_measured_s"] > 0
+    hit = perf_lab.run_lab(_args(
+        plan="autotune", plan_cache=cache, layout="tiled",
+        chunk_elems=512, tile_rows=16, repeats=2,
+    ))
+    assert hit["plan_cache"] == "hit"
+    assert hit["plan_source"] == "autotune-cache"
+    # the cached winner is the measured one
+    assert hit["plan"] == miss["plan"]
+
+    pinned = perf_lab.run_lab(_args(
+        plan="pinned", layout="tiled", chunk_elems=512, tile_rows=16,
+    ))
+    assert pinned["plan_source"] == "pinned"
+    assert pinned["table_dtype"] == "float32"  # legacy threading kept
 
 
 def test_serve_axis_row(tmp_path, monkeypatch, capsys):
