@@ -58,7 +58,8 @@ def _compact_row(row: dict) -> dict:
             "exchange_s_per_iter", "compute_s_per_iter",
             "factors_bit_exact", "removed_bytes_per_chunk",
             "save_stall_removed_s_per_save", "foldin_rmse_over_retrain",
-            "p50_ms", "p99_ms", "vs_roofline", "best_batch")
+            "p50_ms", "p99_ms", "vs_roofline", "best_batch",
+            "tiers", "crossed_to_host_window")
     return {k: row[k] for k in keep if k in row}
 
 
@@ -174,6 +175,16 @@ def main() -> None:
             pa = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# plan_ab: " + json.dumps(pa))
         rows["plan_ab"] = pa
+    # Out-of-core scale sweep (ISSUE 11): resident->host_window tier
+    # crossing under an artificial budget, memory math per point.
+    # CFK_BENCH_SCALE_SWEEP=0 skips it.
+    if os.environ.get("CFK_BENCH_SCALE_SWEEP", "1") != "0":
+        try:
+            sw = _scale_sweep_row()
+        except Exception as e:  # pragma: no cover - device-dependent
+            sw = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# scale_sweep: " + json.dumps(sw))
+        rows["scale_sweep"] = sw
     # Quantized-gather-table A/B: RMSE per table dtype on the planted
     # split + the analytic bytes removed.  CFK_BENCH_QUANT=0 skips it.
     if os.environ.get("CFK_BENCH_QUANT", "1") != "0":
@@ -788,6 +799,185 @@ def run_scale(args) -> dict:
         "blockbuild_wall_s": round(build_s, 3),
         **quality,
     }
+
+
+def scale_sweep_main(args) -> None:
+    print(json.dumps(run_scale_sweep(args)))
+
+
+def run_scale_sweep(args) -> dict:
+    """``--scale-sweep`` (ISSUE 11): s/iter and ratings/sec/chip vs problem
+    size across the resident→windowed offload tiers.
+
+    Each point generates a counter-based power-law corpus
+    (``cfk_tpu.data.synth`` — chunk/shard-invariant, so the same spec is
+    reproducible at any scale), builds stream-mode tiled blocks, resolves
+    the execution plan against a device whose HBM budget is
+    ``--sweep-budget-mb`` (default: the detected device), and trains
+    through whichever tier the planner picked — ``device`` (resident
+    tables, the plain trainer) or ``host_window`` (host stores + windowed
+    staging, ``cfk_tpu.offload``).  Every row records the memory-budget
+    math the decision was made from: resident-working-set bytes vs the
+    device budget, and the staged-window bytes vs the per-window budget.
+    The planner — not the sweep — decides the tier, so the sweep doubles
+    as the acceptance check that oversized shapes resolve to host_window
+    with provenance instead of OOMing.
+    """
+    import dataclasses as _dc
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synth import PowerLawSynth, SynthSpec
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.offload import budget as _budget
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.plan import DeviceSpec, constraints_from_config, plan
+    from cfk_tpu.plan.resolver import shape_for_config
+    from cfk_tpu.utils.metrics import Metrics
+
+    device = DeviceSpec.detect()
+    if args.sweep_budget_mb is not None:
+        device = _dc.replace(device, hbm_bytes=args.sweep_budget_mb * 1e6)
+    scales = [float(s) for s in str(args.sweep_scales).split(",") if s]
+    rows = []
+    for sc in scales:
+        users = max(int(args.users * sc), 16)
+        movies = max(int(args.movies * sc), 8)
+        nnz = max(int(args.nnz * sc), 64)
+        t0 = time.time()
+        coo = PowerLawSynth(
+            SynthSpec(num_users=users, num_movies=movies, nnz=nnz,
+                      seed=args.seed)
+        ).coo()
+        gen_s = time.time() - t0
+        t0 = time.time()
+        ds = Dataset.from_coo(
+            coo, layout="tiled", chunk_elems=args.chunk_elems,
+            tile_rows=args.sweep_tile_rows, accum_max_entities=0,
+        )
+        build_s = time.time() - t0
+        config = ALSConfig(
+            rank=args.rank, lam=args.lam,
+            num_iterations=args.iterations, seed=0, layout="tiled",
+            dtype=args.dtype, hbm_chunk_elems=args.chunk_elems,
+        )
+        shape = shape_for_config(
+            config, num_users=ds.user_map.num_entities,
+            num_movies=ds.movie_map.num_entities, nnz=nnz,
+        )
+        ep, prov = plan(shape, device, constraints_from_config(config))
+        tier = ep.offload_tier
+        # The budget math is recorded from the SAME counts the planner
+        # decided on (the dataset's dense entity universe), so the row's
+        # fits_device can never disagree with the recorded tier.
+        resident = _budget.train_resident_bytes(
+            ds.user_map.num_entities, ds.movie_map.num_entities, nnz,
+            args.rank, dtype=args.dtype,
+        )
+        # Pin the SWEEP's decision into the config: the device-tier arm
+        # must not silently re-resolve against the real detected device
+        # (an artificial --sweep-budget-mb would otherwise let train_als
+        # route differently than the row's tier label claims).
+        config = _dc.replace(config, offload_tier=tier)
+        metrics = Metrics()
+
+        def timed(cfg):
+            t0 = time.time()
+            if tier == "host_window":
+                model = train_als_host_window(
+                    ds, cfg, metrics=metrics,
+                    chunks_per_window=args.sweep_window_chunks,
+                    device_budget_bytes=device.hbm_bytes,
+                )
+                np.asarray(model.user_factors[:1])
+            else:
+                model = train_als(ds, cfg)
+                sync(model.user_factors)
+            return time.time() - t0, model
+
+        # Same two-point (1 vs N iterations) fit as run_scale: the fixed
+        # upload/plan cost cancels exactly.
+        n1 = config.num_iterations
+        config1 = _dc.replace(config, num_iterations=1)
+        timed(config)  # compile both programs
+        timed(config1)
+        t_n, t_1 = [], []
+        for _ in range(args.repeats):
+            t_1.append(timed(config1)[0])
+            t_n.append(timed(config)[0])
+        train_s, short_s = min(t_n), min(t_1)
+        steady_s = (train_s - short_s) / (n1 - 1) * n1 if n1 > 1 else train_s
+        if steady_s <= 0:
+            steady_s = train_s
+        s_per_iter = steady_s / n1
+        row = {
+            "scale": sc,
+            "users": users, "movies": movies, "ratings": nnz,
+            "rank": args.rank, "dtype": args.dtype,
+            "offload_tier": tier,
+            "s_per_iteration": round(s_per_iter, 4),
+            "ratings_per_sec_per_chip": int(
+                nnz * 2 * n1 / max(steady_s, 1e-9)
+            ),
+            # The memory-budget math the tier decision was made from —
+            # recorded so BASELINE.md's table is reproducible arithmetic,
+            # not an assertion.
+            "resident_bytes_mb": round(resident["total"] / 1e6, 2),
+            "factor_tables_mb": round(
+                resident["factor_tables_bytes"] / 1e6, 2
+            ),
+            "block_arrays_mb": round(
+                resident["block_arrays_bytes"] / 1e6, 2
+            ),
+            "device_budget_mb": round(device.hbm_bytes / 1e6, 2),
+            "budget_fraction": _budget.RESIDENT_FRACTION,
+            # THE predicate, not an inline copy — the row's fits_device
+            # must stay the planner's own arithmetic.
+            "fits_device": _budget.fits_device(
+                ds.user_map.num_entities, ds.movie_map.num_entities,
+                nnz, args.rank, hbm_bytes=device.hbm_bytes,
+                dtype=args.dtype,
+            ),
+            "datagen_wall_s": round(gen_s, 3),
+            "blockbuild_wall_s": round(build_s, 3),
+            "train_wall_s": round(train_s, 3),
+            **prov.as_row(),
+        }
+        if tier == "host_window":
+            row.update({
+                "windows_m": metrics.gauges.get("offload_windows_m"),
+                "windows_u": metrics.gauges.get("offload_windows_u"),
+                "window_rows_m": metrics.gauges.get("offload_window_rows_m"),
+                "window_rows_u": metrics.gauges.get("offload_window_rows_u"),
+                "staged_mb_per_run": metrics.gauges.get("offload_staged_mb"),
+                "per_window_budget_mb": round(
+                    _budget.window_budget_bytes(device.hbm_bytes) / 1e6, 2
+                ),
+            })
+        print("# sweep point: " + json.dumps(row), flush=True)
+        rows.append(row)
+    tiers = [r["offload_tier"] for r in rows]
+    return {
+        "metric": "scale_sweep_s_per_iteration",
+        "points": rows,
+        "tiers": tiers,
+        "crossed_to_host_window": "host_window" in tiers,
+    }
+
+
+def _scale_sweep_row() -> dict:
+    """The default-main scale-sweep row: tiny shapes under an artificial
+    2 MB device budget so the largest point CROSSES into the
+    host_window tier on this CPU container (the real budgets are the
+    on-TPU run's job; the tier-resolution machinery is what this row
+    exercises)."""
+    ns = argparse.Namespace(
+        users=3_000, movies=300, nnz=60_000, rank=16, iterations=2,
+        repeats=2, seed=0, dtype="float32", lam=0.05, chunk_elems=4_096,
+        sweep_scales="0.25,1.0", sweep_budget_mb=2.0, sweep_tile_rows=16,
+        sweep_window_chunks=2,
+    )
+    return run_scale_sweep(ns)
 
 
 def _virtual_cpu_mesh(shards: int):
@@ -2239,6 +2429,24 @@ if __name__ == "__main__":
                         "rows run the sharded merge on a virtual mesh)")
     parser.add_argument("--serve-requests", type=int, default=256,
                         help="open-loop requests per row")
+    parser.add_argument("--scale-sweep", action="store_true",
+                        help="out-of-core scale sweep (ISSUE 11): s/iter "
+                        "and ratings/sec/chip vs problem size across the "
+                        "resident->windowed offload tiers, with the "
+                        "memory-budget math per row; the planner picks "
+                        "the tier per point")
+    parser.add_argument("--sweep-scales", default="0.5,1.0,2.0",
+                        help="comma list of multipliers applied to "
+                        "--users/--movies/--nnz per sweep point")
+    parser.add_argument("--sweep-budget-mb", type=float, default=None,
+                        help="artificial device HBM budget (MB) the tier "
+                        "resolution runs against; default = the detected "
+                        "device's real budget")
+    parser.add_argument("--sweep-tile-rows", type=int, default=128,
+                        help="tile rows of the sweep's stream-tiled blocks")
+    parser.add_argument("--sweep-window-chunks", type=int, default=4,
+                        help="chunks per staged window on the host_window "
+                        "tier")
     parser.add_argument("--plan-ab", action="store_true",
                         help="execution-planner A/B (ISSUE 9): the "
                         "resolver's serve plan (free table dtype + batch "
@@ -2247,7 +2455,9 @@ if __name__ == "__main__":
                         "request-slot, provenance in the row")
     cli_args = parser.parse_args()
     run = (
-        (lambda: plan_ab_main(cli_args))
+        (lambda: scale_sweep_main(cli_args))
+        if cli_args.scale_sweep
+        else (lambda: plan_ab_main(cli_args))
         if cli_args.plan_ab
         else (lambda: serve_main(cli_args))
         if cli_args.serve

@@ -303,6 +303,14 @@ def _train(args) -> int:
         # blocks and has no per-shard accumulator cap — the safe default
         # (pass --layout tiled explicitly for the tiled ring).
         args.layout = "padded"
+    if args.layout == "auto" and args.exchange == "hier_ring":
+        # The hierarchical exchange runs on the tiled ring blocks only.
+        args.layout = "tiled"
+    if args.layout == "auto" and args.offload_tier == "host_window":
+        # The windowed host-offload driver streams the tiled stream-mode
+        # layout; resolve up front so config validation never refuses a
+        # flag combination the parser accepted.
+        args.layout = "tiled"
 
     def _resolver(coo):
         return _resolve_auto_layout(coo, args.algorithm, args.solve_chunk)
@@ -314,7 +322,7 @@ def _train(args) -> int:
             cache_dir=args.dataset_cache,
             ring=(
                 (args.exchange if args.exchange == "auto"
-                 else args.exchange == "ring")
+                 else args.exchange in ("ring", "hier_ring"))
                 if args.layout == "tiled" else False
             ),
             auto_resolver=_resolver,
@@ -333,7 +341,7 @@ def _train(args) -> int:
             # an explicit --exchange ring build carries the accum
             # machinery on both halves — the flag has no half to apply to
             # there, so don't request it (avoids the builder's warning).
-            dense_stream=args.exchange != "ring",
+            dense_stream=args.exchange not in ("ring", "hier_ring"),
         )
     if args.layout == "auto":
         # Reflect what _resolve_auto_layout (or a cache hit) actually built,
@@ -355,6 +363,8 @@ def _train(args) -> int:
         seed=args.seed,
         num_shards=args.shards,
         exchange=args.exchange,
+        ici_group=args.ici_group,
+        offload_tier=args.offload_tier,
         overlap=not args.no_overlap,
         in_kernel_gather=(
             None if args.in_kernel_gather == "auto"
@@ -1163,6 +1173,8 @@ def _plan_cmd(args) -> int:
                         else args.reg_solve_algo),
         solver=None if args.solver == "auto" else args.solver,
         chunk_elems=args.chunk_elems,
+        offload_tier=(None if args.offload_tier == "auto"
+                      else args.offload_tier),
     )
     if args.device == "auto":
         device = DeviceSpec.detect()
@@ -1269,7 +1281,8 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--iterations", type=int, default=7)
     t.add_argument("--seed", type=int, default=42)
     t.add_argument("--shards", type=int, default=1)
-    t.add_argument("--exchange", choices=["all_gather", "ring", "auto"],
+    t.add_argument("--exchange",
+                   choices=["all_gather", "ring", "hier_ring", "auto"],
                    default="all_gather",
                    help="fixed-factor exchange; 'auto' (tiled layout) picks "
                    "per half: ring where the Gram accumulator fits, "
@@ -1347,6 +1360,23 @@ def build_parser() -> argparse.ArgumentParser:
         "bucketed/segment/tiled consume it at dataset build time "
         "(ratings per scan chunk); padded derives entities per solve "
         "chunk from it at run time",
+    )
+    t.add_argument(
+        "--offload-tier", choices=["auto", "device", "host_window"],
+        default="auto",
+        help="where the factor tables live (ISSUE 11): 'auto' lets the "
+        "planner's memory-budget predicate decide (resident while they "
+        "fit — today's behavior); 'device' pins resident tables (refused "
+        "up front when they cannot fit); 'host_window' pins the "
+        "out-of-core path — host-RAM factor stores with device_put-"
+        "pipelined windows (explicit ALS, tiled layout, bit-exact vs the "
+        "resident path)",
+    )
+    t.add_argument(
+        "--ici-group", type=int, default=None, metavar="I",
+        help="inner-ring size of --exchange hier_ring (devices per ICI "
+        "domain); default: local device count when it divides --shards, "
+        "else one flat ring",
     )
     t.add_argument(
         "--health-check-every", type=int, default=None, metavar="N",
@@ -1632,7 +1662,7 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["auto", "padded", "bucketed", "segment",
                              "tiled"])
     pl.add_argument("--exchange", default="auto",
-                    choices=["auto", "all_gather", "ring"])
+                    choices=["auto", "all_gather", "ring", "hier_ring"])
     pl.add_argument("--table-dtype", default="auto",
                     choices=["auto", "float32", "bfloat16", "int8"])
     pl.add_argument("--fused", default="auto",
@@ -1646,6 +1676,12 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--solver", default="auto",
                     choices=["auto", "cholesky", "pallas"])
     pl.add_argument("--chunk-elems", type=int, default=None)
+    pl.add_argument("--offload-tier", default="auto",
+                    choices=["auto", "device", "host_window"],
+                    help="out-of-core tier pin (ISSUE 11): 'auto' lets "
+                    "the memory-budget predicate decide; 'device' REFUSES "
+                    "when the resident tables cannot fit; 'host_window' "
+                    "pins the windowed host-offload path")
     pl.add_argument("--device", default="auto",
                     choices=["auto", "v5e", "cpu"],
                     help="'auto' detects the current jax backend; 'v5e' "

@@ -126,6 +126,16 @@ class ALSConfig:
     #                  per-entity ring accumulator could not fit (many solve
     #                  entities), which is exactly when all_gather is
     #                  strictly better there.
+    #   "hier_ring"  — hierarchical ICI-ring-within-DCN-ring (tiled ring
+    #                  datasets only, ISSUE 11): shards group into inner
+    #                  rings of ``ici_group`` devices that rotate blocks
+    #                  over the fast fabric, with ONE outer hop across the
+    #                  slow fabric per phase — O·(I−1) ICI transfers and
+    #                  O−1 DCN hops instead of a flat ring whose boundary
+    #                  edges pay DCN every step.  Same blocks, same
+    #                  accumulator structure as "ring"; with one inner
+    #                  ring (ici_group == num_shards) the schedule — and
+    #                  the factors — are bit-identical to "ring".
     #   "auto"       — per-HALF memory optimum (tiled layout only): ring on
     #                  the half whose fixed table is big and solve entities
     #                  few (movies at Netflix shape: rotate 480k-user blocks
@@ -133,7 +143,13 @@ class ALSConfig:
     #                  other (its ring accumulator would dwarf the table it
     #                  saves).  Build the dataset with Dataset.from_coo(...,
     #                  ring="auto").
-    exchange: Literal["all_gather", "ring", "auto"] = "all_gather"
+    exchange: Literal["all_gather", "ring", "hier_ring", "auto"] = (
+        "all_gather"
+    )
+    # Inner-ring size of the hierarchical exchange: devices per ICI
+    # domain.  None = auto (jax.local_device_count() when it divides
+    # num_shards, else one flat ring).  Must divide num_shards.
+    ici_group: int | None = None
     # Communication/compute overlap — the default execution mode for every
     # ring-layout half-iteration and chunk-streaming body: ring steps are
     # double-buffered (the next block's ppermute is issued before the
@@ -308,6 +324,21 @@ class ALSConfig:
     #                with cache=miss provenance when cold.  Trainers never
     #                measure inline.
     plan: Literal["model", "pinned", "autotune"] = "model"
+    # --- out-of-core factor tables (cfk_tpu.offload, ISSUE 11) ----------
+    # Where the factor tables live during training:
+    #   "auto"        — the planner decides via the memory-budget predicate
+    #                   (cfk_tpu.offload.budget): resident while both
+    #                   tables + blocks fit the device budget (today's
+    #                   behavior, bit-identical), host_window past it.
+    #   "device"      — pin HBM-resident tables; the planner REFUSES
+    #                   (PlanConstraintError) when the budget predicate
+    #                   says they cannot fit, instead of promising an OOM.
+    #   "host_window" — pin the out-of-core path: host-RAM factor stores
+    #                   with device_put-pipelined windows
+    #                   (offload.windowed.train_als_host_window — explicit
+    #                   ALS, tiled layout, single process; bit-exact vs
+    #                   the resident path).
+    offload_tier: Literal["auto", "device", "host_window"] = "auto"
 
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "als++")
@@ -379,8 +410,49 @@ class ALSConfig:
             raise ValueError(f"num_shards must be >= 1, got {self.num_shards}")
         if self.lam < 0:
             raise ValueError(f"lam must be >= 0, got {self.lam}")
-        if self.exchange not in ("all_gather", "ring", "auto"):
+        if self.exchange not in ("all_gather", "ring", "hier_ring", "auto"):
             raise ValueError(f"unknown exchange {self.exchange!r}")
+        if self.exchange == "hier_ring" and self.layout != "tiled":
+            raise ValueError(
+                f"exchange='hier_ring' is implemented for layout='tiled' "
+                f"(the ring-built tiled blocks); layout={self.layout!r}"
+            )
+        if self.ici_group is not None:
+            if self.ici_group < 1:
+                raise ValueError(
+                    f"ici_group must be >= 1 (devices per inner ring), "
+                    f"got {self.ici_group}"
+                )
+            if self.num_shards % self.ici_group != 0:
+                raise ValueError(
+                    f"ici_group={self.ici_group} must divide "
+                    f"num_shards={self.num_shards} (the outer ring walks "
+                    "whole inner rings)"
+                )
+        if self.offload_tier not in ("auto", "device", "host_window"):
+            raise ValueError(
+                f"offload_tier must be 'auto', 'device' or 'host_window', "
+                f"got {self.offload_tier!r}"
+            )
+        if self.offload_tier == "host_window":
+            if self.layout != "tiled":
+                raise ValueError(
+                    f"offload_tier='host_window' streams the tiled "
+                    f"stream-mode layout; layout={self.layout!r}"
+                )
+            if self.algorithm != "als":
+                raise ValueError(
+                    "offload_tier='host_window' supports the explicit ALS "
+                    f"optimizer; algorithm={self.algorithm!r} (the "
+                    "subspace/iALS global-Gram reductions are the "
+                    "documented follow-up)"
+                )
+            if self.num_shards != 1:
+                raise ValueError(
+                    "offload_tier='host_window' is a single-process "
+                    f"driver (num_shards={self.num_shards}); pair the "
+                    "multi-chip regime with exchange='hier_ring' (ROADMAP)"
+                )
         if self.solver not in ("auto", "cholesky", "pallas"):
             raise ValueError(f"unknown solver {self.solver!r}")
         if self.layout not in ("padded", "bucketed", "segment", "tiled"):

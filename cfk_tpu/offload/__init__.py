@@ -1,0 +1,32 @@
+"""Out-of-core factor tables (ROADMAP item 3 / ISSUE 11).
+
+Host-RAM-resident sharded factor stores with ``device_put``-pipelined
+windows: the fixed side of each half-iteration streams through the device
+one window at a time while the current window's Gram+solve runs, bit-exact
+vs the resident path.  ``budget`` is the memory predicate shared with the
+execution planner (``plan.resolver`` resolves oversized problems to the
+``host_window`` tier through it); ``parallel.spmd.
+half_step_tiled_ring_hier`` is the matching hierarchical ICI×DCN exchange.
+See ARCHITECTURE.md "Out-of-core factor tables".
+"""
+
+from cfk_tpu.offload.store import HostFactorStore
+from cfk_tpu.offload.window import WindowPlan, build_window_plan
+
+__all__ = [
+    "HostFactorStore",
+    "WindowPlan",
+    "build_window_plan",
+    "train_als_host_window",
+    "windowed_half_step",
+]
+
+
+def __getattr__(name):
+    # windowed imports jax; keep the package importable without it (the
+    # budget predicate is consumed by the jax-free plan layer).
+    if name in ("train_als_host_window", "windowed_half_step"):
+        from cfk_tpu.offload import windowed
+
+        return getattr(windowed, name)
+    raise AttributeError(name)

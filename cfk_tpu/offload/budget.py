@@ -1,0 +1,107 @@
+"""The memory-budget predicate shared by the planner and the executor.
+
+ISSUE 11's contract: a plan can never promise a resident factor table that
+does not fit — so the predicate that decides whether the ``device`` tier is
+feasible (``cfk_tpu.plan.resolver``) must be the SAME arithmetic the
+offload executor sizes its windows with (``cfk_tpu.offload.windowed``).
+Both import it from here.  Deliberately importable without jax (like
+``config.py`` / ``plan/spec.py``): the plan CLI prices billion-interaction
+shapes on machines that could never hold them.
+
+What counts as resident for one training iteration (the ``device`` tier):
+
+- both factor tables at the storage dtype (master + the solve-side output
+  alive concurrently — the half-steps read one side while writing the
+  other, and the gather paths keep a zero-row-appended working copy of the
+  fixed side, charged as one extra fixed-table copy at the table dtype);
+- the block arrays: per rating per side, neighbor index + rating +
+  weight/meta (int32/f32 each), inflated by the tiled layout's measured
+  tile-padding share;
+- the transient chunk working set is bounded by ``chunk_elems`` and small
+  next to the above — it rides the headroom fraction.
+
+``RESIDENT_FRACTION`` leaves headroom for accumulators, carries, and the
+runtime; the same fraction gates planning and execution so they cannot
+disagree at the boundary.
+"""
+
+from __future__ import annotations
+
+RESIDENT_FRACTION = 0.9
+# Staged window double-buffer: two windows (current + prefetched) are alive
+# at once, so the per-window budget is half the staging share.
+WINDOW_BUFFERS = 2
+# Tiled stream padding share (the measured tile-padding factor at the full
+# Netflix build — cfk_tpu/plan/cost.py's _GATHER_PAD_FACTOR["tiled"]).
+_TILE_PAD = 1.26
+# Bytes per rating per side in the stream blocks: neighbor idx (4) +
+# rating (4) + weight (4).
+_BLOCK_BYTES_PER_CELL = 12.0
+
+
+def dtype_bytes(name: str | None) -> int:
+    """Itemsize of a factor-storage / table dtype name (None → float32)."""
+    return {None: 4, "float32": 4, "bfloat16": 2, "int8": 1}[name]
+
+
+def factor_table_bytes(entities: int, rank: int,
+                       dtype: str | None = "float32") -> float:
+    return float(entities) * rank * dtype_bytes(dtype)
+
+
+def train_resident_bytes(num_users: int, num_movies: int, nnz: int,
+                         rank: int, *, dtype: str = "float32",
+                         table_dtype: str | None = None) -> dict:
+    """Per-term resident bytes of one device-tier training iteration.
+
+    Returns the breakdown dict (the scale lab records it per row); the
+    ``total`` key is what ``fits_device`` compares against the budget."""
+    tables = factor_table_bytes(num_users + num_movies, rank, dtype)
+    # The gather working copy of the fixed side (zero-row append / quantized
+    # view); charge the LARGER side at the effective gather cell size.
+    gather_copy = factor_table_bytes(
+        max(num_users, num_movies), rank,
+        table_dtype if table_dtype is not None else dtype,
+    )
+    blocks = 2.0 * nnz * _BLOCK_BYTES_PER_CELL * _TILE_PAD
+    total = tables + gather_copy + blocks
+    return {
+        "factor_tables_bytes": tables,
+        "gather_copy_bytes": gather_copy,
+        "block_arrays_bytes": blocks,
+        "total": total,
+    }
+
+
+def fits_device(num_users: int, num_movies: int, nnz: int, rank: int, *,
+                hbm_bytes: float, dtype: str = "float32",
+                table_dtype: str | None = None) -> bool:
+    """THE device-tier feasibility predicate (planner AND executor)."""
+    return (
+        train_resident_bytes(
+            num_users, num_movies, nnz, rank,
+            dtype=dtype, table_dtype=table_dtype,
+        )["total"]
+        <= hbm_bytes * RESIDENT_FRACTION
+    )
+
+
+def shape_fits_device(shape, device, table_dtype: str | None = None) -> bool:
+    """``fits_device`` over a ``plan.ProblemShape`` + ``plan.DeviceSpec``
+    (serve shapes are table-resident by construction and not gated here).
+    ``table_dtype`` is the resolve's PINNED gather-table dtype when one
+    exists — quantization shrinks the gather working copy, which is
+    exactly the memory lever, so the predicate must charge it."""
+    if getattr(shape, "kind", "train") != "train":
+        return True
+    return fits_device(
+        shape.num_users, shape.num_movies, shape.nnz, shape.rank,
+        hbm_bytes=device.hbm_bytes, dtype=shape.dtype,
+        table_dtype=table_dtype,
+    )
+
+
+def window_budget_bytes(hbm_bytes: float) -> float:
+    """Per-window staging budget under the double buffer: the headroom
+    fraction of the device, split across the two live windows."""
+    return hbm_bytes * RESIDENT_FRACTION / WINDOW_BUFFERS

@@ -1,0 +1,159 @@
+"""Host-RAM factor tables, sharded by entity range.
+
+The out-of-core tier's ground truth: the full factor matrix lives in host
+memory as contiguous entity-range shards (the ALX placement — each shard
+is what one host would own; a future multi-host driver maps shards to
+processes, a single-process run simply holds them all).  The device only
+ever sees gathered WINDOWS of it (``cfk_tpu.offload.windowed``), and the
+solved rows stream back per window.
+
+Rows are stored at the staging dtype: the storage dtype of the master
+factors (float32, or bfloat16 via ``ml_dtypes`` — the same
+round-to-nearest-even cast XLA performs, so a windowed run's staged rows
+are bit-identical to the resident run's cast table).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _np_dtype(name: str):
+    if name in ("float32", None):
+        return np.dtype(np.float32)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    raise ValueError(
+        f"HostFactorStore stores master factors as 'float32' or "
+        f"'bfloat16', got {name!r}"
+    )
+
+
+class HostFactorStore:
+    """[rows, rank] factor table in host RAM, entity-range sharded."""
+
+    def __init__(self, rows: int, rank: int, *, dtype: str = "float32",
+                 num_shards: int = 1) -> None:
+        if rows < 1 or rank < 1:
+            raise ValueError(f"rows/rank must be >= 1, got {rows}/{rank}")
+        if num_shards < 1 or num_shards > rows:
+            raise ValueError(
+                f"num_shards must be in [1, rows={rows}], got {num_shards}"
+            )
+        self.rows, self.rank = int(rows), int(rank)
+        self.dtype = "float32" if dtype is None else dtype
+        self._np_dtype = _np_dtype(dtype)
+        per = -(-rows // num_shards)
+        # Clip, don't just pin the tail: a ceil-split can overshoot rows
+        # by more than one shard (rows=10, shards=7 → per=2 walks past 10
+        # at shard 5), and unclipped bounds go non-monotonic — trailing
+        # shards are then empty, which is fine.
+        self.bounds = np.minimum(
+            np.arange(0, num_shards + 1) * per, rows
+        )
+        self._shards = [
+            np.zeros((self.bounds[s + 1] - self.bounds[s], rank),
+                     dtype=self._np_dtype)
+            for s in range(num_shards)
+        ]
+
+    @classmethod
+    def from_array(cls, arr, *, dtype: str | None = None,
+                   num_shards: int = 1) -> "HostFactorStore":
+        """Wrap a host array (copied into the shard layout).  ``dtype``
+        defaults to the array's own (must be float32/bfloat16)."""
+        arr = np.asarray(arr)
+        name = dtype or ("bfloat16" if arr.dtype.name == "bfloat16"
+                         else "float32")
+        store = cls(arr.shape[0], arr.shape[1], dtype=name,
+                    num_shards=num_shards)
+        store.write_range(0, arr)
+        return store
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._shards)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self._shards)
+
+    def shard(self, s: int) -> np.ndarray:
+        """Direct (mutable) view of shard ``s`` — the multi-host seam."""
+        return self._shards[s]
+
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        """[len(rows), rank] window of the table (any order, repeats OK) —
+        the staging read.  Crosses shard boundaries transparently."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size and (rows.min() < 0 or rows.max() >= self.rows):
+            raise IndexError(
+                f"window rows outside [0, {self.rows}): "
+                f"[{rows.min()}, {rows.max()}]"
+            )
+        if self.num_shards == 1:
+            return self._shards[0][rows]
+        out = np.empty((rows.shape[0], self.rank), dtype=self._np_dtype)
+        sh = np.searchsorted(self.bounds, rows, side="right") - 1
+        for s in range(self.num_shards):
+            m = sh == s
+            if m.any():
+                out[m] = self._shards[s][rows[m] - self.bounds[s]]
+        return out
+
+    def write_range(self, start: int, values: np.ndarray) -> None:
+        """Write a contiguous [n, rank] row block at ``start`` (the solved
+        rows streaming back; values are cast to the store dtype)."""
+        values = np.asarray(values)
+        stop = start + values.shape[0]
+        if start < 0 or stop > self.rows:
+            raise IndexError(
+                f"write [{start}, {stop}) outside [0, {self.rows})"
+            )
+        sh0 = int(np.searchsorted(self.bounds, start, side="right") - 1)
+        pos = start
+        while pos < stop:
+            s = sh0
+            while self.bounds[s + 1] <= pos:
+                s += 1
+            sh0 = s
+            hi = min(stop, int(self.bounds[s + 1]))
+            self._shards[s][pos - self.bounds[s]:hi - self.bounds[s]] = (
+                values[pos - start:hi - start].astype(
+                    self._np_dtype, copy=False
+                )
+            )
+            pos = hi
+
+    def write_rows(self, rows: np.ndarray, values: np.ndarray) -> None:
+        """Scatter [n, rank] values at arbitrary row ids (solved entities
+        of one window)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        values = np.asarray(values)
+        if self.num_shards == 1:
+            self._shards[0][rows] = values.astype(self._np_dtype, copy=False)
+            return
+        sh = np.searchsorted(self.bounds, rows, side="right") - 1
+        for s in range(self.num_shards):
+            m = sh == s
+            if m.any():
+                self._shards[s][rows[m] - self.bounds[s]] = (
+                    values[m].astype(self._np_dtype, copy=False)
+                )
+
+    def as_array(self) -> np.ndarray:
+        """The whole table as one host array (tests / small shapes / the
+        final model hand-off; defeats the sharding on purpose)."""
+        if self.num_shards == 1:
+            return self._shards[0]
+        return np.concatenate(self._shards, axis=0)
+
+    def copy(self) -> "HostFactorStore":
+        """Deep copy (the resilient loop's last-good snapshot)."""
+        out = HostFactorStore(self.rows, self.rank, dtype=self.dtype,
+                              num_shards=self.num_shards)
+        for s in range(self.num_shards):
+            out._shards[s][...] = self._shards[s]
+        return out

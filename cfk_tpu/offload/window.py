@@ -1,0 +1,191 @@
+"""Window planning: cut a stream-mode tiled chunk scan into stageable units.
+
+A windowed half-step runs the SAME per-chunk Gram+solve as the resident
+``ops.tiled.als_half_step_tiled`` — the only difference is where the fixed
+factor table lives.  The plan built here makes that literal:
+
+- chunks are grouped into consecutive WINDOWS, cut only where
+  ``carry_in == 0`` (no boundary-straddling entity crosses a cut, so each
+  window's zero carry-init is exactly the resident scan's state at that
+  chunk — bit-exactness needs no carry threading across host calls);
+- each window's **neighbor row set** is the sorted unique table rows its
+  chunks gather; the staged window is ``host_table[rows]`` and the chunk
+  indices are REBASED into it (the virtual zero row F maps to the static
+  ``window_rows`` slot — exactly the convention the gather kernels and the
+  zero-row append already use, so the kernels run unmodified against the
+  window);
+- all windows share ONE static shape (``chunks_per_window`` chunks padded
+  with all-trash chunks, ``window_rows`` staged rows): one jit trace
+  serves every window of a side.
+
+The builder is pure numpy on the already-built ``TiledBlocks`` arrays —
+window planning is a build-time cost, paid once per dataset.
+
+Host-memory note: the plan currently materializes padded copies of the
+per-chunk arrays alongside the originals (roughly doubling the
+interaction data's host footprint).  Only the REBASED neighbor stream
+inherently needs new memory — rating/weight/metadata are contiguous
+chunk slices that could be assembled into a reusable staging buffer at
+stage time instead; that refactor is the recorded follow-up for the
+true ~1B-rating regime (ROADMAP item 3 follow-ups).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowPlan:
+    """Per-window staged inputs of one side's windowed half-step."""
+
+    rows: np.ndarray          # [W, R] int64 table rows staged per window
+    row_counts: np.ndarray    # [W] real rows (<= R; the rest pad row 0)
+    chunk_counts: np.ndarray  # [W] real chunks (<= ncw; the rest all-trash)
+    neighbor_idx: np.ndarray  # [W, ncw·C] int32 window-rebased (zero row → R)
+    rating: np.ndarray        # [W, ncw·C] f32
+    weight: np.ndarray        # [W, ncw·C] f32
+    tile_seg: np.ndarray      # [W, ncw·NT] int32
+    chunk_entity: np.ndarray  # [W, ncw·Ec] int32 (trash = local_entities)
+    chunk_count: np.ndarray   # [W, ncw·Ec] int32
+    carry_in: np.ndarray      # [W, ncw] f32 (0 at every window start)
+    last_seg: np.ndarray      # [W, ncw] int32
+    statics: tuple            # (ncw, C, Ec, T) — the per-window half-step's
+    window_rows: int          # R (static staged-table height)
+    table_rows: int           # F (the fixed side's padded rows)
+    local_entities: int       # solve side's padded rows (trash id)
+
+    @property
+    def num_windows(self) -> int:
+        return int(self.rows.shape[0])
+
+    def staged_bytes_per_window(self, rank: int, stage_itemsize: int) -> int:
+        """Bytes one staged window occupies on device: the gathered table
+        rows at the staging dtype plus the window's chunk arrays."""
+        ncw, cap, e_c, _t = self.statics
+        table = int(self.window_rows) * rank * stage_itemsize
+        chunks = (
+            ncw * cap * 12            # nb (int32) + rating + weight (f32)
+            + self.tile_seg.shape[1] * 4
+            + 2 * ncw * e_c * 4       # chunk_entity + chunk_count
+            + 2 * ncw * 4             # carry_in + last_seg
+        )
+        return table + chunks
+
+
+def build_window_plan(blocks, table_rows: int, *,
+                      chunks_per_window: int = 4) -> WindowPlan:
+    """Cut a stream-mode ``TiledBlocks`` side (single shard) into windows.
+
+    ``table_rows`` is the FIXED side's padded entity count (the row space
+    ``neighbor_idx`` addresses, with ``table_rows`` itself as the virtual
+    zero row).  ``chunks_per_window`` is a target: a window grows past it
+    when no ``carry_in == 0`` cut exists (a hot entity straddling chunks),
+    and every window is padded up to the common maximum with all-trash
+    chunks so one static shape serves them all.
+    """
+    if blocks.mode != "stream":
+        raise ValueError(
+            f"window plans cut the stream-mode chunk scan; these blocks "
+            f"are mode={blocks.mode!r} (build with accum_max_entities=0 "
+            "to force stream mode — the out-of-core regime's mode)"
+        )
+    if blocks.num_shards != 1:
+        raise ValueError(
+            "the windowed driver is single-process: build the blocks with "
+            f"num_shards=1 (got {blocks.num_shards})"
+        )
+    if chunks_per_window < 1:
+        raise ValueError(
+            f"chunks_per_window must be >= 1, got {chunks_per_window}"
+        )
+    nc, cap, e_c, t = blocks.statics
+    nt = cap // t
+    nb = blocks.neighbor_idx.reshape(nc, cap)
+    rt = blocks.rating.reshape(nc, cap)
+    wt = blocks.weight.reshape(nc, cap)
+    ts = blocks.tile_seg.reshape(nc, nt)
+    ent = blocks.chunk_entity.reshape(nc, e_c)
+    cnt = blocks.chunk_count.reshape(nc, e_c)
+    cin = blocks.carry_in.reshape(nc)
+    lseg = blocks.last_seg.reshape(nc)
+    local = blocks.local_entities
+
+    # Cut points: a window may start at chunk c only when chunk c does not
+    # continue the previous chunk's last entity.
+    groups: list[tuple[int, int]] = []
+    start = 0
+    while start < nc:
+        end = min(start + chunks_per_window, nc)
+        while end < nc and cin[end] != 0.0:
+            end += 1
+        groups.append((start, end))
+        start = end
+
+    # Floor of 2 chunks per window: a length-1 lax.scan compiles to a
+    # different program shape than the same body inside a longer scan
+    # (XLA simplifies away the loop), which measurably perturbs the
+    # pallas-solver route's bits (~1 ulp) — an all-trash pad chunk keeps
+    # every window a REAL loop with the identical body, preserving the
+    # bit-exactness contract against the resident scan.  EXCEPT when the
+    # resident scan itself is length-1 (nc == 1): then the single-chunk
+    # window is the identical program and padding it would introduce the
+    # very mismatch the floor prevents.
+    ncw = max(2 if nc > 1 else 1,
+              max(end - start for start, end in groups))
+    f = int(table_rows)
+
+    # Per-window unique row sets (sorted ascending — locality for the host
+    # gather and a canonical rebase).
+    row_lists, counts = [], []
+    for lo, hi in groups:
+        w_nb = nb[lo:hi].ravel()
+        real = w_nb[w_nb < f]
+        rows_w = np.unique(real)
+        row_lists.append(rows_w)
+        counts.append(rows_w.shape[0])
+    window_rows = max(_round_up(max(max(counts), 1), 8), 8)
+
+    w = len(groups)
+    rows = np.zeros((w, window_rows), dtype=np.int64)
+    nb_w = np.full((w, ncw * cap), window_rows, dtype=np.int32)
+    rt_w = np.zeros((w, ncw * cap), dtype=np.float32)
+    wt_w = np.zeros((w, ncw * cap), dtype=np.float32)
+    ts_w = np.full((w, ncw * nt), e_c, dtype=np.int32)
+    ent_w = np.full((w, ncw * e_c), local, dtype=np.int32)
+    cnt_w = np.ones((w, ncw * e_c), dtype=blocks.chunk_count.dtype)
+    cin_w = np.zeros((w, ncw), dtype=np.float32)
+    lseg_w = np.zeros((w, ncw), dtype=np.int32)
+    for wi, ((lo, hi), rows_w) in enumerate(zip(groups, row_lists)):
+        n = hi - lo
+        rows[wi, : rows_w.shape[0]] = rows_w
+        chunk_nb = nb[lo:hi].ravel()
+        # Rebase: real rows → their window position; the virtual zero row
+        # (== f) → the window's own virtual zero row (== window_rows).
+        reb = np.searchsorted(rows_w, chunk_nb).astype(np.int32)
+        reb[chunk_nb >= f] = window_rows
+        nb_w[wi, : n * cap] = reb
+        rt_w[wi, : n * cap] = rt[lo:hi].ravel()
+        wt_w[wi, : n * cap] = wt[lo:hi].ravel()
+        ts_w[wi, : n * nt] = ts[lo:hi].ravel()
+        ent_w[wi, : n * e_c] = ent[lo:hi].ravel()
+        cnt_w[wi, : n * e_c] = cnt[lo:hi].ravel()
+        cin_w[wi, :n] = cin[lo:hi]
+        lseg_w[wi, :n] = lseg[lo:hi]
+
+    return WindowPlan(
+        rows=rows,
+        row_counts=np.asarray(counts, dtype=np.int64),
+        chunk_counts=np.asarray([hi - lo for lo, hi in groups],
+                                dtype=np.int64),
+        neighbor_idx=nb_w, rating=rt_w, weight=wt_w, tile_seg=ts_w,
+        chunk_entity=ent_w, chunk_count=cnt_w, carry_in=cin_w,
+        last_seg=lseg_w, statics=(ncw, cap, e_c, t),
+        window_rows=window_rows, table_rows=f, local_entities=local,
+    )

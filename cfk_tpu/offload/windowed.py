@@ -1,0 +1,456 @@
+"""Out-of-core training: host factor stores + windowed half-steps.
+
+The ALX move (arXiv 2112.02194): accept that factor tables exceed one
+chip's HBM, keep them in host RAM (``HostFactorStore``), and stream
+WINDOWS of the fixed side through the device while the solve streams the
+chunk scan.  The execution per chunk is literally the resident tiled
+half-step — ``ops.tiled.als_half_step_tiled`` runs unmodified against the
+staged window with rebased indices (PR 4's in-kernel gather reads from
+ANY-memory-space tables, so the kernels just point at the window) — which
+is what makes the windowed path BIT-EXACT vs the resident path
+(``tests/test_offload.py`` pins it per knob: table dtype, gather mode,
+fused epilogue, overlap).
+
+Schedule per half-step (the ``ops/pipeline.py`` shape, one level up):
+
+    stage(window 0)                     # host gather + device_put
+    for w: stage(w+1)  ||  compute(w)   # double buffer
+            scatter solved rows of w back to the host store
+
+Window w's jitted compute is DISPATCHED first (jit dispatch is async),
+then window w+1's host gather + ``device_put`` run under it, and only
+then is w's result joined — so the host staging work AND the PCIe
+transfer both hide under the Gram+solve exactly as the chunk pipelines
+overlap their gathers; the per-window chunk math, order, and carry
+semantics are unchanged (windows cut only at ``carry_in == 0``
+boundaries — ``offload/window.py``).
+
+``train_als_host_window`` is the ``offload_tier="host_window"`` executor
+the planner resolves oversized problems to (``plan/resolver.py`` gates the
+``device`` tier on ``offload.budget`` — the same predicate the window
+sizing here consumes, so a plan can never promise a resident table that
+does not fit).  Explicit ALS on the tiled stream layout, single process;
+the hierarchical ICI×DCN exchange for the multi-chip regime lives in
+``parallel/spmd.half_step_tiled_ring_hier``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.offload import budget as _budget
+# _np_dtype: the ONE validated name→numpy-dtype mapping (raises on
+# anything but float32/bfloat16 — no silent fallthrough).
+from cfk_tpu.offload.store import HostFactorStore, _np_dtype
+from cfk_tpu.offload.window import WindowPlan, build_window_plan
+
+
+def _stage_dtype(store_dtype: str, table_dtype: str | None) -> str:
+    """The dtype windows cross PCIe at: bf16 tables stage bf16 (half the
+    transfer — the cast is per-element, so host-cast == device-cast
+    bit-exactly); int8 stages at the storage dtype and quantizes on device
+    per window (per-row scheme ⇒ window quantization == sliced full-table
+    quantization; staging the codes themselves is an on-TPU follow-up)."""
+    if table_dtype == "bfloat16":
+        return "bfloat16"
+    return store_dtype
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("statics", "lam", "solver", "overlap",
+                     "fused_epilogue", "in_kernel_gather",
+                     "reg_solve_algo", "table_dtype", "out_dtype"),
+)
+def _window_half_jit(tbl, nb, rt, wt, ts, ent, cnt, cin, lseg, *, statics,
+                     lam, solver, overlap, fused_epilogue,
+                     in_kernel_gather, reg_solve_algo, table_dtype,
+                     out_dtype):
+    """One window's chunks through the UNMODIFIED stream-mode half-step
+    (``return_chunk_rows`` skips the device scatter — the host does it)."""
+    from cfk_tpu.ops.tiled import tiled_half_step
+
+    blk = dict(neighbor_idx=nb, rating=rt, weight=wt, tile_seg=ts,
+               chunk_entity=ent, chunk_count=cnt, carry_in=cin,
+               last_seg=lseg)
+    xs = tiled_half_step(
+        tbl, blk, ("tiled", "stream") + statics, 1, lam,
+        solver=solver, overlap=overlap, fused_epilogue=fused_epilogue,
+        in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+        table_dtype=table_dtype, return_chunk_rows=True,
+    )
+    return xs.astype(jax.numpy.dtype(out_dtype))
+
+
+class WindowIntegrityError(RuntimeError):
+    """A staged window's bytes no longer match the host store's (torn or
+    corrupted transfer, caught by the staging checksum — the window
+    analog of the checkpoint crc32 contract)."""
+
+
+def windowed_half_step(
+    fixed_store: HostFactorStore, wplan: WindowPlan, *, lam: float,
+    out_dtype: str = "float32", solver: str = "auto", overlap=None,
+    fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
+    table_dtype: str | None = None, faults=None, iteration: int = 0,
+    side: str = "", stats: dict | None = None, verify_windows: bool = False,
+) -> np.ndarray:
+    """Solve one side against a host-resident fixed table, window by
+    window.  Returns the solved [local_entities, rank] host array in
+    ``out_dtype`` (untouched rows zero — exactly the resident scatter's
+    output).  ``faults`` (chaos only) is a
+    ``resilience.faults.WindowFaultInjector``; ``verify_windows``
+    checksums each staged window at the store (crc32 before the staging
+    hand-off) against what is about to ship, and raises
+    ``WindowIntegrityError`` on a mismatch — NaN poisoning is caught by
+    the factor sentinel either way, but a TORN window is finite-and-
+    wrong, which only an integrity check can see.  Scope is the HOST
+    staging pipeline up to the ``device_put`` hand-off (which is where
+    the chaos fault hook models its corruption); verifying the PCIe DMA
+    itself would need a device-side checksum — on-TPU follow-up."""
+    import zlib
+
+    k = fixed_store.rank
+    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
+    stage_np = _np_dtype(stage_name)
+    out = np.zeros((wplan.local_entities, k), dtype=_np_dtype(out_dtype))
+    n_w = wplan.num_windows
+
+    def stage(w):
+        if faults is not None:
+            faults.delay(iteration, side, w)
+        tbl = fixed_store.gather(wplan.rows[w])
+        if tbl.dtype != stage_np:
+            tbl = tbl.astype(stage_np)
+        src_crc = zlib.crc32(tbl.tobytes()) if verify_windows else None
+        # The fault hook models in-flight staging corruption: it fires
+        # BETWEEN the source checksum and the device transfer.
+        if faults is not None:
+            tbl = faults.apply_window(iteration, side, w, tbl)
+        if verify_windows and zlib.crc32(tbl.tobytes()) != src_crc:
+            raise WindowIntegrityError(
+                f"side {side!r} iteration {iteration} window {w}: staged "
+                "bytes diverge from the host store (torn/corrupt transfer)"
+            )
+        host = (
+            tbl, wplan.neighbor_idx[w], wplan.rating[w], wplan.weight[w],
+            wplan.tile_seg[w], wplan.chunk_entity[w], wplan.chunk_count[w],
+            wplan.carry_in[w], wplan.last_seg[w],
+        )
+        if stats is not None:
+            stats["windows_staged"] = stats.get("windows_staged", 0) + 1
+            # The FULL staged working set — table AND chunk arrays — the
+            # same quantity the per-window budget was sized against
+            # (WindowPlan.staged_bytes_per_window), so the recorded
+            # arithmetic reproduces the sizing decision.
+            stats["staged_bytes"] = (
+                stats.get("staged_bytes", 0)
+                + sum(a.nbytes for a in host)
+            )
+        return tuple(jax.device_put(x) for x in host)
+
+    staged = stage(0)
+    for w in range(n_w):
+        # DISPATCH window w's compute first (jit dispatch is async), THEN
+        # run window w+1's host gather + device_put under it, and only
+        # then join w's result: both the host staging work (the store
+        # fancy-index gather, the optional checksum) and the transfer
+        # overlap the device compute.
+        xs = _window_half_jit(
+            *staged, statics=wplan.statics, lam=float(lam), solver=solver,
+            overlap=overlap, fused_epilogue=fused_epilogue,
+            in_kernel_gather=in_kernel_gather,
+            reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
+            out_dtype=out_dtype,
+        )
+        nxt = stage(w + 1) if w + 1 < n_w else None
+        xs_np = np.asarray(xs)
+        ent = wplan.chunk_entity[w]
+        real = ent < wplan.local_entities
+        out[ent[real]] = xs_np[real]
+        staged = nxt
+    return out
+
+
+def _stream_blocks_for(dataset, config: ALSConfig, tile_rows: int | None):
+    """The stream-mode tiled blocks the windowed driver runs on: the
+    dataset's own when they already qualify (both sides stream, one
+    shard), else a rebuild from the dense COO with accum mode disabled —
+    accum's persistent [E, k, k] device accumulator is exactly the
+    structure the out-of-core regime cannot hold."""
+    from cfk_tpu.data.blocks import TiledBlocks, build_tiled_blocks
+
+    mb, ub = dataset.movie_blocks, dataset.user_blocks
+    ok = (
+        isinstance(mb, TiledBlocks) and isinstance(ub, TiledBlocks)
+        and mb.mode == "stream" and ub.mode == "stream"
+        and mb.num_shards == 1 and ub.num_shards == 1
+    )
+    if ok:
+        return mb, ub
+    coo = dataset.coo_dense
+    t = tile_rows or (mb.tile_rows if isinstance(mb, TiledBlocks) else 128)
+    m_dense = coo.movie_raw.astype(np.int64)
+    u_dense = coo.user_raw.astype(np.int64)
+    build = functools.partial(
+        build_tiled_blocks, num_shards=1, tile_rows=t,
+        chunk_elems=config.chunk_cells(), accum_max_entities=0,
+    )
+    mb2 = build(m_dense, u_dense, coo.rating,
+                dataset.movie_map.num_entities, dataset.user_map.num_entities)
+    ub2 = build(u_dense, m_dense, coo.rating,
+                dataset.user_map.num_entities, dataset.movie_map.num_entities)
+    return mb2, ub2
+
+
+def _probe(u: np.ndarray, m: np.ndarray, norm_limit: float | None) -> str | None:
+    """Host-side sentinel over the solved stores: NaN/Inf anywhere, or a
+    factor-row 2-norm past the watchdog limit.  Returns the trip reason or
+    None (the same reason vocabulary as ``resilience.sentinel``)."""
+    for name, x in (("user", u), ("movie", m)):
+        xf = np.asarray(x, dtype=np.float32)
+        if not np.isfinite(xf).all():
+            return f"nonfinite {name} factors"
+        if norm_limit is not None:
+            n = float(np.sqrt((xf * xf).sum(axis=1)).max()) if xf.size else 0.0
+            if n > norm_limit:
+                return f"{name} row norm {n:.3g} > {norm_limit:.3g}"
+    return None
+
+
+def train_als_host_window(
+    dataset,
+    config: ALSConfig,
+    *,
+    metrics=None,
+    window_faults=None,
+    tile_rows: int | None = None,
+    chunks_per_window: int | None = None,
+    device_budget_bytes: float | None = None,
+    plan_provenance=None,
+    verify_windows: bool | None = None,
+):
+    """ALS-WR with host-resident factor tables and windowed half-steps.
+
+    Same math, init, and iteration order as ``train_als`` on the same
+    stream-mode tiled blocks — bit-exact at every supported knob
+    (``tests/test_offload.py``).  Supports explicit ALS, ``layout='tiled'``,
+    one process; divergence recovery runs the PR 3 ladder against in-RAM
+    last-good snapshots of the stores (each rung is recorded with the
+    loop vocabulary and as a plan transition when provenance rides along).
+
+    ``device_budget_bytes`` bounds the staged working set (default: the
+    detected device's HBM through ``offload.budget`` — the SAME predicate
+    the planner gates the ``device`` tier with); ``chunks_per_window``
+    overrides the derived window size.
+    """
+    from cfk_tpu.ops.solve import init_factors_stats
+    from cfk_tpu.resilience.policy import (
+        Overrides,
+        TrainingDivergedError,
+        policy_from_config,
+    )
+    from cfk_tpu.utils.metrics import Metrics
+
+    if config.algorithm != "als":
+        raise ValueError(
+            f"host-window offload supports the explicit ALS optimizer; "
+            f"algorithm={config.algorithm!r} (iALS needs the global YᵀY "
+            "over the full fixed table — an out-of-core reduction is the "
+            "documented follow-up)"
+        )
+    if config.num_shards != 1:
+        raise ValueError(
+            "the windowed driver is single-process "
+            f"(num_shards={config.num_shards}); the multi-chip regime "
+            "pairs it with the hierarchical ring exchange "
+            "(parallel.spmd.half_step_tiled_ring_hier)"
+        )
+    if config.layout != "tiled":
+        raise ValueError(
+            f"host-window offload streams the tiled stream-mode layout; "
+            f"layout={config.layout!r}"
+        )
+    metrics = metrics if metrics is not None else Metrics()
+    with metrics.phase("window_plan"):
+        mb, ub = _stream_blocks_for(dataset, config, tile_rows)
+        stage_name = _stage_dtype(config.dtype, config.table_dtype)
+        stage_itemsize = _np_dtype(stage_name).itemsize
+        if device_budget_bytes is None:
+            from cfk_tpu.plan import DeviceSpec
+
+            device_budget_bytes = DeviceSpec.detect().hbm_bytes
+        per_window_budget = _budget.window_budget_bytes(device_budget_bytes)
+
+        def plans_for(cpw):
+            m_plan = build_window_plan(mb, ub.padded_entities,
+                                       chunks_per_window=cpw)
+            u_plan = build_window_plan(ub, mb.padded_entities,
+                                       chunks_per_window=cpw)
+            return m_plan, u_plan
+
+        cpw = chunks_per_window or 4
+        while True:
+            m_plan, u_plan = plans_for(cpw)
+            worst = max(
+                p.staged_bytes_per_window(config.rank, stage_itemsize)
+                for p in (m_plan, u_plan)
+            )
+            if worst <= per_window_budget or cpw == 1:
+                break
+            cpw = max(1, cpw // 2)
+        if worst > per_window_budget:
+            raise ValueError(
+                f"one staged window needs {worst / 1e6:.1f} MB but the "
+                f"per-window budget is {per_window_budget / 1e6:.1f} MB "
+                "(device_budget · RESIDENT_FRACTION / WINDOW_BUFFERS) — "
+                "lower hbm_chunk_elems so single chunks fit the budget"
+            )
+    metrics.gauge("offload_windows_m", m_plan.num_windows)
+    metrics.gauge("offload_windows_u", u_plan.num_windows)
+    metrics.gauge("offload_window_rows_m", m_plan.window_rows)
+    metrics.gauge("offload_window_rows_u", u_plan.window_rows)
+    metrics.gauge("offload_chunks_per_window", cpw)
+
+    # Init: identical to the resident tiled trainer (init_factors_stats at
+    # the padded entity count, zero movie seed).
+    key = jax.random.PRNGKey(config.seed)
+    u0 = init_factors_stats(
+        key, jax.numpy.asarray(ub.rating_sum), jax.numpy.asarray(ub.count),
+        config.rank,
+    ).astype(jax.numpy.dtype(config.dtype))
+    u_store = HostFactorStore.from_array(np.asarray(u0), dtype=config.dtype)
+    m_store = HostFactorStore(mb.padded_entities, config.rank,
+                              dtype=config.dtype)
+
+    policy = policy_from_config(config)
+    base_ov = Overrides(lam=config.lam, fused_epilogue=config.fused_epilogue)
+    ov = base_ov
+    norm_limit = (config.health_norm_limit
+                  if config.health_check_every is not None else None)
+    probe_every = config.health_check_every or 1
+    stats: dict = {}
+    if verify_windows is None:
+        # Checksumming every staged window costs a host pass over its
+        # bytes, and its scope is the host staging pipeline up to the
+        # device_put hand-off (exactly the seam the chaos fault hook
+        # corrupts) — so it defaults on precisely when a fault plan is
+        # armed.  It is NOT a PCIe-DMA integrity check (that needs a
+        # device-side checksum; on-TPU follow-up).
+        verify_windows = window_faults is not None
+    half_kw = dict(
+        out_dtype=config.dtype, solver=config.solver,
+        overlap=bool(config.overlap),
+        in_kernel_gather=config.in_kernel_gather,
+        table_dtype=config.table_dtype, faults=window_faults, stats=stats,
+        verify_windows=verify_windows,
+    )
+    # Probing + last-good snapshots cost a full host pass + memcpy over
+    # both stores per cadence — at the ALX regime that is gigabytes per
+    # iteration — so they arm only when something can trip: the sentinel
+    # (health_check_every), the staging checksum, or a chaos fault plan.
+    # Unarmed runs match the resident trainer's default (no sentinel).
+    armed = (config.health_check_every is not None
+             or verify_windows or window_faults is not None)
+
+    snap = (u_store.copy(), m_store.copy()) if armed else (None, None)
+    snap_iter = 0
+    trips = 0
+    it = 0
+    degraded = False
+
+    def trip(reason: str) -> bool:
+        """Rollback + ladder climb; returns False when retries are
+        exhausted (degrade — the caller breaks the loop)."""
+        nonlocal u_store, m_store, it, trips, ov
+        trips += 1
+        metrics.incr("health_trips")
+        metrics.note(f"health_trip_{trips}", f"iteration {it}: {reason}")
+        if trips > policy.max_recoveries:
+            detail = (
+                f"recovery exhausted after {policy.max_recoveries} "
+                f"trips; last: {reason}"
+            )
+            if policy.on_unrecoverable == "raise":
+                raise TrainingDivergedError(detail)
+            metrics.note("degraded", detail)
+            u_store, m_store = snap
+            it = snap_iter
+            return False
+        u_store, m_store = snap[0].copy(), snap[1].copy()
+        it = snap_iter
+        metrics.incr("rollbacks")
+        new_ov = policy.escalate(ov, trips)
+        detail = (
+            f"rung {trips}: rollback to iter {snap_iter}, "
+            f"lam={new_ov.lam}, fused={new_ov.fused_epilogue}, "
+            f"algo={new_ov.reg_solve_algo or config.reg_solve_algo}"
+        )
+        if new_ov != ov:
+            metrics.gauge("escalation_level", trips)
+            metrics.note(f"escalation_{trips}", detail)
+        ov = new_ov
+        if plan_provenance is not None:
+            t = plan_provenance.record_transition(
+                "recovery_escalation", detail
+            )
+            metrics.note(f"plan_transition_{trips}", str(t))
+        return True
+
+    with metrics.phase("train"):
+        while it < config.num_iterations:
+            algo = ov.reg_solve_algo or config.reg_solve_algo
+            try:
+                m_new = windowed_half_step(
+                    u_store, m_plan, lam=ov.lam,
+                    fused_epilogue=ov.fused_epilogue, reg_solve_algo=algo,
+                    iteration=it, side="m", **half_kw,
+                )
+                m_store.write_range(0, m_new)
+                u_new = windowed_half_step(
+                    m_store, u_plan, lam=ov.lam,
+                    fused_epilogue=ov.fused_epilogue, reg_solve_algo=algo,
+                    iteration=it, side="u", **half_kw,
+                )
+                u_store.write_range(0, u_new)
+            except WindowIntegrityError as e:
+                # The staging checksum caught a torn/corrupt window BEFORE
+                # it reached a kernel; the store is intact, so rollback +
+                # replay is exact (the stores may hold a half-written m —
+                # the snapshot restore erases it).
+                if not trip(f"window integrity: {e}"):
+                    degraded = True
+                    break
+                continue
+            it += 1
+            metrics.incr("iterations")
+            if not armed:
+                continue
+            if it % probe_every != 0 and it < config.num_iterations:
+                continue
+            reason = _probe(u_new, m_new, norm_limit)
+            if reason is None:
+                snap = (u_store.copy(), m_store.copy())
+                snap_iter = it
+                continue
+            if not trip(reason):
+                degraded = True
+                break
+    metrics.gauge("offload_windows_staged", stats.get("windows_staged", 0))
+    metrics.gauge("offload_staged_mb",
+                  round(stats.get("staged_bytes", 0) / 1e6, 3))
+    if degraded:
+        metrics.gauge("iterations_completed", snap_iter)
+
+    from cfk_tpu.models.als import ALSModel
+
+    return ALSModel(
+        user_factors=u_store.as_array(),
+        movie_factors=m_store.as_array(),
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+    )

@@ -373,7 +373,7 @@ def tiled_half_step(
     fixed_factors, blk, chunks, local_entities, lam, *,
     solver="cholesky", implicit_reg=None, stage="full", overlap=None,
     fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
-    table_dtype=None,
+    table_dtype=None, return_chunk_rows=False,
 ):
     """Mode dispatch shared by the single-device and SPMD trainers.
 
@@ -400,6 +400,14 @@ def tiled_half_step(
     fixed_factors, blk = quantize_tiled_operand(
         fixed_factors, blk, chunks, table_dtype
     )
+    if return_chunk_rows and mode != "stream":
+        # The windowed host-offload driver (cfk_tpu.offload) scatters on
+        # the host; only the stream scan's per-chunk solve rows have that
+        # shape — accum solves once at the end, dstream could support it
+        # but no caller needs it yet.
+        raise ValueError(
+            f"return_chunk_rows is a stream-mode contract; mode={mode!r}"
+        )
     if mode == "accum":
         return als_half_step_tiled_accum(
             fixed_factors, blk["neighbor_idx"], blk["rating"], blk["weight"],
@@ -426,6 +434,7 @@ def tiled_half_step(
         statics=st, solver=solver, implicit_reg=implicit_reg, stage=stage,
         overlap=overlap, fused_epilogue=fused_epilogue,
         in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+        return_chunk_rows=return_chunk_rows,
     )
 
 
@@ -539,6 +548,7 @@ def als_half_step_tiled(
     fused_epilogue: bool | None = None,
     in_kernel_gather: bool | None = None,
     reg_solve_algo: str | None = None,
+    return_chunk_rows: bool = False,
 ) -> jax.Array:
     """Stream-mode tiled half-iteration (the many-entities side).
 
@@ -744,6 +754,11 @@ def als_half_step_tiled(
         )
     else:
         _, xs = lax.scan(body, init, chunks)
+    if return_chunk_rows:
+        # The windowed host-offload driver (cfk_tpu.offload.windowed)
+        # scatters these by chunk_entity on the HOST — same values the
+        # device scatter below would place, minus the [E, k] buffer.
+        return xs.reshape(nc * e_c, k)
     out = _match_varying(
         jnp.zeros((local_entities + 1, k), jnp.float32), neighbor_idx
     )

@@ -426,6 +426,243 @@ def _tiled_to_tree(blocks: TiledBlocks, weighted: bool = False
     }
 
 
+def _make_tiled_slice_grams(blk, *, cap, nt, e_c, t, k, backend, gather,
+                            int8):
+    """The per-slice chunk loop both tiled ring schedules share: scan the
+    slice's chunks against whichever factor block this shard currently
+    holds, scatter-adding chunk-dense per-entity Grams into the persistent
+    accumulator.  Factored out of ``half_step_tiled_ring`` so the flat and
+    hierarchical rings run the IDENTICAL per-slice ops (the hierarchy only
+    reorders which block arrives when)."""
+    from cfk_tpu.ops import quant
+    from cfk_tpu.ops.tiled import _entity_gram_chunk
+
+    nb, rt, wt = blk["neighbor_idx"], blk["rating"], blk["weight"]
+    ts, ent = blk["tile_seg"], blk["chunk_entity"]
+    starts = blk["slice_starts"]  # [S+1]
+
+    def slice_grams(acc, tbl, t_idx):
+        factors = tbl[0]
+        scale_blk = tbl[1] if int8 else None
+        # One zero-row append per ring step, not per chunk (the chunk-scan
+        # body would otherwise re-copy the whole block every chunk); the
+        # in-kernel gather skips even that — the kernel DMAs from the raw
+        # rotated block and the weight channel masks the padding rows.
+        if gather == "fused":
+            fz = factors
+        else:
+            fz = jnp.concatenate([
+                factors,
+                _match_varying(
+                    jnp.zeros((1, k), factors.dtype), factors
+                ),
+            ])
+
+        def chunk_body(i, acc):
+            acc_a, acc_b = acc
+            nb_c = lax.dynamic_slice(nb, (i * cap,), (cap,))
+            rt_c = lax.dynamic_slice(rt, (i * cap,), (cap,))
+            wt_c = lax.dynamic_slice(wt, (i * cap,), (cap,))
+            ts_c = lax.dynamic_slice(ts, (i * nt,), (nt,))
+            ent_c = lax.dynamic_slice(ent, (i * e_c,), (e_c,))
+            # int8: fold this block's per-row dequant scale into the 0/1
+            # weight channel (nb is local to the rotated block; the
+            # block-local virtual zero row gets the appended 0 scale).
+            wt_c = quant.fold_scale(wt_c, scale_blk, nb_c)
+            a, b = _entity_gram_chunk(
+                fz, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+                # the ring is explicit-ALS only; int8 must premultiply
+                # (the fold above IS the dequantize)
+                unit_weights=not int8,
+                zero_appended=gather != "fused", gather=gather,
+            )
+            return (acc_a.at[ent_c].add(a[:e_c]), acc_b.at[ent_c].add(b[:e_c]))
+
+        return lax.fori_loop(starts[t_idx], starts[t_idx + 1], chunk_body, acc)
+
+    return slice_grams
+
+
+def resolve_ici_group(config: ALSConfig) -> int:
+    """Inner-ring size of the hierarchical exchange: the explicit
+    ``config.ici_group`` when set, else devices-per-process when that
+    divides the shard count (the physical ICI domain on a multi-host
+    mesh), else one flat ring (bit-identical to ``exchange='ring'``)."""
+    if config.ici_group is not None:
+        return config.ici_group
+    local = jax.local_device_count()
+    if 0 < local <= config.num_shards and config.num_shards % local == 0:
+        return local
+    return config.num_shards
+
+
+def half_step_tiled_ring_hier(
+    fixed_local, blk, chunks, local_entities, *, lam, num_shards, inner,
+    solver="cholesky", gram_backend=None, overlap=None, probe=None,
+    fused_epilogue=None, health=False, in_kernel_gather=None,
+    reg_solve_algo=None, table_dtype=None,
+):
+    """Hierarchical ICI-ring-within-DCN-ring tiled half-iteration
+    (ISSUE 11; the ALX-style exchange for meshes whose fabric is tiered).
+
+    ``num_shards = outer · inner``: shards group into ``inner``-sized
+    rings on the fast fabric.  Phase ``p`` rotates each group's blocks
+    ``inner − 1`` times over the INNER permutation (pure ICI — shard
+    (g, i) visits every block of group g − p), then ONE outer hop moves
+    every held block to the same inner position of the next group (the
+    only transfers that cross DCN).  O·(I−1) + (O−1) = S−1 transfers, all
+    S blocks visited per shard — the flat ring's totals, with the slow
+    fabric paid O−1 times instead of on every boundary edge every step.
+
+    Numerics: the per-slice chunk math is IDENTICAL to the flat ring
+    (``_make_tiled_slice_grams``); only the VISIT ORDER of slices differs,
+    so the per-entity Gram sums associate differently (same additions,
+    different order — within float tolerance of the flat ring, and
+    deterministic for a fixed (num_shards, inner)).  ``inner ==
+    num_shards`` degenerates to one inner ring whose schedule — and
+    factors — are BIT-IDENTICAL to ``half_step_tiled_ring``
+    (tests/test_offload.py pins both contracts).  Each transfer is
+    double-buffered via ``_ring_rotate`` exactly like the flat ring;
+    ``probe``/``health`` as in ``half_step_tiled_ring``.
+    """
+    from cfk_tpu.ops import quant
+    from cfk_tpu.ops.pipeline import resolve_overlap
+    from cfk_tpu.ops.tiled import (
+        default_tiled_gram_backend,
+        resolve_gather_mode,
+    )
+
+    if health and probe is not None:
+        raise ValueError("health probing and timing probes are exclusive")
+    s = num_shards
+    if inner < 1 or s % inner != 0:
+        raise ValueError(
+            f"inner ring size {inner} must divide num_shards={s}"
+        )
+    outer = s // inner
+    overlap = resolve_overlap(overlap)
+    backend = gram_backend or default_tiled_gram_backend()
+    _, _, nc, cap, t, h, e_c = chunks
+    nt = cap // t
+    k = fixed_local.shape[-1]
+    gather = resolve_gather_mode(
+        in_kernel_gather, backend, "full", cap, nt, t, e_c + 1, k,
+    )
+    data, scale = quant.quantize_table(fixed_local, table_dtype)
+    tbl0 = (data,) if scale is None else (data, scale)
+    int8 = scale is not None
+    my = lax.axis_index(AXIS)
+    g, i_pos = my // inner, my % inner
+    # Inner rotation: within-group shift by one; outer hop: same inner
+    # position of the next group.  Both are full permutations of [0, S).
+    inner_perm = [
+        (q, (q // inner) * inner + (q % inner + 1) % inner)
+        for q in range(s)
+    ]
+    outer_perm = [
+        (q, ((q // inner + 1) % outer) * inner + q % inner)
+        for q in range(s)
+    ]
+    slice_grams = _make_tiled_slice_grams(
+        blk, cap=cap, nt=nt, e_c=e_c, t=t, k=k, backend=backend,
+        gather=gather, int8=int8,
+    )
+
+    # Schedule: (phase p, inner step j) — this shard holds the block of
+    # slice (g − p, i + p − j); see the derivation in the docstring.
+    # Rolled as fori loops (the flat ring's discipline): trace size is
+    # O(1) in both `outer` and `inner`, not O(S) — an unrolled schedule
+    # would trace S copies of the chunk loop at 64–256-shard meshes.
+    def held(p, j):
+        return ((g - p) % outer) * inner + (i_pos + p - j) % inner
+
+    if probe == "exchange":  # transfers only; factors are a timing sink
+        def x_inner(t):
+            return lax.fori_loop(
+                0, inner - 1,
+                lambda j, tt: jax.tree.map(
+                    lambda x: lax.ppermute(x, AXIS, inner_perm), tt
+                ),
+                t,
+            )
+
+        tbl = lax.fori_loop(
+            0, outer - 1,
+            lambda p, t: jax.tree.map(
+                lambda x: lax.ppermute(x, AXIS, outer_perm), x_inner(t)
+            ),
+            tbl0,
+        )
+        tbl = x_inner(tbl)
+        return jnp.zeros((local_entities, k), jnp.float32) + jnp.sum(
+            tbl[0].astype(jnp.float32)
+        )
+
+    acc0 = (
+        _to_varying(jnp.zeros((local_entities + 1, k, k), jnp.float32),
+                    AXIS),
+        _to_varying(jnp.zeros((local_entities + 1, k), jnp.float32), AXIS),
+    )
+    bad0 = _to_varying(jnp.zeros((), jnp.int32), AXIS)
+
+    if probe == "compute":  # chunk loops only: never rotate the block
+        def body(r, acc):
+            return slice_grams(acc, tbl0, held(r // inner, r % inner))
+
+        acc_a, acc_b = lax.fori_loop(0, inner * outer, body, acc0)
+        x = regularized_solve(
+            acc_a[:local_entities], acc_b[:local_entities],
+            blk["count"], lam, solver, fused=fused_epilogue,
+            algo=reg_solve_algo,
+        )
+        return x
+
+    def inner_rotations(p, carry):
+        """Phase ``p``'s first inner − 1 visits, each ending in an
+        inner-ring rotation (j = 0 .. inner−2)."""
+        def step(j, c):
+            a, b, tbl, bad = c
+            if health:
+                bad = bad | _payload_nonfinite_flag(tbl)
+            (a, b), tbl = _ring_rotate(
+                tbl, inner_perm,
+                lambda cur: slice_grams((a, b), cur, held(p, j)),
+                overlap=overlap,
+            )
+            return a, b, tbl, bad
+
+        return lax.fori_loop(0, inner - 1, step, carry)
+
+    def phase_body(p, c):
+        # inner − 1 inner rotations, then the phase's LAST visit ends in
+        # the one outer (DCN) hop — no lax.cond around the collectives:
+        # the hop is peeled out of the rolled inner loop.
+        a, b, tbl, bad = inner_rotations(p, c)
+        if health:
+            bad = bad | _payload_nonfinite_flag(tbl)
+        (a, b), tbl = _ring_rotate(
+            tbl, outer_perm,
+            lambda cur: slice_grams((a, b), cur, held(p, inner - 1)),
+            overlap=overlap,
+        )
+        return a, b, tbl, bad
+
+    carry = (acc0[0], acc0[1], tbl0, bad0)
+    carry = lax.fori_loop(0, outer - 1, phase_body, carry)
+    # Final phase: inner − 1 inner rotations, then the last visit
+    # consumes the block without a trailing transfer (S − 1 total).
+    a, b, tbl, bad = inner_rotations(outer - 1, carry)
+    if health:
+        bad = bad | _payload_nonfinite_flag(tbl)
+    a, b = slice_grams((a, b), tbl, held(outer - 1, inner - 1))
+    x = regularized_solve(
+        a[:local_entities], b[:local_entities],
+        blk["count"], lam, solver, fused=fused_epilogue,
+        algo=reg_solve_algo,
+    )
+    return (x, bad) if health else x
+
+
 def half_step_tiled_ring(
     fixed_local, blk, chunks, local_entities, *, lam, num_shards,
     solver="cholesky", gram_backend=None, overlap=None, probe=None,
@@ -487,48 +724,10 @@ def half_step_tiled_ring(
     int8 = scale is not None
     my = lax.axis_index(AXIS)
     perm = [(i, (i + 1) % s) for i in range(s)]
-    nb, rt, wt = blk["neighbor_idx"], blk["rating"], blk["weight"]
-    ts, ent = blk["tile_seg"], blk["chunk_entity"]
-    starts = blk["slice_starts"]  # [S+1]
-
-    def slice_grams(acc, tbl, t_idx):
-        factors = tbl[0]
-        scale_blk = tbl[1] if int8 else None
-        # One zero-row append per ring step, not per chunk (the chunk-scan
-        # body would otherwise re-copy the whole block every chunk); the
-        # in-kernel gather skips even that — the kernel DMAs from the raw
-        # rotated block and the weight channel masks the padding rows.
-        if gather == "fused":
-            fz = factors
-        else:
-            fz = jnp.concatenate([
-                factors,
-                _match_varying(
-                    jnp.zeros((1, k), factors.dtype), factors
-                ),
-            ])
-
-        def chunk_body(i, acc):
-            acc_a, acc_b = acc
-            nb_c = lax.dynamic_slice(nb, (i * cap,), (cap,))
-            rt_c = lax.dynamic_slice(rt, (i * cap,), (cap,))
-            wt_c = lax.dynamic_slice(wt, (i * cap,), (cap,))
-            ts_c = lax.dynamic_slice(ts, (i * nt,), (nt,))
-            ent_c = lax.dynamic_slice(ent, (i * e_c,), (e_c,))
-            # int8: fold this block's per-row dequant scale into the 0/1
-            # weight channel (nb is local to the rotated block; the
-            # block-local virtual zero row gets the appended 0 scale).
-            wt_c = quant.fold_scale(wt_c, scale_blk, nb_c)
-            a, b = _entity_gram_chunk(
-                fz, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
-                # the ring is explicit-ALS only; int8 must premultiply
-                # (the fold above IS the dequantize)
-                unit_weights=not int8,
-                zero_appended=gather != "fused", gather=gather,
-            )
-            return (acc_a.at[ent_c].add(a[:e_c]), acc_b.at[ent_c].add(b[:e_c]))
-
-        return lax.fori_loop(starts[t_idx], starts[t_idx + 1], chunk_body, acc)
+    slice_grams = _make_tiled_slice_grams(
+        blk, cap=cap, nt=nt, e_c=e_c, t=t, k=k, backend=backend,
+        gather=gather, int8=int8,
+    )
 
     if probe == "exchange":  # transfers only; factors are a timing sink
         tbl = lax.fori_loop(
@@ -597,7 +796,7 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig,
     tiled = isinstance(dataset.movie_blocks, TiledBlocks)
     if not (bucketed or segment or tiled):
         return None
-    ring = config.exchange == "ring"
+    ring = config.exchange in ("ring", "hier_ring")
     if ring and not tiled:
         name = "bucketed" if bucketed else "segment"
         raise ValueError(
@@ -618,7 +817,7 @@ def gathered_layout_trees(dataset: Dataset, config: ALSConfig,
                     f"config.exchange={config.exchange!r} but the tiled "
                     f"{name}_blocks were built with ring={blocks.ring}; "
                     f"rebuild with Dataset.from_coo(..., layout='tiled', "
-                    f"ring={ring if config.exchange == 'ring' else False})"
+                    f"ring={ring})"
                 )
     if bucketed:
         mtree, m_chunks = _bucketed_to_tree(dataset.movie_blocks)
@@ -773,17 +972,25 @@ def make_training_step(
         from cfk_tpu.ops.tiled import tiled_half_step
 
         def ring_half(chunks, local):
+            ring_kw = dict(
+                lam=config.lam, num_shards=config.num_shards,
+                solver=config.solver, overlap=config.overlap,
+                probe=ring_probe,
+                fused_epilogue=config.fused_epilogue,
+                health=health_probe,
+                in_kernel_gather=config.in_kernel_gather,
+                reg_solve_algo=config.reg_solve_algo,
+                table_dtype=config.table_dtype,
+            )
+
             def half(fixed_local, blk):
+                if config.exchange == "hier_ring":
+                    return half_step_tiled_ring_hier(
+                        fixed_local, blk, chunks, local,
+                        inner=resolve_ici_group(config), **ring_kw,
+                    )
                 return half_step_tiled_ring(
-                    fixed_local, blk, chunks, local,
-                    lam=config.lam, num_shards=config.num_shards,
-                    solver=config.solver, overlap=config.overlap,
-                    probe=ring_probe,
-                    fused_epilogue=config.fused_epilogue,
-                    health=health_probe,
-                    in_kernel_gather=config.in_kernel_gather,
-                    reg_solve_algo=config.reg_solve_algo,
-                    table_dtype=config.table_dtype,
+                    fixed_local, blk, chunks, local, **ring_kw,
                 )
 
             return half

@@ -25,9 +25,11 @@ import json
 import os
 import tempfile
 import time
+import zlib
 
 from cfk_tpu.plan.cost import plan_cost
 from cfk_tpu.plan.spec import (
+    PLAN_FIELDS,
     DeviceSpec,
     ExecutionPlan,
     PlanConstraints,
@@ -45,7 +47,14 @@ def cache_key(shape: ProblemShape, device: DeviceSpec,
               constraints: PlanConstraints | None = None) -> str:
     from cfk_tpu import __version__
 
-    key = f"{shape.shape_class()}|{device.fingerprint()}|v{__version__}"
+    # The PLAN-FIELD SET is part of the key (ISSUE 11): a winner tuned
+    # before a new plan field existed (e.g. offload_tier) carries no
+    # decision for it, so it must read as a MISS — not silently resolve
+    # the new knob to whatever from_dict would default.  crc of the
+    # sorted field names: stable per schema, changes with any field add.
+    fields_tag = zlib.crc32("|".join(sorted(PLAN_FIELDS)).encode())
+    key = (f"{shape.shape_class()}|{device.fingerprint()}|v{__version__}"
+           f"|p{fields_tag:08x}")
     pins = (constraints or PlanConstraints()).pinned()
     if pins:
         # The pins are part of the tuning PROBLEM: a winner measured with

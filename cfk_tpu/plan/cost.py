@@ -142,15 +142,67 @@ def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
         )
         table_rows = shape.num_users + shape.num_movies  # both halves
         wire = table_rows * row_bytes * (shards - 1) / shards
-        # ICI modeled at HBM bandwidth order; overlap hides the exchange
-        # behind compute up to the floor, serial schedules expose it.
-        exch = wire / bw
+        # Intra-domain legs of EVERY exchange are modeled at HBM-bandwidth
+        # order (the pre-planner convention — `ici_bytes_per_s` is kept on
+        # the DeviceSpec for the on-TPU recalibration, ROADMAP backlog
+        # item (f)); only DOMAIN-CROSSING transfers pay `dcn_bytes_per_s`,
+        # so the fabric model is consistent across the three exchanges and
+        # the hierarchy's advantage is exactly its fewer slow-fabric hops.
+        multi_host = bool(device.ici_domain
+                          and shards > device.ici_domain)
+        if plan.exchange == "hier_ring":
+            # Of the S-1 transfers, O·(I-1) rotate inside the domain and
+            # O-1 hop the DCN.  ici_domain=0 means one domain (all inner)
+            # — the schedule and the cost degenerate to the flat ring's.
+            # The inner size modeled here is the DEVICE topology
+            # (ici_domain); execution's resolve_ici_group defaults to the
+            # same physical quantity (devices per process) but an
+            # explicit ALSConfig.ici_group override is invisible to the
+            # model — ici_group is not a plan field (documented; part of
+            # the on-TPU calibration backlog, ROADMAP item (f)).
+            inner = device.ici_domain or shards
+            inner = inner if shards % inner == 0 else shards
+            outer = shards // inner
+            inner_frac = (outer * (inner - 1)) / max(shards - 1, 1)
+            exch = (wire * inner_frac / bw
+                    + wire * (1.0 - inner_frac) / device.dcn_bytes_per_s)
+        elif plan.exchange == "ring" and multi_host:
+            # Bulk-synchronous shift-by-1: EVERY ring step is gated by
+            # its domain-boundary edge, so the whole rotation runs at DCN
+            # speed — the inversion hier_ring exists to fix.
+            exch = wire / device.dcn_bytes_per_s
+        else:
+            exch = wire / bw
+            if multi_host:
+                # all_gather's inbound share crossing domains.
+                exch += (wire / device.ici_domain
+                         / device.dcn_bytes_per_s)
+        # Overlap hides the exchange behind compute up to the floor,
+        # serial schedules expose it.
         if plan.overlap:
             exposed = max(0.0, exch - floor * 0.5)
         else:
             exposed = exch
         terms["exchange_exposed"] = exposed
         extra += exposed
+
+    # Out-of-core tier (ISSUE 11): every half-iteration stages the fixed
+    # side's windows over PCIe — the full table once per half-step, plus
+    # the duplication of rows shared between adjacent windows (~15% on
+    # power-law data).  The staging double buffer hides it under compute
+    # up to the floor exactly like the exchange term.
+    if plan.offload_tier == "host_window":
+        stage_bytes_per_row = k * (2.0 if plan.table_dtype == "bfloat16"
+                                   else factor_bytes)
+        window_dup = 1.15
+        pcie = ((shape.num_users + shape.num_movies) * stage_bytes_per_row
+                * window_dup / device.pcie_bytes_per_s)
+        if plan.overlap:
+            exposed_pcie = max(0.0, pcie - floor * 0.5)
+        else:
+            exposed_pcie = pcie
+        terms["host_window_pcie"] = exposed_pcie
+        extra += exposed_pcie
 
     # Chunking overhead: each chunk pays a fixed dispatch cost (scan step
     # + DMA setup), so tiny chunks are overhead-bound; oversized chunks

@@ -42,7 +42,7 @@ from cfk_tpu.plan.spec import (
 
 _TRAIN_FIELDS = ("layout", "exchange", "chunk_elems", "fused_epilogue",
                  "in_kernel_gather", "overlap", "reg_solve_algo",
-                 "table_dtype", "solver", "gram_backend")
+                 "table_dtype", "solver", "gram_backend", "offload_tier")
 _SERVE_FIELDS = ("table_dtype", "serve_batch_quantum", "serve_tile_m")
 
 
@@ -59,13 +59,34 @@ def hard_conflict(shape: ProblemShape, pins: dict) -> str | None:
     if pins.get("exchange") == "ring" and layout in ("bucketed", "segment"):
         return (f"exchange='ring' supports the padded/tiled layouts; "
                 f"pinned layout={layout!r}")
+    if pins.get("exchange") == "hier_ring" and layout not in (None, "tiled"):
+        return (f"exchange='hier_ring' is implemented for the tiled "
+                f"layout; pinned layout={layout!r}")
+    if pins.get("offload_tier") == "host_window":
+        if shape.kind != "train":
+            return ("offload_tier='host_window' is a TRAINING tier; "
+                    "serve shapes keep the item table device-resident "
+                    "by construction — unpin it for a serve resolve")
+        if layout not in (None, "tiled"):
+            return (f"offload_tier='host_window' streams the tiled "
+                    f"stream-mode layout; pinned layout={layout!r}")
+        if shape.algorithm != "als" or shape.implicit:
+            return ("offload_tier='host_window' supports explicit ALS; "
+                    f"algorithm={shape.algorithm!r}"
+                    f"{' (implicit)' if shape.implicit else ''} needs the "
+                    "out-of-core global-Gram reduction (ROADMAP)")
+        if shape.num_shards != 1:
+            return ("offload_tier='host_window' is a single-process "
+                    f"driver (num_shards={shape.num_shards}); the sharded "
+                    "pairing with the hier ring is the ROADMAP follow-up")
     if shape.algorithm != "als":
         if layout in ("segment", "tiled"):
             return (f"algorithm={shape.algorithm!r} supports padded/"
                     f"bucketed layouts; pinned layout={layout!r}")
-        if pins.get("exchange") == "ring":
+        if pins.get("exchange") in ("ring", "hier_ring"):
             return (f"algorithm={shape.algorithm!r} supports "
-                    "exchange='all_gather' only; pinned exchange='ring'")
+                    "exchange='all_gather' only; pinned "
+                    f"exchange={pins['exchange']!r}")
     return None
 
 
@@ -78,12 +99,28 @@ def _feasible(shape: ProblemShape, device: DeviceSpec, cand: dict,
         return "int8 table needs a weight stream (tiled/bucketed)"
     if cand["exchange"] == "ring" and layout not in ("padded", "tiled"):
         return "ring exchange needs the padded/tiled layouts"
-    if shape.num_shards == 1 and cand["exchange"] == "ring":
-        return "ring exchange is a multi-shard schedule"
+    if cand["exchange"] == "hier_ring" and layout != "tiled":
+        return "hier_ring exchange is implemented for the tiled layout"
+    if shape.num_shards == 1 and cand["exchange"] != "all_gather":
+        return "ring exchanges are multi-shard schedules"
     if shape.algorithm != "als" and layout in ("segment", "tiled"):
         return "subspace optimizers need padded/bucketed"
     if shape.algorithm != "als" and cand["exchange"] != "all_gather":
         return "subspace optimizers are all_gather only"
+    if cand["offload_tier"] == "host_window" and shape.kind == "train":
+        if layout != "tiled":
+            return "host-window offload streams the tiled stream layout"
+        if shape.algorithm != "als" or shape.implicit:
+            return ("host-window offload supports explicit ALS (the "
+                    "implicit/subspace global-Gram reductions are the "
+                    "ROADMAP follow-up)")
+        if shape.num_shards != 1:
+            return ("host-window offload is a single-process driver — "
+                    "no executor accepts a sharded host_window plan")
+        if cand["exchange"] != "all_gather":
+            return ("host-window offload is a single-process driver "
+                    "(all_gather exchange; the hier ring is the "
+                    "multi-chip pairing, ROADMAP)")
     mosaic = _registry.backend_available("mosaic_tpu")
     if cand["gram_backend"] == "pallas" and not mosaic:
         return "mosaic_tpu backend unavailable"
@@ -131,6 +168,8 @@ _SOFT_PINS = (
     ("in_kernel_gather", True, dict(fused_epilogue=False)),
     ("exchange", "ring", dict(fused_epilogue=False,
                               in_kernel_gather=False)),
+    ("exchange", "hier_ring", dict(fused_epilogue=False,
+                                   in_kernel_gather=False)),
 )
 
 
@@ -160,7 +199,7 @@ def _soft_release(shape, device, pins, explain):
 
 
 def candidates(shape: ProblemShape, constraints: PlanConstraints,
-               ) -> "itertools.product":
+               device: DeviceSpec | None = None) -> "itertools.product":
     """(field order, value tuples) for the free-field product."""
     fields = _SERVE_FIELDS if shape.kind == "serve" else _TRAIN_FIELDS
     pins = constraints.pinned()
@@ -172,9 +211,48 @@ def candidates(shape: ProblemShape, constraints: PlanConstraints,
             vals = PLAN_FIELDS[f]
             if f == "exchange" and shape.num_shards == 1:
                 vals = ("all_gather",)
+            if f == "offload_tier":
+                # The axis IS the memory-budget predicate (ISSUE 11): a
+                # fitting problem enumerates only the resident tier (the
+                # legacy default, zero extra candidates), an oversized one
+                # only host_window — so the resolver can never promise a
+                # resident table the executor's own predicate refuses.
+                # Workloads the windowed driver cannot serve (serve kind,
+                # implicit/subspace optimizers, sharded) keep the legacy
+                # resident tier regardless — the budget cannot re-route
+                # them (and a pinned 'device' there is never refused:
+                # _rank_plans' budget raise shares THIS eligibility).
+                vals = (("host_window",)
+                        if (_host_window_eligible(shape, pins)
+                            and device is not None
+                            and not _fits_device(
+                                shape, device,
+                                table_dtype=pins.get("table_dtype")))
+                        else ("device",))
             axes.append((f, vals))
     names = [f for f, _ in axes]
     return names, itertools.product(*[v for _, v in axes])
+
+
+def _fits_device(shape: ProblemShape, device: DeviceSpec,
+                 table_dtype: str | None = None) -> bool:
+    from cfk_tpu.offload.budget import shape_fits_device
+
+    return shape_fits_device(shape, device, table_dtype=table_dtype)
+
+
+def _host_window_eligible(shape: ProblemShape, pins: dict) -> bool:
+    """Whether the host_window tier is an ALTERNATIVE for this resolve —
+    the one eligibility both the offload_tier axis and the pinned-device
+    budget raise consult, so an explicit ``offload_tier='device'`` pin is
+    refused exactly when unpinning it would have re-routed (and never
+    with a dead-end remedy on shapes the windowed driver cannot serve)."""
+    return (shape.kind == "train"
+            and shape.algorithm == "als"
+            and not shape.implicit
+            and shape.num_shards == 1
+            and pins.get("layout") in (None, "tiled")
+            and pins.get("exchange") in (None, "all_gather"))
 
 
 def _assemble(shape: ProblemShape, cand: dict, pinned: frozenset,
@@ -216,9 +294,29 @@ def _rank_plans(shape: ProblemShape, device: DeviceSpec,
     conflict = hard_conflict(shape, pins)
     if conflict is not None:
         raise PlanConstraintError(conflict)
+    if (pins.get("offload_tier") == "device"
+            and _host_window_eligible(shape, pins)
+            and not _fits_device(shape, device,
+                                 table_dtype=pins.get("table_dtype"))):
+        # The core ISSUE 11 guarantee: no plan may promise a resident
+        # table the memory-budget predicate (offload.budget — the SAME
+        # predicate the executor uses) says cannot exist.
+        from cfk_tpu.offload.budget import train_resident_bytes
+
+        need = train_resident_bytes(
+            shape.num_users, shape.num_movies, shape.nnz, shape.rank,
+            dtype=shape.dtype, table_dtype=pins.get("table_dtype"),
+        )["total"]
+        raise PlanConstraintError(
+            f"offload_tier='device' pinned but the resident working set "
+            f"(~{need / 1e9:.2f} GB) exceeds the device budget "
+            f"({device.hbm_bytes / 1e9:.2f} GB × budget fraction) — "
+            "unpin offload_tier (the resolver will pick 'host_window') "
+            "or shrink the problem"
+        )
     pins = _soft_release(shape, device, pins, explain)
     constraints = PlanConstraints(**pins)
-    names, prod = candidates(shape, constraints)
+    names, prod = candidates(shape, constraints, device)
     pinned = frozenset(pins)
     ranked = []
     for idx, values in enumerate(prod):

@@ -44,7 +44,10 @@ class PlanConstraintError(ValueError):
 # pre-planner behavior.
 PLAN_FIELDS: dict[str, tuple] = {
     "layout": ("tiled", "bucketed", "padded", "segment"),
-    "exchange": ("all_gather", "ring"),
+    # "hier_ring" (ISSUE 11): the ICI-ring-within-DCN-ring schedule —
+    # inner rings rotate device-resident slices, outer hops cross the
+    # slower fabric once per phase (parallel.spmd.half_step_tiled_ring_hier).
+    "exchange": ("all_gather", "ring", "hier_ring"),
     # 64k is the measured-best full-scale chunk (BENCH r4) AND the largest
     # class that fits the in-kernel gather's scalar-prefetch SMEM gate.
     "chunk_elems": (1 << 20, 1 << 16, 1 << 18, 1 << 22),
@@ -57,6 +60,14 @@ PLAN_FIELDS: dict[str, tuple] = {
     "gram_backend": ("pallas", "xla"),
     "serve_batch_quantum": (8, 16, 32, 64, 128, 256),
     "serve_tile_m": (512,),
+    # Out-of-core tier (ISSUE 11): "device" keeps both factor tables
+    # HBM-resident (feasible ONLY while cfk_tpu.offload.budget's predicate
+    # passes — the same predicate the executor sizes windows with);
+    # "host_window" keeps them in host RAM and streams device_put windows
+    # (cfk_tpu.offload.windowed).  The resolver's enumeration axis is the
+    # predicate itself, so oversized problems resolve to host_window
+    # instead of promising a resident table that cannot exist.
+    "offload_tier": ("device", "host_window"),
 }
 
 # Fields whose pins are free-form positive ints (the candidate tuples
@@ -134,6 +145,17 @@ class DeviceSpec:
     gather_rows_per_s: float = _V5E["gather_rows_per_s"]
     vmem_bytes: int = 96 << 20  # the gram kernels' resident-output cap
     smem_bytes: int = 512 << 10  # _GATHER_SMEM_BYTES_CAP
+    # Fabric tiers the offload/hier-exchange terms price (ISSUE 11).
+    # ALL THREE ARE OFF-TPU GUESSES pending the on-TPU validation backlog
+    # (ROADMAP): PCIe ≈ gen4 ×16 effective, ICI ≈ one v5e link pair,
+    # DCN ≈ per-host data-center NIC share.  Off-TPU the model only RANKS,
+    # so the ratios (PCIe ≪ HBM, DCN ≪ ICI) are what matter.
+    pcie_bytes_per_s: float = 32e9
+    ici_bytes_per_s: float = 90e9
+    dcn_bytes_per_s: float = 25e9
+    # Devices per ICI domain (host): the hier-ring cost term's inner-ring
+    # size.  0 = all devices share one ICI domain (single host).
+    ici_domain: int = 0
 
     # Nominal host-CPU numbers: a memory-bandwidth-bound machine with no
     # dedicated gather engine (rows/s set high enough never to bind —
@@ -193,6 +215,7 @@ class PlanConstraints:
     gram_backend: str | None = None
     serve_batch_quantum: int | None = None
     serve_tile_m: int | None = None
+    offload_tier: str | None = None
 
     def __post_init__(self) -> None:
         for f, candidates in PLAN_FIELDS.items():
@@ -254,6 +277,9 @@ def constraints_from_config(config) -> PlanConstraints:
                         else config.reg_solve_algo),
         table_dtype=config.table_dtype,
         solver=None if config.solver == "auto" else config.solver,
+        offload_tier=(None
+                      if getattr(config, "offload_tier", "auto") == "auto"
+                      else config.offload_tier),
     )
 
 
@@ -276,6 +302,10 @@ class ExecutionPlan:
     gram_backend: str
     serve_batch_quantum: int = 8
     serve_tile_m: int = 512
+    # Out-of-core tier (ISSUE 11): "device" = HBM-resident factor tables,
+    # "host_window" = host-RAM stores + device_put-pipelined windows
+    # (cfk_tpu.offload) — gated by offload.budget's fit predicate.
+    offload_tier: str = "device"
     # (slot, backend) pairs — "mosaic_tpu" | "xla_emulation" per kernel
     # slot (cfk_tpu.plan.registry.KERNEL_SLOTS).
     kernels: tuple = ()
@@ -315,7 +345,10 @@ class ExecutionPlan:
     def summary(self) -> str:
         """Compact one-line description (bench rows, metrics notes)."""
         kb = ",".join(f"{s}={b.split('_')[0]}" for s, b in self.kernels)
-        return (f"{self.layout}/{self.exchange} chunk={self.chunk_elems} "
+        tier = ("" if self.offload_tier == "device"
+                else f"tier={self.offload_tier} ")
+        return (f"{tier}{self.layout}/{self.exchange} "
+                f"chunk={self.chunk_elems} "
                 f"fused={'on' if self.fused_epilogue else 'off'} "
                 f"gather={'fused' if self.in_kernel_gather else 'xla'} "
                 f"overlap={'on' if self.overlap else 'off'} "

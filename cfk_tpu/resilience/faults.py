@@ -171,6 +171,101 @@ class FaultInjector:
         return sum(f.fired for f in self.faults)
 
 
+# --- host-window faults (cfk_tpu.offload, ISSUE 11) ------------------------
+
+
+@dataclasses.dataclass
+class HostWindowCorruption:
+    """Corrupt ONE staged host window in flight (PCIe bit-rot / a torn
+    host read) before it reaches the device.  Fires when the windowed
+    driver stages ``(iteration, side, window)``; the host store itself
+    stays intact, so a rollback + replay (the fault is one-shot) recovers
+    to bit-exact factors — the transient-fault contract of the ladder's
+    rung 1.
+
+    ``kind="nan"`` poisons ``num_rows`` seeded rows; ``kind="torn"``
+    replaces the window's second half with stale zeros (a partially
+    completed staging read — values are WRONG but finite, caught by the
+    row-norm watchdog or the divergence it causes rather than isfinite).
+    """
+
+    iteration: int
+    side: str = "m"  # which half-step's staging ("m" | "u")
+    window: int = 0
+    kind: str = "nan"  # "nan" | "torn"
+    num_rows: int = 4
+    seed: int = 0
+    persistent: bool = False
+    fired: int = 0
+
+    def apply_window(self, i: int, side: str, w: int,
+                     tbl: np.ndarray) -> np.ndarray:
+        if (i != self.iteration or side != self.side or w != self.window
+                or (self.fired and not self.persistent)):
+            return tbl
+        self.fired += 1
+        tbl = np.array(tbl)  # never mutate the store's rows
+        if self.kind == "torn":
+            tbl[tbl.shape[0] // 2:] = 0.0
+            return tbl
+        rows = np.random.default_rng(self.seed).choice(
+            tbl.shape[0], size=min(self.num_rows, tbl.shape[0]),
+            replace=False,
+        )
+        tbl[rows] = np.float32(np.nan)
+        return tbl
+
+
+@dataclasses.dataclass
+class SlowHostFetch:
+    """Delay plan for window staging (a contended host / remote-NUMA
+    fetch):
+    sleep ``delay_s`` before every ``every``-th staging.  Purely a timing
+    fault — the double-buffered driver must absorb it without touching
+    the math (the chaos scenario pins bit-exact factors under delay).
+    ``fired`` counts DELAYS actually injected (not staging calls — the
+    chaos row's fault accounting must not inflate)."""
+
+    delay_s: float = 0.01
+    every: int = 1
+    fired: int = 0
+    calls: int = 0
+
+    def delay(self, i: int, side: str, w: int) -> None:
+        if self.every < 1:
+            return
+        self.calls += 1
+        if self.calls % self.every == 0:
+            time.sleep(self.delay_s)
+            self.fired += 1
+
+
+class WindowFaultInjector:
+    """The hook ``offload.windowed`` calls while staging: applies every
+    armed window corruption and delay plan.  The window-level analog of
+    ``FaultInjector`` (which operates on factor buffers at step
+    boundaries)."""
+
+    def __init__(self, *faults):
+        self.faults = list(faults)
+
+    def apply_window(self, i: int, side: str, w: int,
+                     tbl: np.ndarray) -> np.ndarray:
+        for f in self.faults:
+            if hasattr(f, "apply_window"):
+                tbl = f.apply_window(i, side, w, tbl)
+        return tbl
+
+    def delay(self, i: int, side: str, w: int) -> None:
+        for f in self.faults:
+            if hasattr(f, "delay"):
+                f.delay(i, side, w)
+
+    @property
+    def fired(self) -> int:
+        return sum(f.fired for f in self.faults)
+
+
 # --- checkpoint faults -----------------------------------------------------
 
 

@@ -183,6 +183,18 @@ def make_parser() -> argparse.ArgumentParser:
                    help="open-loop request count for --serve on")
     p.add_argument("--serve-tile-m", type=int, default=512,
                    help="movie-axis tile rows of the serve kernel")
+    p.add_argument("--offload", default=None,
+                   choices=[None, "device", "host_window"],
+                   help="out-of-core axis (ISSUE 11): run the SAME "
+                   "stream-forced tiled workload with HBM-resident "
+                   "tables ('device') or host-RAM stores + windowed "
+                   "device_put staging ('host_window'); rows carry a "
+                   "factors crc32 so the tier-1 smoke pins windowed == "
+                   "resident bit-exactness")
+    p.add_argument("--offload-window-chunks", type=int, default=4,
+                   help="chunks per staged window on the host_window tier")
+    p.add_argument("--offload-budget-mb", type=float, default=None,
+                   help="artificial device budget (MB) for window sizing")
     p.add_argument("--plan", default=None,
                    choices=[None, "model", "autotune", "pinned"],
                    help="execution-planner axis (cfk_tpu.plan, ISSUE 9): "
@@ -453,11 +465,132 @@ def _resolve_plan_axis(args, make_steps, mblocks, ublocks, u0, m0):
     return prov, knobs_for(ep)
 
 
+def run_offload_lab(args) -> dict:
+    """The ``--offload`` axis (ISSUE 11): time full training iterations on
+    one tier — resident tables ('device', the plain trainer) or host-RAM
+    stores with windowed staging ('host_window', ``cfk_tpu.offload``) —
+    over the SAME stream-forced tiled blocks, so the two rows differ ONLY
+    in where the factor tables live.  Each row carries the final factors'
+    crc32: the tier-1 smoke (``test_offload_axis_row``) runs both values
+    and pins crc equality — the in-memory proof of the windowed ==
+    resident bit-exactness contract."""
+    import zlib
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synth import synth_coo
+    from cfk_tpu.models.als import train_als
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.utils.metrics import Metrics
+    from cfk_tpu.utils.roofline import als_iteration_cost, roofline_row
+
+    if args.layout != "tiled":
+        raise SystemExit(
+            "--offload runs the stream-forced tiled layout; pass "
+            "--layout tiled"
+        )
+    coo = synth_coo(args.users, args.movies, args.nnz, seed=args.seed)
+    ds = Dataset.from_coo(
+        coo, layout="tiled", chunk_elems=args.chunk_elems,
+        tile_rows=args.tile_rows, accum_max_entities=0,
+    )
+    cfg = ALSConfig(
+        rank=args.rank, lam=0.05, num_iterations=args.iters, seed=0,
+        layout="tiled", dtype=args.dtype, table_dtype=args.table_dtype,
+        solver=args.solver, overlap=args.overlap == "on",
+        fused_epilogue=None if args.fused == "on" else False,
+        in_kernel_gather=None if args.gather == "fused" else False,
+        hbm_chunk_elems=args.chunk_elems,
+        # Pin the axis value into the config so the device arm cannot
+        # silently re-plan onto host_window (the same mislabeling guard
+        # as bench.py's scale sweep).
+        offload_tier=args.offload,
+    )
+    metrics = Metrics()
+    budget = (args.offload_budget_mb * 1e6
+              if args.offload_budget_mb is not None else None)
+
+    def run(cfg_n=None):
+        c = cfg if cfg_n is None else cfg_n
+        if args.offload == "host_window":
+            return train_als_host_window(
+                ds, c, metrics=metrics,
+                chunks_per_window=args.offload_window_chunks,
+                device_budget_bytes=budget,
+            )
+        return train_als(ds, c)
+
+    # Two-point (1 vs N iterations) fit, exactly like bench's scale rows:
+    # each trainer call pays a fixed per-call cost — the device arm's
+    # block upload, the host_window arm's window PLANNING (window.py is a
+    # build-time cost, paid once per dataset in production) — and
+    # differencing cancels it, so the per-iteration number compares the
+    # tiers on iteration cost alone.
+    import dataclasses as _dc
+
+    cfg1 = _dc.replace(cfg, num_iterations=1)
+    t0 = time.time()
+    model = run()
+    compile_s = time.time() - t0
+    print(f"# first call (compile+run): {compile_s:.2f}s", flush=True)
+    run(cfg1)
+    t_n, t_1 = [], []
+    for _ in range(args.repeats):
+        t0 = time.time()
+        run(cfg1)
+        t_1.append(time.time() - t0)
+        t0 = time.time()
+        model = run()
+        np.asarray(model.user_factors[:1])
+        t_n.append(time.time() - t0)
+    n1 = max(args.iters, 1)
+    per_iter = [
+        max(tn - t1_, 1e-9) / max(n1 - 1, 1)
+        for tn, t1_ in zip(t_n, t_1)
+    ] if n1 > 1 else [t / n1 for t in t_n]
+    crc = zlib.crc32(
+        np.asarray(model.user_factors, np.float32).tobytes()
+    ) & 0xFFFFFFFF
+    best = min(per_iter)
+    cost = als_iteration_cost(
+        args.nnz, args.users, args.movies, args.rank,
+        factor_bytes=2 if args.dtype == "bfloat16" else 4,
+        table_dtype=args.table_dtype,
+    )
+    row = {
+        "offload": args.offload,
+        "s_per_iter_min": round(best, 4),
+        "s_per_iter_median": round(sorted(per_iter)[len(per_iter) // 2], 4),
+        **roofline_row(cost, best, table_dtype=args.table_dtype),
+        "layout": args.layout, "solver": args.solver,
+        "chunk_elems": args.chunk_elems, "dtype": args.dtype,
+        "rank": args.rank, "iters_per_call": args.iters,
+        "overlap": args.overlap, "fused": args.fused,
+        "gather": args.gather,
+        "factors_crc32": crc,
+    }
+    if args.offload == "host_window":
+        row.update({
+            "windows_m": metrics.gauges.get("offload_windows_m"),
+            "windows_u": metrics.gauges.get("offload_windows_u"),
+            "window_rows_m": metrics.gauges.get("offload_window_rows_m"),
+            "window_rows_u": metrics.gauges.get("offload_window_rows_u"),
+            "chunks_per_window": metrics.gauges.get(
+                "offload_chunks_per_window"
+            ),
+            "staged_mb_per_run": metrics.gauges.get("offload_staged_mb"),
+        })
+    print(json.dumps(row))
+    return row
+
+
 def run_lab(args) -> dict:
     """Measure and return the result row (also printed as the last JSON
     line — the scoreboard contract ``tests/test_perf_lab.py`` pins)."""
     import jax
 
+    if args.offload:
+        return run_offload_lab(args)
     if args.serve == "on":
         return run_serve_lab(args)
     if args.foldin == "on":

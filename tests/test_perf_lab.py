@@ -30,6 +30,7 @@ def _args(**over):
         foldin="off", foldin_updates=4096, foldin_batch_records=256,
         serve="off", serve_batch=64, serve_k=10, serve_requests=512,
         serve_tile_m=512,
+        offload=None, offload_window_chunks=4, offload_budget_mb=None,
         plan=None, plan_cache=None,
         iters=2, repeats=3, profile_dir=None,
     )
@@ -216,6 +217,32 @@ def test_plan_axis_row(tmp_path, monkeypatch, capsys):
     ))
     assert pinned["plan_source"] == "pinned"
     assert pinned["table_dtype"] == "float32"  # legacy threading kept
+
+
+def test_offload_axis_row(tmp_path, monkeypatch, capsys):
+    # the out-of-core axis (ISSUE 11): the tier-1 in-memory smoke of the
+    # whole store→window-plan→stage→windowed-half-step→host-scatter loop,
+    # mirroring test_plan_axis_row's role for the planner.  Both tier
+    # values run the SAME stream-forced tiled workload; crc equality IS
+    # the windowed == resident bit-exactness contract.
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    base = dict(layout="tiled", users=200, movies=60, nnz=1500,
+                chunk_elems=512, tile_rows=16, rank=8, iters=2, repeats=2)
+    dev = perf_lab.run_lab(_args(offload="device", **base))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == dev  # scoreboard contract holds here too
+    assert dev["offload"] == "device"
+    assert dev["s_per_iter_min"] >= 0
+    assert dev["factors_crc32"] > 0
+
+    win = perf_lab.run_lab(_args(offload="host_window",
+                                 offload_window_chunks=2, **base))
+    assert win["offload"] == "host_window"
+    assert win["windows_m"] >= 1 and win["windows_u"] >= 1
+    assert win["window_rows_m"] >= 8
+    assert win["staged_mb_per_run"] > 0
+    # windowed == resident, bit-exact — the ISSUE 11 acceptance contract
+    assert win["factors_crc32"] == dev["factors_crc32"]
 
 
 def test_serve_axis_row(tmp_path, monkeypatch, capsys):

@@ -1,0 +1,131 @@
+"""Counter-based power-law generator (cfk_tpu.data.synth, ISSUE 11):
+deterministic by construction — same spec ⇒ same bits on any chunking,
+shard split, or process — plus power-law shape sanity."""
+
+import numpy as np
+import pytest
+
+from cfk_tpu.data.synth import (
+    PowerLawSynth,
+    SynthSpec,
+    synth_coo,
+    zipf_cdf,
+)
+
+SPEC = SynthSpec(num_users=500, num_movies=80, nnz=6_000, seed=7)
+
+
+def test_seed_determinism_crc():
+    # Two independent generators of the same spec: identical record crc.
+    a = PowerLawSynth(SPEC).crc32()
+    b = PowerLawSynth(SPEC).crc32()
+    assert a == b
+    # A different seed is a different stream.
+    assert a != PowerLawSynth(
+        SynthSpec(num_users=500, num_movies=80, nnz=6_000, seed=8)
+    ).crc32()
+
+
+def test_crc_is_chunking_invariant():
+    g = PowerLawSynth(SPEC)
+    assert g.crc32(chunk_elems=SPEC.nnz) == g.crc32(chunk_elems=7)
+    assert g.crc32(chunk_elems=SPEC.nnz) == g.crc32(chunk_elems=1024)
+
+
+def test_chunks_tile_the_stream_bit_exactly():
+    # chunk(lo, hi) is a pure function of the index range: any partition
+    # concatenates to the whole stream, bit for bit.
+    g = PowerLawSynth(SPEC)
+    u0, m0, r0 = g.chunk(0, SPEC.nnz)
+    cuts = [0, 13, 1000, 1001, 4096, SPEC.nnz]
+    parts = [g.chunk(lo, hi) for lo, hi in zip(cuts, cuts[1:])]
+    np.testing.assert_array_equal(np.concatenate([p[0] for p in parts]), u0)
+    np.testing.assert_array_equal(np.concatenate([p[1] for p in parts]), m0)
+    np.testing.assert_array_equal(np.concatenate([p[2] for p in parts]), r0)
+
+
+@pytest.mark.parametrize("num_shards", [2, 3, 8])
+def test_shard_ranges_are_bit_identical_across_shard_counts(num_shards):
+    # The per-shard generation contract: shard ranges tile [0, nnz) and
+    # every shard's slice equals the same slice of the 1-shard stream —
+    # "bit-identical blocks across shard counts" at the generator level.
+    g = PowerLawSynth(SPEC)
+    whole = g.chunk(0, SPEC.nnz)
+    cursor = 0
+    for s in range(num_shards):
+        lo, hi = SPEC.shard_range(s, num_shards)
+        assert lo == cursor
+        cursor = hi
+        u, m, r = g.chunk(lo, hi)
+        np.testing.assert_array_equal(u, whole[0][lo:hi])
+        np.testing.assert_array_equal(m, whole[1][lo:hi])
+        np.testing.assert_array_equal(r, whole[2][lo:hi])
+    assert cursor == SPEC.nnz
+
+
+def test_blocks_bit_identical_across_generation_shard_counts():
+    # Building blocks from a 1-chunk COO vs a COO assembled from 4 shard
+    # ranges: identical datasets, hence identical block bytes.
+    from cfk_tpu.data.blocks import Dataset, RatingsCOO
+
+    g = PowerLawSynth(SPEC)
+    one = g.coo()
+    parts = [g.chunk(*SPEC.shard_range(s, 4)) for s in range(4)]
+    four = RatingsCOO(
+        user_raw=np.concatenate([p[0] for p in parts]),
+        movie_raw=np.concatenate([p[1] for p in parts]),
+        rating=np.concatenate([p[2] for p in parts]),
+    )
+    ds1 = Dataset.from_coo(one, layout="tiled", chunk_elems=512,
+                           tile_rows=16, accum_max_entities=0)
+    ds4 = Dataset.from_coo(four, layout="tiled", chunk_elems=512,
+                           tile_rows=16, accum_max_entities=0)
+    for name in ("neighbor_idx", "rating", "weight", "tile_seg",
+                 "chunk_entity", "chunk_count", "carry_in", "last_seg"):
+        np.testing.assert_array_equal(
+            getattr(ds1.movie_blocks, name), getattr(ds4.movie_blocks, name)
+        )
+        np.testing.assert_array_equal(
+            getattr(ds1.user_blocks, name), getattr(ds4.user_blocks, name)
+        )
+
+
+def test_power_law_shape_sanity():
+    # Zipf skew must show: the hottest decile of movies carries far more
+    # than a uniform share of ratings, and the hot side dominates the
+    # cold tail.  Loose bounds — shape sanity, not a fit.
+    g = PowerLawSynth(SynthSpec(num_users=2_000, num_movies=400,
+                                nnz=40_000, seed=0))
+    _, m, _ = g.chunk(0, 40_000)
+    counts = np.bincount(m - 1, minlength=400).astype(np.float64)
+    top = np.sort(counts)[::-1]
+    top_decile_share = top[:40].sum() / counts.sum()
+    assert top_decile_share > 0.3  # uniform would give 0.1
+    assert top[0] > 10 * max(np.median(counts), 1.0)
+
+
+def test_ratings_are_one_to_five():
+    _, _, r = PowerLawSynth(SPEC).chunk(0, SPEC.nnz)
+    assert r.dtype == np.float32
+    assert r.min() >= 1.0 and r.max() <= 5.0
+    assert set(np.unique(r)) <= {1.0, 2.0, 3.0, 4.0, 5.0}
+
+
+def test_zipf_cdf_and_validation():
+    cdf = zipf_cdf(10, 0.9)
+    assert cdf.shape == (10,)
+    assert cdf[-1] == 1.0
+    assert (np.diff(cdf) > 0).all()
+    with pytest.raises(ValueError):
+        SynthSpec(num_users=0, num_movies=1, nnz=1)
+    with pytest.raises(ValueError):
+        PowerLawSynth(SPEC).chunk(5, 4)
+    with pytest.raises(ValueError):
+        SPEC.shard_range(3, 3)
+
+
+def test_synth_coo_convenience():
+    coo = synth_coo(100, 20, 500, seed=1)
+    assert coo.num_ratings == 500
+    assert coo.user_raw.min() >= 1 and coo.user_raw.max() <= 100
+    assert coo.movie_raw.min() >= 1 and coo.movie_raw.max() <= 20
