@@ -806,22 +806,32 @@ def scale_sweep_main(args) -> None:
 
 
 def run_scale_sweep(args) -> dict:
-    """``--scale-sweep`` (ISSUE 11): s/iter and ratings/sec/chip vs problem
-    size across the resident→windowed offload tiers.
+    """``--scale-sweep`` (ISSUE 11/12): s/iter and ratings/sec/chip vs
+    problem size — and SHARD COUNT (``--sweep-shards``) — across the
+    resident→windowed offload tiers.
 
     Each point generates a counter-based power-law corpus
     (``cfk_tpu.data.synth`` — chunk/shard-invariant, so the same spec is
-    reproducible at any scale), builds stream-mode tiled blocks, resolves
-    the execution plan against a device whose HBM budget is
-    ``--sweep-budget-mb`` (default: the detected device), and trains
-    through whichever tier the planner picked — ``device`` (resident
-    tables, the plain trainer) or ``host_window`` (host stores + windowed
-    staging, ``cfk_tpu.offload``).  Every row records the memory-budget
-    math the decision was made from: resident-working-set bytes vs the
-    device budget, and the staged-window bytes vs the per-window budget.
-    The planner — not the sweep — decides the tier, so the sweep doubles
-    as the acceptance check that oversized shapes resolve to host_window
-    with provenance instead of OOMing.
+    reproducible at any scale), builds stream-mode tiled blocks at the
+    point's shard count, resolves the execution plan against a device
+    whose HBM budget is ``--sweep-budget-mb`` (default: the detected
+    device), and trains through whichever tier the planner picked —
+    ``device`` (resident tables, the plain/sharded trainer) or
+    ``host_window`` (host stores + per-shard windowed staging,
+    ``cfk_tpu.offload``).  Every row records the PER-SHARD memory-budget
+    math the decision was made from (tables and blocks divide across
+    shards; the all_gather working copy replicates — which is why an
+    oversized fixed side still routes to host_window at 2+ shards), the
+    staged bytes per table dtype (``--sweep-table-dtypes`` — int8 ships
+    (codes, scales) at ~¼ the f32 bytes), and the device↔host_window
+    crossing per shard count.  The planner — not the sweep — decides the
+    tier, so the sweep doubles as the acceptance check that oversized
+    shapes resolve to host_window with provenance instead of OOMing.
+
+    A device-tier point at shards > the available jax device count
+    records its budget math and tier but skips the timing (the resident
+    arm needs a real/virtual mesh; the windowed arm never does — it is a
+    host driver).
     """
     import dataclasses as _dc
 
@@ -835,11 +845,19 @@ def run_scale_sweep(args) -> dict:
     from cfk_tpu.plan.resolver import shape_for_config
     from cfk_tpu.utils.metrics import Metrics
 
+    import jax as _jax
+
     device = DeviceSpec.detect()
     if args.sweep_budget_mb is not None:
         device = _dc.replace(device, hbm_bytes=args.sweep_budget_mb * 1e6)
     scales = [float(s) for s in str(args.sweep_scales).split(",") if s]
+    shard_counts = [int(s) for s in
+                    str(getattr(args, "sweep_shards", "1")).split(",") if s]
+    dtypes = [d for d in
+              str(getattr(args, "sweep_table_dtypes",
+                          "float32")).split(",") if d]
     rows = []
+    tier_by_point: dict[str, str] = {}
     for sc in scales:
         users = max(int(args.users * sc), 16)
         movies = max(int(args.movies * sc), 8)
@@ -850,117 +868,182 @@ def run_scale_sweep(args) -> dict:
                       seed=args.seed)
         ).coo()
         gen_s = time.time() - t0
-        t0 = time.time()
-        ds = Dataset.from_coo(
-            coo, layout="tiled", chunk_elems=args.chunk_elems,
-            tile_rows=args.sweep_tile_rows, accum_max_entities=0,
-        )
-        build_s = time.time() - t0
-        config = ALSConfig(
-            rank=args.rank, lam=args.lam,
-            num_iterations=args.iterations, seed=0, layout="tiled",
-            dtype=args.dtype, hbm_chunk_elems=args.chunk_elems,
-        )
-        shape = shape_for_config(
-            config, num_users=ds.user_map.num_entities,
-            num_movies=ds.movie_map.num_entities, nnz=nnz,
-        )
-        ep, prov = plan(shape, device, constraints_from_config(config))
-        tier = ep.offload_tier
-        # The budget math is recorded from the SAME counts the planner
-        # decided on (the dataset's dense entity universe), so the row's
-        # fits_device can never disagree with the recorded tier.
-        resident = _budget.train_resident_bytes(
-            ds.user_map.num_entities, ds.movie_map.num_entities, nnz,
-            args.rank, dtype=args.dtype,
-        )
-        # Pin the SWEEP's decision into the config: the device-tier arm
-        # must not silently re-resolve against the real detected device
-        # (an artificial --sweep-budget-mb would otherwise let train_als
-        # route differently than the row's tier label claims).
-        config = _dc.replace(config, offload_tier=tier)
-        metrics = Metrics()
-
-        def timed(cfg):
+        for shards in shard_counts:
             t0 = time.time()
-            if tier == "host_window":
-                model = train_als_host_window(
-                    ds, cfg, metrics=metrics,
-                    chunks_per_window=args.sweep_window_chunks,
-                    device_budget_bytes=device.hbm_bytes,
+            ds = Dataset.from_coo(
+                coo, num_shards=shards, layout="tiled",
+                chunk_elems=args.chunk_elems,
+                tile_rows=args.sweep_tile_rows, accum_max_entities=0,
+            )
+            build_s = time.time() - t0
+            for table_dtype in dtypes:
+                config = ALSConfig(
+                    rank=args.rank, lam=args.lam,
+                    num_iterations=args.iterations, seed=0,
+                    layout="tiled", num_shards=shards,
+                    dtype=args.dtype, table_dtype=table_dtype,
+                    hbm_chunk_elems=args.chunk_elems,
                 )
-                np.asarray(model.user_factors[:1])
-            else:
-                model = train_als(ds, cfg)
-                sync(model.user_factors)
-            return time.time() - t0, model
+                shape = shape_for_config(
+                    config, num_users=ds.user_map.num_entities,
+                    num_movies=ds.movie_map.num_entities, nnz=nnz,
+                )
+                ep, prov = plan(shape, device,
+                                constraints_from_config(config))
+                tier = ep.offload_tier
+                # Keyed per (scale, shards, dtype): int8 can legitimately
+                # flip the tier at the same (scale, shards) — quantization
+                # shrinks the gather working copy — and the acceptance
+                # surface must show every crossing, not the last dtype's.
+                tier_by_point[
+                    f"scale={sc},shards={shards},table={table_dtype}"
+                ] = tier
+                # The budget math is recorded from the SAME counts the
+                # planner decided on (the dataset's dense entity
+                # universe) AT THE POINT'S SHARD COUNT, so the row's
+                # fits_device can never disagree with the recorded tier.
+                resident = _budget.train_resident_bytes(
+                    ds.user_map.num_entities, ds.movie_map.num_entities,
+                    nnz, args.rank, dtype=args.dtype,
+                    table_dtype=table_dtype, num_shards=shards,
+                )
+                # Pin the SWEEP's decision into the config: the
+                # device-tier arm must not silently re-resolve against
+                # the real detected device (an artificial
+                # --sweep-budget-mb would otherwise let the trainers
+                # route differently than the row's tier label claims).
+                config = _dc.replace(config, offload_tier=tier)
+                metrics = Metrics()
+                resident_ok = (tier != "device" or shards == 1
+                               or len(_jax.devices()) >= shards)
 
-        # Same two-point (1 vs N iterations) fit as run_scale: the fixed
-        # upload/plan cost cancels exactly.
-        n1 = config.num_iterations
-        config1 = _dc.replace(config, num_iterations=1)
-        timed(config)  # compile both programs
-        timed(config1)
-        t_n, t_1 = [], []
-        for _ in range(args.repeats):
-            t_1.append(timed(config1)[0])
-            t_n.append(timed(config)[0])
-        train_s, short_s = min(t_n), min(t_1)
-        steady_s = (train_s - short_s) / (n1 - 1) * n1 if n1 > 1 else train_s
-        if steady_s <= 0:
-            steady_s = train_s
-        s_per_iter = steady_s / n1
-        row = {
-            "scale": sc,
-            "users": users, "movies": movies, "ratings": nnz,
-            "rank": args.rank, "dtype": args.dtype,
-            "offload_tier": tier,
-            "s_per_iteration": round(s_per_iter, 4),
-            "ratings_per_sec_per_chip": int(
-                nnz * 2 * n1 / max(steady_s, 1e-9)
-            ),
-            # The memory-budget math the tier decision was made from —
-            # recorded so BASELINE.md's table is reproducible arithmetic,
-            # not an assertion.
-            "resident_bytes_mb": round(resident["total"] / 1e6, 2),
-            "factor_tables_mb": round(
-                resident["factor_tables_bytes"] / 1e6, 2
-            ),
-            "block_arrays_mb": round(
-                resident["block_arrays_bytes"] / 1e6, 2
-            ),
-            "device_budget_mb": round(device.hbm_bytes / 1e6, 2),
-            "budget_fraction": _budget.RESIDENT_FRACTION,
-            # THE predicate, not an inline copy — the row's fits_device
-            # must stay the planner's own arithmetic.
-            "fits_device": _budget.fits_device(
-                ds.user_map.num_entities, ds.movie_map.num_entities,
-                nnz, args.rank, hbm_bytes=device.hbm_bytes,
-                dtype=args.dtype,
-            ),
-            "datagen_wall_s": round(gen_s, 3),
-            "blockbuild_wall_s": round(build_s, 3),
-            "train_wall_s": round(train_s, 3),
-            **prov.as_row(),
-        }
-        if tier == "host_window":
-            row.update({
-                "windows_m": metrics.gauges.get("offload_windows_m"),
-                "windows_u": metrics.gauges.get("offload_windows_u"),
-                "window_rows_m": metrics.gauges.get("offload_window_rows_m"),
-                "window_rows_u": metrics.gauges.get("offload_window_rows_u"),
-                "staged_mb_per_run": metrics.gauges.get("offload_staged_mb"),
-                "per_window_budget_mb": round(
-                    _budget.window_budget_bytes(device.hbm_bytes) / 1e6, 2
-                ),
-            })
-        print("# sweep point: " + json.dumps(row), flush=True)
-        rows.append(row)
+                def timed(cfg):
+                    t0 = time.time()
+                    if tier == "host_window":
+                        model = train_als_host_window(
+                            ds, cfg, metrics=metrics,
+                            chunks_per_window=args.sweep_window_chunks,
+                            device_budget_bytes=device.hbm_bytes,
+                        )
+                        np.asarray(model.user_factors[:1])
+                    elif shards > 1:
+                        from cfk_tpu.parallel.mesh import make_mesh
+                        from cfk_tpu.parallel.spmd import train_als_sharded
+
+                        model = train_als_sharded(ds, cfg,
+                                                  make_mesh(shards))
+                        sync(model.user_factors)
+                    else:
+                        model = train_als(ds, cfg)
+                        sync(model.user_factors)
+                    return time.time() - t0, model
+
+                row = {
+                    "scale": sc,
+                    "users": users, "movies": movies, "ratings": nnz,
+                    "rank": args.rank, "dtype": args.dtype,
+                    "table_dtype": table_dtype,
+                    "num_shards": shards,
+                    "offload_tier": tier,
+                    # The PER-SHARD memory-budget math the tier decision
+                    # was made from — recorded so BASELINE.md's table is
+                    # reproducible arithmetic, not an assertion.
+                    "resident_bytes_mb_per_shard": round(
+                        resident["total"] / 1e6, 2
+                    ),
+                    "factor_tables_mb_per_shard": round(
+                        resident["factor_tables_bytes"] / 1e6, 2
+                    ),
+                    "gather_copy_mb": round(
+                        resident["gather_copy_bytes"] / 1e6, 2
+                    ),
+                    "block_arrays_mb_per_shard": round(
+                        resident["block_arrays_bytes"] / 1e6, 2
+                    ),
+                    "device_budget_mb": round(device.hbm_bytes / 1e6, 2),
+                    "budget_fraction": _budget.RESIDENT_FRACTION,
+                    # THE predicate, not an inline copy — the row's
+                    # fits_device must stay the planner's own arithmetic.
+                    "fits_device": _budget.fits_device(
+                        ds.user_map.num_entities,
+                        ds.movie_map.num_entities,
+                        nnz, args.rank, hbm_bytes=device.hbm_bytes,
+                        dtype=args.dtype, table_dtype=table_dtype,
+                        num_shards=shards,
+                    ),
+                    "datagen_wall_s": round(gen_s, 3),
+                    "blockbuild_wall_s": round(build_s, 3),
+                    **prov.as_row(),
+                }
+                if not resident_ok:
+                    row["s_per_iteration"] = None
+                    row["run"] = (f"skipped: resident arm needs "
+                                  f"{shards} devices")
+                else:
+                    # Same two-point (1 vs N iterations) fit as
+                    # run_scale: the fixed upload/plan cost cancels
+                    # exactly.
+                    n1 = config.num_iterations
+                    config1 = _dc.replace(config, num_iterations=1)
+                    timed(config)  # compile both programs
+                    timed(config1)
+                    t_n, t_1 = [], []
+                    for _ in range(args.repeats):
+                        t_1.append(timed(config1)[0])
+                        t_n.append(timed(config)[0])
+                    train_s, short_s = min(t_n), min(t_1)
+                    steady_s = ((train_s - short_s) / (n1 - 1) * n1
+                                if n1 > 1 else train_s)
+                    if steady_s <= 0:
+                        steady_s = train_s
+                    row["s_per_iteration"] = round(steady_s / n1, 4)
+                    row["ratings_per_sec_per_chip"] = int(
+                        nnz * 2 * n1 / max(steady_s, 1e-9) / shards
+                    )
+                    row["train_wall_s"] = round(train_s, 3)
+                if tier == "host_window" and resident_ok:
+                    row.update({
+                        "windows_m": metrics.gauges.get(
+                            "offload_windows_m"),
+                        "windows_u": metrics.gauges.get(
+                            "offload_windows_u"),
+                        "window_rows_m": metrics.gauges.get(
+                            "offload_window_rows_m"),
+                        "window_rows_u": metrics.gauges.get(
+                            "offload_window_rows_u"),
+                        # The HONEST staged bytes at this table dtype
+                        # (int8 ships codes + per-row scales ≈ ¼ f32 on
+                        # the table share, metered separately from the
+                        # chunk arrays that cross PCIe regardless).
+                        "offload_staged_mb": metrics.gauges.get(
+                            "offload_staged_mb"),
+                        "offload_staged_table_mb": metrics.gauges.get(
+                            "offload_staged_table_mb"),
+                        "plan_held_mb": metrics.gauges.get(
+                            "offload_plan_held_mb"),
+                        "per_window_budget_mb": round(
+                            _budget.window_budget_bytes(
+                                device.hbm_bytes) / 1e6, 2
+                        ),
+                        # Fabric attribution of staged rows (sharded).
+                        "staged_rows_local": metrics.gauges.get(
+                            "offload_rows_local"),
+                        "staged_rows_ici": metrics.gauges.get(
+                            "offload_rows_ici"),
+                        "staged_rows_dcn": metrics.gauges.get(
+                            "offload_rows_dcn"),
+                    })
+                print("# sweep point: " + json.dumps(row), flush=True)
+                rows.append(row)
     tiers = [r["offload_tier"] for r in rows]
     return {
         "metric": "scale_sweep_s_per_iteration",
         "points": rows,
         "tiers": tiers,
+        # The device↔host_window crossing per (scale, shard count) — the
+        # ISSUE 12 acceptance surface: an oversized shape must read
+        # host_window at EVERY shard count, not just 1.
+        "tier_by_point": tier_by_point,
         "crossed_to_host_window": "host_window" in tiers,
     }
 
@@ -968,14 +1051,27 @@ def run_scale_sweep(args) -> dict:
 def _scale_sweep_row() -> dict:
     """The default-main scale-sweep row: tiny shapes under an artificial
     2 MB device budget so the largest point CROSSES into the
-    host_window tier on this CPU container (the real budgets are the
-    on-TPU run's job; the tier-resolution machinery is what this row
-    exercises)."""
+    host_window tier on this CPU container — at one AND two shards, with
+    f32 and int8 staging (the recorded ``offload_staged_mb`` pair is the
+    ¼-bytes acceptance row).  Real budgets are the on-TPU run's job; the
+    tier-resolution machinery is what this row exercises.  The 2-shard
+    resident points skip timing in-process (no virtual mesh after jax
+    init) but still record tier + budget math."""
     ns = argparse.Namespace(
-        users=3_000, movies=300, nnz=60_000, rank=16, iterations=2,
-        repeats=2, seed=0, dtype="float32", lam=0.05, chunk_elems=4_096,
-        sweep_scales="0.25,1.0", sweep_budget_mb=2.0, sweep_tile_rows=16,
-        sweep_window_chunks=2,
+        # rank 64 at 22k users makes the fixed side's all_gather working
+        # copy (22.5k·64·4 B ≈ 5.8 MB) the dominant resident term — the
+        # one sharding cannot divide — so the 1.0× point's per-shard
+        # budget still overflows the 7.2 MB effective budget at one AND
+        # two shards (the ISSUE 12 crossing), while the 0.25× point
+        # stays resident.  The 8 MB budget also leaves the per-window
+        # share (3.6 MB) above the hot-head movie's carry-constrained
+        # window (~3.4 MB — a stream window can only cut where no entity
+        # straddles).
+        users=22_000, movies=500, nnz=60_000, rank=64, iterations=2,
+        repeats=2, seed=0, dtype="float32", lam=0.05, chunk_elems=2_048,
+        sweep_scales="0.25,1.0", sweep_budget_mb=8.0, sweep_tile_rows=16,
+        sweep_window_chunks=2, sweep_shards="1,2",
+        sweep_table_dtypes="float32,int8",
     )
     return run_scale_sweep(ns)
 
@@ -2447,6 +2543,17 @@ if __name__ == "__main__":
     parser.add_argument("--sweep-window-chunks", type=int, default=4,
                         help="chunks per staged window on the host_window "
                         "tier")
+    parser.add_argument("--sweep-shards", default="1",
+                        help="comma list of shard counts per sweep point "
+                        "(ISSUE 12): the tier resolves against the "
+                        "PER-SHARD budget; host_window points run the "
+                        "sharded windowed driver (no mesh needed), "
+                        "device points at >1 shards need that many jax "
+                        "devices or record budget math only")
+    parser.add_argument("--sweep-table-dtypes", default="float32",
+                        help="comma list of gather-table dtypes per sweep "
+                        "point — int8 rows record the (codes, scales) "
+                        "staged bytes (~1/4 of f32 on the table share)")
     parser.add_argument("--plan-ab", action="store_true",
                         help="execution-planner A/B (ISSUE 9): the "
                         "resolver's serve plan (free table dtype + batch "

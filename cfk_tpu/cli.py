@@ -1175,6 +1175,7 @@ def _plan_cmd(args) -> int:
         chunk_elems=args.chunk_elems,
         offload_tier=(None if args.offload_tier == "auto"
                       else args.offload_tier),
+        ici_group=args.ici_group,
     )
     if args.device == "auto":
         device = DeviceSpec.detect()
@@ -1364,13 +1365,15 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--offload-tier", choices=["auto", "device", "host_window"],
         default="auto",
-        help="where the factor tables live (ISSUE 11): 'auto' lets the "
-        "planner's memory-budget predicate decide (resident while they "
-        "fit — today's behavior); 'device' pins resident tables (refused "
-        "up front when they cannot fit); 'host_window' pins the "
-        "out-of-core path — host-RAM factor stores with device_put-"
-        "pipelined windows (explicit ALS, tiled layout, bit-exact vs the "
-        "resident path)",
+        help="where the factor tables live (ISSUE 11/12): 'auto' lets "
+        "the planner's PER-SHARD memory-budget predicate decide "
+        "(resident while they fit — today's behavior); 'device' pins "
+        "resident tables (refused up front when they cannot fit); "
+        "'host_window' pins the out-of-core path — host-RAM factor "
+        "stores with device_put-pipelined windows, sharded too (per-"
+        "shard windows under the all_gather scan or ring/hier_ring "
+        "visit schedules, int8 (codes, scales) PCIe staging; explicit "
+        "ALS, tiled layout, bit-exact vs the resident paths)",
     )
     t.add_argument(
         "--ici-group", type=int, default=None, metavar="I",
@@ -1678,10 +1681,17 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--chunk-elems", type=int, default=None)
     pl.add_argument("--offload-tier", default="auto",
                     choices=["auto", "device", "host_window"],
-                    help="out-of-core tier pin (ISSUE 11): 'auto' lets "
-                    "the memory-budget predicate decide; 'device' REFUSES "
-                    "when the resident tables cannot fit; 'host_window' "
-                    "pins the windowed host-offload path")
+                    help="out-of-core tier pin (ISSUE 11/12): 'auto' "
+                    "lets the PER-SHARD memory-budget predicate decide; "
+                    "'device' REFUSES when the resident tables cannot "
+                    "fit one device; 'host_window' pins the windowed "
+                    "host-offload path (sharded shapes pair it with any "
+                    "exchange)")
+    pl.add_argument("--ici-group", type=int, default=None, metavar="I",
+                    help="inner-ring size pin of the hier_ring exchange "
+                    "(a real plan field since ISSUE 12 — the cost model "
+                    "prices the pinned hierarchy; default: the device's "
+                    "ICI domain)")
     pl.add_argument("--device", default="auto",
                     choices=["auto", "v5e", "cpu"],
                     help="'auto' detects the current jax backend; 'v5e' "

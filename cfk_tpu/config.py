@@ -336,8 +336,10 @@ class ALSConfig:
     #   "host_window" — pin the out-of-core path: host-RAM factor stores
     #                   with device_put-pipelined windows
     #                   (offload.windowed.train_als_host_window — explicit
-    #                   ALS, tiled layout, single process; bit-exact vs
-    #                   the resident path).
+    #                   ALS, tiled layout; sharded too, per-shard windows
+    #                   under the all_gather scan or the ring/hier_ring
+    #                   visit schedules with int8 (codes, scales) PCIe
+    #                   staging; bit-exact vs the resident paths).
     offload_tier: Literal["auto", "device", "host_window"] = "auto"
 
     def _valid_algorithms(self) -> tuple[str, ...]:
@@ -447,12 +449,10 @@ class ALSConfig:
                     "subspace/iALS global-Gram reductions are the "
                     "documented follow-up)"
                 )
-            if self.num_shards != 1:
-                raise ValueError(
-                    "offload_tier='host_window' is a single-process "
-                    f"driver (num_shards={self.num_shards}); pair the "
-                    "multi-chip regime with exchange='hier_ring' (ROADMAP)"
-                )
+            # Sharded host_window is supported (ISSUE 12): the windowed
+            # driver runs per-shard staged windows under the all_gather
+            # scan or the ring/hier_ring visit schedules — no shard-count
+            # restriction here; exchange/layout rules below still apply.
         if self.solver not in ("auto", "cholesky", "pallas"):
             raise ValueError(f"unknown solver {self.solver!r}")
         if self.layout not in ("padded", "bucketed", "segment", "tiled"):

@@ -1,31 +1,45 @@
-"""Out-of-core factor tables (ROADMAP item 3 / ISSUE 11).
+"""Out-of-core factor tables (ROADMAP item 3 / ISSUEs 11+12).
 
 Host-RAM-resident sharded factor stores with ``device_put``-pipelined
 windows: the fixed side of each half-iteration streams through the device
 one window at a time while the current window's Gram+solve runs, bit-exact
-vs the resident path.  ``budget`` is the memory predicate shared with the
-execution planner (``plan.resolver`` resolves oversized problems to the
-``host_window`` tier through it); ``parallel.spmd.
-half_step_tiled_ring_hier`` is the matching hierarchical ICI×DCN exchange.
-See ARCHITECTURE.md "Out-of-core factor tables".
+vs the resident path — single-shard (the stream-mode all_gather scan) AND
+sharded (per-shard windows under the all_gather scan or the
+ring/hier_ring visit schedules, with int8 (codes, scales) PCIe staging
+and zero-copy window plans).  ``budget`` is the PER-SHARD memory
+predicate shared with the execution planner (``plan.resolver`` resolves
+oversized problems to the ``host_window`` tier through it);
+``parallel.spmd.half_step_tiled_ring_hier`` is the matching resident
+hierarchical ICI×DCN exchange whose visit order the windowed ring driver
+replicates.  See ARCHITECTURE.md "Out-of-core factor tables".
 """
 
-from cfk_tpu.offload.store import HostFactorStore
-from cfk_tpu.offload.window import WindowPlan, build_window_plan
+from cfk_tpu.offload.store import HostFactorStore, quantize_rows_host
+from cfk_tpu.offload.window import (
+    RingWindowPlan,
+    WindowPlan,
+    build_ring_window_plan,
+    build_window_plan,
+)
 
 __all__ = [
     "HostFactorStore",
+    "quantize_rows_host",
+    "RingWindowPlan",
     "WindowPlan",
+    "build_ring_window_plan",
     "build_window_plan",
     "train_als_host_window",
     "windowed_half_step",
+    "ring_windowed_half_step",
 ]
 
 
 def __getattr__(name):
     # windowed imports jax; keep the package importable without it (the
     # budget predicate is consumed by the jax-free plan layer).
-    if name in ("train_als_host_window", "windowed_half_step"):
+    if name in ("train_als_host_window", "windowed_half_step",
+                "ring_windowed_half_step"):
         from cfk_tpu.offload import windowed
 
         return getattr(windowed, name)

@@ -49,38 +49,66 @@ def factor_table_bytes(entities: int, rank: int,
     return float(entities) * rank * dtype_bytes(dtype)
 
 
+def shard_entity_range(rows: int, num_shards: int, shard: int
+                       ) -> tuple[int, int]:
+    """Entity-range shard ``shard``'s [lo, hi) rows — the SAME clipped
+    ceil-split ``HostFactorStore`` places shards with (a ceil-split can
+    overshoot ``rows`` by more than one shard: rows=10 / 7 shards walks
+    past 10 at shard 5, so both bounds clip and trailing shards are
+    empty)."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if not 0 <= shard < num_shards:
+        raise ValueError(f"shard {shard} outside [0, {num_shards})")
+    per = -(-rows // num_shards)
+    return min(shard * per, rows), min((shard + 1) * per, rows)
+
+
 def train_resident_bytes(num_users: int, num_movies: int, nnz: int,
                          rank: int, *, dtype: str = "float32",
-                         table_dtype: str | None = None) -> dict:
-    """Per-term resident bytes of one device-tier training iteration.
+                         table_dtype: str | None = None,
+                         num_shards: int = 1) -> dict:
+    """PER-SHARD resident bytes of one device-tier training iteration.
 
     Returns the breakdown dict (the scale lab records it per row); the
-    ``total`` key is what ``fits_device`` compares against the budget."""
-    tables = factor_table_bytes(num_users + num_movies, rank, dtype)
+    ``total`` key is what ``fits_device`` compares against ONE device's
+    budget.  Sharding divides what actually shards — each device holds
+    its slice of the factor tables and its slice of the block arrays —
+    but NOT the gather working copy: the all_gather exchange materializes
+    the full fixed side on every device each half-iteration, which is
+    exactly why an oversized table stays oversized at any shard count and
+    the host_window tier remains the answer (the ring exchanges trade the
+    copy for an [E_local, k, k] accumulator, bounded separately by the
+    block builder's ``accum_max_entities`` gate)."""
+    shards = max(int(num_shards), 1)
+    tables = factor_table_bytes(num_users + num_movies, rank, dtype) / shards
     # The gather working copy of the fixed side (zero-row append / quantized
     # view); charge the LARGER side at the effective gather cell size.
     gather_copy = factor_table_bytes(
         max(num_users, num_movies), rank,
         table_dtype if table_dtype is not None else dtype,
     )
-    blocks = 2.0 * nnz * _BLOCK_BYTES_PER_CELL * _TILE_PAD
+    blocks = 2.0 * nnz * _BLOCK_BYTES_PER_CELL * _TILE_PAD / shards
     total = tables + gather_copy + blocks
     return {
         "factor_tables_bytes": tables,
         "gather_copy_bytes": gather_copy,
         "block_arrays_bytes": blocks,
+        "num_shards": shards,
         "total": total,
     }
 
 
 def fits_device(num_users: int, num_movies: int, nnz: int, rank: int, *,
                 hbm_bytes: float, dtype: str = "float32",
-                table_dtype: str | None = None) -> bool:
-    """THE device-tier feasibility predicate (planner AND executor)."""
+                table_dtype: str | None = None,
+                num_shards: int = 1) -> bool:
+    """THE device-tier feasibility predicate (planner AND executor) —
+    per-shard arithmetic against ONE device's budget."""
     return (
         train_resident_bytes(
             num_users, num_movies, nnz, rank,
-            dtype=dtype, table_dtype=table_dtype,
+            dtype=dtype, table_dtype=table_dtype, num_shards=num_shards,
         )["total"]
         <= hbm_bytes * RESIDENT_FRACTION
     )
@@ -91,17 +119,35 @@ def shape_fits_device(shape, device, table_dtype: str | None = None) -> bool:
     (serve shapes are table-resident by construction and not gated here).
     ``table_dtype`` is the resolve's PINNED gather-table dtype when one
     exists — quantization shrinks the gather working copy, which is
-    exactly the memory lever, so the predicate must charge it."""
+    exactly the memory lever, so the predicate must charge it.  The
+    shape's shard count divides the table/block terms (per-shard
+    arithmetic; the gather copy replicates)."""
     if getattr(shape, "kind", "train") != "train":
         return True
     return fits_device(
         shape.num_users, shape.num_movies, shape.nnz, shape.rank,
         hbm_bytes=device.hbm_bytes, dtype=shape.dtype,
         table_dtype=table_dtype,
+        num_shards=getattr(shape, "num_shards", 1),
     )
 
 
-def window_budget_bytes(hbm_bytes: float) -> float:
+def window_budget_bytes(hbm_bytes: float,
+                        reserved_bytes: float = 0.0) -> float:
     """Per-window staging budget under the double buffer: the headroom
-    fraction of the device, split across the two live windows."""
-    return hbm_bytes * RESIDENT_FRACTION / WINDOW_BUFFERS
+    fraction of the device MINUS any persistent device state the driver
+    holds alongside the windows (the ring modes' per-entity Gram
+    accumulator — charged TWICE, because the un-donatable dispatch
+    boundary keeps input and output alive across a window call), split
+    across the two live windows."""
+    return max(
+        hbm_bytes * RESIDENT_FRACTION - reserved_bytes, 0.0
+    ) / WINDOW_BUFFERS
+
+
+def ring_accumulator_bytes(local_entities: int, rank: int) -> float:
+    """Persistent device bytes of one shard's ring-mode Gram accumulator:
+    the f32 [E_local+1, k, k] + [E_local+1, k] carry pair the windowed
+    ring driver holds across every window of a half-step (the same
+    structure the resident ring carries in-place)."""
+    return float(local_entities + 1) * rank * (rank + 1) * 4.0

@@ -31,6 +31,42 @@ def _np_dtype(name: str):
     )
 
 
+# XLA's algebraic simplifier rewrites the in-jit ``amax / 127.0`` of
+# ``ops.quant.quantize_table`` into ``amax * (1/127)`` with the reciprocal
+# folded at compile time — measured on XLA:CPU (a handful of 1-ulp scale
+# differences vs a true division).  The host staging quantizer must
+# reproduce THAT arithmetic, not the textbook division, or staged int8
+# windows drift ~1e-6 from the resident in-jit quantization
+# (tests/test_offload_sharded.py pins host == jit bitwise).
+_INT8_RECIP = np.float32(1.0) / np.float32(127.0)
+_INT8_LEVELS = np.float32(127.0)
+
+
+def quantize_rows_host(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """int8-quantize factor rows on the HOST — (codes, per-row scales),
+    bit-identical to slicing ``ops.quant.quantize_table``'s in-jit output
+    (the per-row scheme makes any row subset quantize independently).
+
+    This is what lets the staging pipeline ship int8 windows over PCIe as
+    (1-byte codes + one f32 scale per row) instead of storage-dtype
+    floats — a quarter of the staged bytes — while the kernels consume
+    exactly the codes the resident path would have quantized on device.
+    NaN rows poison their scale (``amax == 0`` is False for NaN), the
+    same laundering guard as ``quantize_table``."""
+    f = np.asarray(rows, dtype=np.float32)
+    amax = np.max(np.abs(f), axis=-1) if f.size else np.zeros(
+        (f.shape[0],), np.float32
+    )
+    scale = np.where(
+        amax == 0.0, np.float32(1.0), amax * _INT8_RECIP
+    ).astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        q = np.clip(
+            np.round(f / scale[:, None]), -_INT8_LEVELS, _INT8_LEVELS
+        ).astype(np.int8)
+    return q, scale
+
+
 class HostFactorStore:
     """[rows, rank] factor table in host RAM, entity-range sharded."""
 
@@ -83,6 +119,14 @@ class HostFactorStore:
     def shard(self, s: int) -> np.ndarray:
         """Direct (mutable) view of shard ``s`` — the multi-host seam."""
         return self._shards[s]
+
+    def shard_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Which store shard owns each row — the staging path's fabric
+        attribution (rows from the compute shard's own store shard are
+        local; same-ICI-group shards cross the fast fabric; the rest is
+        the DCN share the hier exchange meters)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        return np.searchsorted(self.bounds, rows, side="right") - 1
 
     def gather(self, rows: np.ndarray) -> np.ndarray:
         """[len(rows), rank] window of the table (any order, repeats OK) —

@@ -1,33 +1,47 @@
-"""Window planning: cut a stream-mode tiled chunk scan into stageable units.
+"""Window planning: cut tiled chunk scans into stageable units.
 
 A windowed half-step runs the SAME per-chunk Gram+solve as the resident
-``ops.tiled.als_half_step_tiled`` — the only difference is where the fixed
-factor table lives.  The plan built here makes that literal:
+tiled half-steps — the only difference is where the fixed factor table
+lives.  The plans built here make that literal, for both execution
+shapes the resident trainers use:
 
-- chunks are grouped into consecutive WINDOWS, cut only where
-  ``carry_in == 0`` (no boundary-straddling entity crosses a cut, so each
-  window's zero carry-init is exactly the resident scan's state at that
-  chunk — bit-exactness needs no carry threading across host calls);
-- each window's **neighbor row set** is the sorted unique table rows its
-  chunks gather; the staged window is ``host_table[rows]`` and the chunk
-  indices are REBASED into it (the virtual zero row F maps to the static
-  ``window_rows`` slot — exactly the convention the gather kernels and the
-  zero-row append already use, so the kernels run unmodified against the
-  window);
-- all windows share ONE static shape (``chunks_per_window`` chunks padded
-  with all-trash chunks, ``window_rows`` staged rows): one jit trace
-  serves every window of a side.
+- ``WindowPlan`` (stream mode, the all_gather-exchange scan): chunks are
+  grouped into consecutive WINDOWS, cut only where ``carry_in == 0`` (no
+  boundary-straddling entity crosses a cut, so each window's zero
+  carry-init is exactly the resident scan's state at that chunk), and
+  each window's **neighbor row set** is the sorted unique table rows its
+  chunks gather;
+- ``RingWindowPlan`` (the ring / hier-ring exchanges, accum-mode ring
+  blocks): each fixed-table SLICE's chunk range is cut into windows (the
+  ring's per-slice Gram accumulation is chunk-dense — no carry — so cuts
+  are free), and the staged window is the slice of the neighbor rows the
+  shard's chunks actually reference — the "window residual" that crosses
+  PCIe/DCN instead of the whole rotating block.
 
-The builder is pure numpy on the already-built ``TiledBlocks`` arrays —
-window planning is a build-time cost, paid once per dataset.
+In both plans the chunk indices are REBASED into the staged window (the
+virtual zero row maps to the static ``window_rows`` slot — exactly the
+convention the gather kernels and the zero-row append already use, so
+the kernels run unmodified against the window), and all windows share
+ONE static shape (``window_chunks`` chunks padded with all-trash chunks,
+``window_rows`` staged rows): one jit trace serves every window of a
+side.
 
-Host-memory note: the plan currently materializes padded copies of the
-per-chunk arrays alongside the originals (roughly doubling the
-interaction data's host footprint).  Only the REBASED neighbor stream
-inherently needs new memory — rating/weight/metadata are contiguous
-chunk slices that could be assembled into a reusable staging buffer at
-stage time instead; that refactor is the recorded follow-up for the
-true ~1B-rating regime (ROADMAP item 3 follow-ups).
+Zero-copy contract (ISSUE 12): the plan holds ONLY the rebased neighbor
+stream (which inherently needs new memory — the rebase is a new index
+space) plus per-window row sets and scalar metadata.  The
+rating/weight/tile/entity chunk arrays are served at stage time as
+**slices of the original block arrays** (``stage_chunks`` returns numpy
+VIEWS for full windows; only a ragged trailing window assembles a padded
+copy, transient to the staging call).  ``plan_held_bytes`` is what a
+plan pins in host RAM — roughly HALF the old padded-copy footprint,
+pinned by the RSS-proxy test in ``tests/test_offload_sharded.py``.
+
+The builders are pure numpy on the already-built ``TiledBlocks`` arrays
+— window planning is a build-time cost, paid once per dataset.  Sharded
+blocks (``num_shards > 1``) are planned per shard via the ``shard=``
+argument: every per-shard leaf is a reshape view of the shard-major flat
+arrays, so sharded planning allocates nothing beyond the per-shard
+neighbor rebase.
 """
 
 from __future__ import annotations
@@ -41,65 +55,136 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _shard_leaf(arr: np.ndarray, num_shards: int, shard: int) -> np.ndarray:
+    """Shard ``shard``'s slice of a shard-major flat block array (a VIEW)."""
+    return arr.reshape(num_shards, -1)[shard]
+
+
 @dataclasses.dataclass(frozen=True)
 class WindowPlan:
-    """Per-window staged inputs of one side's windowed half-step."""
+    """Stream-mode window plan: one shard's chunk scan as staged windows.
+
+    Plan-held arrays are the rebased neighbor stream + per-window row
+    sets + tiny per-window metadata; everything else is served at stage
+    time as views/assemblies over ``src`` (the original block arrays)."""
 
     rows: np.ndarray          # [W, R] int64 table rows staged per window
     row_counts: np.ndarray    # [W] real rows (<= R; the rest pad row 0)
+    chunk_lo: np.ndarray      # [W] first source chunk of each window
     chunk_counts: np.ndarray  # [W] real chunks (<= ncw; the rest all-trash)
     neighbor_idx: np.ndarray  # [W, ncw·C] int32 window-rebased (zero row → R)
-    rating: np.ndarray        # [W, ncw·C] f32
-    weight: np.ndarray        # [W, ncw·C] f32
-    tile_seg: np.ndarray      # [W, ncw·NT] int32
-    chunk_entity: np.ndarray  # [W, ncw·Ec] int32 (trash = local_entities)
-    chunk_count: np.ndarray   # [W, ncw·Ec] int32
     carry_in: np.ndarray      # [W, ncw] f32 (0 at every window start)
     last_seg: np.ndarray      # [W, ncw] int32
     statics: tuple            # (ncw, C, Ec, T) — the per-window half-step's
     window_rows: int          # R (static staged-table height)
     table_rows: int           # F (the fixed side's padded rows)
     local_entities: int       # solve side's padded rows (trash id)
+    # Views of the source chunk arrays (shapes [nc, cap] / [nc, nt] /
+    # [nc, Ec]) — shared memory with the TiledBlocks, never copied here.
+    src: dict = dataclasses.field(repr=False, default_factory=dict)
 
     @property
     def num_windows(self) -> int:
         return int(self.rows.shape[0])
 
-    def staged_bytes_per_window(self, rank: int, stage_itemsize: int) -> int:
+    def staged_bytes_per_window(self, rank: int, stage_itemsize: int, *,
+                                row_overhead_bytes: int = 0) -> int:
         """Bytes one staged window occupies on device: the gathered table
-        rows at the staging dtype plus the window's chunk arrays."""
-        ncw, cap, e_c, _t = self.statics
-        table = int(self.window_rows) * rank * stage_itemsize
+        rows at the staging dtype (+ per-row overhead — the int8 scheme's
+        f32 scale) plus the window's chunk arrays."""
+        ncw, cap, e_c, t = self.statics
+        nt = cap // t
+        table = int(self.window_rows) * (rank * stage_itemsize
+                                         + row_overhead_bytes)
         chunks = (
             ncw * cap * 12            # nb (int32) + rating + weight (f32)
-            + self.tile_seg.shape[1] * 4
+            + ncw * nt * 4            # tile_seg
             + 2 * ncw * e_c * 4       # chunk_entity + chunk_count
             + 2 * ncw * 4             # carry_in + last_seg
         )
         return table + chunks
 
+    def plan_held_bytes(self) -> int:
+        """Host bytes this plan PINS for its lifetime (the zero-copy
+        contract: the rebased neighbor stream + row sets + metadata; the
+        chunk arrays stay the TiledBlocks' own memory)."""
+        return (self.rows.nbytes + self.row_counts.nbytes
+                + self.chunk_lo.nbytes + self.chunk_counts.nbytes
+                + self.neighbor_idx.nbytes + self.carry_in.nbytes
+                + self.last_seg.nbytes)
+
+    def chunk_entity_of(self, w: int) -> np.ndarray:
+        """Window ``w``'s [ncw·Ec] finalization rows (the host scatter's
+        targets — pad chunks route to ``local_entities``)."""
+        ncw, cap, e_c, t = self.statics
+        n = int(self.chunk_counts[w])
+        lo = int(self.chunk_lo[w])
+        ent = self.src["chunk_entity"]
+        if n == ncw:
+            return ent[lo:lo + ncw].reshape(-1)
+        out = np.full(ncw * e_c, self.local_entities, dtype=ent.dtype)
+        out[: n * e_c] = ent[lo:lo + n].reshape(-1)
+        return out
+
+    def stage_chunks(self, w: int) -> tuple:
+        """Window ``w``'s (rating, weight, tile_seg, chunk_entity,
+        chunk_count, carry_in, last_seg) host arrays.  Full windows return
+        flat VIEWS of the original block arrays (zero-copy — the whole
+        point); a ragged trailing window assembles its padded copy here,
+        transient to the staging call."""
+        ncw, cap, e_c, t = self.statics
+        nt = cap // t
+        n = int(self.chunk_counts[w])
+        lo = int(self.chunk_lo[w])
+        s = self.src
+        if n == ncw:
+            return (
+                s["rating"][lo:lo + ncw].reshape(-1),
+                s["weight"][lo:lo + ncw].reshape(-1),
+                s["tile_seg"][lo:lo + ncw].reshape(-1),
+                s["chunk_entity"][lo:lo + ncw].reshape(-1),
+                s["chunk_count"][lo:lo + ncw].reshape(-1),
+                self.carry_in[w], self.last_seg[w],
+            )
+        rt = np.zeros(ncw * cap, dtype=np.float32)
+        wt = np.zeros(ncw * cap, dtype=np.float32)
+        ts = np.full(ncw * nt, e_c, dtype=np.int32)
+        ent = np.full(ncw * e_c, self.local_entities, dtype=np.int32)
+        cnt = np.ones(ncw * e_c, dtype=s["chunk_count"].dtype)
+        rt[: n * cap] = s["rating"][lo:lo + n].reshape(-1)
+        wt[: n * cap] = s["weight"][lo:lo + n].reshape(-1)
+        ts[: n * nt] = s["tile_seg"][lo:lo + n].reshape(-1)
+        ent[: n * e_c] = s["chunk_entity"][lo:lo + n].reshape(-1)
+        cnt[: n * e_c] = s["chunk_count"][lo:lo + n].reshape(-1)
+        return rt, wt, ts, ent, cnt, self.carry_in[w], self.last_seg[w]
+
 
 def build_window_plan(blocks, table_rows: int, *,
-                      chunks_per_window: int = 4) -> WindowPlan:
-    """Cut a stream-mode ``TiledBlocks`` side (single shard) into windows.
+                      chunks_per_window: int = 4,
+                      shard: int = 0) -> WindowPlan:
+    """Cut one shard of a stream-mode ``TiledBlocks`` side into windows.
 
     ``table_rows`` is the FIXED side's padded entity count (the row space
     ``neighbor_idx`` addresses, with ``table_rows`` itself as the virtual
     zero row).  ``chunks_per_window`` is a target: a window grows past it
     when no ``carry_in == 0`` cut exists (a hot entity straddling chunks),
     and every window is padded up to the common maximum with all-trash
-    chunks so one static shape serves them all.
+    chunks so one static shape serves them all.  ``shard`` selects the
+    shard-major slice of sharded blocks (the sharded driver builds one
+    plan per shard; the per-shard chunk scan is exactly what the
+    all_gather-exchange resident step runs on that shard).
     """
     if blocks.mode != "stream":
         raise ValueError(
             f"window plans cut the stream-mode chunk scan; these blocks "
             f"are mode={blocks.mode!r} (build with accum_max_entities=0 "
-            "to force stream mode — the out-of-core regime's mode)"
+            "to force stream mode — the out-of-core regime's mode; the "
+            "ring exchanges' accum blocks go through "
+            "build_ring_window_plan)"
         )
-    if blocks.num_shards != 1:
+    if not 0 <= shard < blocks.num_shards:
         raise ValueError(
-            "the windowed driver is single-process: build the blocks with "
-            f"num_shards=1 (got {blocks.num_shards})"
+            f"shard {shard} outside [0, {blocks.num_shards})"
         )
     if chunks_per_window < 1:
         raise ValueError(
@@ -107,14 +192,15 @@ def build_window_plan(blocks, table_rows: int, *,
         )
     nc, cap, e_c, t = blocks.statics
     nt = cap // t
-    nb = blocks.neighbor_idx.reshape(nc, cap)
-    rt = blocks.rating.reshape(nc, cap)
-    wt = blocks.weight.reshape(nc, cap)
-    ts = blocks.tile_seg.reshape(nc, nt)
-    ent = blocks.chunk_entity.reshape(nc, e_c)
-    cnt = blocks.chunk_count.reshape(nc, e_c)
-    cin = blocks.carry_in.reshape(nc)
-    lseg = blocks.last_seg.reshape(nc)
+    n_sh = blocks.num_shards
+    nb = _shard_leaf(blocks.neighbor_idx, n_sh, shard).reshape(nc, cap)
+    rt = _shard_leaf(blocks.rating, n_sh, shard).reshape(nc, cap)
+    wt = _shard_leaf(blocks.weight, n_sh, shard).reshape(nc, cap)
+    ts = _shard_leaf(blocks.tile_seg, n_sh, shard).reshape(nc, nt)
+    ent = _shard_leaf(blocks.chunk_entity, n_sh, shard).reshape(nc, e_c)
+    cnt = _shard_leaf(blocks.chunk_count, n_sh, shard).reshape(nc, e_c)
+    cin = _shard_leaf(blocks.carry_in, n_sh, shard).reshape(nc)
+    lseg = _shard_leaf(blocks.last_seg, n_sh, shard).reshape(nc)
     local = blocks.local_entities
 
     # Cut points: a window may start at chunk c only when chunk c does not
@@ -155,11 +241,6 @@ def build_window_plan(blocks, table_rows: int, *,
     w = len(groups)
     rows = np.zeros((w, window_rows), dtype=np.int64)
     nb_w = np.full((w, ncw * cap), window_rows, dtype=np.int32)
-    rt_w = np.zeros((w, ncw * cap), dtype=np.float32)
-    wt_w = np.zeros((w, ncw * cap), dtype=np.float32)
-    ts_w = np.full((w, ncw * nt), e_c, dtype=np.int32)
-    ent_w = np.full((w, ncw * e_c), local, dtype=np.int32)
-    cnt_w = np.ones((w, ncw * e_c), dtype=blocks.chunk_count.dtype)
     cin_w = np.zeros((w, ncw), dtype=np.float32)
     lseg_w = np.zeros((w, ncw), dtype=np.int32)
     for wi, ((lo, hi), rows_w) in enumerate(zip(groups, row_lists)):
@@ -171,21 +252,195 @@ def build_window_plan(blocks, table_rows: int, *,
         reb = np.searchsorted(rows_w, chunk_nb).astype(np.int32)
         reb[chunk_nb >= f] = window_rows
         nb_w[wi, : n * cap] = reb
-        rt_w[wi, : n * cap] = rt[lo:hi].ravel()
-        wt_w[wi, : n * cap] = wt[lo:hi].ravel()
-        ts_w[wi, : n * nt] = ts[lo:hi].ravel()
-        ent_w[wi, : n * e_c] = ent[lo:hi].ravel()
-        cnt_w[wi, : n * e_c] = cnt[lo:hi].ravel()
         cin_w[wi, :n] = cin[lo:hi]
         lseg_w[wi, :n] = lseg[lo:hi]
 
     return WindowPlan(
         rows=rows,
         row_counts=np.asarray(counts, dtype=np.int64),
+        chunk_lo=np.asarray([lo for lo, _ in groups], dtype=np.int64),
         chunk_counts=np.asarray([hi - lo for lo, hi in groups],
                                 dtype=np.int64),
-        neighbor_idx=nb_w, rating=rt_w, weight=wt_w, tile_seg=ts_w,
-        chunk_entity=ent_w, chunk_count=cnt_w, carry_in=cin_w,
-        last_seg=lseg_w, statics=(ncw, cap, e_c, t),
+        neighbor_idx=nb_w, carry_in=cin_w, last_seg=lseg_w,
+        statics=(ncw, cap, e_c, t),
         window_rows=window_rows, table_rows=f, local_entities=local,
+        src={"rating": rt, "weight": wt, "tile_seg": ts,
+             "chunk_entity": ent, "chunk_count": cnt},
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RingWindowPlan:
+    """Ring/hier-ring window plan: one shard's accum-mode chunk scan as
+    per-(fixed-table-slice) staged windows.
+
+    The resident ring rotates whole fixed-side BLOCKS and visits each
+    slice's chunk range once; windowed execution stages only the block
+    rows the slice's chunks actually reference (the "window residual")
+    and accumulates the identical per-chunk Grams into the shard's
+    persistent [E_local+1, k(, k)] accumulator.  Cuts inside a slice are
+    free (the accumulation is chunk-dense, no carry), so windows pad to
+    one static shape and one jit trace serves every (slice, window).
+
+    ``rows`` are ABSOLUTE fixed-store rows (slice·H + block-local), so
+    the staging gather is one ``HostFactorStore.gather`` and the driver
+    can attribute each staged row to the store shard that owns it (the
+    fabric-crossing accounting the bench rows record).  Zero-copy like
+    ``WindowPlan``: only the rebased neighbor stream is plan-held."""
+
+    slice_of: np.ndarray      # [NW] int32 fixed-table slice per window
+    rows: np.ndarray          # [NW, R] int64 ABSOLUTE store rows
+    row_counts: np.ndarray    # [NW]
+    chunk_lo: np.ndarray      # [NW] first shard-local chunk
+    chunk_counts: np.ndarray  # [NW] real chunks (<= ncw)
+    neighbor_idx: np.ndarray  # [NW, ncw·C] int32 rebased (zero row → R)
+    statics: tuple            # the blocks' accum statics (NC, C, T, H, Ec)
+    window_chunks: int        # ncw (static chunks per staged window)
+    window_rows: int          # R
+    local_entities: int
+    num_slices: int
+    src: dict = dataclasses.field(repr=False, default_factory=dict)
+
+    @property
+    def num_windows(self) -> int:
+        return int(self.rows.shape[0])
+
+    def windows_of_slice(self, t: int) -> range:
+        lo = int(np.searchsorted(self.slice_of, t, side="left"))
+        hi = int(np.searchsorted(self.slice_of, t, side="right"))
+        return range(lo, hi)
+
+    def staged_bytes_per_window(self, rank: int, stage_itemsize: int, *,
+                                row_overhead_bytes: int = 0) -> int:
+        nc, cap, t, h, e_c = self.statics
+        nt = cap // t
+        table = int(self.window_rows) * (rank * stage_itemsize
+                                         + row_overhead_bytes)
+        chunks = (self.window_chunks
+                  * (cap * 12 + nt * 4 + e_c * 4))
+        return table + chunks
+
+    def plan_held_bytes(self) -> int:
+        return (self.slice_of.nbytes + self.rows.nbytes
+                + self.row_counts.nbytes + self.chunk_lo.nbytes
+                + self.chunk_counts.nbytes + self.neighbor_idx.nbytes)
+
+    def stage_chunks(self, w: int) -> tuple:
+        """Window ``w``'s (rating, weight, tile_seg, chunk_entity) host
+        arrays — views for full windows, padded assembly for ragged."""
+        nc, cap, t, h, e_c = self.statics
+        nt = cap // t
+        ncw = self.window_chunks
+        n = int(self.chunk_counts[w])
+        lo = int(self.chunk_lo[w])
+        s = self.src
+        if n == ncw:
+            return (
+                s["rating"][lo:lo + ncw].reshape(-1),
+                s["weight"][lo:lo + ncw].reshape(-1),
+                s["tile_seg"][lo:lo + ncw].reshape(-1),
+                s["chunk_entity"][lo:lo + ncw].reshape(-1),
+            )
+        rt = np.zeros(ncw * cap, dtype=np.float32)
+        wt = np.zeros(ncw * cap, dtype=np.float32)
+        ts = np.full(ncw * nt, e_c, dtype=np.int32)
+        ent = np.full(ncw * e_c, self.local_entities, dtype=np.int32)
+        rt[: n * cap] = s["rating"][lo:lo + n].reshape(-1)
+        wt[: n * cap] = s["weight"][lo:lo + n].reshape(-1)
+        ts[: n * nt] = s["tile_seg"][lo:lo + n].reshape(-1)
+        ent[: n * e_c] = s["chunk_entity"][lo:lo + n].reshape(-1)
+        return rt, wt, ts, ent
+
+
+def build_ring_window_plan(blocks, *, shard: int,
+                           chunks_per_window: int = 4) -> RingWindowPlan:
+    """Cut one shard of ring-built (accum-mode) ``TiledBlocks`` into
+    per-slice staged windows.
+
+    Slices are the fixed side's factor shards (``num_slices ==
+    num_shards`` for ring builds); a window never spans slices — the
+    slice boundary is where the resident ring would rotate to a
+    different block.  Neighbor indices are block-local in the source
+    arrays; the plan rebases them to the window and records ABSOLUTE
+    store rows (slice·H + local) for the staging gather."""
+    if blocks.mode != "accum" or not blocks.ring:
+        raise ValueError(
+            "ring window plans cut ring-built accum-mode tiled blocks "
+            f"(mode={blocks.mode!r}, ring={blocks.ring}); build the "
+            "dataset with Dataset.from_coo(..., layout='tiled', "
+            "ring=True)"
+        )
+    if not 0 <= shard < blocks.num_shards:
+        raise ValueError(
+            f"shard {shard} outside [0, {blocks.num_shards})"
+        )
+    if chunks_per_window < 1:
+        raise ValueError(
+            f"chunks_per_window must be >= 1, got {chunks_per_window}"
+        )
+    nc, cap, t, h, e_c = blocks.statics
+    nt = cap // t
+    n_sh = blocks.num_shards
+    n_sl = blocks.num_slices
+    nb = _shard_leaf(blocks.neighbor_idx, n_sh, shard).reshape(nc, cap)
+    rt = _shard_leaf(blocks.rating, n_sh, shard).reshape(nc, cap)
+    wt = _shard_leaf(blocks.weight, n_sh, shard).reshape(nc, cap)
+    ts = _shard_leaf(blocks.tile_seg, n_sh, shard).reshape(nc, nt)
+    ent = _shard_leaf(blocks.chunk_entity, n_sh, shard).reshape(nc, e_c)
+    starts = _shard_leaf(blocks.slice_starts, n_sh, shard)
+    local = blocks.local_entities
+
+    groups: list[tuple[int, int, int]] = []  # (slice, lo, hi)
+    for sl in range(n_sl):
+        lo, hi = int(starts[sl]), int(starts[sl + 1])
+        c = lo
+        while c < hi:
+            end = min(c + chunks_per_window, hi)
+            groups.append((sl, c, end))
+            c = end
+        # An empty slice gets NO windows — the resident ring's chunk loop
+        # over it is empty too (fori over an empty range); the driver's
+        # windows_of_slice(t) then yields nothing for it.
+    # A shard with no real chunks at all still plans (zero windows): the
+    # driver's final solve runs on the zero accumulators either way,
+    # matching the resident ring's empty chunk loops.
+    ncw = max((hi - lo for _, lo, hi in groups), default=1)
+
+    row_lists, counts = [], []
+    for sl, lo, hi in groups:
+        w_nb = nb[lo:hi].ravel()
+        real = w_nb[w_nb < h]
+        rows_w = np.unique(real)
+        row_lists.append(rows_w)
+        counts.append(rows_w.shape[0])
+    window_rows = max(_round_up(max(max(counts, default=1), 1), 8), 8)
+
+    w = len(groups)
+    rows = np.zeros((w, window_rows), dtype=np.int64)
+    nb_w = np.full((w, ncw * cap), window_rows, dtype=np.int32)
+    for wi, ((sl, lo, hi), rows_w) in enumerate(zip(groups, row_lists)):
+        n = hi - lo
+        # Absolute store rows: block-local → slice base + local (pad rows
+        # repeat the slice base — gathered but never referenced).
+        rows[wi] = sl * h
+        rows[wi, : rows_w.shape[0]] = sl * h + rows_w
+        if n:
+            chunk_nb = nb[lo:hi].ravel()
+            reb = np.searchsorted(rows_w, chunk_nb).astype(np.int32)
+            reb[chunk_nb >= h] = window_rows
+            nb_w[wi, : n * cap] = reb
+
+    return RingWindowPlan(
+        slice_of=np.asarray([sl for sl, _, _ in groups], dtype=np.int32),
+        rows=rows,
+        row_counts=np.asarray(counts, dtype=np.int64),
+        chunk_lo=np.asarray([lo for _, lo, _ in groups], dtype=np.int64),
+        chunk_counts=np.asarray([hi - lo for _, lo, hi in groups],
+                                dtype=np.int64),
+        neighbor_idx=nb_w,
+        statics=(nc, cap, t, h, e_c),
+        window_chunks=ncw, window_rows=window_rows,
+        local_entities=local, num_slices=n_sl,
+        src={"rating": rt, "weight": wt, "tile_seg": ts,
+             "chunk_entity": ent},
     )

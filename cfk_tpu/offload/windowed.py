@@ -4,12 +4,15 @@ The ALX move (arXiv 2112.02194): accept that factor tables exceed one
 chip's HBM, keep them in host RAM (``HostFactorStore``), and stream
 WINDOWS of the fixed side through the device while the solve streams the
 chunk scan.  The execution per chunk is literally the resident tiled
-half-step — ``ops.tiled.als_half_step_tiled`` runs unmodified against the
-staged window with rebased indices (PR 4's in-kernel gather reads from
-ANY-memory-space tables, so the kernels just point at the window) — which
-is what makes the windowed path BIT-EXACT vs the resident path
-(``tests/test_offload.py`` pins it per knob: table dtype, gather mode,
-fused epilogue, overlap).
+half-step — ``ops.tiled.als_half_step_tiled`` (stream/all_gather mode) or
+the ring schedules' per-slice chunk body (``parallel.spmd.
+_make_tiled_slice_grams``'s ops, ring/hier_ring mode) run unmodified
+against the staged window with rebased indices (PR 4's in-kernel gather
+reads from ANY-memory-space tables, so the kernels just point at the
+window) — which is what makes the windowed path BIT-EXACT vs the resident
+path (``tests/test_offload.py`` + ``tests/test_offload_sharded.py`` pin it
+per knob: shard count, exchange/ici_group, table dtype, gather mode, fused
+epilogue, overlap).
 
 Schedule per half-step (the ``ops/pipeline.py`` shape, one level up):
 
@@ -18,20 +21,30 @@ Schedule per half-step (the ``ops/pipeline.py`` shape, one level up):
             scatter solved rows of w back to the host store
 
 Window w's jitted compute is DISPATCHED first (jit dispatch is async),
-then window w+1's host gather + ``device_put`` run under it, and only
-then is w's result joined — so the host staging work AND the PCIe
-transfer both hide under the Gram+solve exactly as the chunk pipelines
-overlap their gathers; the per-window chunk math, order, and carry
-semantics are unchanged (windows cut only at ``carry_in == 0``
-boundaries — ``offload/window.py``).
+then window w+1's host gather + ``device_put`` run under it — so the host
+staging work AND the PCIe transfer both hide under the Gram+solve exactly
+as the chunk pipelines overlap their gathers.  In the sharded ring modes
+the same double buffer runs under the visit schedule's inner-ICI
+rotations: window w+1 of the NEXT slice visit stages while the current
+slice's Grams accumulate, and the only DCN-share traffic is each window's
+row set gathered from a remote store shard — the "window residual" —
+never the flat ring's O(S) full-table rotation.
+
+Staged bytes per dtype (ISSUE 12): f32 windows stage 4 B/cell, bf16 2
+(the cast is per-element, host-cast == device-cast bit-exactly), and int8
+tables stage the (1-byte codes, one f32 per-row scale) pair the kernels
+consume — a quarter of the f32 bytes — quantized ON THE HOST by
+``store.quantize_rows_host``, whose arithmetic is pinned bit-identical to
+the in-jit ``ops.quant.quantize_table`` (the per-row scheme makes a
+window's rows quantize independently of the table around them).
 
 ``train_als_host_window`` is the ``offload_tier="host_window"`` executor
 the planner resolves oversized problems to (``plan/resolver.py`` gates the
-``device`` tier on ``offload.budget`` — the same predicate the window
-sizing here consumes, so a plan can never promise a resident table that
-does not fit).  Explicit ALS on the tiled stream layout, single process;
-the hierarchical ICI×DCN exchange for the multi-chip regime lives in
-``parallel/spmd.half_step_tiled_ring_hier``.
+``device`` tier on ``offload.budget`` — the same per-shard predicate the
+window sizing here consumes, so a plan can never promise a resident table
+that does not fit).  Explicit ALS on the tiled layout; one process
+driving all shards (each shard's windows stage against the entity-range
+store shard placement a multi-host deployment would pin per host).
 """
 
 from __future__ import annotations
@@ -45,19 +58,35 @@ from cfk_tpu.config import ALSConfig
 from cfk_tpu.offload import budget as _budget
 # _np_dtype: the ONE validated name→numpy-dtype mapping (raises on
 # anything but float32/bfloat16 — no silent fallthrough).
-from cfk_tpu.offload.store import HostFactorStore, _np_dtype
-from cfk_tpu.offload.window import WindowPlan, build_window_plan
+from cfk_tpu.offload.store import (
+    HostFactorStore,
+    _np_dtype,
+    quantize_rows_host,
+)
+from cfk_tpu.offload.window import (
+    RingWindowPlan,
+    WindowPlan,
+    build_ring_window_plan,
+    build_window_plan,
+)
 
 
 def _stage_dtype(store_dtype: str, table_dtype: str | None) -> str:
     """The dtype windows cross PCIe at: bf16 tables stage bf16 (half the
-    transfer — the cast is per-element, so host-cast == device-cast
-    bit-exactly); int8 stages at the storage dtype and quantizes on device
-    per window (per-row scheme ⇒ window quantization == sliced full-table
-    quantization; staging the codes themselves is an on-TPU follow-up)."""
-    if table_dtype == "bfloat16":
-        return "bfloat16"
+    transfer), int8 tables stage the (int8 codes, f32 per-row scales)
+    pair (a quarter — ``quantize_rows_host`` on the host side of the
+    PCIe, bit-identical to the in-jit quantization the resident path
+    runs); f32 stages the storage dtype."""
+    if table_dtype in ("bfloat16", "int8"):
+        return table_dtype
     return store_dtype
+
+
+def _stage_cell_bytes(stage_name: str) -> tuple[int, int]:
+    """(bytes per staged table cell, per-row overhead bytes)."""
+    if stage_name == "int8":
+        return 1, 4  # codes + one f32 scale per row
+    return _np_dtype(stage_name).itemsize, 0
 
 
 @functools.partial(
@@ -66,14 +95,25 @@ def _stage_dtype(store_dtype: str, table_dtype: str | None) -> str:
                      "fused_epilogue", "in_kernel_gather",
                      "reg_solve_algo", "table_dtype", "out_dtype"),
 )
-def _window_half_jit(tbl, nb, rt, wt, ts, ent, cnt, cin, lseg, *, statics,
-                     lam, solver, overlap, fused_epilogue,
+def _window_half_jit(tbl, scale, nb, rt, wt, ts, ent, cnt, cin, lseg, *,
+                     statics, lam, solver, overlap, fused_epilogue,
                      in_kernel_gather, reg_solve_algo, table_dtype,
                      out_dtype):
     """One window's chunks through the UNMODIFIED stream-mode half-step
-    (``return_chunk_rows`` skips the device scatter — the host does it)."""
+    (``return_chunk_rows`` skips the device scatter — the host does it).
+
+    ``scale`` is the staged int8 window's per-row dequant scale (None for
+    f32/bf16 staging): the fold into the weight channel happens HERE, the
+    canonical order ``quantize_tiled_operand`` applies on the resident
+    path, and the codes then flow to the half-step as an
+    already-quantized table (``table_dtype=None`` — quantizing again
+    would be wrong)."""
+    from cfk_tpu.ops import quant
     from cfk_tpu.ops.tiled import tiled_half_step
 
+    if scale is not None:
+        wt = quant.fold_scale(wt, scale, nb)
+        table_dtype = None
     blk = dict(neighbor_idx=nb, rating=rt, weight=wt, tile_seg=ts,
                chunk_entity=ent, chunk_count=cnt, carry_in=cin,
                last_seg=lseg)
@@ -86,10 +126,180 @@ def _window_half_jit(tbl, nb, rt, wt, ts, ent, cnt, cin, lseg, *, statics,
     return xs.astype(jax.numpy.dtype(out_dtype))
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("statics", "backend", "gather", "int8"),
+)
+def _ring_window_jit(acc_a, acc_b, tbl, scale, nb, rt, wt, ts, ent, *,
+                     statics, backend, gather, int8):
+    """One staged ring window's chunks, accumulated into the shard's
+    persistent per-entity Gram carry — op-for-op the flat/hier ring's
+    per-slice chunk body (``parallel.spmd._make_tiled_slice_grams``),
+    with the staged window replacing the rotated block (gathered values
+    are bitwise the block rows, so the Grams — and their scatter-add
+    order — are identical)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from cfk_tpu.ops import quant
+    from cfk_tpu.ops.tiled import _entity_gram_chunk
+
+    ncw, cap, t, e_c = statics
+    nt = cap // t
+    k = tbl.shape[-1]
+    if gather == "fused":
+        fz = tbl
+    else:
+        fz = jnp.concatenate([tbl, jnp.zeros((1, k), tbl.dtype)])
+
+    def chunk_body(i, acc):
+        a0, b0 = acc
+        nb_c = lax.dynamic_slice(nb, (i * cap,), (cap,))
+        rt_c = lax.dynamic_slice(rt, (i * cap,), (cap,))
+        wt_c = lax.dynamic_slice(wt, (i * cap,), (cap,))
+        ts_c = lax.dynamic_slice(ts, (i * nt,), (nt,))
+        ent_c = lax.dynamic_slice(ent, (i * e_c,), (e_c,))
+        wt_c = quant.fold_scale(wt_c, scale, nb_c)
+        a, b = _entity_gram_chunk(
+            fz, nb_c, wt_c, rt_c, ts_c, t, e_c + 1, backend,
+            unit_weights=not int8,
+            zero_appended=gather != "fused", gather=gather,
+        )
+        return (a0.at[ent_c].add(a[:e_c]), b0.at[ent_c].add(b[:e_c]))
+
+    return lax.fori_loop(0, ncw, chunk_body, (acc_a, acc_b))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("local", "lam", "solver", "fused_epilogue",
+                     "reg_solve_algo", "out_dtype"),
+)
+def _ring_solve_jit(acc_a, acc_b, cnt, *, local, lam, solver,
+                    fused_epilogue, reg_solve_algo, out_dtype):
+    from cfk_tpu.ops.solve import regularized_solve
+
+    x = regularized_solve(
+        acc_a[:local], acc_b[:local], cnt, lam, solver,
+        fused=fused_epilogue, algo=reg_solve_algo,
+    )
+    return x.astype(jax.numpy.dtype(out_dtype))
+
+
 class WindowIntegrityError(RuntimeError):
     """A staged window's bytes no longer match the host store's (torn or
     corrupted transfer, caught by the staging checksum — the window
     analog of the checkpoint crc32 contract)."""
+
+
+def hier_visit_order(num_shards: int, inner: int, shard: int) -> list[int]:
+    """The slice visit order of ``parallel.spmd.half_step_tiled_ring_hier``
+    for one shard: phases walk the outer (DCN) ring, inner steps walk the
+    ICI ring — ``held(p, j) = ((g−p)%O)·I + (i+p−j)%I``.  ``inner ==
+    num_shards`` degenerates to the flat ring's ``(shard − r) % S``
+    order, which is the exchange='ring' schedule (the bit-identity the
+    resident paths already pin)."""
+    if inner < 1 or num_shards % inner != 0:
+        raise ValueError(
+            f"inner ring size {inner} must divide num_shards={num_shards}"
+        )
+    outer = num_shards // inner
+    g, i_pos = shard // inner, shard % inner
+    return [
+        ((g - p) % outer) * inner + (i_pos + p - j) % inner
+        for p in range(outer) for j in range(inner)
+    ]
+
+
+def _stage_table(fixed_store: HostFactorStore, rows: np.ndarray, *,
+                 stage_np, int8: bool, faults, iteration: int, side: str,
+                 window: int, shard: int, verify_windows: bool,
+                 stats: dict | None, home_shard: int, ici_group: int):
+    """Gather + (optionally) quantize one window's table rows on the host
+    — the staging pipeline up to the ``device_put`` hand-off.
+
+    Fault hooks and the integrity checksum run on the GATHERED rows
+    (before quantization, so a NaN fault poisons the int8 scale exactly
+    as the resident in-jit quantization would); the fabric attribution
+    meters which store shard each row came from relative to the compute
+    shard's home (local / same-ICI-group / DCN — the hier exchange's
+    payload accounting)."""
+    import zlib
+
+    if faults is not None:
+        faults.delay(iteration, side, window, shard=shard)
+    tbl = fixed_store.gather(rows)
+    if not int8 and tbl.dtype != stage_np:
+        tbl = tbl.astype(stage_np)
+    src_crc = zlib.crc32(tbl.tobytes()) if verify_windows else None
+    # The fault hook models in-flight staging corruption: it fires
+    # BETWEEN the source checksum and the device transfer.
+    if faults is not None:
+        tbl = faults.apply_window(iteration, side, window, tbl,
+                                  shard=shard)
+    if verify_windows and zlib.crc32(tbl.tobytes()) != src_crc:
+        raise WindowIntegrityError(
+            f"shard {shard} side {side!r} iteration {iteration} window "
+            f"{window}: staged bytes diverge from the host store "
+            "(torn/corrupt transfer)"
+        )
+    if int8:
+        data, scale = quantize_rows_host(tbl)
+    else:
+        data, scale = tbl, None
+    if stats is not None and fixed_store.num_shards > 1:
+        owners = fixed_store.shard_of_rows(rows)
+        home = (owners == home_shard)
+        group = (owners // max(ici_group, 1)
+                 == home_shard // max(ici_group, 1))
+        stats["rows_local"] = stats.get("rows_local", 0) + int(home.sum())
+        stats["rows_ici"] = (stats.get("rows_ici", 0)
+                             + int((group & ~home).sum()))
+        stats["rows_dcn"] = stats.get("rows_dcn", 0) + int((~group).sum())
+    return data, scale
+
+
+def _stage_window(fixed_store: HostFactorStore, plan_obj, w: int, *,
+                  stage_np, int8: bool, faults, iteration: int, side: str,
+                  shard: int, verify_windows: bool, stats: dict | None,
+                  ici_group: int) -> tuple:
+    """Stage window ``w`` of either plan kind (the stream ``WindowPlan``
+    or the ``RingWindowPlan`` — both expose rows / neighbor_idx /
+    stage_chunks): host gather + optional quantization + checksum via
+    ``_stage_table``, staged-bytes metering, then the ``device_put``
+    hand-off.  ONE copy of the metering so the bench rows recorded from
+    both execution shapes can never drift apart."""
+    data, scale = _stage_table(
+        fixed_store, plan_obj.rows[w], stage_np=stage_np, int8=int8,
+        faults=faults, iteration=iteration, side=side, window=w,
+        shard=shard, verify_windows=verify_windows, stats=stats,
+        home_shard=shard, ici_group=ici_group,
+    )
+    host = (data, scale, plan_obj.neighbor_idx[w],
+            *plan_obj.stage_chunks(w))
+    if stats is not None:
+        stats["windows_staged"] = stats.get("windows_staged", 0) + 1
+        # The FULL staged working set — table (+ int8 scales) AND chunk
+        # arrays — the same quantity the per-window budget was sized
+        # against (staged_bytes_per_window), so the recorded arithmetic
+        # reproduces the sizing decision.  The chunk arrays are
+        # zero-copy VIEWS of the block arrays on the host, but they
+        # still cross PCIe per window — staged bytes meter the transfer,
+        # not host allocations.  The TABLE share is metered separately:
+        # it is the bytes the staging dtype levers (int8 (codes, scales)
+        # ≈ ¼ of f32 — the honest per-dtype ratio the bench rows
+        # record).
+        stats["staged_bytes"] = (
+            stats.get("staged_bytes", 0)
+            + sum(a.nbytes for a in host if a is not None)
+        )
+        stats["staged_table_bytes"] = (
+            stats.get("staged_table_bytes", 0) + data.nbytes
+            + (scale.nbytes if scale is not None else 0)
+        )
+    return tuple(
+        jax.device_put(x) if x is not None else None for x in host
+    )
 
 
 def windowed_half_step(
@@ -98,68 +308,43 @@ def windowed_half_step(
     fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
     table_dtype: str | None = None, faults=None, iteration: int = 0,
     side: str = "", stats: dict | None = None, verify_windows: bool = False,
+    shard: int = 0, ici_group: int = 1,
 ) -> np.ndarray:
-    """Solve one side against a host-resident fixed table, window by
-    window.  Returns the solved [local_entities, rank] host array in
-    ``out_dtype`` (untouched rows zero — exactly the resident scatter's
-    output).  ``faults`` (chaos only) is a
-    ``resilience.faults.WindowFaultInjector``; ``verify_windows``
-    checksums each staged window at the store (crc32 before the staging
-    hand-off) against what is about to ship, and raises
-    ``WindowIntegrityError`` on a mismatch — NaN poisoning is caught by
-    the factor sentinel either way, but a TORN window is finite-and-
-    wrong, which only an integrity check can see.  Scope is the HOST
-    staging pipeline up to the ``device_put`` hand-off (which is where
-    the chaos fault hook models its corruption); verifying the PCIe DMA
-    itself would need a device-side checksum — on-TPU follow-up."""
-    import zlib
-
+    """Solve one shard's entities against a host-resident fixed table,
+    window by window (the stream-mode / all_gather-exchange scan).
+    Returns the solved [local_entities, rank] host array in ``out_dtype``
+    (untouched rows zero — exactly the resident scatter's output).
+    ``faults`` (chaos only) is a ``resilience.faults.WindowFaultInjector``;
+    ``verify_windows`` checksums each staged window at the store (crc32
+    before the staging hand-off) against what is about to ship, and
+    raises ``WindowIntegrityError`` on a mismatch — NaN poisoning is
+    caught by the factor sentinel either way, but a TORN window is
+    finite-and-wrong, which only an integrity check can see.  Scope is
+    the HOST staging pipeline up to the ``device_put`` hand-off (which is
+    where the chaos fault hook models its corruption); verifying the PCIe
+    DMA itself would need a device-side checksum — on-TPU follow-up."""
     k = fixed_store.rank
     stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
-    stage_np = _np_dtype(stage_name)
+    int8 = stage_name == "int8"
+    stage_np = None if int8 else _np_dtype(stage_name)
     out = np.zeros((wplan.local_entities, k), dtype=_np_dtype(out_dtype))
     n_w = wplan.num_windows
 
     def stage(w):
-        if faults is not None:
-            faults.delay(iteration, side, w)
-        tbl = fixed_store.gather(wplan.rows[w])
-        if tbl.dtype != stage_np:
-            tbl = tbl.astype(stage_np)
-        src_crc = zlib.crc32(tbl.tobytes()) if verify_windows else None
-        # The fault hook models in-flight staging corruption: it fires
-        # BETWEEN the source checksum and the device transfer.
-        if faults is not None:
-            tbl = faults.apply_window(iteration, side, w, tbl)
-        if verify_windows and zlib.crc32(tbl.tobytes()) != src_crc:
-            raise WindowIntegrityError(
-                f"side {side!r} iteration {iteration} window {w}: staged "
-                "bytes diverge from the host store (torn/corrupt transfer)"
-            )
-        host = (
-            tbl, wplan.neighbor_idx[w], wplan.rating[w], wplan.weight[w],
-            wplan.tile_seg[w], wplan.chunk_entity[w], wplan.chunk_count[w],
-            wplan.carry_in[w], wplan.last_seg[w],
+        return _stage_window(
+            fixed_store, wplan, w, stage_np=stage_np, int8=int8,
+            faults=faults, iteration=iteration, side=side, shard=shard,
+            verify_windows=verify_windows, stats=stats,
+            ici_group=ici_group,
         )
-        if stats is not None:
-            stats["windows_staged"] = stats.get("windows_staged", 0) + 1
-            # The FULL staged working set — table AND chunk arrays — the
-            # same quantity the per-window budget was sized against
-            # (WindowPlan.staged_bytes_per_window), so the recorded
-            # arithmetic reproduces the sizing decision.
-            stats["staged_bytes"] = (
-                stats.get("staged_bytes", 0)
-                + sum(a.nbytes for a in host)
-            )
-        return tuple(jax.device_put(x) for x in host)
 
     staged = stage(0)
     for w in range(n_w):
         # DISPATCH window w's compute first (jit dispatch is async), THEN
         # run window w+1's host gather + device_put under it, and only
         # then join w's result: both the host staging work (the store
-        # fancy-index gather, the optional checksum) and the transfer
-        # overlap the device compute.
+        # fancy-index gather, the optional quantization + checksum) and
+        # the transfer overlap the device compute.
         xs = _window_half_jit(
             *staged, statics=wplan.statics, lam=float(lam), solver=solver,
             overlap=overlap, fused_epilogue=fused_epilogue,
@@ -169,42 +354,191 @@ def windowed_half_step(
         )
         nxt = stage(w + 1) if w + 1 < n_w else None
         xs_np = np.asarray(xs)
-        ent = wplan.chunk_entity[w]
+        ent = wplan.chunk_entity_of(w)
         real = ent < wplan.local_entities
         out[ent[real]] = xs_np[real]
         staged = nxt
     return out
 
 
-def _stream_blocks_for(dataset, config: ALSConfig, tile_rows: int | None):
-    """The stream-mode tiled blocks the windowed driver runs on: the
-    dataset's own when they already qualify (both sides stream, one
-    shard), else a rebuild from the dense COO with accum mode disabled —
-    accum's persistent [E, k, k] device accumulator is exactly the
-    structure the out-of-core regime cannot hold."""
+def ring_windowed_half_step(
+    fixed_store: HostFactorStore, rplan: RingWindowPlan, *, lam: float,
+    visits: list[int], count_local: np.ndarray, out_dtype: str = "float32",
+    solver: str = "auto", overlap=None, fused_epilogue=None,
+    in_kernel_gather=None, reg_solve_algo=None,
+    table_dtype: str | None = None, faults=None, iteration: int = 0,
+    side: str = "", stats: dict | None = None, verify_windows: bool = False,
+    shard: int = 0, ici_group: int = 1,
+) -> np.ndarray:
+    """One shard's ring/hier-ring half-iteration against staged windows.
+
+    ``visits`` is the slice visit order the resident exchange would
+    deliver blocks in (``hier_visit_order``); per visit, the slice's
+    windows stage double-buffered while the persistent per-entity Gram
+    accumulator — the SAME [E_local+1, k(,k)] carry the resident ring
+    holds — absorbs each window's chunk Grams.  One solve at the end.
+    The staged window is the slice rows this shard's chunks actually
+    reference (the window residual) — never the whole block, which is
+    how the flat ring's O(S) full-table traffic disappears."""
+    import jax.numpy as jnp
+
+    from cfk_tpu.ops.tiled import (
+        default_tiled_gram_backend,
+        resolve_gather_mode,
+    )
+
+    k = fixed_store.rank
+    nc, cap, t, h, e_c = rplan.statics
+    nt = cap // t
+    local = rplan.local_entities
+    backend = default_tiled_gram_backend()
+    gather = resolve_gather_mode(
+        in_kernel_gather, backend, "full", cap, nt, t, e_c + 1, k,
+    )
+    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
+    int8 = stage_name == "int8"
+    stage_np = None if int8 else _np_dtype(stage_name)
+    schedule = [w for t_idx in visits
+                for w in rplan.windows_of_slice(t_idx)]
+
+    def stage(w):
+        return _stage_window(
+            fixed_store, rplan, w, stage_np=stage_np, int8=int8,
+            faults=faults, iteration=iteration, side=side, shard=shard,
+            verify_windows=verify_windows, stats=stats,
+            ici_group=ici_group,
+        )
+
+    acc_a = jnp.zeros((local + 1, k, k), jnp.float32)
+    acc_b = jnp.zeros((local + 1, k), jnp.float32)
+    staged = stage(schedule[0]) if schedule else None
+    for i, w in enumerate(schedule):
+        # Dispatch this window's accumulation (async), then stage the
+        # next visit's window under it — the inner-ICI-rotation overlap
+        # of the resident hier ring, one level up.
+        acc_a, acc_b = _ring_window_jit(
+            acc_a, acc_b, *staged,
+            statics=(rplan.window_chunks, cap, t, e_c),
+            backend=backend, gather=gather, int8=int8,
+        )
+        staged = stage(schedule[i + 1]) if i + 1 < len(schedule) else None
+    x = _ring_solve_jit(
+        acc_a, acc_b, jax.numpy.asarray(count_local), local=local,
+        lam=float(lam), solver=solver, fused_epilogue=fused_epilogue,
+        reg_solve_algo=reg_solve_algo, out_dtype=out_dtype,
+    )
+    return np.asarray(x)
+
+
+def _resolve_side_modes(dataset, config: ALSConfig
+                        ) -> tuple[bool, bool]:
+    """(movie_side_ring, user_side_ring) — which execution shape each
+    half runs, mirroring the resident trainer's resolution EXACTLY: the
+    ring exchanges apply only at num_shards > 1 (a single-device trainer
+    never consults the exchange knob), ``exchange='auto'`` takes each
+    half's ring flag AS BUILT (the resident per-side memory optimum,
+    ``spmd.gathered_layout_trees``), and the explicit exchanges require
+    matching blocks (validated by ``_blocks_for``)."""
+    from cfk_tpu.data.blocks import TiledBlocks
+
+    if config.num_shards == 1 or config.exchange == "all_gather":
+        return False, False
+    if config.exchange in ("ring", "hier_ring"):
+        return True, True
+    # exchange == "auto": per-side, from how the blocks were built.
+    mb, ub = dataset.movie_blocks, dataset.user_blocks
+    return (
+        bool(isinstance(mb, TiledBlocks) and mb.ring),
+        bool(isinstance(ub, TiledBlocks) and ub.ring),
+    )
+
+
+def _blocks_for(dataset, config: ALSConfig, tile_rows: int | None,
+                ring_m: bool, ring_u: bool):
+    """The tiled blocks the windowed driver runs on, per side.
+
+    Stream (all_gather-shape) sides need stream mode at the config's
+    shard count — the dataset's own blocks when they qualify, else a
+    rebuild from the dense COO with accum mode disabled (accum's
+    persistent [E, k, k] device accumulator is exactly the structure the
+    out-of-core regime cannot hold).  Ring sides need the dataset's
+    ring-built accum blocks as-is (their slice structure IS the exchange
+    schedule; no rebuild can synthesize it honestly).  Mismatches raise
+    with the same remedies the resident trainer gives."""
     from cfk_tpu.data.blocks import TiledBlocks, build_tiled_blocks
 
+    s = config.num_shards
     mb, ub = dataset.movie_blocks, dataset.user_blocks
-    ok = (
-        isinstance(mb, TiledBlocks) and isinstance(ub, TiledBlocks)
-        and mb.mode == "stream" and ub.mode == "stream"
-        and mb.num_shards == 1 and ub.num_shards == 1
+
+    def side_ok(blocks, ring):
+        if not isinstance(blocks, TiledBlocks) or blocks.num_shards != s:
+            return False
+        if ring:
+            return blocks.mode == "accum" and blocks.ring
+        return blocks.mode == "stream" and not blocks.ring
+
+    rebuilt = None
+
+    def stream_rebuild():
+        nonlocal rebuilt
+        if rebuilt is None:
+            coo = dataset.coo_dense
+            t = tile_rows or (mb.tile_rows
+                              if isinstance(mb, TiledBlocks) else 128)
+            build = functools.partial(
+                build_tiled_blocks, num_shards=s, tile_rows=t,
+                chunk_elems=config.chunk_cells(), accum_max_entities=0,
+            )
+            m_dense = coo.movie_raw.astype(np.int64)
+            u_dense = coo.user_raw.astype(np.int64)
+            rebuilt = (
+                build(m_dense, u_dense, coo.rating,
+                      dataset.movie_map.num_entities,
+                      dataset.user_map.num_entities),
+                build(u_dense, m_dense, coo.rating,
+                      dataset.user_map.num_entities,
+                      dataset.movie_map.num_entities),
+            )
+        return rebuilt
+
+    sides = (("movie", mb, ring_m, 0), ("user", ub, ring_u, 1))
+    # Validate first: mismatches that cannot be rebuilt raise with the
+    # resident trainer's own remedies.
+    for name, blocks, ring, _ in sides:
+        if ring and not side_ok(blocks, True):
+            # Ring blocks cannot be synthesized here — their slice
+            # structure IS the exchange schedule.
+            raise ValueError(
+                f"exchange={config.exchange!r} windowed training runs "
+                f"the {name} half on ring-built tiled blocks at "
+                f"num_shards={s}; rebuild with Dataset.from_coo(..., "
+                f"layout='tiled', num_shards={s}, ring=True)"
+            )
+        if (not ring and isinstance(blocks, TiledBlocks) and blocks.ring):
+            # Mirror the resident trainer: an all_gather half on
+            # ring-built blocks raises there too — silently rebuilding
+            # would train a different exchange schedule than the
+            # resident path the bit-exactness contract compares against.
+            raise ValueError(
+                f"exchange={config.exchange!r} runs the {name} half as "
+                "a stream scan, but its blocks were ring-built; pass "
+                "exchange='ring'/'hier_ring' (the windowed ring driver) "
+                "or rebuild with ring=False"
+            )
+    # If ANY stream side needs the rebuild, rebuild EVERY stream side:
+    # mixing dataset-built and driver-rebuilt stream blocks could differ
+    # in chunking (the dataset's build parameters vs the config's), and
+    # one consistent build is the PR 10 discipline.
+    rebuild_streams = any(
+        not ring and not side_ok(blocks, False)
+        for _, blocks, ring, _ in sides
     )
-    if ok:
-        return mb, ub
-    coo = dataset.coo_dense
-    t = tile_rows or (mb.tile_rows if isinstance(mb, TiledBlocks) else 128)
-    m_dense = coo.movie_raw.astype(np.int64)
-    u_dense = coo.user_raw.astype(np.int64)
-    build = functools.partial(
-        build_tiled_blocks, num_shards=1, tile_rows=t,
-        chunk_elems=config.chunk_cells(), accum_max_entities=0,
-    )
-    mb2 = build(m_dense, u_dense, coo.rating,
-                dataset.movie_map.num_entities, dataset.user_map.num_entities)
-    ub2 = build(u_dense, m_dense, coo.rating,
-                dataset.user_map.num_entities, dataset.movie_map.num_entities)
-    return mb2, ub2
+    out = [
+        stream_rebuild()[idx] if (not ring and rebuild_streams)
+        else blocks
+        for _, blocks, ring, idx in sides
+    ]
+    return out[0], out[1]
 
 
 def _probe(u: np.ndarray, m: np.ndarray, norm_limit: float | None) -> str | None:
@@ -222,6 +556,18 @@ def _probe(u: np.ndarray, m: np.ndarray, norm_limit: float | None) -> str | None
     return None
 
 
+def resolve_window_inner(config: ALSConfig) -> int:
+    """The windowed driver's inner-ring size: the SAME resolution the
+    resident hier ring uses (``parallel.spmd.resolve_ici_group``) for
+    ``hier_ring`` — visit order must match the exchange being replaced —
+    and one flat ring otherwise."""
+    if config.exchange == "hier_ring":
+        from cfk_tpu.parallel.spmd import resolve_ici_group
+
+        return resolve_ici_group(config)
+    return config.num_shards
+
+
 def train_als_host_window(
     dataset,
     config: ALSConfig,
@@ -236,17 +582,22 @@ def train_als_host_window(
 ):
     """ALS-WR with host-resident factor tables and windowed half-steps.
 
-    Same math, init, and iteration order as ``train_als`` on the same
-    stream-mode tiled blocks — bit-exact at every supported knob
-    (``tests/test_offload.py``).  Supports explicit ALS, ``layout='tiled'``,
-    one process; divergence recovery runs the PR 3 ladder against in-RAM
-    last-good snapshots of the stores (each rung is recorded with the
-    loop vocabulary and as a plan transition when provenance rides along).
+    Same math, init, and iteration order as ``train_als`` (one shard) or
+    ``parallel.spmd.train_als_sharded`` (sharded — all_gather, ring, or
+    hier_ring exchange) on the same tiled blocks — bit-exact at every
+    supported knob (``tests/test_offload.py`` /
+    ``tests/test_offload_sharded.py``).  Explicit ALS, ``layout='tiled'``,
+    ONE PROCESS driving every shard (the per-shard staging/visit
+    schedules are exactly what a multi-host deployment runs per host;
+    wiring them across real processes is the on-TPU backlog's job);
+    divergence recovery runs the PR 3 ladder against in-RAM last-good
+    snapshots of the stores (each rung is recorded with the loop
+    vocabulary and as a plan transition when provenance rides along).
 
-    ``device_budget_bytes`` bounds the staged working set (default: the
-    detected device's HBM through ``offload.budget`` — the SAME predicate
-    the planner gates the ``device`` tier with); ``chunks_per_window``
-    overrides the derived window size.
+    ``device_budget_bytes`` bounds the staged working set PER SHARD
+    (default: the detected device's HBM through ``offload.budget`` — the
+    SAME predicate the planner gates the ``device`` tier with);
+    ``chunks_per_window`` overrides the derived window size.
     """
     from cfk_tpu.ops.solve import init_factors_stats
     from cfk_tpu.resilience.policy import (
@@ -263,42 +614,68 @@ def train_als_host_window(
             "over the full fixed table — an out-of-core reduction is the "
             "documented follow-up)"
         )
-    if config.num_shards != 1:
-        raise ValueError(
-            "the windowed driver is single-process "
-            f"(num_shards={config.num_shards}); the multi-chip regime "
-            "pairs it with the hierarchical ring exchange "
-            "(parallel.spmd.half_step_tiled_ring_hier)"
-        )
     if config.layout != "tiled":
         raise ValueError(
-            f"host-window offload streams the tiled stream-mode layout; "
+            f"host-window offload streams the tiled layout; "
             f"layout={config.layout!r}"
         )
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "the windowed driver runs one process driving all shards; "
+            "true multi-process windowed training (per-host stores + "
+            "DCN window exchange) is the on-TPU follow-up (ROADMAP)"
+        )
+    s = config.num_shards
+    ring_m, ring_u = _resolve_side_modes(dataset, config)
+    any_ring = ring_m or ring_u
+    inner = resolve_window_inner(config) if any_ring else max(s, 1)
     metrics = metrics if metrics is not None else Metrics()
     with metrics.phase("window_plan"):
-        mb, ub = _stream_blocks_for(dataset, config, tile_rows)
+        mb, ub = _blocks_for(dataset, config, tile_rows, ring_m, ring_u)
         stage_name = _stage_dtype(config.dtype, config.table_dtype)
-        stage_itemsize = _np_dtype(stage_name).itemsize
+        cell_bytes, row_overhead = _stage_cell_bytes(stage_name)
         if device_budget_bytes is None:
             from cfk_tpu.plan import DeviceSpec
 
             device_budget_bytes = DeviceSpec.detect().hbm_bytes
-        per_window_budget = _budget.window_budget_bytes(device_budget_bytes)
+        # The ring modes hold a persistent per-shard Gram accumulator
+        # next to the staged windows; reserve it (×2: the dispatch
+        # boundary keeps a window call's input AND output accumulators
+        # alive — buffer donation is the on-TPU lever to reclaim one)
+        # before splitting the remainder across the window double buffer.
+        acc_reserved = 0.0
+        for blocks, ring in ((mb, ring_m), (ub, ring_u)):
+            if ring:
+                acc_reserved = max(
+                    acc_reserved,
+                    2.0 * _budget.ring_accumulator_bytes(
+                        blocks.local_entities, config.rank
+                    ),
+                )
+        per_window_budget = _budget.window_budget_bytes(
+            device_budget_bytes, reserved_bytes=acc_reserved
+        )
+
+        def side_plans(blocks, fixed, ring, cpw):
+            if ring:
+                return [build_ring_window_plan(blocks, shard=d,
+                                               chunks_per_window=cpw)
+                        for d in range(s)]
+            return [build_window_plan(blocks, fixed.padded_entities,
+                                      chunks_per_window=cpw, shard=d)
+                    for d in range(s)]
 
         def plans_for(cpw):
-            m_plan = build_window_plan(mb, ub.padded_entities,
-                                       chunks_per_window=cpw)
-            u_plan = build_window_plan(ub, mb.padded_entities,
-                                       chunks_per_window=cpw)
-            return m_plan, u_plan
+            return (side_plans(mb, ub, ring_m, cpw),
+                    side_plans(ub, mb, ring_u, cpw))
 
         cpw = chunks_per_window or 4
         while True:
-            m_plan, u_plan = plans_for(cpw)
+            m_plans, u_plans = plans_for(cpw)
             worst = max(
-                p.staged_bytes_per_window(config.rank, stage_itemsize)
-                for p in (m_plan, u_plan)
+                p.staged_bytes_per_window(config.rank, cell_bytes,
+                                          row_overhead_bytes=row_overhead)
+                for p in (*m_plans, *u_plans)
             )
             if worst <= per_window_budget or cpw == 1:
                 break
@@ -307,25 +684,45 @@ def train_als_host_window(
             raise ValueError(
                 f"one staged window needs {worst / 1e6:.1f} MB but the "
                 f"per-window budget is {per_window_budget / 1e6:.1f} MB "
-                "(device_budget · RESIDENT_FRACTION / WINDOW_BUFFERS) — "
-                "lower hbm_chunk_elems so single chunks fit the budget"
+                "((device_budget · RESIDENT_FRACTION − ring accumulator "
+                "reserve) / WINDOW_BUFFERS) — lower hbm_chunk_elems so "
+                "single chunks fit the budget"
             )
-    metrics.gauge("offload_windows_m", m_plan.num_windows)
-    metrics.gauge("offload_windows_u", u_plan.num_windows)
-    metrics.gauge("offload_window_rows_m", m_plan.window_rows)
-    metrics.gauge("offload_window_rows_u", u_plan.window_rows)
+    metrics.gauge("offload_windows_m",
+                  sum(p.num_windows for p in m_plans))
+    metrics.gauge("offload_windows_u",
+                  sum(p.num_windows for p in u_plans))
+    metrics.gauge("offload_window_rows_m",
+                  max(p.window_rows for p in m_plans))
+    metrics.gauge("offload_window_rows_u",
+                  max(p.window_rows for p in u_plans))
     metrics.gauge("offload_chunks_per_window", cpw)
+    metrics.gauge("offload_shards", s)
+    metrics.gauge(
+        "offload_plan_held_mb",
+        round(sum(p.plan_held_bytes()
+                  for p in (*m_plans, *u_plans)) / 1e6, 3),
+    )
+    if any_ring:
+        metrics.gauge("offload_ici_group", inner)
+        metrics.gauge("offload_acc_reserved_mb",
+                      round(acc_reserved / 1e6, 3))
+        metrics.note("offload_exchange", config.exchange)
 
-    # Init: identical to the resident tiled trainer (init_factors_stats at
-    # the padded entity count, zero movie seed).
+    # Init: identical to the resident trainers (init_factors_stats drawn
+    # at the REAL entity count — the shard-count-invariant init — zero
+    # movie seed).
     key = jax.random.PRNGKey(config.seed)
-    u0 = init_factors_stats(
+    u0 = jax.jit(
+        init_factors_stats, static_argnames=("rank", "num_entities")
+    )(
         key, jax.numpy.asarray(ub.rating_sum), jax.numpy.asarray(ub.count),
-        config.rank,
+        rank=config.rank, num_entities=ub.num_entities,
     ).astype(jax.numpy.dtype(config.dtype))
-    u_store = HostFactorStore.from_array(np.asarray(u0), dtype=config.dtype)
+    u_store = HostFactorStore.from_array(np.asarray(u0), dtype=config.dtype,
+                                         num_shards=s)
     m_store = HostFactorStore(mb.padded_entities, config.rank,
-                              dtype=config.dtype)
+                              dtype=config.dtype, num_shards=s)
 
     policy = policy_from_config(config)
     base_ov = Overrides(lam=config.lam, fused_epilogue=config.fused_epilogue)
@@ -347,8 +744,41 @@ def train_als_host_window(
         overlap=bool(config.overlap),
         in_kernel_gather=config.in_kernel_gather,
         table_dtype=config.table_dtype, faults=window_faults, stats=stats,
-        verify_windows=verify_windows,
+        verify_windows=verify_windows, ici_group=inner,
     )
+    m_local = mb.local_entities
+    u_local = ub.local_entities
+    count_m = mb.count.reshape(s, -1)
+    count_u = ub.count.reshape(s, -1)
+
+    def half(side, fixed_store, plans, local, counts, it, ring):
+        """One half-iteration across every shard: per-shard windowed
+        scans against the shared host store, in this side's execution
+        shape (``ring`` — the per-side resolution of
+        ``_resolve_side_modes``, so an ``exchange='auto'`` mixed build
+        runs each half exactly as the resident trainer would).  Reads
+        one store, writes a host buffer (committed by the caller) — no
+        read-after-write hazard across shards, matching the resident
+        step's solve-all-then-exchange structure."""
+        algo = ov.reg_solve_algo or config.reg_solve_algo
+        out = np.zeros((local * s, config.rank),
+                       dtype=_np_dtype(config.dtype))
+        for d in range(s):
+            kw = dict(half_kw, lam=ov.lam,
+                      fused_epilogue=ov.fused_epilogue,
+                      reg_solve_algo=algo, iteration=it, side=side,
+                      shard=d)
+            if ring:
+                visits = hier_visit_order(s, inner, d)
+                rows = ring_windowed_half_step(
+                    fixed_store, plans[d], visits=visits,
+                    count_local=counts[d], **kw,
+                )
+            else:
+                rows = windowed_half_step(fixed_store, plans[d], **kw)
+            out[d * local:(d + 1) * local] = rows
+        return out
+
     # Probing + last-good snapshots cost a full host pass + memcpy over
     # both stores per cadence — at the ALX regime that is gigabytes per
     # iteration — so they arm only when something can trip: the sentinel
@@ -403,19 +833,12 @@ def train_als_host_window(
 
     with metrics.phase("train"):
         while it < config.num_iterations:
-            algo = ov.reg_solve_algo or config.reg_solve_algo
             try:
-                m_new = windowed_half_step(
-                    u_store, m_plan, lam=ov.lam,
-                    fused_epilogue=ov.fused_epilogue, reg_solve_algo=algo,
-                    iteration=it, side="m", **half_kw,
-                )
+                m_new = half("m", u_store, m_plans, m_local, count_m, it,
+                             ring_m)
                 m_store.write_range(0, m_new)
-                u_new = windowed_half_step(
-                    m_store, u_plan, lam=ov.lam,
-                    fused_epilogue=ov.fused_epilogue, reg_solve_algo=algo,
-                    iteration=it, side="u", **half_kw,
-                )
+                u_new = half("u", m_store, u_plans, u_local, count_u, it,
+                             ring_u)
                 u_store.write_range(0, u_new)
             except WindowIntegrityError as e:
                 # The staging checksum caught a torn/corrupt window BEFORE
@@ -443,6 +866,11 @@ def train_als_host_window(
     metrics.gauge("offload_windows_staged", stats.get("windows_staged", 0))
     metrics.gauge("offload_staged_mb",
                   round(stats.get("staged_bytes", 0) / 1e6, 3))
+    metrics.gauge("offload_staged_table_mb",
+                  round(stats.get("staged_table_bytes", 0) / 1e6, 3))
+    for key_ in ("rows_local", "rows_ici", "rows_dcn"):
+        if key_ in stats:
+            metrics.gauge(f"offload_{key_}", stats[key_])
     if degraded:
         metrics.gauge("iterations_completed", snap_iter)
 

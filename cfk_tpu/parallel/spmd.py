@@ -1305,6 +1305,40 @@ def train_als_sharded(
     # to a plan the execution ignored.
     config = _config_under_plan(config, exec_plan)
 
+    if exec_plan.offload_tier == "host_window":
+        # Out-of-core tier, sharded (ISSUE 12): the per-shard budget
+        # predicate said resident tables cannot fit one device (or the
+        # config pinned the tier) — training runs through the sharded
+        # windowed host-offload driver, bit-exact vs THIS resident path
+        # (per-shard staged windows under the all_gather scan or the
+        # ring/hier_ring visit schedules; tests/test_offload_sharded.py).
+        unsupported = [
+            name for name, v in (
+                ("checkpoint_manager", checkpoint_manager),
+                ("fault_injector", fault_injector),
+                ("preemption_guard", preemption_guard),
+                ("watchdog", watchdog),
+            ) if v is not None
+        ]
+        if unsupported:
+            raise NotImplementedError(
+                f"offload_tier='host_window' does not support "
+                f"{unsupported} yet — the windowed driver keeps factors "
+                "in host stores (see cfk_tpu/offload/windowed.py; "
+                "window-level fault injection uses its window_faults=)"
+            )
+        from cfk_tpu.offload.windowed import train_als_host_window
+        from cfk_tpu.utils.metrics import Metrics as _Metrics
+
+        metrics = metrics if metrics is not None else _Metrics()
+        metrics.note("plan", plan_prov.summary())
+        # Config-threading ≡ half_step_kwargs for the windowed driver:
+        # _config_under_plan already wrote the plan's knobs back over the
+        # config fields, so execution cannot diverge from the provenance.
+        return train_als_host_window(
+            dataset, config, metrics=metrics, plan_provenance=plan_prov,
+        )
+
     gathered = gathered_layout_trees(dataset, config)
     stats_init = gathered is not None  # bucketed/segment: init from stats
     step_kw = {}

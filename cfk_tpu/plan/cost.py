@@ -154,13 +154,12 @@ def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
             # Of the S-1 transfers, O·(I-1) rotate inside the domain and
             # O-1 hop the DCN.  ici_domain=0 means one domain (all inner)
             # — the schedule and the cost degenerate to the flat ring's.
-            # The inner size modeled here is the DEVICE topology
-            # (ici_domain); execution's resolve_ici_group defaults to the
-            # same physical quantity (devices per process) but an
-            # explicit ALSConfig.ici_group override is invisible to the
-            # model — ici_group is not a plan field (documented; part of
-            # the on-TPU calibration backlog, ROADMAP item (f)).
-            inner = device.ici_domain or shards
+            # ``ici_group`` is a real plan field now (ISSUE 12): an
+            # explicit ALSConfig.ici_group pin reaches the model here, so
+            # it prices the hierarchy that actually runs; 0 (auto) falls
+            # back to the DEVICE topology (ici_domain), the same physical
+            # quantity execution's resolve_ici_group defaults to.
+            inner = plan.ici_group or device.ici_domain or shards
             inner = inner if shards % inner == 0 else shards
             outer = shards // inner
             inner_frac = (outer * (inner - 1)) / max(shards - 1, 1)
@@ -186,17 +185,27 @@ def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
         terms["exchange_exposed"] = exposed
         extra += exposed
 
-    # Out-of-core tier (ISSUE 11): every half-iteration stages the fixed
-    # side's windows over PCIe — the full table once per half-step, plus
-    # the duplication of rows shared between adjacent windows (~15% on
-    # power-law data).  The staging double buffer hides it under compute
-    # up to the floor exactly like the exchange term.
+    # Out-of-core tier (ISSUE 11/12): every half-iteration stages the
+    # fixed side's windows over PCIe — the full table once per half-step,
+    # plus the duplication of rows shared between adjacent windows (~15%
+    # on power-law data) — DIVIDED across shards: each shard stages only
+    # the window residual its own chunks reference, concurrently on its
+    # own host's PCIe (the DCN share of remote-shard rows is priced by
+    # the exchange term above, unchanged).  Staged cells follow the
+    # STAGING dtype (ISSUE 12): bf16 halves, int8 ships the (1-byte
+    # codes + one f32 scale per row) pair — a quarter, the honest bytes
+    # the executor's ``offload_staged_mb`` now records.  The staging
+    # double buffer hides it under per-shard compute up to the floor
+    # exactly like the exchange term.
     if plan.offload_tier == "host_window":
-        stage_bytes_per_row = k * (2.0 if plan.table_dtype == "bfloat16"
-                                   else factor_bytes)
+        stage_itemsize = {"bfloat16": 2.0, "int8": 1.0}.get(
+            plan.table_dtype, float(factor_bytes)
+        )
+        row_overhead = 4.0 if plan.table_dtype == "int8" else 0.0
+        stage_bytes_per_row = k * stage_itemsize + row_overhead
         window_dup = 1.15
         pcie = ((shape.num_users + shape.num_movies) * stage_bytes_per_row
-                * window_dup / device.pcie_bytes_per_s)
+                * window_dup / shards / device.pcie_bytes_per_s)
         if plan.overlap:
             exposed_pcie = max(0.0, pcie - floor * 0.5)
         else:

@@ -42,7 +42,8 @@ from cfk_tpu.plan.spec import (
 
 _TRAIN_FIELDS = ("layout", "exchange", "chunk_elems", "fused_epilogue",
                  "in_kernel_gather", "overlap", "reg_solve_algo",
-                 "table_dtype", "solver", "gram_backend", "offload_tier")
+                 "table_dtype", "solver", "gram_backend", "offload_tier",
+                 "ici_group")
 _SERVE_FIELDS = ("table_dtype", "serve_batch_quantum", "serve_tile_m")
 
 
@@ -75,10 +76,9 @@ def hard_conflict(shape: ProblemShape, pins: dict) -> str | None:
                     f"algorithm={shape.algorithm!r}"
                     f"{' (implicit)' if shape.implicit else ''} needs the "
                     "out-of-core global-Gram reduction (ROADMAP)")
-        if shape.num_shards != 1:
-            return ("offload_tier='host_window' is a single-process "
-                    f"driver (num_shards={shape.num_shards}); the sharded "
-                    "pairing with the hier ring is the ROADMAP follow-up")
+        # Sharded host_window is a real executor now (ISSUE 12): the
+        # windowed driver runs per-shard staged windows under the
+        # all_gather scan or the ring/hier_ring visit schedules.
     if shape.algorithm != "als":
         if layout in ("segment", "tiled"):
             return (f"algorithm={shape.algorithm!r} supports padded/"
@@ -87,6 +87,14 @@ def hard_conflict(shape: ProblemShape, pins: dict) -> str | None:
             return (f"algorithm={shape.algorithm!r} supports "
                     "exchange='all_gather' only; pinned "
                     f"exchange={pins['exchange']!r}")
+    ici = pins.get("ici_group")
+    if ici and shape.num_shards % ici != 0:
+        # The same divisibility rule ALSConfig enforces (the outer ring
+        # walks whole inner rings) — a plan must never promise a
+        # hierarchy hier_visit_order/half_step_tiled_ring_hier refuse.
+        return (f"ici_group={ici} must divide "
+                f"num_shards={shape.num_shards} (the outer ring walks "
+                "whole inner rings)")
     return None
 
 
@@ -114,13 +122,11 @@ def _feasible(shape: ProblemShape, device: DeviceSpec, cand: dict,
             return ("host-window offload supports explicit ALS (the "
                     "implicit/subspace global-Gram reductions are the "
                     "ROADMAP follow-up)")
-        if shape.num_shards != 1:
-            return ("host-window offload is a single-process driver — "
-                    "no executor accepts a sharded host_window plan")
-        if cand["exchange"] != "all_gather":
-            return ("host-window offload is a single-process driver "
-                    "(all_gather exchange; the hier ring is the "
-                    "multi-chip pairing, ROADMAP)")
+        # Sharded host_window executes (ISSUE 12): the windowed driver
+        # pairs per-shard staged windows with the all_gather scan or the
+        # ring/hier_ring visit schedules; the generic exchange rules
+        # above already refuse ring exchanges at one shard and non-tiled
+        # ring layouts.
     mosaic = _registry.backend_available("mosaic_tpu")
     if cand["gram_backend"] == "pallas" and not mosaic:
         return "mosaic_tpu backend unavailable"
@@ -246,13 +252,18 @@ def _host_window_eligible(shape: ProblemShape, pins: dict) -> bool:
     the one eligibility both the offload_tier axis and the pinned-device
     budget raise consult, so an explicit ``offload_tier='device'`` pin is
     refused exactly when unpinning it would have re-routed (and never
-    with a dead-end remedy on shapes the windowed driver cannot serve)."""
+    with a dead-end remedy on shapes the windowed driver cannot serve).
+    Sharded shapes qualify (ISSUE 12) — every exchange the sharded
+    trainers run (all_gather / ring / hier_ring) has a windowed twin."""
+    exchange_ok = (pins.get("exchange")
+                   in (None, "all_gather", "ring", "hier_ring"))
+    if shape.num_shards == 1:
+        exchange_ok = pins.get("exchange") in (None, "all_gather")
     return (shape.kind == "train"
             and shape.algorithm == "als"
             and not shape.implicit
-            and shape.num_shards == 1
             and pins.get("layout") in (None, "tiled")
-            and pins.get("exchange") in (None, "all_gather"))
+            and exchange_ok)
 
 
 def _assemble(shape: ProblemShape, cand: dict, pinned: frozenset,
@@ -306,10 +317,12 @@ def _rank_plans(shape: ProblemShape, device: DeviceSpec,
         need = train_resident_bytes(
             shape.num_users, shape.num_movies, shape.nnz, shape.rank,
             dtype=shape.dtype, table_dtype=pins.get("table_dtype"),
+            num_shards=shape.num_shards,
         )["total"]
         raise PlanConstraintError(
-            f"offload_tier='device' pinned but the resident working set "
-            f"(~{need / 1e9:.2f} GB) exceeds the device budget "
+            f"offload_tier='device' pinned but the PER-SHARD resident "
+            f"working set (~{need / 1e9:.2f} GB at "
+            f"num_shards={shape.num_shards}) exceeds the device budget "
             f"({device.hbm_bytes / 1e9:.2f} GB × budget fraction) — "
             "unpin offload_tier (the resolver will pick 'host_window') "
             "or shrink the problem"

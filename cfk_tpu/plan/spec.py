@@ -62,17 +62,28 @@ PLAN_FIELDS: dict[str, tuple] = {
     "serve_tile_m": (512,),
     # Out-of-core tier (ISSUE 11): "device" keeps both factor tables
     # HBM-resident (feasible ONLY while cfk_tpu.offload.budget's predicate
-    # passes — the same predicate the executor sizes windows with);
-    # "host_window" keeps them in host RAM and streams device_put windows
-    # (cfk_tpu.offload.windowed).  The resolver's enumeration axis is the
-    # predicate itself, so oversized problems resolve to host_window
-    # instead of promising a resident table that cannot exist.
+    # passes — the same PER-SHARD predicate the executor sizes windows
+    # with); "host_window" keeps them in host RAM and streams device_put
+    # windows (cfk_tpu.offload.windowed — sharded too, ISSUE 12).  The
+    # resolver's enumeration axis is the predicate itself, so oversized
+    # problems resolve to host_window instead of promising a resident
+    # table that cannot exist.
     "offload_tier": ("device", "host_window"),
+    # Inner-ring size of the hierarchical exchange (ISSUE 12 — promoted
+    # from an ALSConfig-only knob so the cost model can SEE the hierarchy
+    # it prices).  0 = auto: the device's ici_domain (execution resolves
+    # devices-per-process via spmd.resolve_ici_group — the same physical
+    # quantity).  An explicit ALSConfig.ici_group pins it, so the model
+    # prices the hierarchy that actually runs; adding this field also
+    # rotates the autotune cache's plan-field-set digest, invalidating
+    # every pre-ici_group winner (they carry no decision for it).
+    "ici_group": (0,),
 }
 
 # Fields whose pins are free-form positive ints (the candidate tuples
 # above are only the resolver's enumeration grid for UNPINNED fields).
-_NUMERIC_FIELDS = ("chunk_elems", "serve_batch_quantum", "serve_tile_m")
+_NUMERIC_FIELDS = ("chunk_elems", "serve_batch_quantum", "serve_tile_m",
+                   "ici_group")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -216,6 +227,7 @@ class PlanConstraints:
     serve_batch_quantum: int | None = None
     serve_tile_m: int | None = None
     offload_tier: str | None = None
+    ici_group: int | None = None
 
     def __post_init__(self) -> None:
         for f, candidates in PLAN_FIELDS.items():
@@ -280,6 +292,7 @@ def constraints_from_config(config) -> PlanConstraints:
         offload_tier=(None
                       if getattr(config, "offload_tier", "auto") == "auto"
                       else config.offload_tier),
+        ici_group=getattr(config, "ici_group", None),
     )
 
 
@@ -304,8 +317,12 @@ class ExecutionPlan:
     serve_tile_m: int = 512
     # Out-of-core tier (ISSUE 11): "device" = HBM-resident factor tables,
     # "host_window" = host-RAM stores + device_put-pipelined windows
-    # (cfk_tpu.offload) — gated by offload.budget's fit predicate.
+    # (cfk_tpu.offload) — gated by offload.budget's per-shard fit
+    # predicate.
     offload_tier: str = "device"
+    # Hierarchical-exchange inner-ring size (ISSUE 12); 0 = the device's
+    # ICI domain (spmd.resolve_ici_group's physical default).
+    ici_group: int = 0
     # (slot, backend) pairs — "mosaic_tpu" | "xla_emulation" per kernel
     # slot (cfk_tpu.plan.registry.KERNEL_SLOTS).
     kernels: tuple = ()
@@ -347,6 +364,8 @@ class ExecutionPlan:
         kb = ",".join(f"{s}={b.split('_')[0]}" for s, b in self.kernels)
         tier = ("" if self.offload_tier == "device"
                 else f"tier={self.offload_tier} ")
+        if self.ici_group:
+            tier += f"ici={self.ici_group} "
         return (f"{tier}{self.layout}/{self.exchange} "
                 f"chunk={self.chunk_elems} "
                 f"fused={'on' if self.fused_epilogue else 'off'} "

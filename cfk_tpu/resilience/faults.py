@@ -187,6 +187,9 @@ class HostWindowCorruption:
     replaces the window's second half with stale zeros (a partially
     completed staging read — values are WRONG but finite, caught by the
     row-norm watchdog or the divergence it causes rather than isfinite).
+    ``shard`` (sharded windowed driver, ISSUE 12) restricts the fault to
+    ONE shard's staging pipeline — None matches any shard (the
+    single-shard driver stages as shard 0).
     """
 
     iteration: int
@@ -196,11 +199,13 @@ class HostWindowCorruption:
     num_rows: int = 4
     seed: int = 0
     persistent: bool = False
+    shard: int | None = None
     fired: int = 0
 
     def apply_window(self, i: int, side: str, w: int,
-                     tbl: np.ndarray) -> np.ndarray:
+                     tbl: np.ndarray, shard: int = 0) -> np.ndarray:
         if (i != self.iteration or side != self.side or w != self.window
+                or (self.shard is not None and shard != self.shard)
                 or (self.fired and not self.persistent)):
             return tbl
         self.fired += 1
@@ -224,15 +229,20 @@ class SlowHostFetch:
     fault — the double-buffered driver must absorb it without touching
     the math (the chaos scenario pins bit-exact factors under delay).
     ``fired`` counts DELAYS actually injected (not staging calls — the
-    chaos row's fault accounting must not inflate)."""
+    chaos row's fault accounting must not inflate).  ``only_shard``
+    restricts the slowdown to one shard's staging (the straggler-host
+    drill of the sharded driver)."""
 
     delay_s: float = 0.01
     every: int = 1
+    only_shard: int | None = None
     fired: int = 0
     calls: int = 0
 
-    def delay(self, i: int, side: str, w: int) -> None:
+    def delay(self, i: int, side: str, w: int, shard: int = 0) -> None:
         if self.every < 1:
+            return
+        if self.only_shard is not None and shard != self.only_shard:
             return
         self.calls += 1
         if self.calls % self.every == 0:
@@ -250,16 +260,16 @@ class WindowFaultInjector:
         self.faults = list(faults)
 
     def apply_window(self, i: int, side: str, w: int,
-                     tbl: np.ndarray) -> np.ndarray:
+                     tbl: np.ndarray, shard: int = 0) -> np.ndarray:
         for f in self.faults:
             if hasattr(f, "apply_window"):
-                tbl = f.apply_window(i, side, w, tbl)
+                tbl = f.apply_window(i, side, w, tbl, shard=shard)
         return tbl
 
-    def delay(self, i: int, side: str, w: int) -> None:
+    def delay(self, i: int, side: str, w: int, shard: int = 0) -> None:
         for f in self.faults:
             if hasattr(f, "delay"):
-                f.delay(i, side, w)
+                f.delay(i, side, w, shard=shard)
 
     @property
     def fired(self) -> int:

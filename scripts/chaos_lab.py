@@ -798,6 +798,120 @@ def scenario_offload_window() -> dict:
     return row
 
 
+def scenario_offload_window_sharded() -> dict:
+    """ISSUE 12: the SHARDED windowed trainer recovers fleet-wide from
+    faults on ONE shard's staging pipeline with BIT-EXACT factors.
+
+    Three fault classes on a 2-shard stream-tiled dataset, all against
+    the fault-free sharded windowed run (itself crc-checked against the
+    resident shard_map trainer when enough jax devices exist):
+
+    1. ``nan`` on shard 1 only: the factor sentinel trips, the ladder
+       rolls BOTH shards' host stores back to the last-good snapshot —
+       one shard's poison must not leave the other shard's already-solved
+       rows in the committed state — and the replay lands crc-identical.
+    2. ``torn`` on shard 0 only: finite wrong bytes, invisible to
+       isfinite; the PER-SHARD staging crc32 contract (``verify_windows``)
+       catches it before any kernel consumes it, and rollback + replay is
+       crc-identical fleet-wide.
+    3. ``slow fetch`` on shard 1 only (a straggler host): fires
+       throughout drill 2 without perturbing a single bit — the
+       double-buffered per-shard staging absorbs it.
+    """
+    import dataclasses as _dc
+    import zlib
+
+    import jax as _jax
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.plan import plan_for_config
+    from cfk_tpu.resilience.faults import (
+        HostWindowCorruption,
+        SlowHostFetch,
+        WindowFaultInjector,
+    )
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(60, 30, 900, seed=0), num_shards=2,
+        layout="tiled", chunk_elems=512, tile_rows=16,
+        accum_max_entities=0,
+    )
+    cfg = _dc.replace(_base_cfg(num_shards=2), layout="tiled",
+                      solver="pallas")
+
+    def crc(model):
+        return zlib.crc32(np.asarray(
+            model.user_factors, np.float32
+        ).tobytes())
+
+    base = train_als_host_window(ds, cfg, chunks_per_window=2)
+    base_rmse, base_crc = _rmse(base, ds), crc(base)
+    resident_crc = None
+    if len(_jax.devices()) >= 2:
+        from cfk_tpu.parallel.mesh import make_mesh
+        from cfk_tpu.parallel.spmd import train_als_sharded
+
+        resident_crc = crc(train_als_sharded(ds, cfg, make_mesh(2)))
+
+    nnz = int(ds.movie_blocks.count.sum())
+    shape_kw = dict(num_users=ds.user_map.num_entities,
+                    num_movies=ds.movie_map.num_entities, nnz=nnz)
+
+    # Drill 1: NaN window on SHARD 1 only, no integrity check — the
+    # factor sentinel path; recovery must restore the whole fleet.
+    nan_fault = WindowFaultInjector(
+        HostWindowCorruption(iteration=1, side="m", window=0, kind="nan",
+                             shard=1),
+    )
+    m1 = Metrics()
+    prov1 = plan_for_config(cfg, **shape_kw)[1]
+    rec1 = train_als_host_window(
+        ds, cfg, chunks_per_window=2, metrics=m1, window_faults=nan_fault,
+        plan_provenance=prov1, verify_windows=False,
+    )
+    # Drill 2: torn window on SHARD 0 + a straggling shard-1 staging —
+    # the per-shard staging-checksum path.
+    torn_fault = WindowFaultInjector(
+        HostWindowCorruption(iteration=2, side="u", window=0, kind="torn",
+                             shard=0),
+        SlowHostFetch(delay_s=0.002, every=2, only_shard=1),
+    )
+    m2 = Metrics()
+    prov2 = plan_for_config(cfg, **shape_kw)[1]
+    rec2 = train_als_host_window(
+        ds, cfg, chunks_per_window=2, metrics=m2,
+        window_faults=torn_fault, plan_provenance=prov2,
+    )
+
+    crc1, crc2 = crc(rec1), crc(rec2)
+    transitions = bool(prov1.transitions) and bool(prov2.transitions)
+    torn_detected = m2.counters.get("health_trips", 0) >= 1
+    for k_, v in m2.counters.items():
+        m1.counters[k_] = m1.counters.get(k_, 0) + v
+    m1.notes.update({f"torn_{k_}": v for k_, v in m2.notes.items()})
+    row = _row(
+        "offload_window_sharded",
+        fired=nan_fault.fired + torn_fault.fired,
+        metrics=m1, base_rmse=base_rmse, rec_rmse=_rmse(rec1, ds),
+        ok_extra=(
+            (resident_crc is None or base_crc == resident_crc)
+            and crc1 == base_crc and crc2 == base_crc
+            and transitions and torn_detected
+        ),
+    )
+    row["windowed_equals_resident"] = (
+        None if resident_crc is None else bool(base_crc == resident_crc)
+    )
+    row["nan_on_one_shard_bit_exact"] = bool(crc1 == base_crc)
+    row["torn_on_one_shard_bit_exact"] = bool(crc2 == base_crc)
+    row["transitions_recorded"] = transitions
+    row["slow_fetch_fired_on_straggler"] = int(torn_fault.faults[1].fired)
+    return row
+
+
 def scenario_serve_under_foldin() -> dict:
     """ISSUE 8: serving stays correct while streaming fold-in commits land
     concurrently.  A RecommendServer thread answers a continuous request
@@ -970,6 +1084,7 @@ SCENARIOS = {
     "serve_under_foldin": scenario_serve_under_foldin,
     "plan_fallback": scenario_plan_fallback,
     "offload_window": scenario_offload_window,
+    "offload_window_sharded": scenario_offload_window_sharded,
 }
 
 

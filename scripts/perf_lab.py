@@ -193,6 +193,13 @@ def make_parser() -> argparse.ArgumentParser:
                    "resident bit-exactness")
     p.add_argument("--offload-window-chunks", type=int, default=4,
                    help="chunks per staged window on the host_window tier")
+    p.add_argument("--offload-shards", type=int, default=1,
+                   help="shard count of the --offload axis (ISSUE 12): "
+                   "the host_window arm runs the sharded windowed "
+                   "driver (no mesh needed); the device arm runs the "
+                   "real shard_map trainer and needs that many jax "
+                   "devices — crc equality between the arms is the "
+                   "sharded bit-exactness proof")
     p.add_argument("--offload-budget-mb", type=float, default=None,
                    help="artificial device budget (MB) for window sizing")
     p.add_argument("--plan", default=None,
@@ -489,14 +496,17 @@ def run_offload_lab(args) -> dict:
             "--offload runs the stream-forced tiled layout; pass "
             "--layout tiled"
         )
+    shards = max(int(getattr(args, "offload_shards", 1) or 1), 1)
     coo = synth_coo(args.users, args.movies, args.nnz, seed=args.seed)
     ds = Dataset.from_coo(
-        coo, layout="tiled", chunk_elems=args.chunk_elems,
+        coo, num_shards=shards, layout="tiled",
+        chunk_elems=args.chunk_elems,
         tile_rows=args.tile_rows, accum_max_entities=0,
     )
     cfg = ALSConfig(
         rank=args.rank, lam=0.05, num_iterations=args.iters, seed=0,
-        layout="tiled", dtype=args.dtype, table_dtype=args.table_dtype,
+        layout="tiled", num_shards=shards, dtype=args.dtype,
+        table_dtype=args.table_dtype,
         solver=args.solver, overlap=args.overlap == "on",
         fused_epilogue=None if args.fused == "on" else False,
         in_kernel_gather=None if args.gather == "fused" else False,
@@ -509,6 +519,22 @@ def run_offload_lab(args) -> dict:
     metrics = Metrics()
     budget = (args.offload_budget_mb * 1e6
               if args.offload_budget_mb is not None else None)
+    mesh = None
+    if shards > 1 and args.offload != "host_window":
+        # The resident arm of a sharded A/B runs the real shard_map
+        # trainer — that is the bit-exactness reference the smoke pins.
+        import jax as _jax
+
+        if len(_jax.devices()) < shards:
+            raise SystemExit(
+                f"--offload device with --offload-shards {shards} needs "
+                f"{shards} jax devices (XLA_FLAGS="
+                "--xla_force_host_platform_device_count=N on CPU); the "
+                "host_window arm needs none"
+            )
+        from cfk_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(shards)
 
     def run(cfg_n=None):
         c = cfg if cfg_n is None else cfg_n
@@ -518,6 +544,10 @@ def run_offload_lab(args) -> dict:
                 chunks_per_window=args.offload_window_chunks,
                 device_budget_bytes=budget,
             )
+        if shards > 1:
+            from cfk_tpu.parallel.spmd import train_als_sharded
+
+            return train_als_sharded(ds, c, mesh)
         return train_als(ds, c)
 
     # Two-point (1 vs N iterations) fit, exactly like bench's scale rows:
@@ -559,6 +589,7 @@ def run_offload_lab(args) -> dict:
     )
     row = {
         "offload": args.offload,
+        "offload_shards": shards,
         "s_per_iter_min": round(best, 4),
         "s_per_iter_median": round(sorted(per_iter)[len(per_iter) // 2], 4),
         **roofline_row(cost, best, table_dtype=args.table_dtype),
@@ -579,6 +610,13 @@ def run_offload_lab(args) -> dict:
                 "offload_chunks_per_window"
             ),
             "staged_mb_per_run": metrics.gauges.get("offload_staged_mb"),
+            "staged_table_mb_per_run": metrics.gauges.get(
+                "offload_staged_table_mb"
+            ),
+            "plan_held_mb": metrics.gauges.get("offload_plan_held_mb"),
+            "staged_rows_local": metrics.gauges.get("offload_rows_local"),
+            "staged_rows_ici": metrics.gauges.get("offload_rows_ici"),
+            "staged_rows_dcn": metrics.gauges.get("offload_rows_dcn"),
         })
     print(json.dumps(row))
     return row

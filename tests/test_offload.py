@@ -128,18 +128,19 @@ def test_window_plan_invariants(stream_ds):
         nbw = wp.neighbor_idx[w]
         real = nbw < wp.window_rows
         # window[rebased] == table[original] for every real entry
-        orig = mb.neighbor_idx.reshape(nc, -1)
         np.testing.assert_array_equal(
             tbl[nbw[real]],
             table[wp.rows[w][nbw[real]]],
         )
     # The windows' real chunks tile the original chunk stream exactly:
-    # concatenating each window's first chunk_counts[w] chunks reproduces
-    # the blocks' flat rating stream.
+    # concatenating each window's first chunk_counts[w] staged rating
+    # chunks reproduces the blocks' flat rating stream.
     ncw, cap = wp.statics[0], wp.statics[1]
     assert wp.chunk_counts.sum() == nc
     real_rt = np.concatenate([
-        wp.rating[w].reshape(ncw, cap)[: wp.chunk_counts[w]].reshape(-1)
+        wp.stage_chunks(w)[0].reshape(ncw, cap)[
+            : wp.chunk_counts[w]
+        ].reshape(-1)
         for w in range(wp.num_windows)
     ])
     np.testing.assert_array_equal(real_rt, mb.rating.reshape(-1))
@@ -331,15 +332,23 @@ def test_plan_resolves_oversized_to_host_window():
     # not promised.
     with pytest.raises(PlanConstraintError, match="cannot|exceeds"):
         plan(big, dev, PlanConstraints(offload_tier="device"))
-    # ...but ONLY where host_window is an alternative: a sharded shape
-    # (no windowed executor) resolves device whether pinned or free —
-    # pinning the tier auto would give must never be refused.
+    # Sharded shapes route through the SAME tier machinery now
+    # (ISSUE 12), with PER-SHARD arithmetic: 4 shards of the 1B-rating
+    # shape genuinely fit a v5e (tables and blocks divide), so the
+    # resolver keeps them resident…
     import dataclasses as _dc
 
-    big4 = _dc.replace(big, num_shards=4)
-    assert plan(big4, dev)[0].offload_tier == "device"
-    ep4, _ = plan(big4, dev, PlanConstraints(offload_tier="device"))
-    assert ep4.offload_tier == "device"
+    assert plan(_dc.replace(big, num_shards=4), dev)[0].offload_tier \
+        == "device"
+    # …but a fixed side whose all_gather working copy ALONE overflows the
+    # device stays oversized at ANY shard count (the copy replicates per
+    # device — the term sharding cannot shrink), resolves host_window,
+    # and refuses a pinned resident table per shard.
+    big4 = _dc.replace(big, num_users=40_000_000, nnz=2_000_000_000,
+                       num_shards=4)
+    assert plan(big4, dev)[0].offload_tier == "host_window"
+    with pytest.raises(PlanConstraintError, match="PER-SHARD|exceeds"):
+        plan(big4, dev, PlanConstraints(offload_tier="device"))
     # Pinned host_window conflicts loudly with a non-tiled layout pin.
     with pytest.raises(PlanConstraintError, match="tiled"):
         plan(small, dev, PlanConstraints(offload_tier="host_window",
@@ -370,12 +379,15 @@ def test_autotune_cache_key_records_plan_field_set(monkeypatch):
 def test_config_offload_validation():
     with pytest.raises(ValueError, match="tiled"):
         ALSConfig(offload_tier="host_window", layout="padded")
-    with pytest.raises(ValueError, match="single-process"):
-        ALSConfig(offload_tier="host_window", layout="tiled", num_shards=2)
     with pytest.raises(ValueError, match="offload_tier"):
         ALSConfig(offload_tier="resident")
     cfg = ALSConfig(offload_tier="host_window", layout="tiled")
     assert cfg.offload_tier == "host_window"
+    # Sharded host_window is legal now (ISSUE 12) — including the ring
+    # exchanges the sharded windowed driver replicates.
+    cfg2 = ALSConfig(offload_tier="host_window", layout="tiled",
+                     num_shards=2, exchange="hier_ring", ici_group=2)
+    assert cfg2.offload_tier == "host_window"
 
 
 def test_trainer_rejects_unsupported_configs(stream_ds):

@@ -31,6 +31,7 @@ def _args(**over):
         serve="off", serve_batch=64, serve_k=10, serve_requests=512,
         serve_tile_m=512,
         offload=None, offload_window_chunks=4, offload_budget_mb=None,
+        offload_shards=1,
         plan=None, plan_cache=None,
         iters=2, repeats=3, profile_dir=None,
     )
@@ -241,7 +242,33 @@ def test_offload_axis_row(tmp_path, monkeypatch, capsys):
     assert win["windows_m"] >= 1 and win["windows_u"] >= 1
     assert win["window_rows_m"] >= 8
     assert win["staged_mb_per_run"] > 0
+    assert win["staged_table_mb_per_run"] > 0
+    assert win["plan_held_mb"] > 0
     # windowed == resident, bit-exact — the ISSUE 11 acceptance contract
+    assert win["factors_crc32"] == dev["factors_crc32"]
+
+
+def test_offload_axis_sharded_row(tmp_path, monkeypatch):
+    # The SHARDED arm (ISSUE 12): the host_window side runs the sharded
+    # windowed driver; the device side the real shard_map trainer (this
+    # test env forces 4 virtual devices) — crc equality between the arms
+    # is the sharded windowed == resident bit-exactness proof, through
+    # the lab's own two-point fit.
+    import jax
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs 2 virtual devices")
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    base = dict(layout="tiled", users=200, movies=60, nnz=1500,
+                chunk_elems=512, tile_rows=16, rank=8, iters=2, repeats=2,
+                offload_shards=2)
+    dev = perf_lab.run_lab(_args(offload="device", **base))
+    assert dev["offload_shards"] == 2
+    win = perf_lab.run_lab(_args(offload="host_window",
+                                 offload_window_chunks=2, **base))
+    assert win["offload_shards"] == 2
     assert win["factors_crc32"] == dev["factors_crc32"]
 
 
