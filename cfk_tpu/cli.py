@@ -365,6 +365,9 @@ def _train(args) -> int:
         exchange=args.exchange,
         ici_group=args.ici_group,
         offload_tier=args.offload_tier,
+        staging=args.staging,
+        staging_pool_depth=args.staging_pool_depth,
+        compile_cache_dir=args.compile_cache_dir,
         overlap=not args.no_overlap,
         in_kernel_gather=(
             None if args.in_kernel_gather == "auto"
@@ -795,6 +798,12 @@ def _serve(args) -> int:
         zipf_user_rows,
     )
 
+    # Before the first compile (ISSUE 13): warm-start compile caching —
+    # a restarted server replays its serve programs from the persistent
+    # cache instead of recompiling the whole bucket set.
+    from cfk_tpu.config import enable_compile_cache
+
+    enable_compile_cache(args.compile_cache_dir)
     if args.format == "netflix":
         coo = parse_netflix(args.data)
     else:
@@ -812,6 +821,13 @@ def _serve(args) -> int:
     engine = engine_from_model(
         model, None if args.include_seen else ds,
         table_dtype=args.table_dtype, tile_m=args.tile_m,
+    )
+    # Trace/compile the pow2 batch-bucket set before traffic arrives
+    # (ISSUE 13): the first real batch then pays zero traces.
+    warm = engine.prewarm(args.k, max_batch=args.max_batch)
+    _eprint(
+        f"prewarmed {warm['programs']} serve programs "
+        f"({warm['new_traces']} new traces) in {warm['prewarm_s']:.2f}s"
     )
     if args.broker:
         host, port, _ = _parse_tcp_url(args.broker, topic_optional=True)
@@ -1042,6 +1058,7 @@ def _stream(args) -> int:
         max_recoveries=args.max_recoveries,
         lam_escalation=args.lam_escalation,
         on_unrecoverable=args.on_unrecoverable,
+        compile_cache_dir=args.compile_cache_dir,
     )
     # Ensure the topic BEFORE the (possibly hours-long) base train: a
     # fresh topic is created empty and followed, instead of training a
@@ -1084,6 +1101,13 @@ def _stream(args) -> int:
             base_model=base_model, metrics=metrics,
             preemption_guard=guard,
         )
+        if args.prewarm:
+            warm = session.prewarm()
+            _eprint(
+                f"prewarmed {warm['programs']} fold-in programs "
+                f"({warm['new_traces']} new traces) in "
+                f"{warm['prewarm_s']:.2f}s"
+            )
         model = session.run(
             max_batches=args.max_batches, follow=args.follow
         )
@@ -1176,6 +1200,7 @@ def _plan_cmd(args) -> int:
         offload_tier=(None if args.offload_tier == "auto"
                       else args.offload_tier),
         ici_group=args.ici_group,
+        staging=None if args.staging == "auto" else args.staging,
     )
     if args.device == "auto":
         device = DeviceSpec.detect()
@@ -1382,6 +1407,28 @@ def build_parser() -> argparse.ArgumentParser:
         "else one flat ring",
     )
     t.add_argument(
+        "--staging", choices=["auto", "pool", "serial"], default="auto",
+        help="host staging engine of the host_window tier (ISSUE 13): "
+        "'pool' (= 'auto', the default) overlaps every shard's window "
+        "staging — store gather, host quantize, checksum, device_put — "
+        "on a bounded thread pool across shards and windows; 'serial' "
+        "pins the one-thread double buffer (the bench.py --staging-ab "
+        "baseline).  Factors are crc-identical across the knob",
+    )
+    t.add_argument(
+        "--staging-pool-depth", type=int, default=None, metavar="D",
+        help="windows staged ahead of consumption in pool mode "
+        "(default: offload.staging.DEFAULT_POOL_DEPTH); always clamped "
+        "so D+1 worst-case windows fit the per-shard window budget",
+    )
+    t.add_argument(
+        "--compile-cache-dir", default=None, metavar="DIR",
+        help="persistent jax compilation cache (ISSUE 13): compiled "
+        "programs are reused across process restarts, keyed per device "
+        "fingerprint inside DIR — a warm cache removes the cold-start "
+        "compile cost the time_to_first_step/batch columns measure",
+    )
+    t.add_argument(
         "--health-check-every", type=int, default=None, metavar="N",
         help="arm the numerical-health sentinel: probe the factor state "
         "(isfinite + norm watchdogs, <2%% overhead at N=1) every N "
@@ -1510,6 +1557,11 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--loadgen-qps", type=float, default=100.0)
     sv.add_argument("--loadgen-requests", type=int, default=256)
     sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                    help="persistent jax compilation cache keyed per "
+                    "device fingerprint (ISSUE 13) — a restarted server "
+                    "replays its prewarmed serve programs instead of "
+                    "recompiling the batch-bucket set")
     sv.set_defaults(fn=_serve)
 
     pd = sub.add_parser(
@@ -1630,6 +1682,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="stream commits retained (per-batch commits grow "
                     "fast; default 8, None-like large values keep more)")
     st.add_argument("--no-preempt-save", action="store_true")
+    st.add_argument("--prewarm", action="store_true",
+                    help="trace the fold-in pow2 bucket grid before the "
+                    "first batch (ISSUE 13): the first real micro-batch "
+                    "then pays zero jit traces (padded fold layout; "
+                    "pair with --compile-cache-dir so a warm restart "
+                    "skips the compiles too)")
+    st.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                    help="persistent jax compilation cache keyed per "
+                    "device fingerprint — removes the cold-process "
+                    "re-compile cost of the fold-in/retrain programs")
     st.add_argument("--no-eval", action="store_true",
                     help="skip the merged-state RMSE evaluation at exit")
     st.add_argument("--dataset-cache", default=None)
@@ -1692,6 +1754,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "(a real plan field since ISSUE 12 — the cost model "
                     "prices the pinned hierarchy; default: the device's "
                     "ICI domain)")
+    pl.add_argument("--staging", default="auto",
+                    choices=["auto", "pool", "serial"],
+                    help="host staging engine pin of the host_window "
+                    "tier (ISSUE 13): the cost model exposes only the "
+                    "PCIe share the chosen engine cannot hide")
     pl.add_argument("--device", default="auto",
                     choices=["auto", "v5e", "cpu"],
                     help="'auto' detects the current jax backend; 'v5e' "

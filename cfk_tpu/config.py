@@ -94,6 +94,65 @@ def apply_overlap_xla_flags(config: "ALSConfig") -> None:
     set_async_collective_permute(config.async_collective_permute)
 
 
+def enable_compile_cache(cache_dir: str | None) -> str | None:
+    """Wire jax's persistent compilation cache at ``cache_dir`` (the
+    ``ALSConfig.compile_cache_dir`` / ``--compile-cache-dir`` seam,
+    ISSUE 13).  Returns the resolved per-device directory, or None when
+    disabled/unsupported.
+
+    Key discipline: the cache lives in a SUBDIRECTORY keyed by the
+    device fingerprint (``plan.DeviceSpec.fingerprint()`` — backend,
+    device kind, device count: the same key the autotune cache trusts
+    measured winners by), so one shared tree never replays an
+    executable compiled for different hardware.  The thresholds are
+    lowered to cache every program — the fold-in/serve bucket programs
+    this exists for compile in milliseconds each but number dozens per
+    cold process (the PR 6 re-trace bound, paid again as re-COMPILE on
+    every restart).
+
+    Must run BEFORE the first compile to cover it (trainer/session/
+    engine entries call this; jax ignores dir changes for programs
+    already compiled).  Idempotent; failures (an old jax without the
+    config knobs, an unwritable path) degrade to a no-op with a warning
+    rather than failing training."""
+    if not cache_dir:
+        return None
+    import os as _os
+    import warnings as _warnings
+
+    try:
+        import jax as _jax
+
+        from cfk_tpu.plan.spec import DeviceSpec
+
+        sub = _os.path.join(
+            cache_dir, DeviceSpec.detect().fingerprint().replace(":", "_")
+        )
+        _os.makedirs(sub, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", sub)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        try:
+            # jax latches "no cache" on the first compile that ran
+            # without a dir; reset so the next compile re-initializes
+            # against the directory just configured (measured on 0.4.37:
+            # without this, a dir set after any compile is ignored with
+            # "cache is disabled/not initialized").
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass  # newer jax may not need (or expose) the reset
+        return sub
+    except Exception as e:  # pragma: no cover - jax/filesystem specific
+        _warnings.warn(
+            f"persistent compilation cache disabled ({e}); training "
+            "continues with cold compiles"
+        )
+        return None
+
+
 @dataclasses.dataclass(frozen=True)
 class ALSConfig:
     """Hyper-parameters + execution layout for a block-partitioned ALS run.
@@ -341,6 +400,35 @@ class ALSConfig:
     #                   visit schedules with int8 (codes, scales) PCIe
     #                   staging; bit-exact vs the resident paths).
     offload_tier: Literal["auto", "device", "host_window"] = "auto"
+    # --- host staging engine (cfk_tpu.offload.staging, ISSUE 13) --------
+    # How the host_window tier's windows are staged (gather + quantize +
+    # checksum + device_put):
+    #   "auto"/"pool" — ONE bounded thread pool per half-iteration stages
+    #                   every shard's windows ahead of consumption, so
+    #                   shard d+1's host-side window work overlaps shard
+    #                   d's compute (the ALX per-shard transfer pipeline's
+    #                   host half; the default, like PR 1's overlap).
+    #   "serial"      — the PR 10/11 single-thread double buffer (the
+    #                   measurement baseline of bench.py --staging-ab).
+    # Factors are crc-identical across the knob (the staging order never
+    # changes the consumption order — tests/test_offload_sharded.py).
+    staging: Literal["auto", "pool", "serial"] = "auto"
+    # Staged-ahead windows beyond the one being consumed (pool mode).
+    # None = offload.staging.DEFAULT_POOL_DEPTH; always clamped so
+    # depth+1 worst-case windows fit the per-shard window budget next to
+    # the ring accumulator reservation (offload.budget.max_pool_depth).
+    staging_pool_depth: int | None = None
+    # --- warm-start compile caching (ISSUE 13) --------------------------
+    # Directory for jax's persistent compilation cache.  None disables
+    # (today's behavior).  A path is keyed per device fingerprint (the
+    # autotune cache's discipline — a winner compiled on one backend
+    # must not collide with another's), so one tree serves mixed fleets;
+    # trainers/serving/streaming apply it at entry via
+    # enable_compile_cache(), BEFORE their first compile.  Cold-process
+    # time-to-first-step/batch is what it buys; trace counts are
+    # unchanged (tracing is jax-side — the cache removes the XLA compile
+    # behind each trace).
+    compile_cache_dir: str | None = None
 
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "als++")
@@ -435,6 +523,17 @@ class ALSConfig:
             raise ValueError(
                 f"offload_tier must be 'auto', 'device' or 'host_window', "
                 f"got {self.offload_tier!r}"
+            )
+        if self.staging not in ("auto", "pool", "serial"):
+            raise ValueError(
+                f"staging must be 'auto', 'pool' or 'serial', got "
+                f"{self.staging!r}"
+            )
+        if self.staging_pool_depth is not None and self.staging_pool_depth < 1:
+            raise ValueError(
+                f"staging_pool_depth must be >= 1 (windows staged ahead "
+                f"of consumption), got {self.staging_pool_depth}; use "
+                "staging='serial' for the unpooled baseline"
             )
         if self.offload_tier == "host_window":
             if self.layout != "tiled":

@@ -529,8 +529,11 @@ def train_als(
     from cfk_tpu.resilience.sentinel import health_from_config
     from cfk_tpu.utils.metrics import Metrics
 
+    from cfk_tpu.config import enable_compile_cache
     from cfk_tpu.plan import plan_for_config
 
+    # Before the first compile (ISSUE 13): warm-start compile caching.
+    enable_compile_cache(getattr(config, "compile_cache_dir", None))
     health = health_from_config(config)
     validate_cadence(checkpoint_every, health)
     metrics = metrics if metrics is not None else Metrics()

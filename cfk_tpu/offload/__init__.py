@@ -14,6 +14,12 @@ hierarchical ICI×DCN exchange whose visit order the windowed ring driver
 replicates.  See ARCHITECTURE.md "Out-of-core factor tables".
 """
 
+from cfk_tpu.offload.staging import (
+    DEFAULT_POOL_DEPTH,
+    StagingStats,
+    WindowStager,
+    resolve_staging,
+)
 from cfk_tpu.offload.store import HostFactorStore, quantize_rows_host
 from cfk_tpu.offload.window import (
     RingWindowPlan,
@@ -32,6 +38,10 @@ __all__ = [
     "train_als_host_window",
     "windowed_half_step",
     "ring_windowed_half_step",
+    "WindowStager",
+    "StagingStats",
+    "resolve_staging",
+    "DEFAULT_POOL_DEPTH",
 ]
 
 

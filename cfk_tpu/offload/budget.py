@@ -67,7 +67,8 @@ def shard_entity_range(rows: int, num_shards: int, shard: int
 def train_resident_bytes(num_users: int, num_movies: int, nnz: int,
                          rank: int, *, dtype: str = "float32",
                          table_dtype: str | None = None,
-                         num_shards: int = 1) -> dict:
+                         num_shards: int = 1,
+                         donation: bool = True) -> dict:
     """PER-SHARD resident bytes of one device-tier training iteration.
 
     Returns the breakdown dict (the scale lab records it per row); the
@@ -79,7 +80,18 @@ def train_resident_bytes(num_users: int, num_movies: int, nnz: int,
     exactly why an oversized table stays oversized at any shard count and
     the host_window tier remains the answer (the ring exchanges trade the
     copy for an [E_local, k, k] accumulator, bounded separately by the
-    block builder's ``accum_max_entities`` gate)."""
+    block builder's ``accum_max_entities`` gate).
+
+    ``donation`` (ISSUE 13): the resident trainers donate their factor
+    arguments through the iteration jit (``models/als.py`` /
+    ``parallel/spmd.py`` ``donate_argnums=(0, 1)``), so a half-step's
+    solved output ALIASES the side it replaces — input and output never
+    coexist, which is the arithmetic the default charges (bit-identical
+    to the pre-ISSUE-13 totals).  ``donation=False`` is the un-donated
+    accounting: the larger side's fresh output buffer coexists with its
+    predecessor at the solve boundary, charged as one extra table side —
+    the credit the scale-sweep rows record so a tier decision that only
+    holds BECAUSE of donation is visible in provenance."""
     shards = max(int(num_shards), 1)
     tables = factor_table_bytes(num_users + num_movies, rank, dtype) / shards
     # The gather working copy of the fixed side (zero-row append / quantized
@@ -89,11 +101,17 @@ def train_resident_bytes(num_users: int, num_movies: int, nnz: int,
         table_dtype if table_dtype is not None else dtype,
     )
     blocks = 2.0 * nnz * _BLOCK_BYTES_PER_CELL * _TILE_PAD / shards
-    total = tables + gather_copy + blocks
+    solve_output = (
+        0.0 if donation
+        else factor_table_bytes(max(num_users, num_movies), rank, dtype)
+        / shards
+    )
+    total = tables + gather_copy + blocks + solve_output
     return {
         "factor_tables_bytes": tables,
         "gather_copy_bytes": gather_copy,
         "block_arrays_bytes": blocks,
+        "solve_output_bytes": solve_output,
         "num_shards": shards,
         "total": total,
     }
@@ -102,19 +120,23 @@ def train_resident_bytes(num_users: int, num_movies: int, nnz: int,
 def fits_device(num_users: int, num_movies: int, nnz: int, rank: int, *,
                 hbm_bytes: float, dtype: str = "float32",
                 table_dtype: str | None = None,
-                num_shards: int = 1) -> bool:
+                num_shards: int = 1, donation: bool = True) -> bool:
     """THE device-tier feasibility predicate (planner AND executor) —
-    per-shard arithmetic against ONE device's budget."""
+    per-shard arithmetic against ONE device's budget.  ``donation=True``
+    (the default — the trainers really do donate) credits the solved
+    side's aliased output; False is the un-donated comparison arm."""
     return (
         train_resident_bytes(
             num_users, num_movies, nnz, rank,
             dtype=dtype, table_dtype=table_dtype, num_shards=num_shards,
+            donation=donation,
         )["total"]
         <= hbm_bytes * RESIDENT_FRACTION
     )
 
 
-def shape_fits_device(shape, device, table_dtype: str | None = None) -> bool:
+def shape_fits_device(shape, device, table_dtype: str | None = None,
+                      donation: bool = True) -> bool:
     """``fits_device`` over a ``plan.ProblemShape`` + ``plan.DeviceSpec``
     (serve shapes are table-resident by construction and not gated here).
     ``table_dtype`` is the resolve's PINNED gather-table dtype when one
@@ -128,21 +150,35 @@ def shape_fits_device(shape, device, table_dtype: str | None = None) -> bool:
         shape.num_users, shape.num_movies, shape.nnz, shape.rank,
         hbm_bytes=device.hbm_bytes, dtype=shape.dtype,
         table_dtype=table_dtype,
-        num_shards=getattr(shape, "num_shards", 1),
+        num_shards=getattr(shape, "num_shards", 1), donation=donation,
     )
 
 
 def window_budget_bytes(hbm_bytes: float,
-                        reserved_bytes: float = 0.0) -> float:
-    """Per-window staging budget under the double buffer: the headroom
-    fraction of the device MINUS any persistent device state the driver
-    holds alongside the windows (the ring modes' per-entity Gram
-    accumulator — charged TWICE, because the un-donatable dispatch
-    boundary keeps input and output alive across a window call), split
-    across the two live windows."""
+                        reserved_bytes: float = 0.0,
+                        buffers: int = WINDOW_BUFFERS) -> float:
+    """Per-window staging budget: the headroom fraction of the device
+    MINUS any persistent device state the driver holds alongside the
+    windows (the ring modes' per-entity Gram accumulator — see
+    ``ring_accumulator_reservation``), split across the ``buffers`` live
+    windows.  ``buffers`` defaults to the classic double buffer (current
+    + one prefetched); the pooled staging engine sizes its windows at the
+    same 2 and then admits extra pool depth from the leftover share
+    (``max_pool_depth``) — the "staging arena" term of ISSUE 13."""
     return max(
         hbm_bytes * RESIDENT_FRACTION - reserved_bytes, 0.0
-    ) / WINDOW_BUFFERS
+    ) / max(int(buffers), 1)
+
+
+def max_pool_depth(hbm_bytes: float, worst_window_bytes: float,
+                   reserved_bytes: float = 0.0) -> int:
+    """The deepest staging pool the budget admits: ``depth + 1`` windows
+    (``depth`` staged ahead + one consuming) of the worst window must fit
+    the staging share next to the reserved device state.  Never below 1
+    (one window ahead == the classic double buffer's footprint)."""
+    share = max(hbm_bytes * RESIDENT_FRACTION - reserved_bytes, 0.0)
+    live = int(share // max(float(worst_window_bytes), 1.0))
+    return max(live - 1, 1)
 
 
 def ring_accumulator_bytes(local_entities: int, rank: int) -> float:
@@ -151,3 +187,19 @@ def ring_accumulator_bytes(local_entities: int, rank: int) -> float:
     ring driver holds across every window of a half-step (the same
     structure the resident ring carries in-place)."""
     return float(local_entities + 1) * rank * (rank + 1) * 4.0
+
+
+def ring_accumulator_reservation(local_entities: int, rank: int, *,
+                                 donated: bool = True) -> float:
+    """What the window sizing must RESERVE for the ring accumulator.
+
+    With buffer donation through the per-window accumulation jit
+    (``offload/windowed.py`` ``_ring_window_jit`` donates its carry pair,
+    ISSUE 13 — the ``models/als.py``/``spmd.py`` idiom) the output
+    accumulator ALIASES the input, so exactly one copy is live: ×1.
+    Without donation the dispatch boundary keeps a window call's input
+    AND output accumulators alive: ×2 — the PR 11 accounting, kept as
+    the comparison arm so a shape that fits only because of donation is
+    attributable to it."""
+    return ((1.0 if donated else 2.0)
+            * ring_accumulator_bytes(local_entities, rank))

@@ -130,7 +130,13 @@ class HostFactorStore:
 
     def gather(self, rows: np.ndarray) -> np.ndarray:
         """[len(rows), rank] window of the table (any order, repeats OK) —
-        the staging read.  Crosses shard boundaries transparently."""
+        the staging read.  Crosses shard boundaries transparently.
+
+        Implemented with ``np.take`` (identical values to fancy
+        indexing): its copy loop releases the GIL, which is what lets the
+        pooled staging engine (``offload/staging.py``) actually gather
+        several shards' windows concurrently on worker threads instead of
+        serializing on the interpreter lock."""
         rows = np.asarray(rows, dtype=np.int64)
         if rows.size and (rows.min() < 0 or rows.max() >= self.rows):
             raise IndexError(
@@ -138,13 +144,14 @@ class HostFactorStore:
                 f"[{rows.min()}, {rows.max()}]"
             )
         if self.num_shards == 1:
-            return self._shards[0][rows]
+            return np.take(self._shards[0], rows, axis=0)
         out = np.empty((rows.shape[0], self.rank), dtype=self._np_dtype)
         sh = np.searchsorted(self.bounds, rows, side="right") - 1
         for s in range(self.num_shards):
             m = sh == s
             if m.any():
-                out[m] = self._shards[s][rows[m] - self.bounds[s]]
+                out[m] = np.take(self._shards[s], rows[m] - self.bounds[s],
+                                 axis=0)
         return out
 
     def write_range(self, start: int, values: np.ndarray) -> None:

@@ -87,6 +87,14 @@ class WindowPlan:
     def num_windows(self) -> int:
         return int(self.rows.shape[0])
 
+    def schedule(self) -> list[int]:
+        """The window consumption order the stream half-step commits —
+        the chunk scan's own order.  THE order the staging engine
+        (``offload/staging.py``) must serve windows in; having one
+        authority here is what keeps the pooled and serial drivers
+        consuming identical sequences."""
+        return list(range(self.num_windows))
+
     def staged_bytes_per_window(self, rank: int, stage_itemsize: int, *,
                                 row_overhead_bytes: int = 0) -> int:
         """Bytes one staged window occupies on device: the gathered table
@@ -309,6 +317,14 @@ class RingWindowPlan:
         lo = int(np.searchsorted(self.slice_of, t, side="left"))
         hi = int(np.searchsorted(self.slice_of, t, side="right"))
         return range(lo, hi)
+
+    def schedule(self, visits: list[int]) -> list[int]:
+        """The window consumption order for one shard's exchange visit
+        order (``hier_visit_order``): each visited slice's windows, in
+        slice-internal order — exactly the sequence the resident exchange
+        delivers blocks in.  The one authority the ring half-step AND the
+        staging engine share (``WindowPlan.schedule``'s ring twin)."""
+        return [w for t in visits for w in self.windows_of_slice(t)]
 
     def staged_bytes_per_window(self, rank: int, stage_itemsize: int, *,
                                 row_overhead_bytes: int = 0) -> int:

@@ -50,12 +50,21 @@ store shard placement a multi-host deployment would pin per host).
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import numpy as np
 
 from cfk_tpu.config import ALSConfig
 from cfk_tpu.offload import budget as _budget
+from cfk_tpu.offload.staging import (
+    DEFAULT_POOL_DEPTH,
+    StagingStats,
+    WindowStager,
+    pool_workers_for,
+    resolve_staging,
+    stats_add,
+)
 # _np_dtype: the ONE validated name→numpy-dtype mapping (raises on
 # anything but float32/bfloat16 — no silent fallthrough).
 from cfk_tpu.offload.store import (
@@ -69,6 +78,18 @@ from cfk_tpu.offload.window import (
     build_ring_window_plan,
     build_window_plan,
 )
+
+# Trace counter for the windowed driver's jits: the bodies below bump it
+# once per TRACE (python side effects run only while tracing), so the
+# staging-A/B bench rows can report `trace_count` and a warm compile
+# cache (ALSConfig.compile_cache_dir) shows up as fewer compile seconds
+# at an unchanged trace count.
+_TRACES = [0]
+
+
+def trace_count() -> int:
+    """Traces of the windowed driver's jitted programs this process."""
+    return _TRACES[0]
 
 
 def _stage_dtype(store_dtype: str, table_dtype: str | None) -> str:
@@ -89,16 +110,26 @@ def _stage_cell_bytes(stage_name: str) -> tuple[int, int]:
     return _np_dtype(stage_name).itemsize, 0
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("statics", "lam", "solver", "overlap",
-                     "fused_epilogue", "in_kernel_gather",
-                     "reg_solve_algo", "table_dtype", "out_dtype"),
-)
-def _window_half_jit(tbl, scale, nb, rt, wt, ts, ent, cnt, cin, lseg, *,
-                     statics, lam, solver, overlap, fused_epilogue,
-                     in_kernel_gather, reg_solve_algo, table_dtype,
-                     out_dtype):
+def _staged_donate_argnums(base: tuple, staged: tuple) -> tuple:
+    """Donation positions for a window jit: ``base`` (device-owned
+    carries — always donatable) plus the staged-table positions on TPU
+    only.  On CPU ``jax.device_put`` ZERO-COPY-ALIASES host numpy arrays
+    (measured in this container), and jax refuses to donate an aliased
+    buffer with a "donated buffers were not usable" warning per program —
+    so the staged (tbl, scale) pair donates only where the PCIe copy
+    makes it device-owned (on-TPU validation backlog re-measures the
+    reclaim).  The chunk operands are NEVER donated: they are stage-time
+    VIEWS of the TiledBlocks, and a donated alias would let XLA scribble
+    on the dataset itself."""
+    if jax.default_backend() == "tpu":
+        return base + staged
+    return base
+
+
+def _window_half_impl(tbl, scale, nb, rt, wt, ts, ent, cnt, cin, lseg, *,
+                      statics, lam, solver, overlap, fused_epilogue,
+                      in_kernel_gather, reg_solve_algo, table_dtype,
+                      out_dtype):
     """One window's chunks through the UNMODIFIED stream-mode half-step
     (``return_chunk_rows`` skips the device scatter — the host does it).
 
@@ -111,6 +142,7 @@ def _window_half_jit(tbl, scale, nb, rt, wt, ts, ent, cnt, cin, lseg, *,
     from cfk_tpu.ops import quant
     from cfk_tpu.ops.tiled import tiled_half_step
 
+    _TRACES[0] += 1
     if scale is not None:
         wt = quant.fold_scale(wt, scale, nb)
         table_dtype = None
@@ -126,12 +158,21 @@ def _window_half_jit(tbl, scale, nb, rt, wt, ts, ent, cnt, cin, lseg, *,
     return xs.astype(jax.numpy.dtype(out_dtype))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("statics", "backend", "gather", "int8"),
-)
-def _ring_window_jit(acc_a, acc_b, tbl, scale, nb, rt, wt, ts, ent, *,
-                     statics, backend, gather, int8):
+@functools.lru_cache(maxsize=None)
+def _window_half_jit():
+    """The stream-mode window jit, built lazily so the staged-pair
+    donation can consult the backend (see ``_staged_donate_argnums``)."""
+    return jax.jit(
+        _window_half_impl,
+        static_argnames=("statics", "lam", "solver", "overlap",
+                         "fused_epilogue", "in_kernel_gather",
+                         "reg_solve_algo", "table_dtype", "out_dtype"),
+        donate_argnums=_staged_donate_argnums((), (0, 1)),
+    )
+
+
+def _ring_window_impl(acc_a, acc_b, tbl, scale, nb, rt, wt, ts, ent, *,
+                      statics, backend, gather, int8):
     """One staged ring window's chunks, accumulated into the shard's
     persistent per-entity Gram carry — op-for-op the flat/hier ring's
     per-slice chunk body (``parallel.spmd._make_tiled_slice_grams``),
@@ -143,6 +184,8 @@ def _ring_window_jit(acc_a, acc_b, tbl, scale, nb, rt, wt, ts, ent, *,
 
     from cfk_tpu.ops import quant
     from cfk_tpu.ops.tiled import _entity_gram_chunk
+
+    _TRACES[0] += 1
 
     ncw, cap, t, e_c = statics
     nt = cap // t
@@ -170,15 +213,40 @@ def _ring_window_jit(acc_a, acc_b, tbl, scale, nb, rt, wt, ts, ent, *,
     return lax.fori_loop(0, ncw, chunk_body, (acc_a, acc_b))
 
 
+@functools.lru_cache(maxsize=None)
+def _ring_window_jit():
+    """The ring-mode window jit.  Donates the persistent Gram carry pair
+    (ISSUE 13): the accumulation is in-place by construction
+    (``acc.at[...].add``), so donation lets the output accumulator ALIAS
+    the input — input and output never coexist across the dispatch
+    boundary, which is exactly the ×2→×1 reservation reclaim
+    ``budget.ring_accumulator_reservation`` credits (the
+    ``models/als.py``/``spmd.py`` ``donate_argnums`` idiom applied at the
+    window boundary).  The staged (tbl, scale) pair additionally donates
+    on TPU (``_staged_donate_argnums``); the chunk operands never do
+    (stage-time views of the blocks)."""
+    return jax.jit(
+        _ring_window_impl,
+        static_argnames=("statics", "backend", "gather", "int8"),
+        donate_argnums=_staged_donate_argnums((0, 1), (2, 3)),
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("local", "lam", "solver", "fused_epilogue",
                      "reg_solve_algo", "out_dtype"),
+    # NOT donated: the solve's [local, k] output is smaller than either
+    # accumulator, so no output can alias them — XLA refuses the
+    # donation ("donated buffers were not usable") and nothing is
+    # reclaimed.  The window-boundary donation in _ring_window_jit is
+    # where the ×2→×1 accumulator reservation actually comes from.
 )
 def _ring_solve_jit(acc_a, acc_b, cnt, *, local, lam, solver,
                     fused_epilogue, reg_solve_algo, out_dtype):
     from cfk_tpu.ops.solve import regularized_solve
 
+    _TRACES[0] += 1
     x = regularized_solve(
         acc_a[:local], acc_b[:local], cnt, lam, solver,
         fused=fused_epilogue, algo=reg_solve_algo,
@@ -252,10 +320,11 @@ def _stage_table(fixed_store: HostFactorStore, rows: np.ndarray, *,
         home = (owners == home_shard)
         group = (owners // max(ici_group, 1)
                  == home_shard // max(ici_group, 1))
-        stats["rows_local"] = stats.get("rows_local", 0) + int(home.sum())
-        stats["rows_ici"] = (stats.get("rows_ici", 0)
-                             + int((group & ~home).sum()))
-        stats["rows_dcn"] = stats.get("rows_dcn", 0) + int((~group).sum())
+        # stats_add: staging may run on pool worker threads (ISSUE 13),
+        # where an unguarded read-modify-write would lose counts.
+        stats_add(stats, "rows_local", int(home.sum()))
+        stats_add(stats, "rows_ici", int((group & ~home).sum()))
+        stats_add(stats, "rows_dcn", int((~group).sum()))
     return data, scale
 
 
@@ -278,7 +347,7 @@ def _stage_window(fixed_store: HostFactorStore, plan_obj, w: int, *,
     host = (data, scale, plan_obj.neighbor_idx[w],
             *plan_obj.stage_chunks(w))
     if stats is not None:
-        stats["windows_staged"] = stats.get("windows_staged", 0) + 1
+        stats_add(stats, "windows_staged", 1)
         # The FULL staged working set — table (+ int8 scales) AND chunk
         # arrays — the same quantity the per-window budget was sized
         # against (staged_bytes_per_window), so the recorded arithmetic
@@ -288,18 +357,41 @@ def _stage_window(fixed_store: HostFactorStore, plan_obj, w: int, *,
         # not host allocations.  The TABLE share is metered separately:
         # it is the bytes the staging dtype levers (int8 (codes, scales)
         # ≈ ¼ of f32 — the honest per-dtype ratio the bench rows
-        # record).
-        stats["staged_bytes"] = (
-            stats.get("staged_bytes", 0)
-            + sum(a.nbytes for a in host if a is not None)
+        # record).  Metered from the HOST arrays BEFORE the device_put
+        # hand-off — the device (tbl, scale) pair is donated through the
+        # window jit (ISSUE 13), so nothing may read it after dispatch.
+        stats_add(stats, "staged_bytes",
+                  sum(a.nbytes for a in host if a is not None))
+        stats_add(stats, "staged_table_bytes",
+                  data.nbytes + (scale.nbytes if scale is not None else 0))
+    # ONE pytree device_put for the whole window (None leaves pass
+    # through): per-array puts paid jax dispatch overhead 7-10× per
+    # window, which dominated staging at small windows — one issue per
+    # window is also the shape a real PCIe queue wants.
+    return jax.device_put(host)
+
+
+def _own_stager(fixed_store, plan_obj, schedule, *, table_dtype, faults,
+                iteration, side, shard, verify_windows, stats, ici_group,
+                ) -> WindowStager:
+    """A single-shard SERIAL stager for direct half-step callers (tests,
+    library use): byte-for-byte the PR 10/11 schedule — staging runs on
+    the consuming thread at the classic double-buffer positions.  The
+    sharded driver passes a shared pooled stager instead."""
+    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
+    int8 = stage_name == "int8"
+    stage_np = None if int8 else _np_dtype(stage_name)
+
+    def stage_task(d, w):
+        return _stage_window(
+            fixed_store, plan_obj, w, stage_np=stage_np, int8=int8,
+            faults=faults, iteration=iteration, side=side, shard=d,
+            verify_windows=verify_windows, stats=stats,
+            ici_group=ici_group,
         )
-        stats["staged_table_bytes"] = (
-            stats.get("staged_table_bytes", 0) + data.nbytes
-            + (scale.nbytes if scale is not None else 0)
-        )
-    return tuple(
-        jax.device_put(x) if x is not None else None for x in host
-    )
+
+    return WindowStager([(shard, w) for w in schedule], stage_task,
+                        mode="serial", stats=stats)
 
 
 def windowed_half_step(
@@ -308,7 +400,7 @@ def windowed_half_step(
     fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
     table_dtype: str | None = None, faults=None, iteration: int = 0,
     side: str = "", stats: dict | None = None, verify_windows: bool = False,
-    shard: int = 0, ici_group: int = 1,
+    shard: int = 0, ici_group: int = 1, stager: WindowStager | None = None,
 ) -> np.ndarray:
     """Solve one shard's entities against a host-resident fixed table,
     window by window (the stream-mode / all_gather-exchange scan).
@@ -322,42 +414,52 @@ def windowed_half_step(
     finite-and-wrong, which only an integrity check can see.  Scope is
     the HOST staging pipeline up to the ``device_put`` hand-off (which is
     where the chaos fault hook models its corruption); verifying the PCIe
-    DMA itself would need a device-side checksum — on-TPU follow-up."""
+    DMA itself would need a device-side checksum — on-TPU follow-up.
+
+    ``stager`` (ISSUE 13): the staging engine serving this shard's
+    windows — the sharded driver passes ONE pooled stager shared across
+    every shard of a half-iteration, so shard d+1's staging overlaps
+    shard d's compute on worker threads.  ``None`` builds a private
+    serial stager (the classic double-buffer schedule, unchanged
+    behavior for direct callers); the faults/verify/stats arguments
+    configure only that private stager — a shared stager carries its
+    own."""
     k = fixed_store.rank
-    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
-    int8 = stage_name == "int8"
-    stage_np = None if int8 else _np_dtype(stage_name)
     out = np.zeros((wplan.local_entities, k), dtype=_np_dtype(out_dtype))
     n_w = wplan.num_windows
-
-    def stage(w):
-        return _stage_window(
-            fixed_store, wplan, w, stage_np=stage_np, int8=int8,
+    own = stager is None
+    if own:
+        stager = _own_stager(
+            fixed_store, wplan, wplan.schedule(), table_dtype=table_dtype,
             faults=faults, iteration=iteration, side=side, shard=shard,
             verify_windows=verify_windows, stats=stats,
             ici_group=ici_group,
         )
-
-    staged = stage(0)
-    for w in range(n_w):
-        # DISPATCH window w's compute first (jit dispatch is async), THEN
-        # run window w+1's host gather + device_put under it, and only
-        # then join w's result: both the host staging work (the store
-        # fancy-index gather, the optional quantization + checksum) and
-        # the transfer overlap the device compute.
-        xs = _window_half_jit(
-            *staged, statics=wplan.statics, lam=float(lam), solver=solver,
-            overlap=overlap, fused_epilogue=fused_epilogue,
-            in_kernel_gather=in_kernel_gather,
-            reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
-            out_dtype=out_dtype,
-        )
-        nxt = stage(w + 1) if w + 1 < n_w else None
-        xs_np = np.asarray(xs)
-        ent = wplan.chunk_entity_of(w)
-        real = ent < wplan.local_entities
-        out[ent[real]] = xs_np[real]
-        staged = nxt
+    try:
+        staged = stager.take() if n_w else None
+        for w in range(n_w):
+            # DISPATCH window w's compute first (jit dispatch is async),
+            # THEN take window w+1 — a serial stager runs the host gather
+            # + device_put HERE, under the dispatched compute (the PR 10
+            # double buffer); a pooled stager usually has it already
+            # staged by a worker — and only then join w's result.
+            xs = _window_half_jit()(
+                *staged, statics=wplan.statics, lam=float(lam),
+                solver=solver, overlap=overlap,
+                fused_epilogue=fused_epilogue,
+                in_kernel_gather=in_kernel_gather,
+                reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
+                out_dtype=out_dtype,
+            )
+            nxt = stager.take() if w + 1 < n_w else None
+            xs_np = np.asarray(xs)
+            ent = wplan.chunk_entity_of(w)
+            real = ent < wplan.local_entities
+            out[ent[real]] = xs_np[real]
+            staged = nxt
+    finally:
+        if own:
+            stager.close()
     return out
 
 
@@ -368,18 +470,21 @@ def ring_windowed_half_step(
     in_kernel_gather=None, reg_solve_algo=None,
     table_dtype: str | None = None, faults=None, iteration: int = 0,
     side: str = "", stats: dict | None = None, verify_windows: bool = False,
-    shard: int = 0, ici_group: int = 1,
+    shard: int = 0, ici_group: int = 1, stager: WindowStager | None = None,
 ) -> np.ndarray:
     """One shard's ring/hier-ring half-iteration against staged windows.
 
     ``visits`` is the slice visit order the resident exchange would
     deliver blocks in (``hier_visit_order``); per visit, the slice's
-    windows stage double-buffered while the persistent per-entity Gram
-    accumulator — the SAME [E_local+1, k(,k)] carry the resident ring
-    holds — absorbs each window's chunk Grams.  One solve at the end.
-    The staged window is the slice rows this shard's chunks actually
-    reference (the window residual) — never the whole block, which is
-    how the flat ring's O(S) full-table traffic disappears."""
+    windows stage ahead (the shared pooled ``stager``, or a private
+    serial one — see ``windowed_half_step``) while the persistent
+    per-entity Gram accumulator — the SAME [E_local+1, k(,k)] carry the
+    resident ring holds, DONATED through each window call so input and
+    output never coexist (ISSUE 13) — absorbs each window's chunk Grams.
+    One solve at the end.  The staged window is the slice rows this
+    shard's chunks actually reference (the window residual) — never the
+    whole block, which is how the flat ring's O(S) full-table traffic
+    disappears."""
     import jax.numpy as jnp
 
     from cfk_tpu.ops.tiled import (
@@ -395,33 +500,34 @@ def ring_windowed_half_step(
     gather = resolve_gather_mode(
         in_kernel_gather, backend, "full", cap, nt, t, e_c + 1, k,
     )
-    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
-    int8 = stage_name == "int8"
-    stage_np = None if int8 else _np_dtype(stage_name)
-    schedule = [w for t_idx in visits
-                for w in rplan.windows_of_slice(t_idx)]
-
-    def stage(w):
-        return _stage_window(
-            fixed_store, rplan, w, stage_np=stage_np, int8=int8,
+    int8 = _stage_dtype(fixed_store.dtype, table_dtype) == "int8"
+    schedule = rplan.schedule(visits)
+    own = stager is None
+    if own:
+        stager = _own_stager(
+            fixed_store, rplan, schedule, table_dtype=table_dtype,
             faults=faults, iteration=iteration, side=side, shard=shard,
             verify_windows=verify_windows, stats=stats,
             ici_group=ici_group,
         )
-
     acc_a = jnp.zeros((local + 1, k, k), jnp.float32)
     acc_b = jnp.zeros((local + 1, k), jnp.float32)
-    staged = stage(schedule[0]) if schedule else None
-    for i, w in enumerate(schedule):
-        # Dispatch this window's accumulation (async), then stage the
-        # next visit's window under it — the inner-ICI-rotation overlap
-        # of the resident hier ring, one level up.
-        acc_a, acc_b = _ring_window_jit(
-            acc_a, acc_b, *staged,
-            statics=(rplan.window_chunks, cap, t, e_c),
-            backend=backend, gather=gather, int8=int8,
-        )
-        staged = stage(schedule[i + 1]) if i + 1 < len(schedule) else None
+    try:
+        staged = stager.take() if schedule else None
+        for i, w in enumerate(schedule):
+            # Dispatch this window's accumulation (async), then take the
+            # next visit's window under it — the inner-ICI-rotation
+            # overlap of the resident hier ring, one level up.  The
+            # donated carry rebinds; nothing may read the pre-call pair.
+            acc_a, acc_b = _ring_window_jit()(
+                acc_a, acc_b, *staged,
+                statics=(rplan.window_chunks, cap, t, e_c),
+                backend=backend, gather=gather, int8=int8,
+            )
+            staged = (stager.take() if i + 1 < len(schedule) else None)
+    finally:
+        if own:
+            stager.close()
     x = _ring_solve_jit(
         acc_a, acc_b, jax.numpy.asarray(count_local), local=local,
         lam=float(lam), solver=solver, fused_epilogue=fused_epilogue,
@@ -579,6 +685,8 @@ def train_als_host_window(
     device_budget_bytes: float | None = None,
     plan_provenance=None,
     verify_windows: bool | None = None,
+    staging: str | None = None,
+    pool_depth: int | None = None,
 ):
     """ALS-WR with host-resident factor tables and windowed half-steps.
 
@@ -598,7 +706,21 @@ def train_als_host_window(
     (default: the detected device's HBM through ``offload.budget`` — the
     SAME predicate the planner gates the ``device`` tier with);
     ``chunks_per_window`` overrides the derived window size.
+
+    ``staging`` (ISSUE 13) picks the host staging engine's mode —
+    ``"pool"`` (the default: one bounded thread pool per half-iteration
+    stages every shard's windows ahead of consumption, overlapping the
+    host gather/quantize/checksum/``device_put`` across shards AND
+    windows) or ``"serial"`` (the PR 10/11 one-thread double buffer, the
+    A/B baseline) — defaulting to ``config.staging``.  ``pool_depth``
+    bounds the staged-ahead windows (default ``config.staging_pool_depth``
+    or ``offload.staging.DEFAULT_POOL_DEPTH``), and is always CLAMPED so
+    ``depth + 1`` worst-case windows fit the per-shard staging budget
+    next to the ring accumulator reservation (``budget.max_pool_depth``
+    — the staging-arena term).  Both modes are crc-identical to each
+    other and to the resident paths.
     """
+    from cfk_tpu.config import enable_compile_cache
     from cfk_tpu.ops.solve import init_factors_stats
     from cfk_tpu.resilience.policy import (
         Overrides,
@@ -607,6 +729,7 @@ def train_als_host_window(
     )
     from cfk_tpu.utils.metrics import Metrics
 
+    enable_compile_cache(getattr(config, "compile_cache_dir", None))
     if config.algorithm != "als":
         raise ValueError(
             f"host-window offload supports the explicit ALS optimizer; "
@@ -639,17 +762,19 @@ def train_als_host_window(
 
             device_budget_bytes = DeviceSpec.detect().hbm_bytes
         # The ring modes hold a persistent per-shard Gram accumulator
-        # next to the staged windows; reserve it (×2: the dispatch
-        # boundary keeps a window call's input AND output accumulators
-        # alive — buffer donation is the on-TPU lever to reclaim one)
-        # before splitting the remainder across the window double buffer.
+        # next to the staged windows; reserve it at ×1 (ISSUE 13:
+        # ``_ring_window_jit`` DONATES the carry pair, so a window call's
+        # output accumulator aliases its input — the ×2 the PR 11
+        # dispatch boundary used to keep alive is reclaimed, which is
+        # exactly why the budget now admits larger windows here) before
+        # splitting the remainder across the window double buffer.
         acc_reserved = 0.0
         for blocks, ring in ((mb, ring_m), (ub, ring_u)):
             if ring:
                 acc_reserved = max(
                     acc_reserved,
-                    2.0 * _budget.ring_accumulator_bytes(
-                        blocks.local_entities, config.rank
+                    _budget.ring_accumulator_reservation(
+                        blocks.local_entities, config.rank, donated=True
                     ),
                 )
         per_window_budget = _budget.window_budget_bytes(
@@ -688,6 +813,23 @@ def train_als_host_window(
                 "reserve) / WINDOW_BUFFERS) — lower hbm_chunk_elems so "
                 "single chunks fit the budget"
             )
+        # Staging engine resolution (ISSUE 13): mode from the explicit
+        # argument or the config, depth clamped by the staging arena —
+        # depth + 1 worst-case windows must fit the budget share next to
+        # the accumulator reservation, so a deep pool can never promise
+        # device memory the window sizing above did not leave free.
+        staging = resolve_staging(
+            staging if staging is not None
+            else getattr(config, "staging", "auto"),
+        )
+        if pool_depth is None:
+            pool_depth = (getattr(config, "staging_pool_depth", None)
+                          or DEFAULT_POOL_DEPTH)
+        pool_depth = max(1, min(
+            int(pool_depth),
+            _budget.max_pool_depth(device_budget_bytes, worst,
+                                   reserved_bytes=acc_reserved),
+        ))
     metrics.gauge("offload_windows_m",
                   sum(p.num_windows for p in m_plans))
     metrics.gauge("offload_windows_u",
@@ -708,6 +850,11 @@ def train_als_host_window(
         metrics.gauge("offload_acc_reserved_mb",
                       round(acc_reserved / 1e6, 3))
         metrics.note("offload_exchange", config.exchange)
+    metrics.note("offload_staging", staging)
+    if staging == "pool":
+        metrics.gauge("offload_pool_depth", pool_depth)
+        metrics.gauge("offload_pool_workers",
+                      pool_workers_for(pool_depth))
 
     # Init: identical to the resident trainers (init_factors_stats drawn
     # at the REAL entity count — the shard-count-invariant init — zero
@@ -730,7 +877,11 @@ def train_als_host_window(
     norm_limit = (config.health_norm_limit
                   if config.health_check_every is not None else None)
     probe_every = config.health_check_every or 1
-    stats: dict = {}
+    # StagingStats, not a dict: pooled staging increments these from
+    # worker threads (the guard the donated-buffer/step-hook audit asks
+    # for — every gauge below reads HOST-side counters metered before
+    # the device_put hand-off, never a donated device array).
+    stats = StagingStats()
     if verify_windows is None:
         # Checksumming every staged window costs a host pass over its
         # bytes, and its scope is the host staging pipeline up to the
@@ -751,6 +902,10 @@ def train_als_host_window(
     count_m = mb.count.reshape(s, -1)
     count_u = ub.count.reshape(s, -1)
 
+    stage_name_cfg = _stage_dtype(config.dtype, config.table_dtype)
+    int8_cfg = stage_name_cfg == "int8"
+    stage_np_cfg = None if int8_cfg else _np_dtype(stage_name_cfg)
+
     def half(side, fixed_store, plans, local, counts, it, ring):
         """One half-iteration across every shard: per-shard windowed
         scans against the shared host store, in this side's execution
@@ -759,24 +914,55 @@ def train_als_host_window(
         runs each half exactly as the resident trainer would).  Reads
         one store, writes a host buffer (committed by the caller) — no
         read-after-write hazard across shards, matching the resident
-        step's solve-all-then-exchange structure."""
+        step's solve-all-then-exchange structure.
+
+        ONE staging engine serves the whole half (ISSUE 13): the task
+        list flattens every shard's schedule shard-major — exactly the
+        order the per-shard half-steps consume below — and the pool
+        stages ahead across that order, so shard d+1's host gather +
+        ``device_put`` run under shard d's dispatched compute instead of
+        after it.  Staging is a pure read of ``fixed_store`` (written
+        only after the half commits), so any staging-ahead interleave is
+        bit-safe; consumption order — and therefore every bit — is
+        unchanged.  ``close()`` in the ``finally`` drains workers before
+        any rollback can swap the store under them."""
         algo = ov.reg_solve_algo or config.reg_solve_algo
         out = np.zeros((local * s, config.rank),
                        dtype=_np_dtype(config.dtype))
-        for d in range(s):
-            kw = dict(half_kw, lam=ov.lam,
-                      fused_epilogue=ov.fused_epilogue,
-                      reg_solve_algo=algo, iteration=it, side=side,
-                      shard=d)
-            if ring:
-                visits = hier_visit_order(s, inner, d)
-                rows = ring_windowed_half_step(
-                    fixed_store, plans[d], visits=visits,
-                    count_local=counts[d], **kw,
-                )
-            else:
-                rows = windowed_half_step(fixed_store, plans[d], **kw)
-            out[d * local:(d + 1) * local] = rows
+        schedules = [
+            (plans[d].schedule(hier_visit_order(s, inner, d)) if ring
+             else plans[d].schedule())
+            for d in range(s)
+        ]
+        tasks = [(d, w) for d in range(s) for w in schedules[d]]
+
+        def stage_task(d, w):
+            return _stage_window(
+                fixed_store, plans[d], w, stage_np=stage_np_cfg,
+                int8=int8_cfg, faults=window_faults, iteration=it,
+                side=side, shard=d, verify_windows=verify_windows,
+                stats=stats, ici_group=inner,
+            )
+
+        stager = WindowStager(tasks, stage_task, mode=staging,
+                              depth=pool_depth, stats=stats)
+        try:
+            for d in range(s):
+                kw = dict(half_kw, lam=ov.lam,
+                          fused_epilogue=ov.fused_epilogue,
+                          reg_solve_algo=algo, iteration=it, side=side,
+                          shard=d, stager=stager)
+                if ring:
+                    rows = ring_windowed_half_step(
+                        fixed_store, plans[d],
+                        visits=hier_visit_order(s, inner, d),
+                        count_local=counts[d], **kw,
+                    )
+                else:
+                    rows = windowed_half_step(fixed_store, plans[d], **kw)
+                out[d * local:(d + 1) * local] = rows
+        finally:
+            stager.close()
         return out
 
     # Probing + last-good snapshots cost a full host pass + memcpy over
@@ -792,6 +978,9 @@ def train_als_host_window(
     trips = 0
     it = 0
     degraded = False
+    traces0 = trace_count()
+    train_t0 = time.time()
+    first_step_s = None
 
     def trip(reason: str) -> bool:
         """Rollback + ladder climb; returns False when retries are
@@ -851,6 +1040,11 @@ def train_als_host_window(
                 continue
             it += 1
             metrics.incr("iterations")
+            if first_step_s is None:
+                # Cold-start attribution (ISSUE 13): how long until the
+                # first full iteration lands — the quantity a warm
+                # persistent compile cache (compile_cache_dir) shrinks.
+                first_step_s = time.time() - train_t0
             if not armed:
                 continue
             if it % probe_every != 0 and it < config.num_iterations:
@@ -868,6 +1062,27 @@ def train_als_host_window(
                   round(stats.get("staged_bytes", 0) / 1e6, 3))
     metrics.gauge("offload_staged_table_mb",
                   round(stats.get("staged_table_bytes", 0) / 1e6, 3))
+    # Staging-engine accounting (ISSUE 13): busy = summed staging task
+    # seconds, stall = the consuming thread's exposed wait (== busy in
+    # serial mode by construction), hidden = 1 − stall/busy.  All read
+    # from HOST-side counters — never a donated device buffer.
+    busy = float(stats.get("stage_busy_s", 0.0))
+    stall = float(stats.get("stage_stall_s", 0.0))
+    metrics.gauge("offload_stage_busy_s", round(busy, 4))
+    metrics.gauge("offload_stage_stall_s", round(stall, 4))
+    if busy > 0:
+        metrics.gauge("offload_stage_hidden_frac",
+                      round(max(0.0, 1.0 - stall / busy), 4))
+        metrics.gauge("offload_staged_mb_per_s",
+                      round(stats.get("staged_bytes", 0) / 1e6 / busy, 2))
+    if staging == "pool":
+        metrics.gauge("offload_pool_peak_inflight",
+                      stats.get("pool_peak_inflight", 0))
+        metrics.gauge("offload_pool_worker_stagings",
+                      stats.get("pool_worker_stagings", 0))
+    metrics.gauge("offload_trace_count", trace_count() - traces0)
+    if first_step_s is not None:
+        metrics.gauge("time_to_first_step_s", round(first_step_s, 4))
     for key_ in ("rows_local", "rows_ici", "rows_dcn"):
         if key_ in stats:
             metrics.gauge(f"offload_{key_}", stats[key_])

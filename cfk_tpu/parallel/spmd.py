@@ -1281,12 +1281,14 @@ def train_als_sharded(
     (``make_training_step(health_probe=True)``); a trip rolls back to the
     last good checkpoint and escalates (``cfk_tpu.resilience``).
     """
-    from cfk_tpu.config import apply_overlap_xla_flags
+    from cfk_tpu.config import apply_overlap_xla_flags, enable_compile_cache
     from cfk_tpu.resilience.loop import validate_cadence
     from cfk_tpu.resilience.sentinel import health_from_config
 
     from cfk_tpu.plan import plan_for_config
 
+    # Before the first compile (ISSUE 13): warm-start compile caching.
+    enable_compile_cache(getattr(config, "compile_cache_dir", None))
     s = config.num_shards
     health = health_from_config(config)
     validate_cadence(checkpoint_every, health)

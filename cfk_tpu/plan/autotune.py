@@ -232,6 +232,12 @@ def measure_with_training(shape: ProblemShape, base_config=None, *,
             reg_solve_algo=ep.reg_solve_algo,
             table_dtype=ep.table_dtype,
             solver=ep.solver,
+            # Thread the staging engine too (ISSUE 13): on a host_window
+            # resolve the enumerated pool/serial candidates must EXECUTE
+            # their own mode, or both arms would measure the config
+            # default and the cached winner's staging value would not be
+            # backed by any measurement.
+            staging=ep.staging,
             plan="pinned",
         )
         ds = cached_scale_dataset(

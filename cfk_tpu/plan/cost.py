@@ -194,9 +194,18 @@ def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
     # the exchange term above, unchanged).  Staged cells follow the
     # STAGING dtype (ISSUE 12): bf16 halves, int8 ships the (1-byte
     # codes + one f32 scale per row) pair — a quarter, the honest bytes
-    # the executor's ``offload_staged_mb`` now records.  The staging
-    # double buffer hides it under per-shard compute up to the floor
-    # exactly like the exchange term.
+    # the executor's ``offload_staged_mb`` now records.
+    #
+    # Hiding (ISSUE 13): the POOLED staging engine overlaps the whole
+    # host pipeline (gather, quantize, checksum, device_put issue)
+    # across shards and windows on worker threads, so staging hides
+    # under compute up to the FULL floor; the serial double buffer only
+    # overlaps one window at a time on the consuming thread and — like
+    # the exchange term — is credited half the floor, and only when the
+    # chunk pipelines overlap at all.  (The donation reclaim is a
+    # MEMORY credit, not a time term: it lands in offload.budget —
+    # larger admitted windows, the ×1 accumulator reservation, and the
+    # resident-tier solve-output credit the tier predicate consumes.)
     if plan.offload_tier == "host_window":
         stage_itemsize = {"bfloat16": 2.0, "int8": 1.0}.get(
             plan.table_dtype, float(factor_bytes)
@@ -206,7 +215,9 @@ def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
         window_dup = 1.15
         pcie = ((shape.num_users + shape.num_movies) * stage_bytes_per_row
                 * window_dup / shards / device.pcie_bytes_per_s)
-        if plan.overlap:
+        if plan.staging == "pool":
+            exposed_pcie = max(0.0, pcie - floor)
+        elif plan.overlap:
             exposed_pcie = max(0.0, pcie - floor * 0.5)
         else:
             exposed_pcie = pcie

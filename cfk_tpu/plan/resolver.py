@@ -43,7 +43,7 @@ from cfk_tpu.plan.spec import (
 _TRAIN_FIELDS = ("layout", "exchange", "chunk_elems", "fused_epilogue",
                  "in_kernel_gather", "overlap", "reg_solve_algo",
                  "table_dtype", "solver", "gram_backend", "offload_tier",
-                 "ici_group")
+                 "ici_group", "staging")
 _SERVE_FIELDS = ("table_dtype", "serve_batch_quantum", "serve_tile_m")
 
 
@@ -210,13 +210,22 @@ def candidates(shape: ProblemShape, constraints: PlanConstraints,
     fields = _SERVE_FIELDS if shape.kind == "serve" else _TRAIN_FIELDS
     pins = constraints.pinned()
     axes = []
+    tier_vals: tuple = ("device",)
     for f in fields:
         if f in pins:
             axes.append((f, (pins[f],)))
+            if f == "offload_tier":
+                tier_vals = (pins[f],)
         else:
             vals = PLAN_FIELDS[f]
             if f == "exchange" and shape.num_shards == 1:
                 vals = ("all_gather",)
+            if f == "staging" and "host_window" not in tier_vals:
+                # The staging engine exists only on the host_window tier
+                # — enumerating it for resident candidates would mint
+                # cost-identical duplicates that crowd real candidates
+                # out of autotune's measured top-N.
+                vals = (PLAN_FIELDS[f][0],)
             if f == "offload_tier":
                 # The axis IS the memory-budget predicate (ISSUE 11): a
                 # fitting problem enumerates only the resident tier (the
@@ -235,6 +244,7 @@ def candidates(shape: ProblemShape, constraints: PlanConstraints,
                                 shape, device,
                                 table_dtype=pins.get("table_dtype")))
                         else ("device",))
+                tier_vals = vals
             axes.append((f, vals))
     names = [f for f, _ in axes]
     return names, itertools.product(*[v for _, v in axes])

@@ -78,6 +78,17 @@ PLAN_FIELDS: dict[str, tuple] = {
     # rotates the autotune cache's plan-field-set digest, invalidating
     # every pre-ici_group winner (they carry no decision for it).
     "ici_group": (0,),
+    # Host staging engine mode of the host_window tier (ISSUE 13):
+    # "pool" overlaps the per-(shard, window) host staging work across
+    # shards and windows on a bounded thread pool (the default execution
+    # mode — the ALX per-shard transfer pipeline's host half), "serial"
+    # is the PR 10/11 one-thread double buffer.  crc-identical across
+    # the knob; the cost model prices only how much of the
+    # host_window_pcie term stays exposed.  ALSConfig.staging always
+    # pins it (a concrete dataclass default, like overlap), and its
+    # existence rotates the autotune field-set digest — pre-staging
+    # winners carry no decision for it and must miss.
+    "staging": ("pool", "serial"),
 }
 
 # Fields whose pins are free-form positive ints (the candidate tuples
@@ -228,6 +239,7 @@ class PlanConstraints:
     serve_tile_m: int | None = None
     offload_tier: str | None = None
     ici_group: int | None = None
+    staging: str | None = None
 
     def __post_init__(self) -> None:
         for f, candidates in PLAN_FIELDS.items():
@@ -293,6 +305,12 @@ def constraints_from_config(config) -> PlanConstraints:
                       if getattr(config, "offload_tier", "auto") == "auto"
                       else config.offload_tier),
         ici_group=getattr(config, "ici_group", None),
+        # staging always pins (ISSUE 13): 'auto' resolves to the pool
+        # deterministically (offload.staging.resolve_staging), so the
+        # plan records the engine that actually runs.
+        staging=("pool"
+                 if getattr(config, "staging", "auto") == "auto"
+                 else config.staging),
     )
 
 
@@ -323,6 +341,9 @@ class ExecutionPlan:
     # Hierarchical-exchange inner-ring size (ISSUE 12); 0 = the device's
     # ICI domain (spmd.resolve_ici_group's physical default).
     ici_group: int = 0
+    # Host staging engine of the host_window tier (ISSUE 13): "pool"
+    # (concurrent per-(shard, window) staging, the default) | "serial".
+    staging: str = "pool"
     # (slot, backend) pairs — "mosaic_tpu" | "xla_emulation" per kernel
     # slot (cfk_tpu.plan.registry.KERNEL_SLOTS).
     kernels: tuple = ()
@@ -366,6 +387,8 @@ class ExecutionPlan:
                 else f"tier={self.offload_tier} ")
         if self.ici_group:
             tier += f"ici={self.ici_group} "
+        if self.offload_tier == "host_window" and self.staging != "pool":
+            tier += f"stage={self.staging} "
         return (f"{tier}{self.layout}/{self.exchange} "
                 f"chunk={self.chunk_elems} "
                 f"fused={'on' if self.fused_epilogue else 'off'} "

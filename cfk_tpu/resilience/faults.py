@@ -201,6 +201,11 @@ class HostWindowCorruption:
     persistent: bool = False
     shard: int | None = None
     fired: int = 0
+    # Thread names the fault fired on (ISSUE 13): the pooled staging
+    # engine runs window staging on worker threads, and the chaos
+    # staging_pool scenario asserts the injection really happened INSIDE
+    # a pool worker ("cfk-stage-*"), not on the consuming thread.
+    fired_in: list = dataclasses.field(default_factory=list)
 
     def apply_window(self, i: int, side: str, w: int,
                      tbl: np.ndarray, shard: int = 0) -> np.ndarray:
@@ -209,6 +214,7 @@ class HostWindowCorruption:
                 or (self.fired and not self.persistent)):
             return tbl
         self.fired += 1
+        self.fired_in.append(threading.current_thread().name)
         tbl = np.array(tbl)  # never mutate the store's rows
         if self.kind == "torn":
             tbl[tbl.shape[0] // 2:] = 0.0
@@ -226,28 +232,68 @@ class SlowHostFetch:
     """Delay plan for window staging (a contended host / remote-NUMA
     fetch):
     sleep ``delay_s`` before every ``every``-th staging.  Purely a timing
-    fault — the double-buffered driver must absorb it without touching
-    the math (the chaos scenario pins bit-exact factors under delay).
-    ``fired`` counts DELAYS actually injected (not staging calls — the
-    chaos row's fault accounting must not inflate).  ``only_shard``
-    restricts the slowdown to one shard's staging (the straggler-host
-    drill of the sharded driver)."""
+    fault — the staging engine (pooled or serial double buffer) must
+    absorb it without touching the math (the chaos scenario pins
+    bit-exact factors under delay).  ``fired`` counts DELAYS actually
+    injected (not staging calls — the chaos row's fault accounting must
+    not inflate), under a lock: the pooled engine stages concurrently
+    from worker threads, and an unguarded ``calls`` cadence would race.
+    ``only_shard`` restricts the slowdown to one shard's staging (the
+    straggler-host drill — the pool keeps the OTHER shards staging while
+    this one sleeps, which the scenario proves via pool_peak_inflight)."""
 
     delay_s: float = 0.01
     every: int = 1
     only_shard: int | None = None
     fired: int = 0
     calls: int = 0
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False,
+    )
 
     def delay(self, i: int, side: str, w: int, shard: int = 0) -> None:
         if self.every < 1:
             return
         if self.only_shard is not None and shard != self.only_shard:
             return
-        self.calls += 1
-        if self.calls % self.every == 0:
+        with self._lock:
+            self.calls += 1
+            due = self.calls % self.every == 0
+            if due:
+                self.fired += 1
+        if due:
             time.sleep(self.delay_s)
-            self.fired += 1
+
+
+@dataclasses.dataclass
+class StagingCrash:
+    """Raise an arbitrary exception from INSIDE one window's staging
+    (ISSUE 13) — a host allocator failure, a dead NUMA node, any
+    non-checksum staging error.  The pooled engine's contract under
+    test: a worker exception must propagate to the caller as the staging
+    error (``WindowStager.take`` re-raises and cancels the remaining
+    tasks) — never a hang, and never a half-staged window reaching a
+    kernel.  Fires via the ``WindowFaultInjector.apply_window`` hook, so
+    it lands exactly where real staging work runs (a pool worker thread
+    in pooled mode)."""
+
+    iteration: int
+    side: str = "m"
+    window: int = 0
+    shard: int | None = None
+    message: str = "injected staging crash"
+    fired: int = 0
+    fired_in: list = dataclasses.field(default_factory=list)
+
+    def apply_window(self, i: int, side: str, w: int,
+                     tbl: np.ndarray, shard: int = 0) -> np.ndarray:
+        if (i != self.iteration or side != self.side or w != self.window
+                or (self.shard is not None and shard != self.shard)
+                or self.fired):
+            return tbl
+        self.fired += 1
+        self.fired_in.append(threading.current_thread().name)
+        raise RuntimeError(self.message)
 
 
 class WindowFaultInjector:

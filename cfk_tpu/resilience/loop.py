@@ -150,14 +150,24 @@ def resilient_train_loop(
         raise ValueError("pass exactly one of step_fn / make_step")
     policy = policy or RecoveryPolicy()
     if snapshot_fn is None:
-        snapshot_fn = lambda u, m: (np.asarray(u), np.asarray(m))
+        # SNAPSHOT-BEFORE-DONATE (ISSUE 13 audit): the trainers' step
+        # jits DONATE their factor arguments, and on CPU np.asarray of a
+        # jax array can be a zero-copy VIEW of the device buffer — a
+        # donated step could then reuse the snapshot's memory for its
+        # outputs and silently rewrite the ladder's last-good anchor.
+        # np.array(copy=True) pins an owned host copy; same bytes.
+        snapshot_fn = lambda u, m: (np.array(u, copy=True),
+                                    np.array(m, copy=True))
     if restore_fn is None:
         restore_fn = lambda hu, hm: (
             jnp.asarray(hu, dtype=dtype), jnp.asarray(hm, dtype=dtype)
         )
     if save_fn is None:
         def save_fn(done, u, m):
-            hu, hm = np.asarray(u), np.asarray(m)
+            # Owned copies, not views: the returned pair doubles as the
+            # rollback anchor (host_pair) and must survive the next
+            # donated step — see snapshot_fn above.
+            hu, hm = np.array(u, copy=True), np.array(m, copy=True)
             meta = {"rank": rank, "model": model,
                     "num_shards": num_shards}
             if plan_provenance is not None:

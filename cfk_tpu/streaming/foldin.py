@@ -51,12 +51,25 @@ def _pow2_ceil(x: int, floor: int) -> int:
     return out
 
 
+# Trace counter (ISSUE 13): bumped once per TRACE of a fold-in program
+# (the bodies run only while jax traces a new shape bucket), so the
+# session's prewarm() can pin its zero-new-traces contract and the bench
+# fold-in row can report trace_count alongside updates/s.
+_TRACES = [0]
+
+
+def trace_count() -> int:
+    """Fold-in program traces this process (both layouts)."""
+    return _TRACES[0]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("lam", "solver", "reg_solve_algo"),
 )
 def _padded_fold(fixed, neighbor_idx, rating, mask, count, *, lam, solver,
                  reg_solve_algo):
+    _TRACES[0] += 1
     return als_half_step(
         fixed, neighbor_idx, rating, mask, count, lam,
         solver=solver, reg_solve_algo=reg_solve_algo,
@@ -70,6 +83,7 @@ def _padded_fold(fixed, neighbor_idx, rating, mask, count, *, lam, solver,
 )
 def _tiled_fold(fixed, blk, *, chunks, entities, lam, solver, fused_epilogue,
                 in_kernel_gather, reg_solve_algo):
+    _TRACES[0] += 1
     return tiled_half_step(
         fixed, blk, chunks, entities, lam, solver=solver,
         fused_epilogue=fused_epilogue, in_kernel_gather=in_kernel_gather,

@@ -132,6 +132,7 @@ class StreamSession:
         preemption_guard=None,
         policy: RecoveryPolicy | None = None,
     ) -> None:
+        from cfk_tpu.config import enable_compile_cache
         from cfk_tpu.utils.metrics import Metrics
 
         if manager is None:
@@ -140,6 +141,10 @@ class StreamSession:
                 "cursor commits atomically with the factors, so a durable "
                 "store is not optional"
             )
+        # Before the first compile (ISSUE 13): a warm persistent cache is
+        # what makes a cold fold-in process skip the re-COMPILE half of
+        # the per-batch trace bound; prewarm() covers the trace half.
+        enable_compile_cache(getattr(config, "compile_cache_dir", None))
         self.dataset = dataset
         self.config = config
         self.transport = transport
@@ -402,6 +407,94 @@ class StreamSession:
                 )))
             self.metrics.incr("health_checks")
         return rows, word
+
+    def prewarm(self, *, max_touched: int | None = None,
+                max_width: int | None = None) -> dict:
+        """Trace the fold-in pow2 bucket grid up front (ISSUE 13).
+
+        The solve shapes a live stream produces are bounded: touched
+        users bucket to ``_pow2_ceil(t, 8)`` up to ``batch_records`` and
+        rectangle widths to pow2 multiples of ``pad_multiple`` up to the
+        heaviest neighbor list.  Walking that grid once with synthetic
+        zero batches compiles every program a cold process would
+        otherwise trace mid-stream — the ROADMAP-measured fold-in bound
+        ("per-batch jit re-trace dominates") paid at startup instead of
+        against live updates (and not at all on a warm restart when
+        ``ALSConfig.compile_cache_dir`` is wired — the persistent cache
+        serves each compile).  Results are discarded; the jit cache keys
+        on shapes, so the stream's bits are untouched.
+
+        Covers the PADDED fold layout (the micro-batch default).  Tiled
+        fold-in block statics are data-dependent (chunk cuts follow the
+        batch's actual neighbor lists), so a tiled-layout session
+        returns ``{"skipped": ...}`` — its first-batch compile is
+        bounded by the compile cache instead.
+
+        Returns ``{"programs", "new_traces", "prewarm_s"}``; serving a
+        first real batch inside the warmed grid afterwards traces
+        nothing (``tests/test_staging.py`` pins it)."""
+        import time as _time
+
+        from cfk_tpu.streaming.foldin import _pow2_ceil, trace_count
+
+        t0 = _time.time()
+        if self._layout != "padded":
+            note = ("skipped: tiled fold-in block statics are "
+                    "data-dependent; rely on compile_cache_dir")
+            self.metrics.note("prewarm", note)
+            return {"programs": 0, "new_traces": 0, "prewarm_s": 0.0,
+                    "skipped": note}
+        mt = max(int(max_touched or self.stream.batch_records), 1)
+        if max_width is None:
+            counts = np.asarray(self.dataset.user_blocks.count)
+            max_width = max(int(counts.max()) if counts.size else 1, 1)
+        pm = max(self.config.pad_multiple, 1)
+        widths = []
+        p = _pow2_ceil(1, pm)
+        while True:
+            widths.append(p)
+            if p >= max_width:
+                break
+            p *= 2
+        ents = []
+        e = _pow2_ceil(1, 8)
+        while True:
+            ents.append(e)
+            if e >= mt:
+                break
+            e *= 2
+        before = trace_count()
+        programs = 0
+        num_m = int(self._m.shape[0])
+        for e in ents:
+            for p in widths:
+                # One user at the full width pins the rectangle to
+                # exactly (e, p); movie rows are valid table rows,
+                # ratings zero — the solved values are discarded.
+                wide = (np.minimum(np.arange(p), num_m - 1)
+                        .astype(np.int32),
+                        np.zeros(p, np.float32))
+                thin = (np.zeros(1, np.int32), np.zeros(1, np.float32))
+                fold_in_rows(
+                    self._m, [wide] + [thin] * (e - 1),
+                    lam=self._overrides.lam,
+                    solver=self.config.solver,
+                    layout="padded",
+                    pad_multiple=self.config.pad_multiple,
+                    fused_epilogue=self._overrides.fused_epilogue,
+                    in_kernel_gather=self.config.in_kernel_gather,
+                    reg_solve_algo=self._overrides.reg_solve_algo,
+                )
+                programs += 1
+        out = {
+            "programs": programs,
+            "new_traces": trace_count() - before,
+            "prewarm_s": round(_time.time() - t0, 4),
+        }
+        self.metrics.gauge("prewarm_programs", programs)
+        self.metrics.gauge("prewarm_new_traces", out["new_traces"])
+        self.metrics.gauge("prewarm_s", out["prewarm_s"])
+        return out
 
     def _commit(self, note: str | None = None) -> None:
         meta = {

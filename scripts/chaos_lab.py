@@ -912,6 +912,142 @@ def scenario_offload_window_sharded() -> dict:
     return row
 
 
+def scenario_staging_pool() -> dict:
+    """ISSUE 13: faults INSIDE the pooled host staging engine.
+
+    Four drills on a 2-shard stream-tiled dataset, all with
+    ``staging="pool"`` against the serial engine's fault-free crc (which
+    itself must equal the pooled fault-free crc — the pooled == serial
+    contract that makes the recoveries meaningful):
+
+    1. ``straggler``: ``SlowHostFetch(only_shard=1)`` delays one shard's
+       staging inside pool workers.  The other shard's windows keep
+       staging (``pool_peak_inflight >= 2`` proves concurrent staging
+       around the straggler), the half-iteration barrier holds, and the
+       factors drift zero bits.
+    2. ``nan``: a pool WORKER stages a NaN-poisoned window (the fault
+       must fire on a ``cfk-stage-*`` thread — pinned via ``fired_in``).
+       The factor sentinel trips and the ladder recovers crc-exact.
+    3. ``torn``: finite-wrong bytes staged by a worker; the per-shard
+       staging crc32 contract catches it BEFORE any kernel consumes it
+       (the ``WindowIntegrityError`` propagates from the worker through
+       ``WindowStager.take`` — not a hang), rollback + replay crc-exact.
+    4. ``crash``: ``StagingCrash`` raises an arbitrary exception inside
+       a worker; it must surface as the run's error, not hang the pool.
+    """
+    import dataclasses as _dc
+    import zlib
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.resilience.faults import (
+        HostWindowCorruption,
+        SlowHostFetch,
+        StagingCrash,
+        WindowFaultInjector,
+    )
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(60, 30, 900, seed=0), num_shards=2,
+        layout="tiled", chunk_elems=512, tile_rows=16,
+        accum_max_entities=0,
+    )
+    cfg = _dc.replace(_base_cfg(num_shards=2), layout="tiled",
+                      solver="pallas")
+
+    def crc(model):
+        return zlib.crc32(np.asarray(
+            model.user_factors, np.float32
+        ).tobytes())
+
+    serial_crc = crc(train_als_host_window(ds, cfg, chunks_per_window=2,
+                                           staging="serial"))
+    base = train_als_host_window(ds, cfg, chunks_per_window=2,
+                                 staging="pool")
+    base_rmse, base_crc = _rmse(base, ds), crc(base)
+
+    # Drill 1: straggler shard — purely timing, zero drift, others
+    # proceed (peak in-flight staging >= 2 while shard 1 sleeps).
+    slow = WindowFaultInjector(
+        SlowHostFetch(delay_s=0.004, every=1, only_shard=1),
+    )
+    m1 = Metrics()
+    rec1 = train_als_host_window(ds, cfg, chunks_per_window=2,
+                                 staging="pool", metrics=m1,
+                                 window_faults=slow,
+                                 verify_windows=False)
+    crc1 = crc(rec1)
+    peak = m1.gauges.get("offload_pool_peak_inflight", 0)
+
+    # Drill 2: NaN window staged BY A POOL WORKER — sentinel path.
+    nan_fault = HostWindowCorruption(iteration=1, side="m", window=0,
+                                     kind="nan", shard=1)
+    inj2 = WindowFaultInjector(nan_fault)
+    m2 = Metrics()
+    rec2 = train_als_host_window(ds, cfg, chunks_per_window=2,
+                                 staging="pool", metrics=m2,
+                                 window_faults=inj2, verify_windows=False)
+    crc2 = crc(rec2)
+    nan_in_worker = any(t.startswith("cfk-stage")
+                        for t in nan_fault.fired_in)
+
+    # Drill 3: torn window staged by a worker — the staging crc32
+    # contract catches it pre-kernel; the WindowIntegrityError crosses
+    # the pool boundary as the staging error.
+    torn_fault = HostWindowCorruption(iteration=1, side="u", window=0,
+                                      kind="torn", shard=0)
+    inj3 = WindowFaultInjector(torn_fault)
+    m3 = Metrics()
+    rec3 = train_als_host_window(ds, cfg, chunks_per_window=2,
+                                 staging="pool", metrics=m3,
+                                 window_faults=inj3)
+    crc3 = crc(rec3)
+    torn_in_worker = any(t.startswith("cfk-stage")
+                         for t in torn_fault.fired_in)
+    torn_detected = m3.counters.get("health_trips", 0) >= 1
+
+    # Drill 4: a worker exception propagates as the staging error.
+    crash = StagingCrash(iteration=0, side="m", window=0,
+                         message="chaos: staging crash drill")
+    crashed = False
+    try:
+        train_als_host_window(ds, cfg, chunks_per_window=2,
+                              staging="pool",
+                              window_faults=WindowFaultInjector(crash))
+    except RuntimeError as e:
+        crashed = "staging crash drill" in str(e)
+    crash_in_worker = any(t.startswith("cfk-stage")
+                          for t in crash.fired_in)
+
+    for extra in (m2, m3):
+        for k_, v in extra.counters.items():
+            m1.counters[k_] = m1.counters.get(k_, 0) + v
+    row = _row(
+        "staging_pool",
+        fired=(slow.fired + nan_fault.fired + torn_fault.fired
+               + crash.fired),
+        metrics=m1, base_rmse=base_rmse, rec_rmse=_rmse(rec2, ds),
+        ok_extra=(
+            base_crc == serial_crc
+            and crc1 == base_crc and crc2 == base_crc
+            and crc3 == base_crc
+            and peak >= 2 and nan_in_worker and torn_in_worker
+            and torn_detected and crashed and crash_in_worker
+        ),
+    )
+    row["pooled_equals_serial"] = bool(base_crc == serial_crc)
+    row["straggler_bit_exact"] = bool(crc1 == base_crc)
+    row["straggler_pool_peak_inflight"] = int(peak)
+    row["nan_from_worker_bit_exact"] = bool(crc2 == base_crc)
+    row["nan_fired_in_worker"] = nan_in_worker
+    row["torn_from_worker_bit_exact"] = bool(crc3 == base_crc)
+    row["torn_fired_in_worker"] = torn_in_worker
+    row["worker_exception_propagated"] = crashed
+    return row
+
+
 def scenario_serve_under_foldin() -> dict:
     """ISSUE 8: serving stays correct while streaming fold-in commits land
     concurrently.  A RecommendServer thread answers a continuous request
@@ -1085,6 +1221,7 @@ SCENARIOS = {
     "plan_fallback": scenario_plan_fallback,
     "offload_window": scenario_offload_window,
     "offload_window_sharded": scenario_offload_window_sharded,
+    "staging_pool": scenario_staging_pool,
 }
 
 

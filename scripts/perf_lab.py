@@ -202,6 +202,29 @@ def make_parser() -> argparse.ArgumentParser:
                    "sharded bit-exactness proof")
     p.add_argument("--offload-budget-mb", type=float, default=None,
                    help="artificial device budget (MB) for window sizing")
+    p.add_argument("--staging", default=None,
+                   choices=[None, "serial", "pool"],
+                   help="host staging engine A/B axis of the "
+                   "host_window tier (ISSUE 13): 'pool' (the config "
+                   "default) overlaps every shard's window staging — "
+                   "store gather, host quantize, checksum, device_put — "
+                   "on a bounded thread pool across shards AND windows; "
+                   "'serial' pins the PR 10/11 one-thread double buffer "
+                   "(the baseline arm).  crc equality across the axis "
+                   "is pinned by the tier-1 smoke; the row records pool "
+                   "depth, staged MB/s, the overlap-hidden fraction, "
+                   "trace_count, and time_to_first_step_s")
+    p.add_argument("--staging-pool-depth", type=int, default=None,
+                   help="windows staged ahead of consumption (pool "
+                   "mode); clamped so depth+1 worst windows fit the "
+                   "window budget")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="persistent jax compilation cache (ISSUE 13), "
+                   "keyed per device fingerprint: a second lab run "
+                   "against the same DIR skips the XLA compiles behind "
+                   "its traces — compare the rows' "
+                   "time_to_first_step_s/compile wall to measure the "
+                   "warm-start win")
     p.add_argument("--plan", default=None,
                    choices=[None, "model", "autotune", "pinned"],
                    help="execution-planner axis (cfk_tpu.plan, ISSUE 9): "
@@ -515,6 +538,7 @@ def run_offload_lab(args) -> dict:
         # silently re-plan onto host_window (the same mislabeling guard
         # as bench.py's scale sweep).
         offload_tier=args.offload,
+        compile_cache_dir=args.compile_cache_dir,
     )
     metrics = Metrics()
     budget = (args.offload_budget_mb * 1e6
@@ -543,6 +567,8 @@ def run_offload_lab(args) -> dict:
                 ds, c, metrics=metrics,
                 chunks_per_window=args.offload_window_chunks,
                 device_budget_bytes=budget,
+                staging=args.staging,
+                pool_depth=args.staging_pool_depth,
             )
         if shards > 1:
             from cfk_tpu.parallel.spmd import train_als_sharded
@@ -563,6 +589,11 @@ def run_offload_lab(args) -> dict:
     model = run()
     compile_s = time.time() - t0
     print(f"# first call (compile+run): {compile_s:.2f}s", flush=True)
+    # Cold-start columns from the FIRST call (later calls overwrite the
+    # shared metrics with warm numbers): how long until the first full
+    # iteration landed, and how many windowed-driver programs it traced.
+    cold_first_step_s = metrics.gauges.get("time_to_first_step_s")
+    cold_trace_count = metrics.gauges.get("offload_trace_count")
     run(cfg1)
     t_n, t_1 = [], []
     for _ in range(args.repeats):
@@ -602,6 +633,27 @@ def run_offload_lab(args) -> dict:
     }
     if args.offload == "host_window":
         row.update({
+            # Staging-engine columns (ISSUE 13) — all read from the
+            # driver's HOST-side gauges, never a donated device array
+            # (the measure_steps on_call guard, extended to this axis:
+            # the windowed driver donates its ring accumulators and, on
+            # TPU, the staged table pair, so row assembly must consume
+            # only the metrics the driver exported).
+            "staging": metrics.notes.get("offload_staging"),
+            "pool_depth": metrics.gauges.get("offload_pool_depth"),
+            "pool_peak_inflight": metrics.gauges.get(
+                "offload_pool_peak_inflight"
+            ),
+            "stage_busy_s": metrics.gauges.get("offload_stage_busy_s"),
+            "stage_stall_s": metrics.gauges.get("offload_stage_stall_s"),
+            "staged_mb_per_s": metrics.gauges.get(
+                "offload_staged_mb_per_s"
+            ),
+            "overlap_hidden_fraction": metrics.gauges.get(
+                "offload_stage_hidden_frac"
+            ),
+            "trace_count": cold_trace_count,
+            "time_to_first_step_s": cold_first_step_s,
             "windows_m": metrics.gauges.get("offload_windows_m"),
             "windows_u": metrics.gauges.get("offload_windows_u"),
             "window_rows_m": metrics.gauges.get("offload_window_rows_m"),

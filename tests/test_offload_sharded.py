@@ -475,6 +475,72 @@ def test_pre_ici_group_autotune_cache_misses(tmp_path, monkeypatch):
     assert prov.cache == "miss"
 
 
+# --- pooled vs serial staging (ISSUE 13) -------------------------------------
+
+
+def _pool_serial_crc(ds, cfg, cpw, depth=None):
+    a = _crc(train_als_host_window(ds, cfg, chunks_per_window=cpw,
+                                   staging="serial"))
+    b = _crc(train_als_host_window(ds, cfg, chunks_per_window=cpw,
+                                   staging="pool", pool_depth=depth))
+    return a, b
+
+
+def test_pooled_staging_crc_identity_fast_representatives(corpus,
+                                                          stream_ds2,
+                                                          ring_ds4):
+    # One fast representative per knob pair (the exhaustive matrix is
+    # slow-marked below): staging order must never change consumption
+    # order, so pooled == serial bit-for-bit.
+    # (a) single shard, stream scan, int8 staging
+    ds1 = Dataset.from_coo(corpus, layout="tiled", tile_rows=16,
+                           chunk_elems=512, accum_max_entities=0)
+    cfg1 = ALSConfig(rank=8, lam=0.05, num_iterations=2, seed=3,
+                     layout="tiled", table_dtype="int8")
+    a, b = _pool_serial_crc(ds1, cfg1, 2)
+    assert a == b
+    # (b) 2 shards, all_gather windows, bf16 tables, deep pool
+    cfg2 = ALSConfig(rank=8, lam=0.05, num_iterations=2, seed=3,
+                     num_shards=2, layout="tiled", table_dtype="bfloat16")
+    a, b = _pool_serial_crc(stream_ds2, cfg2, 3, depth=8)
+    assert a == b
+    # (c) 4 shards, hier_ring visit schedule (ici_group=2), f32
+    cfg3 = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=3,
+                     num_shards=4, layout="tiled", exchange="hier_ring",
+                     ici_group=2)
+    a, b = _pool_serial_crc(ring_ds4, cfg3, 2)
+    assert a == b
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shards,exchange,ici", [
+    (1, "all_gather", None),
+    (2, "all_gather", None),
+    (4, "all_gather", None),
+    (4, "ring", None),
+    (4, "hier_ring", 2),
+    (4, "hier_ring", 4),
+])
+@pytest.mark.parametrize("table_dtype", ["float32", "bfloat16", "int8"])
+@pytest.mark.parametrize("cpw", [1, 3])
+def test_pooled_staging_crc_identity_matrix(corpus, shards, exchange, ici,
+                                            table_dtype, cpw):
+    # The exhaustive pooled-vs-serial identity: shard count × exchange/
+    # ici_group × table dtype × window size.  Combined with the
+    # windowed==resident matrix above, this closes the chain
+    # pool == serial == resident shard_map.
+    ring = exchange in ("ring", "hier_ring")
+    build_kw = dict(ring=True, ring_warn=False) if ring \
+        else dict(accum_max_entities=0)
+    ds = Dataset.from_coo(corpus, num_shards=shards, layout="tiled",
+                          tile_rows=16, chunk_elems=512, **build_kw)
+    cfg = ALSConfig(rank=4, lam=0.05, num_iterations=2, seed=3,
+                    num_shards=shards, layout="tiled", exchange=exchange,
+                    ici_group=ici, table_dtype=table_dtype)
+    a, b = _pool_serial_crc(ds, cfg, cpw)
+    assert a == b, (shards, exchange, ici, table_dtype, cpw)
+
+
 # --- shard-targeted faults --------------------------------------------------
 
 

@@ -32,6 +32,7 @@ def _args(**over):
         serve_tile_m=512,
         offload=None, offload_window_chunks=4, offload_budget_mb=None,
         offload_shards=1,
+        staging=None, staging_pool_depth=None, compile_cache_dir=None,
         plan=None, plan_cache=None,
         iters=2, repeats=3, profile_dir=None,
     )
@@ -246,6 +247,32 @@ def test_offload_axis_row(tmp_path, monkeypatch, capsys):
     assert win["plan_held_mb"] > 0
     # windowed == resident, bit-exact — the ISSUE 11 acceptance contract
     assert win["factors_crc32"] == dev["factors_crc32"]
+
+
+def test_offload_axis_staging_row(tmp_path, monkeypatch):
+    # The staging A/B axis (ISSUE 13): both engine modes run the SAME
+    # 2-shard host_window workload — crc equality is the pooled==serial
+    # bit-exactness proof through the lab itself, and the pool arm's row
+    # carries the engine columns (depth, hidden fraction, trace count).
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    base = dict(layout="tiled", users=200, movies=60, nnz=1500,
+                chunk_elems=512, tile_rows=16, rank=8, iters=2, repeats=2,
+                offload="host_window", offload_window_chunks=2,
+                offload_shards=2)
+    serial = perf_lab.run_lab(_args(staging="serial", **base))
+    pool = perf_lab.run_lab(_args(staging="pool", **base))
+    assert serial["staging"] == "serial" and pool["staging"] == "pool"
+    assert pool["factors_crc32"] == serial["factors_crc32"]
+    assert pool["pool_depth"] >= 1
+    assert pool["stage_busy_s"] >= 0
+    # the first (cold) arm traced the window programs; the second reuses
+    # them — the process-wide jit cache IS the re-trace bound at work
+    assert serial["trace_count"] >= 1
+    assert pool["trace_count"] == 0
+    assert pool["time_to_first_step_s"] > 0
+    # serial stages on the consuming thread: stall == busy ⇒ hidden 0
+    assert serial["overlap_hidden_fraction"] == 0.0
+    assert serial["pool_depth"] is None
 
 
 def test_offload_axis_sharded_row(tmp_path, monkeypatch):
