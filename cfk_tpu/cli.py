@@ -280,20 +280,71 @@ def _cache_sans_fingerprint(cache_dir, build_key, Dataset, ignore,
         return None
 
 
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def _telemetry_session(args, metrics=None):
+    """Wire the telemetry subsystem for one CLI command (ISSUE 14).
+
+    ``--trace-dir`` installs the host span tracer (Chrome-trace JSON
+    written at exit, colocated with ``--profile-dir``'s jax-profiler trace
+    when both point at the same directory); the flight recorder's dump
+    directory resolves to the trace dir, else the checkpoint/stream dir,
+    so any trip/escalation/eviction/crash leaves its forensic dump next to
+    the run's other artifacts; ``--metrics-jsonl`` streams periodic
+    registry snapshots for training dashboards."""
+    from cfk_tpu import telemetry
+
+    trace_dir = getattr(args, "trace_dir", None)
+    dump_dir = (trace_dir
+                or getattr(args, "checkpoint_dir", None)
+                or getattr(args, "stream_dir", None))
+    tracer = None
+    if trace_dir:
+        tracer = telemetry.configure(trace_dir=trace_dir)
+    if dump_dir:
+        telemetry.get_recorder().configure(dump_dir=dump_dir)
+        telemetry.install_crash_hooks()
+    emitter = None
+    jsonl = getattr(args, "metrics_jsonl", None)
+    if jsonl and metrics is not None:
+        emitter = telemetry.MetricsEmitter(
+            metrics, jsonl,
+            interval_s=getattr(args, "metrics_interval_s", 10.0),
+        ).start()
+    try:
+        yield
+    finally:
+        if emitter is not None:
+            emitter.stop()
+        if tracer is not None:
+            path = telemetry.shutdown(write=True)
+            if path:
+                _eprint(f"host span trace written to {path}")
+
+
 def _train(args) -> int:
+    from cfk_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    with _telemetry_session(args, metrics):
+        return _train_impl(args, metrics)
+
+
+def _train_impl(args, metrics) -> int:
     from cfk_tpu.config import ALSConfig, set_async_collective_permute
     from cfk_tpu.eval.metrics import mse_rmse_from_model
     from cfk_tpu.eval.predict import save_prediction_csv
     from cfk_tpu.models.als import train_als
     from cfk_tpu.models.ials import IALSConfig, train_ials, train_ials_sharded
-    from cfk_tpu.utils.metrics import Metrics, maybe_profile
+    from cfk_tpu.utils.metrics import maybe_profile
 
     # Must land in LIBTPU_INIT_ARGS before the first jax computation (the
     # dataset load below initializes the backend, which is when libtpu
     # reads the env on TPU; never XLA_FLAGS — CPU/GPU-only XLA aborts on
     # the unknown TPU flag).
     set_async_collective_permute(args.async_collective_permute)
-    metrics = Metrics()
     if args.layout == "auto" and args.exchange == "auto":
         # The per-half exchange builds on the tiled layout only (config
         # validation says so); resolve up front so ring blocks are built.
@@ -781,7 +832,16 @@ def _serve(args) -> int:
       against an in-memory log (--loadgen-qps/--loadgen-requests) and
       prints the measured QPS/p50/p99 row — the self-contained smoke
       (the recorded-at-scale numbers live in ``bench.py --serve``).
+
+    ``--metrics-port`` makes the server answer ``GET /metrics``
+    (Prometheus text) while it serves; ``--trace-dir`` writes the host
+    span trace (batch assemble/compute/respond timeline) at exit.
     """
+    with _telemetry_session(args):
+        return _serve_impl(args)
+
+
+def _serve_impl(args) -> int:
     import numpy as np
 
     from cfk_tpu.data.blocks import RatingsIndex
@@ -839,7 +899,10 @@ def _serve(args) -> int:
             response_partitions=args.response_partitions,
         )
         server = RecommendServer(engine, transport,
-                                 max_batch=args.max_batch)
+                                 max_batch=args.max_batch,
+                                 metrics_port=args.metrics_port)
+        if server.metrics_server is not None:
+            _eprint(f"metrics endpoint: {server.metrics_server.url}")
         _eprint(
             f"serving {ds.user_map.num_entities} users × "
             f"{ds.movie_map.num_entities} movies (rank "
@@ -850,6 +913,8 @@ def _serve(args) -> int:
             server.serve_forever()
         except KeyboardInterrupt:  # pragma: no cover - interactive
             pass
+        finally:
+            server.close()
         _eprint(f"served {server.requests_served} requests "
                 f"in {server.batches} batches")
         return 0
@@ -857,18 +922,24 @@ def _serve(args) -> int:
 
     transport = InMemoryBroker()
     ensure_serve_topics(transport)
-    server = RecommendServer(engine, transport, max_batch=args.max_batch)
+    server = RecommendServer(engine, transport, max_batch=args.max_batch,
+                             metrics_port=args.metrics_port)
+    if server.metrics_server is not None:
+        _eprint(f"metrics endpoint: {server.metrics_server.url}")
     client = ServeClient(transport)
     pool = zipf_user_rows(
         ds.user_map.num_entities, args.loadgen_requests, seed=args.seed
     )
-    warm_serve_programs(client, server, pool, args.k,
-                        min(args.max_batch, pool.shape[0]))
-    report = run_open_loop(
-        client, rate_qps=args.loadgen_qps,
-        num_requests=args.loadgen_requests, user_rows=pool, k=args.k,
-        server=server, drive_server=True,
-    )
+    try:
+        warm_serve_programs(client, server, pool, args.k,
+                            min(args.max_batch, pool.shape[0]))
+        report = run_open_loop(
+            client, rate_qps=args.loadgen_qps,
+            num_requests=args.loadgen_requests, user_rows=pool, k=args.k,
+            server=server, drive_server=True,
+        )
+    finally:
+        server.close()
     import json
 
     print(json.dumps({
@@ -992,9 +1063,29 @@ def _stream(args) -> int:
     cursor — including after a crash or an eviction SIGTERM.
     ``--produce-csv`` instead appends "user,movie,rating" lines to the
     updates topic and exits (the producer side of the loop).
-    """
-    from cfk_tpu.config import ALSConfig
+    ``--metrics-port`` serves the live registry as Prometheus text on
+    ``GET /metrics`` for the duration of the stream."""
     from cfk_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    with _telemetry_session(args, metrics):
+        http = None
+        if getattr(args, "metrics_port", None) is not None:
+            from cfk_tpu.telemetry import MetricsHTTPServer
+
+            http = MetricsHTTPServer(
+                metrics, port=args.metrics_port
+            ).start()
+            _eprint(f"metrics endpoint: {http.url}")
+        try:
+            return _stream_impl(args, metrics)
+        finally:
+            if http is not None:
+                http.stop()
+
+
+def _stream_impl(args, metrics) -> int:
+    from cfk_tpu.config import ALSConfig
 
     try:
         transport = _updates_transport(args.updates)
@@ -1041,7 +1132,6 @@ def _stream(args) -> int:
     from cfk_tpu.streaming import StreamConfig, StreamSession
     from cfk_tpu.transport.checkpoint import CheckpointManager
 
-    metrics = Metrics()
     config = ALSConfig(
         rank=args.rank,
         lam=args.lam,
@@ -1491,6 +1581,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument("--profile-dir", default=None, help="write a jax.profiler trace")
     t.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="write the host span trace (Chrome-trace JSON) here at exit; "
+        "pass the same directory as --profile-dir to line the host "
+        "timeline up with the jax-profiler device trace",
+    )
+    t.add_argument(
+        "--metrics-jsonl", default=None, metavar="PATH",
+        help="stream periodic metrics-registry snapshots (one JSON line "
+        "per interval) for live dashboards",
+    )
+    t.add_argument(
+        "--metrics-interval-s", type=float, default=10.0,
+        help="seconds between --metrics-jsonl snapshots",
+    )
+    t.add_argument(
         "--output", default="auto",
         help="'auto' = predictions/prediction_matrix_<ts>, 'none', or a path",
     )
@@ -1562,6 +1667,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "device fingerprint (ISSUE 13) — a restarted server "
                     "replays its prewarmed serve programs instead of "
                     "recompiling the batch-bucket set")
+    sv.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics (Prometheus text) on this "
+                    "port while the server runs (0 = ephemeral)")
+    sv.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="write the host span trace (batch assemble/"
+                    "compute/respond timeline) here at exit")
     sv.set_defaults(fn=_serve)
 
     pd = sub.add_parser(
@@ -1692,6 +1803,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="persistent jax compilation cache keyed per "
                     "device fingerprint — removes the cold-process "
                     "re-compile cost of the fold-in/retrain programs")
+    st.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics (Prometheus text) on this "
+                    "port while the stream runs (0 = ephemeral)")
+    st.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="write the host span trace (stream batch stage/"
+                    "solve/probe/commit timeline) here at exit")
     st.add_argument("--no-eval", action="store_true",
                     help="skip the merged-state RMSE evaluation at exit")
     st.add_argument("--dataset-cache", default=None)

@@ -618,8 +618,15 @@ def train_als(
                or preemption_guard is not None or watchdog is not None
                or warm_start is not None)
     if not stepped:
+        from cfk_tpu.telemetry import record_event, span
+
         train_s_before = metrics.phases.get("train", 0.0)
-        with metrics.phase("train"):
+        # ONE span for the whole fused fori_loop: the iterations live
+        # inside a single jit, so per-iteration host spans exist only on
+        # the stepped path (resilience/loop.py) — the device-side
+        # breakdown is the jax-profiler trace's job (same --trace-dir).
+        with metrics.phase("train"), \
+                span("train/fused_loop", iters=config.num_iterations):
             out = _train_loop(
                 key,
                 mblocks,
@@ -654,6 +661,8 @@ def train_als(
             report = report_from_carry(out[2], u, m)
         if report is None or report.healthy:
             metrics.incr("iterations", config.num_iterations)
+            record_event("train", "fused_loop_done",
+                         iters=config.num_iterations)
         else:
             import warnings
 

@@ -334,8 +334,13 @@ def train_ials(
     stepped = (checkpoint_manager is not None or fault_injector is not None
                or preemption_guard is not None or watchdog is not None)
     if not stepped:
+        from cfk_tpu.telemetry import record_event, span
+
         train_s_before = metrics.phases.get("train", 0.0)
-        with metrics.phase("train"):
+        # One span per fused fori_loop — see models/als.py (per-iteration
+        # host spans live on the stepped path only).
+        with metrics.phase("train"), \
+                span("train/fused_loop", iters=config.num_iterations):
             out = _train_loop(
                 key,
                 mblocks,
@@ -370,6 +375,8 @@ def train_ials(
             report = report_from_carry(out[2], u, m)
         if report is None or report.healthy:
             metrics.incr("iterations", config.num_iterations)
+            record_event("train", "fused_loop_done",
+                         iters=config.num_iterations)
         else:
             import warnings
 

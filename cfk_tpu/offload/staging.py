@@ -60,6 +60,9 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from cfk_tpu.telemetry import record_event, span
+from cfk_tpu.telemetry.recorder import dump_flight
+
 # Staged-ahead windows beyond the one being consumed.  The driver clamps
 # this by the window budget (depth + 1 windows must fit the staging
 # share) and by the task count; 4 keeps four shards' first windows in
@@ -185,7 +188,14 @@ class WindowStager:
         stats_max(self._stats, "pool_peak_inflight", peak)
         t0 = time.perf_counter()
         try:
-            out = self._fn(shard, key)
+            # The worker span carries thread (implicit) + (shard, window)
+            # ids, so pool overlap against the consuming compute spans is
+            # VISIBLE in the trace; its duration is exactly the interval
+            # stage_busy_s meters, which is what lets the trace-recomputed
+            # overlap fraction agree with the driver's gauge.
+            with span("train/iter/half_step/window_stage",
+                      shard=shard, window=key, mode=self.mode):
+                out = self._fn(shard, key)
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -220,22 +230,38 @@ class WindowStager:
         if i >= len(self._tasks):
             raise IndexError("WindowStager exhausted: every task taken")
         self._next_take += 1
+        shard, key = self._tasks[i]
         if self._pool is None:
             # Serial: the whole staging occupies the consuming thread —
             # stall == busy by construction, which is what makes the
             # overlap_hidden_fraction column read 0 for the baseline arm.
             t0 = time.perf_counter()
-            out = self._run(i)
+            try:
+                with span("train/iter/half_step/window_wait",
+                          shard=shard, window=key, mode=self.mode):
+                    out = self._run(i)
+            except BaseException as e:
+                record_event("fault", "staging_error", shard=shard,
+                             window=key, error=f"{type(e).__name__}: {e}")
+                dump_flight("staging_error")
+                raise
             stats_add(self._stats, "stage_stall_s",
                       time.perf_counter() - t0)
             return out
         fut = self._futures.pop(i)
         t0 = time.perf_counter()
         try:
-            out = fut.result()
-        except BaseException:
+            with span("train/iter/half_step/window_wait",
+                      shard=shard, window=key, mode=self.mode):
+                out = fut.result()
+        except BaseException as e:
             # Propagate as the staging error — never leave workers
             # running against a store the caller is about to roll back.
+            # Flight-record first: a staging-worker death is exactly the
+            # incident the ring buffer exists to explain.
+            record_event("fault", "staging_error", shard=shard, window=key,
+                         error=f"{type(e).__name__}: {e}")
+            dump_flight("staging_error")
             self.close()
             raise
         stats_add(self._stats, "stage_stall_s",

@@ -78,6 +78,8 @@ from cfk_tpu.offload.window import (
     build_ring_window_plan,
     build_window_plan,
 )
+from cfk_tpu.telemetry import record_event, span
+from cfk_tpu.telemetry.recorder import dump_flight
 
 # Trace counter for the windowed driver's jits: the bodies below bump it
 # once per TRACE (python side effects run only while tracing), so the
@@ -442,17 +444,21 @@ def windowed_half_step(
             # THEN take window w+1 — a serial stager runs the host gather
             # + device_put HERE, under the dispatched compute (the PR 10
             # double buffer); a pooled stager usually has it already
-            # staged by a worker — and only then join w's result.
-            xs = _window_half_jit()(
-                *staged, statics=wplan.statics, lam=float(lam),
-                solver=solver, overlap=overlap,
-                fused_epilogue=fused_epilogue,
-                in_kernel_gather=in_kernel_gather,
-                reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
-                out_dtype=out_dtype,
-            )
-            nxt = stager.take() if w + 1 < n_w else None
-            xs_np = np.asarray(xs)
+            # staged by a worker — and only then join w's result.  The
+            # compute span covers dispatch → join, so a pooled staging
+            # worker's window_stage span visibly overlaps it.
+            with span("train/iter/half_step/window_compute",
+                      side=side, shard=shard, window=w):
+                xs = _window_half_jit()(
+                    *staged, statics=wplan.statics, lam=float(lam),
+                    solver=solver, overlap=overlap,
+                    fused_epilogue=fused_epilogue,
+                    in_kernel_gather=in_kernel_gather,
+                    reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
+                    out_dtype=out_dtype,
+                )
+                nxt = stager.take() if w + 1 < n_w else None
+                xs_np = np.asarray(xs)
             ent = wplan.chunk_entity_of(w)
             real = ent < wplan.local_entities
             out[ent[real]] = xs_np[real]
@@ -519,21 +525,29 @@ def ring_windowed_half_step(
             # next visit's window under it — the inner-ICI-rotation
             # overlap of the resident hier ring, one level up.  The
             # donated carry rebinds; nothing may read the pre-call pair.
-            acc_a, acc_b = _ring_window_jit()(
-                acc_a, acc_b, *staged,
-                statics=(rplan.window_chunks, cap, t, e_c),
-                backend=backend, gather=gather, int8=int8,
-            )
-            staged = (stager.take() if i + 1 < len(schedule) else None)
+            # The ring_visit span is the exchange-phase timeline: visit
+            # order IS the block-delivery order the resident ring/hier
+            # ring would rotate, so the trace shows each phase's staging
+            # (window residual — the DCN-hop payload) against compute.
+            with span("train/iter/half_step/ring_visit",
+                      side=side, shard=shard, visit=i, window=w):
+                acc_a, acc_b = _ring_window_jit()(
+                    acc_a, acc_b, *staged,
+                    statics=(rplan.window_chunks, cap, t, e_c),
+                    backend=backend, gather=gather, int8=int8,
+                )
+                staged = (stager.take() if i + 1 < len(schedule) else None)
     finally:
         if own:
             stager.close()
-    x = _ring_solve_jit(
-        acc_a, acc_b, jax.numpy.asarray(count_local), local=local,
-        lam=float(lam), solver=solver, fused_epilogue=fused_epilogue,
-        reg_solve_algo=reg_solve_algo, out_dtype=out_dtype,
-    )
-    return np.asarray(x)
+    with span("train/iter/half_step/ring_solve", side=side, shard=shard):
+        x = _ring_solve_jit(
+            acc_a, acc_b, jax.numpy.asarray(count_local), local=local,
+            lam=float(lam), solver=solver, fused_epilogue=fused_epilogue,
+            reg_solve_algo=reg_solve_algo, out_dtype=out_dtype,
+        )
+        x = np.asarray(x)
+    return x
 
 
 def _resolve_side_modes(dataset, config: ALSConfig
@@ -952,14 +966,17 @@ def train_als_host_window(
                           fused_epilogue=ov.fused_epilogue,
                           reg_solve_algo=algo, iteration=it, side=side,
                           shard=d, stager=stager)
-                if ring:
-                    rows = ring_windowed_half_step(
-                        fixed_store, plans[d],
-                        visits=hier_visit_order(s, inner, d),
-                        count_local=counts[d], **kw,
-                    )
-                else:
-                    rows = windowed_half_step(fixed_store, plans[d], **kw)
+                with span("train/iter/half_step", side=side, shard=d,
+                          ring=bool(ring), iteration=it):
+                    if ring:
+                        rows = ring_windowed_half_step(
+                            fixed_store, plans[d],
+                            visits=hier_visit_order(s, inner, d),
+                            count_local=counts[d], **kw,
+                        )
+                    else:
+                        rows = windowed_half_step(fixed_store, plans[d],
+                                                  **kw)
                 out[d * local:(d + 1) * local] = rows
         finally:
             stager.close()
@@ -989,14 +1006,24 @@ def train_als_host_window(
         trips += 1
         metrics.incr("health_trips")
         metrics.note(f"health_trip_{trips}", f"iteration {it}: {reason}")
+        # Flight-record + dump: the ring buffer holds the window/half
+        # events of the iterations leading here — the forensic timeline
+        # every chaos offload scenario asserts on.
+        record_event("fault", "health_trip", iteration=it, trip=trips,
+                     reason=reason)
+        dump_flight(f"health_trip_{trips}")
         if trips > policy.max_recoveries:
             detail = (
                 f"recovery exhausted after {policy.max_recoveries} "
                 f"trips; last: {reason}"
             )
             if policy.on_unrecoverable == "raise":
+                record_event("fault", "unrecoverable", detail=detail)
+                dump_flight("unrecoverable")
                 raise TrainingDivergedError(detail)
             metrics.note("degraded", detail)
+            record_event("fault", "degraded", detail=detail)
+            dump_flight("degraded")
             u_store, m_store = snap
             it = snap_iter
             return False
@@ -1012,6 +1039,7 @@ def train_als_host_window(
         if new_ov != ov:
             metrics.gauge("escalation_level", trips)
             metrics.note(f"escalation_{trips}", detail)
+            record_event("fault", "escalation", rung=trips, detail=detail)
         ov = new_ov
         if plan_provenance is not None:
             t = plan_provenance.record_transition(
@@ -1023,12 +1051,14 @@ def train_als_host_window(
     with metrics.phase("train"):
         while it < config.num_iterations:
             try:
-                m_new = half("m", u_store, m_plans, m_local, count_m, it,
-                             ring_m)
-                m_store.write_range(0, m_new)
-                u_new = half("u", m_store, u_plans, u_local, count_u, it,
-                             ring_u)
-                u_store.write_range(0, u_new)
+                with span("train/iter", i=it, tier="host_window"):
+                    m_new = half("m", u_store, m_plans, m_local, count_m,
+                                 it, ring_m)
+                    m_store.write_range(0, m_new)
+                    u_new = half("u", m_store, u_plans, u_local, count_u,
+                                 it, ring_u)
+                    u_store.write_range(0, u_new)
+                record_event("train", "iter", i=it, tier="host_window")
             except WindowIntegrityError as e:
                 # The staging checksum caught a torn/corrupt window BEFORE
                 # it reached a kernel; the store is intact, so rollback +

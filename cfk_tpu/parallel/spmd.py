@@ -1154,6 +1154,18 @@ def _config_under_plan(config, exec_plan):
     )
 
 
+def _snapshot_to_host(u, m, **attrs):
+    """Allgather-to-host under a ``train/host_gather`` span — the
+    expensive host-side edge of a sharded save/snapshot cadence.  The
+    resilient loop's ``snapshot_fn`` seam calls it bare; ``save_fn``
+    passes ``what="save"``/``i=`` attrs."""
+    from cfk_tpu.telemetry import span as _span
+
+    attrs.setdefault("what", "snapshot")
+    with _span("train/host_gather", **attrs):
+        return to_host(u), to_host(m)
+
+
 def _sharded_resilient_loop(
     manager, *, model, dataset, config, mesh, dtype, init_fn, make_raw_step,
     mtree, utree, metrics, checkpoint_every, health, fault_injector,
@@ -1202,7 +1214,7 @@ def _sharded_resilient_loop(
         # but only process 0 writes the checkpoint dir — async, so the
         # step loop never waits for serialize+fsync+rename.  The gathered
         # pair doubles as the resilient loop's rollback anchor.
-        uh, mh = to_host(u), to_host(m)
+        uh, mh = _snapshot_to_host(u, m, i=done, what="save")
         if jax.process_index() == 0:
             meta = save_meta
             if plan_provenance is not None:
@@ -1246,7 +1258,7 @@ def _sharded_resilient_loop(
         health=health,
         policy=policy_from_config(config),
         fault_injector=fault_injector,
-        snapshot_fn=lambda u, m: (to_host(u), to_host(m)),
+        snapshot_fn=_snapshot_to_host,
         restore_fn=restore_fn,
         save_fn=save_fn,
         resume_fn=resume_fn,

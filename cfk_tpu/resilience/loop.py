@@ -35,6 +35,8 @@ from cfk_tpu.resilience.policy import (
     RecoveryPolicy,
     TrainingDivergedError,
 )
+from cfk_tpu.telemetry import record_event, span
+from cfk_tpu.telemetry.recorder import dump_flight
 
 
 def validate_cadence(checkpoint_every: int, health=None) -> None:
@@ -266,10 +268,11 @@ def _run_loop_body(
     while i < num_iterations:
         if fault_injector is not None:
             u, m = fault_injector.before_step(i, u, m)
-        with metrics.phase("train"):
+        with metrics.phase("train"), span("train/iter", i=i):
             out = step(u, m)
             u, m, ring_bad = out if len(out) == 3 else (*out, None)
             u.block_until_ready()
+        record_event("train", "iter", i=i)
         if ring_bad is not None:
             # Accumulate EVERY step's exchange flag (a ready int32 scalar
             # — block_until_ready already synced) so a corrupt in-flight
@@ -303,7 +306,8 @@ def _run_loop_body(
         word = 0
         if probing:
             # Save points force a probe so a bad state is never committed.
-            with metrics.phase("health_check"):
+            with metrics.phase("health_check"), \
+                    span("train/health_probe", i=done):
                 word = int(np.asarray(probe(u, m)))
                 if ring_pending:
                     word |= _sentinel.RING_EXCHANGE
@@ -318,13 +322,17 @@ def _run_loop_body(
             # the recovery ladder, and a bad state must never be committed
             # — return the last-good factors and leave the store's newest
             # committed (healthy) step as the resume point.
+            probe_summary = _sentinel.HealthReport(done, word, {}).summary()
+            record_event("fault", "evicted_unhealthy", iteration=done,
+                         reason=evict_reason, probe=probe_summary)
+            dump_flight("evicted_unhealthy")
             anchor, (u, m) = rollback()
             metrics.gauge("preempted", 1)
             metrics.gauge("trained_iterations", anchor)
             metrics.note(
                 "preempted",
                 f"{evict_reason} at iteration {done} with a tripped "
-                f"health probe ({_sentinel.HealthReport(done, word, {}).summary()}); "
+                f"health probe ({probe_summary}); "
                 f"returning last-good factors from iteration {anchor}",
             )
             return u, m
@@ -336,6 +344,13 @@ def _run_loop_body(
             reports.append(report)
             metrics.incr("health_trips")
             metrics.note(f"health_trip_{trips}", report.summary())
+            # Flight-record + dump before any recovery action: the ring
+            # buffer's tail is the timeline of the iterations that led
+            # into this trip (the chaos scenarios assert the dump's final
+            # events name the fault).
+            record_event("fault", "health_trip", iteration=done,
+                         trip=trips, reason=report.summary())
+            dump_flight(f"health_trip_{trips}")
             if trips > policy.max_recoveries:
                 msg = (
                     f"health sentinel tripped {trips} times "
@@ -343,8 +358,12 @@ def _run_loop_body(
                     f"{report.summary()}"
                 )
                 if policy.on_unrecoverable == "raise":
+                    record_event("fault", "unrecoverable", detail=msg)
+                    dump_flight("unrecoverable")
                     raise TrainingDivergedError(msg, reports)
                 anchor, (u, m) = rollback()
+                record_event("fault", "degraded", detail=msg)
+                dump_flight("degraded")
                 metrics.gauge("degraded", 1)
                 metrics.gauge("trained_iterations", anchor)
                 metrics.note(
@@ -376,6 +395,11 @@ def _run_loop_body(
                     detail += (
                         "; " + _plan_registry.REGISTRY.availability_summary()
                     )
+                record_event(
+                    "fault",
+                    "escalation" if escalated else "backend_outage",
+                    rung=trips, detail=detail,
+                )
                 overrides = new_overrides
                 if escalated:
                     # escalation_* accounting means "a recovery rung
@@ -412,7 +436,8 @@ def _run_loop_body(
             continue
         host_pair = None
         if saving:
-            with metrics.phase("checkpoint"):
+            with metrics.phase("checkpoint"), \
+                    span("train/checkpoint", i=done):
                 # save_fn returns the host copies it gathered so the
                 # rollback anchor below reuses them instead of paying
                 # a second device→host gather per save point.
@@ -436,6 +461,9 @@ def _run_loop_body(
             # the forced save point); drain the writer so it is on disk
             # before this process dies, then exit resumable.
             drain_checkpoints(manager)
+            record_event("signal", "preempted", iteration=done,
+                         reason=evict_reason, committed=bool(saving))
+            dump_flight("preemption")
             metrics.gauge("preempted", 1)
             metrics.gauge("trained_iterations", done)
             metrics.note(

@@ -86,6 +86,14 @@ class PreemptionGuard:
         """Request a graceful stop as if the signal had been delivered."""
         self.signum = signum if signum is not None else self.signum
         self._event.set()
+        # Flight-record the request (trigger() is the test/chaos entry —
+        # the real signal handler stays flag-only by the async-signal-
+        # safety rule; the resilient loops record the delivery when they
+        # poll the flag at the next boundary).
+        from cfk_tpu.telemetry.recorder import record_event
+
+        record_event("signal", "preemption_requested",
+                     signal=self.signal_name)
 
     def _handler(self, signum, frame):
         if self._event.is_set():
@@ -220,6 +228,18 @@ class StallWatchdog:
                 return
 
     def _stall_exit(self) -> None:  # pragma: no cover - exercised via drills
+        try:
+            # Flight-record the stall before exiting: the dump's tail is
+            # the last iterations this process completed before its peer
+            # died (host-only work — the rule about never touching the
+            # wedged jax runtime holds).
+            from cfk_tpu.telemetry.recorder import dump_flight, record_event
+
+            record_event("fault", "stall_watchdog", last_done=self.last_done,
+                         timeout_s=self.timeout_s)
+            dump_flight("stall_watchdog")
+        except Exception:
+            pass
         try:
             if self.on_stall is not None:
                 self.on_stall(self)

@@ -61,6 +61,14 @@ def retry_call(
             return fn()
         except retry_on as e:
             last = e
+            # Flight-record each retried failure: a flaky broker's
+            # drop/backoff timeline is the forensic trail chaos_lab's
+            # flaky_broker scenario asserts on.
+            from cfk_tpu.telemetry.recorder import record_event
+
+            record_event("retry", "retryable_failure", op=describe,
+                         attempt=attempt + 1,
+                         error=f"{type(e).__name__}: {e}")
             if attempt == retries:
                 break
             sleep(next(delays))

@@ -34,6 +34,7 @@ from cfk_tpu.serving.topk_kernel import (
     build_seen_tiles,
     topk_scores_pallas,
 )
+from cfk_tpu.telemetry import span
 
 
 def pad_table(table: np.ndarray, tile_m: int, shards: int = 1) -> np.ndarray:
@@ -232,40 +233,43 @@ class ServeEngine:
         if not 1 <= k <= self.num_movies:
             raise ValueError(f"k must be in [1, {self.num_movies}], got {k}")
         b = _pow2_ceil(n, self.batch_quantum)
-        with self._lock:
-            table, scale = self._table
-            u = np.zeros((b, self._u_base.shape[1]), np.float32)
-            u[:n] = self._gather_users(user_rows)
-            seen = self._batch_seen(user_rows) if exclude_seen else None
-        nt = self.table_rows // self.tile_m
-        seen_tiles = None
-        if seen is not None:
-            movies, indptr = seen
-            # padding slots carry EMPTY seen lists (repeat the last indptr
-            # entry), not user 0's — aliasing the heaviest user into every
-            # pad slot would inflate the seen-rectangle width for rows
-            # whose output is sliced off anyway
-            indptr_pad = np.concatenate(
-                [indptr, np.full(b - n, indptr[-1], np.int64)]
-            )
-            seen_tiles = jnp.asarray(build_seen_tiles(
-                movies, indptr_pad, np.arange(b),
-                num_movies=self.num_movies,
-                tile_m=self.tile_m, num_tiles=nt,
-            ))
-        if self.mesh is not None:
-            from cfk_tpu.parallel.spmd import serve_topk_sharded
+        with span("serve/batch/assemble", n=n, b=b):
+            with self._lock:
+                table, scale = self._table
+                u = np.zeros((b, self._u_base.shape[1]), np.float32)
+                u[:n] = self._gather_users(user_rows)
+                seen = self._batch_seen(user_rows) if exclude_seen else None
+            nt = self.table_rows // self.tile_m
+            seen_tiles = None
+            if seen is not None:
+                movies, indptr = seen
+                # padding slots carry EMPTY seen lists (repeat the last
+                # indptr entry), not user 0's — aliasing the heaviest user
+                # into every pad slot would inflate the seen-rectangle
+                # width for rows whose output is sliced off anyway
+                indptr_pad = np.concatenate(
+                    [indptr, np.full(b - n, indptr[-1], np.int64)]
+                )
+                seen_tiles = jnp.asarray(build_seen_tiles(
+                    movies, indptr_pad, np.arange(b),
+                    num_movies=self.num_movies,
+                    tile_m=self.tile_m, num_tiles=nt,
+                ))
+        with span("serve/batch/compute", n=n, b=b, k=k):
+            if self.mesh is not None:
+                from cfk_tpu.parallel.spmd import serve_topk_sharded
 
-            vals, ids = serve_topk_sharded(
-                self.mesh, jnp.asarray(u), table, scale, seen_tiles,
-                k_top=k, num_movies=self.num_movies, tile_m=self.tile_m,
-            )
-        else:
-            vals, ids = _topk_jit_fn()(
-                jnp.asarray(u), table, scale, seen_tiles,
-                k_top=k, num_movies=self.num_movies, tile_m=self.tile_m,
-            )
-        return np.asarray(vals)[:n], np.asarray(ids)[:n]
+                vals, ids = serve_topk_sharded(
+                    self.mesh, jnp.asarray(u), table, scale, seen_tiles,
+                    k_top=k, num_movies=self.num_movies, tile_m=self.tile_m,
+                )
+            else:
+                vals, ids = _topk_jit_fn()(
+                    jnp.asarray(u), table, scale, seen_tiles,
+                    k_top=k, num_movies=self.num_movies, tile_m=self.tile_m,
+                )
+            vals, ids = np.asarray(vals)[:n], np.asarray(ids)[:n]
+        return vals, ids
 
     @property
     def trace_count(self) -> int:
@@ -295,33 +299,34 @@ class ServeEngine:
         nothing, which ``tests/test_staging.py`` pins."""
         import time as _time
 
-        t0 = _time.time()
-        top = _pow2_ceil(max(max_batch or self.batch_quantum, 1),
-                         self.batch_quantum)
-        if user_rows is None:
-            rows = np.arange(min(top, self.num_users), dtype=np.int64)
-        else:
-            rows = np.asarray(user_rows, dtype=np.int64)
-        if rows.size == 0:
-            return {"programs": 0, "new_traces": 0, "prewarm_s": 0.0}
-        before = trace_count()
-        programs = 0
-        b = self.batch_quantum
-        while b <= top:
-            take = rows[: min(b, rows.size)]
-            # pad by REPEATING the sample rather than truncating the
-            # bucket: topk pads to _pow2_ceil(n, quantum), so a short
-            # sample still traces the intended batch size
-            if take.size < b:
-                take = np.resize(take, b)
-            self.topk(take, k, exclude_seen=exclude_seen)
-            programs += 1
-            b *= 2
-        return {
-            "programs": programs,
-            "new_traces": trace_count() - before,
-            "prewarm_s": round(_time.time() - t0, 4),
-        }
+        with span("serve/prewarm", k=k, max_batch=max_batch):
+            t0 = _time.time()
+            top = _pow2_ceil(max(max_batch or self.batch_quantum, 1),
+                             self.batch_quantum)
+            if user_rows is None:
+                rows = np.arange(min(top, self.num_users), dtype=np.int64)
+            else:
+                rows = np.asarray(user_rows, dtype=np.int64)
+            if rows.size == 0:
+                return {"programs": 0, "new_traces": 0, "prewarm_s": 0.0}
+            before = trace_count()
+            programs = 0
+            b = self.batch_quantum
+            while b <= top:
+                take = rows[: min(b, rows.size)]
+                # pad by REPEATING the sample rather than truncating the
+                # bucket: topk pads to _pow2_ceil(n, quantum), so a short
+                # sample still traces the intended batch size
+                if take.size < b:
+                    take = np.resize(take, b)
+                self.topk(take, k, exclude_seen=exclude_seen)
+                programs += 1
+                b *= 2
+            return {
+                "programs": programs,
+                "new_traces": trace_count() - before,
+                "prewarm_s": round(_time.time() - t0, 4),
+            }
 
 
 # Trace counter (ISSUE 13): bumped once per TRACE of the serve program

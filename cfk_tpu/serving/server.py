@@ -24,6 +24,7 @@ import time
 import numpy as np
 
 from cfk_tpu.serving.topk_kernel import _pow2_ceil
+from cfk_tpu.telemetry import record_event, span
 from cfk_tpu.transport.serdes import (
     ScoreRequest,
     ScoreResponse,
@@ -64,6 +65,7 @@ class RecommendServer:
         max_batch: int = 256,
         poll_wait_s: float = 0.002,
         metrics=None,
+        metrics_port: int | None = None,
     ) -> None:
         from cfk_tpu.utils.metrics import Metrics
 
@@ -79,6 +81,30 @@ class RecommendServer:
         self.requests_served = 0
         self.batches = 0
         self.malformed_requests = 0
+        # Live metrics export (ISSUE 14): with a port, this server scrapes
+        # — GET /metrics answers the Prometheus text rendering of
+        # self.metrics even while batches are in flight (the registry is
+        # thread-safe; 0 binds an ephemeral port, read it back from
+        # .metrics_server.port).
+        self.metrics_server = None
+        if metrics_port is not None:
+            from cfk_tpu.telemetry import MetricsHTTPServer
+
+            self.metrics_server = MetricsHTTPServer(
+                self.metrics, port=int(metrics_port)
+            ).start()
+
+    def close(self) -> None:
+        """Release the /metrics endpoint (idempotent)."""
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+
+    def __enter__(self) -> "RecommendServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def _poll_requests(self) -> list[ScoreRequest]:
         """Everything currently pending, up to ``max_batch``, in
@@ -113,16 +139,19 @@ class RecommendServer:
         reqs = self._poll_requests()
         if not reqs:
             return 0
-        with self.metrics.phase("serve_batch"):
+        t_batch = time.perf_counter()
+        with self.metrics.phase("serve_batch"), \
+                span("serve/batch", requests=len(reqs)):
             # Refuse out-of-range rows per REQUEST (an error response),
             # never per batch — one bad query must not poison its
             # co-batched neighbors.
-            valid: list[ScoreRequest] = []
-            errors: list[ScoreRequest] = []
-            for r in reqs:
-                ok = (0 <= r.user < self.engine.num_users
-                      and 1 <= r.k <= self.engine.num_movies)
-                (valid if ok else errors).append(r)
+            with span("serve/batch/validate", requests=len(reqs)):
+                valid: list[ScoreRequest] = []
+                errors: list[ScoreRequest] = []
+                for r in reqs:
+                    ok = (0 <= r.user < self.engine.num_users
+                          and 1 <= r.k <= self.engine.num_movies)
+                    (valid if ok else errors).append(r)
             responses: list[tuple[int, ScoreResponse]] = []
             if valid:
                 k_pad = _pow2_ceil(
@@ -131,6 +160,8 @@ class RecommendServer:
                 )
                 k_pad = min(k_pad, self.engine.num_movies)
                 rows = np.asarray([r.user for r in valid], np.int64)
+                # engine.topk opens the serve/batch/assemble + compute
+                # spans — the kernel side of this batch's timeline
                 scores, ids = self.engine.topk(rows, k_pad)
                 for i, r in enumerate(valid):
                     responses.append((r.reply_partition, ScoreResponse(
@@ -147,18 +178,27 @@ class RecommendServer:
                            f"[0, {self.engine.num_users}) or k {r.k} "
                            f"outside [1, {self.engine.num_movies}]"),
                 )))
-            for part, resp in responses:
-                self.transport.produce(
-                    self.responses_topic, key=int(resp.req_id % (1 << 31)),
-                    value=encode_score_response(resp), partition=part,
-                )
-            flush = getattr(self.transport, "flush", None)
-            if flush is not None:
-                flush()
+            with span("serve/batch/respond", responses=len(responses)):
+                for part, resp in responses:
+                    self.transport.produce(
+                        self.responses_topic,
+                        key=int(resp.req_id % (1 << 31)),
+                        value=encode_score_response(resp), partition=part,
+                    )
+                flush = getattr(self.transport, "flush", None)
+                if flush is not None:
+                    flush()
         self.requests_served += len(reqs)
         self.batches += 1
         self.metrics.incr("serve_requests", len(reqs))
         self.metrics.incr("serve_batches")
+        # Bounded-reservoir latency distributions (ISSUE 14): per-batch
+        # wall and coalesced size — the /metrics summary quantiles.
+        self.metrics.observe("serve_batch_ms",
+                             (time.perf_counter() - t_batch) * 1e3)
+        self.metrics.observe("serve_batch_size", len(reqs))
+        record_event("serve", "batch", requests=len(reqs),
+                     batch=self.batches)
         return len(reqs)
 
     def serve_forever(self, *, max_requests: int | None = None,

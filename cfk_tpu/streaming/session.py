@@ -50,6 +50,8 @@ from cfk_tpu.streaming.consumer import StreamConsumer
 from cfk_tpu.streaming.foldin import fold_in_rows
 from cfk_tpu.streaming.producer import UPDATES_TOPIC
 from cfk_tpu.streaming.state import StreamState
+from cfk_tpu.telemetry import record_event, span
+from cfk_tpu.telemetry.recorder import dump_flight
 
 _STREAM_MODEL = "als-stream"
 
@@ -278,6 +280,7 @@ class StreamSession:
             f"step {self.stream_step}, cursor {cursors}, "
             f"{len(meta.get('new_users', []))} streamed-in users",
         )
+        record_event("stream", "stream_resumed", step=self.stream_step)
         return True
 
     def _replay_state(self, cursors: dict[int, int], meta: dict) -> None:
@@ -388,7 +391,8 @@ class StreamSession:
             self.state.neighbors(row, pending.cell_writes.get(row))
             for row in pending.touched_rows
         ]
-        with self.metrics.phase("foldin_solve"):
+        with self.metrics.phase("foldin_solve"), \
+                span("stream/batch/solve", touched=len(neighbor_data)):
             rows = fold_in_rows(
                 self._m, neighbor_data,
                 lam=overrides.lam,
@@ -401,7 +405,8 @@ class StreamSession:
             )
         word = 0
         if self.health is not None and rows.shape[0]:
-            with self.metrics.phase("health_check"):
+            with self.metrics.phase("health_check"), \
+                    span("stream/batch/probe"):
                 word = int(np.asarray(_sentinel.probe_word(
                     jnp.asarray(rows), self._m, self.health.norm_limit
                 )))
@@ -433,6 +438,12 @@ class StreamSession:
         Returns ``{"programs", "new_traces", "prewarm_s"}``; serving a
         first real batch inside the warmed grid afterwards traces
         nothing (``tests/test_staging.py`` pins it)."""
+        with span("stream/prewarm"):
+            return self._prewarm_impl(max_touched=max_touched,
+                                      max_width=max_width)
+
+    def _prewarm_impl(self, *, max_touched: int | None = None,
+                      max_width: int | None = None) -> dict:
         import time as _time
 
         from cfk_tpu.streaming.foldin import _pow2_ceil, trace_count
@@ -524,12 +535,15 @@ class StreamSession:
         }
         if note:
             meta["note"] = note
-        with self.metrics.phase("commit"):
+        with self.metrics.phase("commit"), \
+                span("stream/batch/commit", step=self.stream_step):
             save_checkpoint(
                 self.manager, self.stream_step, self._u,
                 np.asarray(self._m), meta=meta,
             )
         self.metrics.incr("stream_commits")
+        record_event("stream", "commit", step=self.stream_step,
+                     note=note or "")
 
     def add_commit_listener(self, fn) -> None:
         """Subscribe ``fn(event: dict)`` to every durable commit.
@@ -556,15 +570,27 @@ class StreamSession:
         batch = self.consumer.poll(self.stream.batch_records)
         if batch is None:
             return None
-        with self.metrics.phase("stage"):
+        with span("stream/batch", step=self.stream_step + 1,
+                  records=batch.num_records):
+            return self._step_batch(batch)
+
+    def _step_batch(self, batch) -> dict:
+        with self.metrics.phase("stage"), \
+                span("stream/batch/stage", records=batch.num_records):
             pending = self.state.stage(batch.updates)
         self.metrics.incr("updates_fresh", pending.stats.fresh)
         self.metrics.incr("updates_stale", pending.stats.stale)
         self.metrics.incr("updates_unknown_movie", pending.stats.unknown_movie)
         if batch.duplicates_dropped:
             self.metrics.incr("delivery_duplicates", batch.duplicates_dropped)
+            record_event("stream", "delivery_duplicates_dropped",
+                         step=self.stream_step + 1,
+                         duplicates=batch.duplicates_dropped)
         if batch.gap_repolls:
             self.metrics.incr("delivery_gap_repolls", batch.gap_repolls)
+            record_event("stream", "delivery_gap_repolls",
+                         step=self.stream_step + 1,
+                         repolls=batch.gap_repolls)
         summary = {
             "records": batch.num_records,
             "fresh": pending.stats.fresh,
@@ -591,6 +617,10 @@ class StreamSession:
                     f"stream_trip_{self.stream_step + 1}_{trips}",
                     report.summary(),
                 )
+                record_event("fault", "stream_trip",
+                             step=self.stream_step + 1, trip=trips,
+                             reason=report.summary())
+                dump_flight(f"stream_trip_{self.stream_step + 1}_{trips}")
                 if trips > self.policy.max_recoveries:
                     # The whole ladder lost: quarantine the batch — its
                     # offsets are consumed (a poison pill must not wedge
@@ -602,6 +632,10 @@ class StreamSession:
                         f"offsets {batch.cursors_before} → "
                         f"{batch.cursors_after} quarantined"
                     )
+                    record_event("fault", "quarantine",
+                                 step=self.stream_step + 1,
+                                 reasons=report.reasons, detail=msg)
+                    dump_flight("quarantine")
                     if self.policy.on_unrecoverable == "raise":
                         raise PoisonedBatchError(msg)
                     self.quarantined.append({
@@ -636,6 +670,8 @@ class StreamSession:
                         f"fused={overrides.fused_epilogue} "
                         f"algo={overrides.reg_solve_algo}",
                     )
+                    record_event("fault", "stream_escalation", rung=trips,
+                                 lam=overrides.lam)
             if pending is not None:
                 self.state.commit(pending)
                 self._grow_users(self.state.num_users)
@@ -709,6 +745,9 @@ class StreamSession:
         """Eviction: the last commit already carries the cursor — drain
         the writer so it is durably on disk, then return resumable."""
         drain_checkpoints(self.manager)
+        record_event("signal", "stream_evicted", step=self.stream_step,
+                     signal=self.guard.signal_name)
+        dump_flight("stream_eviction")
         self.metrics.gauge("preempted", 1)
         self.metrics.note(
             "preempted",

@@ -546,6 +546,12 @@ class CheckpointManager:
             os.rename(tmp, final)
             _fsync_dir(self.directory)
             self._retain(iteration)
+            # Flight-record the commit (post-rename — the event means "this
+            # step is durably on disk", the fact an incident reader needs).
+            from cfk_tpu.telemetry.recorder import record_event
+
+            record_event("checkpoint", "checkpoint_committed",
+                         iteration=iteration)
             return final
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
@@ -609,6 +615,17 @@ class CheckpointManager:
                 self.verify(it)
             except CheckpointCorruptError as e:
                 warnings.warn(f"skipping corrupt checkpoint: {e}")
+                # Flight-record the torn step (and dump): resume silently
+                # falling back past a corrupt checkpoint is exactly the
+                # kind of incident that must leave a forensic trail.
+                from cfk_tpu.telemetry.recorder import (
+                    dump_flight,
+                    record_event,
+                )
+
+                record_event("checkpoint", "corrupt_checkpoint_skipped",
+                             iteration=it, error=str(e))
+                dump_flight("corrupt_checkpoint")
                 continue
             return it
         return None

@@ -1,81 +1,38 @@
-"""Structured metrics + phase timing.
+"""Structured metrics + phase timing (compat shim; see cfk_tpu.telemetry).
 
 Replaces the reference's observability story — raw ``System.out.println``
 wall-clock stamps at phase edges (``apps/ALSAppRunner.java:25,32``,
 ``processors/FeatureCollector.java:47,94``) and a per-partition solve-time
 accumulator printed by a 60 s punctuator
 (``processors/MFeatureCalculator.java:40-45,135``) — with a typed registry:
-counters, gauges, and phase timers, dumped as one JSON line or logfmt.
+counters, gauges, phase timers, and bounded-reservoir histograms, dumped
+as one JSON line or logfmt, streamed as JSONL, or scraped as Prometheus
+text.
+
+The implementation lives in ``cfk_tpu.telemetry.metrics`` (ISSUE 14 made
+the registry thread-safe — PR 12's staging-pool workers and the serve
+server's commit listeners mutate it from worker threads); this module
+keeps the historical import path every call site uses.
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
-import time
-from collections import defaultdict
 
-
-class Metrics:
-    """Process-local metrics registry: counters, gauges, phase timers."""
-
-    def __init__(self) -> None:
-        self.counters: dict[str, float] = defaultdict(float)
-        self.gauges: dict[str, float] = {}
-        self.phases: dict[str, float] = defaultdict(float)
-        self.notes: dict[str, str] = {}
-
-    def incr(self, name: str, value: float = 1.0) -> None:
-        self.counters[name] += value
-
-    def gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = value
-
-    def note(self, name: str, text: str) -> None:
-        """Free-text diagnostic (health-sentinel trip reasons, escalation
-        decisions, degradation notices) — the report channel the resilience
-        loop writes so a degraded run's output says *why*."""
-        self.notes[name] = text
-
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        """Accumulate wall seconds spent inside the block under ``name``."""
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.phases[name] += time.perf_counter() - t0
-
-    def to_dict(self) -> dict:
-        d = {
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "phase_seconds": {k: round(v, 6) for k, v in self.phases.items()},
-        }
-        if self.notes:
-            d["notes"] = dict(self.notes)
-        return d
-
-    def json_line(self) -> str:
-        return json.dumps(self.to_dict(), sort_keys=True)
-
-    def logfmt(self) -> str:
-        parts = []
-        for k, v in sorted(self.counters.items()):
-            parts.append(f"ctr.{k}={v:g}")
-        for k, v in sorted(self.gauges.items()):
-            parts.append(f"g.{k}={v:g}")
-        for k, v in sorted(self.phases.items()):
-            parts.append(f"t.{k}={v:.3f}s")
-        for k, v in sorted(self.notes.items()):
-            parts.append(f"n.{k}={v!r}")
-        return " ".join(parts)
+from cfk_tpu.telemetry.metrics import (  # noqa: F401  (re-exports)
+    Histogram,
+    Metrics,
+    MetricsEmitter,
+    MetricsRegistry,
+)
 
 
 @contextlib.contextmanager
 def maybe_profile(profile_dir: str | None):
     """jax.profiler trace hook: writes a TensorBoard-loadable trace when a
-    directory is given, otherwise a no-op."""
+    directory is given, otherwise a no-op.  Pass the same directory as
+    ``--trace-dir`` to line the device timeline up with the host span
+    trace (``cfk_tpu.telemetry.trace``)."""
     if profile_dir is None:
         yield
         return

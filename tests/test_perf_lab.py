@@ -34,6 +34,7 @@ def _args(**over):
         offload_shards=1,
         staging=None, staging_pool_depth=None, compile_cache_dir=None,
         plan=None, plan_cache=None,
+        telemetry="off", trace_dir=None,
         iters=2, repeats=3, profile_dir=None,
     )
     base.update(over)
@@ -297,6 +298,37 @@ def test_offload_axis_sharded_row(tmp_path, monkeypatch):
                                  offload_window_chunks=2, **base))
     assert win["offload_shards"] == 2
     assert win["factors_crc32"] == dev["factors_crc32"]
+
+
+def test_telemetry_axis_row(tmp_path, monkeypatch, capsys):
+    # The --telemetry A/B axis (ISSUE 14), mirroring test_offload_axis_row:
+    # both arms run the SAME trimmed host_window workload — crc equality is
+    # the telemetry-on == telemetry-off bit-exactness contract (spans are
+    # host-side observation only), and the on arm's row carries the span
+    # count + the written Chrome trace.
+    import cfk_tpu.telemetry as telemetry
+
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    base = dict(layout="tiled", users=200, movies=60, nnz=1500,
+                chunk_elems=512, tile_rows=16, rank=8, iters=2, repeats=2,
+                offload="host_window", offload_window_chunks=2)
+    off = perf_lab.run_lab(_args(telemetry="off", **base))
+    assert "telemetry" not in off  # off arm is byte-for-byte pre-axis
+    on = perf_lab.run_lab(_args(telemetry="on",
+                                trace_dir=str(tmp_path / "trace"), **base))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == on  # scoreboard contract incl. telemetry
+    assert on["telemetry"] == "on"
+    assert on["telemetry_spans"] > 0
+    # spans are observation only: factors bit-identical across the arms
+    assert on["factors_crc32"] == off["factors_crc32"]
+    with open(on["telemetry_trace_path"]) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert "train/iter" in names
+    assert any(n.endswith("window_stage") for n in names)
+    # the axis tears the tracer down — later labs must not keep tracing
+    assert telemetry.get_tracer() is None
 
 
 def test_serve_axis_row(tmp_path, monkeypatch, capsys):
