@@ -195,6 +195,17 @@ def main() -> None:
             sa = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# staging_ab: " + json.dumps(sa))
         rows["staging_ab"] = sa
+    # Hot-row device cache A/B (ISSUE 15): auto hot resolution vs the
+    # full-staging engine on a power-law host_window point — resolved
+    # hot fraction, reference coverage, hot/cold staged MB, the staged-
+    # table-byte cut, crc equality.  CFK_BENCH_HOT=0 skips it.
+    if os.environ.get("CFK_BENCH_HOT", "1") != "0":
+        try:
+            ha = _hot_ab_row()
+        except Exception as e:  # pragma: no cover - device-dependent
+            ha = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# hot_ab: " + json.dumps(ha))
+        rows["hot_ab"] = ha
     # Quantized-gather-table A/B: RMSE per table dtype on the planted
     # split + the analytic bytes removed.  CFK_BENCH_QUANT=0 skips it.
     if os.environ.get("CFK_BENCH_QUANT", "1") != "0":
@@ -938,6 +949,7 @@ def run_scale_sweep(args) -> dict:
                             staging=staging,
                         )
                         np.asarray(model.user_factors[:1])
+                        timed.last_model = model
                     elif shards > 1:
                         from cfk_tpu.parallel.mesh import make_mesh
                         from cfk_tpu.parallel.spmd import train_als_sharded
@@ -1081,6 +1093,54 @@ def run_scale_sweep(args) -> dict:
                         row["staging_speedup"] = round(
                             ser_iter / max(per_iter, 1e-9), 3
                         )
+                    if (tier == "host_window"
+                            and getattr(args, "hot_ab", False)):
+                        # The hot-cache A/B arm (ISSUE 15): the point
+                        # above ran with the DEFAULT hot resolution
+                        # (auto — the coverage knee under the budget);
+                        # re-run the SAME point with hot_rows=0 (the PR
+                        # 12 full-staging engine) and record the staged
+                        # table-byte cut + crc equality — the acceptance
+                        # measurement.  One un-timed run per arm is
+                        # enough: staged bytes are deterministic.
+                        import zlib as _zlib
+
+                        from cfk_tpu.utils.metrics import (
+                            Metrics as _Metrics,
+                        )
+
+                        def _crc(m):
+                            return _zlib.crc32(np.asarray(
+                                m.user_factors, np.float32
+                            ).tobytes()) & 0xFFFFFFFF
+
+                        crc_on = _crc(timed.last_model)
+                        m_off = _Metrics()
+                        cfg_off = _dc.replace(config, hot_rows=0)
+                        timed(cfg_off, None, m_off)
+                        crc_off = _crc(timed.last_model)
+                        cold_on = metrics.gauges.get(
+                            "offload_staged_cold_mb") or 0.0
+                        cold_off = m_off.gauges.get(
+                            "offload_staged_cold_mb") or 0.0
+                        row.update({
+                            "hot_rows": metrics.gauges.get(
+                                "offload_hot_rows", 0),
+                            "hot_coverage": metrics.gauges.get(
+                                "offload_hot_coverage"),
+                            "delta_coverage": metrics.gauges.get(
+                                "offload_delta_coverage"),
+                            "hot_resident_mb": metrics.gauges.get(
+                                "offload_hot_resident_mb"),
+                            "staged_cold_mb_hot_off": cold_off,
+                            "staged_table_cut": (
+                                round(cold_off / cold_on, 3)
+                                if cold_on else None
+                            ),
+                            "hot_crc_equal": bool(crc_on == crc_off),
+                            "hot_decision": metrics.notes.get(
+                                "offload_hot_decision"),
+                        })
                 if tier == "host_window" and resident_ok:
                     row.update({
                         "windows_m": metrics.gauges.get(
@@ -1095,10 +1155,20 @@ def run_scale_sweep(args) -> dict:
                         # (int8 ships codes + per-row scales ≈ ¼ f32 on
                         # the table share, metered separately from the
                         # chunk arrays that cross PCIe regardless).
+                        # Split per ISSUE 15: cold = table bytes that
+                        # actually crossed PCIe; hot = device-resident
+                        # partition bytes (0 / absent when the cache is
+                        # off — then cold IS the whole table share).
                         "offload_staged_mb": metrics.gauges.get(
                             "offload_staged_mb"),
-                        "offload_staged_table_mb": metrics.gauges.get(
-                            "offload_staged_table_mb"),
+                        "offload_staged_cold_mb": metrics.gauges.get(
+                            "offload_staged_cold_mb"),
+                        "offload_hot_resident_mb": metrics.gauges.get(
+                            "offload_hot_resident_mb"),
+                        "offload_hot_rows": metrics.gauges.get(
+                            "offload_hot_rows"),
+                        "offload_hot_coverage": metrics.gauges.get(
+                            "offload_hot_coverage"),
                         "plan_held_mb": metrics.gauges.get(
                             "offload_plan_held_mb"),
                         "per_window_budget_mb": round(
@@ -1188,6 +1258,31 @@ def _staging_ab_row() -> dict:
         sweep_scales="1.0", sweep_budget_mb=2.7, sweep_tile_rows=16,
         sweep_window_chunks=2, sweep_shards="4",
         sweep_table_dtypes="float32", staging_ab=True,
+    )
+    return run_scale_sweep(ns)
+
+
+def _hot_ab_row() -> dict:
+    """The default-main hot-cache A/B row (ISSUE 15): one power-law
+    2-shard host_window point (the budget refuses residency) run with
+    the AUTO hot resolution vs ``hot_rows=0`` via the sweep's
+    ``--hot-ab`` arm.
+
+    The acceptance quantity is ``staged_table_cut`` — full-staging cold
+    bytes over hot-arm cold bytes, per iteration: the counter-based
+    generator is Zipf by construction, so the coverage-curve knee keeps
+    the reference head device-resident and the cut should comfortably
+    clear 2× (the measured row records the resolved fraction and the
+    reference-coverage it bought, plus ``hot_crc_equal`` — the arms are
+    bitwise the same factors).  Wall-clock is expected near parity on
+    this CPU container (PR 12's zero-copy ``device_put`` — no PCIe leg
+    exists to cut; the byte meter is the honest quantity off-TPU)."""
+    ns = argparse.Namespace(
+        users=2_400, movies=240, nnz=48_000, rank=16, iterations=2,
+        repeats=1, seed=0, dtype="float32", lam=0.05, chunk_elems=1_024,
+        sweep_scales="1.0", sweep_budget_mb=1.05, sweep_tile_rows=16,
+        sweep_window_chunks=2, sweep_shards="2",
+        sweep_table_dtypes="float32", hot_ab=True,
     )
     return run_scale_sweep(ns)
 
@@ -2721,6 +2816,15 @@ if __name__ == "__main__":
                         "trace_count and time_to_first_step_s; the "
                         "4-shard point is the ISSUE 13 acceptance "
                         "measurement")
+    parser.add_argument("--hot-ab", action="store_true",
+                        help="hot-row-cache A/B modifier on --scale-sweep "
+                        "(ISSUE 15): every host_window point re-runs with "
+                        "hot_rows=0 (the PR 12 full-staging engine) next "
+                        "to the default auto resolution, recording the "
+                        "resolved hot fraction, the reference-coverage "
+                        "fraction, hot-resident vs cold-staged MB, the "
+                        "staged-table-byte cut, and crc equality between "
+                        "the arms — the ISSUE 15 acceptance measurement")
     parser.add_argument("--sweep-table-dtypes", default="float32",
                         help="comma list of gather-table dtypes per sweep "
                         "point — int8 rows record the (codes, scales) "

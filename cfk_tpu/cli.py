@@ -418,6 +418,7 @@ def _train_impl(args, metrics) -> int:
         offload_tier=args.offload_tier,
         staging=args.staging,
         staging_pool_depth=args.staging_pool_depth,
+        hot_rows=args.hot_rows,
         compile_cache_dir=args.compile_cache_dir,
         overlap=not args.no_overlap,
         in_kernel_gather=(
@@ -1291,6 +1292,7 @@ def _plan_cmd(args) -> int:
                       else args.offload_tier),
         ici_group=args.ici_group,
         staging=None if args.staging == "auto" else args.staging,
+        hot_rows=args.hot_rows,
     )
     if args.device == "auto":
         device = DeviceSpec.detect()
@@ -1504,6 +1506,17 @@ def build_parser() -> argparse.ArgumentParser:
         "on a bounded thread pool across shards and windows; 'serial' "
         "pins the one-thread double buffer (the bench.py --staging-ab "
         "baseline).  Factors are crc-identical across the knob",
+    )
+    t.add_argument(
+        "--hot-rows", type=int, default=None, metavar="F",
+        help="skew-aware hot-row device cache of the host_window tier "
+        "(ISSUE 15): keep the top-F most-referenced fixed-table rows "
+        "(total, both sides) device-resident so windows stage only "
+        "their cold delta.  Default: AUTO — the coverage-curve knee of "
+        "the window plans' own reference counts, clamped by the budget "
+        "headroom (resolves off when either refuses); 0 pins the cache "
+        "off (the full-staging engine); an impossible F raises naming "
+        "the bytes.  Factors are crc-identical across the knob",
     )
     t.add_argument(
         "--staging-pool-depth", type=int, default=None, metavar="D",
@@ -1876,6 +1889,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="host staging engine pin of the host_window "
                     "tier (ISSUE 13): the cost model exposes only the "
                     "PCIe share the chosen engine cannot hide")
+    pl.add_argument("--hot-rows", type=int, default=None, metavar="F",
+                    help="hot-row device cache pin of the host_window "
+                    "tier (ISSUE 15): total top-referenced rows kept "
+                    "device-resident (0 = off).  Default: free — the "
+                    "resolver picks the ~10%% power-law target when the "
+                    "budget headroom admits the reservation, else 0; "
+                    "--explain prints the decision (admitted bytes vs "
+                    "the coverage target), and a pinned-impossible F "
+                    "raises naming the bytes")
     pl.add_argument("--device", default="auto",
                     choices=["auto", "v5e", "cpu"],
                     help="'auto' detects the current jax backend; 'v5e' "

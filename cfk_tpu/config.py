@@ -418,6 +418,23 @@ class ALSConfig:
     # depth+1 worst-case windows fit the per-shard window budget next to
     # the ring accumulator reservation (offload.budget.max_pool_depth).
     staging_pool_depth: int | None = None
+    # --- skew-aware hot-row device cache (cfk_tpu.offload.hot, ISSUE 15)
+    # The host_window tier keeps the top-f fixed-table rows (by cross-
+    # window reference count — the power-law head) device-resident at
+    # the staging dtype, and windows stage only their COLD DELTA vs the
+    # schedule predecessor:
+    #   None  — AUTO: f from the coverage-curve knee of the window
+    #           plans' own reference counts, clamped by the budget
+    #           headroom left after the accumulator/window/delta-arena
+    #           reservations (resolves to 0 — off — when headroom or
+    #           skew refuses).
+    #   0     — OFF: byte-for-byte the PR 12 full-staging engine.
+    #   >= 1  — pin the TOTAL resident rows across both sides; an
+    #           impossible reservation raises loudly (planner AND
+    #           executor, offload.budget.hot_reservation_fits).
+    # Factors are crc-identical across the knob (assembled windows are
+    # bitwise the fully-staged ones); only staged PCIe bytes change.
+    hot_rows: int | None = None
     # --- warm-start compile caching (ISSUE 13) --------------------------
     # Directory for jax's persistent compilation cache.  None disables
     # (today's behavior).  A path is keyed per device fingerprint (the
@@ -534,6 +551,11 @@ class ALSConfig:
                 f"staging_pool_depth must be >= 1 (windows staged ahead "
                 f"of consumption), got {self.staging_pool_depth}; use "
                 "staging='serial' for the unpooled baseline"
+            )
+        if self.hot_rows is not None and self.hot_rows < 0:
+            raise ValueError(
+                f"hot_rows must be None (auto), 0 (off) or a positive "
+                f"total resident row count, got {self.hot_rows}"
             )
         if self.offload_tier == "host_window":
             if self.layout != "tiled":

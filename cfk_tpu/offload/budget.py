@@ -181,6 +181,93 @@ def max_pool_depth(hbm_bytes: float, worst_window_bytes: float,
     return max(live - 1, 1)
 
 
+# --- hot-row device cache (ISSUE 15) ---------------------------------------
+#
+# The skew-aware hot partition keeps the top-f fixed-table rows device-
+# resident at the STAGING dtype, so windows stage only their cold
+# residual.  Its bytes are a RESERVATION next to the ring-accumulator
+# term: persistent device state the window double-buffer split must not
+# promise away.  The planner (plan/resolver.py) and the executor
+# (offload/windowed.py) consult the SAME arithmetic here — the planner
+# with the fraction cap below (it sizes no windows), the executor with
+# the exact residual after the accumulator + window + delta-arena terms.
+
+# The planner-side cap: the hot partition may claim at most this share of
+# the budget fraction — the remainder is the window double buffer +
+# accumulator share the resolver cannot size without the real blocks.
+# The executor's exact arithmetic usually admits more; this cap only has
+# to guarantee the resolver never promises a reservation the window
+# sizing cannot live beside.
+HOT_BUDGET_FRACTION = 0.5
+
+# The planner's hot-fraction TARGET when the knob is free: on power-law
+# data the top ~10% of rows covers well over half the references
+# (data/synth.py's Zipf head; Netflix/ML-25M in the wild), so the
+# resolver aims there and the executor clamps to the REAL coverage-curve
+# knee of the plans' own row sets at build time.
+HOT_ROW_TARGET_FRACTION = 0.10
+
+
+def planner_hot_rows(num_users: int, num_movies: int, rank: int,
+                     stage_dtype: str | None, hbm_bytes: float) -> int:
+    """The resolver's hot-row target for a free ``hot_rows`` field: the
+    ~10% power-law head, clamped by what the planner-side budget
+    predicate admits (0 when the headroom refuses — the "nonzero only
+    when the reservation fits" acceptance rule)."""
+    target = int((num_users + num_movies) * HOT_ROW_TARGET_FRACTION)
+    return min(target, max_hot_rows(hbm_bytes, rank, stage_dtype))
+
+
+def stage_row_bytes(rank: int, stage_dtype: str | None) -> float:
+    """Bytes one staged/hot-resident table row occupies at the staging
+    dtype: ``rank`` cells plus the int8 scheme's per-row f32 scale."""
+    cell = dtype_bytes(stage_dtype)
+    overhead = 4.0 if stage_dtype == "int8" else 0.0
+    return float(rank) * cell + overhead
+
+
+def hot_reservation_bytes(hot_rows: int, rank: int,
+                          stage_dtype: str | None) -> float:
+    """Persistent device bytes of a ``hot_rows``-row hot partition (both
+    sides' partitions sum — callers pass the total row count)."""
+    return max(int(hot_rows), 0) * stage_row_bytes(rank, stage_dtype)
+
+
+def delta_arena_bytes(window_rows: int, rank: int,
+                      stage_dtype: str | None) -> float:
+    """The delta-staging arena bound: ONE predecessor window's assembled
+    table stays device-resident while its successor assembles (the
+    device-to-device reuse source), on top of the classic double buffer —
+    charged at the worst window's table share."""
+    return float(window_rows) * stage_row_bytes(rank, stage_dtype)
+
+
+def max_hot_rows(hbm_bytes: float, rank: int, stage_dtype: str | None,
+                 reserved_bytes: float = 0.0) -> int:
+    """The largest hot partition (total rows, both sides) the budget
+    admits next to ``reserved_bytes`` of other persistent state.  The
+    executor passes the exact reservation (ring accumulators + the live
+    window buffers + the delta arena); the planner, which has not sized
+    windows yet, passes 0 and the ``HOT_BUDGET_FRACTION`` cap holds the
+    window share instead."""
+    share = max(hbm_bytes * RESIDENT_FRACTION - reserved_bytes, 0.0)
+    if reserved_bytes == 0.0:
+        share *= HOT_BUDGET_FRACTION
+    return int(share // stage_row_bytes(rank, stage_dtype))
+
+
+def hot_reservation_fits(hot_rows: int, rank: int,
+                         stage_dtype: str | None, hbm_bytes: float,
+                         reserved_bytes: float = 0.0) -> bool:
+    """THE hot-reservation predicate (planner AND executor): can a
+    ``hot_rows``-row partition live beside ``reserved_bytes``?  The
+    planner refuses a pinned-impossible reservation at resolution with
+    this; the executor re-checks with its exact terms."""
+    return (int(hot_rows)
+            <= max_hot_rows(hbm_bytes, rank, stage_dtype,
+                            reserved_bytes=reserved_bytes))
+
+
 def ring_accumulator_bytes(local_entities: int, rank: int) -> float:
     """Persistent device bytes of one shard's ring-mode Gram accumulator:
     the f32 [E_local+1, k, k] + [E_local+1, k] carry pair the windowed

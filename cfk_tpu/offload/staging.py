@@ -150,7 +150,7 @@ class WindowStager:
 
     def __init__(self, tasks, stage_fn, *, mode: str = "pool",
                  depth: int = DEFAULT_POOL_DEPTH, workers: int | None = None,
-                 stats=None) -> None:
+                 stats=None, span_attrs=None) -> None:
         if mode not in STAGING_MODES:
             raise ValueError(
                 f"staging mode must be one of {STAGING_MODES}, got {mode!r}"
@@ -159,6 +159,11 @@ class WindowStager:
         self._fn = stage_fn
         self.mode = mode
         self._stats = stats
+        # Optional (shard, key) -> dict of extra window_stage span attrs
+        # (ISSUE 15: rows_staged / rows_delta_skipped / rows_hot — plan-
+        # time constants, so the provider must be a pure lookup; it runs
+        # on worker threads).
+        self._span_attrs = span_attrs
         self._next_submit = 0
         self._next_take = 0
         self._closed = False
@@ -192,9 +197,13 @@ class WindowStager:
             # ids, so pool overlap against the consuming compute spans is
             # VISIBLE in the trace; its duration is exactly the interval
             # stage_busy_s meters, which is what lets the trace-recomputed
-            # overlap fraction agree with the driver's gauge.
+            # overlap fraction agree with the driver's gauge.  Extra attrs
+            # (rows_staged / rows_delta_skipped) come from the driver's
+            # provider so the trace shows the hot/delta reuse per window.
+            extra = (self._span_attrs(shard, key)
+                     if self._span_attrs is not None else {})
             with span("train/iter/half_step/window_stage",
-                      shard=shard, window=key, mode=self.mode):
+                      shard=shard, window=key, mode=self.mode, **extra):
                 out = self._fn(shard, key)
         finally:
             with self._lock:

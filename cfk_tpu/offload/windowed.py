@@ -173,6 +173,23 @@ def _window_half_jit():
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _window_half_hot_jit():
+    """The stream-mode window jit under the hot/delta engine (ISSUE 15):
+    the SAME program as ``_window_half_jit`` — one trace of the identical
+    chunk body — but WITHOUT the staged-pair donation: the assembled
+    (tbl, scale) window table must OUTLIVE the call, because the
+    successor window's delta reuse copies its shared cold rows out of it
+    device-to-device (the resident-cold arena).  Donating it would hand
+    XLA a buffer the next assembly still reads."""
+    return jax.jit(
+        _window_half_impl,
+        static_argnames=("statics", "lam", "solver", "overlap",
+                         "fused_epilogue", "in_kernel_gather",
+                         "reg_solve_algo", "table_dtype", "out_dtype"),
+    )
+
+
 def _ring_window_impl(acc_a, acc_b, tbl, scale, nb, rt, wt, ts, ent, *,
                       statics, backend, gather, int8):
     """One staged ring window's chunks, accumulated into the shard's
@@ -231,6 +248,98 @@ def _ring_window_jit():
         _ring_window_impl,
         static_argnames=("statics", "backend", "gather", "int8"),
         donate_argnums=_staged_donate_argnums((0, 1), (2, 3)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_window_hot_jit():
+    """The ring-mode window jit under the hot/delta engine: identical
+    program to ``_ring_window_jit`` with the Gram-carry donation kept
+    (the ×1 accumulator reservation) but the staged-table donation
+    dropped — the assembled window table is the successor's delta-reuse
+    source (see ``_window_half_hot_jit``)."""
+    return jax.jit(
+        _ring_window_impl,
+        static_argnames=("statics", "backend", "gather", "int8"),
+        donate_argnums=(0, 1),
+    )
+
+
+def _assemble_impl(delta, dscale, prev_tbl, prev_scale, hot_tbl, hot_scale,
+                   keep_dst, keep_src, new_dst, hot_dst, hot_src, *,
+                   window_rows, int8):
+    """Assemble one window's staged table from its three sources
+    (ISSUE 15): the PCIe-staged cold delta, the predecessor window's
+    assembled table (device-to-device reuse of shared cold rows), and
+    the device-resident hot partition.  Every row is a COPY of bytes
+    bitwise identical to what full staging would have produced, so the
+    assembled table — and everything computed from it — is bit-exact vs
+    the PR 12 engine by construction.
+
+    Index pads point AT ``window_rows`` (out of bounds) and are dropped
+    by the explicit scatter ``mode="drop"``; rows no source claims stay
+    zero — they are the [row_count, window_rows) pad rows no rebased
+    neighbor index ever references (the full-staging path filled them
+    with row-0 repeats; either value is unread)."""
+    import jax.numpy as jnp
+
+    _TRACES[0] += 1
+    r = window_rows
+    tbl = jnp.zeros((r, delta.shape[-1]), delta.dtype)
+    tbl = tbl.at[keep_dst].set(prev_tbl[keep_src], mode="drop")
+    tbl = tbl.at[new_dst].set(delta, mode="drop")
+    tbl = tbl.at[hot_dst].set(hot_tbl[hot_src], mode="drop")
+    if not int8:
+        return tbl, None
+    sc = jnp.zeros((r,), jnp.float32)
+    sc = sc.at[keep_dst].set(prev_scale[keep_src], mode="drop")
+    sc = sc.at[new_dst].set(dscale, mode="drop")
+    sc = sc.at[hot_dst].set(hot_scale[hot_src], mode="drop")
+    return tbl, sc
+
+
+@functools.lru_cache(maxsize=None)
+def _assemble_jit():
+    """The window-assembly jit.  Shapes re-trace per (delta bucket,
+    index widths, window_rows) — a scatter/gather-only program, cheap
+    next to the window compute (which keeps ONE trace because it always
+    sees the same assembled [window_rows, k] table shape)."""
+    return jax.jit(
+        _assemble_impl, static_argnames=("window_rows", "int8"),
+    )
+
+
+def _hot_update_impl(hot_tbl, hot_scale, xs, src, dst, *, int8):
+    """Scatter one window's solved hot rows back into the device
+    partition IN PLACE — no host round-trip (ISSUE 15).  ``src`` indexes
+    the solved [rows, k] output (last finalization slot per entity — the
+    host scatter's last-write-wins), ``dst`` the partition (pads are out
+    of bounds, dropped).  The cast/quantization is the in-jit arithmetic
+    the host staging pipeline is pinned bit-identical to
+    (``store.quantize_rows_host`` ≡ ``quant.quantize_table``), so a hot
+    row's device copy always matches what re-staging it from the host
+    master would produce."""
+    from cfk_tpu.ops import quant
+
+    _TRACES[0] += 1
+    rows = xs[src]
+    if int8:
+        codes, scales = quant.quantize_table(rows, "int8")
+        return (hot_tbl.at[dst].set(codes, mode="drop"),
+                hot_scale.at[dst].set(scales, mode="drop"))
+    return (hot_tbl.at[dst].set(rows.astype(hot_tbl.dtype), mode="drop"),
+            hot_scale)
+
+
+@functools.lru_cache(maxsize=None)
+def _hot_update_jit():
+    """The scatter-back jit.  The partition pair donates on TPU only
+    (``_staged_donate_argnums``: in-place update ⇒ output aliases input;
+    on CPU the initial ``device_put`` zero-copy-aliases host numpy and
+    jax refuses aliased donations with a warning)."""
+    return jax.jit(
+        _hot_update_impl, static_argnames=("int8",),
+        donate_argnums=_staged_donate_argnums((), (0, 1)),
     )
 
 
@@ -356,16 +465,23 @@ def _stage_window(fixed_store: HostFactorStore, plan_obj, w: int, *,
         # reproduces the sizing decision.  The chunk arrays are
         # zero-copy VIEWS of the block arrays on the host, but they
         # still cross PCIe per window — staged bytes meter the transfer,
-        # not host allocations.  The TABLE share is metered separately:
-        # it is the bytes the staging dtype levers (int8 (codes, scales)
-        # ≈ ¼ of f32 — the honest per-dtype ratio the bench rows
-        # record).  Metered from the HOST arrays BEFORE the device_put
-        # hand-off — the device (tbl, scale) pair is donated through the
-        # window jit (ISSUE 13), so nothing may read it after dispatch.
+        # not host allocations.  The TABLE share is metered separately
+        # as staged_cold_bytes: the bytes the staging dtype AND the hot
+        # cache lever (with the cache off — this path — every table row
+        # is "cold"; int8 (codes, scales) ≈ ¼ of f32, the honest
+        # per-dtype ratio the bench rows record).  Metered from the HOST
+        # arrays BEFORE the device_put hand-off — the device (tbl,
+        # scale) pair is donated through the window jit (ISSUE 13), so
+        # nothing may read it after dispatch.
         stats_add(stats, "staged_bytes",
                   sum(a.nbytes for a in host if a is not None))
-        stats_add(stats, "staged_table_bytes",
+        stats_add(stats, "staged_cold_bytes",
                   data.nbytes + (scale.nbytes if scale is not None else 0))
+        # rows_staged counts REAL table rows (pre-pad) on every staging
+        # path — full windows here, the delta path in
+        # _stage_window_delta, and the window_stage span attrs all agree
+        # — while the byte meters above record the PADDED transfer.
+        stats_add(stats, "rows_staged", int(plan_obj.row_counts[w]))
     # ONE pytree device_put for the whole window (None leaves pass
     # through): per-array puts paid jax dispatch overhead 7-10× per
     # window, which dominated staging at small windows — one issue per
@@ -373,18 +489,218 @@ def _stage_window(fixed_store: HostFactorStore, plan_obj, w: int, *,
     return jax.device_put(host)
 
 
+def _stage_window_delta(fixed_store: HostFactorStore, plan_obj, hmap, w: int,
+                        *, stage_np, int8: bool, faults, iteration: int,
+                        side: str, shard: int, verify_windows: bool,
+                        stats: dict | None, ici_group: int) -> tuple:
+    """Stage window ``w``'s COLD DELTA (ISSUE 15): only the cold rows the
+    predecessor window in the schedule did not already stage cross PCIe —
+    the hot partition and the device-kept rows are assembled on device by
+    ``_assemble_jit``.  Gather + quantize + checksum run through the SAME
+    ``_stage_table`` as full staging (the fault hooks and the crc32
+    integrity contract see exactly the bytes that ship), then the delta
+    pads to its pow2 bucket (static jit shapes; the pad rows scatter out
+    of bounds and are dropped)."""
+    rows = hmap.delta_rows[w]
+    data, scale = _stage_table(
+        fixed_store, rows, stage_np=stage_np, int8=int8, faults=faults,
+        iteration=iteration, side=side, window=w, shard=shard,
+        verify_windows=verify_windows, stats=stats, home_shard=shard,
+        ici_group=ici_group,
+    )
+    d = int(rows.shape[0])
+    bucket = hmap.delta_bucket(w)
+    pad = np.zeros((bucket, fixed_store.rank), dtype=data.dtype)
+    pad[:d] = data
+    if scale is not None:
+        ps = np.zeros((bucket,), dtype=np.float32)
+        ps[:d] = scale
+        scale = ps
+    data = pad
+    host = (data, scale, plan_obj.neighbor_idx[w],
+            *plan_obj.stage_chunks(w))
+    if stats is not None:
+        stats_add(stats, "windows_staged", 1)
+        # Same metering seam as full staging: staged_bytes is the whole
+        # transfer (delta table + chunk arrays), staged_cold_bytes the
+        # table share that actually shipped — the quantity the hot
+        # engine exists to cut, recorded at the PADDED bucket size (the
+        # honest transfer, not the pre-pad row count).
+        stats_add(stats, "staged_bytes",
+                  sum(a.nbytes for a in host if a is not None))
+        stats_add(stats, "staged_cold_bytes",
+                  data.nbytes + (scale.nbytes if scale is not None else 0))
+        stats_add(stats, "rows_staged", d)
+        stats_add(stats, "rows_delta_skipped", int(hmap.keep_dst[w].size))
+        stats_add(stats, "rows_hot_device", int(hmap.hot_dst[w].size))
+    return jax.device_put(host)
+
+
+class HotPartition:
+    """One fixed side's device-resident hot rows (ISSUE 15), stored
+    dequant-ready at the STAGING dtype: f32/bf16 data, or the (int8
+    codes, f32 per-row scales) pair — exactly the bytes full staging
+    would have shipped for these rows, so a window assembled from the
+    partition is bitwise the fully-staged window.
+
+    The host master store stays ground truth: ``rebuild`` re-gathers the
+    partition from it (driver rollback — a poisoned partition is erased
+    by the same snapshot restore that heals the stores), while the
+    steady-state updates come from ``_hot_update_jit``'s in-place device
+    scatter-back (no host round-trip)."""
+
+    def __init__(self, rows: np.ndarray, stage_name: str) -> None:
+        self.rows = np.asarray(rows, dtype=np.int64)
+        self.stage_name = stage_name
+        self.int8 = stage_name == "int8"
+        self._stage_np = None if self.int8 else _np_dtype(stage_name)
+        self.data = None
+        self.scale = None
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        if self.data is None:
+            return 0
+        return int(self.data.nbytes
+                   + (self.scale.nbytes if self.scale is not None else 0))
+
+    def rebuild(self, store: HostFactorStore) -> None:
+        """(Re)gather the partition from the host master — the initial
+        build and the rollback path share it, so a recovered run's
+        partition is bit-identical to a fresh one."""
+        tbl = store.gather(self.rows)
+        if tbl.shape[0] == 0:
+            # A 0-row side still participates in the delta engine (the
+            # other side may be the hot one); keep one zeros row so the
+            # assembly's padded gathers stay in bounds (pad destinations
+            # are out of bounds and dropped, so the value is never used).
+            tbl = np.zeros((1, store.rank), dtype=tbl.dtype)
+        if self.int8:
+            data, scale = quantize_rows_host(tbl)
+        else:
+            data = (tbl if tbl.dtype == self._stage_np
+                    else tbl.astype(self._stage_np))
+            scale = None
+        self.data = jax.device_put(data)
+        self.scale = None if scale is None else jax.device_put(scale)
+
+    def poison(self, rows: np.ndarray) -> None:
+        """Chaos seam: NaN the given PARTITION positions in the device
+        copy (the int8 pair poisons the scale — the only leaf that can
+        go nonfinite, same as the in-flight quantization contract).  The
+        host master is untouched, so rollback + ``rebuild`` recovers
+        bit-exactly."""
+        import jax.numpy as jnp
+
+        rows = np.asarray(rows, dtype=np.int32)
+        if self.int8:
+            self.scale = self.scale.at[rows].set(jnp.nan, mode="drop")
+        else:
+            self.data = self.data.at[rows].set(
+                jnp.asarray(np.nan, self.data.dtype), mode="drop"
+            )
+
+
+class _HotHalf:
+    """One (side, shard)'s view of the hot/delta engine for a half-step:
+    the FIXED side's partition (read by window assembly), the SOLVE
+    side's partition (scatter-back target), this shard's window split
+    map, and the device-resident index constants (built once — they are
+    plan-time constants, so only the delta table pays PCIe per
+    iteration)."""
+
+    def __init__(self, fixed: HotPartition, solve: HotPartition | None,
+                 hmap, sb_maps) -> None:
+        self.fixed = fixed
+        self.solve = solve
+        self.hmap = hmap
+        self.sb = sb_maps  # stream: {w: (src, dst)}; ring: (src, dst)
+        r = hmap.window_rows
+        self._idx = {}
+        for w in hmap.prev_of:
+            hp, kp = hmap.hot_pad, hmap.keep_pad
+            bucket = hmap.delta_bucket(w)
+            self._idx[w] = jax.device_put((
+                _pad_idx(hmap.keep_dst[w], kp, r),
+                _pad_idx(hmap.keep_src[w], kp, 0),
+                _pad_idx(hmap.delta_dst[w], bucket, r),
+                _pad_idx(hmap.hot_dst[w], hp, r),
+                _pad_idx(hmap.hot_src[w], hp, 0),
+            ))
+        if isinstance(sb_maps, dict):
+            pad = max((v[0].size for v in sb_maps.values()), default=0)
+            self.sb_pad = pad
+            f = solve.num_rows if solve is not None else 0
+            self._sb_idx = {
+                w: jax.device_put((_pad_idx(src, pad, 0),
+                                   _pad_idx(dst, pad, f)))
+                for w, (src, dst) in sb_maps.items()
+            } if pad else {}
+        else:
+            self.sb_pad = 0 if sb_maps is None else int(sb_maps[0].size)
+            self._sb_idx = (None if not self.sb_pad
+                            else jax.device_put(tuple(sb_maps)))
+
+    def idx(self, w):
+        return self._idx[w]
+
+    def sb_idx(self, w=None):
+        return self._sb_idx if w is None else self._sb_idx.get(w)
+
+
+def _fixed_rows_of(plan_obj) -> int:
+    """The fixed-table row space a plan's windows gather from — the
+    store's own row count (stream plans record it; ring plans address
+    slice·H + local over every slice)."""
+    if hasattr(plan_obj, "table_rows"):
+        return int(plan_obj.table_rows)
+    return int(plan_obj.num_slices * plan_obj.statics[3])
+
+
+def _pad_idx(arr: np.ndarray, width: int, pad_val: int) -> np.ndarray:
+    out = np.full((max(int(width), 1),), pad_val, dtype=np.int32)
+    out[: arr.size] = arr
+    return out
+
+
+def _hot_zero_prev(window_rows: int, rank: int, stage_name: str):
+    """The chain head's predecessor: a zeros (tbl, scale) pair at the
+    staging dtype (nothing is kept from it — the first window of every
+    schedule stages its full cold set as delta)."""
+    import jax.numpy as jnp
+
+    if stage_name == "int8":
+        return (jnp.zeros((window_rows, rank), jnp.int8),
+                jnp.zeros((window_rows,), jnp.float32))
+    dt = jnp.bfloat16 if stage_name == "bfloat16" else jnp.float32
+    return jnp.zeros((window_rows, rank), dt), None
+
+
 def _own_stager(fixed_store, plan_obj, schedule, *, table_dtype, faults,
                 iteration, side, shard, verify_windows, stats, ici_group,
-                ) -> WindowStager:
+                hot=None) -> WindowStager:
     """A single-shard SERIAL stager for direct half-step callers (tests,
     library use): byte-for-byte the PR 10/11 schedule — staging runs on
     the consuming thread at the classic double-buffer positions.  The
-    sharded driver passes a shared pooled stager instead."""
+    sharded driver passes a shared pooled stager instead.  With ``hot``
+    (a ``_HotHalf``), tasks stage the cold delta instead of the full
+    window."""
     stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
     int8 = stage_name == "int8"
     stage_np = None if int8 else _np_dtype(stage_name)
 
     def stage_task(d, w):
+        if hot is not None:
+            return _stage_window_delta(
+                fixed_store, plan_obj, hot.hmap, w, stage_np=stage_np,
+                int8=int8, faults=faults, iteration=iteration, side=side,
+                shard=d, verify_windows=verify_windows, stats=stats,
+                ici_group=ici_group,
+            )
         return _stage_window(
             fixed_store, plan_obj, w, stage_np=stage_np, int8=int8,
             faults=faults, iteration=iteration, side=side, shard=d,
@@ -393,7 +709,26 @@ def _own_stager(fixed_store, plan_obj, schedule, *, table_dtype, faults,
         )
 
     return WindowStager([(shard, w) for w in schedule], stage_task,
-                        mode="serial", stats=stats)
+                        mode="serial", stats=stats,
+                        span_attrs=lambda d, w: _stage_span_attrs(
+                            hot.hmap if hot is not None else None,
+                            plan_obj, w))
+
+
+def _stage_span_attrs(hmap, plan_obj, w: int) -> dict:
+    """The ``window_stage`` span attrs (ISSUE 15): rows_staged /
+    rows_delta_skipped / rows_hot per window, so the trace shows the
+    reuse.  ONE copy shared by the direct half-step callers and the
+    sharded driver (the PR 11 no-two-meters discipline) — rows are REAL
+    (pre-pad) counts, matching the ``rows_staged`` stats key.  Plan-time
+    constants: a pure lookup, safe on worker threads."""
+    if hmap is None:
+        return {"rows_staged": int(plan_obj.row_counts[w])}
+    return {
+        "rows_staged": int(len(hmap.delta_rows[w])),
+        "rows_delta_skipped": int(hmap.keep_dst[w].size),
+        "rows_hot": int(hmap.hot_dst[w].size),
+    }
 
 
 def windowed_half_step(
@@ -403,6 +738,7 @@ def windowed_half_step(
     table_dtype: str | None = None, faults=None, iteration: int = 0,
     side: str = "", stats: dict | None = None, verify_windows: bool = False,
     shard: int = 0, ici_group: int = 1, stager: WindowStager | None = None,
+    hot: "_HotHalf | None" = None,
 ) -> np.ndarray:
     """Solve one shard's entities against a host-resident fixed table,
     window by window (the stream-mode / all_gather-exchange scan).
@@ -435,8 +771,17 @@ def windowed_half_step(
             fixed_store, wplan, wplan.schedule(), table_dtype=table_dtype,
             faults=faults, iteration=iteration, side=side, shard=shard,
             verify_windows=verify_windows, stats=stats,
-            ici_group=ici_group,
+            ici_group=ici_group, hot=hot,
         )
+    half_kw = dict(
+        statics=wplan.statics, lam=float(lam), solver=solver,
+        overlap=overlap, fused_epilogue=fused_epilogue,
+        in_kernel_gather=in_kernel_gather, reg_solve_algo=reg_solve_algo,
+        table_dtype=table_dtype, out_dtype=out_dtype,
+    )
+    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
+    prev = (None if hot is None
+            else _hot_zero_prev(wplan.window_rows, k, stage_name))
     try:
         staged = stager.take() if n_w else None
         for w in range(n_w):
@@ -449,14 +794,31 @@ def windowed_half_step(
             # worker's window_stage span visibly overlaps it.
             with span("train/iter/half_step/window_compute",
                       side=side, shard=shard, window=w):
-                xs = _window_half_jit()(
-                    *staged, statics=wplan.statics, lam=float(lam),
-                    solver=solver, overlap=overlap,
-                    fused_epilogue=fused_epilogue,
-                    in_kernel_gather=in_kernel_gather,
-                    reg_solve_algo=reg_solve_algo, table_dtype=table_dtype,
-                    out_dtype=out_dtype,
-                )
+                if hot is None:
+                    xs = _window_half_jit()(*staged, **half_kw)
+                else:
+                    # Assemble from delta + predecessor + hot partition,
+                    # then the SAME window program (one trace — the
+                    # assembled table shape never changes) WITHOUT the
+                    # staged donation (the next window reuses this one).
+                    delta, dscale, *rest = staged
+                    tbl, scale = _assemble_jit()(
+                        delta, dscale, *prev,
+                        hot.fixed.data, hot.fixed.scale, *hot.idx(w),
+                        window_rows=wplan.window_rows,
+                        int8=hot.fixed.int8,
+                    )
+                    xs = _window_half_hot_jit()(tbl, scale, *rest,
+                                                **half_kw)
+                    prev = (tbl, scale)
+                    sb = hot.sb_idx(w)
+                    if sb is not None:
+                        # Solved hot rows of THIS side scatter back into
+                        # its partition in place — no host round-trip.
+                        hot.solve.data, hot.solve.scale = _hot_update_jit()(
+                            hot.solve.data, hot.solve.scale, xs, *sb,
+                            int8=hot.solve.int8,
+                        )
                 nxt = stager.take() if w + 1 < n_w else None
                 xs_np = np.asarray(xs)
             ent = wplan.chunk_entity_of(w)
@@ -477,6 +839,7 @@ def ring_windowed_half_step(
     table_dtype: str | None = None, faults=None, iteration: int = 0,
     side: str = "", stats: dict | None = None, verify_windows: bool = False,
     shard: int = 0, ici_group: int = 1, stager: WindowStager | None = None,
+    hot: "_HotHalf | None" = None,
 ) -> np.ndarray:
     """One shard's ring/hier-ring half-iteration against staged windows.
 
@@ -506,7 +869,8 @@ def ring_windowed_half_step(
     gather = resolve_gather_mode(
         in_kernel_gather, backend, "full", cap, nt, t, e_c + 1, k,
     )
-    int8 = _stage_dtype(fixed_store.dtype, table_dtype) == "int8"
+    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
+    int8 = stage_name == "int8"
     schedule = rplan.schedule(visits)
     own = stager is None
     if own:
@@ -514,10 +878,12 @@ def ring_windowed_half_step(
             fixed_store, rplan, schedule, table_dtype=table_dtype,
             faults=faults, iteration=iteration, side=side, shard=shard,
             verify_windows=verify_windows, stats=stats,
-            ici_group=ici_group,
+            ici_group=ici_group, hot=hot,
         )
     acc_a = jnp.zeros((local + 1, k, k), jnp.float32)
     acc_b = jnp.zeros((local + 1, k), jnp.float32)
+    prev = (None if hot is None
+            else _hot_zero_prev(rplan.window_rows, k, stage_name))
     try:
         staged = stager.take() if schedule else None
         for i, w in enumerate(schedule):
@@ -531,11 +897,26 @@ def ring_windowed_half_step(
             # (window residual — the DCN-hop payload) against compute.
             with span("train/iter/half_step/ring_visit",
                       side=side, shard=shard, visit=i, window=w):
-                acc_a, acc_b = _ring_window_jit()(
-                    acc_a, acc_b, *staged,
-                    statics=(rplan.window_chunks, cap, t, e_c),
-                    backend=backend, gather=gather, int8=int8,
-                )
+                if hot is None:
+                    acc_a, acc_b = _ring_window_jit()(
+                        acc_a, acc_b, *staged,
+                        statics=(rplan.window_chunks, cap, t, e_c),
+                        backend=backend, gather=gather, int8=int8,
+                    )
+                else:
+                    delta, dscale, *rest = staged
+                    tbl, scale = _assemble_jit()(
+                        delta, dscale, *prev,
+                        hot.fixed.data, hot.fixed.scale, *hot.idx(w),
+                        window_rows=rplan.window_rows,
+                        int8=hot.fixed.int8,
+                    )
+                    acc_a, acc_b = _ring_window_hot_jit()(
+                        acc_a, acc_b, tbl, scale, *rest,
+                        statics=(rplan.window_chunks, cap, t, e_c),
+                        backend=backend, gather=gather, int8=int8,
+                    )
+                    prev = (tbl, scale)
                 staged = (stager.take() if i + 1 < len(schedule) else None)
     finally:
         if own:
@@ -546,6 +927,13 @@ def ring_windowed_half_step(
             lam=float(lam), solver=solver, fused_epilogue=fused_epilogue,
             reg_solve_algo=reg_solve_algo, out_dtype=out_dtype,
         )
+        if hot is not None and hot.sb_pad:
+            # The ring modes solve once at the end: one in-place scatter
+            # of this shard's hot solve rows back into the partition.
+            hot.solve.data, hot.solve.scale = _hot_update_jit()(
+                hot.solve.data, hot.solve.scale, x, *hot.sb_idx(),
+                int8=hot.solve.int8,
+            )
         x = np.asarray(x)
     return x
 
@@ -701,6 +1089,7 @@ def train_als_host_window(
     verify_windows: bool | None = None,
     staging: str | None = None,
     pool_depth: int | None = None,
+    hot_rows: int | None = None,
 ):
     """ALS-WR with host-resident factor tables and windowed half-steps.
 
@@ -720,6 +1109,19 @@ def train_als_host_window(
     (default: the detected device's HBM through ``offload.budget`` — the
     SAME predicate the planner gates the ``device`` tier with);
     ``chunks_per_window`` overrides the derived window size.
+
+    ``hot_rows`` (ISSUE 15) sizes the skew-aware hot-row device cache:
+    ``None`` defers to ``config.hot_rows`` (whose ``None`` default is
+    AUTO — the coverage-curve knee of the plans' own cross-window
+    reference counts, clamped by the budget headroom left after the
+    accumulator + window + delta-arena reservations); ``0`` pins the
+    cache OFF (byte-for-byte the PR 12 engine); ``>= 1`` pins the TOTAL
+    resident row count across both sides (split proportionally to each
+    side's reference mass), raising when the reservation cannot fit —
+    the same loud-refusal convention as the per-window budget.  With the
+    cache on, windows stage only their COLD DELTA vs the schedule
+    predecessor; factors are crc-identical across the knob (the
+    assembled window tables are bitwise the fully-staged ones).
 
     ``staging`` (ISSUE 13) picks the host staging engine's mode —
     ``"pool"`` (the default: one bounded thread pool per half-iteration
@@ -844,6 +1246,124 @@ def train_als_host_window(
             _budget.max_pool_depth(device_budget_bytes, worst,
                                    reserved_bytes=acc_reserved),
         ))
+        # --- skew-aware hot-row cache resolution (ISSUE 15) ----------
+        # Decided HERE, at window-plan build time, from the plans' own
+        # per-window row sets: the planner's plan field carries the
+        # budget-admitted TARGET; this is the exact resolution against
+        # the real reference skew.  The window sizing above is untouched
+        # by the knob on purpose — hot on/off share cpw, so their
+        # schedules (and therefore every bit) are identical.
+        from cfk_tpu.offload import hot as _hotmod
+
+        requested = (hot_rows if hot_rows is not None
+                     else getattr(config, "hot_rows", None))
+        schedules = {
+            ("m", d): (m_plans[d].schedule(hier_visit_order(s, inner, d))
+                       if ring_m else m_plans[d].schedule())
+            for d in range(s)
+        }
+        schedules.update({
+            ("u", d): (u_plans[d].schedule(hier_visit_order(s, inner, d))
+                       if ring_u else u_plans[d].schedule())
+            for d in range(s)
+        })
+        hot_note = None
+        f_u = f_m = 0
+        if requested != 0:
+            row_b = _budget.stage_row_bytes(config.rank, stage_name)
+            arena = max(
+                p.window_rows * row_b for p in (*m_plans, *u_plans)
+            )
+            live = (pool_depth + 1 if staging == "pool"
+                    else _budget.WINDOW_BUFFERS)
+            live = max(live, _budget.WINDOW_BUFFERS)
+            hot_reserved = acc_reserved + live * worst + arena
+            admit = _budget.max_hot_rows(
+                device_budget_bytes, config.rank, stage_name,
+                reserved_bytes=hot_reserved,
+            )
+            # Per-side reference counts over the FIXED table each side's
+            # windows gather, zeroed outside the rows the OTHER half
+            # provably re-solves (so an in-place device copy can never
+            # go stale vs the host master — on real data this is a
+            # no-op: referenced rows have interactions, interactions
+            # make solve entities).
+            counts_u = _hotmod.reference_counts(
+                m_plans, _fixed_rows_of(m_plans[0])
+            )
+            counts_m = _hotmod.reference_counts(
+                u_plans, _fixed_rows_of(u_plans[0])
+            )
+            solved_u = np.concatenate([
+                _hotmod.solved_rows_of(u_plans[d], d, ub.local_entities)
+                for d in range(s)
+            ]) if s else np.zeros(0, np.int64)
+            solved_m = np.concatenate([
+                _hotmod.solved_rows_of(m_plans[d], d, mb.local_entities)
+                for d in range(s)
+            ]) if s else np.zeros(0, np.int64)
+            mask_u = np.zeros(counts_u.shape, bool)
+            mask_u[solved_u] = True
+            counts_u[~mask_u] = 0
+            mask_m = np.zeros(counts_m.shape, bool)
+            mask_m[solved_m] = True
+            counts_m[~mask_m] = 0
+            slots_u = int(counts_u.sum())
+            slots_m = int(counts_m.sum())
+            if requested is None:
+                f_u = _hotmod.knee_hot_rows(counts_u)
+                f_m = _hotmod.knee_hot_rows(counts_m)
+                total = f_u + f_m
+                if total > admit:
+                    # Budget clamp, proportional — deterministic ints.
+                    f_u = f_u * admit // max(total, 1)
+                    f_m = min(admit - f_u, f_m)
+                    hot_note = (f"knee clamped by budget headroom "
+                                f"({admit} rows admitted)")
+                else:
+                    hot_note = "coverage-curve knee within headroom"
+            else:
+                req = int(requested)
+                if not _budget.hot_reservation_fits(
+                    req, config.rank, stage_name, device_budget_bytes,
+                    reserved_bytes=hot_reserved,
+                ):
+                    need = _budget.hot_reservation_bytes(
+                        req, config.rank, stage_name
+                    )
+                    raise ValueError(
+                        f"hot_rows={req} pinned but its reservation "
+                        f"({need / 1e6:.2f} MB at the {stage_name!r} "
+                        f"staging dtype) exceeds the headroom left by "
+                        f"the accumulator/window/delta-arena terms "
+                        f"({admit * row_b / 1e6:.2f} MB ≈ {admit} rows) "
+                        "— lower hot_rows, raise the device budget, or "
+                        "use hot_rows=0 (the full-staging engine)"
+                    )
+                denom = max(slots_u + slots_m, 1)
+                f_u = req * slots_u // denom
+                f_m = req - f_u
+                hot_note = f"pinned total {req}"
+            f_u = min(f_u, int((counts_u > 0).sum()))
+            f_m = min(f_m, int((counts_m > 0).sum()))
+            if f_u + f_m == 0:
+                hot_note = (hot_note or "") + "; resolved 0 (off)"
+        hot_ctx = None
+        if f_u + f_m > 0:
+            rows_hot_u = _hotmod.select_hot_rows(counts_u, f_u)
+            rows_hot_m = _hotmod.select_hot_rows(counts_m, f_m)
+            hmaps = {
+                ("m", d): _hotmod.build_hot_map(
+                    m_plans[d], schedules[("m", d)], rows_hot_u)
+                for d in range(s)
+            }
+            hmaps.update({
+                ("u", d): _hotmod.build_hot_map(
+                    u_plans[d], schedules[("u", d)], rows_hot_m)
+                for d in range(s)
+            })
+            hot_ctx = {"rows_u": rows_hot_u, "rows_m": rows_hot_m,
+                       "maps": hmaps, "note": hot_note}
     metrics.gauge("offload_windows_m",
                   sum(p.num_windows for p in m_plans))
     metrics.gauge("offload_windows_u",
@@ -869,6 +1389,25 @@ def train_als_host_window(
         metrics.gauge("offload_pool_depth", pool_depth)
         metrics.gauge("offload_pool_workers",
                       pool_workers_for(pool_depth))
+    metrics.note("offload_hot", "on" if hot_ctx is not None else "off")
+    if hot_note:
+        metrics.note("offload_hot_decision", hot_note)
+    if hot_ctx is not None:
+        maps_all = hot_ctx["maps"].values()
+        slots_total = sum(m.slots_total for m in maps_all)
+        metrics.gauge("offload_hot_rows", f_u + f_m)
+        metrics.gauge("offload_hot_rows_u", f_u)
+        metrics.gauge("offload_hot_rows_m", f_m)
+        if slots_total:
+            # Reference coverage: the fraction of per-window row-slots
+            # served from the device (hot partition + delta reuse) — the
+            # staged-table-byte cut before pow2 padding.
+            metrics.gauge("offload_hot_coverage", round(
+                sum(m.slots_hot for m in hot_ctx["maps"].values())
+                / slots_total, 4))
+            metrics.gauge("offload_delta_coverage", round(
+                sum(m.slots_kept for m in hot_ctx["maps"].values())
+                / slots_total, 4))
 
     # Init: identical to the resident trainers (init_factors_stats drawn
     # at the REAL entity count — the shard-count-invariant init — zero
@@ -884,6 +1423,41 @@ def train_als_host_window(
                                          num_shards=s)
     m_store = HostFactorStore(mb.padded_entities, config.rank,
                               dtype=config.dtype, num_shards=s)
+
+    # Hot partitions + per-(side, shard) contexts (ISSUE 15): the device
+    # copies gather from the just-initialized masters (the movie side
+    # starts all-zero, exactly like its store), index constants
+    # device_put once — only the cold delta crosses PCIe per window from
+    # here on.
+    hot_u_part = hot_m_part = None
+    hot_halves: dict = {}
+    if hot_ctx is not None:
+        hot_u_part = HotPartition(hot_ctx["rows_u"], stage_name)
+        hot_m_part = HotPartition(hot_ctx["rows_m"], stage_name)
+        hot_u_part.rebuild(u_store)
+        hot_m_part.rebuild(m_store)
+        from cfk_tpu.offload import hot as _hotmod
+        for d in range(s):
+            sb_m = (_hotmod.ring_scatter_back(d, mb.local_entities,
+                                              hot_m_part.rows)
+                    if ring_m else
+                    _hotmod.scatter_back_maps(m_plans[d], d,
+                                              mb.local_entities,
+                                              hot_m_part.rows))
+            hot_halves[("m", d)] = _HotHalf(
+                hot_u_part, hot_m_part, hot_ctx["maps"][("m", d)], sb_m,
+            )
+            sb_u = (_hotmod.ring_scatter_back(d, ub.local_entities,
+                                              hot_u_part.rows)
+                    if ring_u else
+                    _hotmod.scatter_back_maps(u_plans[d], d,
+                                              ub.local_entities,
+                                              hot_u_part.rows))
+            hot_halves[("u", d)] = _HotHalf(
+                hot_m_part, hot_u_part, hot_ctx["maps"][("u", d)], sb_u,
+            )
+        metrics.gauge("offload_hot_resident_mb", round(
+            (hot_u_part.nbytes + hot_m_part.nbytes) / 1e6, 3))
 
     policy = policy_from_config(config)
     base_ov = Overrides(lam=config.lam, fused_epilogue=config.fused_epilogue)
@@ -949,8 +1523,29 @@ def train_als_host_window(
             for d in range(s)
         ]
         tasks = [(d, w) for d in range(s) for w in schedules[d]]
+        hot_on = bool(hot_halves)
+        if hot_on and window_faults is not None:
+            # Chaos seam (ISSUE 15): poison the FIXED side's device
+            # partition before the half reads it — the host master is
+            # untouched, so the sentinel trip that follows rolls back
+            # and `rebuild` recovers the partition bit-exactly.
+            part = hot_halves[(side, 0)].fixed
+            pois = (window_faults.apply_hot(it, side, part.num_rows)
+                    if hasattr(window_faults, "apply_hot") else None)
+            if pois is not None:
+                record_event("fault", "hot_cache_corruption",
+                             iteration=it, side=side, rows=len(pois))
+                part.poison(pois)
 
         def stage_task(d, w):
+            if hot_on:
+                return _stage_window_delta(
+                    fixed_store, plans[d], hot_halves[(side, d)].hmap, w,
+                    stage_np=stage_np_cfg, int8=int8_cfg,
+                    faults=window_faults, iteration=it, side=side,
+                    shard=d, verify_windows=verify_windows, stats=stats,
+                    ici_group=inner,
+                )
             return _stage_window(
                 fixed_store, plans[d], w, stage_np=stage_np_cfg,
                 int8=int8_cfg, faults=window_faults, iteration=it,
@@ -958,14 +1553,22 @@ def train_als_host_window(
                 stats=stats, ici_group=inner,
             )
 
+        def stage_attrs(d, w):
+            return _stage_span_attrs(
+                hot_halves[(side, d)].hmap if hot_on else None,
+                plans[d], w,
+            )
+
         stager = WindowStager(tasks, stage_task, mode=staging,
-                              depth=pool_depth, stats=stats)
+                              depth=pool_depth, stats=stats,
+                              span_attrs=stage_attrs)
         try:
             for d in range(s):
                 kw = dict(half_kw, lam=ov.lam,
                           fused_epilogue=ov.fused_epilogue,
                           reg_solve_algo=algo, iteration=it, side=side,
-                          shard=d, stager=stager)
+                          shard=d, stager=stager,
+                          hot=hot_halves.get((side, d)))
                 with span("train/iter/half_step", side=side, shard=d,
                           ring=bool(ring), iteration=it):
                     if ring:
@@ -999,6 +1602,15 @@ def train_als_host_window(
     train_t0 = time.time()
     first_step_s = None
 
+    def _rebuild_hot() -> None:
+        """Rollback heals the hot partitions the same way it heals the
+        stores: re-gather from the restored host masters (ISSUE 15 —
+        a poisoned or stale device partition cannot survive a rollback,
+        so replay is bit-identical to a fresh run)."""
+        if hot_u_part is not None:
+            hot_u_part.rebuild(u_store)
+            hot_m_part.rebuild(m_store)
+
     def trip(reason: str) -> bool:
         """Rollback + ladder climb; returns False when retries are
         exhausted (degrade — the caller breaks the loop)."""
@@ -1026,9 +1638,11 @@ def train_als_host_window(
             dump_flight("degraded")
             u_store, m_store = snap
             it = snap_iter
+            _rebuild_hot()
             return False
         u_store, m_store = snap[0].copy(), snap[1].copy()
         it = snap_iter
+        _rebuild_hot()
         metrics.incr("rollbacks")
         new_ov = policy.escalate(ov, trips)
         detail = (
@@ -1090,8 +1704,15 @@ def train_als_host_window(
     metrics.gauge("offload_windows_staged", stats.get("windows_staged", 0))
     metrics.gauge("offload_staged_mb",
                   round(stats.get("staged_bytes", 0) / 1e6, 3))
-    metrics.gauge("offload_staged_table_mb",
-                  round(stats.get("staged_table_bytes", 0) / 1e6, 3))
+    # The staged TABLE share, split per ISSUE 15: cold bytes actually
+    # shipped over PCIe vs the device-resident hot partition (0 when the
+    # cache is off — then cold == the whole table share, the PR 12
+    # number under its new name).
+    metrics.gauge("offload_staged_cold_mb",
+                  round(stats.get("staged_cold_bytes", 0) / 1e6, 3))
+    for key_ in ("rows_staged", "rows_delta_skipped", "rows_hot_device"):
+        if key_ in stats:
+            metrics.gauge(f"offload_{key_}", stats[key_])
     # Staging-engine accounting (ISSUE 13): busy = summed staging task
     # seconds, stall = the consuming thread's exposed wait (== busy in
     # serial mode by construction), hidden = 1 − stall/busy.  All read

@@ -215,6 +215,23 @@ def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
         window_dup = 1.15
         pcie = ((shape.num_users + shape.num_movies) * stage_bytes_per_row
                 * window_dup / shards / device.pcie_bytes_per_s)
+        # Hot-row cache (ISSUE 15): the term scales by the COLD
+        # reference fraction.  The resolver cannot see the real skew, so
+        # the coverage of a top-f head is estimated with the Zipf(1)
+        # harmonic mass H_f/H_n ≈ ln(1+f)/ln(1+n) — the curve the
+        # counter-based synth generator (and Netflix-like data) follows
+        # closely enough to RANK hot against cold staging; the executor
+        # meters the real per-window coverage (offload_hot_coverage) and
+        # the bench hot-A/B row records the measured cut.  Floored so a
+        # hot plan never looks free: the cold tail and the chunk arrays
+        # still cross PCIe every window.
+        if plan.hot_rows > 0:
+            import math
+
+            n = shape.num_users + shape.num_movies
+            f = min(plan.hot_rows, n)
+            coverage = math.log1p(f) / max(math.log1p(n), 1e-9)
+            pcie *= max(1.0 - coverage, 0.05)
         if plan.staging == "pool":
             exposed_pcie = max(0.0, pcie - floor)
         elif plan.overlap:

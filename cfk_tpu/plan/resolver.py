@@ -43,7 +43,7 @@ from cfk_tpu.plan.spec import (
 _TRAIN_FIELDS = ("layout", "exchange", "chunk_elems", "fused_epilogue",
                  "in_kernel_gather", "overlap", "reg_solve_algo",
                  "table_dtype", "solver", "gram_backend", "offload_tier",
-                 "ici_group", "staging")
+                 "ici_group", "staging", "hot_rows")
 _SERVE_FIELDS = ("table_dtype", "serve_batch_quantum", "serve_tile_m")
 
 
@@ -95,6 +95,12 @@ def hard_conflict(shape: ProblemShape, pins: dict) -> str | None:
         return (f"ici_group={ici} must divide "
                 f"num_shards={shape.num_shards} (the outer ring walks "
                 "whole inner rings)")
+    if pins.get("hot_rows") and pins.get("offload_tier") == "device":
+        # The hot cache is the host_window tier's staged-byte lever; the
+        # device tier has no staging to cut.
+        return (f"hot_rows={pins['hot_rows']} is a host_window-tier "
+                "knob (it cuts staged PCIe bytes); pinned "
+                "offload_tier='device' has no staging — unpin one side")
     return None
 
 
@@ -127,6 +133,9 @@ def _feasible(shape: ProblemShape, device: DeviceSpec, cand: dict,
         # ring/hier_ring visit schedules; the generic exchange rules
         # above already refuse ring exchanges at one shard and non-tiled
         # ring layouts.
+    if cand["hot_rows"] and cand["offload_tier"] != "host_window":
+        return ("the hot-row cache is the host_window tier's staged-byte "
+                "lever (the resident tier has no staging)")
     mosaic = _registry.backend_available("mosaic_tpu")
     if cand["gram_backend"] == "pallas" and not mosaic:
         return "mosaic_tpu backend unavailable"
@@ -245,6 +254,19 @@ def candidates(shape: ProblemShape, constraints: PlanConstraints,
                                 table_dtype=pins.get("table_dtype")))
                         else ("device",))
                 tier_vals = vals
+            if f == "hot_rows":
+                # Like the tier axis, this one IS a budget predicate
+                # (ISSUE 15): a free hot_rows on the host_window tier
+                # resolves to the ~10% power-law target when the hot
+                # reservation fits the planner-side headroom, 0
+                # otherwise — so the plan carries a nonzero hot fraction
+                # ONLY when the budget admits it.  The executor clamps
+                # the target to the real coverage-curve knee (and its
+                # exact headroom) at window-plan build time.
+                vals = ((_planner_hot_rows(shape, device, pins),)
+                        if ("host_window" in tier_vals
+                            and device is not None)
+                        else (0,))
             axes.append((f, vals))
     names = [f for f, _ in axes]
     return names, itertools.product(*[v for _, v in axes])
@@ -255,6 +277,27 @@ def _fits_device(shape: ProblemShape, device: DeviceSpec,
     from cfk_tpu.offload.budget import shape_fits_device
 
     return shape_fits_device(shape, device, table_dtype=table_dtype)
+
+
+def _stage_dtype_of(shape: ProblemShape, pins: dict) -> str:
+    """The staging dtype the hot reservation is charged at: the pinned
+    table dtype when it shrinks staging (bf16/int8), else the storage
+    dtype — with an UNPINNED table dtype charged at the storage dtype
+    (the largest candidate: the conservative reservation)."""
+    td = pins.get("table_dtype")
+    if td in ("bfloat16", "int8"):
+        return td
+    return shape.dtype
+
+
+def _planner_hot_rows(shape: ProblemShape, device: DeviceSpec,
+                      pins: dict) -> int:
+    from cfk_tpu.offload.budget import planner_hot_rows
+
+    return planner_hot_rows(
+        shape.num_users, shape.num_movies, shape.rank,
+        _stage_dtype_of(shape, pins), device.hbm_bytes,
+    )
 
 
 def _host_window_eligible(shape: ProblemShape, pins: dict) -> bool:
@@ -337,6 +380,69 @@ def _rank_plans(shape: ProblemShape, device: DeviceSpec,
             "unpin offload_tier (the resolver will pick 'host_window') "
             "or shrink the problem"
         )
+    # Hot-row cache resolution (ISSUE 15) — the hot-fraction decision
+    # the plan CLI's --explain prints: which tier this resolve takes,
+    # whether the reservation fits, and the target the axis will carry.
+    will_host_window = (
+        pins.get("offload_tier") == "host_window"
+        or (_host_window_eligible(shape, pins)
+            and "offload_tier" not in pins
+            and not _fits_device(shape, device,
+                                 table_dtype=pins.get("table_dtype")))
+    )
+    hot_pin = pins.get("hot_rows")
+    if hot_pin:
+        if not will_host_window:
+            # Execution ignores the knob on the resident tier (the
+            # windowed driver is the only consumer) — release, don't
+            # raise, per the _SOFT_PINS convention.
+            explain.append(("hot_rows", None,
+                            f"pinned {hot_pin} but this resolve stays on "
+                            "the resident tier (no staging to cut); "
+                            "released to the execution-time no-op"))
+            pins.pop("hot_rows")
+        else:
+            from cfk_tpu.offload.budget import (
+                hot_reservation_bytes,
+                hot_reservation_fits,
+                max_hot_rows,
+            )
+
+            stage = _stage_dtype_of(shape, pins)
+            if not hot_reservation_fits(hot_pin, shape.rank, stage,
+                                        device.hbm_bytes):
+                need = hot_reservation_bytes(hot_pin, shape.rank, stage)
+                admit = max_hot_rows(device.hbm_bytes, shape.rank, stage)
+                # Mirror the pinned-impossible offload_tier convention:
+                # a reservation the budget predicate refuses raises AT
+                # RESOLUTION, naming the bytes.
+                raise PlanConstraintError(
+                    f"hot_rows={hot_pin} pinned but its device "
+                    f"reservation ({need / 1e6:.2f} MB at the {stage!r} "
+                    f"staging dtype) exceeds the hot-cache budget share "
+                    f"({admit} rows on this device) — lower hot_rows, "
+                    "unpin it (the resolver clamps to the headroom), or "
+                    "pin 0 for the full-staging engine"
+                )
+    elif hot_pin is None and will_host_window and device is not None:
+        target = _planner_hot_rows(shape, device, pins)
+        stage = _stage_dtype_of(shape, pins)
+        if target > 0:
+            from cfk_tpu.offload.budget import hot_reservation_bytes
+
+            explain.append((
+                "hot_rows", target,
+                f"budget headroom admits the hot reservation "
+                f"({hot_reservation_bytes(target, shape.rank, stage) / 1e6:.2f}"
+                f" MB at {stage}) — target min(~10% of rows, headroom); "
+                "the executor clamps to the coverage-curve knee"
+            ))
+        else:
+            explain.append((
+                "hot_rows", 0,
+                "hot reservation refused by the budget headroom — "
+                "windows stage their full row sets"
+            ))
     pins = _soft_release(shape, device, pins, explain)
     constraints = PlanConstraints(**pins)
     names, prod = candidates(shape, constraints, device)

@@ -89,12 +89,28 @@ PLAN_FIELDS: dict[str, tuple] = {
     # existence rotates the autotune field-set digest — pre-staging
     # winners carry no decision for it and must miss.
     "staging": ("pool", "serial"),
+    # Skew-aware hot-row device cache of the host_window tier (ISSUE
+    # 15): the TOTAL top-referenced fixed-table rows (both sides) kept
+    # device-resident at the staging dtype, so windows stage only their
+    # cold delta.  0 = off (the PR 12 full-staging engine).  A free
+    # field resolves through the resolver's budget-predicate axis: the
+    # ~10% power-law target when the reservation fits the headroom
+    # (offload.budget.planner_hot_rows), 0 otherwise — "nonzero only
+    # when the budget admits".  The executor re-resolves the exact count
+    # against the real coverage-curve knee at window-plan build time;
+    # the plan's value is the budget-admitted TARGET the cost model
+    # priced.  crc-identical across the knob; adding the field rotates
+    # the autotune digest (pre-hot winners carry no decision for it).
+    "hot_rows": (0,),
 }
 
 # Fields whose pins are free-form positive ints (the candidate tuples
 # above are only the resolver's enumeration grid for UNPINNED fields).
 _NUMERIC_FIELDS = ("chunk_elems", "serve_batch_quantum", "serve_tile_m",
-                   "ici_group")
+                   "ici_group", "hot_rows")
+# Numeric fields where 0 is a legal pin (an explicit OFF, not "unset"):
+# hot_rows=0 pins the full-staging engine.
+_ZERO_OK_FIELDS = ("hot_rows",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,6 +256,7 @@ class PlanConstraints:
     offload_tier: str | None = None
     ici_group: int | None = None
     staging: str | None = None
+    hot_rows: int | None = None
 
     def __post_init__(self) -> None:
         for f, candidates in PLAN_FIELDS.items():
@@ -248,10 +265,13 @@ class PlanConstraints:
                 continue
             if f in _NUMERIC_FIELDS:
                 # Numeric pins accept any positive value (the candidate
-                # tuple is only the resolver's enumeration grid).
-                if not isinstance(v, int) or v < 1:
+                # tuple is only the resolver's enumeration grid); the
+                # _ZERO_OK_FIELDS additionally accept an explicit 0.
+                floor = 0 if f in _ZERO_OK_FIELDS else 1
+                if not isinstance(v, int) or v < floor:
                     raise PlanConstraintError(
                         f"constraint {f}={v!r} must be a positive int"
+                        + (" (or 0 = off)" if floor == 0 else "")
                     )
             elif v not in candidates:
                 raise PlanConstraintError(
@@ -311,6 +331,9 @@ def constraints_from_config(config) -> PlanConstraints:
         staging=("pool"
                  if getattr(config, "staging", "auto") == "auto"
                  else config.staging),
+        # hot_rows: None (auto) stays FREE — the resolver's budget-
+        # predicate axis decides; an explicit 0 (off) or count pins.
+        hot_rows=getattr(config, "hot_rows", None),
     )
 
 
@@ -344,6 +367,10 @@ class ExecutionPlan:
     # Host staging engine of the host_window tier (ISSUE 13): "pool"
     # (concurrent per-(shard, window) staging, the default) | "serial".
     staging: str = "pool"
+    # Hot-row device cache target of the host_window tier (ISSUE 15):
+    # total resident rows across both sides (0 = off — the device tier's
+    # only value, and the budget-refused resolution).
+    hot_rows: int = 0
     # (slot, backend) pairs — "mosaic_tpu" | "xla_emulation" per kernel
     # slot (cfk_tpu.plan.registry.KERNEL_SLOTS).
     kernels: tuple = ()
@@ -389,6 +416,8 @@ class ExecutionPlan:
             tier += f"ici={self.ici_group} "
         if self.offload_tier == "host_window" and self.staging != "pool":
             tier += f"stage={self.staging} "
+        if self.offload_tier == "host_window" and self.hot_rows:
+            tier += f"hot={self.hot_rows} "
         return (f"{tier}{self.layout}/{self.exchange} "
                 f"chunk={self.chunk_elems} "
                 f"fused={'on' if self.fused_epilogue else 'off'} "

@@ -228,6 +228,39 @@ class HostWindowCorruption:
 
 
 @dataclasses.dataclass
+class HotCacheCorruption:
+    """Poison the DEVICE-RESIDENT hot partition (ISSUE 15) — an HBM
+    bit-flip / DMA fault landing in the skew-aware hot-row cache rather
+    than a staged window.  Fires when the windowed driver is about to
+    READ the ``(iteration, side)`` half's fixed partition; the driver
+    NaNs ``num_rows`` partition positions (the int8 pair poisons the
+    per-row scale — the one leaf that can go nonfinite).  The host
+    master store is untouched, so the sentinel trip that follows rolls
+    back and the partition REBUILD from the master recovers bit-exact
+    factors — the hot-cache analog of ``HostWindowCorruption``'s
+    transient-fault contract."""
+
+    iteration: int
+    side: str = "m"
+    num_rows: int = 4
+    seed: int = 0
+    persistent: bool = False
+    fired: int = 0
+
+    def apply_hot(self, i: int, side: str,
+                  partition_rows: int = 0) -> np.ndarray | None:
+        if (i != self.iteration or side != self.side
+                or partition_rows < 1
+                or (self.fired and not self.persistent)):
+            return None
+        self.fired += 1
+        return np.random.default_rng(self.seed).choice(
+            partition_rows, size=min(self.num_rows, partition_rows),
+            replace=False,
+        )
+
+
+@dataclasses.dataclass
 class SlowHostFetch:
     """Delay plan for window staging (a contended host / remote-NUMA
     fetch):
@@ -316,6 +349,17 @@ class WindowFaultInjector:
         for f in self.faults:
             if hasattr(f, "delay"):
                 f.delay(i, side, w, shard=shard)
+
+    def apply_hot(self, i: int, side: str,
+                  partition_rows: int = 0) -> np.ndarray | None:
+        """Poison positions for the (iteration, side) half's hot
+        partition, or None (ISSUE 15 — ``HotCacheCorruption``)."""
+        for f in self.faults:
+            if hasattr(f, "apply_hot"):
+                rows = f.apply_hot(i, side, partition_rows)
+                if rows is not None:
+                    return rows
+        return None
 
     @property
     def fired(self) -> int:

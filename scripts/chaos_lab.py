@@ -914,8 +914,14 @@ def scenario_offload_window_sharded() -> dict:
         layout="tiled", chunk_elems=512, tile_rows=16,
         accum_max_entities=0,
     )
+    # hot_rows=0: this scenario drills the FULL-staging integrity path
+    # (its window corruptions must land on staged table rows; under the
+    # ISSUE 15 hot/delta engine a targeted window's delta can be EMPTY
+    # on a tiny sharded shape and the fault would corrupt nothing).  The
+    # hot engine's own fault paths — partition NaN + torn cold delta —
+    # are the `hot_cache` scenario's job.
     cfg = _dc.replace(_base_cfg(num_shards=2), layout="tiled",
-                      solver="pallas")
+                      solver="pallas", hot_rows=0)
 
     def crc(model):
         return zlib.crc32(np.asarray(
@@ -984,6 +990,123 @@ def scenario_offload_window_sharded() -> dict:
     row["torn_on_one_shard_bit_exact"] = bool(crc2 == base_crc)
     row["transitions_recorded"] = transitions
     row["slow_fetch_fired_on_straggler"] = int(torn_fault.faults[1].fired)
+    return row
+
+
+def scenario_hot_cache() -> dict:
+    """ISSUE 15: faults in the skew-aware hot-row device cache.
+
+    Two drills on the stream-tiled dataset, both with the hot/delta
+    engine ON (auto resolution) against the hot-off AND resident crcs
+    (the hot == full-staging == resident chain that makes bit-exact
+    recovery meaningful):
+
+    1. ``hot partition NaN``: ``HotCacheCorruption`` poisons rows of the
+       DEVICE-RESIDENT user partition before the m half reads it (the
+       host master is untouched).  The poison flows through assembled
+       windows into solved factors, the sentinel trips, and rollback
+       REBUILDS the partition from the host master — the replay
+       (one-shot fault) lands crc-identical to fault-free.
+    2. ``torn cold delta``: a ``HostWindowCorruption(kind='torn')`` on a
+       staged COLD DELTA (with the hot engine on, the gathered rows the
+       fault corrupts ARE the delta).  The existing staging crc32
+       contract catches the tear BEFORE any kernel consumes it;
+       rollback + replay is crc-identical — proving the integrity seam
+       survived the staging-path change.
+
+    Both recoveries are recorded as plan transitions; the flight dump's
+    tail names the fault (``hot_cache_corruption`` / ``health_trip``)."""
+    import dataclasses as _dc
+    import zlib
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.plan import plan_for_config
+    from cfk_tpu.resilience.faults import (
+        HostWindowCorruption,
+        HotCacheCorruption,
+        WindowFaultInjector,
+    )
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(60, 30, 900, seed=0), layout="tiled",
+        chunk_elems=512, tile_rows=16, accum_max_entities=0,
+    )
+    cfg = _dc.replace(_base_cfg(), layout="tiled", solver="pallas")
+
+    def crc(model):
+        return zlib.crc32(np.asarray(
+            model.user_factors, np.float32
+        ).tobytes())
+
+    m_base = Metrics()
+    base = train_als_host_window(ds, cfg, chunks_per_window=2,
+                                 metrics=m_base)
+    base_rmse, base_crc = _rmse(base, ds), crc(base)
+    hot_resolved = int(m_base.gauges.get("offload_hot_rows", 0))
+    hot_off_crc = crc(train_als_host_window(ds, cfg, chunks_per_window=2,
+                                            hot_rows=0))
+    resident_crc = crc(_train(ds, cfg))
+
+    nnz = int(ds.movie_blocks.count.sum())
+    shape_kw = dict(num_users=ds.user_map.num_entities,
+                    num_movies=ds.movie_map.num_entities, nnz=nnz)
+
+    # Drill 1: NaN in the device-resident hot partition — the sentinel
+    # path plus the rollback partition REBUILD.  Target the half whose
+    # FIXED partition is non-empty (the auto knee may resolve one side
+    # to 0 rows at this tiny shape): the m half reads the USER
+    # partition, the u half the MOVIE one.
+    nan_side = ("m" if m_base.gauges.get("offload_hot_rows_u", 0) > 0
+                else "u")
+    nan_fault = WindowFaultInjector(
+        HotCacheCorruption(iteration=1, side=nan_side),
+    )
+    m1 = Metrics()
+    prov1 = plan_for_config(cfg, **shape_kw)[1]
+    rec1 = train_als_host_window(
+        ds, cfg, chunks_per_window=2, metrics=m1, window_faults=nan_fault,
+        plan_provenance=prov1, verify_windows=False,
+    )
+    # Drill 2: torn COLD-DELTA stage — the staging crc32 contract on the
+    # hot engine's residual staging path.
+    torn_fault = WindowFaultInjector(
+        HostWindowCorruption(iteration=2, side="u", window=0,
+                             kind="torn"),
+    )
+    m2 = Metrics()
+    prov2 = plan_for_config(cfg, **shape_kw)[1]
+    rec2 = train_als_host_window(
+        ds, cfg, chunks_per_window=2, metrics=m2,
+        window_faults=torn_fault, plan_provenance=prov2,
+    )
+
+    crc1, crc2 = crc(rec1), crc(rec2)
+    transitions = bool(prov1.transitions) and bool(prov2.transitions)
+    torn_detected = m2.counters.get("health_trips", 0) >= 1
+    for k_, v in m2.counters.items():
+        m1.counters[k_] = m1.counters.get(k_, 0) + v
+    m1.notes.update({f"torn_{k_}": v for k_, v in m2.notes.items()})
+    row = _row(
+        "hot_cache",
+        fired=nan_fault.fired + torn_fault.fired,
+        metrics=m1, base_rmse=base_rmse, rec_rmse=_rmse(rec1, ds),
+        ok_extra=(
+            hot_resolved > 0
+            and base_crc == hot_off_crc == resident_crc
+            and crc1 == base_crc and crc2 == base_crc
+            and transitions and torn_detected
+        ),
+    )
+    row["hot_rows_resolved"] = hot_resolved
+    row["hot_equals_off_equals_resident"] = bool(
+        base_crc == hot_off_crc == resident_crc
+    )
+    row["hot_nan_rebuild_bit_exact"] = bool(crc1 == base_crc)
+    row["torn_delta_bit_exact"] = bool(crc2 == base_crc)
+    row["transitions_recorded"] = transitions
     return row
 
 
@@ -1297,6 +1420,7 @@ SCENARIOS = {
     "offload_window": scenario_offload_window,
     "offload_window_sharded": scenario_offload_window_sharded,
     "staging_pool": scenario_staging_pool,
+    "hot_cache": scenario_hot_cache,
     "telemetry_overhead": scenario_telemetry_overhead,
 }
 
@@ -1326,6 +1450,7 @@ FLIGHT_EXPECT = {
     "offload_window": ("health_trip",),
     "offload_window_sharded": ("health_trip",),
     "staging_pool": ("health_trip", "staging_error"),
+    "hot_cache": ("hot_cache_corruption", "health_trip"),
     "telemetry_overhead": ("telemetry_overhead",),
 }
 

@@ -218,6 +218,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="windows staged ahead of consumption (pool "
                    "mode); clamped so depth+1 worst windows fit the "
                    "window budget")
+    p.add_argument("--hot-rows", type=int, default=None,
+                   help="hot-row device cache axis of the host_window "
+                   "tier (ISSUE 15): total top-referenced fixed-table "
+                   "rows kept device-resident so windows stage only "
+                   "their cold delta.  None = auto (coverage-curve knee "
+                   "under the budget headroom), 0 = off (the PR 12 "
+                   "full-staging engine — the A/B baseline), N = pinned "
+                   "total.  crc equality across the axis is pinned by "
+                   "the tier-1 smoke; the row records the resolved "
+                   "fraction, reference coverage, and hot/cold staged "
+                   "MB")
     p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
                    help="persistent jax compilation cache (ISSUE 13), "
                    "keyed per device fingerprint: a second lab run "
@@ -577,6 +588,7 @@ def run_offload_lab(args) -> dict:
                 device_budget_bytes=budget,
                 staging=args.staging,
                 pool_depth=args.staging_pool_depth,
+                hot_rows=args.hot_rows,
             )
         if shards > 1:
             from cfk_tpu.parallel.spmd import train_als_sharded
@@ -670,9 +682,21 @@ def run_offload_lab(args) -> dict:
                 "offload_chunks_per_window"
             ),
             "staged_mb_per_run": metrics.gauges.get("offload_staged_mb"),
-            "staged_table_mb_per_run": metrics.gauges.get(
-                "offload_staged_table_mb"
+            # Split per ISSUE 15: cold = table bytes that crossed PCIe
+            # (the whole table share when the hot cache is off), hot =
+            # the device-resident partition.
+            "staged_cold_mb_per_run": metrics.gauges.get(
+                "offload_staged_cold_mb"
             ),
+            "hot_resident_mb": metrics.gauges.get(
+                "offload_hot_resident_mb"
+            ),
+            "hot_rows": metrics.gauges.get("offload_hot_rows", 0),
+            "hot_coverage": metrics.gauges.get("offload_hot_coverage"),
+            "delta_coverage": metrics.gauges.get(
+                "offload_delta_coverage"
+            ),
+            "hot": metrics.notes.get("offload_hot"),
             "plan_held_mb": metrics.gauges.get("offload_plan_held_mb"),
             "staged_rows_local": metrics.gauges.get("offload_rows_local"),
             "staged_rows_ici": metrics.gauges.get("offload_rows_ici"),

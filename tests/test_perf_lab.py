@@ -33,6 +33,7 @@ def _args(**over):
         offload=None, offload_window_chunks=4, offload_budget_mb=None,
         offload_shards=1,
         staging=None, staging_pool_depth=None, compile_cache_dir=None,
+        hot_rows=None,
         plan=None, plan_cache=None,
         telemetry="off", trace_dir=None,
         iters=2, repeats=3, profile_dir=None,
@@ -244,10 +245,36 @@ def test_offload_axis_row(tmp_path, monkeypatch, capsys):
     assert win["windows_m"] >= 1 and win["windows_u"] >= 1
     assert win["window_rows_m"] >= 8
     assert win["staged_mb_per_run"] > 0
-    assert win["staged_table_mb_per_run"] > 0
+    assert win["staged_cold_mb_per_run"] > 0
     assert win["plan_held_mb"] > 0
     # windowed == resident, bit-exact — the ISSUE 11 acceptance contract
     assert win["factors_crc32"] == dev["factors_crc32"]
+
+
+def test_offload_axis_hot_row(tmp_path, monkeypatch):
+    # The hot-row cache axis (ISSUE 15): hot off (the PR 12 engine),
+    # auto (coverage-knee resolution), and a pinned count all run the
+    # SAME host_window workload — crc equality across the axis is the
+    # hot/cold bit-exactness proof through the lab itself, and the hot
+    # arms' rows carry the split metering (cold staged vs hot resident).
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    base = dict(layout="tiled", users=200, movies=60, nnz=1500,
+                chunk_elems=512, tile_rows=16, rank=8, iters=2, repeats=2,
+                offload="host_window", offload_window_chunks=2)
+    off = perf_lab.run_lab(_args(hot_rows=0, **base))
+    auto = perf_lab.run_lab(_args(hot_rows=None, **base))
+    pinned = perf_lab.run_lab(_args(hot_rows=12, **base))
+    assert off["hot"] == "off" and off["hot_rows"] == 0
+    assert off["hot_resident_mb"] in (None, 0, 0.0)
+    assert auto["hot"] == "on" and auto["hot_rows"] > 0
+    assert auto["hot_coverage"] > 0
+    assert auto["hot_resident_mb"] > 0
+    # The cache exists to cut staged table bytes — auto must not stage
+    # MORE than full staging on the same schedule.
+    assert auto["staged_cold_mb_per_run"] < off["staged_cold_mb_per_run"]
+    assert pinned["hot_rows"] <= 12 and pinned["hot_rows"] > 0
+    assert (off["factors_crc32"] == auto["factors_crc32"]
+            == pinned["factors_crc32"])
 
 
 def test_offload_axis_staging_row(tmp_path, monkeypatch):
