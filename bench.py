@@ -59,7 +59,7 @@ def _compact_row(row: dict) -> dict:
             "factors_bit_exact", "removed_bytes_per_chunk",
             "save_stall_removed_s_per_save", "foldin_rmse_over_retrain",
             "p50_ms", "p99_ms", "vs_roofline", "best_batch",
-            "tiers", "crossed_to_host_window")
+            "tiers", "crossed_to_host_window", "bytes_cut", "recall_at_k")
     return {k: row[k] for k in keep if k in row}
 
 
@@ -2260,25 +2260,51 @@ def _serve_row() -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _serve_factors(args, rng):
+    """Synthetic factor tables at the requested shape.
+
+    Mixture-of-Gaussians ITEM factors with user vectors aligned to the
+    components under a skewed popularity law — trained CF factor tables
+    cluster (the IVF premise the two-stage index banks on), and the
+    two_stage rows' MEASURED batch-union width depends on that structure,
+    so i.i.d. factors would misstate the one cost axis this bench exists
+    to record.  Exact-scan cost stays value-independent either way."""
+    import numpy as np
+
+    k = args.serve_rank
+    ncomp = min(64, max(args.serve_movies // 16, 1))
+    comp = rng.standard_normal((ncomp, k)).astype(np.float32) * 0.3
+    m = (comp[rng.integers(0, ncomp, size=args.serve_movies)]
+         + rng.standard_normal((args.serve_movies, k),
+                               dtype=np.float32) * 0.05)
+    w = 1.0 / np.arange(1, ncomp + 1, dtype=np.float64) ** 1.2
+    # sorted draw: zipf traffic hammers LOW user rows (loadgen), and the
+    # heavy components sort first, so the hot rows share components —
+    # a coalesced batch's probed clusters then OVERLAP, the same
+    # popularity-skew premise the hot-row device cache (PR 14) banks on
+    u_comp = np.sort(rng.choice(ncomp, size=args.serve_users,
+                                p=w / w.sum()))
+    u = (comp[u_comp]
+         + rng.standard_normal((args.serve_users, k),
+                               dtype=np.float32) * 0.05)
+    return u, m
+
+
 def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh,
-                  plan=None):
+                  plan=None, serve_mode="exact"):
     """Engine + synthetic serving state at the requested shape.
 
-    Factors are random — serving cost is independent of factor VALUES
-    (the same rationale as perf_lab's fold-in base model); the seen-CSR
-    is built only for the loadgen's user pool (the rows traffic will
-    touch), at the ML-25M mean ratings/user, so exclusion masking is
-    exercised at realistic widths without materializing 25M seen cells.
+    Factors come from ``_serve_factors`` (clustered — see its docstring);
+    the seen-CSR is built only for the loadgen's user pool (the rows
+    traffic will touch), at the ML-25M mean ratings/user, so exclusion
+    masking is exercised at realistic widths without materializing 25M
+    seen cells.
     """
     import numpy as np
 
     from cfk_tpu.serving.engine import ServeEngine
 
-    k = args.serve_rank
-    u = (rng.standard_normal((args.serve_users, k), dtype=np.float32)
-         * 0.1)
-    m = (rng.standard_normal((args.serve_movies, k), dtype=np.float32)
-         * 0.1)
+    u, m = _serve_factors(args, rng)
     mean_seen = max(1, args.serve_nnz // args.serve_users)
     pool = np.unique(jnp_users)
     counts = np.zeros(args.serve_users, np.int64)
@@ -2295,6 +2321,9 @@ def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh,
         u, m, num_users=args.serve_users, num_movies=args.serve_movies,
         seen_movies=seen, seen_indptr=indptr, table_dtype=table_dtype,
         tile_m=args.serve_tile_m, mesh=mesh, plan=plan,
+        serve_mode=serve_mode,
+        clusters=args.serve_clusters or None,
+        probe_clusters=args.serve_probe_clusters or None,
     )
 
 
@@ -2311,6 +2340,16 @@ def run_serve(args) -> dict:
     repo's first latency-axis bench rows.  Multi-shard rows run the
     item-sharded path on a virtual CPU mesh (equality with single-shard
     is pinned by tier-1 tests; rows here measure the merge overhead).
+
+    ISSUE 16 adds the serve-mode axis: two_stage rows run the clustered
+    centroid-probe → shortlist-rescore path and EVERY row now records
+    measured ``recall_at_k`` (vs the same engine's bit-exact scan;
+    exact rows are 1.0 by construction) and ``bytes_scanned_per_batch``
+    for the EXECUTED mode (the two_stage figure uses the REAL batch-union
+    shortlist width, not the closed-form expectation), with
+    ``vs_roofline`` against that mode's own floor.  The summary carries
+    the headline A/B: the bytes cut of the best two_stage row over its
+    exact twin at the same (batch, dtype), with its recall.
     """
     import numpy as np
 
@@ -2341,20 +2380,28 @@ def run_serve(args) -> dict:
     ])
     batch_list = [int(b) for b in args.serve_batches.split(",") if b]
     dtype_list = [d for d in args.serve_dtypes.split(",") if d]
-    sweeps = [(b, "float32", 1) for b in batch_list]
-    sweeps += [(batch_list[-1], d, 1) for d in dtype_list
-               if d != "float32"]
-    sweeps += [(batch_list[-1], "float32", s) for s in shard_list if s > 1]
+    mode_list = [m for m in args.serve_modes.split(",") if m]
+    sweeps = []
+    for mode in mode_list:
+        sweeps += [(b, "float32", 1, mode) for b in batch_list]
+        sweeps += [(batch_list[-1], d, 1, mode) for d in dtype_list
+                   if d != "float32"]
+        if mode == "exact":
+            # two_stage rescores its (small) shortlist on one device —
+            # the shard axis partitions the full scan, so it is an
+            # exact-mode axis only
+            sweeps += [(batch_list[-1], "float32", s, mode)
+                       for s in shard_list if s > 1]
     rows = []
     engines: dict = {}
     prewarms: dict = {}
-    for batch, td, shards in sweeps:
-        key = (td, shards)
+    for batch, td, shards, mode in sweeps:
+        key = (td, shards, mode)
         if key not in engines:
             mesh = make_mesh(shards) if shards > 1 else None
             engines[key] = _serve_engine(
                 args, pool, np.random.default_rng(args.seed + 2),
-                table_dtype=td, shards=shards, mesh=mesh,
+                table_dtype=td, shards=shards, mesh=mesh, serve_mode=mode,
             )
             # Warm-start (ISSUE 13): trace/compile the pow2 batch-bucket
             # set before traffic — the per-row first batch then shows
@@ -2388,19 +2435,42 @@ def run_serve(args) -> dict:
             user_rows=traffic,
             k=args.serve_k, server=server, drive_server=True,
         )
-        cost = serve_batch_cost(
-            args.serve_movies, args.serve_rank, batch, args.serve_k,
-            table_dtype=td, m_pad=eng.table_rows,
-        )
+        # recall vs the SAME engine's bit-exact scan (force_exact skips
+        # the candidate stage but keeps table/masks/jit), and the scan
+        # accounting of the executed mode — both first-class per row
+        from cfk_tpu.serving import recall_at_k
+
+        _, ids = eng.topk(qrows, args.serve_k)
+        scan = dict(eng.last_scan)
+        if mode == "two_stage" and scan.get("serve_mode") == "two_stage":
+            _, oracle = eng.topk(qrows, args.serve_k, force_exact=True)
+            recall = float(recall_at_k(ids, oracle))
+            cost = serve_batch_cost(
+                args.serve_movies, args.serve_rank, batch, args.serve_k,
+                table_dtype=td, serve_mode="two_stage",
+                clusters=scan["clusters"],
+                probe_clusters=scan["probe_clusters"],
+                shortlist_rows=scan["shortlist_rows_padded"],
+            )
+        else:
+            recall = 1.0
+            cost = serve_batch_cost(
+                args.serve_movies, args.serve_rank, batch, args.serve_k,
+                table_dtype=td, m_pad=eng.table_rows,
+            )
         row = {
             "batch": batch,
             "table_dtype": td,
             "shards": shards,
             "k": args.serve_k,
+            "serve_mode": scan.get("serve_mode", mode),
+            "recall_at_k": round(recall, 4),
             "batch_s": round(batch_s, 5),
             "capacity_qps": round(capacity, 1),
             **report.as_row(),
             **serve_roofline_row(cost, batch_s, table_dtype=td),
+            **{kk: scan[kk] for kk in ("clusters", "probe_clusters",
+                                       "shortlist_rows") if kk in scan},
             "users": args.serve_users, "movies": args.serve_movies,
             "rank": args.serve_rank, "tile_m": args.serve_tile_m,
             "backend": jx.default_backend(),
@@ -2413,7 +2483,7 @@ def run_serve(args) -> dict:
         print("# serve: " + json.dumps(row), flush=True)
         rows.append(row)
     best = max(rows, key=lambda r: r["qps"])
-    return {
+    out = {
         "metric": "serve_topk_ml25m",
         "unit": "qps",
         "value": best["qps"],
@@ -2423,6 +2493,30 @@ def run_serve(args) -> dict:
         "vs_roofline": best["vs_roofline"],
         "rows": rows,
     }
+    # Headline two_stage-vs-exact pair (ISSUE 16 acceptance): the bytes
+    # cut at the matching (batch, dtype, shards) exact row, maximized
+    # over two_stage rows, with the recall that bought it.
+    exact_by_key = {(r["batch"], r["table_dtype"], r["shards"]): r
+                    for r in rows if r["serve_mode"] == "exact"}
+    ab = None
+    for r in rows:
+        if r["serve_mode"] != "two_stage":
+            continue
+        ex = exact_by_key.get((r["batch"], r["table_dtype"], r["shards"]))
+        if ex is None:
+            continue
+        cut = ex["bytes_scanned_per_batch"] / max(
+            r["bytes_scanned_per_batch"], 1)
+        if ab is None or cut > ab["bytes_cut"]:
+            ab = {"bytes_cut": round(cut, 2),
+                  "recall_at_k": r["recall_at_k"],
+                  "batch": r["batch"], "table_dtype": r["table_dtype"],
+                  "two_stage_qps": r["qps"], "exact_qps": ex["qps"]}
+    if ab is not None:
+        out["bytes_cut"] = ab["bytes_cut"]
+        out["recall_at_k"] = ab["recall_at_k"]
+        out["serve_ab"] = ab
+    return out
 
 
 def _plan_ab_args():
@@ -2760,8 +2854,10 @@ if __name__ == "__main__":
                         "at ML-25M scale through the full request path "
                         "(log → batch coalescing → score+top-K kernel → "
                         "response log), swept over batch size, table "
-                        "dtype, and shard count, each row with its "
-                        "table-scan vs_roofline")
+                        "dtype, shard count, and serve mode "
+                        "(exact/two_stage, ISSUE 16), each row with its "
+                        "executed-mode vs_roofline, recall_at_k, and "
+                        "measured bytes_scanned_per_batch")
     parser.add_argument("--serve-users", type=int, default=162_541)
     parser.add_argument("--serve-movies", type=int, default=59_047)
     parser.add_argument("--serve-nnz", type=int, default=25_000_095,
@@ -2780,6 +2876,18 @@ if __name__ == "__main__":
                         "rows run the sharded merge on a virtual mesh)")
     parser.add_argument("--serve-requests", type=int, default=256,
                         help="open-loop requests per row")
+    parser.add_argument("--serve-modes", default="exact,two_stage",
+                        help="comma list of retrieval modes (ISSUE 16): "
+                        "two_stage rows run the clustered candidate -> "
+                        "rescore path; every row records recall_at_k + "
+                        "measured bytes_scanned_per_batch")
+    parser.add_argument("--serve-clusters", type=int, default=1024,
+                        help="two_stage k-means cluster count (0 = engine "
+                        "auto ~sqrt(movies); default tuned for the ML-25M "
+                        "shape so the batch union stays narrow)")
+    parser.add_argument("--serve-probe-clusters", type=int, default=32,
+                        help="clusters probed per user (0 = engine auto "
+                        "at the 0.95 recall floor)")
     parser.add_argument("--scale-sweep", action="store_true",
                         help="out-of-core scale sweep (ISSUE 11): s/iter "
                         "and ratings/sec/chip vs problem size across the "

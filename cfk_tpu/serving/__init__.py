@@ -10,13 +10,32 @@ transport log into pow2-bucketed batches (``server``) over a live-updating
 ``ServeEngine`` whose hot-user factor cache re-serves streaming fold-in
 commits (``engine``); and an open-loop generator measures QPS/p50/p99
 honestly (``loadgen``; ``bench.py --serve`` for the recorded rows).
+
+Two-stage clustered retrieval (ISSUE 16 / ROADMAP item 4) breaks the
+O(users × catalog) scan floor: a seeded k-means over the item factors
+(``cluster``) stores the table CLUSTER-MAJOR, a centroid probe picks
+top-probe clusters per user, and only the batch union of those clusters'
+rows is rescored EXACTLY through the same Pallas kernel (``twostage``) —
+recall@K vs the dense oracle is measured first-class and the exact scan
+stays the un-disableable fallback.
 """
 
+from cfk_tpu.serving.cluster import (
+    ClusterIndex,
+    build_cluster_index,
+    kmeans_item_clusters,
+)
 from cfk_tpu.serving.engine import (
     ServeEngine,
     engine_from_model,
     pad_table,
     plan_for_serving,
+)
+from cfk_tpu.serving.twostage import (
+    Shortlist,
+    build_shortlist,
+    default_two_stage_params,
+    recall_at_k,
 )
 from cfk_tpu.serving.loadgen import (
     LoadReport,
@@ -41,6 +60,13 @@ __all__ = [
     "engine_from_model",
     "plan_for_serving",
     "pad_table",
+    "ClusterIndex",
+    "build_cluster_index",
+    "kmeans_item_clusters",
+    "Shortlist",
+    "build_shortlist",
+    "default_two_stage_params",
+    "recall_at_k",
     "LoadReport",
     "run_open_loop",
     "warm_serve_programs",

@@ -1,0 +1,209 @@
+"""Clustered item-table index for two-stage retrieval (ISSUE 16).
+
+The exact serve path streams the ENTIRE item table per batch, so QPS is
+pinned to the table-scan byte floor no matter how fast the kernel gets.
+This module builds the index the two-stage path probes instead: a seeded,
+deterministic k-means over the item factor rows, with the table stored
+CLUSTER-MAJOR — rows of one cluster contiguous — so a coarse
+centroid-probe stage selects clusters and the rescore stage gathers their
+rows as contiguous ranges (the memory-placement playbook of
+arXiv 1808.03843 applied to serving: co-locate what is accessed
+together).
+
+Everything here is host-side numpy and bit-deterministic for a fixed
+``(factors, clusters, seed)``: the k-means init draws from
+``np.random.default_rng(seed)``, iteration count is fixed (no
+convergence-dependent early exit), empty clusters are repaired by a
+deterministic farthest-row rule, and the cluster-major permutation sorts
+``kind="stable"`` so rows within a cluster keep ascending global order —
+which is what makes the rescore stage's tie order reproducible.
+
+Lifecycle (enforced by ``ServeEngine``):
+
+- built at engine construction and REBUILT atomically on every full
+  table swap (warm-retrain commit events),
+- per-row fold-in deltas update factor rows IN PLACE at their existing
+  cluster-major position (``note_stale`` records them; assignments and
+  centroids intentionally go stale between swaps — bounded by the
+  engine's stale-fraction cap, which degrades to the exact scan rather
+  than serve from an index that no longer reflects the table),
+- never mutated by the serve path itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def kmeans_item_clusters(
+    factors: np.ndarray,
+    clusters: int,
+    *,
+    seed: int = 0,
+    iters: int = 8,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic k-means over item factor rows.
+
+    Returns ``(centroids [C, k] f32, assign [M] int32)``.  Lloyd
+    iterations with a fixed count (no data-dependent early exit — same
+    input, same output, bit-for-bit on one platform), squared-Euclidean
+    objective via the expanded form ``argmax(x·cᵀ − ½|c|²)`` so the
+    assignment step is one BLAS matmul even at catalog scale.  Empty
+    clusters re-seed at the highest-norm rows not already serving as a
+    centroid seed — deterministic, and heavy rows are exactly the ones
+    worth a dedicated cluster.
+    """
+    x = np.ascontiguousarray(np.asarray(factors, np.float32))
+    if x.ndim != 2:
+        raise ValueError(f"factors must be [M, k], got shape {x.shape}")
+    m = x.shape[0]
+    c = int(clusters)
+    if not 1 <= c <= m:
+        raise ValueError(f"clusters must be in [1, {m}], got {c}")
+    rng = np.random.default_rng(seed)
+    init = np.sort(rng.choice(m, size=c, replace=False))
+    cent = x[init].copy()
+    norms = (x * x).sum(axis=1)
+    by_norm = np.argsort(-norms, kind="stable")
+    assign = np.zeros(m, np.int32)
+    for _ in range(max(int(iters), 1)):
+        scores = x @ cent.T - 0.5 * (cent * cent).sum(axis=1)
+        assign = np.argmax(scores, axis=1).astype(np.int32)
+        sums = np.zeros((c, x.shape[1]), np.float64)
+        np.add.at(sums, assign, x)
+        counts = np.bincount(assign, minlength=c).astype(np.float64)
+        cent = (sums / np.maximum(counts, 1.0)[:, None]).astype(np.float32)
+        empty = np.flatnonzero(counts == 0)
+        if empty.size:
+            cent[empty] = x[by_norm[: empty.size]]
+    scores = x @ cent.T - 0.5 * (cent * cent).sum(axis=1)
+    assign = np.argmax(scores, axis=1).astype(np.int32)
+    return cent, assign
+
+
+@dataclasses.dataclass
+class ClusterIndex:
+    """The cluster-major view of one item-table snapshot.
+
+    ``perm[pos] = global row`` (cluster-major order), ``inv_perm`` its
+    inverse, ``offsets [C+1]`` the row ranges — cluster ``c`` owns
+    cluster-major positions ``[offsets[c], offsets[c+1])``.  ``assign``
+    is kept for the fold-in delta path and the nearest-centroid
+    fallbacks ("similar items" / cold-start).
+    """
+
+    centroids: np.ndarray  # [C, k] f32
+    assign: np.ndarray  # [M] int32 global row -> cluster
+    perm: np.ndarray  # [M] int64 cluster-major position -> global row
+    inv_perm: np.ndarray  # [M] int64 global row -> cluster-major position
+    offsets: np.ndarray  # [C+1] int64 cluster row ranges
+    seed: int
+    stale_rows: int = 0  # fold-in delta rows applied since the build
+
+    @property
+    def num_clusters(self) -> int:
+        return int(self.centroids.shape[0])
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.perm.shape[0])
+
+    @property
+    def stale_fraction(self) -> float:
+        return self.stale_rows / max(self.num_rows, 1)
+
+    def positions_of(self, rows) -> np.ndarray:
+        """Cluster-major positions of global rows (the in-place delta
+        target: the row moved here at build time and STAYS here until
+        the next full rebuild)."""
+        return self.inv_perm[np.asarray(rows, np.int64)]
+
+    def note_stale(self, n_rows: int) -> int:
+        """Record ``n_rows`` in-place delta rows; returns the total."""
+        self.stale_rows += int(n_rows)
+        return self.stale_rows
+
+    def ranges(self, cluster_ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(starts, ends) cluster-major row ranges for the given clusters."""
+        cids = np.asarray(cluster_ids, np.int64)
+        return self.offsets[cids], self.offsets[cids + 1]
+
+    def nearest_clusters(self, vec: np.ndarray, n: int = 1) -> np.ndarray:
+        """Top-n clusters by centroid dot score for one [k] query vector —
+        the cold-start / "similar items" fallback: a user (or item) with
+        no history still lands in the catalog region nearest its factor
+        direction."""
+        scores = self.centroids @ np.asarray(vec, np.float32)
+        n = min(int(n), self.num_clusters)
+        top = np.argpartition(-scores, n - 1)[:n]
+        return top[np.argsort(-scores[top], kind="stable")]
+
+    def similar_items(self, movie_row: int, n: int = 10) -> np.ndarray:
+        """Global rows of the item's cluster neighbors (excluding itself)
+        — the clustered layout's free "similar items" answer: one range
+        slice, no table scan."""
+        cid = int(self.assign[int(movie_row)])
+        lo, hi = int(self.offsets[cid]), int(self.offsets[cid + 1])
+        members = self.perm[lo:hi]
+        return members[members != int(movie_row)][: int(n)]
+
+    def quick_check(self) -> str | None:
+        """Cheap per-batch health probe (O(C·k), no table pass): reason
+        the index must not be served from, or None.  The chaos scenario
+        corrupts exactly what this catches — NaN centroids, broken
+        offsets — and the engine's response is the exact-scan fallback,
+        never a wrong answer."""
+        if not np.isfinite(self.centroids).all():
+            return "non-finite centroid values"
+        if self.offsets.shape[0] != self.num_clusters + 1:
+            return "offsets length != clusters + 1"
+        if int(self.offsets[0]) != 0 or int(self.offsets[-1]) != self.num_rows:
+            return "offsets do not span the table rows"
+        if np.any(np.diff(self.offsets) < 0):
+            return "offsets not monotone"
+        return None
+
+    def validate(self) -> None:
+        """Full structural check (O(M); build/swap time, not per batch)."""
+        reason = self.quick_check()
+        if reason is None:
+            seen = np.zeros(self.num_rows, bool)
+            seen[self.perm] = True
+            if not seen.all():
+                reason = "perm is not a permutation"
+            elif np.any(self.perm[self.inv_perm]
+                        != np.arange(self.num_rows)):
+                reason = "inv_perm is not perm's inverse"
+        if reason is not None:
+            raise ValueError(f"corrupt cluster index: {reason}")
+
+
+def build_cluster_index(
+    movie_factors: np.ndarray,
+    clusters: int,
+    *,
+    seed: int = 0,
+    iters: int = 8,
+) -> ClusterIndex:
+    """Cluster the item factors and derive the cluster-major layout.
+
+    The permutation sorts rows by cluster with ``kind="stable"``, so
+    within a cluster global row order is preserved — the property the
+    rescore stage's deterministic tie order (and the round-trip test)
+    leans on.
+    """
+    centroids, assign = kmeans_item_clusters(
+        movie_factors, clusters, seed=seed, iters=iters
+    )
+    perm = np.argsort(assign, kind="stable").astype(np.int64)
+    inv_perm = np.empty_like(perm)
+    inv_perm[perm] = np.arange(perm.shape[0], dtype=np.int64)
+    counts = np.bincount(assign, minlength=int(clusters)).astype(np.int64)
+    offsets = np.zeros(int(clusters) + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return ClusterIndex(
+        centroids=centroids, assign=assign, perm=perm, inv_perm=inv_perm,
+        offsets=offsets, seed=int(seed),
+    )

@@ -19,7 +19,20 @@ user rows" and "[B, K] ids+scores":
 - pow2 request-batch bucketing: batches pad to a power of two (and the
   seen rectangle width is pow2 from ``build_seen_tiles``), so live
   traffic converges onto a handful of compiled programs instead of
-  re-tracing per batch — the same trick PR 6 used for fold-in shapes.
+  re-tracing per batch — the same trick PR 6 used for fold-in shapes,
+- two-stage clustered retrieval (ISSUE 16, ``serve_mode="two_stage"``):
+  a k-means index over the item factors (``serving.cluster``), rebuilt
+  ATOMICALLY on every table swap, probed by a centroid stage
+  (``serve/candidate``) whose selected clusters' rows are rescored
+  exactly through the same kernel (``serve/rescore`` —
+  ``serving.twostage``).  The exact scan is the un-disableable fallback:
+  a corrupt index (NaN centroids, broken offsets, non-finite coarse
+  scores) or a staleness overrun degrades THIS engine to the exact path
+  bit-exactly — same table, same jitted program — records the plan
+  transition + flight-recorder event, and recovers two_stage at the
+  next table swap.  Per-row fold-in movie deltas update the clustered
+  table IN PLACE at their cluster-major position (staleness counted);
+  only a full snapshot swap re-clusters.
 """
 
 from __future__ import annotations
@@ -34,7 +47,7 @@ from cfk_tpu.serving.topk_kernel import (
     build_seen_tiles,
     topk_scores_pallas,
 )
-from cfk_tpu.telemetry import span
+from cfk_tpu.telemetry import dump_flight, record_event, span
 
 
 def pad_table(table: np.ndarray, tile_m: int, shards: int = 1) -> np.ndarray:
@@ -72,14 +85,20 @@ class ServeEngine:
         mesh=None,
         plan=None,  # cfk_tpu.plan.ExecutionPlan (serve knobs)
         plan_provenance=None,
+        serve_mode: str | None = None,  # "exact" | "two_stage"
+        clusters: int | None = None,
+        probe_clusters: int | None = None,
+        cluster_seed: int = 0,
+        max_stale_fraction: float = 0.25,
+        metrics=None,  # telemetry.Metrics — recall/bytes-scanned gauges
     ) -> None:
         from cfk_tpu.ops.quant import resolve_table_dtype
 
         # Opt-in plan consumption (cfk_tpu.plan): when a plan is given its
-        # serve knobs (batch quantum, movie tile rows, and — unless passed
-        # explicitly — the table dtype) configure the engine, and the
-        # provenance rides along for the bench rows.  No plan → the
-        # pre-planner defaults, unchanged.
+        # serve knobs (batch quantum, movie tile rows, retrieval mode +
+        # index size, and — unless passed explicitly — the table dtype)
+        # configure the engine, and the provenance rides along for the
+        # bench rows.  No plan → the pre-planner defaults, unchanged.
         self.plan = plan
         self.plan_provenance = plan_provenance
         if plan is not None:
@@ -87,14 +106,44 @@ class ServeEngine:
                 table_dtype = plan.table_dtype
             batch_quantum = plan.serve_batch_quantum
             tile_m = plan.serve_tile_m
+            if serve_mode is None:
+                serve_mode = plan.serve_mode
+            if clusters is None and plan.clusters:
+                clusters = plan.clusters
+            if probe_clusters is None and plan.probe_clusters:
+                probe_clusters = plan.probe_clusters
+        self.serve_mode = serve_mode or "exact"
+        if self.serve_mode not in ("exact", "two_stage"):
+            raise ValueError(
+                f"serve_mode must be 'exact' or 'two_stage', "
+                f"got {self.serve_mode!r}"
+            )
         self.num_movies = int(num_movies)
         self.num_users = int(num_users)
+        if self.serve_mode == "two_stage":
+            from cfk_tpu.serving.twostage import default_two_stage_params
+
+            dc, dp = default_two_stage_params(self.num_movies)
+            clusters = int(clusters or dc)
+            probe_clusters = int(probe_clusters or dp)
+        self.clusters = int(clusters or 0)
+        self.probe_clusters = int(probe_clusters or 0)
+        self.cluster_seed = int(cluster_seed)
+        self.max_stale_fraction = float(max_stale_fraction)
+        self.metrics = metrics
         self.table_dtype = resolve_table_dtype(table_dtype)
         self.tile_m = int(tile_m)
         self.batch_quantum = int(batch_quantum)
         self.mesh = mesh
         self._shards = 1 if mesh is None else int(mesh.devices.size)
         self._lock = threading.RLock()
+        # Two-stage state: (ClusterIndex, cluster-major quantized table,
+        # its scales, quantized centroids, centroid scales) — ONE tuple so
+        # every swap is a single atomic reference assignment, like _table.
+        self._cluster = None
+        self._two_stage_disabled = False
+        self.two_stage_fallbacks = 0
+        self.last_scan: dict = {}
         self._u_base = np.asarray(user_factors, np.float32)[:num_users]
         self._u_hot: dict[int, np.ndarray] = {}
         if (seen_movies is None) != (seen_indptr is None):
@@ -131,6 +180,38 @@ class ServeEngine:
         # captured; the next batch sees the new one
         self._table = (jax.device_put(data),
                        None if scale is None else jax.device_put(scale))
+        if self.serve_mode == "two_stage":
+            # Rebuild the cluster index with every swap (re-cluster ONLY
+            # here — fold-in deltas update rows in place).  Built off to
+            # the side, swapped as one reference: a batch in flight keeps
+            # the (index, table) pair it captured.
+            from cfk_tpu.serving.cluster import build_cluster_index
+
+            host = np.asarray(movie_factors_host, np.float32)
+            index = build_cluster_index(
+                host, min(self.clusters, max(host.shape[0], 1)),
+                seed=self.cluster_seed,
+            )
+            cpad = pad_table(host[index.perm], self.tile_m, 1)
+            cdata, cscale = quantize_table(
+                jnp.asarray(cpad), self.table_dtype
+            )
+            # the coarse stage scores the QUANTIZED centroid view — the
+            # same canonical ops.quant placement as the kernel's tiles
+            qc, qcs = quantize_table(
+                jnp.asarray(index.centroids), self.table_dtype
+            )
+            self._cluster = (
+                index,
+                jax.device_put(cdata),
+                None if cscale is None else jax.device_put(cscale),
+                jax.device_put(qc),
+                None if qcs is None else jax.device_put(qcs),
+            )
+            # a fresh index is healthy by construction — re-arm two_stage
+            # after any fault-driven degradation (the recovery half of the
+            # chaos contract)
+            self._two_stage_disabled = False
 
     @property
     def table_rows(self) -> int:
@@ -158,6 +239,12 @@ class ServeEngine:
                 self._seen_hot.setdefault(int(row), []).append(int(movie))
             self.num_users = max(self.num_users,
                                  int(event.get("num_users", self.num_users)))
+            # Item-side per-row deltas (ISSUE 16): a commit that ships
+            # re-solved MOVIE rows updates both table views in place —
+            # within each row's existing cluster — without re-clustering.
+            mrows = event.get("movie_rows")
+            if mrows is not None and not event.get("retrain"):
+                self.apply_movie_deltas(mrows, event["movie_row_factors"])
             if event.get("retrain"):
                 # a warm retrain re-solves EVERY row: drop the overlay and
                 # re-snapshot both sides
@@ -170,6 +257,46 @@ class ServeEngine:
                                np.float32)[: self.num_movies]
                 )
                 self.table_swaps += 1
+
+    def apply_movie_deltas(self, rows, factors) -> int:
+        """Update item factor rows IN PLACE in both table views.
+
+        The exact table updates at the global row; the cluster-major
+        table (when two_stage) at the row's EXISTING cluster position —
+        assignments and centroids intentionally go stale (recorded via
+        ``ClusterIndex.note_stale``; re-clustering happens only on a full
+        snapshot swap).  Quantization is per-row (``ops.quant``), so a
+        delta row's codes+scale are bit-identical to what a full-table
+        requantization would produce.  Returns the rows applied."""
+        import jax.numpy as jnp
+
+        from cfk_tpu.ops.quant import quantize_table
+
+        rows = np.asarray(rows, np.int64)
+        f = np.asarray(factors, np.float32)
+        keep = (rows >= 0) & (rows < self.num_movies)
+        rows, f = rows[keep], f[keep]
+        if rows.size == 0:
+            return 0
+        qd, qs = quantize_table(jnp.asarray(f), self.table_dtype)
+        with self._lock:
+            data, scale = self._table
+            data = data.at[rows].set(qd.astype(data.dtype))
+            if scale is not None:
+                scale = scale.at[rows].set(qs)
+            self._table = (data, scale)
+            if self._cluster is not None:
+                index, ctable, cscale, qc, qcs = self._cluster
+                pos = index.positions_of(rows)
+                ctable = ctable.at[pos].set(qd.astype(ctable.dtype))
+                if cscale is not None:
+                    cscale = cscale.at[pos].set(qs)
+                index.note_stale(rows.size)
+                self._cluster = (index, ctable, cscale, qc, qcs)
+                if self.metrics is not None:
+                    self.metrics.gauge("serve/index_stale_rows",
+                                       index.stale_rows)
+        return int(rows.size)
 
     # -- request path --------------------------------------------------------
 
@@ -212,11 +339,16 @@ class ServeEngine:
                   else np.zeros(0, np.int32))
         return movies, indptr
 
-    def topk(self, user_rows, k: int, *, exclude_seen: bool = True):
+    def topk(self, user_rows, k: int, *, exclude_seen: bool = True,
+             force_exact: bool = False):
         """(scores [n, k] f32, movie rows [n, k] int32) for the requested
         user rows.  The batch is padded to the pow2 quantum (padding rows
         score with a zero factor vector and are sliced off), so request
-        coalescing shares compiled programs across batch sizes."""
+        coalescing shares compiled programs across batch sizes.
+
+        ``force_exact`` skips the two-stage candidate path for this one
+        batch (same table, same masks, same jitted exact program) — the
+        dense oracle the recall@K measurements score against."""
         import jax.numpy as jnp
 
         user_rows = np.asarray(user_rows, dtype=np.int64)
@@ -236,11 +368,11 @@ class ServeEngine:
         with span("serve/batch/assemble", n=n, b=b):
             with self._lock:
                 table, scale = self._table
+                cluster = self._cluster
                 u = np.zeros((b, self._u_base.shape[1]), np.float32)
                 u[:n] = self._gather_users(user_rows)
                 seen = self._batch_seen(user_rows) if exclude_seen else None
-            nt = self.table_rows // self.tile_m
-            seen_tiles = None
+            seen_pad = None
             if seen is not None:
                 movies, indptr = seen
                 # padding slots carry EMPTY seen lists (repeat the last
@@ -250,11 +382,24 @@ class ServeEngine:
                 indptr_pad = np.concatenate(
                     [indptr, np.full(b - n, indptr[-1], np.int64)]
                 )
-                seen_tiles = jnp.asarray(build_seen_tiles(
-                    movies, indptr_pad, np.arange(b),
-                    num_movies=self.num_movies,
-                    tile_m=self.tile_m, num_tiles=nt,
-                ))
+                seen_pad = (movies, indptr_pad)
+        if (self.serve_mode == "two_stage" and not force_exact
+                and not self._two_stage_disabled):
+            out = self._topk_two_stage(cluster, u, n, b, k, seen_pad)
+            if out is not None:
+                return out
+            # a detected fault fell through: the exact path below IS the
+            # un-disableable fallback — same table, same jitted program
+            # as serve_mode="exact", so the degraded answer is bit-exact
+        seen_tiles = None
+        if seen_pad is not None:
+            movies, indptr_pad = seen_pad
+            seen_tiles = jnp.asarray(build_seen_tiles(
+                movies, indptr_pad, np.arange(b),
+                num_movies=self.num_movies,
+                tile_m=self.tile_m,
+                num_tiles=self.table_rows // self.tile_m,
+            ))
         with span("serve/batch/compute", n=n, b=b, k=k):
             if self.mesh is not None:
                 from cfk_tpu.parallel.spmd import serve_topk_sharded
@@ -269,7 +414,129 @@ class ServeEngine:
                     k_top=k, num_movies=self.num_movies, tile_m=self.tile_m,
                 )
             vals, ids = np.asarray(vals)[:n], np.asarray(ids)[:n]
+        self._record_scan(mode="exact", b=b, k=k)
         return vals, ids
+
+    def _topk_two_stage(self, cluster, u, n, b, k, seen_pad):
+        """One two-stage batch: centroid probe → batch-union shortlist →
+        exact rescore.  Returns ``(vals, ids)`` sliced to ``n``, or None
+        after recording a fault — the caller then takes the exact scan."""
+        import jax.numpy as jnp
+
+        from cfk_tpu.serving.twostage import (
+            build_shortlist,
+            coarse_jit_fn,
+            map_shortlist_ids,
+            rescore_jit_fn,
+            shortlist_seen_tiles,
+        )
+
+        if cluster is None:
+            self._two_stage_fault("cluster index missing")
+            return None
+        index, ctable, cscale, qc, qcs = cluster
+        reason = index.quick_check()
+        if reason is not None:
+            self._two_stage_fault(reason)
+            return None
+        if index.stale_fraction > self.max_stale_fraction:
+            self._two_stage_fault(
+                f"index staleness {index.stale_fraction:.3f} over the "
+                f"{self.max_stale_fraction} bound (awaiting table swap)"
+            )
+            return None
+        probe = min(max(self.probe_clusters, 1), index.num_clusters)
+        with span("serve/candidate", n=n, b=b, probe=probe):
+            cvals, cids = coarse_jit_fn()(jnp.asarray(u), qc, qcs,
+                                          probe=probe)
+            if not np.isfinite(np.asarray(cvals)[:n]).all():
+                self._two_stage_fault("non-finite coarse scores")
+                return None
+            # union over the REAL rows only — padding slots carry a zero
+            # factor vector and would vote junk clusters into the gather
+            shortlist = build_shortlist(
+                index, np.asarray(cids)[:n].ravel(),
+                tile_m=self.tile_m, min_rows=k,
+            )
+            seen_tiles = None
+            if seen_pad is not None:
+                movies, indptr_pad = seen_pad
+                seen_tiles = jnp.asarray(shortlist_seen_tiles(
+                    index, shortlist, movies, indptr_pad, b,
+                    tile_m=self.tile_m,
+                ))
+        with span("serve/rescore", n=n, b=b, k=k, rows=shortlist.rows,
+                  rows_padded=shortlist.rows_padded):
+            vals, ids = rescore_jit_fn()(
+                jnp.asarray(u), jnp.asarray(shortlist.indices), ctable,
+                cscale, seen_tiles, np.int32(shortlist.offset),
+                k_top=k, tile_m=self.tile_m,
+            )
+            vals = np.asarray(vals)[:n]
+            ids = map_shortlist_ids(np.asarray(ids)[:n], shortlist)
+        self._record_scan(mode="two_stage", b=b, k=k, shortlist=shortlist,
+                          probe=probe, index=index)
+        return vals, ids
+
+    def _two_stage_fault(self, reason: str) -> None:
+        """Degrade to the exact scan until the next table swap.
+
+        The chaos contract (``chaos_lab two_stage_fallback``): the fault
+        is RECORDED (flight-recorder event + dump, plan transition,
+        fallback counter), the answer comes from the exact path
+        bit-exactly, and ``_set_table`` re-arms two_stage when a healthy
+        index is rebuilt."""
+        self._two_stage_disabled = True
+        self.two_stage_fallbacks += 1
+        record_event("serve", "two_stage_fault", reason=reason,
+                     fallbacks=self.two_stage_fallbacks)
+        dump_flight(f"two_stage_fallback: {reason}")
+        if self.plan_provenance is not None:
+            self.plan_provenance.record_transition(
+                "two_stage_fallback",
+                f"{reason}; exact scan until the next table swap "
+                "rebuilds the index",
+            )
+        if self.metrics is not None:
+            self.metrics.incr("serve/two_stage_fallbacks")
+
+    def _record_scan(self, *, mode, b, k, shortlist=None, probe=0,
+                     index=None) -> None:
+        """Per-batch scan accounting: the MEASURED byte traffic of the
+        executed mode (``utils.roofline.serve_batch_cost`` over the real
+        shortlist union for two_stage), exposed as ``last_scan`` for the
+        bench rows and as metrics gauges."""
+        from cfk_tpu.utils.roofline import serve_batch_cost
+
+        rank = int(self._u_base.shape[1])
+        if mode == "two_stage":
+            cost = serve_batch_cost(
+                self.num_movies, rank, b, k, table_dtype=self.table_dtype,
+                serve_mode="two_stage", clusters=index.num_clusters,
+                probe_clusters=probe,
+                shortlist_rows=shortlist.rows_padded,
+            )
+            self.last_scan = {
+                "serve_mode": "two_stage",
+                "clusters": index.num_clusters,
+                "probe_clusters": probe,
+                "shortlist_rows": shortlist.rows,
+                "shortlist_rows_padded": shortlist.rows_padded,
+                "index_stale_rows": index.stale_rows,
+                "bytes_scanned_per_batch": round(cost.hbm_bytes),
+            }
+        else:
+            cost = serve_batch_cost(
+                self.num_movies, rank, b, k, table_dtype=self.table_dtype,
+                m_pad=self.table_rows,
+            )
+            self.last_scan = {
+                "serve_mode": "exact",
+                "bytes_scanned_per_batch": round(cost.hbm_bytes),
+            }
+        if self.metrics is not None:
+            self.metrics.gauge("serve/bytes_scanned_per_batch",
+                               self.last_scan["bytes_scanned_per_batch"])
 
     @property
     def trace_count(self) -> int:
@@ -296,7 +563,11 @@ class ServeEngine:
         restart pays neither.  Returns
         ``{"programs", "new_traces", "prewarm_s"}``; a later batch whose
         (padded size, seen width) bucket was covered here traces
-        nothing, which ``tests/test_staging.py`` pins."""
+        nothing, which ``tests/test_staging.py`` pins.  In two_stage
+        mode each rung additionally traces the centroid probe and the
+        rescore at the shortlist width that rung's union produced —
+        pass a workload ``user_rows`` sample so those widths land in
+        the same pow2 buckets as live traffic."""
         import time as _time
 
         with span("serve/prewarm", k=k, max_batch=max_batch):
@@ -321,6 +592,16 @@ class ServeEngine:
                     take = np.resize(take, b)
                 self.topk(take, k, exclude_seen=exclude_seen)
                 programs += 1
+                if self.serve_mode == "two_stage" and rows.size > b:
+                    # a second, disjoint sample per rung: the shortlist
+                    # union width is data-dependent, so one sample warms
+                    # one pow2 width bucket — a second makes the
+                    # neighboring bucket resident when live unions
+                    # straddle a boundary
+                    alt = rows[b:2 * b]
+                    if alt.size < b:
+                        alt = np.resize(alt, b)
+                    self.topk(alt, k, exclude_seen=exclude_seen)
                 b *= 2
             return {
                 "programs": programs,
@@ -338,8 +619,12 @@ _TRACES = [0]
 
 
 def trace_count() -> int:
-    """Traces of the single-device serve program this process."""
-    return _TRACES[0]
+    """Traces of the single-device serve programs this process — the
+    exact scan plus (ISSUE 16) the two-stage coarse/rescore stages, so
+    the prewarm contract covers whichever mode the plan picked."""
+    from cfk_tpu.serving import twostage
+
+    return _TRACES[0] + twostage.trace_count()
 
 
 def _topk_call(u, table, scale, seen_tiles, *, k_top, num_movies, tile_m):
@@ -363,11 +648,17 @@ def _topk_jit_fn():
 
 def plan_for_serving(num_users: int, num_movies: int, rank: int, *,
                      k_top: int = 100, table_dtype: str | None = None,
+                     serve_mode: str | None = None,
+                     clusters: int | None = None,
+                     probe_clusters: int | None = None,
                      mode: str = "model", cache_path: str | None = None):
-    """Resolve a serve-side ExecutionPlan: the batch quantum and table
-    dtype chosen from the table-scan byte model (``cost.serve_batch_cost_
-    for``), with an explicit ``table_dtype`` arriving as a pin.  Returns
-    ``(plan, provenance)`` — hand both to ``ServeEngine(plan=...)``."""
+    """Resolve a serve-side ExecutionPlan: the batch quantum, table dtype
+    and (ISSUE 16) serve mode chosen from the scan/shortlist byte model
+    (``cost.serve_batch_cost_for``), with explicit knobs arriving as
+    pins — a pinned two_stage whose modeled recall@K falls below the
+    0.95 floor raises at resolution rather than serving bad answers.
+    Returns ``(plan, provenance)`` — hand both to
+    ``ServeEngine(plan=...)``."""
     from cfk_tpu.plan import PlanConstraints, ProblemShape, plan
 
     shape = ProblemShape(
@@ -375,13 +666,16 @@ def plan_for_serving(num_users: int, num_movies: int, rank: int, *,
         nnz=max(num_users, num_movies), rank=rank, kind="serve",
         serve_k=k_top,
     )
-    cons = PlanConstraints(table_dtype=table_dtype)
+    cons = PlanConstraints(table_dtype=table_dtype, serve_mode=serve_mode,
+                           clusters=clusters,
+                           probe_clusters=probe_clusters)
     return plan(shape, None, cons, mode=mode, cache_path=cache_path)
 
 
 def engine_from_model(model, dataset=None, *, table_dtype=None, tile_m=512,
                       mesh=None, batch_quantum=8, plan=None,
-                      plan_provenance=None) -> ServeEngine:
+                      plan_provenance=None, serve_mode=None, clusters=None,
+                      probe_clusters=None, metrics=None) -> ServeEngine:
     """Build an engine from an ``ALSModel`` (+ optional dataset/index whose
     ``coo_dense`` provides the exclude-seen lists).  ``plan`` (see
     ``plan_for_serving``) optionally supplies the serve knobs."""
@@ -410,5 +704,6 @@ def engine_from_model(model, dataset=None, *, table_dtype=None, tile_m=512,
         seen_movies=seen_movies, seen_indptr=seen_indptr,
         table_dtype=table_dtype, tile_m=tile_m, mesh=mesh,
         batch_quantum=batch_quantum, plan=plan,
-        plan_provenance=plan_provenance,
+        plan_provenance=plan_provenance, serve_mode=serve_mode,
+        clusters=clusters, probe_clusters=probe_clusters, metrics=metrics,
     )

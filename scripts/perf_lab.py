@@ -183,6 +183,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="open-loop request count for --serve on")
     p.add_argument("--serve-tile-m", type=int, default=512,
                    help="movie-axis tile rows of the serve kernel")
+    p.add_argument("--serve-mode", default="exact",
+                   choices=["exact", "two_stage"],
+                   help="retrieval mode for --serve on (ISSUE 16): "
+                   "two_stage runs the clustered candidate -> exact "
+                   "rescore path and the row reports measured recall_at_k "
+                   "vs the bit-exact scan plus bytes_scanned_per_batch — "
+                   "the A/B axis against the default exact scan")
+    p.add_argument("--serve-clusters", type=int, default=0,
+                   help="two_stage k-means cluster count (0 = auto "
+                   "~sqrt(movies); probe count follows the 0.95 recall "
+                   "floor)")
     p.add_argument("--offload", default=None,
                    choices=[None, "device", "host_window"],
                    help="out-of-core axis (ISSUE 11): run the SAME "
@@ -385,6 +396,7 @@ def run_serve_lab(args) -> dict:
     )
     eng = engine_from_model(
         model, ds, table_dtype=args.table_dtype, tile_m=args.serve_tile_m,
+        serve_mode=args.serve_mode, clusters=args.serve_clusters or None,
     )
     k = min(args.serve_k, num_movies)
     batch = args.serve_batch
@@ -408,14 +420,35 @@ def run_serve_lab(args) -> dict:
                                  seed=args.seed + 2),
         k=k, server=server, drive_server=True,
     )
-    cost = serve_batch_cost(
-        num_movies, args.rank, batch, k,
-        table_dtype=args.table_dtype, m_pad=eng.table_rows,
-    )
+    # recall vs the same engine's bit-exact scan + the executed mode's
+    # measured scan bytes (ISSUE 16 A/B columns, mirroring bench --serve)
+    from cfk_tpu.serving import recall_at_k
+
+    _, ids = eng.topk(qrows, k)
+    scan = dict(eng.last_scan)
+    if scan.get("serve_mode") == "two_stage":
+        _, oracle = eng.topk(qrows, k, force_exact=True)
+        recall = float(recall_at_k(ids, oracle))
+        cost = serve_batch_cost(
+            num_movies, args.rank, batch, k, table_dtype=args.table_dtype,
+            serve_mode="two_stage", clusters=scan["clusters"],
+            probe_clusters=scan["probe_clusters"],
+            shortlist_rows=scan["shortlist_rows_padded"],
+        )
+    else:
+        recall = 1.0
+        cost = serve_batch_cost(
+            num_movies, args.rank, batch, k,
+            table_dtype=args.table_dtype, m_pad=eng.table_rows,
+        )
     row = {
         "serve": "on",
         "serve_batch": batch,
         "serve_k": k,
+        "serve_mode": scan.get("serve_mode", args.serve_mode),
+        "recall_at_k": round(recall, 4),
+        **{kk: scan[kk] for kk in ("clusters", "probe_clusters",
+                                   "shortlist_rows") if kk in scan},
         "batch_s": round(batch_s, 5),
         "capacity_qps": round(batch / batch_s, 1),
         **report.as_row(),

@@ -29,7 +29,7 @@ def _args(**over):
         health_norm_limit=1e6, ckpt=None,
         foldin="off", foldin_updates=4096, foldin_batch_records=256,
         serve="off", serve_batch=64, serve_k=10, serve_requests=512,
-        serve_tile_m=512,
+        serve_tile_m=512, serve_mode="exact", serve_clusters=0,
         offload=None, offload_window_chunks=4, offload_budget_mb=None,
         offload_shards=1,
         staging=None, staging_pool_depth=None, compile_cache_dir=None,
@@ -380,3 +380,32 @@ def test_serve_axis_row(tmp_path, monkeypatch, capsys):
                 "serve_roofline_s"):
         assert row[key] >= 0, key
     assert row["p50_ms"] <= row["p99_ms"]
+    # every serve row now carries the ISSUE 16 A/B columns
+    assert row["serve_mode"] == "exact"
+    assert row["recall_at_k"] == 1.0
+    assert row["bytes_scanned_per_batch"] > 0
+
+
+def test_serve_axis_two_stage_row(tmp_path, monkeypatch, capsys):
+    # the --serve-mode A/B axis (ISSUE 16), mirroring test_serve_axis_row:
+    # the clustered candidate → exact-rescore path through the same full
+    # request loop, with measured recall vs the bit-exact scan and the
+    # executed mode's scan bytes in the row
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    row = perf_lab.run_lab(_args(
+        serve="on", serve_requests=24, serve_batch=8, serve_k=3,
+        serve_tile_m=16, repeats=2, serve_mode="two_stage",
+        serve_clusters=8,
+    ))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1]) == row
+    assert row["serve"] == "on"
+    assert row["serve_mode"] == "two_stage"
+    assert row["answered"] == 24
+    assert row["qps"] > 0
+    assert row["clusters"] == 8
+    assert row["probe_clusters"] >= 1
+    assert 0 < row["shortlist_rows"] <= row["movies"]
+    assert 0.0 <= row["recall_at_k"] <= 1.0
+    assert row["bytes_scanned_per_batch"] > 0
+    assert row["vs_roofline"] > 0
