@@ -1186,7 +1186,7 @@ def run_scale_sweep(args) -> dict:
                 print("# sweep point: " + json.dumps(row), flush=True)
                 rows.append(row)
     tiers = [r["offload_tier"] for r in rows]
-    return {
+    result = {
         "metric": "scale_sweep_s_per_iteration",
         "points": rows,
         "tiers": tiers,
@@ -1196,6 +1196,59 @@ def run_scale_sweep(args) -> dict:
         "tier_by_point": tier_by_point,
         "crossed_to_host_window": "host_window" in tiers,
     }
+    # Fleet tier: the sweep's out-of-core ladder extends past one host —
+    # a 2-process Gloo run at a shape whose per-host store footprint a
+    # simulated single-host RAM budget refuses.  CFK_BENCH_FLEET=0 skips
+    # (it spawns a real worker pair).
+    import os as _os
+
+    if _os.environ.get("CFK_BENCH_FLEET", "1") != "0":
+        try:
+            fleet = _fleet_row()
+        except Exception as e:  # pragma: no cover - subprocess-dependent
+            fleet = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# fleet: " + json.dumps(fleet), flush=True)
+        result["fleet"] = fleet
+    return result
+
+
+def _fleet_row() -> dict:
+    """The fleet scale-sweep row (distributed window exchange): spawn
+    TWO real Gloo processes running the offload bench drill — a
+    power-law shape whose single-host store footprint the simulated RAM
+    budget refuses completes with each process owning half the
+    ``HostFactorStore`` — and parse the worker's ``OFFLOAD_BENCH_ROW``:
+    per-host residual DCN rows/bytes, the dense no-split baseline and
+    the hot/delta reduction against it, and the budget provenance
+    proving the single-host refusal + per-process fit."""
+    import importlib.util
+    import os as _os
+
+    root = _os.path.dirname(_os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "multihost_worker",
+        _os.path.join(root, "tests", "multihost_worker.py"),
+    )
+    mhw = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mhw)
+    port = 29900 + (_os.getpid() % 200)
+    t0 = time.time()
+    procs = mhw.spawn_workers(port, 2, None, "--drill", "offload-bench")
+    outs = mhw.communicate_all(procs, timeout=540)
+    wall = time.time() - t0
+    for i, p in enumerate(procs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"fleet worker {i} rc={p.returncode}: {outs[i][-400:]}")
+    row = None
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("OFFLOAD_BENCH_ROW "):
+                row = json.loads(line.split(" ", 1)[1])
+    if row is None:
+        raise RuntimeError("no OFFLOAD_BENCH_ROW in worker output")
+    row["wall_s"] = round(wall, 2)
+    return row
 
 
 def _scale_sweep_row() -> dict:

@@ -290,3 +290,58 @@ def ring_accumulator_reservation(local_entities: int, rank: int, *,
     attributable to it."""
     return ((1.0 if donated else 2.0)
             * ring_accumulator_bytes(local_entities, rank))
+
+
+def fleet_host_ram_bytes(num_users: int, num_movies: int, nnz: int,
+                         rank: int, *, dtype: str = "float32",
+                         processes: int = 1, armed: bool = True) -> dict:
+    """PER-PROCESS host-RAM bytes of the FLEET out-of-core tier
+    (ISSUE 17): what one host must hold when ``train_als_host_window``
+    runs multi-process with per-process store slices and the distributed
+    window exchange.
+
+    - both factor-store SLICES at the storage dtype — the term that
+      scales OUT with the fleet (the whole point: a table no single host
+      fits splits across processes);
+    - last-good snapshot copies of both slices when the sentinel is
+      armed (the rollback ladder's in-RAM restore point — ×2 slices);
+    - the residual mirror's worst case: every fixed-table row OUTSIDE
+      the slice arrives over DCN for the larger side (value bytes + an
+      int64 row id each).  The exchange manifests bound this exactly at
+      plan time; this predicate prices shapes WITHOUT building plans, so
+      it charges the all-remote-referenced ceiling;
+    - this host's share of the block arrays (per-shard streams — the
+      contiguous shard-block ownership splits them with the stores).
+
+    Importable without jax, like the rest of this module — the plan CLI
+    prices fleet shapes on a laptop."""
+    p = max(int(processes), 1)
+    row = rank * dtype_bytes(dtype)
+    slices = float(num_users + num_movies) * row / p
+    snapshots = slices if not armed else 2.0 * slices
+    larger = float(max(num_users, num_movies))
+    mirror = (larger - larger / p) * (row + 8.0)
+    blocks = 2.0 * nnz * _BLOCK_BYTES_PER_CELL * _TILE_PAD / p
+    total = slices + snapshots + mirror + blocks
+    return {
+        "store_slices_bytes": slices,
+        "snapshot_bytes": snapshots,
+        "mirror_bytes": mirror,
+        "block_arrays_bytes": blocks,
+        "processes": p,
+        "total": total,
+    }
+
+
+def fits_fleet_host(num_users: int, num_movies: int, nnz: int, rank: int,
+                    *, host_ram_bytes: float, dtype: str = "float32",
+                    processes: int = 1, armed: bool = True) -> bool:
+    """THE fleet host-RAM predicate: does one process's share of the
+    out-of-core tier fit one host's RAM budget?  ``processes=1`` is the
+    single-host question — the resolver's fleet provenance proves a
+    shape that fails here at P=1 passes at the fleet size, which is the
+    claim that makes multi-process training worth its DCN bytes."""
+    need = fleet_host_ram_bytes(num_users, num_movies, nnz, rank,
+                                dtype=dtype, processes=processes,
+                                armed=armed)["total"]
+    return need <= host_ram_bytes * RESIDENT_FRACTION

@@ -1,0 +1,559 @@
+"""Distributed window-residual exchange: the multi-process out-of-core tier.
+
+The ALX end-state (arXiv 2112.02194): each host owns only its
+entity-range ``HostFactorStore`` slice — host RAM scales out with the
+fleet — and the windowed driver's per-host schedules run where they were
+always headed: one process per host.  What has to move between hosts is
+exactly the COLD WINDOW RESIDUAL the single-process driver already
+meters as its DCN share (``_stage_table``'s fabric attribution): the
+fixed-table rows a shard's windows gather from store shards other
+processes own.
+
+The protocol rides on one structural fact the out-of-core tier has
+maintained since PR 10: window plans, visit schedules, and hot/delta
+split maps are DETERMINISTIC functions of the tiled blocks.  Every
+process builds every shard's plans identically, so the full exchange
+manifest — who ships which rows to whom, in which hier-ring phase — is
+computed without any communication (``build_half_exchange``), and the
+wire carries only factor bytes, never indices.
+
+Per half-iteration, per outer DCN phase ``t`` of the hier-ring visit
+order (``parallel.spmd.hier_phase_of_visit`` — the SAME phase structure
+``half_step_tiled_ring_hier`` rotates; ``ici_group == num_shards``
+degenerates to one phase, the flat path):
+
+- each process ships the residual rows any peer's phase-``t`` windows
+  gather from its slice, CUMULATIVELY deduplicated (a row crosses DCN at
+  most once per half, however many windows reference it);
+- with the hot/delta engine on (ISSUE 15), manifests are built from the
+  per-window COLD DELTA row sets — the hot partition and delta-kept rows
+  never ship — plus one phase-0 hot-refresh manifest (the fixed side's
+  remote-owned hot rows, so each process rebuilds its device partition
+  from master bytes);
+- payloads are the raw little-endian bytes of the store dtype (bitwise —
+  no re-encode), padded to the plan-time maximum row count over
+  processes (Gloo requires equal collective shapes; measured: ragged
+  ``process_allgather`` shapes crash the transport), and shipped via
+  ``multihost_utils.process_allgather``;
+- receivers slice each peer's payload by the plan-known layout
+  (``send_rows`` is sorted-unique, so selection is a searchsorted) into
+  a ``ResidualMirror`` — a read-only ``HostFactorStore`` facade over
+  (local slice, received residual) whose ``gather``/``shard_of_rows``
+  are bitwise the full store's.  The staging pipeline, fault hooks,
+  checksums, and fabric attribution then run UNCHANGED against it,
+  which is what makes the 2-process drill crc-bit-identical to the
+  one-process driver (``tests/test_offload_exchange.py`` pins the staged
+  bytes meshless; ``tests/multihost_worker.py --drill offload`` pins the
+  factors over real Gloo processes).
+
+Accounting: ``exchange_rows_dcn``/``exchange_bytes_dcn`` meter the
+pairwise residual a point-to-point DCN fabric would carry (the protocol
+quantity the bench fleet row records); ``exchange_wire_bytes`` meters
+what the allgather transport actually moved (pad × peers — the honest
+gap between the reference collective and a tuned pairwise exchange).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cfk_tpu.offload.staging import stats_add
+from cfk_tpu.offload.store import HostFactorStore, _np_dtype
+from cfk_tpu.telemetry import span
+
+
+def full_store_bounds(rows_total: int, num_shards: int) -> np.ndarray:
+    """The shard bounds a FULL-table ``HostFactorStore`` would carry —
+    the one formula (ceil-split, clipped) duplicated nowhere else: the
+    mirror's ``shard_of_rows`` must be bitwise the full store's for the
+    fabric attribution to survive the multi-process split."""
+    per = -(-rows_total // num_shards)
+    return np.minimum(np.arange(0, num_shards + 1) * per, rows_total)
+
+
+@dataclasses.dataclass(frozen=True)
+class OwnershipMap:
+    """Which process owns which shards (and therefore store rows) of one
+    side's factor table.
+
+    Contiguous shard blocks: process ``p`` owns shards
+    ``[p·spp, (p+1)·spp)`` and — because ``padded_entities = S · local``
+    makes the store's ceil-split bounds coincide exactly with the shard
+    solve ranges — store rows ``[p·spp·rows_per_shard, ...)``.  Solve
+    write-back is therefore always process-local; only fixed-side READS
+    cross the fleet, which is why the exchange ships windows residuals
+    and nothing else."""
+
+    num_shards: int
+    num_processes: int
+    process: int
+    rows_per_shard: int
+
+    def __post_init__(self):
+        if self.num_shards % self.num_processes != 0:
+            raise ValueError(
+                f"num_shards={self.num_shards} must be divisible by "
+                f"num_processes={self.num_processes} (contiguous "
+                "shard-block ownership; run with a shard count the fleet "
+                "divides)"
+            )
+        if not 0 <= self.process < self.num_processes:
+            raise ValueError(
+                f"process {self.process} outside fleet of "
+                f"{self.num_processes}"
+            )
+
+    @property
+    def shards_per_process(self) -> int:
+        return self.num_shards // self.num_processes
+
+    @property
+    def rows_total(self) -> int:
+        return self.num_shards * self.rows_per_shard
+
+    def owner_of_shard(self, shard: int) -> int:
+        return shard // self.shards_per_process
+
+    def owned_shards(self, process: int | None = None) -> range:
+        p = self.process if process is None else process
+        spp = self.shards_per_process
+        return range(p * spp, (p + 1) * spp)
+
+    def row_bounds(self, process: int | None = None) -> tuple[int, int]:
+        p = self.process if process is None else process
+        spp_rows = self.shards_per_process * self.rows_per_shard
+        return p * spp_rows, (p + 1) * spp_rows
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseExchange:
+    """One DCN phase's manifests: ``send_rows[q]`` is the sorted-unique
+    absolute rows process ``q`` ships (the union of every peer's needs
+    from ``q`` this phase — the payload layout every process can derive,
+    so the wire never carries indices); ``recv`` is THIS process's view:
+    (peer, absolute rows taken, selection into the peer's payload)."""
+
+    send_rows: tuple
+    pad_rows: int
+    recv: tuple
+
+    @property
+    def recv_row_count(self) -> int:
+        return sum(int(r.shape[0]) for _, r, _ in self.recv)
+
+
+@dataclasses.dataclass(frozen=True)
+class HalfExchangePlan:
+    """The full exchange schedule for one half-iteration (one fixed
+    side), phase-structured by the hier-ring delivery contract."""
+
+    side: str
+    own: OwnershipMap
+    phases: tuple
+    # What shipping every window's remote rows WITH repeats would cost
+    # this process (the no-split baseline): the hot/delta keep-chains are
+    # what make the repeats identifiable, so cumulative dedup can ship a
+    # row once per half — dense/deduped is the split's DCN cut, and at a
+    # power-law shape the repeat mass concentrates exactly where the
+    # references do.
+    dense_rows_total: int = 0
+
+    @property
+    def num_phases(self) -> int:
+        return len(self.phases)
+
+    @property
+    def recv_rows_total(self) -> int:
+        return sum(p.recv_row_count for p in self.phases)
+
+    @property
+    def send_rows_total(self) -> int:
+        return sum(int(p.send_rows[self.own.process].shape[0])
+                   for p in self.phases)
+
+
+def _phase_row_lists(own: OwnershipMap, plans, schedules, *, inner: int,
+                     visits, hmaps, hot_rows):
+    """``need[p][t]``: the row arrays process ``p``'s shards gather in
+    phase ``t`` — delta rows under the hot/delta engine (the cold
+    residual; hot and kept rows never ship), full window row sets (pads
+    included — they gather too) otherwise."""
+    from cfk_tpu.parallel.spmd import hier_phase_of_visit
+
+    if visits is None:
+        num_phases = 1
+    else:
+        num_phases = max(1, own.num_shards // max(inner, 1))
+    need = [[[] for _ in range(num_phases)]
+            for _ in range(own.num_processes)]
+    for d in range(own.num_shards):
+        p = own.owner_of_shard(d)
+        plan, hmap = plans[d], (None if hmaps is None else hmaps[d])
+        if visits is None:
+            for w in schedules[d]:
+                rows = (hmap.delta_rows[w] if hmap is not None
+                        else plan.rows[w])
+                need[p][0].append(np.asarray(rows, np.int64))
+        else:
+            for vi, sl in enumerate(visits[d]):
+                t = hier_phase_of_visit(vi, inner)
+                for w in plan.windows_of_slice(sl):
+                    rows = (hmap.delta_rows[w] if hmap is not None
+                            else plan.rows[w])
+                    need[p][t].append(np.asarray(rows, np.int64))
+    if hot_rows is not None and np.asarray(hot_rows).size:
+        # Hot refresh: every process rebuilds the fixed side's device
+        # partition from master bytes at half start, so the full hot row
+        # set rides the FIRST phase (locally-owned rows are dropped by
+        # the ownership filter below like any other manifest row).
+        hr = np.asarray(hot_rows, np.int64)
+        for p in range(own.num_processes):
+            need[p][0].append(hr)
+    return need, num_phases
+
+
+def build_half_exchange(own: OwnershipMap, plans, schedules, *,
+                        inner: int, visits=None, hmaps=None,
+                        hot_rows=None, side: str = "") -> HalfExchangePlan:
+    """Derive one half's exchange schedule from the (deterministic,
+    everywhere-identical) window plans — no communication.
+
+    ``plans``/``schedules`` cover ALL shards (every process builds every
+    shard's plans; only its owned shards' windows ever stage).
+    ``visits`` (ring sides) is the per-shard ``hier_visit_order`` —
+    phase ``t`` of the exchange is outer hop ``t`` of that schedule;
+    ``None`` (stream sides) is the degenerate single-phase flat path.
+    ``hmaps`` (hot/delta on) switches manifests to cold-delta rows;
+    ``hot_rows`` adds the fixed side's hot-refresh manifest to phase 0.
+    """
+    P = own.num_processes
+    need, num_phases = _phase_row_lists(
+        own, plans, schedules, inner=inner, visits=visits, hmaps=hmaps,
+        hot_rows=hot_rows,
+    )
+    empty = np.zeros(0, np.int64)
+    lo_p, hi_p = own.row_bounds()
+    dense = 0
+    for t in range(num_phases):
+        for arr in need[own.process][t]:
+            dense += int(((arr < lo_p) | (arr >= hi_p)).sum())
+    # Per process: per-phase REMOTE rows, cumulatively deduplicated — a
+    # row received in phase t is in the mirror for every later phase, so
+    # it never ships twice in one half.
+    recv_rows = [[empty] * num_phases for _ in range(P)]
+    for p in range(P):
+        lo, hi = own.row_bounds(p)
+        seen = empty
+        for t in range(num_phases):
+            if need[p][t]:
+                r = np.unique(np.concatenate(need[p][t]))
+            else:
+                r = empty
+            r = r[(r < lo) | (r >= hi)]
+            if seen.size:
+                r = np.setdiff1d(r, seen, assume_unique=True)
+            recv_rows[p][t] = r
+            seen = np.union1d(seen, r)
+    phases = []
+    my_lo, my_hi = None, None
+    for t in range(num_phases):
+        send = []
+        for q in range(P):
+            qlo, qhi = own.row_bounds(q)
+            owned = [rr[(rr >= qlo) & (rr < qhi)]
+                     for p in range(P) if p != q
+                     for rr in (recv_rows[p][t],)]
+            send.append(np.unique(np.concatenate(owned))
+                        if owned else empty)
+        pad = max((int(s.shape[0]) for s in send), default=0)
+        recv = []
+        mine = recv_rows[own.process][t]
+        for q in range(P):
+            if q == own.process:
+                continue
+            qlo, qhi = own.row_bounds(q)
+            take = mine[(mine >= qlo) & (mine < qhi)]
+            if take.size:
+                sel = np.searchsorted(send[q], take)
+                recv.append((q, take, sel.astype(np.int64)))
+        phases.append(PhaseExchange(send_rows=tuple(send), pad_rows=pad,
+                                    recv=tuple(recv)))
+    return HalfExchangePlan(side=side, own=own, phases=tuple(phases),
+                            dense_rows_total=dense)
+
+
+class ResidualMirror:
+    """Read-only ``HostFactorStore`` facade over (local slice, received
+    window residual): the object the staging pipeline gathers from in a
+    multi-process run.
+
+    ``gather`` returns bitwise what a full-table store's would (local
+    rows read the slice in place; remote rows read the raw store bytes
+    the owner shipped), and ``shard_of_rows`` answers with the FULL
+    table's shard bounds — so ``_stage_table``'s checksums, int8
+    quantization, and local/ICI/DCN fabric attribution are byte-for-byte
+    the single-process driver's.  A gather of a row the exchange never
+    delivered raises loudly (a protocol violation, not a silent zero)."""
+
+    def __init__(self, store: HostFactorStore, own: OwnershipMap) -> None:
+        if store.rows != own.row_bounds()[1] - own.row_bounds()[0]:
+            raise ValueError(
+                f"local store holds {store.rows} rows but the ownership "
+                f"map assigns {own.row_bounds()} to process {own.process}"
+            )
+        self._store = store
+        self._own = own
+        self._lo, self._hi = own.row_bounds()
+        self.rank = store.rank
+        self.dtype = store.dtype
+        self._np = _np_dtype(store.dtype)
+        self.rows = own.rows_total
+        self._bounds = full_store_bounds(own.rows_total, own.num_shards)
+        self._r_rows = np.zeros(0, np.int64)
+        self._r_vals = np.zeros((0, store.rank), self._np)
+
+    @property
+    def num_shards(self) -> int:
+        return self._own.num_shards
+
+    @property
+    def resident_bytes(self) -> int:
+        """What the mirror itself pins in host RAM beyond the slice —
+        the per-process residual term ``budget.fleet_host_ram_bytes``
+        charges."""
+        return int(self._r_rows.nbytes + self._r_vals.nbytes)
+
+    def reset(self) -> None:
+        self._r_rows = np.zeros(0, np.int64)
+        self._r_vals = np.zeros((0, self.rank), self._np)
+
+    def rebind(self, store: HostFactorStore) -> None:
+        """Follow the driver's store rebinding (rollback restores a
+        snapshot COPY — a new object; the mirror must read the live
+        slice, never a stale one)."""
+        if store.rows != self._hi - self._lo:
+            raise ValueError(
+                f"rebind store holds {store.rows} rows, slice is "
+                f"{self._hi - self._lo}"
+            )
+        self._store = store
+
+    def deliver(self, rows: np.ndarray, values: np.ndarray) -> None:
+        """Merge one peer's phase payload (sorted kept sorted — phases
+        ship disjoint row sets by the cumulative dedup, so a merge is a
+        concatenate + argsort, never a conflict resolution)."""
+        rows = np.asarray(rows, np.int64)
+        if not rows.size:
+            return
+        values = np.asarray(values)
+        if values.dtype != self._np:
+            raise TypeError(
+                f"residual payload dtype {values.dtype} != store dtype "
+                f"{self._np} (raw-byte shipping must be bitwise)"
+            )
+        all_rows = np.concatenate([self._r_rows, rows])
+        order = np.argsort(all_rows, kind="stable")
+        self._r_rows = all_rows[order]
+        self._r_vals = np.concatenate([self._r_vals, values])[order]
+
+    def shard_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        rows = np.asarray(rows, dtype=np.int64)
+        return np.searchsorted(self._bounds, rows, side="right") - 1
+
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size and (rows.min() < 0 or rows.max() >= self.rows):
+            raise IndexError(
+                f"window rows outside [0, {self.rows}): "
+                f"[{rows.min()}, {rows.max()}]"
+            )
+        out = np.empty((rows.shape[0], self.rank), dtype=self._np)
+        local = (rows >= self._lo) & (rows < self._hi)
+        if local.any():
+            out[local] = self._store.gather(rows[local] - self._lo)
+        rem = ~local
+        if rem.any():
+            want = rows[rem]
+            idx = np.searchsorted(self._r_rows, want)
+            ok = (idx < self._r_rows.shape[0])
+            ok[ok] &= self._r_rows[idx[ok]] == want[ok]
+            if not ok.all():
+                missing = np.unique(want[~ok])[:8]
+                raise KeyError(
+                    f"rows {missing.tolist()} gathered but never "
+                    "delivered by the window exchange (manifest/consumer "
+                    "divergence — the plans are not deterministic across "
+                    "processes, or a phase was skipped)"
+                )
+            out[rem] = np.take(self._r_vals, idx, axis=0)
+        return out
+
+
+class GlooFleet:
+    """The live transport: the jax distributed runtime this process was
+    initialized into (``parallel.mesh.initialize_distributed``), with
+    ``process_allgather`` as the one collective — at fleet size 2 an
+    allgather IS the pairwise exchange, and the equal-shape stacked
+    layout is what Gloo's TCP pairs require (ragged shapes crash the
+    transport, measured)."""
+
+    def __init__(self) -> None:
+        import jax
+
+        self.num_processes = int(jax.process_count())
+        self.process = int(jax.process_index())
+
+    def allgather_bytes(self, buf: np.ndarray) -> np.ndarray:
+        """[rows, width] uint8, equal shape on every process →
+        [P, rows, width] stacked in process order."""
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(
+            np.ascontiguousarray(buf, dtype=np.uint8)
+        )
+        return np.asarray(out)
+
+    def allgather_i32(self, values) -> np.ndarray:
+        """Small control words (trip flags, checkpoint steps) → [P, n].
+        int32 on purpose: the x64-disabled jax default would silently
+        downcast int64 with a warning per call."""
+        from jax.experimental import multihost_utils
+
+        vec = np.atleast_1d(np.asarray(values, dtype=np.int32))
+        return np.asarray(multihost_utils.process_allgather(vec))
+
+
+class LocalFleet:
+    """A meshless P-process fleet simulated in ONE process (tier-1
+    tests, ``tests/test_offload_exchange.py``): ``allgather_bytes``
+    stacks the per-logical-process payloads the caller registers, so the
+    protocol functions run byte-for-byte the Gloo path without spawning
+    anything."""
+
+    def __init__(self, num_processes: int, process: int) -> None:
+        self.num_processes = int(num_processes)
+        self.process = int(process)
+        self._pending: list | None = None
+
+    def preload(self, payloads: list) -> None:
+        self._pending = [np.ascontiguousarray(p, dtype=np.uint8)
+                         for p in payloads]
+
+    def allgather_bytes(self, buf: np.ndarray) -> np.ndarray:
+        if self._pending is None:
+            raise RuntimeError("LocalFleet.preload(payloads) first")
+        got = np.stack(self._pending)
+        self._pending = None
+        return got
+
+    def allgather_i32(self, values) -> np.ndarray:
+        vec = np.atleast_1d(np.asarray(values, dtype=np.int32))
+        return np.tile(vec, (self.num_processes, 1))
+
+
+def phase_payload(plan: HalfExchangePlan, phase: int,
+                  store: HostFactorStore) -> np.ndarray:
+    """This process's phase payload: its send manifest's rows gathered
+    from the local slice as RAW BYTES (dtype-agnostic, bitwise — bf16
+    masters ship 2 B/cell exactly as staged windows do), padded to the
+    plan-time fleet maximum so the collective shape is equal everywhere."""
+    ph = plan.phases[phase]
+    rows = ph.send_rows[plan.own.process]
+    lo, _ = plan.own.row_bounds()
+    width = store.rank * _np_dtype(store.dtype).itemsize
+    buf = np.zeros((ph.pad_rows, width), np.uint8)
+    if rows.size:
+        vals = np.ascontiguousarray(store.gather(rows - lo))
+        buf[: rows.shape[0]] = vals.view(np.uint8).reshape(
+            rows.shape[0], width
+        )
+    return buf
+
+
+def deliver_phase(plan: HalfExchangePlan, phase: int,
+                  gathered: np.ndarray, mirror: ResidualMirror) -> dict:
+    """Slice each peer's payload by the plan-known layout into the
+    mirror; returns the phase's accounting (pairwise residual rows/bytes
+    + actual wire bytes)."""
+    ph = plan.phases[phase]
+    np_dt = _np_dtype(mirror.dtype)
+    width = mirror.rank * np_dt.itemsize
+    rows_got = 0
+    for peer, take, sel in ph.recv:
+        n = int(ph.send_rows[peer].shape[0])
+        vals = np.ascontiguousarray(gathered[peer, :n]).view(
+            np_dt
+        ).reshape(n, mirror.rank)
+        mirror.deliver(take, np.ascontiguousarray(vals[sel]))
+        rows_got += int(take.shape[0])
+    return {
+        "rows": rows_got,
+        "bytes": rows_got * width,
+        "wire_bytes": int(ph.pad_rows) * width
+        * (plan.own.num_processes - 1),
+    }
+
+
+def exchange_half(plan: HalfExchangePlan, store: HostFactorStore,
+                  mirror: ResidualMirror, fleet, *, stats=None,
+                  iteration: int = 0) -> dict:
+    """Run one half's full exchange: reset the mirror, then one
+    collective per DCN phase in visit order.  All phases complete before
+    the half's compute starts (the staging pool may stage any window
+    ahead of consumption, so the mirror must be whole first; overlapping
+    phase t+1's collective under phase t's compute is the on-TPU
+    follow-up).  Phases with an empty fleet-wide manifest skip the
+    collective — a plan-time constant, so every process skips together."""
+    mirror.rebind(store)
+    mirror.reset()
+    totals = {"rows": 0, "bytes": 0, "wire_bytes": 0}
+    for t in range(plan.num_phases):
+        ph = plan.phases[t]
+        if ph.pad_rows == 0:
+            continue
+        with span("train/iter/half_step/window_exchange",
+                  side=plan.side, phase=t, host=fleet.process,
+                  iteration=iteration, rows=ph.recv_row_count):
+            payload = phase_payload(plan, t, store)
+            gathered = fleet.allgather_bytes(payload)
+            got = deliver_phase(plan, t, gathered, mirror)
+        for k, v in got.items():
+            totals[k] += v
+    if stats is not None:
+        stats_add(stats, "exchange_rows_dcn", totals["rows"])
+        stats_add(stats, "exchange_bytes_dcn", totals["bytes"])
+        stats_add(stats, "exchange_wire_bytes", totals["wire_bytes"])
+    return totals
+
+
+def allgather_store(fleet, store: HostFactorStore,
+                    own: OwnershipMap) -> np.ndarray:
+    """Assemble the full table from every process's slice (final model
+    hand-off and the drills' crc comparison; equal slice shapes by the
+    divisibility contract).  At true ALX scale the full table never
+    materializes on one host — callers that only need the local slice
+    skip this."""
+    np_dt = _np_dtype(store.dtype)
+    width = store.rank * np_dt.itemsize
+    flat = np.ascontiguousarray(store.as_array()).view(np.uint8).reshape(
+        store.rows, width
+    )
+    got = fleet.allgather_bytes(flat)
+    full = np.ascontiguousarray(
+        got.reshape(own.num_processes * store.rows, width)
+    ).view(np_dt).reshape(own.rows_total, store.rank)
+    return full
+
+
+def agree_min_i32(fleet, value: int) -> int:
+    """Fleet-wide minimum of one int32 (checkpoint-step agreement: the
+    newest step EVERY host holds intact is the only resumable one)."""
+    return int(fleet.allgather_i32([int(value)]).min())
+
+
+def any_flag(fleet, flag: bool) -> np.ndarray:
+    """Allgather one boolean per process (the lockstep trip word: any
+    host's sentinel trip rolls every host back identically)."""
+    return fleet.allgather_i32([1 if flag else 0]).reshape(-1)

@@ -738,7 +738,7 @@ def windowed_half_step(
     table_dtype: str | None = None, faults=None, iteration: int = 0,
     side: str = "", stats: dict | None = None, verify_windows: bool = False,
     shard: int = 0, ici_group: int = 1, stager: WindowStager | None = None,
-    hot: "_HotHalf | None" = None,
+    hot: "_HotHalf | None" = None, host: int = 0,
 ) -> np.ndarray:
     """Solve one shard's entities against a host-resident fixed table,
     window by window (the stream-mode / all_gather-exchange scan).
@@ -793,7 +793,7 @@ def windowed_half_step(
             # compute span covers dispatch → join, so a pooled staging
             # worker's window_stage span visibly overlaps it.
             with span("train/iter/half_step/window_compute",
-                      side=side, shard=shard, window=w):
+                      side=side, shard=shard, window=w, host=host):
                 if hot is None:
                     xs = _window_half_jit()(*staged, **half_kw)
                 else:
@@ -839,7 +839,7 @@ def ring_windowed_half_step(
     table_dtype: str | None = None, faults=None, iteration: int = 0,
     side: str = "", stats: dict | None = None, verify_windows: bool = False,
     shard: int = 0, ici_group: int = 1, stager: WindowStager | None = None,
-    hot: "_HotHalf | None" = None,
+    hot: "_HotHalf | None" = None, host: int = 0,
 ) -> np.ndarray:
     """One shard's ring/hier-ring half-iteration against staged windows.
 
@@ -896,7 +896,8 @@ def ring_windowed_half_step(
             # ring would rotate, so the trace shows each phase's staging
             # (window residual — the DCN-hop payload) against compute.
             with span("train/iter/half_step/ring_visit",
-                      side=side, shard=shard, visit=i, window=w):
+                      side=side, shard=shard, visit=i, window=w,
+                      host=host):
                 if hot is None:
                     acc_a, acc_b = _ring_window_jit()(
                         acc_a, acc_b, *staged,
@@ -1090,6 +1091,9 @@ def train_als_host_window(
     staging: str | None = None,
     pool_depth: int | None = None,
     hot_rows: int | None = None,
+    checkpoint_manager=None,
+    checkpoint_every: int = 1,
+    watchdog=None,
 ):
     """ALS-WR with host-resident factor tables and windowed half-steps.
 
@@ -1097,10 +1101,13 @@ def train_als_host_window(
     ``parallel.spmd.train_als_sharded`` (sharded — all_gather, ring, or
     hier_ring exchange) on the same tiled blocks — bit-exact at every
     supported knob (``tests/test_offload.py`` /
-    ``tests/test_offload_sharded.py``).  Explicit ALS, ``layout='tiled'``,
-    ONE PROCESS driving every shard (the per-shard staging/visit
-    schedules are exactly what a multi-host deployment runs per host;
-    wiring them across real processes is the on-TPU backlog's job);
+    ``tests/test_offload_sharded.py``).  Explicit ALS, ``layout='tiled'``.
+    Under ``jax.distributed`` with ``process_count() > 1`` the SAME entry
+    point runs the fleet mode (ISSUE 17): each process keeps only its
+    own entity-range store slice and the hier-ring DCN phases allgather
+    the cold window residual (``offload/exchange.py``) into a read-only
+    mirror — factors stay crc-identical to the one-process driver, whose
+    per-shard schedules are the degenerate single-host case;
     divergence recovery runs the PR 3 ladder against in-RAM last-good
     snapshots of the stores (each rung is recorded with the loop
     vocabulary and as a plan transition when provenance rides along).
@@ -1143,6 +1150,7 @@ def train_als_host_window(
         TrainingDivergedError,
         policy_from_config,
     )
+    from cfk_tpu.transport.checkpoint import should_save
     from cfk_tpu.utils.metrics import Metrics
 
     enable_compile_cache(getattr(config, "compile_cache_dir", None))
@@ -1158,12 +1166,23 @@ def train_als_host_window(
             f"host-window offload streams the tiled layout; "
             f"layout={config.layout!r}"
         )
+    # Fleet mode (ISSUE 17): under a multi-process jax runtime each
+    # process owns only its contiguous shard block's store slice and the
+    # halves exchange cold window residuals over the hier-ring's DCN
+    # phases (offload.exchange).  Everything below that reads or writes
+    # a factor table goes through the slice store or its ResidualMirror;
+    # the single-process path is byte-for-byte untouched.
+    fleet = None
     if jax.process_count() > 1:
-        raise NotImplementedError(
-            "the windowed driver runs one process driving all shards; "
-            "true multi-process windowed training (per-host stores + "
-            "DCN window exchange) is the on-TPU follow-up (ROADMAP)"
-        )
+        from cfk_tpu.offload import exchange as _exchange
+
+        fleet = _exchange.GlooFleet()
+        if config.num_shards % fleet.num_processes != 0:
+            raise ValueError(
+                f"num_shards={config.num_shards} must be divisible by "
+                f"the fleet size ({fleet.num_processes} processes) for "
+                "contiguous shard-block store ownership"
+            )
     s = config.num_shards
     ring_m, ring_u = _resolve_side_modes(dataset, config)
     any_ring = ring_m or ring_u
@@ -1419,10 +1438,93 @@ def train_als_host_window(
         key, jax.numpy.asarray(ub.rating_sum), jax.numpy.asarray(ub.count),
         rank=config.rank, num_entities=ub.num_entities,
     ).astype(jax.numpy.dtype(config.dtype))
-    u_store = HostFactorStore.from_array(np.asarray(u0), dtype=config.dtype,
-                                         num_shards=s)
-    m_store = HostFactorStore(mb.padded_entities, config.rank,
-                              dtype=config.dtype, num_shards=s)
+    u_full_init = np.asarray(u0)
+    if fleet is None:
+        u_store = HostFactorStore.from_array(u_full_init,
+                                             dtype=config.dtype,
+                                             num_shards=s)
+        m_store = HostFactorStore(mb.padded_entities, config.rank,
+                                  dtype=config.dtype, num_shards=s)
+        own_u = own_m = fleet_sides = owned_shards = None
+    else:
+        # Every process draws the SAME full u0 (deterministic init) and
+        # keeps only its owned slice — the one unavoidably global moment;
+        # sharding the init draw itself is the on-TPU follow-up.  Store
+        # bounds coincide with shard solve ranges (padded = S · local),
+        # so solve write-back stays purely local.
+        own_u = _exchange.OwnershipMap(s, fleet.num_processes,
+                                       fleet.process,
+                                       ub.padded_entities // s)
+        own_m = _exchange.OwnershipMap(s, fleet.num_processes,
+                                       fleet.process,
+                                       mb.padded_entities // s)
+        owned_shards = own_u.owned_shards()
+        u_lo, u_hi = own_u.row_bounds()
+        m_lo, m_hi = own_m.row_bounds()
+        u_store = HostFactorStore.from_array(
+            u_full_init[u_lo:u_hi], dtype=config.dtype,
+            num_shards=own_u.shards_per_process,
+        )
+        m_store = HostFactorStore(m_hi - m_lo, config.rank,
+                                  dtype=config.dtype,
+                                  num_shards=own_m.shards_per_process)
+        visits_all = [hier_visit_order(s, inner, d) for d in range(s)]
+        hmaps_m = hmaps_u = rows_hot_u = rows_hot_m = None
+        if hot_ctx is not None:
+            hmaps_m = [hot_ctx["maps"][("m", d)] for d in range(s)]
+            hmaps_u = [hot_ctx["maps"][("u", d)] for d in range(s)]
+            rows_hot_u = hot_ctx["rows_u"]
+            rows_hot_m = hot_ctx["rows_m"]
+        explan_m = _exchange.build_half_exchange(
+            own_u, m_plans, [schedules[("m", d)] for d in range(s)],
+            inner=inner, visits=visits_all if ring_m else None,
+            hmaps=hmaps_m, hot_rows=rows_hot_u, side="m",
+        )
+        explan_u = _exchange.build_half_exchange(
+            own_m, u_plans, [schedules[("u", d)] for d in range(s)],
+            inner=inner, visits=visits_all if ring_u else None,
+            hmaps=hmaps_u, hot_rows=rows_hot_m, side="u",
+        )
+        fleet_sides = {
+            "m": (_exchange.ResidualMirror(u_store, own_u), explan_m),
+            "u": (_exchange.ResidualMirror(m_store, own_m), explan_u),
+        }
+        metrics.gauge("offload_fleet_processes", fleet.num_processes)
+        metrics.gauge("offload_fleet_process", fleet.process)
+        metrics.gauge("offload_exchange_phases",
+                      explan_m.num_phases + explan_u.num_phases)
+        metrics.gauge("offload_exchange_recv_rows_iter",
+                      explan_m.recv_rows_total + explan_u.recv_rows_total)
+        metrics.gauge("offload_exchange_rows_dense_iter",
+                      explan_m.dense_rows_total
+                      + explan_u.dense_rows_total)
+
+    # Resume (ISSUE 17): restore the newest checkpoint step EVERY
+    # process holds intact — the fleet-wide minimum of each host's
+    # latest_valid_iteration, so a host whose shard slice died recovers
+    # from its own manifest while the survivors roll back to the same
+    # step (the PR 5 lockstep contract, per-host stores edition).
+    start_it = 0
+    if checkpoint_manager is not None:
+        latest = checkpoint_manager.latest_valid_iteration()
+        step = -1 if latest is None else int(latest)
+        if fleet is not None:
+            step = _exchange.agree_min_i32(fleet, step)
+        if step >= 0:
+            st = checkpoint_manager.restore(iteration=step)
+            if st.user_factors.shape != (u_store.rows, config.rank):
+                raise ValueError(
+                    f"checkpoint step {step} holds user factors "
+                    f"{st.user_factors.shape} but this process's store "
+                    f"slice is {(u_store.rows, config.rank)} — resuming "
+                    "under a different fleet size or shard count is not "
+                    "a thing the ownership map can reinterpret"
+                )
+            u_store.write_range(0, np.asarray(st.user_factors))
+            m_store.write_range(0, np.asarray(st.movie_factors))
+            start_it = step
+            metrics.gauge("offload_resumed_from", step)
+            record_event("train", "offload_resume", iteration=step)
 
     # Hot partitions + per-(side, shard) contexts (ISSUE 15): the device
     # copies gather from the just-initialized masters (the movie side
@@ -1434,25 +1536,53 @@ def train_als_host_window(
     if hot_ctx is not None:
         hot_u_part = HotPartition(hot_ctx["rows_u"], stage_name)
         hot_m_part = HotPartition(hot_ctx["rows_m"], stage_name)
-        hot_u_part.rebuild(u_store)
-        hot_m_part.rebuild(m_store)
+        if fleet is None:
+            hot_u_part.rebuild(u_store)
+            hot_m_part.rebuild(m_store)
+        else:
+            # Fleet: the masters are slices, so the initial partitions
+            # build from transient full-table views (u0 is already fully
+            # materialized on every process; the movie side is zeros).
+            # From here on each half START rebuilds the FIXED side's
+            # partition from the exchange mirror — master bytes, the
+            # same pinned rebuild-≡-restage invariant the rollback path
+            # relies on — replacing the in-half device scatter-back
+            # (disabled below: its update would be process-local, and
+            # the next half's rebuild overwrites it anyway).
+            hot_u_part.rebuild(HostFactorStore.from_array(
+                u_full_init, dtype=config.dtype))
+            hot_m_part.rebuild(HostFactorStore.from_array(
+                np.zeros((own_m.rows_total, config.rank),
+                         _np_dtype(config.dtype)),
+                dtype=config.dtype))
         from cfk_tpu.offload import hot as _hotmod
-        for d in range(s):
-            sb_m = (_hotmod.ring_scatter_back(d, mb.local_entities,
-                                              hot_m_part.rows)
-                    if ring_m else
-                    _hotmod.scatter_back_maps(m_plans[d], d,
-                                              mb.local_entities,
-                                              hot_m_part.rows))
+        for d in (range(s) if fleet is None else owned_shards):
+            if fleet is not None:
+                # No in-half device scatter-back across a fleet (the
+                # update would be process-local); the mirror rebuild at
+                # each half start refreshes the partition from master
+                # bytes instead.  Ring mode disables via None (guarded
+                # by sb_pad), stream mode via an empty map dict.
+                sb_m = None if ring_m else {}
+            else:
+                sb_m = (_hotmod.ring_scatter_back(d, mb.local_entities,
+                                                  hot_m_part.rows)
+                        if ring_m else
+                        _hotmod.scatter_back_maps(m_plans[d], d,
+                                                  mb.local_entities,
+                                                  hot_m_part.rows))
             hot_halves[("m", d)] = _HotHalf(
                 hot_u_part, hot_m_part, hot_ctx["maps"][("m", d)], sb_m,
             )
-            sb_u = (_hotmod.ring_scatter_back(d, ub.local_entities,
-                                              hot_u_part.rows)
-                    if ring_u else
-                    _hotmod.scatter_back_maps(u_plans[d], d,
-                                              ub.local_entities,
-                                              hot_u_part.rows))
+            if fleet is not None:
+                sb_u = None if ring_u else {}
+            else:
+                sb_u = (_hotmod.ring_scatter_back(d, ub.local_entities,
+                                                  hot_u_part.rows)
+                        if ring_u else
+                        _hotmod.scatter_back_maps(u_plans[d], d,
+                                                  ub.local_entities,
+                                                  hot_u_part.rows))
             hot_halves[("u", d)] = _HotHalf(
                 hot_m_part, hot_u_part, hot_ctx["maps"][("u", d)], sb_u,
             )
@@ -1484,6 +1614,7 @@ def train_als_host_window(
         in_kernel_gather=config.in_kernel_gather,
         table_dtype=config.table_dtype, faults=window_faults, stats=stats,
         verify_windows=verify_windows, ici_group=inner,
+        host=0 if fleet is None else fleet.process,
     )
     m_local = mb.local_entities
     u_local = ub.local_entities
@@ -1515,21 +1646,37 @@ def train_als_host_window(
         unchanged.  ``close()`` in the ``finally`` drains workers before
         any rollback can swap the store under them."""
         algo = ov.reg_solve_algo or config.reg_solve_algo
-        out = np.zeros((local * s, config.rank),
+        shards = range(s) if fleet is None else owned_shards
+        hot_on = bool(hot_halves)
+        fixed_read = fixed_store
+        if fleet is not None:
+            # Distributed window exchange (ISSUE 17): every DCN phase's
+            # cold residual lands in the mirror BEFORE compute starts
+            # (the pooled stager may stage any window ahead), then the
+            # fixed side's hot partition rebuilds from the just-shipped
+            # master bytes.  The staging pipeline below runs unchanged
+            # against the mirror — same gathers, same checksums, same
+            # fabric attribution, same bits.
+            mirror, explan = fleet_sides[side]
+            _exchange.exchange_half(explan, fixed_store, mirror, fleet,
+                                    stats=stats, iteration=it)
+            if hot_on:
+                hot_halves[(side, shards.start)].fixed.rebuild(mirror)
+            fixed_read = mirror
+        out = np.zeros((local * len(shards), config.rank),
                        dtype=_np_dtype(config.dtype))
         schedules = [
             (plans[d].schedule(hier_visit_order(s, inner, d)) if ring
              else plans[d].schedule())
             for d in range(s)
         ]
-        tasks = [(d, w) for d in range(s) for w in schedules[d]]
-        hot_on = bool(hot_halves)
+        tasks = [(d, w) for d in shards for w in schedules[d]]
         if hot_on and window_faults is not None:
             # Chaos seam (ISSUE 15): poison the FIXED side's device
             # partition before the half reads it — the host master is
             # untouched, so the sentinel trip that follows rolls back
             # and `rebuild` recovers the partition bit-exactly.
-            part = hot_halves[(side, 0)].fixed
+            part = hot_halves[(side, shards.start)].fixed
             pois = (window_faults.apply_hot(it, side, part.num_rows)
                     if hasattr(window_faults, "apply_hot") else None)
             if pois is not None:
@@ -1540,47 +1687,51 @@ def train_als_host_window(
         def stage_task(d, w):
             if hot_on:
                 return _stage_window_delta(
-                    fixed_store, plans[d], hot_halves[(side, d)].hmap, w,
+                    fixed_read, plans[d], hot_halves[(side, d)].hmap, w,
                     stage_np=stage_np_cfg, int8=int8_cfg,
                     faults=window_faults, iteration=it, side=side,
                     shard=d, verify_windows=verify_windows, stats=stats,
                     ici_group=inner,
                 )
             return _stage_window(
-                fixed_store, plans[d], w, stage_np=stage_np_cfg,
+                fixed_read, plans[d], w, stage_np=stage_np_cfg,
                 int8=int8_cfg, faults=window_faults, iteration=it,
                 side=side, shard=d, verify_windows=verify_windows,
                 stats=stats, ici_group=inner,
             )
 
         def stage_attrs(d, w):
-            return _stage_span_attrs(
+            attrs = _stage_span_attrs(
                 hot_halves[(side, d)].hmap if hot_on else None,
                 plans[d], w,
             )
+            attrs["host"] = 0 if fleet is None else fleet.process
+            return attrs
 
         stager = WindowStager(tasks, stage_task, mode=staging,
                               depth=pool_depth, stats=stats,
                               span_attrs=stage_attrs)
         try:
-            for d in range(s):
+            for d in shards:
                 kw = dict(half_kw, lam=ov.lam,
                           fused_epilogue=ov.fused_epilogue,
                           reg_solve_algo=algo, iteration=it, side=side,
                           shard=d, stager=stager,
                           hot=hot_halves.get((side, d)))
                 with span("train/iter/half_step", side=side, shard=d,
-                          ring=bool(ring), iteration=it):
+                          ring=bool(ring), iteration=it,
+                          host=0 if fleet is None else fleet.process):
                     if ring:
                         rows = ring_windowed_half_step(
-                            fixed_store, plans[d],
+                            fixed_read, plans[d],
                             visits=hier_visit_order(s, inner, d),
                             count_local=counts[d], **kw,
                         )
                     else:
-                        rows = windowed_half_step(fixed_store, plans[d],
+                        rows = windowed_half_step(fixed_read, plans[d],
                                                   **kw)
-                out[d * local:(d + 1) * local] = rows
+                out[(d - shards.start) * local:
+                    (d - shards.start + 1) * local] = rows
         finally:
             stager.close()
         return out
@@ -1594,9 +1745,9 @@ def train_als_host_window(
              or verify_windows or window_faults is not None)
 
     snap = (u_store.copy(), m_store.copy()) if armed else (None, None)
-    snap_iter = 0
+    snap_iter = start_it
     trips = 0
-    it = 0
+    it = start_it
     degraded = False
     traces0 = trace_count()
     train_t0 = time.time()
@@ -1607,9 +1758,13 @@ def train_als_host_window(
         stores: re-gather from the restored host masters (ISSUE 15 —
         a poisoned or stale device partition cannot survive a rollback,
         so replay is bit-identical to a fresh run)."""
-        if hot_u_part is not None:
+        if hot_u_part is not None and fleet is None:
             hot_u_part.rebuild(u_store)
             hot_m_part.rebuild(m_store)
+        # Fleet: partitions rebuild from the exchange mirror at each
+        # half start (master bytes of the ROLLED-BACK stores — the
+        # exchange rebinds to the restored slice), so there is nothing
+        # to heal here.
 
     def trip(reason: str) -> bool:
         """Rollback + ladder climb; returns False when retries are
@@ -1662,45 +1817,90 @@ def train_als_host_window(
             metrics.note(f"plan_transition_{trips}", str(t))
         return True
 
-    with metrics.phase("train"):
-        while it < config.num_iterations:
-            try:
-                with span("train/iter", i=it, tier="host_window"):
-                    m_new = half("m", u_store, m_plans, m_local, count_m,
-                                 it, ring_m)
-                    m_store.write_range(0, m_new)
-                    u_new = half("u", m_store, u_plans, u_local, count_u,
-                                 it, ring_u)
-                    u_store.write_range(0, u_new)
-                record_event("train", "iter", i=it, tier="host_window")
-            except WindowIntegrityError as e:
-                # The staging checksum caught a torn/corrupt window BEFORE
-                # it reached a kernel; the store is intact, so rollback +
-                # replay is exact (the stores may hold a half-written m —
-                # the snapshot restore erases it).
-                if not trip(f"window integrity: {e}"):
+    if watchdog is not None:
+        watchdog.arm()
+    try:
+        with metrics.phase("train"):
+            while it < config.num_iterations:
+                try:
+                    with span("train/iter", i=it, tier="host_window"):
+                        m_new = half("m", u_store, m_plans, m_local,
+                                     count_m, it, ring_m)
+                        m_store.write_range(0, m_new)
+                        u_new = half("u", m_store, u_plans, u_local,
+                                     count_u, it, ring_u)
+                        u_store.write_range(0, u_new)
+                    record_event("train", "iter", i=it, tier="host_window")
+                except WindowIntegrityError as e:
+                    # The staging checksum caught a torn/corrupt window
+                    # BEFORE it reached a kernel; the store is intact, so
+                    # rollback + replay is exact (the stores may hold a
+                    # half-written m — the snapshot restore erases it).
+                    if fleet is not None:
+                        # A half-iteration trip desyncs the fleet's
+                        # collective schedule (peers are already past the
+                        # probe sync) — fatal here; peers are bounded by
+                        # the Gloo transport error or their StallWatchdog.
+                        record_event("fault", "window_integrity_fleet",
+                                     iteration=it, detail=str(e))
+                        dump_flight("window_integrity_fleet")
+                        raise
+                    if not trip(f"window integrity: {e}"):
+                        degraded = True
+                        break
+                    continue
+                it += 1
+                metrics.incr("iterations")
+                if (checkpoint_manager is not None
+                        and should_save(it, checkpoint_every,
+                                        config.num_iterations)):
+                    # Per-process manifest of the OWNED slice, after the
+                    # iteration commit — the recovery unit a killed
+                    # host's replacement restores (fleet-min agreement
+                    # at startup picks the step every host holds).
+                    checkpoint_manager.save(
+                        it, u_store.as_array(), m_store.as_array(),
+                        meta={
+                            "tier": "host_window",
+                            "processes": (1 if fleet is None
+                                          else fleet.num_processes),
+                            "process": (0 if fleet is None
+                                        else fleet.process),
+                        },
+                    )
+                if watchdog is not None:
+                    watchdog.tick(it)
+                if first_step_s is None:
+                    # Cold-start attribution (ISSUE 13): how long until
+                    # the first full iteration lands — the quantity a
+                    # warm persistent compile cache (compile_cache_dir)
+                    # shrinks.
+                    first_step_s = time.time() - train_t0
+                if not armed:
+                    continue
+                if it % probe_every != 0 and it < config.num_iterations:
+                    continue
+                reason = _probe(u_new, m_new, norm_limit)
+                if fleet is not None:
+                    # Lockstep trip sync (the PR 5 contract): one word
+                    # per process; ANY nonzero rolls every host back to
+                    # the same snapshot step with the same ladder rung —
+                    # the collective schedules stay aligned.
+                    flags = _exchange.any_flag(fleet, reason is not None)
+                    if reason is None and flags.any():
+                        peers = [p for p in range(fleet.num_processes)
+                                 if flags[p]]
+                        reason = f"lockstep trip from peer {peers}"
+                if reason is None:
+                    snap = (u_store.copy(), m_store.copy())
+                    snap_iter = it
+                    continue
+                if not trip(reason):
                     degraded = True
                     break
-                continue
-            it += 1
-            metrics.incr("iterations")
-            if first_step_s is None:
-                # Cold-start attribution (ISSUE 13): how long until the
-                # first full iteration lands — the quantity a warm
-                # persistent compile cache (compile_cache_dir) shrinks.
-                first_step_s = time.time() - train_t0
-            if not armed:
-                continue
-            if it % probe_every != 0 and it < config.num_iterations:
-                continue
-            reason = _probe(u_new, m_new, norm_limit)
-            if reason is None:
-                snap = (u_store.copy(), m_store.copy())
-                snap_iter = it
-                continue
-            if not trip(reason):
-                degraded = True
-                break
+    finally:
+        if watchdog is not None:
+            watchdog.disarm()
     metrics.gauge("offload_windows_staged", stats.get("windows_staged", 0))
     metrics.gauge("offload_staged_mb",
                   round(stats.get("staged_bytes", 0) / 1e6, 3))
@@ -1737,14 +1937,35 @@ def train_als_host_window(
     for key_ in ("rows_local", "rows_ici", "rows_dcn"):
         if key_ in stats:
             metrics.gauge(f"offload_{key_}", stats[key_])
+    if fleet is not None:
+        # Residual DCN accounting (ISSUE 17): rows/bytes a pairwise DCN
+        # fabric would carry per the exchange manifests (cumulative-
+        # deduped cold residual — the quantity the hot/delta split
+        # shrinks), plus the actual allgather wire bytes (pad × peers).
+        for key_ in ("exchange_rows_dcn", "exchange_bytes_dcn",
+                     "exchange_wire_bytes"):
+            if key_ in stats:
+                metrics.gauge(f"offload_{key_}", stats[key_])
+        metrics.gauge("offload_exchange_mb_dcn",
+                      round(stats.get("exchange_bytes_dcn", 0) / 1e6, 3))
+        metrics.gauge("offload_exchange_wire_mb",
+                      round(stats.get("exchange_wire_bytes", 0) / 1e6, 3))
     if degraded:
         metrics.gauge("iterations_completed", snap_iter)
 
     from cfk_tpu.models.als import ALSModel
 
+    if fleet is None:
+        u_arr, m_arr = u_store.as_array(), m_store.as_array()
+    else:
+        # Final hand-off: assemble the full tables from every process's
+        # slice (the drills' crc comparison reads this; slice-only
+        # consumers at true ALX scale would skip it — ROADMAP).
+        u_arr = _exchange.allgather_store(fleet, u_store, own_u)
+        m_arr = _exchange.allgather_store(fleet, m_store, own_m)
     return ALSModel(
-        user_factors=u_store.as_array(),
-        movie_factors=m_store.as_array(),
+        user_factors=u_arr,
+        movie_factors=m_arr,
         num_users=dataset.user_map.num_entities,
         num_movies=dataset.movie_map.num_entities,
     )

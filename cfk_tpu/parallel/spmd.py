@@ -496,6 +496,30 @@ def resolve_ici_group(config: ALSConfig) -> int:
     return config.num_shards
 
 
+def hier_phase_count(num_shards: int, inner: int) -> int:
+    """Outer (DCN) phase count of the hierarchical exchange: the number
+    of cross-group hops ``half_step_tiled_ring_hier`` rotates, and
+    therefore the number of collectives the distributed window exchange
+    runs per half.  ``inner == num_shards`` (the flat path) degenerates
+    to one phase."""
+    if inner < 1 or num_shards % inner != 0:
+        raise ValueError(
+            f"inner ring size {inner} must divide num_shards={num_shards}"
+        )
+    return num_shards // inner
+
+
+def hier_phase_of_visit(visit_index: int, inner: int) -> int:
+    """Which outer (DCN) phase a position in ``hier_visit_order``
+    belongs to: the visit order walks ``inner`` ICI steps per outer hop,
+    so phase = ``visit_index // inner``.  This is the cross-process
+    delivery contract — a window's fixed-table residual must be on its
+    consuming host by the start of the phase its slice is visited in."""
+    if inner < 1:
+        raise ValueError(f"inner ring size {inner} must be >= 1")
+    return visit_index // inner
+
+
 def half_step_tiled_ring_hier(
     fixed_local, blk, chunks, local_entities, *, lam, num_shards, inner,
     solver="cholesky", gram_backend=None, overlap=None, probe=None,

@@ -647,3 +647,60 @@ def plan_for_config(config, *, num_users: int, num_movies: int, nnz: int,
     mode = getattr(config, "plan", "model")
     return plan(shape, device, constraints, mode=mode,
                 cache_path=cache_path)
+
+
+def fleet_host_window_plan(shape: ProblemShape, *, host_ram_bytes: float,
+                           processes: int, armed: bool = True) -> dict:
+    """Provenance for the FLEET out-of-core tier: prove that a shape whose
+    factor tables exceed one host's RAM budget fits once the
+    ``HostFactorStore`` is range-sharded over ``processes`` hosts.
+
+    Returns a breakdown dict recording both verdicts — the single-host
+    refusal (``single_host_fits``) and the per-process fit
+    (``fleet_fits``) — alongside the byte terms they were judged on, so a
+    bench row or a fleet launcher can show WHY the fleet was required.
+    Raises ``PlanConstraintError`` when even the fleet does not fit (the
+    message names the two levers: more processes, or more host RAM)."""
+    from cfk_tpu.offload.budget import (
+        RESIDENT_FRACTION,
+        fleet_host_ram_bytes,
+        fits_fleet_host,
+    )
+
+    if processes < 1:
+        raise PlanConstraintError(f"processes must be >= 1, got {processes}")
+    if shape.num_shards % processes != 0:
+        raise PlanConstraintError(
+            f"num_shards={shape.num_shards} must be divisible by "
+            f"processes={processes}: the window exchange assigns each "
+            f"process a contiguous run of shards")
+    kw = dict(dtype=shape.dtype, armed=armed)
+    single = fleet_host_ram_bytes(shape.num_users, shape.num_movies,
+                                  shape.nnz, shape.rank, processes=1, **kw)
+    fleet = fleet_host_ram_bytes(shape.num_users, shape.num_movies,
+                                 shape.nnz, shape.rank,
+                                 processes=processes, **kw)
+    single_fits = fits_fleet_host(
+        shape.num_users, shape.num_movies, shape.nnz, shape.rank,
+        host_ram_bytes=host_ram_bytes, processes=1, **kw)
+    fleet_fits = fits_fleet_host(
+        shape.num_users, shape.num_movies, shape.nnz, shape.rank,
+        host_ram_bytes=host_ram_bytes, processes=processes, **kw)
+    if not fleet_fits:
+        raise PlanConstraintError(
+            f"per-process host window footprint "
+            f"{fleet['total'] / 2**20:.1f} MiB exceeds the "
+            f"{host_ram_bytes * RESIDENT_FRACTION / 2**20:.1f} MiB resident "
+            f"budget even at processes={processes}; raise processes (shards "
+            f"permitting) or host_ram_bytes")
+    return {
+        "tier": "fleet_host_window",
+        "processes": processes,
+        "host_ram_bytes": float(host_ram_bytes),
+        "resident_fraction": RESIDENT_FRACTION,
+        "single_host_bytes": single["total"],
+        "single_host_fits": single_fits,
+        "per_process_bytes": fleet["total"],
+        "per_process_breakdown": fleet,
+        "fleet_fits": fleet_fits,
+    }

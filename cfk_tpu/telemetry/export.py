@@ -55,12 +55,26 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
 
 
-def prometheus_text(metrics: Metrics, prefix: str = PREFIX) -> str:
+def prometheus_text(metrics: Metrics, prefix: str = PREFIX,
+                    labels: dict[str, object] | None = None) -> str:
     """Render the registry in the text exposition format.  One snapshot
     per call (the registry lock guards each family's copy), TYPE line
     before its samples, trailing newline — the conformance test walks
-    these properties line by line."""
+    these properties line by line.
+
+    ``labels`` attaches constant labels to every counter/gauge sample —
+    the fleet attribution seam: a multi-process offload host exports with
+    ``labels={"process": jax.process_index()}`` so one scrape target per
+    host aggregates cleanly (phase/histogram samples keep their own label
+    sets; Prometheus merges per-target constant labels upstream)."""
     lines: list[str] = []
+    lbl = ""
+    if labels:
+        pairs = ",".join(
+            f'{sanitize_metric_name(str(k))}="{_escape_label(str(v))}"'
+            for k, v in sorted(labels.items())
+        )
+        lbl = "{" + pairs + "}"
     with metrics._lock:
         counters = sorted(metrics.counters.items())
         gauges = sorted(metrics.gauges.items())
@@ -69,7 +83,7 @@ def prometheus_text(metrics: Metrics, prefix: str = PREFIX) -> str:
     for name, value in counters:
         m = f"{prefix}_{sanitize_metric_name(name)}_total"
         lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_fmt(value)}")
+        lines.append(f"{m}{lbl} {_fmt(value)}")
     for name, value in gauges:
         try:
             v = _fmt(value)
@@ -77,7 +91,7 @@ def prometheus_text(metrics: Metrics, prefix: str = PREFIX) -> str:
             continue  # non-numeric gauge (provenance strings etc.)
         m = f"{prefix}_{sanitize_metric_name(name)}"
         lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {v}")
+        lines.append(f"{m}{lbl} {v}")
     if phases:
         m = f"{prefix}_phase_seconds"
         lines.append(f"# TYPE {m} gauge")
@@ -107,15 +121,19 @@ class MetricsHTTPServer:
     probe a supervisor wants next to the scrape target)."""
 
     def __init__(self, metrics: Metrics, *, port: int = 0,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 labels: dict[str, object] | None = None) -> None:
         self.metrics = metrics
+        self.labels = dict(labels) if labels else None
         registry = metrics
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 if self.path.split("?", 1)[0] == "/metrics":
-                    body = prometheus_text(registry).encode()
+                    body = prometheus_text(
+                        registry, labels=outer.labels
+                    ).encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",

@@ -467,6 +467,109 @@ def scenario_worker_kill() -> dict:
     }
 
 
+def scenario_offload_fleet() -> dict:
+    """Distributed window exchange under a hard host loss: SIGKILL one of
+    two offload-fleet processes AFTER it commits its per-host store-slice
+    checkpoint; the survivor must exit bounded (Gloo collective error or
+    the StallWatchdog — never a hang), every host's manifest must hold
+    only intact committed steps, and restarting the full fleet must
+    min-agree the resume step across the per-host manifests and land
+    bit-identically (crc32) on the uninterrupted 2-process run — which
+    itself bit-matches the one-process driver (the exchange contract)."""
+    import importlib.util
+    import re
+    import signal
+    import tempfile
+
+    from cfk_tpu.resilience.preempt import STALL_EXIT_CODE
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = 29700 + (os.getpid() % 200) + 20
+
+    spec = importlib.util.spec_from_file_location(
+        "multihost_worker",
+        os.path.join(root, "tests", "multihost_worker.py"),
+    )
+    mhw = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mhw)
+
+    def spawn_pair(ckdir, drill, extra=(), port_off=0):
+        procs = mhw.spawn_workers(
+            port + port_off, 2, ckdir, "--drill", drill, *extra
+        )
+        return procs, mhw.communicate_all(procs, timeout=240)
+
+    def drill_rows(outs, tag):
+        return {json.loads(line.split(" ", 1)[1])["pid"]:
+                json.loads(line.split(" ", 1)[1])
+                for out in outs for line in out.splitlines()
+                if line.startswith(tag + " ")}
+
+    kill_iter = 2
+    with tempfile.TemporaryDirectory() as ck:
+        # uninterrupted 2-process reference — the crc the resumed fleet
+        # must land on bit-exactly
+        uprocs, uouts = spawn_pair(None, "offload", port_off=4)
+        urows = drill_rows(uouts, "DRILL_OFFLOAD")
+        fleet_crc = urows.get(0, {}).get("crc")
+        fleet_agrees = (len(urows) == 2
+                        and urows[0]["crc"] == urows[1]["crc"])
+
+        procs, outs = spawn_pair(
+            ck, "offload-kill",
+            ("--kill-iteration", str(kill_iter), "--stall-timeout", "6"),
+        )
+        victim_killed = procs[1].returncode == -signal.SIGKILL
+        survivor_bounded = procs[0].returncode != 0
+        survivor_graceful = procs[0].returncode == STALL_EXIT_CODE
+        # BOTH hosts' manifests hold only intact committed steps (the
+        # dead host's store slice recovers from ITS manifest, not a copy)
+        intact = True
+        steps_by_host = {}
+        for pid in (0, 1):
+            mgr = CheckpointManager(os.path.join(ck, f"host_{pid}"))
+            steps = mgr.iterations()
+            steps_by_host[pid] = steps
+            try:
+                for it in steps:
+                    mgr.verify(it)
+            except Exception:
+                intact = False
+            intact = intact and bool(steps)
+        rprocs, routs = spawn_pair(ck, "offload-resume", port_off=2)
+        rrows = drill_rows(routs, "DRILL_OFFLOAD_RESUME")
+    resumed_ok = (
+        all(p.returncode == 0 for p in rprocs)
+        and len(rrows) == 2
+        and rrows[0]["crc"] == rrows[1]["crc"] == fleet_crc
+        and rrows[0]["resumed_from"] >= kill_iter
+    )
+    from cfk_tpu.telemetry import record_event
+
+    record_event("fault", "offload_fleet_kill_observed",
+                 victim_exit=procs[1].returncode,
+                 survivor_exit=procs[0].returncode,
+                 steps_intact=bool(intact),
+                 resumed_from=rrows.get(0, {}).get("resumed_from"))
+    return {
+        "scenario": "offload_fleet",
+        "fault_fired": bool(victim_killed),
+        "detected": bool(survivor_bounded),
+        "recovered": bool(resumed_ok),
+        "survivor_exit": procs[0].returncode,
+        "survivor_graceful_stall_exit": bool(survivor_graceful),
+        "steps_committed": steps_by_host,
+        "checkpoints_intact": bool(intact),
+        "fleet_crc_agrees": bool(fleet_agrees),
+        "uninterrupted_crc": fleet_crc,
+        "resumed_crc": rrows.get(0, {}).get("crc"),
+        "resumed_from": rrows.get(0, {}).get("resumed_from"),
+        "ok": bool(victim_killed and survivor_bounded and intact
+                   and fleet_agrees and resumed_ok),
+    }
+
+
 def _stream_fixture(parts=2, n=60, new_users=(4242,)):
     """(dataset, config, base model, broker-with-produced-stream)."""
     from cfk_tpu.config import ALSConfig
@@ -1484,6 +1587,7 @@ SCENARIOS = {
     "preemption": scenario_preemption,
     "slow_disk": scenario_slow_disk,
     "worker_kill": scenario_worker_kill,
+    "offload_fleet": scenario_offload_fleet,
     "stream_duplicates": scenario_stream_duplicates,
     "stream_crash_replay": scenario_stream_crash_replay,
     "stream_poison_batch": scenario_stream_poison_batch,
@@ -1515,6 +1619,7 @@ FLIGHT_EXPECT = {
     "preemption": ("preempt",),
     "slow_disk": ("checkpoint_committed",),
     "worker_kill": ("worker_kill",),
+    "offload_fleet": ("offload_fleet_kill",),
     "stream_duplicates": ("delivery_duplicates",),
     "stream_crash_replay": ("stream_resumed", "corrupt_checkpoint"),
     "stream_poison_batch": ("quarantine",),

@@ -25,6 +25,25 @@ for the driver to compare with a single-process run.
 - ``init-timeout`` — start ONE process of a declared 2-process fleet and
   assert ``initialize_distributed(init_timeout_s=...)`` raises the
   actionable missing-peer error instead of hanging for the 300 s default.
+
+The ``offload*`` drills exercise the FLEET out-of-core tier (the
+distributed window-residual exchange): each process owns a contiguous
+entity-range slice of the ``HostFactorStore`` and ships cold window
+residuals over the hier-ring DCN phases.
+
+- ``offload`` — 2-process Gloo ``train_als_host_window`` run; every
+  process prints a crc32 of the allgathered final factors, which must
+  bit-match both the peer's AND a one-process driver run of the same
+  config (the exchange contract: the fleet IS the single driver,
+  distributed).
+- ``offload-kill`` / ``offload-resume`` — process 1 SIGKILLs itself
+  after committing a per-host checkpoint; the survivor exits bounded
+  (``STALL_EXIT_CODE``); the restarted fleet min-agrees the resume step
+  across per-host manifests and must land on the uninterrupted crc.
+- ``offload-bench`` — a larger power-law shape whose per-host store
+  footprint exceeds a simulated single-host RAM budget; process 0
+  prints the fleet bench row (DCN residual rows/bytes, dense no-split
+  baseline, hot/delta coverage, budget provenance).
 """
 
 import argparse
@@ -37,6 +56,8 @@ import warnings
 import zlib
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:  # standalone `python tests/multihost_worker.py ...`
+    sys.path.insert(0, _ROOT)
 
 
 def spawn_workers(port, nprocs=2, ckdir=None, *extra, pids=None):
@@ -296,6 +317,171 @@ def drill_init_timeout(pid: int, nprocs: int, port: int,
     sys.exit(1)
 
 
+def _offload_setup(bench: bool = False):
+    """The FLEET drill config: 4 hier-ring shards over however many
+    processes joined (2 in the drills; the same call under ONE process is
+    the bit-exactness reference)."""
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+
+    shape = (2000, 800, 40000, 2) if bench else (64, 32, 900, 0)
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(shape[0], shape[1], shape[2], seed=shape[3]),
+        num_shards=4, layout="tiled", tile_rows=16, chunk_elems=512,
+        ring=True, ring_warn=False,
+    )
+    cfg = ALSConfig(rank=4, lam=0.05, num_iterations=4, seed=3,
+                    num_shards=4, layout="tiled", exchange="hier_ring",
+                    ici_group=2, health_check_every=1)
+    return ds, cfg
+
+
+def drill_offload(pid: int) -> None:
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds, cfg = _offload_setup()
+    metrics = Metrics()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = train_als_host_window(ds, cfg, metrics=metrics)
+    print("DRILL_OFFLOAD " + json.dumps({
+        "pid": pid,
+        "crc": _crc(model.user_factors, model.movie_factors),
+        "processes": int(metrics.gauges.get("offload_fleet_processes", 1)),
+        "rows_dcn": int(metrics.gauges.get("offload_exchange_rows_dcn", 0)),
+        "wire_mb": metrics.gauges.get("offload_exchange_wire_mb", 0.0),
+    }, sort_keys=True), flush=True)
+
+
+def drill_offload_kill(pid: int, ckdir: str, kill_iteration: int,
+                       stall_timeout: float, resume: bool) -> None:
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.resilience.preempt import STALL_EXIT_CODE, StallWatchdog
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds, cfg = _offload_setup()
+    # Per-host manager: each process checkpoints ITS store slice under its
+    # own manifest; resume min-agrees the latest step EVERY host committed.
+    manager = CheckpointManager(os.path.join(ckdir, f"host_{pid}"))
+
+    if resume:
+        metrics = Metrics()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = train_als_host_window(
+                ds, cfg, metrics=metrics, checkpoint_manager=manager,
+            )
+        print("DRILL_OFFLOAD_RESUME " + json.dumps({
+            "pid": pid,
+            "crc": _crc(model.user_factors, model.movie_factors),
+            "resumed_from": int(
+                metrics.gauges.get("offload_resumed_from", -1)
+            ),
+        }, sort_keys=True), flush=True)
+        return
+
+    class _KillingWatchdog(StallWatchdog):
+        # tick() fires AFTER the iteration's synchronous per-host save
+        # (windowed.py orders save before tick), so the kill lands on a
+        # committed step: the restarted fleet min-agrees to exactly
+        # ``kill_iteration``.
+        def tick(self, done=None):
+            super().tick(done)
+            print(f"DRILL_ITER pid={pid} done={done}", flush=True)
+            if pid == 1 and done is not None and done >= kill_iteration:
+                sys.stdout.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    wd = _KillingWatchdog(stall_timeout, manager=manager)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            train_als_host_window(
+                ds, cfg, checkpoint_manager=manager, watchdog=wd,
+            )
+    except Exception as e:
+        wd.disarm()
+        try:
+            manager.wait_pending(timeout=30.0)
+        except Exception:
+            pass
+        print(f"DRILL_COLLECTIVE_ERROR pid={pid} "
+              f"error={type(e).__name__}", flush=True)
+        # Same os._exit rationale as drill_kill: atexit's coordination
+        # barrier aborts against the dead peer and clobbers the status.
+        sys.stdout.flush()
+        os._exit(STALL_EXIT_CODE)
+    print(f"DRILL_OFFLOAD_KILL_COMPLETED pid={pid}", flush=True)
+
+
+def drill_offload_bench(pid: int) -> None:
+    """The fleet scale-sweep row: a power-law shape whose per-host store
+    exceeds a simulated single-host RAM budget completes under 2
+    processes; process 0 prints the row with the DCN residual accounting
+    and the budget provenance that forced the fleet."""
+    import jax
+
+    from cfk_tpu.offload.budget import fleet_host_ram_bytes
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.plan.resolver import fleet_host_window_plan
+    from cfk_tpu.plan.spec import ProblemShape
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds, cfg = _offload_setup(bench=True)
+    nprocs = jax.process_count()
+    nu = ds.user_map.num_entities
+    nm = ds.movie_map.num_entities
+    nnz = ds.coo_dense.num_ratings
+    # Simulated budget between the P=1 and P=nprocs footprints: a single
+    # host REFUSES this shape, the fleet fits it — provenance proves both.
+    s1 = fleet_host_ram_bytes(nu, nm, nnz, cfg.rank, processes=1)["total"]
+    sp = fleet_host_ram_bytes(nu, nm, nnz, cfg.rank,
+                              processes=nprocs)["total"]
+    budget = (s1 + sp) / 2 / 0.9
+    shape = ProblemShape(num_users=nu, num_movies=nm, nnz=nnz,
+                         rank=cfg.rank, num_shards=cfg.num_shards)
+    prov = fleet_host_window_plan(shape, host_ram_bytes=budget,
+                                  processes=nprocs)
+    assert not prov["single_host_fits"], prov
+    metrics = Metrics()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = train_als_host_window(ds, cfg, metrics=metrics)
+    g = metrics.gauges
+    recv = int(g.get("offload_exchange_recv_rows_iter", 0))
+    dense = int(g.get("offload_exchange_rows_dense_iter", 0))
+    row = {
+        "tier": "fleet",
+        "processes": nprocs,
+        "users": nu, "movies": nm, "nnz": nnz, "rank": cfg.rank,
+        "crc": _crc(model.user_factors, model.movie_factors),
+        "rows_dcn": int(g.get("offload_exchange_rows_dcn", 0)),
+        "mb_dcn": g.get("offload_exchange_mb_dcn", 0.0),
+        "wire_mb": g.get("offload_exchange_wire_mb", 0.0),
+        "recv_rows_iter": recv,
+        "dense_rows_iter": dense,
+        # The hot/delta split's win over the no-split dense exchange
+        # (which would re-ship every remote reference, repeats included).
+        "dcn_reduction": round(1.0 - recv / dense, 4) if dense else 0.0,
+        "rows_staged": int(g.get("offload_rows_staged", 0)),
+        "rows_delta_skipped": int(g.get("offload_rows_delta_skipped", 0)),
+        "hot": metrics.notes.get("offload_hot", "off"),
+        "budget": {
+            "host_ram_mb": round(budget / 1e6, 2),
+            "single_host_mb": round(prov["single_host_bytes"] / 1e6, 2),
+            "per_process_mb": round(prov["per_process_bytes"] / 1e6, 2),
+            "single_host_fits": prov["single_host_fits"],
+            "fleet_fits": prov["fleet_fits"],
+        },
+    }
+    if pid == 0:
+        print("OFFLOAD_BENCH_ROW " + json.dumps(row, sort_keys=True),
+              flush=True)
+
+
 def legacy_main(pid, nprocs, mesh, n, ckdir) -> None:
     import jax
 
@@ -362,7 +548,8 @@ def main() -> None:
     p.add_argument("ckdir", nargs="?", default=None)
     p.add_argument("--drill", default=None,
                    choices=["lockstep", "kill", "resume", "preempt",
-                            "init-timeout"])
+                            "init-timeout", "offload", "offload-kill",
+                            "offload-resume", "offload-bench"])
     p.add_argument("--kill-iteration", type=int, default=4)
     p.add_argument("--preempt-iteration", type=int, default=3)
     p.add_argument("--stall-timeout", type=float, default=10.0)
@@ -386,6 +573,22 @@ def main() -> None:
         process_id=args.pid, init_timeout_s=120,
     )
     assert got == args.nprocs, (got, args.nprocs)
+
+    # The offload drills run the host-window driver, which never builds a
+    # device mesh — the fleet seam keys off ``jax.process_count()``.
+    if args.drill == "offload":
+        drill_offload(args.pid)
+        return
+    if args.drill == "offload-bench":
+        drill_offload_bench(args.pid)
+        return
+    if args.drill in ("offload-kill", "offload-resume"):
+        assert args.ckdir, "offload kill/resume drills need a checkpoint dir"
+        drill_offload_kill(args.pid, args.ckdir, args.kill_iteration,
+                           args.stall_timeout,
+                           resume=args.drill == "offload-resume")
+        return
+
     mesh = make_multihost_mesh()
     n = jax.device_count()
 

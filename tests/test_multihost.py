@@ -226,3 +226,127 @@ def test_initialize_distributed_timeout_is_actionable():
         assert p.returncode == 0, text[-3000:]
         assert "DRILL_INIT_TIMEOUT actionable=True" in text, text[-2000:]
     assert "process ids [1]" in text, text[-2000:]
+
+# --- fleet out-of-core drills (distributed window exchange) ----------------
+
+
+@pytest.mark.slow
+def test_offload_fleet_matches_one_process_driver():
+    """The exchange contract: a 2-process host-window run — each process
+    owning HALF the HostFactorStore and receiving the other half's cold
+    window residuals over the hier-ring DCN phases — must produce factor
+    tables bit-identical to the one-process driver on the same config."""
+    procs = spawn_workers(_PORT + 6, 2, None, "--drill", "offload")
+    outs = communicate_all(procs)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+    rows = {json.loads(line.split(" ", 1)[1])["pid"]:
+            json.loads(line.split(" ", 1)[1])
+            for out in outs for line in out.splitlines()
+            if line.startswith("DRILL_OFFLOAD ")}
+    assert set(rows) == {0, 1}, rows
+    assert rows[0]["processes"] == rows[1]["processes"] == 2
+    assert rows[0]["crc"] == rows[1]["crc"], rows
+    # residual bytes actually crossed the process boundary
+    assert rows[0]["rows_dcn"] > 0 and rows[1]["rows_dcn"] > 0, rows
+
+    # one-process driver reference: bit-identical, not merely close
+    import warnings
+
+    from multihost_worker import _crc, _offload_setup
+
+    from cfk_tpu.offload.windowed import train_als_host_window
+
+    ds, cfg = _offload_setup()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = train_als_host_window(ds, cfg)
+    crc_one = _crc(model.user_factors, model.movie_factors)
+    assert rows[0]["crc"] == crc_one, (rows[0]["crc"], crc_one)
+
+
+@pytest.mark.slow
+def test_offload_fleet_kill_and_resume(tmp_path):
+    """SIGKILL one host of the 2-process offload fleet after it commits
+    its per-host checkpoint: the survivor exits bounded (Gloo error or
+    StallWatchdog — never a hang), and the restarted fleet min-agrees the
+    resume step across per-host manifests and lands on the uninterrupted
+    run's crc bit-exactly."""
+    from cfk_tpu.resilience.preempt import STALL_EXIT_CODE
+    from cfk_tpu.transport.checkpoint import CheckpointManager
+
+    ck = str(tmp_path / "ck")
+    kill_iter = 2
+    procs = spawn_workers(
+        _PORT + 7, 2, ck, "--drill", "offload-kill",
+        "--kill-iteration", str(kill_iter), "--stall-timeout", "10",
+    )
+    outs = communicate_all(procs, timeout=240)
+    assert procs[1].returncode == -signal.SIGKILL, (
+        procs[1].returncode, outs[1][-2000:],
+    )
+    assert procs[0].returncode != 0, outs[0][-2000:]
+    survivor_graceful = procs[0].returncode == STALL_EXIT_CODE
+    assert any("DRILL_ITER" in o for o in outs), outs[0][-2000:]
+
+    # every host's manifest holds only intact committed steps; the kill
+    # fired after the victim's save of kill_iter, so both reached it
+    for pid in (0, 1):
+        mgr = CheckpointManager(os.path.join(ck, f"host_{pid}"))
+        steps = mgr.iterations()
+        assert steps, f"host_{pid}: no checkpoint survived the kill"
+        assert kill_iter <= max(steps) <= kill_iter + 1, (pid, steps)
+        for it in steps:
+            mgr.verify(it)
+        assert mgr.latest_valid_iteration() == max(steps)
+
+    procs = spawn_workers(_PORT + 8, 2, ck, "--drill", "offload-resume")
+    outs = communicate_all(procs)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"resume process {i} failed:\n{out[-3000:]}"
+    rows = {json.loads(line.split(" ", 1)[1])["pid"]:
+            json.loads(line.split(" ", 1)[1])
+            for out in outs for line in out.splitlines()
+            if line.startswith("DRILL_OFFLOAD_RESUME ")}
+    assert set(rows) == {0, 1}, rows
+    assert rows[0]["resumed_from"] >= kill_iter, rows
+    assert rows[0]["crc"] == rows[1]["crc"], rows
+
+    # the resumed fleet lands on the uninterrupted trajectory bit-exactly
+    import warnings
+
+    from multihost_worker import _crc, _offload_setup
+
+    from cfk_tpu.offload.windowed import train_als_host_window
+
+    ds, cfg = _offload_setup()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = train_als_host_window(ds, cfg)
+    crc_one = _crc(model.user_factors, model.movie_factors)
+    assert rows[0]["crc"] == crc_one, (rows[0]["crc"], crc_one)
+    print(f"survivor_graceful_stall_exit={survivor_graceful}")
+
+
+@pytest.mark.slow
+def test_offload_fleet_bench_row():
+    """The fleet scale-sweep row: a power-law shape the simulated
+    single-host RAM budget refuses completes under 2 processes, with the
+    DCN residual accounting recorded and reduced by the hot/delta split."""
+    procs = spawn_workers(_PORT + 9, 2, None, "--drill", "offload-bench")
+    outs = communicate_all(procs)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+    m = [json.loads(line.split(" ", 1)[1])
+         for out in outs for line in out.splitlines()
+         if line.startswith("OFFLOAD_BENCH_ROW ")]
+    assert len(m) == 1, outs[0][-2000:]
+    row = m[0]
+    assert row["processes"] == 2
+    assert not row["budget"]["single_host_fits"]
+    assert row["budget"]["fleet_fits"]
+    assert row["rows_dcn"] > 0 and row["mb_dcn"] > 0
+    # the hot/delta split beat the dense no-split exchange at this skew
+    assert row["hot"] == "on"
+    assert 0.0 < row["dcn_reduction"] < 1.0, row
+    assert row["recv_rows_iter"] < row["dense_rows_iter"], row

@@ -1,0 +1,352 @@
+"""Distributed window-residual exchange protocol (ISSUE 17) — meshless.
+
+The fleet contract, pinned WITHOUT spawning processes: the exchange
+manifests are deterministic functions of the window plans, the payload
+builder + ``ResidualMirror`` serve every window's fixed-table rows
+bitwise what the one-process driver's full store serves (so the staged
+windows — and therefore every downstream bit — are identical), rows
+ship at most once per half (cumulative dedup), the hot/delta split cuts
+the manifests, and the single-process / single-phase cases degenerate
+cleanly.  ``LocalFleet`` stands in for the Gloo allgather: same stacked
+equal-shape payload layout, one process.
+"""
+
+import numpy as np
+import pytest
+
+from cfk_tpu.config import ALSConfig
+from cfk_tpu.data.blocks import Dataset
+from cfk_tpu.data.synth import synth_coo
+from cfk_tpu.offload import exchange as ex
+from cfk_tpu.offload import hot as hotmod
+from cfk_tpu.offload.store import HostFactorStore
+from cfk_tpu.offload.window import build_ring_window_plan, build_window_plan
+from cfk_tpu.offload.windowed import (
+    _fixed_rows_of,
+    _stage_window,
+    hier_visit_order,
+)
+from cfk_tpu.parallel.spmd import hier_phase_count, hier_phase_of_visit
+
+S, P, INNER, RANK = 4, 2, 2, 4
+
+
+@pytest.fixture(scope="module")
+def ring_ds4():
+    return Dataset.from_coo(synth_coo(64, 32, 900, seed=1), num_shards=S,
+                            layout="tiled", tile_rows=16, chunk_elems=512,
+                            ring=True, ring_warn=False)
+
+
+@pytest.fixture(scope="module")
+def ring_half(ring_ds4):
+    """The m half's ring plans + visit orders + a random fixed (u) store
+    — the exact objects the driver hands the exchange."""
+    mb = ring_ds4.movie_blocks
+    plans = [build_ring_window_plan(mb, shard=d, chunks_per_window=2)
+             for d in range(S)]
+    visits = [hier_visit_order(S, INNER, d) for d in range(S)]
+    schedules = [plans[d].schedule(visits[d]) for d in range(S)]
+    rows_total = _fixed_rows_of(plans[0])
+    rng = np.random.default_rng(7)
+    full = rng.standard_normal((rows_total, RANK)).astype(np.float32)
+    store = HostFactorStore.from_array(full, num_shards=S)
+    return plans, visits, schedules, store
+
+
+def _simulate(plans, visits, schedules, full_store, *, hmaps=None,
+              hot_rows=None):
+    """Run the exchange for every logical process in one process: build
+    each p's plan, everyone's payloads, stack them (the allgather), and
+    deliver into each p's mirror.  Returns [(own, mirror, explan)]."""
+    rows_total = full_store.rows
+    out = []
+    owns = [ex.OwnershipMap(S, P, p, rows_total // S) for p in range(P)]
+    slices = []
+    for own in owns:
+        lo, hi = own.row_bounds()
+        slices.append(HostFactorStore.from_array(
+            full_store.as_array()[lo:hi],
+            num_shards=own.shards_per_process))
+    explans = [
+        ex.build_half_exchange(
+            owns[p], plans, schedules, inner=INNER, visits=visits,
+            hmaps=hmaps, hot_rows=hot_rows, side="m")
+        for p in range(P)
+    ]
+    for p in range(P):
+        mirror = ex.ResidualMirror(slices[p], owns[p])
+        fleet = ex.LocalFleet(P, p)
+        mirror.reset()
+        for t in range(explans[p].num_phases):
+            if explans[p].phases[t].pad_rows == 0:
+                continue
+            fleet.preload([ex.phase_payload(explans[q], t, slices[q])
+                           for q in range(P)])
+            gathered = fleet.allgather_bytes(None)
+            ex.deliver_phase(explans[p], t, gathered, mirror)
+        out.append((owns[p], mirror, explans[p]))
+    return out
+
+
+def test_phase_helpers_degenerate():
+    assert hier_phase_count(4, 4) == 1          # flat path: one phase
+    assert hier_phase_count(4, 2) == 2
+    assert hier_phase_count(8, 2) == 4
+    assert [hier_phase_of_visit(i, 2) for i in range(4)] == [0, 0, 1, 1]
+    with pytest.raises(ValueError):
+        hier_phase_count(4, 3)
+    # Phase structure must agree with the visit order's length.
+    v = hier_visit_order(4, 2, 1)
+    assert hier_phase_of_visit(len(v) - 1, 2) == hier_phase_count(4, 2) - 1
+
+
+def test_ownership_map_contract():
+    own = ex.OwnershipMap(S, P, 1, 10)
+    assert list(own.owned_shards()) == [2, 3]
+    assert own.row_bounds() == (20, 40)
+    assert own.owner_of_shard(0) == 0 and own.owner_of_shard(3) == 1
+    with pytest.raises(ValueError):
+        ex.OwnershipMap(3, 2, 0, 10)            # 3 % 2 != 0
+    # The mirror's full-table bounds ARE the full store's bounds.
+    st = HostFactorStore(40, RANK, num_shards=S)
+    assert np.array_equal(ex.full_store_bounds(40, S), st.bounds)
+
+
+def test_mirror_serves_every_window_bitwise(ring_half):
+    plans, visits, schedules, store = ring_half
+    for own, mirror, _ in _simulate(plans, visits, schedules, store):
+        # Attribution parity: the mirror answers shard-of-row with the
+        # FULL table's bounds, so rows_local/ici/dcn metering cannot
+        # shift under the fleet split.
+        probe = np.arange(store.rows, dtype=np.int64)
+        assert np.array_equal(mirror.shard_of_rows(probe),
+                              store.shard_of_rows(probe))
+        for d in own.owned_shards():
+            for w in range(plans[d].num_windows):
+                rows = plans[d].rows[w]
+                got = mirror.gather(rows)
+                want = store.gather(rows)
+                assert got.dtype == want.dtype
+                assert got.tobytes() == want.tobytes()
+
+
+def test_undelivered_row_raises(ring_half):
+    plans, visits, schedules, store = ring_half
+    own, mirror, _ = _simulate(plans, visits, schedules, store)[0]
+    lo, hi = own.row_bounds()
+    remote = np.setdiff1d(
+        np.arange(store.rows, dtype=np.int64),
+        np.arange(lo, hi, dtype=np.int64))
+    needed = np.unique(np.concatenate(
+        [plans[d].rows[w].ravel() for d in own.owned_shards()
+         for w in range(plans[d].num_windows)]))
+    never = np.setdiff1d(remote, needed)
+    if never.size == 0:
+        pytest.skip("every remote row is referenced at this shape")
+    with pytest.raises(KeyError, match="never\\s+delivered"):
+        mirror.gather(never[:1])
+
+
+def test_staged_windows_bitwise_int8(ring_half):
+    """The satellite's literal contract: staged windows built from the
+    exchange-fed mirror are byte-identical to the one-process driver's
+    — through the REAL staging pipeline (gather + host int8 quantize +
+    checksum + device_put), not just the host gather."""
+    plans, visits, schedules, store = ring_half
+    own, mirror, _ = _simulate(plans, visits, schedules, store)[0]
+    kw = dict(stage_np=None, int8=True, faults=None, iteration=0,
+              side="m", verify_windows=True, stats=None, ici_group=INNER)
+    for d in own.owned_shards():
+        for w in schedules[d][:3]:
+            a = _stage_window(mirror, plans[d], w, shard=d, **kw)
+            b = _stage_window(store, plans[d], w, shard=d, **kw)
+            for x, y in zip(a, b):
+                assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+def test_rows_ship_once_per_half(ring_half):
+    """Cumulative dedup: each process receives exactly its unique remote
+    referenced rows — once — however many windows (or phases) touch
+    them."""
+    plans, visits, schedules, store = ring_half
+    for own, _, explan in _simulate(plans, visits, schedules, store):
+        lo, hi = own.row_bounds()
+        needed = np.unique(np.concatenate(
+            [plans[d].rows[w].ravel() for d in own.owned_shards()
+             for w in range(plans[d].num_windows)]))
+        needed = needed[(needed < lo) | (needed >= hi)]
+        got = np.concatenate([
+            take for ph in explan.phases for _, take, _ in ph.recv
+        ]) if explan.recv_rows_total else np.zeros(0, np.int64)
+        assert got.size == np.unique(got).size        # no row twice
+        assert np.array_equal(np.sort(got), needed)   # exactly the need
+        # Phase-correct delivery: every row arrives no LATER than the
+        # first phase one of its consuming windows runs in.
+        first_need = {}
+        for d in own.owned_shards():
+            for vi, sl in enumerate(visits[d]):
+                t = hier_phase_of_visit(vi, INNER)
+                for w in plans[d].windows_of_slice(sl):
+                    for r in np.asarray(plans[d].rows[w]).ravel():
+                        first_need.setdefault(int(r), t)
+        for t, ph in enumerate(explan.phases):
+            for _, take, _ in ph.recv:
+                for r in take:
+                    assert t <= first_need[int(r)]
+
+
+def test_single_process_manifests_empty(ring_half):
+    plans, visits, schedules, store = ring_half
+    own = ex.OwnershipMap(S, 1, 0, store.rows // S)
+    explan = ex.build_half_exchange(own, plans, schedules, inner=INNER,
+                                    visits=visits, side="m")
+    assert all(ph.pad_rows == 0 for ph in explan.phases)
+    assert explan.recv_rows_total == 0
+    # exchange_half therefore runs zero collectives and the mirror
+    # (== the whole table) serves everything locally.
+    mirror = ex.ResidualMirror(
+        HostFactorStore.from_array(store.as_array(), num_shards=S), own)
+    got = ex.exchange_half(explan, mirror._store, mirror,
+                           ex.LocalFleet(1, 0))
+    assert got == {"rows": 0, "bytes": 0, "wire_bytes": 0}
+    rows = plans[0].rows[0]
+    assert mirror.gather(rows).tobytes() == store.gather(rows).tobytes()
+
+
+def test_hot_delta_split_cuts_manifests(ring_half):
+    """Composing with ISSUE 15: cold-delta manifests + the phase-0 hot
+    refresh ship FEWER rows than full-window manifests, and the mirror
+    still serves both the delta rows and the hot partition rebuild
+    bitwise."""
+    plans, visits, schedules, store = ring_half
+    counts = hotmod.reference_counts(plans, store.rows)
+    hot_rows = hotmod.select_hot_rows(counts, 24)
+    hmaps = [hotmod.build_hot_map(plans[d], schedules[d], hot_rows)
+             for d in range(S)]
+    cold = _simulate(plans, visits, schedules, store, hmaps=hmaps,
+                     hot_rows=hot_rows)
+    full = _simulate(plans, visits, schedules, store)
+    for (own, mirror, ex_cold), (_, _, ex_full) in zip(cold, full):
+        # The deduped residual never exceeds the no-split dense baseline
+        # (remote refs with repeats — what shipping each window's rows
+        # blindly would cost), and the hot/delta manifests never exceed
+        # the full-window ones.  At a dense shape the unique sets can
+        # coincide; the CUT vs dense is the split's DCN win.
+        assert ex_cold.recv_rows_total <= ex_full.recv_rows_total
+        assert ex_full.recv_rows_total < ex_full.dense_rows_total
+        assert ex_cold.recv_rows_total < ex_full.dense_rows_total
+        assert mirror.gather(hot_rows).tobytes() == \
+            store.gather(hot_rows).tobytes()
+        for d in own.owned_shards():
+            for w in schedules[d]:
+                rows = hmaps[d].delta_rows[w]
+                assert mirror.gather(rows).tobytes() == \
+                    store.gather(rows).tobytes()
+
+
+def test_stream_plans_single_phase():
+    """The all_gather (stream) execution shape rides the same protocol
+    as one flat phase — the ``ici_group == S`` degenerate case."""
+    ds = Dataset.from_coo(synth_coo(64, 32, 900, seed=1), num_shards=S,
+                          layout="tiled", tile_rows=16, chunk_elems=512,
+                          accum_max_entities=0)
+    mb, ub = ds.movie_blocks, ds.user_blocks
+    plans = [build_window_plan(mb, ub.padded_entities,
+                               chunks_per_window=2, shard=d)
+             for d in range(S)]
+    schedules = [p.schedule() for p in plans]
+    rng = np.random.default_rng(9)
+    full = rng.standard_normal(
+        (ub.padded_entities, RANK)).astype(np.float32)
+    store = HostFactorStore.from_array(full, num_shards=S)
+    for own, mirror, explan in _simulate(plans, None, schedules, store):
+        assert explan.num_phases == 1
+        for d in own.owned_shards():
+            for w in range(plans[d].num_windows):
+                rows = plans[d].rows[w]
+                assert mirror.gather(rows).tobytes() == \
+                    store.gather(rows).tobytes()
+
+
+def test_payload_roundtrip_bf16():
+    """Raw-byte shipping is dtype-honest: bf16 masters cross at 2 B/cell
+    and land bitwise."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(3)
+    full = rng.standard_normal((8, RANK)).astype(ml_dtypes.bfloat16)
+    own0 = ex.OwnershipMap(2, 2, 0, 4)
+    own1 = ex.OwnershipMap(2, 2, 1, 4)
+    s0 = HostFactorStore.from_array(full[:4], dtype="bfloat16")
+    s1 = HostFactorStore.from_array(full[4:], dtype="bfloat16")
+    plan = ex.HalfExchangePlan(side="m", own=own1, phases=(
+        ex.PhaseExchange(
+            send_rows=(np.array([1, 3], np.int64), np.zeros(0, np.int64)),
+            pad_rows=2,
+            recv=((0, np.array([1, 3], np.int64),
+                   np.array([0, 1], np.int64)),),
+        ),
+    ))
+    plan0 = ex.HalfExchangePlan(side="m", own=own0, phases=(
+        ex.PhaseExchange(send_rows=plan.phases[0].send_rows, pad_rows=2,
+                         recv=()),
+    ))
+    mirror = ex.ResidualMirror(s1, own1)
+    gathered = np.stack([ex.phase_payload(plan0, 0, s0),
+                         ex.phase_payload(plan, 0, s1)])
+    got = ex.deliver_phase(plan, 0, gathered, mirror)
+    assert got["rows"] == 2 and got["bytes"] == 2 * RANK * 2
+    assert mirror.gather(np.array([1, 3])).tobytes() == \
+        full[[1, 3]].tobytes()
+    assert mirror.gather(np.array([5])).tobytes() == full[[5]].tobytes()
+
+
+# --- fleet RAM budget + plan provenance ------------------------------------
+
+
+def test_fleet_budget_scales_out_with_processes():
+    from cfk_tpu.offload.budget import fleet_host_ram_bytes, fits_fleet_host
+
+    kw = dict(dtype="float32")
+    s1 = fleet_host_ram_bytes(20_000, 4_000, 200_000, 32, processes=1, **kw)
+    s2 = fleet_host_ram_bytes(20_000, 4_000, 200_000, 32, processes=2, **kw)
+    s4 = fleet_host_ram_bytes(20_000, 4_000, 200_000, 32, processes=4, **kw)
+    # per-process footprint strictly shrinks as the fleet grows (store
+    # slices + snapshots + blocks divide; only the mirror term grows)
+    assert s4["total"] < s2["total"] < s1["total"]
+    for s in (s1, s2, s4):
+        assert s["total"] == (s["store_slices_bytes"] + s["snapshot_bytes"]
+                              + s["mirror_bytes"] + s["block_arrays_bytes"])
+    # a budget between the P=1 and P=2 footprints: single host refuses,
+    # the 2-process fleet fits — host RAM scaled out with the fleet
+    budget = (s1["total"] + s2["total"]) / 2 / 0.9
+    assert not fits_fleet_host(20_000, 4_000, 200_000, 32,
+                               host_ram_bytes=budget, processes=1, **kw)
+    assert fits_fleet_host(20_000, 4_000, 200_000, 32,
+                           host_ram_bytes=budget, processes=2, **kw)
+
+
+def test_fleet_host_window_plan_provenance():
+    from cfk_tpu.offload.budget import fleet_host_ram_bytes
+    from cfk_tpu.plan.resolver import fleet_host_window_plan
+    from cfk_tpu.plan.spec import PlanConstraintError, ProblemShape
+
+    sh = ProblemShape(num_users=20_000, num_movies=4_000, nnz=200_000,
+                      rank=32, num_shards=4)
+    s1 = fleet_host_ram_bytes(20_000, 4_000, 200_000, 32,
+                              processes=1)["total"]
+    s2 = fleet_host_ram_bytes(20_000, 4_000, 200_000, 32,
+                              processes=2)["total"]
+    budget = (s1 + s2) / 2 / 0.9
+    prov = fleet_host_window_plan(sh, host_ram_bytes=budget, processes=2)
+    assert prov["tier"] == "fleet_host_window"
+    assert not prov["single_host_fits"] and prov["fleet_fits"]
+    assert prov["per_process_bytes"] < prov["single_host_bytes"]
+    assert prov["per_process_breakdown"]["total"] == prov["per_process_bytes"]
+    # even the fleet doesn't fit -> actionable refusal naming the levers
+    with pytest.raises(PlanConstraintError, match="raise processes"):
+        fleet_host_window_plan(sh, host_ram_bytes=s2 * 0.1, processes=2)
+    # the exchange requires shards to divide across processes
+    with pytest.raises(PlanConstraintError, match="divisible"):
+        fleet_host_window_plan(sh, host_ram_bytes=budget, processes=3)

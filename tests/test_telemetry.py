@@ -602,3 +602,66 @@ def test_staging_error_leaves_flight_dump(recorder, tmp_path):
     last = doc["events"][-1]
     assert last["name"] == "staging_error"
     assert "worker crashed" in last["error"]
+
+
+def test_prometheus_text_constant_labels():
+    # Fleet attribution (distributed window exchange): per-host exporters
+    # attach {process="N"} to every counter/gauge sample so one scrape
+    # target per host aggregates without name collisions.
+    m = Metrics()
+    m.incr("exchange_payloads", 7)
+    m.gauge("offload_exchange_rows_dcn", 192)
+    m.gauge("offload_fleet_process", 1)
+    with m.phase("train"):
+        pass
+    text = telemetry.prometheus_text(m, labels={"process": 1})
+    assert 'cfk_exchange_payloads_total{process="1"} 7' in text
+    assert 'cfk_offload_exchange_rows_dcn{process="1"} 192' in text
+    # phase samples keep their own label set (constant labels are a
+    # per-target concern; merging them into multi-label samples is the
+    # scraper's job)
+    assert 'cfk_phase_seconds{phase="train"}' in text
+    # TYPE lines never carry labels
+    assert "# TYPE cfk_offload_exchange_rows_dcn gauge" in text
+    # unlabeled rendering is unchanged
+    plain = telemetry.prometheus_text(m)
+    assert "cfk_offload_exchange_rows_dcn 192" in plain
+    # label values are escaped, names sanitized
+    odd = telemetry.prometheus_text(m, labels={"host name": 'a"b'})
+    assert 'host_name="a\\"b"' in odd
+
+
+def test_metrics_http_server_labels_passthrough():
+    import urllib.request
+
+    m = Metrics()
+    m.gauge("offload_exchange_rows_dcn", 44)
+    with telemetry.MetricsHTTPServer(m, port=0,
+                                     labels={"process": 0}) as srv:
+        body = urllib.request.urlopen(srv.url, timeout=5).read().decode()
+    assert 'cfk_offload_exchange_rows_dcn{process="0"} 44' in body
+
+
+def test_windowed_spans_carry_host_attribution(tracer):
+    # Every fabric-attributed span of the windowed driver (window_stage,
+    # window_compute / ring_visit, half_step) must carry the host attr —
+    # 0 under one process; the fleet drills assert per-process values.
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synth import synth_coo
+    from cfk_tpu.offload.windowed import train_als_host_window
+
+    ds = Dataset.from_coo(
+        synth_coo(120, 50, 1200, seed=0), num_shards=2, layout="tiled",
+        chunk_elems=512, tile_rows=16, accum_max_entities=0,
+    )
+    cfg = ALSConfig(rank=4, lam=0.05, num_iterations=1, seed=0,
+                    layout="tiled", num_shards=2,
+                    offload_tier="host_window")
+    train_als_host_window(ds, cfg, chunks_per_window=2)
+    events = tracer.events()
+    for suffix in ("window_stage", "window_compute", "half_step"):
+        spans = [e for e in events if e["name"].endswith(suffix)]
+        assert spans, f"no {suffix} spans"
+        for e in spans:
+            assert e["args"].get("host") == 0, (suffix, e["args"])
