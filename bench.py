@@ -165,6 +165,15 @@ def main() -> None:
             sv = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("# serve: " + json.dumps(sv))
         rows["serve"] = sv
+    # Replicated serving fleet (ISSUE 18): goodput QPS scaling + admission
+    # shed rate vs replica count.  CFK_BENCH_SERVE_FLEET=0 skips it.
+    if os.environ.get("CFK_BENCH_SERVE_FLEET", "1") != "0":
+        try:
+            sf = _serve_fleet_row()
+        except Exception as e:  # pragma: no cover - subprocess-dependent
+            sf = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("# serve_fleet: " + json.dumps(sf))
+        rows["serve_fleet"] = sf
     # Execution-planner A/B (ISSUE 9): resolver's serve plan vs the
     # static defaults, measured per request-slot with provenance.
     # CFK_BENCH_PLAN=0 skips it.
@@ -2353,11 +2362,26 @@ def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh,
     masking is exercised at realistic widths without materializing 25M
     seen cells.
     """
-    import numpy as np
-
     from cfk_tpu.serving.engine import ServeEngine
 
     u, m = _serve_factors(args, rng)
+    seen, indptr = _serve_seen_csr(args, jnp_users, rng)
+    return ServeEngine(
+        u, m, num_users=args.serve_users, num_movies=args.serve_movies,
+        seen_movies=seen, seen_indptr=indptr, table_dtype=table_dtype,
+        tile_m=args.serve_tile_m, mesh=mesh, plan=plan,
+        serve_mode=serve_mode,
+        clusters=args.serve_clusters or None,
+        probe_clusters=args.serve_probe_clusters or None,
+    )
+
+
+def _serve_seen_csr(args, jnp_users, rng):
+    """Seen-CSR for the loadgen pool at the ML-25M mean ratings/user: the
+    rows traffic will touch get realistic exclusion widths without
+    materializing 25M seen cells."""
+    import numpy as np
+
     mean_seen = max(1, args.serve_nnz // args.serve_users)
     pool = np.unique(jnp_users)
     counts = np.zeros(args.serve_users, np.int64)
@@ -2370,14 +2394,154 @@ def _serve_engine(args, jnp_users, rng, *, table_dtype, shards, mesh,
         seen[lo:hi] = np.sort(rng.choice(
             args.serve_movies, size=hi - lo, replace=False
         )).astype(np.int32)
-    return ServeEngine(
-        u, m, num_users=args.serve_users, num_movies=args.serve_movies,
-        seen_movies=seen, seen_indptr=indptr, table_dtype=table_dtype,
-        tile_m=args.serve_tile_m, mesh=mesh, plan=plan,
-        serve_mode=serve_mode,
-        clusters=args.serve_clusters or None,
-        probe_clusters=args.serve_probe_clusters or None,
+    return seen, indptr
+
+
+def serve_fleet_main(args) -> None:
+    print(json.dumps(run_serve_fleet(args)))
+
+
+def _serve_fleet_row() -> dict:
+    """Default-run replicated-fleet serving row (subprocess: the fleet's
+    replica threads + jax init stay isolated from the parent bench)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, __file__, "--serve-fleet"],
+        capture_output=True, text=True, timeout=3600,
     )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip()[-300:]
+        return {"error": f"serve-fleet subprocess failed: {tail}"}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_serve_fleet(args) -> dict:
+    """Replicated serving fleet bench (ISSUE 18 / ROADMAP item 3):
+    goodput QPS scaling and admission shed rate vs replica count at the
+    ML-25M shape.
+
+    Every fleet size drives the SAME shaped open loop, deliberately
+    overloaded — ``--serve-fleet-load`` (default 1.25) x the fleet's
+    measured aggregate capacity — through the full replicated path:
+    user-keyed routing into N request-log partitions, per-replica
+    admission control, engine, response log.  Overload is the point:
+    each replica's admission queue is bounded at one measured batch, so
+    goodput (engine-served responses/s) tracks fleet capacity while the
+    excess is shed as explicit retriable rejections instead of queue
+    bloat — the row records both axes.  Latency quantiles are over ALL
+    responses (served + rejected), the client-observed truth under
+    overload; replica threads score concurrently (jax releases the GIL
+    in compute), so the scaling column measures the one-host ceiling.
+    """
+    import numpy as np
+
+    from cfk_tpu.serving import (
+        ServeClient,
+        ServeFleet,
+        run_open_loop,
+        zipf_user_rows,
+    )
+    from cfk_tpu.serving.engine import ServeEngine
+    from cfk_tpu.transport import InMemoryBroker
+
+    k = args.serve_k
+    batch = args.serve_fleet_batch
+    nreq = args.serve_fleet_requests
+    replica_list = [int(n) for n in args.serve_fleet_replicas.split(",")
+                    if n]
+    traffic = zipf_user_rows(args.serve_users, nreq, seed=args.seed + 3)
+    pool = np.concatenate([
+        zipf_user_rows(args.serve_users, 4096, seed=args.seed + 1),
+        traffic,
+    ])
+    rng = np.random.default_rng(args.seed + 2)
+    u, m = _serve_factors(args, rng)
+    seen, indptr = _serve_seen_csr(args, pool, rng)
+    engines: dict = {}
+
+    def factory(i: int):
+        # full-table copies per replica (the one-host stand-in for
+        # per-host meshes); cached across fleet sizes so each replica
+        # engine prewarms exactly once for the whole sweep
+        if i not in engines:
+            eng = ServeEngine(
+                u, m, num_users=args.serve_users,
+                num_movies=args.serve_movies, seen_movies=seen,
+                seen_indptr=indptr, tile_m=args.serve_tile_m,
+            )
+            eng.prewarm(k, max_batch=batch, user_rows=pool)
+            engines[i] = eng
+        return engines[i]
+
+    # Per-replica capacity: steady-state direct-call batch time (min of
+    # repeats) — sizes the admission queue AND the offered rate.
+    eng0 = factory(0)
+    qrows = pool[:batch]
+    eng0.topk(qrows, k)
+    times = []
+    for _ in range(args.repeats):
+        t0 = time.time()
+        eng0.topk(qrows, k)
+        times.append(time.time() - t0)
+    capacity = batch / min(times)
+    rows = []
+    for n in replica_list:
+        broker = InMemoryBroker()
+        # Poll depth 4x the admission bound: the replica DRAINS backlog
+        # every step and sheds what it cannot admit — the queue stays
+        # bounded under overload instead of growing in the log.
+        fleet = ServeFleet(
+            factory, broker, replicas=n, max_batch=4 * batch,
+            admission_max_queue=batch,
+        )
+        fleet.seed_store(u, m, num_users=args.serve_users)
+        rate = max(args.serve_fleet_load * capacity * n, 1.0)
+        with fleet:
+            client = ServeClient(broker, route_by_user=True)
+            c0 = fleet.counters()
+            report = run_open_loop(
+                client, rate_qps=rate, num_requests=nreq,
+                user_rows=traffic, k=k,
+            )
+            c1 = fleet.counters()
+        served = c1["served"] - c0["served"]
+        shed = c1["shed"] - c0["shed"]
+        batches = c1["batches"] - c0["batches"]
+        row = {
+            "replicas": n,
+            "batch": batch,
+            "k": k,
+            "capacity_per_replica_qps": round(capacity, 1),
+            "offered_qps": round(rate, 1),
+            **report.as_row(),
+            # loadgen can't see the fleet's servers — batch accounting
+            # comes from the fleet counters instead
+            "batches": int(batches),
+            "mean_batch": round(served / batches, 1) if batches else 0.0,
+            "goodput_qps": round(served / report.wall_s, 1),
+            "served": int(served),
+            "shed": int(shed),
+            "shed_rate": round(shed / max(served + shed, 1), 4),
+            "users": args.serve_users, "movies": args.serve_movies,
+            "rank": args.serve_rank, "tile_m": args.serve_tile_m,
+        }
+        print("# serve_fleet: " + json.dumps(row), flush=True)
+        rows.append(row)
+    base = next((r for r in rows if r["replicas"] == 1), rows[0])
+    best = max(rows, key=lambda r: r["goodput_qps"])
+    return {
+        "metric": "serve_fleet_ml25m",
+        "unit": "goodput_qps",
+        "value": best["goodput_qps"],
+        "replicas": best["replicas"],
+        "scaling_vs_1": round(
+            best["goodput_qps"] / max(base["goodput_qps"], 1e-9), 2),
+        "shed_rate": best["shed_rate"],
+        "capacity_per_replica_qps": round(capacity, 1),
+        "rows": rows,
+    }
 
 
 def run_serve(args) -> dict:
@@ -2941,6 +3105,27 @@ if __name__ == "__main__":
     parser.add_argument("--serve-probe-clusters", type=int, default=32,
                         help="clusters probed per user (0 = engine auto "
                         "at the 0.95 recall floor)")
+    parser.add_argument("--serve-fleet", action="store_true",
+                        help="replicated serving fleet bench (ISSUE 18): "
+                        "goodput QPS scaling + admission shed rate vs "
+                        "replica count through the full replicated path "
+                        "(user-keyed routing -> per-replica admission "
+                        "control -> engine -> response log), every fleet "
+                        "size driven at --serve-fleet-load x its measured "
+                        "aggregate capacity")
+    parser.add_argument("--serve-fleet-replicas", default="1,2,4",
+                        help="comma list of fleet sizes to sweep")
+    parser.add_argument("--serve-fleet-requests", type=int, default=1024,
+                        help="open-loop requests per fleet size")
+    parser.add_argument("--serve-fleet-batch", type=int, default=64,
+                        help="admitted batch per replica step (the "
+                        "admission queue bound; each step drains up to "
+                        "4x this from the log and sheds the excess as "
+                        "retriable rejections)")
+    parser.add_argument("--serve-fleet-load", type=float, default=1.25,
+                        help="offered rate as a multiple of the fleet's "
+                        "measured aggregate capacity (>1 exercises "
+                        "admission shedding)")
     parser.add_argument("--scale-sweep", action="store_true",
                         help="out-of-core scale sweep (ISSUE 11): s/iter "
                         "and ratings/sec/chip vs problem size across the "
@@ -3002,6 +3187,8 @@ if __name__ == "__main__":
         if cli_args.scale_sweep
         else (lambda: plan_ab_main(cli_args))
         if cli_args.plan_ab
+        else (lambda: serve_fleet_main(cli_args))
+        if cli_args.serve_fleet
         else (lambda: serve_main(cli_args))
         if cli_args.serve
         else (lambda: quant_ab_main(cli_args))

@@ -837,6 +837,11 @@ def _serve(args) -> int:
     ``--metrics-port`` makes the server answer ``GET /metrics``
     (Prometheus text) while it serves; ``--trace-dir`` writes the host
     span trace (batch assemble/compute/respond timeline) at exit.
+
+    ``--replicas N`` (ISSUE 18) serves through the replicated fleet
+    instead: N replicas behind the request log (one partition each,
+    user-keyed routing), each with its own /metrics + /readyz and
+    optional admission control (``--admission-queue``).
     """
     with _telemetry_session(args):
         return _serve_impl(args)
@@ -898,11 +903,56 @@ def _serve_impl(args) -> int:
         f"prewarmed {warm['programs']} serve programs "
         f"({warm['new_traces']} new traces) in {warm['prewarm_s']:.2f}s"
     )
+    # Replicated fleet (ISSUE 18): N replicas behind the request log —
+    # user-keyed routing, per-replica /metrics + /readyz, admission
+    # control, delta/rollover plumbing ready for a publisher to join.
+    def _fleet(transport):
+        from cfk_tpu.serving import ServeFleet
+
+        fleet = ServeFleet(
+            lambda i: engine if i == 0 else engine_from_model(
+                model, None if args.include_seen else ds,
+                table_dtype=args.table_dtype, tile_m=args.tile_m,
+                serve_mode=args.serve_mode, clusters=args.clusters or None,
+                probe_clusters=args.probe_clusters or None,
+            ),
+            transport, replicas=args.replicas, max_batch=args.max_batch,
+            admission_max_queue=args.admission_queue or None,
+            metrics_ports=args.metrics_port is not None,
+        )
+        fleet.seed_store(model.user_factors, model.movie_factors,
+                         num_users=model.num_users)
+        fleet.prewarm(args.k, max_batch=args.max_batch)
+        for r in fleet.replicas:
+            ms = r.server.metrics_server
+            if ms is not None:
+                _eprint(f"replica {r.index} metrics endpoint: {ms.url}")
+        return fleet
+
     if args.broker:
         host, port, _ = _parse_tcp_url(args.broker, topic_optional=True)
         from cfk_tpu.transport.tcp import TcpBrokerClient
 
         transport = TcpBrokerClient(host, port)
+        if args.replicas > 1:
+            import time as _time
+
+            fleet = _fleet(transport).start()
+            _eprint(
+                f"serving fleet: {args.replicas} replicas over broker "
+                f"{host}:{port} (user-keyed routing; ^C to stop)"
+            )
+            try:
+                while True:
+                    _time.sleep(1.0)
+            except KeyboardInterrupt:  # pragma: no cover - interactive
+                pass
+            finally:
+                fleet.stop()
+            c = fleet.counters()
+            _eprint(f"fleet served {c['served']} requests "
+                    f"({c['shed']} shed) in {c['batches']} batches")
+            return 0
         ensure_serve_topics(
             transport, request_partitions=args.request_partitions,
             response_partitions=args.response_partitions,
@@ -930,6 +980,39 @@ def _serve_impl(args) -> int:
     from cfk_tpu.transport import InMemoryBroker
 
     transport = InMemoryBroker()
+    if args.replicas > 1:
+        import json
+
+        fleet = _fleet(transport).start()
+        client = ServeClient(transport, route_by_user=True)
+        pool = zipf_user_rows(
+            ds.user_map.num_entities, args.loadgen_requests, seed=args.seed
+        )
+        try:
+            report = run_open_loop(
+                client, rate_qps=args.loadgen_qps,
+                num_requests=args.loadgen_requests, user_rows=pool,
+                k=args.k,
+            )
+        finally:
+            fleet.stop()
+        c = fleet.counters()
+        print(json.dumps({
+            "users": ds.user_map.num_entities,
+            "movies": ds.movie_map.num_entities,
+            "k": args.k,
+            "table_dtype": engine.table_dtype,
+            "replicas": args.replicas,
+            "shed": c["shed"],
+            "client_retries": client.retries,
+            **report.as_row(),
+            # the loadgen can't see the fleet's servers — batch
+            # accounting comes from the fleet counters instead
+            "batches": c["batches"],
+            "mean_batch": (round(c["served"] / c["batches"], 1)
+                           if c["batches"] else 0.0),
+        }))
+        return 0
     ensure_serve_topics(transport)
     server = RecommendServer(engine, transport, max_batch=args.max_batch,
                              metrics_port=args.metrics_port)
@@ -1694,6 +1777,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "0.95 modeled recall floor)")
     sv.add_argument("--max-batch", type=int, default=256,
                     help="max requests coalesced into one scoring batch")
+    sv.add_argument("--replicas", type=int, default=1,
+                    help="serving fleet size (ISSUE 18): N replicas "
+                    "behind the request log with user-keyed routing, "
+                    "per-replica /metrics + /readyz, admission control, "
+                    "and kill/failover at the committed cursor")
+    sv.add_argument("--admission-queue", type=int, default=0,
+                    help="fleet admission-control queue depth per poll "
+                    "(0 = unbounded); backlog beyond it is answered "
+                    "with explicit RETRIABLE rejections, never dropped")
     sv.add_argument("--request-partitions", type=int, default=1)
     sv.add_argument("--response-partitions", type=int, default=1)
     sv.add_argument("--loadgen-qps", type=float, default=100.0)

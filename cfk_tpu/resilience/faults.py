@@ -658,6 +658,50 @@ class FlakyTransport:
         yield from out
 
 
+class DeltaStreamTamper:
+    """A ``Transport`` proxy that PERMANENTLY hides chosen frames of one
+    topic from consumers — the factor-delta gap fault (ISSUE 18).
+
+    ``FlakyTransport.drop`` models a missed *delivery*: the record comes
+    back on a later pass, which seq-ordered apply absorbs silently.  This
+    wrapper models the loss the delta protocol must detect LOUDLY — a
+    frame that never arrives (compacted away, crossed a retention
+    boundary, or corrupted at rest): offsets in ``hide`` (per ``topic``)
+    vanish from every consume pass, so the replica's next frame skips a
+    seq and the gap→snapshot-resync path has to fire.  ``mode="truncate"``
+    instead delivers the frame with its payload cut in half — the
+    undecodable-frame spelling of the same gap.  ``hidden``/``truncated``
+    count firings so the chaos test can assert the fault actually
+    happened."""
+
+    def __init__(self, inner, *, topic: str, hide=(), mode: str = "hide"):
+        if mode not in ("hide", "truncate"):
+            raise ValueError(f"mode must be hide|truncate, got {mode!r}")
+        self.inner = inner
+        self.topic = topic
+        self.hide = set(int(o) for o in hide)
+        self.mode = mode
+        self.hidden = 0
+        self.truncated = 0
+
+    def __getattr__(self, name):  # produce/create_topic/... pass through
+        return getattr(self.inner, name)
+
+    def consume(self, topic, partition, start_offset=0):
+        for rec in self.inner.consume(topic, partition, start_offset):
+            if topic == self.topic and rec.offset in self.hide:
+                if self.mode == "hide":
+                    self.hidden += 1
+                    continue
+                import dataclasses
+
+                self.truncated += 1
+                rec = dataclasses.replace(
+                    rec, value=rec.value[: max(1, len(rec.value) // 2)]
+                )
+            yield rec
+
+
 def blockstructured_coo(
     num_users: int = 24,
     num_movies: int = 16,

@@ -18,6 +18,13 @@ top-probe clusters per user, and only the batch union of those clusters'
 rows is rescored EXACTLY through the same Pallas kernel (``twostage``) —
 recall@K vs the dense oracle is measured first-class and the exact scan
 stays the un-disableable fallback.
+
+The replicated fleet (ISSUE 18 / ROADMAP item 3, ``fleet``) puts N
+replicas behind the request log: user-keyed routing, admission control
+with explicit retriable rejections, versioned factor-delta shipping with
+seq-gap detection + epoch-snapshot resync (bit-exact, ``table_crc``),
+zero-downtime epoch rollover (background prewarm + single pointer flip),
+and kill/failover at the committed cursor (at-least-once re-serve).
 """
 
 from cfk_tpu.serving.cluster import (
@@ -30,6 +37,16 @@ from cfk_tpu.serving.engine import (
     engine_from_model,
     pad_table,
     plan_for_serving,
+)
+from cfk_tpu.serving.fleet import (
+    DELTAS_TOPIC,
+    AdmissionController,
+    DeltaPublisher,
+    FleetReplica,
+    ServeFleet,
+    SnapshotStore,
+    ensure_deltas_topic,
+    table_crc,
 )
 from cfk_tpu.serving.twostage import (
     Shortlist,
@@ -73,9 +90,17 @@ __all__ = [
     "zipf_user_rows",
     "REQUESTS_TOPIC",
     "RESPONSES_TOPIC",
+    "DELTAS_TOPIC",
     "RecommendServer",
     "ServeClient",
     "ensure_serve_topics",
+    "ensure_deltas_topic",
+    "AdmissionController",
+    "DeltaPublisher",
+    "FleetReplica",
+    "ServeFleet",
+    "SnapshotStore",
+    "table_crc",
     "build_seen_tiles",
     "topk_scores_pallas",
 ]

@@ -163,6 +163,50 @@ class ServeEngine:
         self._set_table(m_host)
         self.invalidations = 0
         self.table_swaps = 0
+        # Fleet state (ISSUE 18): the factor-table epoch every response is
+        # stamped with (bumped on each full-table swap), and the readiness
+        # flag behind /readyz — an engine is live from construction but
+        # READY only once prewarm() has traced the batch-bucket set.
+        self.epoch = 0
+        self.prewarmed = False
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (vs liveness): prewarmed AND an epoch table loaded —
+        the /readyz signal and the fleet's rollover gate."""
+        return bool(self.prewarmed and getattr(self, "_table", None)
+                    is not None)
+
+    def load_state(self, user_factors, movie_factors=None, *,
+                   hot_rows=None, seen_cells=None, num_users=None,
+                   epoch=None) -> None:
+        """Atomically replace the live user-side state (and optionally the
+        item table) from an epoch snapshot — the fleet replica's resync
+        seam (ISSUE 18).  ``user_factors`` becomes the new base snapshot,
+        ``hot_rows`` ({row: factor row}) the new overlay, ``seen_cells``
+        ((user_row, movie_row) pairs) rebuild the seen overlay from
+        scratch; ``movie_factors``/``epoch`` additionally swap the item
+        table (a cross-epoch resync).  All under the engine lock, so a
+        concurrently scoring batch reads entirely-old or entirely-new
+        state, never a mixture."""
+        with self._lock:
+            self._u_base = np.asarray(user_factors, np.float32)
+            self._u_hot = (
+                {int(r): np.asarray(f, np.float32)
+                 for r, f in hot_rows.items()} if hot_rows else {}
+            )
+            self._seen_hot = {}
+            for row, movie in seen_cells or ():
+                self._seen_hot.setdefault(int(row), []).append(int(movie))
+            if num_users is not None:
+                self.num_users = int(num_users)
+            if movie_factors is not None:
+                self._set_table(
+                    np.asarray(movie_factors, np.float32)[: self.num_movies]
+                )
+                self.table_swaps += 1
+            if epoch is not None:
+                self.epoch = int(epoch)
 
     # -- table ---------------------------------------------------------------
 
@@ -257,6 +301,7 @@ class ServeEngine:
                                np.float32)[: self.num_movies]
                 )
                 self.table_swaps += 1
+                self.epoch += 1
 
     def apply_movie_deltas(self, rows, factors) -> int:
         """Update item factor rows IN PLACE in both table views.
@@ -603,6 +648,7 @@ class ServeEngine:
                         alt = np.resize(alt, b)
                     self.topk(alt, k, exclude_seen=exclude_seen)
                 b *= 2
+            self.prewarmed = True  # the /readyz gate flips here
             return {
                 "programs": programs,
                 "new_traces": trace_count() - before,

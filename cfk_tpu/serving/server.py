@@ -66,6 +66,10 @@ class RecommendServer:
         poll_wait_s: float = 0.002,
         metrics=None,
         metrics_port: int | None = None,
+        partitions=None,
+        admission=None,
+        staleness_fn=None,
+        labels: dict | None = None,
     ) -> None:
         from cfk_tpu.utils.metrics import Metrics
 
@@ -76,23 +80,58 @@ class RecommendServer:
         self.max_batch = int(max_batch)
         self.poll_wait_s = poll_wait_s
         self.metrics = metrics if metrics is not None else Metrics()
+        # Fleet seams (ISSUE 18): ``partitions`` restricts this server to
+        # its OWN request partitions (a fleet replica owns partition i of
+        # N; standalone servers keep draining them all); ``admission``
+        # sheds polled backlog beyond the controller's queue depth with
+        # retriable rejections; ``staleness_fn`` supplies the per-response
+        # staleness bound (the replica's unapplied delta backlog).
+        self.admission = admission
+        self._staleness_fn = staleness_fn
         nparts = transport.num_partitions(requests_topic)
-        self._cursors = {p: 0 for p in range(nparts)}
+        own = (range(nparts) if partitions is None
+               else [int(p) for p in partitions])
+        self._cursors = {p: 0 for p in own}
+        # Committed cursors move only AFTER a batch's responses are
+        # produced and flushed — the failover handoff point: a survivor
+        # adopting a dead replica's partition resumes here, re-serving
+        # (at-least-once) anything the victim had polled but not yet
+        # answered, so no accepted request is ever silently lost.
+        self.committed_cursors = dict(self._cursors)
         self.requests_served = 0
         self.batches = 0
         self.malformed_requests = 0
+        self.shed = 0
         # Live metrics export (ISSUE 14): with a port, this server scrapes
         # — GET /metrics answers the Prometheus text rendering of
         # self.metrics even while batches are in flight (the registry is
         # thread-safe; 0 binds an ephemeral port, read it back from
-        # .metrics_server.port).
+        # .metrics_server.port).  /readyz reports the ENGINE's readiness
+        # (prewarmed + epoch table loaded), distinct from /healthz
+        # liveness; ``labels`` ride every sample (per-replica attribution
+        # through the PR 16 constant-label seam).
         self.metrics_server = None
         if metrics_port is not None:
             from cfk_tpu.telemetry import MetricsHTTPServer
 
             self.metrics_server = MetricsHTTPServer(
-                self.metrics, port=int(metrics_port)
+                self.metrics, port=int(metrics_port), labels=labels,
+                ready_fn=lambda: self.ready,
             ).start()
+
+    @property
+    def ready(self) -> bool:
+        """Readiness = the engine's (prewarmed + table loaded); engines
+        without the flag (doubles in tests) read as ready."""
+        return bool(getattr(self.engine, "ready", True))
+
+    def adopt_partition(self, partition: int, cursor: int = 0) -> None:
+        """Take over a request partition at ``cursor`` (failover: the
+        supervisor hands a dead replica's partition to a survivor at the
+        victim's COMMITTED cursor)."""
+        p = int(partition)
+        self._cursors[p] = int(cursor)
+        self.committed_cursors[p] = int(cursor)
 
     def close(self) -> None:
         """Release the /metrics endpoint (idempotent)."""
@@ -133,15 +172,46 @@ class RecommendServer:
             self._cursors[p] += got
         return out
 
+    def _stamp(self) -> tuple[int, int]:
+        """(epoch, staleness) for this batch's response stamps."""
+        epoch = int(getattr(self.engine, "epoch", 0))
+        stale = 0
+        if self._staleness_fn is not None:
+            try:
+                stale = int(self._staleness_fn())
+            except Exception:
+                stale = -1  # unknown beats a silently-wrong 0
+        return epoch, stale
+
     def step(self) -> int:
         """Serve ONE coalesced batch; returns the number of requests
-        answered (0 = nothing pending)."""
+        answered (0 = nothing pending).  Requests shed by admission
+        control are answered too — with an explicit RETRIABLE rejection,
+        never a silent drop — and count toward the return value."""
         reqs = self._poll_requests()
+        # a fuzzed frame can decode into a request whose reply_partition
+        # doesn't exist — unanswerable (there is no partition to refuse
+        # it on), so it is counted and dropped BEFORE admission rather
+        # than letting the produce raise and kill its co-batched
+        # neighbors (or consume queue depth a real request needed)
+        nresp = self.transport.num_partitions(self.responses_topic)
+        routable = []
+        for r in reqs:
+            if 0 <= r.reply_partition < nresp:
+                routable.append(r)
+            else:
+                self.malformed_requests += 1
+                self.metrics.incr("serve_malformed_requests")
+        reqs = routable
         if not reqs:
             return 0
+        shed: list[ScoreRequest] = []
+        if self.admission is not None:
+            reqs, shed = self.admission.admit(reqs)
         t_batch = time.perf_counter()
+        epoch, staleness = self._stamp()
         with self.metrics.phase("serve_batch"), \
-                span("serve/batch", requests=len(reqs)):
+                span("serve/batch", requests=len(reqs), shed=len(shed)):
             # Refuse out-of-range rows per REQUEST (an error response),
             # never per batch — one bad query must not poison its
             # co-batched neighbors.
@@ -168,6 +238,7 @@ class RecommendServer:
                         req_id=r.req_id,
                         movie_rows=ids[i, : r.k],
                         scores=scores[i, : r.k],
+                        epoch=epoch, staleness=staleness,
                     )))
             for r in errors:
                 responses.append((r.reply_partition, ScoreResponse(
@@ -177,6 +248,17 @@ class RecommendServer:
                     error=(f"user row {r.user} out of range "
                            f"[0, {self.engine.num_users}) or k {r.k} "
                            f"outside [1, {self.engine.num_movies}]"),
+                    epoch=epoch, staleness=staleness,
+                )))
+            for r in shed:
+                # Explicit retriable rejection: the client backs off and
+                # re-sends; the request is ANSWERED, not dropped.
+                responses.append((r.reply_partition, ScoreResponse(
+                    req_id=r.req_id,
+                    movie_rows=np.zeros(0, np.int32),
+                    scores=np.zeros(0, np.float32),
+                    error="overloaded: admission queue depth exceeded",
+                    retriable=True, epoch=epoch, staleness=staleness,
                 )))
             with span("serve/batch/respond", responses=len(responses)):
                 for part, resp in responses:
@@ -188,8 +270,15 @@ class RecommendServer:
                 flush = getattr(self.transport, "flush", None)
                 if flush is not None:
                     flush()
+        # Responses durable → commit the read cursors (failover handoff).
+        self.committed_cursors.update(self._cursors)
         self.requests_served += len(reqs)
         self.batches += 1
+        if shed:
+            self.shed += len(shed)
+            self.metrics.incr("serve_shed", len(shed))
+            record_event("serve", "shed", requests=len(shed),
+                         served=len(reqs))
         self.metrics.incr("serve_requests", len(reqs))
         self.metrics.incr("serve_batches")
         # Bounded-reservoir latency distributions (ISSUE 14): per-batch
@@ -199,7 +288,7 @@ class RecommendServer:
         self.metrics.observe("serve_batch_size", len(reqs))
         record_event("serve", "batch", requests=len(reqs),
                      batch=self.batches)
-        return len(reqs)
+        return len(reqs) + len(shed)
 
     def serve_forever(self, *, max_requests: int | None = None,
                       idle_timeout_s: float | None = None,
@@ -235,6 +324,8 @@ class ServeClient:
         reply_partition: int = 0,
         requests_topic: str = REQUESTS_TOPIC,
         responses_topic: str = RESPONSES_TOPIC,
+        route_by_user: bool = False,
+        metrics=None,
     ) -> None:
         import os
 
@@ -243,6 +334,13 @@ class ServeClient:
         self.responses_topic = responses_topic
         self.reply_partition = int(reply_partition)
         self._req_parts = transport.num_partitions(requests_topic)
+        # Fleet routing (ISSUE 18): user-keyed partitioning pins every
+        # request for a user onto ONE replica's partition (user % N — the
+        # PureModPartitioner rule), so a user's answers come from a single
+        # hot-row overlay; the default req_id spread stays for standalone
+        # servers, where any partition reaches the one server anyway.
+        self.route_by_user = bool(route_by_user)
+        self.metrics = metrics
         # req_ids start at a random 40-bit base: the response partition is
         # supposed to be one-per-client, but if two clients DO share one
         # (misconfiguration), colliding id sequences would silently
@@ -251,11 +349,14 @@ class ServeClient:
         self._next_req = int.from_bytes(os.urandom(5), "big") << 16
         self._cursor = transport.end_offset(responses_topic, reply_partition)
         self.malformed_responses = 0
+        self.retries = 0
+        self.rejections = 0
 
     def request(self, user: int, k: int) -> int:
         """Send one query; returns its req_id (the response's echo key)."""
         req_id = self._next_req
         self._next_req += 1
+        part = (int(user) if self.route_by_user else req_id) % self._req_parts
         self.transport.produce(
             self.requests_topic,
             key=int(user) % (1 << 31),
@@ -263,7 +364,7 @@ class ServeClient:
                 req_id=req_id, user=int(user), k=int(k),
                 reply_partition=self.reply_partition,
             )),
-            partition=req_id % self._req_parts,
+            partition=part,
         )
         return req_id
 
@@ -290,28 +391,83 @@ class ServeClient:
         return out
 
     def ask(self, users, k: int, *, server=None, timeout_s: float = 30.0,
-            poll_wait_s: float = 0.002) -> dict[int, ScoreResponse]:
+            poll_wait_s: float = 0.002, retries: int = 3,
+            backoff_base: float = 0.02, rng=None,
+            sleep=time.sleep) -> dict[int, ScoreResponse]:
         """Blocking convenience: send, then poll until every response is
         back — driving ``server.step()`` inline when one is given (the
         single-threaded test mode; with a live server thread/process pass
-        None).  Returns {req_id: response}."""
+        None).  Returns {req_id: response} keyed by the FIRST-attempt
+        req_ids (stable for callers even when a retry re-sent a query
+        under a fresh id).
+
+        Resilience (ISSUE 18): instead of one hard raise at the deadline,
+        the poll window splits across ``retries + 1`` attempts with
+        exponential backoff + jitter between them (``resilience.retry``
+        schedule; ``rng``/``sleep`` injectable so tests assert without
+        waiting).  A RETRIABLE rejection (admission-control shed) and a
+        response that never arrived (dead replica mid-failover) are both
+        re-sent; permanent errors are final answers.  The final failure
+        is still a TimeoutError — bounded, never an infinite loop."""
+        from cfk_tpu.resilience.retry import backoff_delays
+
         self.flush()
         ids = [self.request(int(u), k) for u in users]
         self.flush()
-        want = set(ids)
+        user_of = {rid: int(u) for rid, u in zip(ids, users)}
+        alias: dict[int, int] = {}  # re-sent req_id -> original req_id
         got: dict[int, ScoreResponse] = {}
-        deadline = time.monotonic() + timeout_s
-        while want - set(got):
-            if server is not None:
-                server.step()
+        attempts = max(int(retries), 0) + 1
+        window = max(timeout_s / attempts, poll_wait_s)
+        delays = backoff_delays(base=backoff_base, rng=rng)
+
+        rejected: set[int] = set()  # orig ids shed THIS attempt
+
+        def drain() -> None:
             for resp in self.poll_responses():
-                got[resp.req_id] = resp
-            if want - set(got):
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"{len(want - set(got))} of {len(ids)} responses "
-                        f"missing after {timeout_s}s"
-                    )
-                if server is None:
-                    time.sleep(poll_wait_s)
-        return got
+                orig = alias.get(resp.req_id, resp.req_id)
+                if orig not in user_of:
+                    continue  # stale duplicate from a pre-failover serve
+                if resp.retriable:
+                    self.rejections += 1
+                    rejected.add(orig)
+                    if self.metrics is not None:
+                        self.metrics.incr("serve_client_rejections")
+                    continue  # shed — stays missing, re-sent next attempt
+                got.setdefault(orig, resp)
+
+        for attempt in range(attempts):
+            deadline = time.monotonic() + window
+            rejected.clear()
+            while set(user_of) - set(got):
+                if server is not None:
+                    server.step()
+                drain()
+                missing_now = set(user_of) - set(got)
+                if missing_now:
+                    # every straggler already answered "retry later" —
+                    # nothing more arrives this attempt, back off now
+                    if missing_now <= rejected:
+                        break
+                    if time.monotonic() > deadline:
+                        break
+                    if server is None:
+                        sleep(poll_wait_s)
+            missing = set(user_of) - set(got)
+            if not missing:
+                return got
+            if attempt == attempts - 1:
+                break
+            sleep(next(delays))
+            for orig in sorted(missing):
+                new_id = self.request(user_of[orig], k)
+                alias[new_id] = orig
+                self.retries += 1
+                if self.metrics is not None:
+                    self.metrics.incr("serve_client_retries")
+            self.flush()
+        raise TimeoutError(
+            f"{len(set(user_of) - set(got))} of {len(ids)} responses "
+            f"missing after {timeout_s}s ({attempts} attempts, "
+            f"{self.rejections} rejections seen)"
+        )

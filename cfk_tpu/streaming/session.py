@@ -562,7 +562,20 @@ class StreamSession:
         event.setdefault("stream_step", self.stream_step)
         event.setdefault("num_users", self.state.num_users)
         for fn in self._commit_listeners:
-            fn(event)
+            # A listener failure must not poison the commit that already
+            # happened, nor starve the OTHER listeners (a broken serving
+            # subscriber taking down the training stream would invert the
+            # dependency) — record it loudly and keep going.
+            try:
+                fn(event)
+            except Exception as e:
+                self.metrics.incr("commit_listener_errors")
+                record_event(
+                    "stream", "commit_listener_error",
+                    step=self.stream_step,
+                    listener=getattr(fn, "__qualname__", repr(fn)),
+                    error=f"{type(e).__name__}: {e}",
+                )
 
     def step(self) -> dict | None:
         """Process ONE micro-batch; returns its summary, or None when

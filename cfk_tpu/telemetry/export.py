@@ -118,13 +118,21 @@ class MetricsHTTPServer:
     ``self.port`` after construction.  ``start()`` runs the accept loop
     on a daemon thread; ``stop()`` shuts it down and releases the
     socket.  Also answers ``GET /healthz`` with ``ok`` (the liveness
-    probe a supervisor wants next to the scrape target)."""
+    probe a supervisor wants next to the scrape target) and — liveness
+    and readiness are DIFFERENT questions (ISSUE 18) — ``GET /readyz``:
+    200 only while ``ready_fn()`` is true (an engine that is alive but
+    still prewarming or mid-epoch-load must not receive traffic; the
+    fleet's rollover gate polls exactly this).  ``ready_fn=None`` means
+    always ready (the pre-fleet behavior); a ``ready_fn`` that raises
+    reads as NOT ready rather than killing the probe."""
 
     def __init__(self, metrics: Metrics, *, port: int = 0,
                  host: str = "127.0.0.1",
-                 labels: dict[str, object] | None = None) -> None:
+                 labels: dict[str, object] | None = None,
+                 ready_fn=None) -> None:
         self.metrics = metrics
         self.labels = dict(labels) if labels else None
+        self.ready_fn = ready_fn
         registry = metrics
         outer = self
 
@@ -146,6 +154,18 @@ class MetricsHTTPServer:
                 elif self.path.split("?", 1)[0] == "/healthz":
                     body = b"ok\n"
                     self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.split("?", 1)[0] == "/readyz":
+                    try:
+                        ready = (outer.ready_fn is None
+                                 or bool(outer.ready_fn()))
+                    except Exception:
+                        ready = False
+                    body = b"ready\n" if ready else b"not ready\n"
+                    self.send_response(200 if ready else 503)
                     self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()

@@ -130,17 +130,35 @@ def decode_score_request(data: bytes) -> ScoreRequest:
     return ScoreRequest(req_id=req_id, user=user, k=k, reply_partition=reply)
 
 
+# ScoreResponse header: int64 req_id | int32 n | uint16 error_len |
+# uint8 flags | int32 epoch | int32 staleness — 23 bytes, then the error
+# text and the parallel >i4/>f4 arrays.  ``flags`` bit0 = RETRIABLE: the
+# request was refused by admission control (overload shed), not by
+# validation — the client may re-send it, unlike a permanent error.
+# ``epoch``/``staleness`` (ISSUE 18) stamp every answer with the factor
+# table's epoch and the serving replica's delta-log backlog at score
+# time — the per-response staleness bound of the fleet contract.
+_SCORE_RESPONSE_HDR = struct.Struct(">qiHBii")
+_FLAG_RETRIABLE = 0x01
+
+
 @dataclasses.dataclass(frozen=True)
 class ScoreResponse:
     """Top-K answer: parallel (movie row, score) arrays, ids −1-padded when
     fewer than K candidates exist (the kernel's empty-slot convention).
-    ``error`` non-empty marks a refused request (unknown user, bad k) —
-    ids/scores are then empty."""
+    ``error`` non-empty marks a refused request — ids/scores are then
+    empty; ``retriable`` distinguishes an admission-control shed (re-send
+    later) from a permanent refusal (unknown user, bad k).  ``epoch`` is
+    the factor-table epoch that scored the answer and ``staleness`` the
+    replica's unapplied delta backlog at score time (frames)."""
 
     req_id: int
     movie_rows: np.ndarray  # int32 [k]
     scores: np.ndarray  # float32 [k]
     error: str = ""
+    retriable: bool = False
+    epoch: int = 0
+    staleness: int = 0
 
 
 def encode_score_response(msg: ScoreResponse) -> bytes:
@@ -151,15 +169,20 @@ def encode_score_response(msg: ScoreResponse) -> bytes:
             f"parallel 1-D arrays required, got {ids.shape}/{sc.shape}"
         )
     err = msg.error.encode()
-    return (struct.pack(">qiH", msg.req_id, ids.shape[0], len(err))
+    flags = _FLAG_RETRIABLE if msg.retriable else 0
+    return (_SCORE_RESPONSE_HDR.pack(msg.req_id, ids.shape[0], len(err),
+                                     flags, msg.epoch, msg.staleness)
             + err + ids.tobytes() + sc.tobytes())
 
 
 def decode_score_response(data: bytes) -> ScoreResponse:
-    if len(data) < 14:
+    hdr = _SCORE_RESPONSE_HDR.size
+    if len(data) < hdr:
         raise ValueError(f"ScoreResponse frame truncated at {len(data)} bytes")
-    req_id, n, elen = struct.unpack_from(">qiH", data, 0)
-    off = 14
+    req_id, n, elen, flags, epoch, staleness = _SCORE_RESPONSE_HDR.unpack_from(
+        data, 0
+    )
+    off = hdr
     if n < 0 or off + elen + 8 * n != len(data):
         raise ValueError(
             f"corrupt ScoreResponse frame: count {n}, error len {elen}, "
@@ -170,7 +193,9 @@ def decode_score_response(data: bytes) -> ScoreResponse:
     ids = np.frombuffer(data, dtype=">i4", count=n, offset=off).astype(np.int32)
     off += 4 * n
     sc = np.frombuffer(data, dtype=">f4", count=n, offset=off).astype(np.float32)
-    return ScoreResponse(req_id=req_id, movie_rows=ids, scores=sc, error=err)
+    return ScoreResponse(req_id=req_id, movie_rows=ids, scores=sc, error=err,
+                         retriable=bool(flags & _FLAG_RETRIABLE),
+                         epoch=epoch, staleness=staleness)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -235,6 +260,142 @@ def decode_float_array(data: bytes) -> np.ndarray:
     if n < 0 or 4 + 4 * n != len(data):
         raise ValueError(f"corrupt float array frame: count {n}, {len(data)} bytes")
     return np.frombuffer(data, dtype=">f4", count=n, offset=4).astype(np.float32)
+
+
+# FactorDelta header (ISSUE 18): int32 epoch | int64 seq | uint8 kind |
+# int32 num_users | int32 rank | int32 H (eager user rows) | int32 L
+# (lazy user rows) | int32 C (seen cells) | int32 M (movie rows) —
+# 37 bytes, then the payload arrays in declaration order.  ``seq`` is
+# publisher-assigned, strictly increasing across epochs — the replica's
+# gap detector compares consecutive frames' seqs, and a hole means a
+# lost delta that only a full epoch-snapshot resync can recover.
+_FACTOR_DELTA_HDR = struct.Struct(">iqBiiiiii")
+
+DELTA_KIND_ROWS = 0  # per-commit factor rows + seen cells
+DELTA_KIND_EPOCH = 1  # epoch rollover announcement (snapshot in the store)
+
+_DELTA_KIND_NAMES = {DELTA_KIND_ROWS: "rows", DELTA_KIND_EPOCH: "epoch"}
+_DELTA_KIND_CODES = {v: k for k, v in _DELTA_KIND_NAMES.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorDelta:
+    """One versioned factor-shipping frame on the durable deltas topic.
+
+    ``kind="rows"`` ships a fold-in commit: ``user_rows``/``user_factors``
+    are the EAGER (hot) rows with factors in-frame; ``lazy_user_rows``
+    name cold rows whose factors live only in the epoch snapshot store
+    (replicas pull them on demand — the PR 14 hot/cold split applied to
+    shipping); ``cells`` are the commit's rated (user_row, movie_row)
+    seen-list extensions; ``movie_rows``/``movie_factors`` carry item-side
+    per-row deltas when the commit re-solved movie rows.
+    ``kind="epoch"`` announces a warm-retrain rollover: the full snapshot
+    is in the ``SnapshotStore`` under ``epoch``; the frame itself carries
+    no factors (a multi-GB table does not belong in one log record)."""
+
+    epoch: int
+    seq: int
+    kind: str  # "rows" | "epoch"
+    num_users: int
+    user_rows: np.ndarray  # int32 [H] eager rows
+    user_factors: np.ndarray  # float32 [H, k]
+    lazy_user_rows: np.ndarray  # int32 [L] cold rows (factors in the store)
+    cells: np.ndarray  # int32 [C, 2] (user_row, movie_row)
+    movie_rows: np.ndarray  # int32 [M]
+    movie_factors: np.ndarray  # float32 [M, k]
+
+
+def make_factor_delta(epoch: int, seq: int, kind: str = "rows", *,
+                      num_users: int = 0, user_rows=(), user_factors=None,
+                      lazy_user_rows=(), cells=(), movie_rows=(),
+                      movie_factors=None, rank: int = 0) -> FactorDelta:
+    """Normalize python lists/arrays into a well-formed ``FactorDelta``
+    (contiguous dtypes, consistent rank) — the one constructor the
+    publisher uses, so encode never sees ragged input."""
+    ur = np.asarray(user_rows, np.int32).reshape(-1)
+    uf = (np.zeros((0, rank), np.float32) if user_factors is None
+          else np.asarray(user_factors, np.float32).reshape(ur.shape[0], -1))
+    mr = np.asarray(movie_rows, np.int32).reshape(-1)
+    mf = (np.zeros((0, uf.shape[1] if uf.size else rank), np.float32)
+          if movie_factors is None
+          else np.asarray(movie_factors, np.float32).reshape(mr.shape[0], -1))
+    cl = np.asarray(list(cells), np.int32).reshape(-1, 2)
+    return FactorDelta(
+        epoch=int(epoch), seq=int(seq), kind=kind, num_users=int(num_users),
+        user_rows=ur, user_factors=uf,
+        lazy_user_rows=np.asarray(lazy_user_rows, np.int32).reshape(-1),
+        cells=cl, movie_rows=mr, movie_factors=mf,
+    )
+
+
+def encode_factor_delta(msg: FactorDelta) -> bytes:
+    if msg.kind not in _DELTA_KIND_CODES:
+        raise ValueError(f"unknown FactorDelta kind {msg.kind!r}")
+    ur = np.ascontiguousarray(msg.user_rows, dtype=">i4")
+    uf = np.ascontiguousarray(msg.user_factors, dtype=">f4")
+    lz = np.ascontiguousarray(msg.lazy_user_rows, dtype=">i4")
+    cl = np.ascontiguousarray(msg.cells, dtype=">i4")
+    mr = np.ascontiguousarray(msg.movie_rows, dtype=">i4")
+    mf = np.ascontiguousarray(msg.movie_factors, dtype=">f4")
+    rank = int(uf.shape[1]) if uf.ndim == 2 and uf.shape[0] else (
+        int(mf.shape[1]) if mf.ndim == 2 and mf.shape[0] else 0
+    )
+    if uf.shape[0] != ur.shape[0] or mf.shape[0] != mr.shape[0]:
+        raise ValueError(
+            f"rows/factors mismatch: {ur.shape[0]}/{uf.shape[0]} user, "
+            f"{mr.shape[0]}/{mf.shape[0]} movie"
+        )
+    hdr = _FACTOR_DELTA_HDR.pack(
+        msg.epoch, msg.seq, _DELTA_KIND_CODES[msg.kind], msg.num_users,
+        rank, ur.shape[0], lz.shape[0], cl.shape[0], mr.shape[0],
+    )
+    return (hdr + ur.tobytes() + uf.tobytes() + lz.tobytes()
+            + cl.tobytes() + mr.tobytes() + mf.tobytes())
+
+
+def decode_factor_delta(data: bytes) -> FactorDelta:
+    hdr = _FACTOR_DELTA_HDR.size
+    if len(data) < hdr:
+        raise ValueError(f"FactorDelta frame truncated at {len(data)} bytes")
+    epoch, seq, kind, num_users, rank, h, lz, c, m = (
+        _FACTOR_DELTA_HDR.unpack_from(data, 0)
+    )
+    if kind not in _DELTA_KIND_NAMES:
+        raise ValueError(f"corrupt FactorDelta frame: unknown kind {kind}")
+    if min(rank, h, lz, c, m) < 0:
+        raise ValueError(
+            f"corrupt FactorDelta frame: negative count "
+            f"(rank {rank}, H {h}, L {lz}, C {c}, M {m})"
+        )
+    expect = hdr + 4 * h + 4 * h * rank + 4 * lz + 8 * c + 4 * m + 4 * m * rank
+    if expect != len(data):
+        raise ValueError(
+            f"corrupt FactorDelta frame: {len(data)} bytes, "
+            f"expected {expect} for (rank {rank}, H {h}, L {lz}, "
+            f"C {c}, M {m})"
+        )
+    off = hdr
+    ur = np.frombuffer(data, dtype=">i4", count=h, offset=off)
+    off += 4 * h
+    uf = np.frombuffer(data, dtype=">f4", count=h * rank, offset=off)
+    off += 4 * h * rank
+    lzr = np.frombuffer(data, dtype=">i4", count=lz, offset=off)
+    off += 4 * lz
+    cl = np.frombuffer(data, dtype=">i4", count=2 * c, offset=off)
+    off += 8 * c
+    mr = np.frombuffer(data, dtype=">i4", count=m, offset=off)
+    off += 4 * m
+    mf = np.frombuffer(data, dtype=">f4", count=m * rank, offset=off)
+    return FactorDelta(
+        epoch=epoch, seq=seq, kind=_DELTA_KIND_NAMES[kind],
+        num_users=num_users,
+        user_rows=ur.astype(np.int32),
+        user_factors=uf.astype(np.float32).reshape(h, rank),
+        lazy_user_rows=lzr.astype(np.int32),
+        cells=cl.astype(np.int32).reshape(c, 2),
+        movie_rows=mr.astype(np.int32),
+        movie_factors=mf.astype(np.float32).reshape(m, rank),
+    )
 
 
 def encode_int_list(values) -> bytes:

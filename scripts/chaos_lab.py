@@ -1578,6 +1578,241 @@ def scenario_two_stage_fallback() -> dict:
     }
 
 
+def _fleet_fixture(replicas, transport=None, seed=0, users=48, movies=64,
+                   rank=6, **fleet_kw):
+    """(fleet, publisher, broker, (u, m), oracle_engine) — a prewarmed
+    serving fleet over synthetic factors with the store seeded; the
+    oracle is a fresh engine over the same factors (the torn-read and
+    crc witnesses)."""
+    from cfk_tpu.serving import DeltaPublisher, ServeEngine, ServeFleet
+    from cfk_tpu.transport import InMemoryBroker
+
+    rng = np.random.default_rng(seed)
+    u = rng.standard_normal((users, rank)).astype(np.float32)
+    m = rng.standard_normal((movies, rank)).astype(np.float32)
+
+    def engine(i=0):
+        return ServeEngine(u, m, num_users=users, num_movies=movies,
+                           tile_m=16)
+
+    broker = InMemoryBroker()
+    fleet = ServeFleet(engine, transport if transport is not None
+                       else broker, replicas=replicas, **fleet_kw)
+    fleet.seed_store(u, m, num_users=users)
+    fleet.prewarm(5, max_batch=16)
+    pub = DeltaPublisher(broker, fleet.store)
+    return fleet, pub, broker, (u, m), engine()
+
+
+def scenario_serve_replica_kill() -> dict:
+    """ISSUE 18: killing a serving replica mid-traffic loses NOTHING.
+    A 2-replica fleet answers a user-keyed request stream; replica 0 is
+    killed abruptly (no cursor commit, no farewell) partway through.
+    Contract: (1) NO LOST REQUESTS — every accepted request gets a
+    response or an explicit retriable rejection (the client's bounded
+    retry then re-sends; zero TimeoutErrors); (2) NO TORN READS — every
+    response bit-matches the oracle engine over the same factors;
+    (3) STALENESS RECORDED — every response carries a staleness stamp;
+    (4) FAILOVER — the victim's partition moves to the survivor at the
+    committed cursor and its users keep being answered."""
+    from cfk_tpu.serving import ServeClient
+
+    fleet, pub, broker, (u, m), oracle = _fleet_fixture(replicas=2)
+    k = 5
+    client = ServeClient(broker, route_by_user=True)
+    answered = []
+    timeouts = 0
+    fleet.start()
+    try:
+        for wave in range(6):
+            if wave == 3:
+                fleet.kill_replica(0)  # abrupt, mid-stream
+            for user in range(0, 16):
+                try:
+                    got = client.ask([user], k, timeout_s=20)
+                    answered.append((user, next(iter(got.values()))))
+                except TimeoutError:
+                    timeouts += 1
+    finally:
+        fleet.stop()
+    torn = []
+    stamped = True
+    for user, resp in answered:
+        sc, ids = oracle.topk(np.asarray([user]), k)
+        if not (np.array_equal(np.asarray(resp.scores), sc[0])
+                and np.array_equal(np.asarray(resp.movie_rows), ids[0])):
+            torn.append(user)
+        stamped &= resp.staleness >= 0
+    c = fleet.counters()
+    return {
+        "scenario": "serve_replica_kill",
+        "fault_fired": bool(c["failovers"] == 1
+                            and not fleet.replicas[0].alive),
+        "detected": bool(c["failovers"] == 1),
+        "recovered": bool(timeouts == 0 and len(answered) == 96
+                          and not torn),
+        "requests_answered": len(answered),
+        "timeouts": timeouts,
+        "torn_responses": torn,
+        "staleness_stamped": bool(stamped),
+        "client_retries": int(client.retries),
+        "client_rejections": int(client.rejections),
+        "survivor_served": int(
+            fleet.replicas[1].server.requests_served
+        ),
+        "ok": bool(c["failovers"] == 1 and timeouts == 0
+                   and len(answered) == 96 and not torn and stamped),
+    }
+
+
+def scenario_serve_delta_gap() -> dict:
+    """ISSUE 18: a lost factor-delta frame must be detected LOUDLY and
+    recovered bit-exactly.  A DeltaStreamTamper permanently hides one
+    frame of the deltas topic from the replica; the publisher keeps
+    shipping commits.  Contract: (1) DETECTED — the seq hole fires the
+    gap path (flight event + dump, counter); (2) RECOVERED CRC-EXACT —
+    the epoch-snapshot resync rebuilds user-side state bit-identical to
+    a fresh engine that applied EVERY commit (table_crc); (3) SERVES
+    FRESH — a post-resync request returns the re-solved factors' scores,
+    including rows shipped only in the hidden frame."""
+    from cfk_tpu.resilience.faults import DeltaStreamTamper
+    from cfk_tpu.serving import ServeClient, ensure_serve_topics, table_crc
+    from cfk_tpu.transport import InMemoryBroker
+
+    broker = InMemoryBroker()
+    tampered = DeltaStreamTamper(broker, topic="factor-deltas", hide=[2])
+    fleet, pub, _, (u, m), oracle = _fleet_fixture(
+        replicas=1, transport=tampered,
+    )
+    # _fleet_fixture built its own broker for the publisher — rewire the
+    # publisher onto the REAL log underneath the tamper
+    from cfk_tpu.serving import DeltaPublisher
+
+    pub = DeltaPublisher(broker, fleet.store)
+    ensure_serve_topics(broker)
+    rng = np.random.default_rng(3)
+    replica = fleet.replicas[0]
+    victim_rows = None
+    for i in range(6):
+        rows = rng.integers(0, 48, size=3)
+        ev = {
+            "touched_rows": [int(r) for r in rows],
+            "rows": rng.standard_normal((3, 6)).astype(np.float32),
+            "cells": [], "retrain": False, "num_users": 48,
+        }
+        if i == 2:
+            victim_rows = [int(r) for r in rows]  # only in hidden frame
+        pub.on_commit(ev)
+        oracle.on_commit(ev)
+    replica.pump()
+    crc_match = table_crc(replica.engine) == table_crc(oracle)
+    # post-resync serving answers from the fully-recovered table
+    client = ServeClient(broker)
+    got = client.ask([victim_rows[0]], 5, server=replica.server)
+    resp = next(iter(got.values()))
+    sc, ids = oracle.topk(np.asarray([victim_rows[0]]), 5)
+    fresh = bool(np.array_equal(np.asarray(resp.scores), sc[0])
+                 and np.array_equal(np.asarray(resp.movie_rows), ids[0]))
+    return {
+        "scenario": "serve_delta_gap",
+        "fault_fired": bool(tampered.hidden >= 1),
+        "detected": bool(replica.gaps_detected >= 1),
+        "recovered": bool(replica.resyncs >= 1 and crc_match and fresh),
+        "frames_hidden": int(tampered.hidden),
+        "gaps_detected": int(replica.gaps_detected),
+        "resyncs": int(replica.resyncs),
+        "applied_seq": int(replica.applied_seq),
+        "crc_exact_vs_fresh_engine": bool(crc_match),
+        "post_resync_fresh": fresh,
+        "ok": bool(tampered.hidden >= 1 and replica.gaps_detected >= 1
+                   and replica.resyncs >= 1 and crc_match and fresh),
+    }
+
+
+def scenario_serve_rollover() -> dict:
+    """ISSUE 18: a warm-retrain epoch rollover under continuous traffic
+    serves EVERY request and never shows a mixed-epoch table.  A hammer
+    stream asks while the publisher announces epoch 1; the replica
+    prewarms the new engine on a background thread and flips one pointer
+    at a batch boundary.  Contract: (1) CONTINUOUS — zero timeouts
+    through the swap; (2) NO MIXED-EPOCH READ — every response
+    bit-matches the epoch-0 oracle or the epoch-1 oracle, never neither,
+    and its epoch stamp agrees with the oracle it matched; (3) the swap
+    COMPLETES — post-flip answers come from epoch 1."""
+    import time as _t
+
+    from cfk_tpu.serving import ServeClient, ServeEngine
+
+    fleet, pub, broker, (u, m), oracle0 = _fleet_fixture(replicas=1)
+    rng = np.random.default_rng(9)
+    u2 = rng.standard_normal(u.shape).astype(np.float32)
+    m2 = rng.standard_normal(m.shape).astype(np.float32)
+    oracle1 = ServeEngine(u2, m2, num_users=u.shape[0],
+                          num_movies=m.shape[0], tile_m=16)
+    k = 5
+    client = ServeClient(broker, route_by_user=True)
+    answered = []
+    timeouts = 0
+    fleet.start()
+    replica = fleet.replicas[0]
+    try:
+        deadline = _t.monotonic() + 60
+        asks = post_flip = 0
+        while _t.monotonic() < deadline:
+            user = asks % 16
+            try:
+                got = client.ask([user], k, timeout_s=20)
+                answered.append((user, next(iter(got.values()))))
+            except TimeoutError:
+                timeouts += 1
+            asks += 1
+            if asks == 10:
+                pub.on_commit({"retrain": True, "user_factors": u2,
+                               "movie_factors": m2, "num_users": 48})
+            if replica.rollovers >= 1:
+                # a few post-flip asks prove the new epoch serves, but
+                # stop before their batch events push the rollover
+                # events out of the flight dump's tail window
+                post_flip += 1
+                if post_flip >= 8:
+                    break
+    finally:
+        fleet.stop()
+    mixed = []
+    stamp_wrong = []
+    post_flip_new = False
+    for user, resp in answered:
+        s0, i0 = oracle0.topk(np.asarray([user]), k)
+        s1, i1 = oracle1.topk(np.asarray([user]), k)
+        is0 = bool(np.array_equal(np.asarray(resp.scores), s0[0])
+                   and np.array_equal(np.asarray(resp.movie_rows), i0[0]))
+        is1 = bool(np.array_equal(np.asarray(resp.scores), s1[0])
+                   and np.array_equal(np.asarray(resp.movie_rows), i1[0]))
+        if not (is0 or is1):
+            mixed.append(user)
+        elif is1 and not is0:
+            post_flip_new = True
+            if resp.epoch != 1:
+                stamp_wrong.append(user)
+        elif is0 and not is1 and resp.epoch != 0:
+            stamp_wrong.append(user)
+    return {
+        "scenario": "serve_rollover",
+        "fault_fired": bool(replica.rollovers >= 1),
+        "detected": bool(replica.engine.epoch == 1),
+        "recovered": bool(timeouts == 0 and not mixed and post_flip_new),
+        "requests_answered": len(answered),
+        "timeouts": timeouts,
+        "rollovers": int(replica.rollovers),
+        "mixed_epoch_responses": mixed,
+        "epoch_stamp_mismatches": stamp_wrong,
+        "served_from_new_epoch": post_flip_new,
+        "ok": bool(replica.rollovers >= 1 and replica.engine.epoch == 1
+                   and timeouts == 0 and not mixed and not stamp_wrong
+                   and post_flip_new),
+    }
+
+
 SCENARIOS = {
     "nan": scenario_nan,
     "inf": scenario_inf,
@@ -1593,6 +1828,9 @@ SCENARIOS = {
     "stream_poison_batch": scenario_stream_poison_batch,
     "quantized_table": scenario_quantized_table,
     "serve_under_foldin": scenario_serve_under_foldin,
+    "serve_replica_kill": scenario_serve_replica_kill,
+    "serve_delta_gap": scenario_serve_delta_gap,
+    "serve_rollover": scenario_serve_rollover,
     "two_stage_fallback": scenario_two_stage_fallback,
     "plan_fallback": scenario_plan_fallback,
     "offload_window": scenario_offload_window,
@@ -1625,6 +1863,9 @@ FLIGHT_EXPECT = {
     "stream_poison_batch": ("quarantine",),
     "quantized_table": ("health_trip", "nonfinite"),
     "serve_under_foldin": ("commit", "serve"),
+    "serve_replica_kill": ("replica_kill", "failover"),
+    "serve_delta_gap": ("delta_gap", "resync"),
+    "serve_rollover": ("rollover_begin", "rollover_flip"),
     "two_stage_fallback": ("two_stage_fault",),
     "plan_fallback": ("health_trip", "nonfinite"),
     "offload_window": ("health_trip",),

@@ -510,3 +510,135 @@ def test_commit_event_carries_committed_dtype_rows(tmp_path):
         rows, np.asarray(sess.user_factors[np.asarray(touched)], np.float32)
     )
     assert rows.dtype == np.float32
+
+
+def test_hostile_request_frames_fuzz_batch_isolation():
+    # serdes fuzz (ISSUE 18): malformed, truncated, and oversized request
+    # frames co-batched with valid ones — every valid request is answered,
+    # every hostile frame is counted + skipped, and the serve loop stays
+    # alive (no exception, no wedged cursor)
+    from cfk_tpu.serving import (
+        RecommendServer,
+        ServeClient,
+        engine_from_model,
+        ensure_serve_topics,
+    )
+    from cfk_tpu.transport import InMemoryBroker
+    from cfk_tpu.transport.serdes import ScoreRequest, encode_score_request
+
+    ds, model = _tiny_model()
+    eng = engine_from_model(model, ds, tile_m=16)
+    broker = InMemoryBroker()
+    ensure_serve_topics(broker)
+    server = RecommendServer(eng, broker)
+    client = ServeClient(broker)
+    good = encode_score_request(ScoreRequest(req_id=1, user=3, k=4))
+    hostile = [
+        b"",                      # empty
+        b"\x00",                  # 1 byte
+        good[:11],                # truncated header
+        good + b"\xff" * 9,       # oversized (trailing junk)
+        bytes(255 for _ in range(len(good))),  # right length, hostile bits
+        b"\x00" * 1024,           # oversized zeros
+    ]
+    rng = np.random.default_rng(7)
+    hostile += [bytes(rng.integers(0, 256, size=int(n), dtype=np.uint8))
+                for n in rng.integers(1, 64, size=10) if int(n) != 24]
+    valid_ids = []
+    for i, frame in enumerate(hostile):
+        valid_ids.append(client.request(i % eng.num_users, 3))
+        broker.produce("serve-requests", key=0, value=frame, partition=0)
+    client.flush()
+    while server.step():
+        pass
+    by_id = {r.req_id: r for r in client.poll_responses()}
+    # every VALID co-batched request answered, no errors
+    assert set(valid_ids) <= set(by_id)
+    assert all(not by_id[rid].error for rid in valid_ids)
+    # every hostile frame skipped and counted, none re-read
+    assert server.malformed_requests == len(hostile)
+    assert server.step() == 0
+    assert server.malformed_requests == len(hostile)
+    # the "right length, hostile bits" frame may have decoded into an
+    # insane ScoreRequest — that one gets a per-request ERROR response
+    # (validation), which must not have poisoned anything above
+
+
+def test_hostile_frame_fuzz_decoders_raise_value_error_only():
+    # every truncation/corruption of a valid frame either round-trips or
+    # raises ValueError — never struct.error/IndexError/segfault-bait —
+    # for all three serving codecs (request, response, factor delta)
+    from cfk_tpu.transport.serdes import (
+        ScoreRequest,
+        ScoreResponse,
+        decode_factor_delta,
+        decode_score_request,
+        decode_score_response,
+        encode_factor_delta,
+        encode_score_request,
+        encode_score_response,
+        make_factor_delta,
+    )
+
+    rng = np.random.default_rng(11)
+    frames = [
+        (decode_score_request,
+         encode_score_request(ScoreRequest(req_id=9, user=4, k=7))),
+        (decode_score_response,
+         encode_score_response(ScoreResponse(
+             req_id=9, movie_rows=np.arange(5, dtype=np.int32),
+             scores=np.arange(5, dtype=np.float32), error="x",
+             retriable=True, epoch=3, staleness=2))),
+        (decode_factor_delta,
+         encode_factor_delta(make_factor_delta(
+             1, 4, "rows", num_users=8, user_rows=[2, 5],
+             user_factors=np.ones((2, 3), np.float32),
+             lazy_user_rows=[7], cells=[(2, 1)], rank=3))),
+    ]
+    for decode, frame in frames:
+        for cut in range(len(frame)):
+            try:
+                decode(frame[:cut])
+            except ValueError:
+                pass
+        for _ in range(50):
+            mutated = bytearray(frame)
+            for pos in rng.integers(0, len(frame), size=3):
+                mutated[pos] ^= int(rng.integers(1, 256))
+            try:
+                decode(bytes(mutated))
+            except ValueError:
+                pass
+        with pytest.raises(ValueError):
+            decode(frame + b"\x01")
+
+
+def test_factor_delta_round_trip():
+    from cfk_tpu.transport.serdes import (
+        decode_factor_delta,
+        encode_factor_delta,
+        make_factor_delta,
+    )
+
+    rng = np.random.default_rng(5)
+    d = make_factor_delta(
+        2, 17, "rows", num_users=100, user_rows=[3, 9, 41],
+        user_factors=rng.standard_normal((3, 6)).astype(np.float32),
+        lazy_user_rows=[55, 60], cells=[(3, 7), (9, 1)],
+        movie_rows=[4], movie_factors=rng.standard_normal((1, 6)),
+    )
+    back = decode_factor_delta(encode_factor_delta(d))
+    assert (back.epoch, back.seq, back.kind) == (2, 17, "rows")
+    assert back.num_users == 100
+    np.testing.assert_array_equal(back.user_rows, d.user_rows)
+    np.testing.assert_array_equal(back.user_factors, d.user_factors)
+    np.testing.assert_array_equal(back.lazy_user_rows, d.lazy_user_rows)
+    np.testing.assert_array_equal(back.cells, d.cells)
+    np.testing.assert_array_equal(back.movie_rows, d.movie_rows)
+    np.testing.assert_array_equal(back.movie_factors, d.movie_factors)
+    # epoch announcement: no factors in-frame (snapshot lives in the store)
+    e = make_factor_delta(3, 18, "epoch", num_users=100)
+    back = decode_factor_delta(encode_factor_delta(e))
+    assert back.kind == "epoch" and back.user_rows.size == 0
+    with pytest.raises(ValueError, match="kind"):
+        encode_factor_delta(make_factor_delta(1, 1, "nope"))
