@@ -450,6 +450,25 @@ class ALSConfig:
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "als++")
 
+    def _check_host_window(self) -> None:
+        """The per-family ``offload_tier='host_window'`` gate.  The
+        explicit base family streams the tiled stream-mode layout under
+        explicit ALS; ``IALSConfig`` overrides for the bucketed
+        width-class windows (ISSUE 19)."""
+        if self.layout != "tiled":
+            raise ValueError(
+                f"offload_tier='host_window' streams the tiled "
+                f"stream-mode layout; layout={self.layout!r}"
+            )
+        if self.algorithm != "als":
+            raise ValueError(
+                "offload_tier='host_window' supports the explicit ALS "
+                f"optimizer at layout='tiled'; algorithm="
+                f"{self.algorithm!r} (the subspace als++ windowed walk "
+                "is the documented follow-up — the implicit family's "
+                "iALS/iALS++ run out-of-core via IALSConfig)"
+            )
+
     def chunk_cells(self) -> int:
         """The gather-cell budget for build-time layouts: the one knob
         (``hbm_chunk_elems``) when set, else the deprecated
@@ -558,22 +577,14 @@ class ALSConfig:
                 f"total resident row count, got {self.hot_rows}"
             )
         if self.offload_tier == "host_window":
-            if self.layout != "tiled":
-                raise ValueError(
-                    f"offload_tier='host_window' streams the tiled "
-                    f"stream-mode layout; layout={self.layout!r}"
-                )
-            if self.algorithm != "als":
-                raise ValueError(
-                    "offload_tier='host_window' supports the explicit ALS "
-                    f"optimizer; algorithm={self.algorithm!r} (the "
-                    "subspace/iALS global-Gram reductions are the "
-                    "documented follow-up)"
-                )
-            # Sharded host_window is supported (ISSUE 12): the windowed
-            # driver runs per-shard staged windows under the all_gather
-            # scan or the ring/hier_ring visit schedules — no shard-count
-            # restriction here; exchange/layout rules below still apply.
+            # Family hook: explicit ALS streams the tiled stream-mode
+            # layout; the implicit family (IALSConfig) overrides with the
+            # bucketed width-class gate (ISSUE 19).  Sharded host_window
+            # is supported (ISSUE 12): the windowed driver runs per-shard
+            # staged windows under the all_gather scan or the
+            # ring/hier_ring visit schedules — no shard-count restriction
+            # here; exchange/layout rules below still apply.
+            self._check_host_window()
         if self.solver not in ("auto", "cholesky", "pallas"):
             raise ValueError(f"unknown solver {self.solver!r}")
         if self.layout not in ("padded", "bucketed", "segment", "tiled"):

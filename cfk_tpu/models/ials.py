@@ -58,6 +58,19 @@ class IALSConfig(ALSConfig):
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "ials++")
 
+    def _check_host_window(self) -> None:
+        """Implicit out-of-core (ISSUE 19): the windowed driver streams
+        the BUCKETED width-class layout (the global-Gram reduction plus
+        per-class windows), for both the full implicit solve and the
+        iALS++ subspace sweeps — the tiled stream-mode layout is the
+        explicit family's format."""
+        if self.layout != "bucketed":
+            raise ValueError(
+                "offload_tier='host_window' for the implicit family "
+                "streams the bucketed width-class layout; layout="
+                f"{self.layout!r}"
+            )
+
     def __post_init__(self) -> None:
         super().__post_init__()
         if self.alpha <= 0:
@@ -316,6 +329,36 @@ def train_ials(
     )
     knobs = exec_plan.half_step_kwargs(config)
     metrics.note("plan", plan_prov.summary())
+    if exec_plan.offload_tier == "host_window":
+        # Out-of-core implicit tier (ISSUE 19): the memory-budget
+        # predicate said the resident tables cannot fit (or the config
+        # pinned the tier), so training runs through the bucketed
+        # windowed driver — global-Gram reduction + width-class windows,
+        # bit-exact vs the resident bucketed path on the same blocks.
+        unsupported = [
+            name for name, v in (
+                ("checkpoint_manager", checkpoint_manager),
+                ("fault_injector", fault_injector),
+                ("preemption_guard", preemption_guard),
+                ("watchdog", watchdog),
+            ) if v is not None
+        ]
+        if unsupported:
+            raise NotImplementedError(
+                f"offload_tier='host_window' does not support "
+                f"{unsupported} yet — the windowed driver keeps factors "
+                "in host stores (see cfk_tpu/offload/windowed.py; "
+                "window-level fault injection uses its window_faults=)"
+            )
+        from cfk_tpu.offload.windowed import train_ials_host_window
+
+        # Same knob-threading seam as als.train_als's host_window exit:
+        # every knob the windowed driver reads off the config is either
+        # pinned there or deferred with the config's own sentinel — the
+        # recorded provenance cannot diverge from execution.
+        return train_ials_host_window(
+            dataset, config, metrics=metrics, plan_provenance=plan_prov,
+        )
     key = jax.random.PRNGKey(config.seed)
     if isinstance(dataset.movie_blocks, BucketedBlocks):
         mblocks, ublocks, u_stats, layout_kw = _bucketed_device_setup(dataset)

@@ -276,6 +276,29 @@ def ring_accumulator_bytes(local_entities: int, rank: int) -> float:
     return float(local_entities + 1) * rank * (rank + 1) * 4.0
 
 
+def gram_accumulator_bytes(rank: int) -> float:
+    """Persistent device bytes of the implicit path's global-Gram
+    accumulator (ISSUE 19): the f32 [k, k] YᵀY of one fixed side, held
+    across a half-step's windows and rebuilt per half."""
+    return float(rank) * rank * 4.0
+
+
+def gram_reservation_bytes(rank: int, stage_dtype: str | None, *,
+                           block_rows: int = 4096) -> float:
+    """What windowed iALS/iALS++ must RESERVE for the global-Gram
+    reduction: the [k, k] f32 accumulator itself plus the double-buffered
+    streamed factor blocks it is reduced from (``block_rows`` rows of the
+    fixed store crossing PCIe at the staging dtype per reduction step —
+    the same block grid the resident ``global_gram_blocked`` scans, so
+    the windowed reduction is bit-identical to the resident Gram).
+
+    The default ``block_rows`` mirrors ``ops.solve.GRAM_BLOCK_ROWS``; it
+    is a parameter here because this module must import without jax."""
+    return (gram_accumulator_bytes(rank)
+            + WINDOW_BUFFERS * block_rows * stage_row_bytes(rank,
+                                                            stage_dtype))
+
+
 def ring_accumulator_reservation(local_entities: int, rank: int, *,
                                  donated: bool = True) -> float:
     """What the window sizing must RESERVE for the ring accumulator.

@@ -460,3 +460,212 @@ def build_ring_window_plan(blocks, *, shard: int,
         src={"rating": rt, "weight": wt, "tile_seg": ts,
              "chunk_entity": ent},
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketWindowPlan:
+    """Bucketed-layout window plan (ISSUE 19): one side's width-class
+    rectangles cut into staged windows for the implicit out-of-core path.
+
+    A bucket is chunked exactly where the RESIDENT bucketed half-steps
+    chunk it (the ``chunk_rows`` hint, which ``chunk_map`` scans), so a
+    window groups consecutive resident chunks and its per-chunk batch
+    shapes — hence the XLA batched-solve bits — are identical to the
+    resident scan's.  Unchunked buckets stage as ONE whole-rectangle
+    window (the resident path solves them in one direct call).  Windows
+    never span buckets: the width class is the jit shape.
+
+    Row sets are the FIXED-table rows a window's neighbor cells gather
+    (unique over ALL cells — padding cells point at row 0 with mask 0,
+    whose contribution is exactly zero, so staging their target keeps
+    every rebased index in bounds without perturbing a single bit).
+    ``entity`` holds each window's ABSOLUTE solve-side entity ids
+    (shard·e_local + entity_local; trash rows → ``local_entities``), so
+    the hot engine's helpers run with ``shard=0, local=local_entities``
+    and the host scatter needs no per-shard rebase.
+
+    Duck-typed to the ``WindowPlan`` surface the staging pipeline and
+    ``offload/hot.py`` consume: rows / row_counts / window_rows /
+    num_windows / schedule() / chunk_entity_of(w) / stage_chunks(w) /
+    staged_bytes_per_window / plan_held_bytes."""
+
+    rows: np.ndarray          # [W, R] int64 fixed-table rows staged per window
+    row_counts: np.ndarray    # [W] real rows (<= R; the rest pad row 0)
+    bucket_of: np.ndarray     # [W] int32 source bucket per window
+    chunk_lo: np.ndarray      # [W] first resident chunk (bucket-local)
+    chunk_counts: np.ndarray  # [W] real chunks (<= ncw; the rest all-trash)
+    neighbor_idx: tuple       # per-window flat [slots·width] int32 rebased
+    entity: tuple             # per-window [slots] int64 ABSOLUTE entity ids
+    shapes: tuple             # per-bucket (ncw, chunk, width, whole)
+    window_rows: int          # R (static staged-table height)
+    table_rows: int           # F (fixed side's padded rows)
+    local_entities: int       # solve side's E_pad (trash id)
+    # Per-bucket {"rating": [rows, width], "mask": [rows, width]} views of
+    # the Bucket arrays — shared memory, never copied here.
+    src: tuple = dataclasses.field(repr=False, default_factory=tuple)
+
+    @property
+    def num_windows(self) -> int:
+        return int(self.rows.shape[0])
+
+    def schedule(self) -> list[int]:
+        """Consumption order (bucket-major, chunk order within a bucket —
+        the resident layout's own scan order); the one authority the
+        staging engine and the half-step share."""
+        return list(range(self.num_windows))
+
+    def window_shape(self, w: int) -> tuple:
+        """Window ``w``'s static solve shape (ncw, chunk, width, whole)."""
+        return self.shapes[int(self.bucket_of[w])]
+
+    def staged_bytes_per_window(self, rank: int, stage_itemsize: int, *,
+                                row_overhead_bytes: int = 0) -> int:
+        """Worst-case bytes one staged window occupies on device: the
+        gathered table rows at the staging dtype plus the widest bucket's
+        chunk arrays (nb int32 + rating f32 + mask f32 per cell, plus the
+        per-slot entity ids and iALS++'s warm-start row)."""
+        table = int(self.window_rows) * (rank * stage_itemsize
+                                         + row_overhead_bytes)
+        cells = max((ncw * chunk * width
+                     for ncw, chunk, width, _ in self.shapes), default=0)
+        slots = max((ncw * chunk
+                     for ncw, chunk, width, _ in self.shapes), default=0)
+        # entity ids (int64) + the staged warm-start row at f32 — the
+        # iALS++ upper bound covers plain iALS too.
+        return table + cells * 12 + slots * (8 + rank * 4)
+
+    def plan_held_bytes(self) -> int:
+        """Host bytes the plan pins (rebased neighbor stream + row sets +
+        entity ids + metadata; rating/mask stay the Buckets' own memory)."""
+        return (self.rows.nbytes + self.row_counts.nbytes
+                + self.bucket_of.nbytes + self.chunk_lo.nbytes
+                + self.chunk_counts.nbytes
+                + sum(a.nbytes for a in self.neighbor_idx)
+                + sum(a.nbytes for a in self.entity))
+
+    def chunk_entity_of(self, w: int) -> np.ndarray:
+        """Window ``w``'s [slots] ABSOLUTE solve-entity ids (trash →
+        ``local_entities``) — the host scatter's targets and the hot
+        engine's partition key."""
+        return self.entity[w]
+
+    def stage_chunks(self, w: int) -> tuple:
+        """Window ``w``'s (rating, mask) flat host arrays — views for
+        full windows, zero-padded assembly for the ragged trailing window
+        of a chunked bucket (all-trash pad chunks: mask 0 everywhere, so
+        their contribution is exactly zero)."""
+        j = int(self.bucket_of[w])
+        ncw, chunk, width, _whole = self.shapes[j]
+        n = int(self.chunk_counts[w])
+        lo = int(self.chunk_lo[w]) * chunk
+        s = self.src[j]
+        if n == ncw:
+            hi = lo + ncw * chunk
+            return (s["rating"][lo:hi].reshape(-1),
+                    s["mask"][lo:hi].reshape(-1))
+        rt = np.zeros(ncw * chunk * width, dtype=np.float32)
+        mk = np.zeros(ncw * chunk * width, dtype=np.float32)
+        real = n * chunk * width
+        rt[:real] = s["rating"][lo:lo + n * chunk].reshape(-1)
+        mk[:real] = s["mask"][lo:lo + n * chunk].reshape(-1)
+        return rt, mk
+
+
+def build_bucket_window_plan(blocks, table_rows: int, *,
+                             chunks_per_window: int = 4) -> BucketWindowPlan:
+    """Cut one side of a ``BucketedBlocks`` into staged windows.
+
+    ``blocks`` is the SOLVE side (its buckets hold the rows being
+    updated), ``table_rows`` the FIXED side's padded entity count (the
+    row space ``neighbor_idx`` addresses).  Chunked buckets group
+    ``chunks_per_window`` consecutive resident chunks per window with a
+    floor of 2 (the scan-length bit contract — a length-1 ``lax.map``
+    compiles to a different program than the same body in a longer scan);
+    unchunked buckets stage whole, matching the resident direct solve.
+    One plan covers every shard: rows are shard-major, chunk boundaries
+    never straddle shards, and entity ids are absolute."""
+    if chunks_per_window < 1:
+        raise ValueError(
+            f"chunks_per_window must be >= 1, got {chunks_per_window}"
+        )
+    e_local = blocks.local_entities
+    e_pad = blocks.padded_entities
+    n_sh = blocks.num_shards
+    f = int(table_rows)
+
+    groups = []      # (bucket j, chunk_lo, chunk_count)
+    shapes = []      # per bucket (ncw, chunk, width, whole)
+    src = []
+    ent_abs_of = []  # per bucket [rows] int64 absolute entity ids
+    for j, b in enumerate(blocks.buckets):
+        rows_b, width = b.neighbor_idx.shape
+        per_shard = rows_b // n_sh
+        sh = np.arange(rows_b, dtype=np.int64) // per_shard
+        el = b.entity_local.astype(np.int64)
+        ent_abs_of.append(np.where(el < e_local, sh * e_local + el, e_pad))
+        src.append({"rating": b.rating, "mask": b.mask})
+        if b.chunk_rows is None or b.chunk_rows >= rows_b:
+            shapes.append((1, rows_b, width, True))
+            groups.append((j, 0, 1))
+            continue
+        chunk = int(b.chunk_rows)
+        nc = rows_b // chunk  # builder guarantees chunk | rows_b, nc >= 2
+        ncw = max(2, min(chunks_per_window, nc))
+        shapes.append((ncw, chunk, width, False))
+        c = 0
+        while c < nc:
+            end = min(c + ncw, nc)
+            groups.append((j, c, end - c))
+            c = end
+
+    # Per-window unique row sets over ALL neighbor cells (padding cells
+    # included — see class docstring), sorted ascending for gather
+    # locality and a canonical rebase.
+    row_lists, counts = [], []
+    for j, lo, n in groups:
+        _, chunk, _, _ = shapes[j]
+        w_nb = blocks.buckets[j].neighbor_idx[
+            lo * chunk:(lo + n) * chunk
+        ].ravel()
+        rows_w = np.unique(w_nb)
+        row_lists.append(rows_w)
+        counts.append(rows_w.shape[0])
+    window_rows = max(_round_up(max(counts, default=1), 8), 8)
+
+    w = len(groups)
+    rows = np.zeros((w, window_rows), dtype=np.int64)
+    nb_list, ent_list = [], []
+    for wi, ((j, lo, n), rows_w) in enumerate(zip(groups, row_lists)):
+        ncw, chunk, width, _whole = shapes[j]
+        rows[wi, : rows_w.shape[0]] = rows_w
+        slots = ncw * chunk
+        chunk_nb = blocks.buckets[j].neighbor_idx[
+            lo * chunk:(lo + n) * chunk
+        ].ravel()
+        reb = np.searchsorted(rows_w, chunk_nb).astype(np.int32)
+        if n == ncw:
+            nb_w = reb
+            ent_w = ent_abs_of[j][lo * chunk:(lo + ncw) * chunk]
+        else:
+            # Ragged trailing window: all-trash pad chunks point their
+            # neighbor cells at window position 0 (mask 0 — exact zero
+            # contribution) and their entities at the trash slot.
+            nb_w = np.zeros(slots * width, dtype=np.int32)
+            nb_w[: n * chunk * width] = reb
+            ent_w = np.full(slots, e_pad, dtype=np.int64)
+            ent_w[: n * chunk] = ent_abs_of[j][lo * chunk:(lo + n) * chunk]
+        nb_list.append(nb_w)
+        ent_list.append(np.ascontiguousarray(ent_w))
+
+    return BucketWindowPlan(
+        rows=rows,
+        row_counts=np.asarray(counts, dtype=np.int64),
+        bucket_of=np.asarray([j for j, _, _ in groups], dtype=np.int32),
+        chunk_lo=np.asarray([lo for _, lo, _ in groups], dtype=np.int64),
+        chunk_counts=np.asarray([n for _, _, n in groups], dtype=np.int64),
+        neighbor_idx=tuple(nb_list),
+        entity=tuple(ent_list),
+        shapes=tuple(shapes),
+        window_rows=window_rows, table_rows=f, local_entities=e_pad,
+        src=tuple(src),
+    )

@@ -73,8 +73,10 @@ from cfk_tpu.offload.store import (
     quantize_rows_host,
 )
 from cfk_tpu.offload.window import (
+    BucketWindowPlan,
     RingWindowPlan,
     WindowPlan,
+    build_bucket_window_plan,
     build_ring_window_plan,
     build_window_plan,
 )
@@ -1966,6 +1968,921 @@ def train_als_host_window(
     return ALSModel(
         user_factors=u_arr,
         movie_factors=m_arr,
+        num_users=dataset.user_map.num_entities,
+        num_movies=dataset.movie_map.num_entities,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Out-of-core iALS / iALS++ (ISSUE 19): the global-Gram reduction over the
+# host store, the bucketed width-class window jits, and the implicit driver.
+# ---------------------------------------------------------------------------
+
+
+def _gram_block_impl(acc, data, scale):
+    """One staged block's contribution to the global YᵀY accumulator —
+    the SAME ``gram_block_add`` body the resident ``global_gram_blocked``
+    scans (per-block bits are scan-length-invariant, so the streamed
+    reduction is bit-equal to the resident in-jit scan), fed the
+    dequantized view the kernels read (``quant.dequantize_table`` — the
+    int8 Gram must see codes·scale, not raw codes)."""
+    from cfk_tpu.ops import quant
+    from cfk_tpu.ops.solve import gram_block_add
+
+    _TRACES[0] += 1
+    return gram_block_add(acc, quant.dequantize_table(data, scale))
+
+
+@functools.lru_cache(maxsize=None)
+def _gram_block_jit():
+    """The Gram-reduction jit.  The accumulator donates (in-place add —
+    output aliases input, the ring-carry idiom at the [k,k] scale); the
+    staged block pair additionally donates on TPU only
+    (``_staged_donate_argnums`` — on CPU ``device_put`` zero-copy-aliases
+    the host block)."""
+    return jax.jit(
+        _gram_block_impl,
+        donate_argnums=_staged_donate_argnums((0,), (1, 2)),
+    )
+
+
+def windowed_store_gram(store: HostFactorStore, *,
+                        table_dtype: str | None = None,
+                        stats: dict | None = None,
+                        block_rows: int | None = None):
+    """Global YᵀY of a host-resident factor table, reduced block-by-block
+    into a device [k, k] f32 accumulator (ISSUE 19's piece 1).
+
+    The implicit half-steps need the FULL fixed-side Gram, which the
+    resident bucketed paths compute in-jit from the whole table — exactly
+    the array the out-of-core regime cannot hold.  Here the store streams
+    through the device in ``ops.solve.GRAM_BLOCK_ROWS`` blocks at the
+    STAGING dtype (host cast / ``quantize_rows_host`` — per-row pinned
+    bit-identical to the resident in-jit quantization), each block's
+    partial Gram accumulating via the SAME ``gram_block_add`` body the
+    resident ``global_gram_blocked`` scans.  The tail block zero-pads in
+    the dequantized domain (int8 pads ship zero codes with scale 1.0 —
+    dequantize to exact 0.0 rows, a zero Gram contribution), matching the
+    resident zero-pad bit-for-bit.  Result: the streamed accumulator is
+    BIT-EQUAL to the resident global Gram at every staging dtype.
+
+    The [k,k] accumulator plus the double-buffered staged block are the
+    ``budget.gram_reservation_bytes`` term the driver reserves before
+    window sizing — refused loudly when it does not fit.
+
+    Lifetime: recomputed from the host MASTERS at the start of each half
+    (never carried across iterations), so the rollback ladder's store
+    restore heals the accumulator for free — replay recomputes it from
+    the restored bytes."""
+    from cfk_tpu.ops.solve import GRAM_BLOCK_ROWS
+
+    import jax.numpy as jnp
+
+    br = int(block_rows) if block_rows else GRAM_BLOCK_ROWS
+    stage_name = _stage_dtype(store.dtype, table_dtype)
+    int8 = stage_name == "int8"
+    stage_np = None if int8 else _np_dtype(stage_name)
+    k = store.rank
+    acc = jnp.zeros((k, k), jnp.float32)
+    for lo in range(0, store.rows, br):
+        hi = min(lo + br, store.rows)
+        tbl = store.gather(np.arange(lo, hi, dtype=np.int64))
+        if int8:
+            data, scale = quantize_rows_host(tbl)
+        else:
+            data = (tbl if tbl.dtype == stage_np
+                    else tbl.astype(stage_np))
+            scale = None
+        if hi - lo < br:
+            pad = np.zeros((br, k), dtype=data.dtype)
+            pad[: hi - lo] = data
+            data = pad
+            if scale is not None:
+                ps = np.ones((br,), dtype=np.float32)
+                ps[: hi - lo] = scale
+                scale = ps
+        if stats is not None:
+            stats_add(stats, "gram_staged_bytes",
+                      data.nbytes + (scale.nbytes if scale is not None
+                                     else 0))
+            stats_add(stats, "gram_blocks_staged", 1)
+        data, scale = jax.device_put((data, scale))
+        acc = _gram_block_jit()(acc, data, scale)
+    return acc
+
+
+def _bucket_window_impl(tbl, scale, nb, rt, mk, gram, *, shape, lam, alpha,
+                        solver, overlap, fused_epilogue, in_kernel_gather,
+                        reg_solve_algo, out_dtype):
+    """One staged width-class window through the UNMODIFIED resident
+    bucket piece (``ops.solve.ials_half_step_bucketed``'s solve_piece):
+    the ported gather/Gram kernels where the static gates admit them,
+    else the legacy XLA schedule against the dequantized window view.
+    Whole-bucket windows run the direct call; chunked windows run the
+    resident ``chunk_map`` scan at the resident per-chunk batch shape
+    (scan-length-invariant bits for length ≥ 2 — the plan's floor), so
+    the per-entity solves are bit-identical to the resident walk."""
+    import jax.numpy as jnp
+
+    from cfk_tpu.ops import bucketed as bport
+    from cfk_tpu.ops import quant
+    from cfk_tpu.ops.pipeline import chunk_map
+    from cfk_tpu.ops.solve import (
+        gather_gram_implicit,
+        regularized_solve_matrix,
+    )
+
+    _TRACES[0] += 1
+    ncw, chunk, width, whole = shape
+    view = quant.dequantize_table(tbl, scale)
+    k = view.shape[-1]
+    reg_m = gram + lam * jnp.eye(k, dtype=jnp.float32)
+
+    def solve_piece(ni, rt_c, mk_c):
+        rows = ni.shape[0]
+        modes = bport.resolve_bucket_modes(
+            fused_epilogue, in_kernel_gather, solver, rows, width, k,
+            None, reg_solve_algo,
+        )
+        if modes is None:
+            a_obs, b = gather_gram_implicit(view, ni, alpha * rt_c, mk_c)
+            return regularized_solve_matrix(a_obs, b, reg_m, solver,
+                                            algo=reg_solve_algo)
+        fused, gather = modes
+        wt, rt_b = bport.ials_reparam(rt_c, mk_c, alpha)
+        return bport.bucket_gram_solve(
+            tbl, scale, ni, wt, rt_b, reg_m, lam=0.0, reg_mode="matrix",
+            solver=solver, fused=fused, gather=gather, algo=reg_solve_algo,
+        )
+
+    if whole:
+        xs = solve_piece(nb.reshape(chunk, width),
+                         rt.reshape(chunk, width),
+                         mk.reshape(chunk, width))
+    else:
+        xs = chunk_map(
+            solve_piece,
+            (nb.reshape(ncw, chunk, width), rt.reshape(ncw, chunk, width),
+             mk.reshape(ncw, chunk, width)),
+            ncw, overlap=overlap,
+        ).reshape(ncw * chunk, k)
+    return xs.astype(jnp.dtype(out_dtype))
+
+
+_BUCKET_STATICS = ("shape", "lam", "alpha", "solver", "overlap",
+                   "fused_epilogue", "in_kernel_gather", "reg_solve_algo",
+                   "out_dtype")
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_window_jit():
+    """The bucketed-iALS window jit (one trace per width-class shape).
+    The staged (tbl, scale) pair donates on TPU only; the Gram
+    accumulator is NEVER donated — every window of the half reads it."""
+    return jax.jit(
+        _bucket_window_impl, static_argnames=_BUCKET_STATICS,
+        donate_argnums=_staged_donate_argnums((), (0, 1)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_window_hot_jit():
+    """Same program under the hot/delta engine: no staged donation — the
+    assembled window table is the successor's delta-reuse source."""
+    return jax.jit(
+        _bucket_window_impl, static_argnames=_BUCKET_STATICS,
+    )
+
+
+def _bucket_window_pp_impl(tbl, scale, nb, rt, mk, xw, gram, *, shape, lam,
+                           alpha, block_size, sweeps, solver, overlap,
+                           fused_epilogue, in_kernel_gather,
+                           reg_solve_algo, out_dtype):
+    """One staged width-class window through the UNMODIFIED iALS++
+    subspace sweep (``ops.subspace._sweep_rect`` — the identical body the
+    resident ``ials_pp_half_step_bucketed`` walks), warm-started from the
+    staged ``xw`` rows (the solve side's previous factors gathered per
+    window slot; trash slots zero — exactly the resident warm walk's
+    zero-seeded scratch row).  The sweeps are purely per-entity, so the
+    windowed per-chunk results are bit-identical to the resident scan at
+    the same chunk shape."""
+    import jax.numpy as jnp
+
+    from cfk_tpu.ops.pipeline import chunk_map
+    from cfk_tpu.ops.subspace import _sweep_rect
+
+    _TRACES[0] += 1
+    ncw, chunk, width, whole = shape
+    k = xw.shape[-1]
+
+    def sweep_piece(xb, ni, rt_c, mk_c):
+        for _ in range(sweeps):
+            xb = _sweep_rect(
+                tbl, xb, ni, rt_c, mk_c, lam, alpha, gram, block_size,
+                solver, scale=scale, in_kernel_gather=in_kernel_gather,
+                fused_epilogue=fused_epilogue,
+                reg_solve_algo=reg_solve_algo,
+            )
+        return xb
+
+    x0 = xw.astype(jnp.float32)
+    if whole:
+        xs = sweep_piece(x0, nb.reshape(chunk, width),
+                         rt.reshape(chunk, width),
+                         mk.reshape(chunk, width))
+    else:
+        xs = chunk_map(
+            sweep_piece,
+            (x0.reshape(ncw, chunk, k), nb.reshape(ncw, chunk, width),
+             rt.reshape(ncw, chunk, width), mk.reshape(ncw, chunk, width)),
+            ncw, overlap=overlap,
+        ).reshape(ncw * chunk, k)
+    return xs.astype(jnp.dtype(out_dtype))
+
+
+_BUCKET_PP_STATICS = _BUCKET_STATICS + ("block_size", "sweeps")
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_window_pp_jit():
+    """The iALS++ window jit: staged table pair AND the per-window
+    warm-start rows donate on TPU (both are freshly staged per window);
+    the Gram accumulator never donates."""
+    return jax.jit(
+        _bucket_window_pp_impl, static_argnames=_BUCKET_PP_STATICS,
+        donate_argnums=_staged_donate_argnums((), (0, 1, 5)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_window_pp_hot_jit():
+    """iALS++ under the hot/delta engine: the assembled table outlives
+    the call (delta reuse), so nothing donates."""
+    return jax.jit(
+        _bucket_window_pp_impl, static_argnames=_BUCKET_PP_STATICS,
+    )
+
+
+def _bucket_stager(fixed_store, bplan, schedule, *, table_dtype, faults,
+                   iteration, side, shard, verify_windows, stats, ici_group,
+                   hot=None, x_prev=None, mode="serial",
+                   depth=1) -> WindowStager:
+    """The staging engine for one bucketed half: the SAME
+    ``_stage_window`` / ``_stage_window_delta`` pipeline the tiled driver
+    runs (gather → fault hook → checksum → quantize → ONE ``device_put``),
+    plus — for iALS++ — each window's warm-start rows ``x_prev[entity]``
+    appended to the staged tuple (gathered from an immutable snapshot
+    padded with one zeros trash row, so pooled staging threads read a
+    frozen array; the bytes are metered into ``staged_bytes`` — they
+    cross PCIe like every other staged operand)."""
+    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
+    int8 = stage_name == "int8"
+    stage_np = None if int8 else _np_dtype(stage_name)
+    x_pad = None
+    if x_prev is not None:
+        xp = np.asarray(x_prev)
+        x_pad = np.zeros((bplan.local_entities + 1, xp.shape[1]),
+                         dtype=xp.dtype)
+        x_pad[: bplan.local_entities] = xp[: bplan.local_entities]
+
+    def stage_task(d, w):
+        if hot is not None:
+            staged = _stage_window_delta(
+                fixed_store, bplan, hot.hmap, w, stage_np=stage_np,
+                int8=int8, faults=faults, iteration=iteration, side=side,
+                shard=d, verify_windows=verify_windows, stats=stats,
+                ici_group=ici_group,
+            )
+        else:
+            staged = _stage_window(
+                fixed_store, bplan, w, stage_np=stage_np, int8=int8,
+                faults=faults, iteration=iteration, side=side, shard=d,
+                verify_windows=verify_windows, stats=stats,
+                ici_group=ici_group,
+            )
+        if x_pad is None:
+            return staged
+        xw = x_pad[bplan.chunk_entity_of(w)]
+        if stats is not None:
+            stats_add(stats, "staged_bytes", xw.nbytes)
+        return staged + (jax.device_put(xw),)
+
+    return WindowStager([(shard, w) for w in schedule], stage_task,
+                        mode=mode, depth=depth, stats=stats,
+                        span_attrs=lambda d, w: _stage_span_attrs(
+                            hot.hmap if hot is not None else None,
+                            bplan, w))
+
+
+def bucket_windowed_half_step(
+    fixed_store: HostFactorStore, bplan: BucketWindowPlan, *, gram,
+    lam: float, alpha: float, algorithm: str = "als", block_size: int = 32,
+    sweeps: int = 1, x_prev: np.ndarray | None = None,
+    out_dtype: str = "float32", solver: str = "auto", overlap=None,
+    fused_epilogue=None, in_kernel_gather=None, reg_solve_algo=None,
+    table_dtype: str | None = None, faults=None, iteration: int = 0,
+    side: str = "", stats: dict | None = None,
+    verify_windows: bool = False, shard: int = 0, ici_group: int = 1,
+    stager: WindowStager | None = None, hot: "_HotHalf | None" = None,
+    host: int = 0,
+) -> np.ndarray:
+    """Solve one side's bucketed entities against a host-resident fixed
+    table, width-class window by window (ISSUE 19's piece 2).
+
+    ``gram`` is the device [k,k] f32 global YᵀY of the fixed table
+    (``windowed_store_gram``), shared read-only by every window.
+    ``algorithm='als'`` runs the full per-entity implicit solve;
+    ``'ials++'`` runs ``sweeps`` subspace passes warm-started from
+    ``x_prev`` (the solve side's previous factors, [padded_entities, k]
+    host array — REQUIRED for ials++; untouched entities keep their
+    previous rows in the output, exactly the resident warm walk).
+    Returns the solved [padded_entities, rank] host array in
+    ``out_dtype``.  Same staging/fault/checksum/hot-delta semantics as
+    ``windowed_half_step`` — the hot engine's assembly, scatter-back, and
+    delta reuse run UNMODIFIED against the width-class windows."""
+    k = fixed_store.rank
+    pp = algorithm == "ials++"
+    out_np = _np_dtype(out_dtype)
+    if pp:
+        if x_prev is None:
+            raise ValueError(
+                "algorithm='ials++' needs x_prev (the solve side's "
+                "previous factors) for the warm-started subspace sweeps"
+            )
+        out = np.array(np.asarray(x_prev)[: bplan.local_entities],
+                       dtype=out_np, copy=True)
+    else:
+        out = np.zeros((bplan.local_entities, k), dtype=out_np)
+    n_w = bplan.num_windows
+    own = stager is None
+    if own:
+        stager = _bucket_stager(
+            fixed_store, bplan, bplan.schedule(), table_dtype=table_dtype,
+            faults=faults, iteration=iteration, side=side, shard=shard,
+            verify_windows=verify_windows, stats=stats,
+            ici_group=ici_group, hot=hot,
+            x_prev=x_prev if pp else None,
+        )
+    half_kw = dict(
+        lam=float(lam), alpha=float(alpha), solver=solver, overlap=overlap,
+        fused_epilogue=fused_epilogue, in_kernel_gather=in_kernel_gather,
+        reg_solve_algo=reg_solve_algo, out_dtype=out_dtype,
+    )
+    if pp:
+        half_kw.update(block_size=int(block_size), sweeps=int(sweeps))
+    stage_name = _stage_dtype(fixed_store.dtype, table_dtype)
+    prev = (None if hot is None
+            else _hot_zero_prev(bplan.window_rows, k, stage_name))
+    try:
+        staged = stager.take() if n_w else None
+        for w in range(n_w):
+            shape = bplan.window_shape(w)
+            with span("train/iter/half_step/window_compute",
+                      side=side, shard=shard, window=w, host=host):
+                if hot is None:
+                    if pp:
+                        xs = _bucket_window_pp_jit()(*staged, gram,
+                                                     shape=shape,
+                                                     **half_kw)
+                    else:
+                        xs = _bucket_window_jit()(*staged, gram,
+                                                  shape=shape, **half_kw)
+                else:
+                    delta, dscale, nb, rt, mk, *xw_t = staged
+                    tbl, scale = _assemble_jit()(
+                        delta, dscale, *prev,
+                        hot.fixed.data, hot.fixed.scale, *hot.idx(w),
+                        window_rows=bplan.window_rows,
+                        int8=hot.fixed.int8,
+                    )
+                    if pp:
+                        xs = _bucket_window_pp_hot_jit()(
+                            tbl, scale, nb, rt, mk, xw_t[0], gram,
+                            shape=shape, **half_kw)
+                    else:
+                        xs = _bucket_window_hot_jit()(
+                            tbl, scale, nb, rt, mk, gram,
+                            shape=shape, **half_kw)
+                    prev = (tbl, scale)
+                    sb = hot.sb_idx(w)
+                    if sb is not None:
+                        hot.solve.data, hot.solve.scale = _hot_update_jit()(
+                            hot.solve.data, hot.solve.scale, xs, *sb,
+                            int8=hot.solve.int8,
+                        )
+                nxt = stager.take() if w + 1 < n_w else None
+                xs_np = np.asarray(xs)
+            ent = bplan.chunk_entity_of(w)
+            real = ent < bplan.local_entities
+            out[ent[real]] = xs_np[real]
+            staged = nxt
+    finally:
+        if own:
+            stager.close()
+    return out
+
+
+def train_ials_host_window(
+    dataset,
+    config,
+    *,
+    metrics=None,
+    window_faults=None,
+    chunks_per_window: int | None = None,
+    device_budget_bytes: float | None = None,
+    plan_provenance=None,
+    verify_windows: bool | None = None,
+    staging: str | None = None,
+    pool_depth: int | None = None,
+    hot_rows: int | None = None,
+):
+    """Implicit ALS / iALS++ with host-resident factor tables and
+    windowed width-class half-steps (ISSUE 19's tentpole driver).
+
+    Same math, init, and iteration order as ``models.ials.train_ials`` on
+    the same bucketed blocks — bit-exact at f32 defaults and pinned per
+    knob by ``tests/test_offload_ials.py`` (table dtype, hot cache,
+    window size, shard count).  Per half-iteration:
+
+        gram  = windowed_store_gram(fixed store)   # streamed YᵀY
+        solve = width-class windows through the resident bucket pieces
+        commit = store.write_range (the atomic host hand-off)
+
+    The [k,k] Gram accumulator + its double-buffered staged block are
+    reserved via ``budget.gram_reservation_bytes`` BEFORE window sizing,
+    and the sizing refuses loudly — naming the Gram reserve — when one
+    window cannot fit next to it.  Divergence recovery runs the PR 3
+    ladder against in-RAM last-good snapshots; the Gram accumulator needs
+    no snapshot (recomputed from the restored masters each half), and the
+    hot partitions rebuild from them — replay is bit-identical.
+
+    Single-process only (the fleet residual exchange is tiled-layout;
+    bucketed fleet mode is a documented follow-up)."""
+    from cfk_tpu.config import enable_compile_cache
+    from cfk_tpu.data.blocks import BucketedBlocks
+    from cfk_tpu.ops.solve import init_factors_stats
+    from cfk_tpu.resilience.policy import (
+        Overrides,
+        TrainingDivergedError,
+        policy_from_config,
+    )
+    from cfk_tpu.utils.metrics import Metrics
+
+    import jax.numpy as jnp
+
+    enable_compile_cache(getattr(config, "compile_cache_dir", None))
+    if getattr(config, "alpha", None) is None:
+        raise ValueError(
+            "host-window iALS needs an implicit-feedback config "
+            "(IALSConfig — the confidence weight alpha drives the solve)"
+        )
+    if config.algorithm not in ("als", "ials++"):
+        raise ValueError(
+            f"host-window iALS supports algorithm in ('als', 'ials++'); "
+            f"got {config.algorithm!r}"
+        )
+    if config.layout != "bucketed":
+        raise ValueError(
+            f"host-window iALS streams the bucketed width-class layout; "
+            f"layout={config.layout!r}"
+        )
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "the multi-process fleet mode (ISSUE 17) is tiled-layout "
+            "only; bucketed iALS fleet exchange is a documented follow-up"
+        )
+    mb, ub = dataset.movie_blocks, dataset.user_blocks
+    if not isinstance(mb, BucketedBlocks) or not isinstance(
+            ub, BucketedBlocks):
+        raise ValueError(
+            "host-window iALS needs BucketedBlocks on both sides — "
+            "build the dataset with layout='bucketed'"
+        )
+    s = config.num_shards
+    if mb.num_shards != s or ub.num_shards != s:
+        raise ValueError(
+            f"blocks built at num_shards={mb.num_shards}/{ub.num_shards} "
+            f"but config.num_shards={s} — rebuild the dataset"
+        )
+    pp = config.algorithm == "ials++"
+    metrics = metrics if metrics is not None else Metrics()
+    with metrics.phase("window_plan"):
+        stage_name = _stage_dtype(config.dtype, config.table_dtype)
+        cell_bytes, row_overhead = _stage_cell_bytes(stage_name)
+        if device_budget_bytes is None:
+            from cfk_tpu.plan import DeviceSpec
+
+            device_budget_bytes = DeviceSpec.detect().hbm_bytes
+        # The global-Gram reduction holds a [k,k] f32 accumulator plus a
+        # double-buffered staged Gram block next to the staged windows —
+        # one more reservation term, carved out BEFORE the window split
+        # (the ring-accumulator template).
+        gram_reserved = _budget.gram_reservation_bytes(
+            config.rank, stage_name
+        )
+        per_window_budget = _budget.window_budget_bytes(
+            device_budget_bytes, reserved_bytes=gram_reserved
+        )
+        cpw = chunks_per_window or 4
+        while True:
+            m_plan = build_bucket_window_plan(mb, ub.padded_entities,
+                                              chunks_per_window=cpw)
+            u_plan = build_bucket_window_plan(ub, mb.padded_entities,
+                                              chunks_per_window=cpw)
+            worst = max(
+                p.staged_bytes_per_window(config.rank, cell_bytes,
+                                          row_overhead_bytes=row_overhead)
+                for p in (m_plan, u_plan)
+            )
+            if worst <= per_window_budget or cpw == 1:
+                break
+            cpw = max(1, cpw // 2)
+        if worst > per_window_budget:
+            raise ValueError(
+                f"one staged window needs {worst / 1e6:.1f} MB but the "
+                f"per-window budget is {per_window_budget / 1e6:.1f} MB "
+                f"((device_budget · RESIDENT_FRACTION − "
+                f"{gram_reserved / 1e6:.2f} MB global-Gram accumulator "
+                "reserve) / WINDOW_BUFFERS) — lower hbm_chunk_elems so "
+                "single chunks fit the budget, or raise the device budget"
+            )
+        staging = resolve_staging(
+            staging if staging is not None
+            else getattr(config, "staging", "auto"),
+        )
+        if pool_depth is None:
+            pool_depth = (getattr(config, "staging_pool_depth", None)
+                          or DEFAULT_POOL_DEPTH)
+        pool_depth = max(1, min(
+            int(pool_depth),
+            _budget.max_pool_depth(device_budget_bytes, worst,
+                                   reserved_bytes=gram_reserved),
+        ))
+        # Skew-aware hot-row cache resolution (ISSUE 15), unchanged
+        # machinery against the width-class plans: one plan per side
+        # covers every shard (absolute entity ids), so the helpers run
+        # at shard=0 / local=padded_entities.
+        from cfk_tpu.offload import hot as _hotmod
+
+        requested = (hot_rows if hot_rows is not None
+                     else getattr(config, "hot_rows", None))
+        schedules = {("m", 0): m_plan.schedule(),
+                     ("u", 0): u_plan.schedule()}
+        hot_note = None
+        f_u = f_m = 0
+        if requested != 0:
+            row_b = _budget.stage_row_bytes(config.rank, stage_name)
+            arena = max(p.window_rows * row_b for p in (m_plan, u_plan))
+            live = (pool_depth + 1 if staging == "pool"
+                    else _budget.WINDOW_BUFFERS)
+            live = max(live, _budget.WINDOW_BUFFERS)
+            hot_reserved = gram_reserved + live * worst + arena
+            admit = _budget.max_hot_rows(
+                device_budget_bytes, config.rank, stage_name,
+                reserved_bytes=hot_reserved,
+            )
+            counts_u = _hotmod.reference_counts(
+                [m_plan], _fixed_rows_of(m_plan)
+            )
+            counts_m = _hotmod.reference_counts(
+                [u_plan], _fixed_rows_of(u_plan)
+            )
+            solved_u = _hotmod.solved_rows_of(u_plan, 0,
+                                              ub.padded_entities)
+            solved_m = _hotmod.solved_rows_of(m_plan, 0,
+                                              mb.padded_entities)
+            mask_u = np.zeros(counts_u.shape, bool)
+            mask_u[solved_u] = True
+            counts_u[~mask_u] = 0
+            mask_m = np.zeros(counts_m.shape, bool)
+            mask_m[solved_m] = True
+            counts_m[~mask_m] = 0
+            slots_u = int(counts_u.sum())
+            slots_m = int(counts_m.sum())
+            if requested is None:
+                f_u = _hotmod.knee_hot_rows(counts_u)
+                f_m = _hotmod.knee_hot_rows(counts_m)
+                total = f_u + f_m
+                if total > admit:
+                    f_u = f_u * admit // max(total, 1)
+                    f_m = min(admit - f_u, f_m)
+                    hot_note = (f"knee clamped by budget headroom "
+                                f"({admit} rows admitted)")
+                else:
+                    hot_note = "coverage-curve knee within headroom"
+            else:
+                req = int(requested)
+                if not _budget.hot_reservation_fits(
+                    req, config.rank, stage_name, device_budget_bytes,
+                    reserved_bytes=hot_reserved,
+                ):
+                    need = _budget.hot_reservation_bytes(
+                        req, config.rank, stage_name
+                    )
+                    raise ValueError(
+                        f"hot_rows={req} pinned but its reservation "
+                        f"({need / 1e6:.2f} MB at the {stage_name!r} "
+                        f"staging dtype) exceeds the headroom left by "
+                        f"the Gram/window/delta-arena terms "
+                        f"({admit * row_b / 1e6:.2f} MB ≈ {admit} rows) "
+                        "— lower hot_rows, raise the device budget, or "
+                        "use hot_rows=0 (the full-staging engine)"
+                    )
+                denom = max(slots_u + slots_m, 1)
+                f_u = req * slots_u // denom
+                f_m = req - f_u
+                hot_note = f"pinned total {req}"
+            f_u = min(f_u, int((counts_u > 0).sum()))
+            f_m = min(f_m, int((counts_m > 0).sum()))
+            if f_u + f_m == 0:
+                hot_note = (hot_note or "") + "; resolved 0 (off)"
+        hot_ctx = None
+        if f_u + f_m > 0:
+            rows_hot_u = _hotmod.select_hot_rows(counts_u, f_u)
+            rows_hot_m = _hotmod.select_hot_rows(counts_m, f_m)
+            hmaps = {
+                ("m", 0): _hotmod.build_hot_map(
+                    m_plan, schedules[("m", 0)], rows_hot_u),
+                ("u", 0): _hotmod.build_hot_map(
+                    u_plan, schedules[("u", 0)], rows_hot_m),
+            }
+            hot_ctx = {"rows_u": rows_hot_u, "rows_m": rows_hot_m,
+                       "maps": hmaps, "note": hot_note}
+    metrics.gauge("offload_windows_m", m_plan.num_windows)
+    metrics.gauge("offload_windows_u", u_plan.num_windows)
+    metrics.gauge("offload_window_rows_m", m_plan.window_rows)
+    metrics.gauge("offload_window_rows_u", u_plan.window_rows)
+    metrics.gauge("offload_chunks_per_window", cpw)
+    metrics.gauge("offload_shards", s)
+    metrics.gauge(
+        "offload_plan_held_mb",
+        round((m_plan.plan_held_bytes()
+               + u_plan.plan_held_bytes()) / 1e6, 3),
+    )
+    metrics.gauge("offload_gram_reserved_mb",
+                  round(gram_reserved / 1e6, 3))
+    metrics.note("offload_optimizer",
+                 "ials++" if pp else "ials")
+    metrics.note("offload_staging", staging)
+    if staging == "pool":
+        metrics.gauge("offload_pool_depth", pool_depth)
+        metrics.gauge("offload_pool_workers",
+                      pool_workers_for(pool_depth))
+    metrics.note("offload_hot", "on" if hot_ctx is not None else "off")
+    if hot_note:
+        metrics.note("offload_hot_decision", hot_note)
+    if hot_ctx is not None:
+        maps_all = hot_ctx["maps"].values()
+        slots_total = sum(m.slots_total for m in maps_all)
+        metrics.gauge("offload_hot_rows", f_u + f_m)
+        metrics.gauge("offload_hot_rows_u", f_u)
+        metrics.gauge("offload_hot_rows_m", f_m)
+        if slots_total:
+            metrics.gauge("offload_hot_coverage", round(
+                sum(m.slots_hot for m in hot_ctx["maps"].values())
+                / slots_total, 4))
+            metrics.gauge("offload_delta_coverage", round(
+                sum(m.slots_kept for m in hot_ctx["maps"].values())
+                / slots_total, 4))
+
+    # Init: identical to the resident trainer — init_factors_stats over
+    # the bucketed per-entity stats (drawn at the real entity count, the
+    # shard-count-invariant init), zero movie seed.
+    key = jax.random.PRNGKey(config.seed)
+    u0 = jax.jit(
+        init_factors_stats, static_argnames=("rank", "num_entities")
+    )(
+        key, jnp.asarray(ub.rating_sum), jnp.asarray(ub.count),
+        rank=config.rank, num_entities=ub.num_entities,
+    ).astype(jnp.dtype(config.dtype))
+    u_store = HostFactorStore.from_array(np.asarray(u0),
+                                         dtype=config.dtype,
+                                         num_shards=s)
+    m_store = HostFactorStore(mb.padded_entities, config.rank,
+                              dtype=config.dtype, num_shards=s)
+
+    # Hot partitions + per-side contexts: device copies gather from the
+    # just-initialized masters; only the cold delta crosses PCIe per
+    # window from here on.
+    hot_u_part = hot_m_part = None
+    hot_halves: dict = {}
+    if hot_ctx is not None:
+        hot_u_part = HotPartition(hot_ctx["rows_u"], stage_name)
+        hot_m_part = HotPartition(hot_ctx["rows_m"], stage_name)
+        hot_u_part.rebuild(u_store)
+        hot_m_part.rebuild(m_store)
+        sb_m = _hotmod.scatter_back_maps(m_plan, 0, mb.padded_entities,
+                                         hot_m_part.rows)
+        sb_u = _hotmod.scatter_back_maps(u_plan, 0, ub.padded_entities,
+                                         hot_u_part.rows)
+        hot_halves[("m", 0)] = _HotHalf(
+            hot_u_part, hot_m_part, hot_ctx["maps"][("m", 0)], sb_m)
+        hot_halves[("u", 0)] = _HotHalf(
+            hot_m_part, hot_u_part, hot_ctx["maps"][("u", 0)], sb_u)
+        metrics.gauge("offload_hot_resident_mb", round(
+            (hot_u_part.nbytes + hot_m_part.nbytes) / 1e6, 3))
+
+    policy = policy_from_config(config)
+    base_ov = Overrides(lam=config.lam,
+                        fused_epilogue=config.fused_epilogue)
+    ov = base_ov
+    norm_limit = (config.health_norm_limit
+                  if config.health_check_every is not None else None)
+    probe_every = config.health_check_every or 1
+    stats = StagingStats()
+    if verify_windows is None:
+        verify_windows = window_faults is not None
+
+    def half(side, fixed_store, solve_store, plan, it, gram):
+        """One bucketed half-iteration: stage the fixed side's windows
+        (pool or serial), sweep/solve them against the shared Gram
+        accumulator, return the solved host buffer (committed by the
+        caller — the same solve-all-then-commit structure as the tiled
+        driver)."""
+        algo = ov.reg_solve_algo or config.reg_solve_algo
+        hot_half = hot_halves.get((side, 0))
+        if hot_half is not None and window_faults is not None:
+            part = hot_half.fixed
+            pois = (window_faults.apply_hot(it, side, part.num_rows)
+                    if hasattr(window_faults, "apply_hot") else None)
+            if pois is not None:
+                record_event("fault", "hot_cache_corruption",
+                             iteration=it, side=side, rows=len(pois))
+                part.poison(pois)
+        x_prev = solve_store.as_array() if pp else None
+        stager = _bucket_stager(
+            fixed_store, plan, plan.schedule(),
+            table_dtype=config.table_dtype, faults=window_faults,
+            iteration=it, side=side, shard=0,
+            verify_windows=verify_windows, stats=stats, ici_group=1,
+            hot=hot_half, x_prev=x_prev, mode=staging, depth=pool_depth,
+        )
+        try:
+            with span("train/iter/half_step", side=side, shard=0,
+                      iteration=it, tier="host_window"):
+                rows = bucket_windowed_half_step(
+                    fixed_store, plan, gram=gram, lam=ov.lam,
+                    alpha=config.alpha, algorithm=config.algorithm,
+                    block_size=config.block_size, sweeps=config.sweeps,
+                    x_prev=x_prev, out_dtype=config.dtype,
+                    solver=config.solver, overlap=bool(config.overlap),
+                    fused_epilogue=ov.fused_epilogue,
+                    in_kernel_gather=config.in_kernel_gather,
+                    reg_solve_algo=algo, table_dtype=config.table_dtype,
+                    faults=window_faults, iteration=it, side=side,
+                    stats=stats, verify_windows=verify_windows,
+                    shard=0, stager=stager, hot=hot_half,
+                )
+        finally:
+            stager.close()
+        return rows
+
+    armed = (config.health_check_every is not None
+             or verify_windows or window_faults is not None)
+    snap = (u_store.copy(), m_store.copy()) if armed else (None, None)
+    snap_iter = 0
+    trips = 0
+    it = 0
+    degraded = False
+    traces0 = trace_count()
+    train_t0 = time.time()
+    first_step_s = None
+
+    def _rebuild_hot() -> None:
+        if hot_u_part is not None:
+            hot_u_part.rebuild(u_store)
+            hot_m_part.rebuild(m_store)
+
+    def trip(reason: str) -> bool:
+        """Rollback + ladder climb (the tiled driver's ladder verbatim):
+        restore the last-good stores, rebuild the hot partitions from
+        them, and recompute the Gram accumulator on the next half — the
+        accumulator has no snapshot because it needs none."""
+        nonlocal u_store, m_store, it, trips, ov
+        trips += 1
+        metrics.incr("health_trips")
+        metrics.note(f"health_trip_{trips}", f"iteration {it}: {reason}")
+        record_event("fault", "health_trip", iteration=it, trip=trips,
+                     reason=reason)
+        dump_flight(f"health_trip_{trips}")
+        if trips > policy.max_recoveries:
+            detail = (
+                f"recovery exhausted after {policy.max_recoveries} "
+                f"trips; last: {reason}"
+            )
+            if policy.on_unrecoverable == "raise":
+                record_event("fault", "unrecoverable", detail=detail)
+                dump_flight("unrecoverable")
+                raise TrainingDivergedError(detail)
+            metrics.note("degraded", detail)
+            record_event("fault", "degraded", detail=detail)
+            dump_flight("degraded")
+            u_store, m_store = snap
+            it = snap_iter
+            _rebuild_hot()
+            return False
+        u_store, m_store = snap[0].copy(), snap[1].copy()
+        it = snap_iter
+        _rebuild_hot()
+        metrics.incr("rollbacks")
+        new_ov = policy.escalate(ov, trips)
+        detail = (
+            f"rung {trips}: rollback to iter {snap_iter}, "
+            f"lam={new_ov.lam}, fused={new_ov.fused_epilogue}, "
+            f"algo={new_ov.reg_solve_algo or config.reg_solve_algo}"
+        )
+        if new_ov != ov:
+            metrics.gauge("escalation_level", trips)
+            metrics.note(f"escalation_{trips}", detail)
+            record_event("fault", "escalation", rung=trips,
+                         detail=detail)
+        ov = new_ov
+        if plan_provenance is not None:
+            t = plan_provenance.record_transition(
+                "recovery_escalation", detail
+            )
+            metrics.note(f"plan_transition_{trips}", str(t))
+        return True
+
+    with metrics.phase("train"):
+        while it < config.num_iterations:
+            try:
+                with span("train/iter", i=it, tier="host_window",
+                          optimizer="ials++" if pp else "ials"):
+                    # Per-half Gram over the CURRENT fixed masters —
+                    # exactly the resident iteration body's order (the
+                    # u-half's Gram reads the freshly committed m).
+                    gram_u = windowed_store_gram(
+                        u_store, table_dtype=config.table_dtype,
+                        stats=stats)
+                    m_new = half("m", u_store, m_store, m_plan, it,
+                                 gram_u)
+                    m_store.write_range(0, m_new)
+                    gram_m = windowed_store_gram(
+                        m_store, table_dtype=config.table_dtype,
+                        stats=stats)
+                    u_new = half("u", m_store, u_store, u_plan, it,
+                                 gram_m)
+                    u_store.write_range(0, u_new)
+                record_event("train", "iter", i=it, tier="host_window")
+            except WindowIntegrityError as e:
+                if not trip(f"window integrity: {e}"):
+                    degraded = True
+                    break
+                continue
+            it += 1
+            metrics.incr("iterations")
+            if first_step_s is None:
+                first_step_s = time.time() - train_t0
+            if not armed:
+                continue
+            if it % probe_every != 0 and it < config.num_iterations:
+                continue
+            reason = _probe(u_new, m_new, norm_limit)
+            if reason is None:
+                snap = (u_store.copy(), m_store.copy())
+                snap_iter = it
+                continue
+            if not trip(reason):
+                degraded = True
+                break
+    metrics.gauge("offload_windows_staged",
+                  stats.get("windows_staged", 0))
+    metrics.gauge("offload_staged_mb",
+                  round(stats.get("staged_bytes", 0) / 1e6, 3))
+    metrics.gauge("offload_staged_cold_mb",
+                  round(stats.get("staged_cold_bytes", 0) / 1e6, 3))
+    metrics.gauge("offload_gram_staged_mb",
+                  round(stats.get("gram_staged_bytes", 0) / 1e6, 3))
+    for key_ in ("rows_staged", "rows_delta_skipped", "rows_hot_device",
+                 "gram_blocks_staged"):
+        if key_ in stats:
+            metrics.gauge(f"offload_{key_}", stats[key_])
+    busy = float(stats.get("stage_busy_s", 0.0))
+    stall = float(stats.get("stage_stall_s", 0.0))
+    metrics.gauge("offload_stage_busy_s", round(busy, 4))
+    metrics.gauge("offload_stage_stall_s", round(stall, 4))
+    if busy > 0:
+        metrics.gauge("offload_stage_hidden_frac",
+                      round(max(0.0, 1.0 - stall / busy), 4))
+        metrics.gauge("offload_staged_mb_per_s",
+                      round(stats.get("staged_bytes", 0) / 1e6 / busy, 2))
+    if staging == "pool":
+        metrics.gauge("offload_pool_peak_inflight",
+                      stats.get("pool_peak_inflight", 0))
+        metrics.gauge("offload_pool_worker_stagings",
+                      stats.get("pool_worker_stagings", 0))
+    metrics.gauge("offload_trace_count", trace_count() - traces0)
+    if first_step_s is not None:
+        metrics.gauge("time_to_first_step_s", round(first_step_s, 4))
+    if degraded:
+        metrics.gauge("iterations_completed", snap_iter)
+
+    from cfk_tpu.models.als import ALSModel
+
+    return ALSModel(
+        user_factors=u_store.as_array(),
+        movie_factors=m_store.as_array(),
         num_users=dataset.user_map.num_entities,
         num_movies=dataset.movie_map.num_entities,
     )

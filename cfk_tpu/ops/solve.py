@@ -129,6 +129,54 @@ def global_gram(factors: jax.Array) -> jax.Array:
     )
 
 
+# Canonical block height for the blocked global-Gram reduction.  One value
+# shared by the resident bucketed implicit paths and the out-of-core Gram
+# pass (offload/windowed.py) — the summation ORDER is part of the bit
+# contract between them, and the block height is what fixes it.
+GRAM_BLOCK_ROWS = 4096
+
+
+def global_gram_blocked(factors: jax.Array,
+                        block_rows: int = GRAM_BLOCK_ROWS) -> jax.Array:
+    """YᵀY by a pinned blocked reduction — [k, k], float32.
+
+    Same math as ``global_gram`` with one canonical summation order: the
+    table is cut into consecutive ``[block_rows, k]`` blocks (zero-padded
+    tail — the pad contributes exact 0.0) and the per-block Grams
+    accumulate in f32, block 0 first.  The out-of-core Gram pass replays
+    this reduction block-for-block against staged ``HostFactorStore``
+    rows, which is what keeps the resident and host_window implicit
+    half-steps crc-identical: both run THIS program, never the
+    whole-table einsum whose reassociation XLA owns.
+    """
+    f, k = factors.shape
+    nb = max(-(-f // block_rows), 1)
+    pad = nb * block_rows - f
+    x = factors
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad, k), x.dtype)], axis=0
+        )
+    acc = jnp.zeros((k, k), jnp.float32)
+
+    def body(acc, blk):
+        return gram_block_add(acc, blk), None
+
+    acc, _ = jax.lax.scan(body, acc, x.reshape(nb, block_rows, k))
+    return acc
+
+
+def gram_block_add(acc: jax.Array, blk: jax.Array) -> jax.Array:
+    """One blocked-Gram step: ``acc + blkᵀblk`` (f32).  The single body
+    both ``global_gram_blocked`` and the windowed store reduction run —
+    per-block shapes and this op are the whole bit contract."""
+    ct, prec = _gram_compute_dtype(blk)
+    b = blk.astype(ct)
+    return acc + jnp.einsum(
+        "fk,fl->kl", b, b, preferred_element_type=jnp.float32, precision=prec
+    )
+
+
 def ials_half_step(
     fixed_factors: jax.Array,  # [F, k] (full fixed side)
     neighbor_idx: jax.Array,
@@ -227,7 +275,9 @@ def ials_half_step_bucketed(
     data, scale = quant.quantize_table(fixed_factors, table_dtype)
     view = quant.dequantize_table(data, scale)
     if gram is None:
-        gram = global_gram(view)
+        # Blocked (not whole-einsum) so the out-of-core Gram pass can
+        # replay the identical reduction — see global_gram_blocked.
+        gram = global_gram_blocked(view)
     reg_m = gram + lam * jnp.eye(k, dtype=jnp.float32)
 
     def solve_piece(ni, rt, mk):

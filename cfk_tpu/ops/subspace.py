@@ -345,11 +345,13 @@ def ials_pp_half_step_bucketed(
     the HBM gather table (``ops.quant``).
     """
     from cfk_tpu.ops import quant
-    from cfk_tpu.ops.solve import global_gram
+    from cfk_tpu.ops.solve import global_gram_blocked
 
     data, scale = quant.quantize_table(fixed, table_dtype)
     if gram is None:
-        gram = global_gram(quant.dequantize_table(data, scale))
+        # Blocked (not whole-einsum) so the out-of-core Gram pass can
+        # replay the identical reduction — see global_gram_blocked.
+        gram = global_gram_blocked(quant.dequantize_table(data, scale))
 
     def sweep_piece(xb, ni, rt, mk):
         for _ in range(sweeps):

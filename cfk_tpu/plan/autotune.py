@@ -30,6 +30,7 @@ import zlib
 from cfk_tpu.plan.cost import plan_cost
 from cfk_tpu.plan.spec import (
     PLAN_FIELDS,
+    PLAN_FIELDSET_VERSION,
     DeviceSpec,
     ExecutionPlan,
     PlanConstraints,
@@ -52,7 +53,13 @@ def cache_key(shape: ProblemShape, device: DeviceSpec,
     # decision for it, so it must read as a MISS — not silently resolve
     # the new knob to whatever from_dict would default.  crc of the
     # sorted field names: stable per schema, changes with any field add.
-    fields_tag = zlib.crc32("|".join(sorted(PLAN_FIELDS)).encode())
+    # PLAN_FIELDSET_VERSION folds in semantic changes to EXISTING fields
+    # (ISSUE 19: bucketed × host_window became resolvable) so winners
+    # tuned under the old feasible set also miss.
+    fields_tag = zlib.crc32(
+        (f"v{PLAN_FIELDSET_VERSION}|"
+         + "|".join(sorted(PLAN_FIELDS))).encode()
+    )
     key = (f"{shape.shape_class()}|{device.fingerprint()}|v{__version__}"
            f"|p{fields_tag:08x}")
     pins = (constraints or PlanConstraints()).pinned()

@@ -241,6 +241,19 @@ def train_iteration_cost(shape: ProblemShape, device: DeviceSpec,
             exposed_pcie = pcie
         terms["host_window_pcie"] = exposed_pcie
         extra += exposed_pcie
+        # Implicit out-of-core (ISSUE 19): each half-iteration also
+        # streams the fixed side's FULL table once more for the
+        # global-Gram reduction (the [k,k] accumulator's block feed) —
+        # a second pass at the staging dtype, never hidden by the hot
+        # cache (the Gram must see every row) and serial with compute
+        # today (the accumulator is a device-side dependency of every
+        # window's solve, so only the double buffer overlaps it).
+        if shape.implicit:
+            gram_pcie = ((shape.num_users + shape.num_movies)
+                         * stage_bytes_per_row / shards
+                         / device.pcie_bytes_per_s)
+            terms["host_window_gram_pcie"] = gram_pcie
+            extra += gram_pcie
 
     # Chunking overhead: each chunk pays a fixed dispatch cost (scan step
     # + DMA setup), so tiny chunks are overhead-bound; oversized chunks

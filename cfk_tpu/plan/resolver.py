@@ -69,14 +69,23 @@ def hard_conflict(shape: ProblemShape, pins: dict) -> str | None:
             return ("offload_tier='host_window' is a TRAINING tier; "
                     "serve shapes keep the item table device-resident "
                     "by construction — unpin it for a serve resolve")
-        if layout not in (None, "tiled"):
-            return (f"offload_tier='host_window' streams the tiled "
-                    f"stream-mode layout; pinned layout={layout!r}")
-        if shape.algorithm != "als" or shape.implicit:
-            return ("offload_tier='host_window' supports explicit ALS; "
-                    f"algorithm={shape.algorithm!r}"
-                    f"{' (implicit)' if shape.implicit else ''} needs the "
-                    "out-of-core global-Gram reduction (ROADMAP)")
+        if shape.implicit:
+            # Implicit out-of-core (ISSUE 19): the bucketed windowed
+            # driver runs iALS and iALS++ via the streamed global-Gram
+            # reduction + width-class windows.
+            if layout not in (None, "bucketed"):
+                return ("offload_tier='host_window' for the implicit "
+                        "family streams the bucketed width-class layout; "
+                        f"pinned layout={layout!r}")
+        else:
+            if layout not in (None, "tiled"):
+                return (f"offload_tier='host_window' streams the tiled "
+                        f"stream-mode layout; pinned layout={layout!r}")
+            if shape.algorithm != "als":
+                return ("offload_tier='host_window' supports explicit ALS "
+                        f"at layout='tiled'; algorithm="
+                        f"{shape.algorithm!r} (the explicit subspace "
+                        "windowed walk is the documented follow-up)")
         # Sharded host_window is a real executor now (ISSUE 12): the
         # windowed driver runs per-shard staged windows under the
         # all_gather scan or the ring/hier_ring visit schedules.
@@ -153,12 +162,23 @@ def _feasible(shape: ProblemShape, device: DeviceSpec, cand: dict,
     if shape.algorithm != "als" and cand["exchange"] != "all_gather":
         return "subspace optimizers are all_gather only"
     if cand["offload_tier"] == "host_window" and shape.kind == "train":
-        if layout != "tiled":
-            return "host-window offload streams the tiled stream layout"
-        if shape.algorithm != "als" or shape.implicit:
-            return ("host-window offload supports explicit ALS (the "
-                    "implicit/subspace global-Gram reductions are the "
-                    "ROADMAP follow-up)")
+        if shape.implicit:
+            # ISSUE 19: the implicit windowed driver streams the
+            # bucketed width-class layout (both iALS and iALS++ — the
+            # global-Gram reduction serves either solve).  iALS is
+            # all_gather only, and the generic exchange rules above
+            # already refuse ring exchanges at bucketed layouts.
+            if layout != "bucketed":
+                return ("implicit host-window offload streams the "
+                        "bucketed width-class layout")
+        else:
+            if layout != "tiled":
+                return ("host-window offload streams the tiled stream "
+                        "layout")
+            if shape.algorithm != "als":
+                return ("explicit host-window offload supports the full "
+                        "ALS solve (the explicit subspace windowed walk "
+                        "is the ROADMAP follow-up)")
         # Sharded host_window executes (ISSUE 12): the windowed driver
         # pairs per-shard staged windows with the all_gather scan or the
         # ring/hier_ring visit schedules; the generic exchange rules
@@ -307,11 +327,13 @@ def candidates(shape: ProblemShape, constraints: PlanConstraints,
                 # legacy default, zero extra candidates), an oversized one
                 # only host_window — so the resolver can never promise a
                 # resident table the executor's own predicate refuses.
-                # Workloads the windowed driver cannot serve (serve kind,
-                # implicit/subspace optimizers, sharded) keep the legacy
-                # resident tier regardless — the budget cannot re-route
-                # them (and a pinned 'device' there is never refused:
-                # _rank_plans' budget raise shares THIS eligibility).
+                # Workloads no windowed driver serves (serve kind, the
+                # explicit subspace optimizer) keep the legacy resident
+                # tier regardless — the budget cannot re-route them (and
+                # a pinned 'device' there is never refused: _rank_plans'
+                # budget raise shares THIS eligibility).  Implicit
+                # shapes route to the bucketed windowed driver (ISSUE
+                # 19); explicit ALS to the tiled one.
                 vals = (("host_window",)
                         if (_host_window_eligible(shape, pins)
                             and device is not None
@@ -378,9 +400,16 @@ def _host_window_eligible(shape: ProblemShape, pins: dict) -> bool:
                    in (None, "all_gather", "ring", "hier_ring"))
     if shape.num_shards == 1:
         exchange_ok = pins.get("exchange") in (None, "all_gather")
+    if shape.implicit:
+        # ISSUE 19: the implicit family's out-of-core twin is the
+        # bucketed windowed driver — iALS and iALS++ both qualify
+        # (all_gather only; IALSConfig refuses other exchanges anyway).
+        return (shape.kind == "train"
+                and shape.algorithm in ("als", "ials++")
+                and pins.get("layout") in (None, "bucketed")
+                and pins.get("exchange") in (None, "all_gather"))
     return (shape.kind == "train"
             and shape.algorithm == "als"
-            and not shape.implicit
             and pins.get("layout") in (None, "tiled")
             and exchange_ok)
 

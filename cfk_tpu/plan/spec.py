@@ -120,6 +120,15 @@ PLAN_FIELDS: dict[str, tuple] = {
     "hot_rows": (0,),
 }
 
+# Semantic version of the plan field SET (ISSUE 19).  The autotune cache
+# digests the sorted field NAMES, which rotates on any field add — but a
+# feasibility change that adds no field (bucketed × host_window becoming
+# resolvable for the implicit family) would leave stale winners readable
+# under the old semantics.  Bump this whenever the feasible set of an
+# EXISTING field changes; autotune folds it into the field-set digest so
+# every pre-change winner reads as a miss.
+PLAN_FIELDSET_VERSION = 2
+
 # Fields whose pins are free-form positive ints (the candidate tuples
 # above are only the resolver's enumeration grid for UNPINNED fields).
 _NUMERIC_FIELDS = ("chunk_elems", "serve_batch_quantum", "serve_tile_m",

@@ -143,6 +143,83 @@ def fold_in_rows(
     return np.asarray(out[:t], np.float32)
 
 
+def fold_in_rows_windowed(
+    movie_store,
+    neighbor_data,
+    *,
+    lam: float,
+    solver: str = "auto",
+    pad_multiple: int = 8,
+    reg_solve_algo: str | None = None,
+    stats: dict | None = None,
+    return_staged: bool = False,
+):
+    """Restricted fold-in against an OUT-OF-CORE movie table (ISSUE 19).
+
+    ``movie_store`` is a host-resident ``offload.store.HostFactorStore``;
+    the batch's touched movie rows stage as ONE ad-hoc window (unique
+    referenced rows gathered host-side, one ``device_put``), neighbor
+    indices rebase into the window via ``searchsorted``, and the SAME
+    ``_padded_fold`` program solves the identical pow2 rectangle — so the
+    solved rows are BIT-IDENTICAL to ``fold_in_rows`` over the full
+    device-resident table (the gather reads the same values; mask-0 cells
+    contribute exact zeros; the rectangle shape is unchanged, so the
+    batched solve bits are too).  The window row count buckets to pow2
+    (min 8) so a long-running stream converges onto the same handful of
+    compiled programs the resident path enjoys; pad slots replicate
+    window row 0 (masked out — exact zero contribution).
+
+    ``return_staged=True`` additionally returns the staged window (the
+    device array the solve read), so the caller's health probe can run
+    against the rows actually consumed — the out-of-core twin of probing
+    the resident table.  ``stats`` (a dict) receives
+    ``foldin_windows_staged`` / ``foldin_staged_bytes`` increments.
+    """
+    t = len(neighbor_data)
+    k = movie_store.rank
+    if t == 0:
+        empty = np.zeros((0, k), np.float32)
+        return (empty, None) if return_staged else empty
+    touched = (np.unique(np.concatenate(
+        [mv.astype(np.int64) for mv, _ in neighbor_data]))
+        if any(mv.shape[0] for mv, _ in neighbor_data)
+        else np.zeros((1,), np.int64))
+    if touched.size == 0:
+        touched = np.zeros((1,), np.int64)
+    w = _pow2_ceil(int(touched.size), 8)
+    rows = np.concatenate([
+        touched, np.full(w - touched.size, touched[0], np.int64)
+    ])
+    window = movie_store.gather(rows)
+    if stats is not None:
+        stats["foldin_windows_staged"] = (
+            stats.get("foldin_windows_staged", 0) + 1)
+        stats["foldin_staged_bytes"] = (
+            stats.get("foldin_staged_bytes", 0) + window.nbytes)
+    staged = jnp.asarray(window)
+    width = max(int(mv.shape[0]) for mv, _ in neighbor_data)
+    p = _pow2_ceil(max(width, 1), max(pad_multiple, 1))
+    e = _pow2_ceil(t, 8)
+    neighbor_idx = np.zeros((e, p), np.int32)
+    rating = np.zeros((e, p), np.float32)
+    mask = np.zeros((e, p), np.float32)
+    count = np.zeros((e,), np.float32)
+    for i, (mv, rt) in enumerate(neighbor_data):
+        n = mv.shape[0]
+        neighbor_idx[i, :n] = np.searchsorted(
+            touched, mv.astype(np.int64)).astype(np.int32)
+        rating[i, :n] = rt
+        mask[i, :n] = 1.0
+        count[i] = n
+    out = _padded_fold(
+        staged, jnp.asarray(neighbor_idx), jnp.asarray(rating),
+        jnp.asarray(mask), jnp.asarray(count),
+        lam=float(lam), solver=solver, reg_solve_algo=reg_solve_algo,
+    )
+    solved = np.asarray(out[:t], np.float32)
+    return (solved, staged) if return_staged else solved
+
+
 def _fold_tiled(movie_factors, neighbor_data, *, lam, solver, fused_epilogue,
                 in_kernel_gather, reg_solve_algo):
     from cfk_tpu.data.blocks import build_tiled_blocks

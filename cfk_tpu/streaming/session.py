@@ -160,6 +160,27 @@ class StreamSession:
             self.stream.foldin_layout if self.stream.foldin_layout != "auto"
             else ("tiled" if config.layout == "tiled" else "padded")
         )
+        # Out-of-core sessions (ISSUE 19): with offload_tier='host_window'
+        # the movie table lives in a host-resident ``HostFactorStore``
+        # (the user table always was host numpy) and every fold-in stages
+        # the batch's touched movie rows as one ad-hoc window
+        # (``foldin.fold_in_rows_windowed`` — bit-identical rows).  The
+        # commit protocol, sentinel ladder, and quarantine semantics below
+        # are UNCHANGED: they only ever see the solved rows and the
+        # factor arrays at commit time.
+        self._offload = (
+            getattr(config, "offload_tier", "device") == "host_window"
+        )
+        self._m_store = None
+        self._foldin_stats: dict = {}
+        if self._offload:
+            if self.stream.foldin_layout == "tiled":
+                raise ValueError(
+                    "foldin_layout='tiled' needs the device-resident "
+                    "movie table; an offload_tier='host_window' session "
+                    "stages ad-hoc windows (foldin_layout 'auto'/'padded')"
+                )
+            self._layout = "padded"
         self._overrides = Overrides(
             lam=config.lam, fused_epilogue=config.fused_epilogue,
             reg_solve_algo=(None if config.reg_solve_algo == "auto"
@@ -186,9 +207,25 @@ class StreamSession:
 
         return jnp.dtype(self.config.dtype)
 
-    def _bootstrap(self, base_model) -> None:
+    def _set_movie(self, arr) -> None:
+        """Install the fixed movie table: a device array normally, a
+        host ``HostFactorStore`` in offload mode — SAME bytes either way
+        (the store holds the config dtype verbatim), so the staged
+        fold-in windows read exactly what the resident path would."""
         import jax.numpy as jnp
 
+        if self._offload:
+            from cfk_tpu.offload.store import HostFactorStore
+
+            self._m_store = HostFactorStore.from_array(
+                np.asarray(arr), dtype=self.config.dtype
+            )
+            self._m = None
+        else:
+            self._m = jnp.asarray(np.asarray(arr),
+                                  dtype=self._factor_dtype())
+
+    def _bootstrap(self, base_model) -> None:
         if base_model is None:
             raise ValueError(
                 "no resumable stream state in the checkpoint store and no "
@@ -197,7 +234,7 @@ class StreamSession:
             )
         dt = self._factor_dtype()
         self._u = np.asarray(base_model.user_factors).astype(dt)
-        self._m = jnp.asarray(np.asarray(base_model.movie_factors), dtype=dt)
+        self._set_movie(base_model.movie_factors)
         nparts = self.transport.num_partitions(self.stream.topic)
         self.consumer = StreamConsumer(
             self.transport, topic=self.stream.topic,
@@ -210,8 +247,6 @@ class StreamSession:
         self._commit(note="bootstrap")
 
     def _try_resume(self) -> bool:
-        import jax.numpy as jnp
-
         latest = self.manager.latest_valid_iteration()
         if latest is None:
             return False
@@ -237,7 +272,7 @@ class StreamSession:
             )
         dt = self._factor_dtype()
         self._u = np.asarray(st.user_factors).astype(dt)
-        self._m = jnp.asarray(np.asarray(st.movie_factors), dtype=dt)
+        self._set_movie(st.movie_factors)
         self.stream_step = int(meta.get("stream_step", latest))
         self.quarantined = list(meta.get("quarantined", []))
         ov = meta.get("overrides")
@@ -352,14 +387,26 @@ class StreamSession:
 
     @property
     def movie_factors(self):
+        if self._offload:
+            return self._m_store.as_array()
         return self._m
 
     def model(self):
-        """Current live factors as an ``ALSModel`` (serving view)."""
+        """Current live factors as an ``ALSModel`` (serving view).  An
+        offload session returns host arrays (materializing the store is
+        the caller's choice — the session itself never holds the full
+        movie table on device)."""
         import jax.numpy as jnp
 
         from cfk_tpu.models.als import ALSModel
 
+        if self._offload:
+            return ALSModel(
+                user_factors=self._u,
+                movie_factors=self._m_store.as_array(),
+                num_users=self.state.num_users,
+                num_movies=self.state.num_movies,
+            )
         return ALSModel(
             user_factors=jnp.asarray(self._u),
             movie_factors=self._m,
@@ -391,24 +438,51 @@ class StreamSession:
             self.state.neighbors(row, pending.cell_writes.get(row))
             for row in pending.touched_rows
         ]
+        staged = None
         with self.metrics.phase("foldin_solve"), \
-                span("stream/batch/solve", touched=len(neighbor_data)):
-            rows = fold_in_rows(
-                self._m, neighbor_data,
-                lam=overrides.lam,
-                solver=self.config.solver,
-                layout=self._layout,
-                pad_multiple=self.config.pad_multiple,
-                fused_epilogue=overrides.fused_epilogue,
-                in_kernel_gather=self.config.in_kernel_gather,
-                reg_solve_algo=overrides.reg_solve_algo,
-            )
+                span("stream/batch/solve", touched=len(neighbor_data),
+                     offload=int(self._offload)):
+            if self._offload:
+                from cfk_tpu.streaming.foldin import fold_in_rows_windowed
+
+                rows, staged = fold_in_rows_windowed(
+                    self._m_store, neighbor_data,
+                    lam=overrides.lam,
+                    solver=self.config.solver,
+                    pad_multiple=self.config.pad_multiple,
+                    reg_solve_algo=overrides.reg_solve_algo,
+                    stats=self._foldin_stats,
+                    return_staged=True,
+                )
+                self.metrics.gauge(
+                    "foldin_windows_staged",
+                    self._foldin_stats.get("foldin_windows_staged", 0))
+                self.metrics.gauge(
+                    "foldin_staged_mb",
+                    round(self._foldin_stats.get(
+                        "foldin_staged_bytes", 0) / 1e6, 3))
+            else:
+                rows = fold_in_rows(
+                    self._m, neighbor_data,
+                    lam=overrides.lam,
+                    solver=self.config.solver,
+                    layout=self._layout,
+                    pad_multiple=self.config.pad_multiple,
+                    fused_epilogue=overrides.fused_epilogue,
+                    in_kernel_gather=self.config.in_kernel_gather,
+                    reg_solve_algo=overrides.reg_solve_algo,
+                )
         word = 0
         if self.health is not None and rows.shape[0]:
             with self.metrics.phase("health_check"), \
                     span("stream/batch/probe"):
+                # Offload mode probes the STAGED window — the fixed rows
+                # the solve actually read — instead of the full table the
+                # session no longer holds on device; the sentinel bitmask
+                # semantics (non-finite / norm) are unchanged.
+                m_probe = staged if self._offload else self._m
                 word = int(np.asarray(_sentinel.probe_word(
-                    jnp.asarray(rows), self._m, self.health.norm_limit
+                    jnp.asarray(rows), m_probe, self.health.norm_limit
                 )))
             self.metrics.incr("health_checks")
         return rows, word
@@ -449,6 +523,13 @@ class StreamSession:
         from cfk_tpu.streaming.foldin import _pow2_ceil, trace_count
 
         t0 = _time.time()
+        if self._offload:
+            note = ("skipped: offload fold-in programs key on the staged "
+                    "window's pow2 row bucket (data-dependent); rely on "
+                    "compile_cache_dir")
+            self.metrics.note("prewarm", note)
+            return {"programs": 0, "new_traces": 0, "prewarm_s": 0.0,
+                    "skipped": note}
         if self._layout != "padded":
             note = ("skipped: tiled fold-in block statics are "
                     "data-dependent; rely on compile_cache_dir")
@@ -539,7 +620,7 @@ class StreamSession:
                 span("stream/batch/commit", step=self.stream_step):
             save_checkpoint(
                 self.manager, self.stream_step, self._u,
-                np.asarray(self._m), meta=meta,
+                np.asarray(self.movie_factors), meta=meta,
             )
         self.metrics.incr("stream_commits")
         record_event("stream", "commit", step=self.stream_step,
@@ -782,11 +863,16 @@ class StreamSession:
         """
         import dataclasses as _dc
 
-        import jax.numpy as jnp
-
         from cfk_tpu.data.blocks import Dataset
         from cfk_tpu.models.als import train_als
 
+        if self._offload:
+            raise NotImplementedError(
+                "warm full retrain in an offload_tier='host_window' "
+                "session needs warm_start threading through the windowed "
+                "trainer (documented follow-up) — run the retrain "
+                "offline and bootstrap a fresh session from its model"
+            )
         with self.metrics.phase("retrain_build"):
             coo = self.state.to_coo()
             ds2 = Dataset.from_coo(
@@ -831,8 +917,7 @@ class StreamSession:
         u_sess = np.zeros_like(self._u)
         u_sess[: self.state.num_users] = u2[perm]
         self._u = u_sess
-        self._m = jnp.asarray(np.asarray(model.movie_factors),
-                              dtype=self._factor_dtype())
+        self._set_movie(model.movie_factors)
         self.metrics.incr("stream_retrains")
         self._commit(note=f"warm retrain at step {self.stream_step}")
         self._fire_commit({

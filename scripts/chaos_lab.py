@@ -1213,6 +1213,123 @@ def scenario_hot_cache() -> dict:
     return row
 
 
+def scenario_offload_ials() -> dict:
+    """ISSUE 19: the out-of-core iALS++ subspace driver detects and
+    recovers from staged width-class-window faults with BIT-EXACT
+    factors — and the rollback rebuilds BOTH device-resident carries,
+    the hot partition (from the restored host masters) and the
+    global-Gram accumulator (recomputed from those masters at the next
+    half's reduction; it has no snapshot because it needs none).
+
+    Two drills on a bucketed implicit dataset, both against a fault-free
+    windowed run whose crc32 must equal the RESIDENT ``train_ials``
+    run's (the windowed==resident contract for the subspace family):
+
+    1. ``nan``: a seeded ``HostWindowCorruption`` NaNs rows of one
+       staged width-class window mid-sweep at iteration 1 (no integrity
+       checking — the poison reaches the b×b subspace kernels).  The
+       factor sentinel trips, the ladder rolls the host stores back,
+       the hot partition rebuilds, the Gram reduction recomputes, and
+       the replay (one-shot fault) lands crc-identical to fault-free.
+    2. ``torn``: finite-wrong bytes in a staged window — the staging
+       checksum (``verify_windows``) catches the tear BEFORE any
+       subspace kernel consumes it; rollback + replay is crc-identical.
+
+    Both recoveries are recorded as plan transitions; the flight dump's
+    tail names the fault (``health_trip``)."""
+    import zlib
+
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.models.ials import IALSConfig, train_ials
+    from cfk_tpu.offload.windowed import train_ials_host_window
+    from cfk_tpu.plan import plan_for_config
+    from cfk_tpu.resilience.faults import (
+        HostWindowCorruption,
+        WindowFaultInjector,
+    )
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(60, 30, 900, seed=0), layout="bucketed",
+        chunk_elems=512,
+    )
+    cfg = IALSConfig(
+        rank=4, num_iterations=6, health_check_every=1, lam=0.1,
+        alpha=40.0, layout="bucketed", algorithm="ials++", block_size=2,
+    )
+    hot = 16  # pinned so the rollback's partition REBUILD is exercised
+
+    def crc(model):
+        return zlib.crc32(np.asarray(
+            model.user_factors, np.float32
+        ).tobytes())
+
+    m_base = Metrics()
+    base = train_ials_host_window(ds, cfg, chunks_per_window=2,
+                                  hot_rows=hot, metrics=m_base)
+    base_rmse, base_crc = _rmse(base, ds), crc(base)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        resident_crc = crc(train_ials(ds, cfg))
+    gram_staged = float(m_base.gauges.get("offload_gram_staged_mb", 0))
+    hot_resolved = int(m_base.gauges.get("offload_hot_rows", 0))
+
+    nnz = int(ds.movie_blocks.count.sum())
+    shape_kw = dict(num_users=ds.user_map.num_entities,
+                    num_movies=ds.movie_map.num_entities, nnz=nnz,
+                    implicit=True)
+
+    # Drill 1: NaN width-class window mid-sweep — the sentinel path plus
+    # the hot-partition + Gram-accumulator rebuild on rollback.
+    nan_fault = WindowFaultInjector(
+        HostWindowCorruption(iteration=1, side="m", window=0, kind="nan"),
+    )
+    m1 = Metrics()
+    prov1 = plan_for_config(cfg, **shape_kw)[1]
+    rec1 = train_ials_host_window(
+        ds, cfg, chunks_per_window=2, hot_rows=hot, metrics=m1,
+        window_faults=nan_fault, plan_provenance=prov1,
+        verify_windows=False,
+    )
+    # Drill 2: torn window — the staging-checksum path.
+    torn_fault = WindowFaultInjector(
+        HostWindowCorruption(iteration=2, side="u", window=0,
+                             kind="torn"),
+    )
+    m2 = Metrics()
+    prov2 = plan_for_config(cfg, **shape_kw)[1]
+    rec2 = train_ials_host_window(
+        ds, cfg, chunks_per_window=2, hot_rows=hot, metrics=m2,
+        window_faults=torn_fault, plan_provenance=prov2,
+    )
+
+    crc1, crc2 = crc(rec1), crc(rec2)
+    transitions = bool(prov1.transitions) and bool(prov2.transitions)
+    torn_detected = m2.counters.get("health_trips", 0) >= 1
+    for k_, v in m2.counters.items():
+        m1.counters[k_] = m1.counters.get(k_, 0) + v
+    m1.notes.update({f"torn_{k_}": v for k_, v in m2.notes.items()})
+    row = _row(
+        "offload_ials",
+        fired=nan_fault.fired + torn_fault.fired,
+        metrics=m1, base_rmse=base_rmse, rec_rmse=_rmse(rec1, ds),
+        ok_extra=(
+            base_crc == resident_crc
+            and crc1 == base_crc and crc2 == base_crc
+            and transitions and torn_detected
+            and gram_staged > 0 and hot_resolved > 0
+        ),
+    )
+    row["windowed_equals_resident"] = bool(base_crc == resident_crc)
+    row["nan_bit_exact"] = bool(crc1 == base_crc)
+    row["torn_bit_exact"] = bool(crc2 == base_crc)
+    row["transitions_recorded"] = transitions
+    row["gram_staged_mb"] = gram_staged
+    row["hot_rows_resolved"] = hot_resolved
+    return row
+
+
 def scenario_staging_pool() -> dict:
     """ISSUE 13: faults INSIDE the pooled host staging engine.
 
@@ -1837,6 +1954,7 @@ SCENARIOS = {
     "offload_window_sharded": scenario_offload_window_sharded,
     "staging_pool": scenario_staging_pool,
     "hot_cache": scenario_hot_cache,
+    "offload_ials": scenario_offload_ials,
     "telemetry_overhead": scenario_telemetry_overhead,
 }
 
@@ -1872,6 +1990,7 @@ FLIGHT_EXPECT = {
     "offload_window_sharded": ("health_trip",),
     "staging_pool": ("health_trip", "staging_error"),
     "hot_cache": ("hot_cache_corruption", "health_trip"),
+    "offload_ials": ("health_trip",),
     "telemetry_overhead": ("telemetry_overhead",),
 }
 

@@ -204,6 +204,18 @@ def make_parser() -> argparse.ArgumentParser:
                    "resident bit-exactness")
     p.add_argument("--offload-window-chunks", type=int, default=4,
                    help="chunks per staged window on the host_window tier")
+    p.add_argument("--optimizer", default="als",
+                   choices=["als", "ials", "ialspp"],
+                   help="optimizer of the --offload axis (ISSUE 19): "
+                   "'als' runs the explicit trainer on the stream-forced "
+                   "tiled layout (the original axis); 'ials'/'ialspp' run "
+                   "the implicit family on the bucketed width-class "
+                   "layout (--layout bucketed) — the host_window arm "
+                   "streams width-class windows through the out-of-core "
+                   "subspace driver with the global-Gram reduction, and "
+                   "crc equality against the resident arm is the "
+                   "windowed == resident bit-exactness proof for the "
+                   "implicit optimizers")
     p.add_argument("--offload-shards", type=int, default=1,
                    help="shard count of the --offload axis (ISSUE 12): "
                    "the host_window arm runs the sharded windowed "
@@ -555,48 +567,94 @@ def run_offload_lab(args) -> dict:
     in where the factor tables live.  Each row carries the final factors'
     crc32: the tier-1 smoke (``test_offload_axis_row``) runs both values
     and pins crc equality — the in-memory proof of the windowed ==
-    resident bit-exactness contract."""
+    resident bit-exactness contract.
+
+    ``--optimizer ials/ialspp`` (ISSUE 19) swaps in the implicit family
+    on the bucketed width-class layout: the host_window arm runs the
+    out-of-core subspace driver (width-class windows + the global-Gram
+    reduction over the staged table) and the same crc contract holds
+    against the resident ``train_ials`` arm
+    (``test_offload_axis_optimizer_row``)."""
     import zlib
 
     from cfk_tpu.config import ALSConfig
     from cfk_tpu.data.blocks import Dataset
     from cfk_tpu.data.synth import synth_coo
     from cfk_tpu.models.als import train_als
-    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.offload.windowed import (
+        train_als_host_window,
+        train_ials_host_window,
+    )
     from cfk_tpu.utils.metrics import Metrics
     from cfk_tpu.utils.roofline import als_iteration_cost, roofline_row
 
-    if args.layout != "tiled":
+    optimizer = getattr(args, "optimizer", "als") or "als"
+    implicit = optimizer in ("ials", "ialspp")
+    if implicit:
+        if args.layout != "bucketed":
+            raise SystemExit(
+                "--offload with --optimizer ials/ialspp runs the bucketed "
+                "width-class layout; pass --layout bucketed"
+            )
+    elif args.layout != "tiled":
         raise SystemExit(
             "--offload runs the stream-forced tiled layout; pass "
             "--layout tiled"
         )
     shards = max(int(getattr(args, "offload_shards", 1) or 1), 1)
     coo = synth_coo(args.users, args.movies, args.nnz, seed=args.seed)
-    ds = Dataset.from_coo(
-        coo, num_shards=shards, layout="tiled",
-        chunk_elems=args.chunk_elems,
-        tile_rows=args.tile_rows, accum_max_entities=0,
-    )
-    cfg = ALSConfig(
-        rank=args.rank, lam=0.05, num_iterations=args.iters, seed=0,
-        layout="tiled", num_shards=shards, dtype=args.dtype,
-        table_dtype=args.table_dtype,
-        solver=args.solver, overlap=args.overlap == "on",
-        fused_epilogue=None if args.fused == "on" else False,
-        in_kernel_gather=None if args.gather == "fused" else False,
-        hbm_chunk_elems=args.chunk_elems,
-        # Pin the axis value into the config so the device arm cannot
-        # silently re-plan onto host_window (the same mislabeling guard
-        # as bench.py's scale sweep).
-        offload_tier=args.offload,
-        compile_cache_dir=args.compile_cache_dir,
-    )
+    if implicit:
+        from cfk_tpu.models.ials import IALSConfig, train_ials
+
+        ds = Dataset.from_coo(
+            coo, num_shards=shards, layout="bucketed",
+            chunk_elems=args.chunk_elems,
+        )
+        block_size = max(b for b in (32, 16, 8, 4, 2, 1)
+                         if args.rank % b == 0)
+        cfg = IALSConfig(
+            rank=args.rank, lam=0.1, alpha=args.alpha,
+            num_iterations=args.iters, seed=0,
+            layout="bucketed", num_shards=shards, dtype=args.dtype,
+            table_dtype=args.table_dtype, solver=args.solver,
+            overlap=args.overlap == "on",
+            fused_epilogue=None if args.fused == "on" else False,
+            in_kernel_gather=None if args.gather == "fused" else False,
+            algorithm="ials++" if optimizer == "ialspp" else "als",
+            block_size=block_size,
+            offload_tier=args.offload,
+            compile_cache_dir=args.compile_cache_dir,
+        )
+    else:
+        ds = Dataset.from_coo(
+            coo, num_shards=shards, layout="tiled",
+            chunk_elems=args.chunk_elems,
+            tile_rows=args.tile_rows, accum_max_entities=0,
+        )
+        cfg = ALSConfig(
+            rank=args.rank, lam=0.05, num_iterations=args.iters, seed=0,
+            layout="tiled", num_shards=shards, dtype=args.dtype,
+            table_dtype=args.table_dtype,
+            solver=args.solver, overlap=args.overlap == "on",
+            fused_epilogue=None if args.fused == "on" else False,
+            in_kernel_gather=None if args.gather == "fused" else False,
+            hbm_chunk_elems=args.chunk_elems,
+            # Pin the axis value into the config so the device arm cannot
+            # silently re-plan onto host_window (the same mislabeling
+            # guard as bench.py's scale sweep).
+            offload_tier=args.offload,
+            compile_cache_dir=args.compile_cache_dir,
+        )
     metrics = Metrics()
     budget = (args.offload_budget_mb * 1e6
               if args.offload_budget_mb is not None else None)
     mesh = None
     if shards > 1 and args.offload != "host_window":
+        if implicit:
+            raise SystemExit(
+                "--optimizer ials/ialspp resident arm is single-shard; "
+                "the host_window arm shards without a mesh"
+            )
         # The resident arm of a sharded A/B runs the real shard_map
         # trainer — that is the bit-exactness reference the smoke pins.
         import jax as _jax
@@ -615,7 +673,9 @@ def run_offload_lab(args) -> dict:
     def run(cfg_n=None):
         c = cfg if cfg_n is None else cfg_n
         if args.offload == "host_window":
-            return train_als_host_window(
+            train_hw = (train_ials_host_window if implicit
+                        else train_als_host_window)
+            return train_hw(
                 ds, c, metrics=metrics,
                 chunks_per_window=args.offload_window_chunks,
                 device_budget_bytes=budget,
@@ -623,6 +683,8 @@ def run_offload_lab(args) -> dict:
                 pool_depth=args.staging_pool_depth,
                 hot_rows=args.hot_rows,
             )
+        if implicit:
+            return train_ials(ds, c)
         if shards > 1:
             from cfk_tpu.parallel.spmd import train_als_sharded
 
@@ -670,9 +732,12 @@ def run_offload_lab(args) -> dict:
         args.nnz, args.users, args.movies, args.rank,
         factor_bytes=2 if args.dtype == "bfloat16" else 4,
         table_dtype=args.table_dtype,
+        implicit=implicit,
+        sweeps=cfg.sweeps if optimizer == "ialspp" else 1,
     )
     row = {
         "offload": args.offload,
+        "optimizer": optimizer,
         "offload_shards": shards,
         "s_per_iter_min": round(best, 4),
         "s_per_iter_median": round(sorted(per_iter)[len(per_iter) // 2], 4),
@@ -734,6 +799,14 @@ def run_offload_lab(args) -> dict:
             "staged_rows_local": metrics.gauges.get("offload_rows_local"),
             "staged_rows_ici": metrics.gauges.get("offload_rows_ici"),
             "staged_rows_dcn": metrics.gauges.get("offload_rows_dcn"),
+            # Implicit-family columns (ISSUE 19): the global-Gram
+            # reduction's own staging meter + its budget reservation.
+            "gram_staged_mb_per_run": metrics.gauges.get(
+                "offload_gram_staged_mb"
+            ),
+            "gram_reserved_mb": metrics.gauges.get(
+                "offload_gram_reserved_mb"
+            ),
         })
     print(json.dumps(row))
     return row

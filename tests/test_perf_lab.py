@@ -31,7 +31,7 @@ def _args(**over):
         serve="off", serve_batch=64, serve_k=10, serve_requests=512,
         serve_tile_m=512, serve_mode="exact", serve_clusters=0,
         offload=None, offload_window_chunks=4, offload_budget_mb=None,
-        offload_shards=1,
+        offload_shards=1, optimizer="als",
         staging=None, staging_pool_depth=None, compile_cache_dir=None,
         hot_rows=None,
         plan=None, plan_cache=None,
@@ -248,6 +248,37 @@ def test_offload_axis_row(tmp_path, monkeypatch, capsys):
     assert win["staged_cold_mb_per_run"] > 0
     assert win["plan_held_mb"] > 0
     # windowed == resident, bit-exact — the ISSUE 11 acceptance contract
+    assert win["factors_crc32"] == dev["factors_crc32"]
+
+
+def test_offload_axis_optimizer_row(tmp_path, monkeypatch):
+    # The --optimizer axis (ISSUE 19), mirroring test_offload_axis_row
+    # for the implicit family: iALS++ on the bucketed width-class layout,
+    # resident vs host_window through the out-of-core subspace driver
+    # (width-class windows + global-Gram reduction) — crc equality is the
+    # windowed == resident bit-exactness proof for the subspace sweeps,
+    # and the windowed row carries the Gram reduction's own meters.
+    # (iALS++ only, repeats=1: the plain-ials windowed == resident pair
+    # lives in tests/test_offload_ials.py — duplicating it here pushed
+    # the tier-1 suite past its wall-clock budget.)
+    monkeypatch.setattr(perf_lab, "CACHE_ROOT", str(tmp_path))
+    base = dict(layout="bucketed", users=120, movies=40, nnz=900,
+                chunk_elems=512, rank=4, iters=2, repeats=1,
+                optimizer="ialspp")
+    dev = perf_lab.run_lab(_args(offload="device", **base))
+    assert dev["offload"] == "device"
+    assert dev["optimizer"] == "ialspp"
+    assert dev["factors_crc32"] > 0
+
+    win = perf_lab.run_lab(_args(offload="host_window",
+                                 offload_window_chunks=2, **base))
+    assert win["offload"] == "host_window"
+    assert win["optimizer"] == "ialspp"
+    assert win["windows_m"] >= 1 and win["windows_u"] >= 1
+    assert win["staged_mb_per_run"] > 0
+    assert win["gram_staged_mb_per_run"] > 0
+    assert win["gram_reserved_mb"] > 0
+    # windowed == resident, bit-exact — the ISSUE 19 acceptance contract
     assert win["factors_crc32"] == dev["factors_crc32"]
 
 
