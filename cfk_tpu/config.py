@@ -446,6 +446,27 @@ class ALSConfig:
     # unchanged (tracing is jax-side — the cache removes the XLA compile
     # behind each trace).
     compile_cache_dir: str | None = None
+    # --- elastic fleet membership (ISSUE 20) ----------------------------
+    # Multi-process host_window training survives a dead peer live: a
+    # collective failure triggers the shrink protocol (min-agree the
+    # covered step, repartition ownership, reload the orphan slice,
+    # continue) instead of the bounded exit, and a restarted host can
+    # rejoin at an iteration boundary.  None = AUTO: elastic when a
+    # fleet-manifests directory is available (the protocol needs the
+    # per-host manifests to agree and reload), off otherwise.
+    fleet_elastic: bool | None = None
+    # Transient-vs-fatal peer classification: a fleet collective that
+    # fails with a retryable error (slow GC pause, dropped packet) is
+    # retried with backoff+jitter up to fleet_retry_attempts times
+    # before the peer is declared dead and the shrink fires.
+    fleet_retry_attempts: int = 2
+    fleet_retry_base_s: float = 0.05
+    fleet_retry_max_delay_s: float = 1.0
+    # A collective that HANGS (no error) is declared dead after this
+    # many seconds — SIGKILL'd Gloo peers sometimes hang the survivor
+    # rather than erroring.  None disables the timeout (the
+    # StallWatchdog remains the outer backstop).
+    fleet_collective_timeout_s: float | None = None
 
     def _valid_algorithms(self) -> tuple[str, ...]:
         return ("als", "als++")
@@ -613,6 +634,28 @@ class ALSConfig:
         if self.max_recoveries < 0:
             raise ValueError(
                 f"max_recoveries must be >= 0, got {self.max_recoveries}"
+            )
+        if self.fleet_retry_attempts < 0:
+            raise ValueError(
+                f"fleet_retry_attempts must be >= 0 (retries before a "
+                f"peer is declared dead), got {self.fleet_retry_attempts}"
+            )
+        if self.fleet_retry_base_s <= 0:
+            raise ValueError(
+                f"fleet_retry_base_s must be > 0, got "
+                f"{self.fleet_retry_base_s}"
+            )
+        if self.fleet_retry_max_delay_s < self.fleet_retry_base_s:
+            raise ValueError(
+                f"fleet_retry_max_delay_s must be >= fleet_retry_base_s, "
+                f"got {self.fleet_retry_max_delay_s} < "
+                f"{self.fleet_retry_base_s}"
+            )
+        if (self.fleet_collective_timeout_s is not None
+                and self.fleet_collective_timeout_s <= 0):
+            raise ValueError(
+                f"fleet_collective_timeout_s must be > 0 (or None to "
+                f"disable), got {self.fleet_collective_timeout_s}"
             )
         if self.lam_escalation <= 1:
             raise ValueError(

@@ -1,0 +1,917 @@
+"""Elastic fleet membership for the multi-process out-of-core tier.
+
+PR 16's fleet contract is stop-the-world: a dead peer turns every
+survivor's next collective into an error (or a hang), and the drills
+answer with ``os._exit(STALL_EXIT_CODE)`` — correct, but the whole fleet
+pays a full restart for one evicted host.  This module is the live
+alternative: classification, agreement, and membership-change machinery
+that lets ``train_als_host_window`` *shrink* around a dead peer and
+*readmit* it when it comes back, instead of dying.
+
+Layers (bottom up):
+
+- **Errors** — the protocol vocabulary.  ``PeerDeadError`` is what the
+  driver catches to trigger a shrink; ``StaleEpochError`` is what a
+  zombie (a frame from a host's previous life) receives; the rest name
+  the refusal reasons.
+- **``RetryPolicy`` + ``ElasticFleet``** — transient-vs-fatal peer
+  classification.  Wraps any fleet (``GlooFleet``, ``LocalFleet``, a
+  ``ThreadFleet``) and retries *transient* collective failures with
+  backoff+jitter (``resilience/retry.py``'s schedule) before declaring
+  the peer dead; a fatal error type or retry exhaustion raises
+  ``PeerDeadError``.  An optional collective timeout catches the hang
+  case (a SIGKILL'd Gloo peer sometimes hangs the survivor instead of
+  erroring).
+- **``FleetManifests``** — per-host checkpoint manifests on shared
+  storage (``<dir>/host_<pid>/step_*/manifest.json``).  Each save
+  records the writer's fleet epoch and owned row ranges, so survivors
+  can (a) min-agree the last step whose manifests jointly cover every
+  factor row and (b) reload a dead host's orphaned slice from exactly
+  those committed bytes.
+- **``Rendezvous`` / ``ThreadFleet``** — an in-process fleet fabric
+  (threads + a condition variable) that supports what jax 0.4.37's Gloo
+  runtime cannot: membership change and rejoin mid-run.  The REAL
+  driver runs on it unmodified via ``train_als_host_window(fleet=...)``,
+  which is how the general P→P′ shrink and the rejoin handshake are
+  tested without a reformable collective runtime.  Epoch fencing lives
+  here: every membership change bumps the epoch, and frames tagged with
+  an older epoch from a declared-dead pid raise ``StaleEpochError`` at
+  the *sender*.
+
+Under real Gloo the supported live-shrink is 2→1 (the survivor needs no
+further collectives, so the un-reformable runtime is simply abandoned);
+wider fleets fall back to the bounded-exit path.  That boundary is
+documented in ARCHITECTURE.md ("what still requires restart").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+
+import numpy as np
+
+from cfk_tpu.resilience.retry import backoff_delays
+from cfk_tpu.telemetry.recorder import record_event
+
+
+# --------------------------------------------------------------------------
+# Protocol errors
+# --------------------------------------------------------------------------
+
+
+class TransientFleetError(RuntimeError):
+    """A collective failure worth retrying (injected by tests; real
+    transports map their retryable failures here or to ``OSError``)."""
+
+
+class PeerDeadError(RuntimeError):
+    """A peer is gone for good: retries exhausted, a fatal transport
+    error, or a collective timeout.  ``peers`` names the dead original
+    pids when the transport knows them (may be empty)."""
+
+    def __init__(self, msg: str, *, peers: tuple = ()) -> None:
+        super().__init__(msg)
+        self.peers = tuple(peers)
+
+
+class StaleEpochError(RuntimeError):
+    """A frame from a previous fleet life: the sender was declared dead
+    and the epoch has moved on.  Raised at the *sender* — the zombie
+    learns it must rejoin, the survivors never see the frame."""
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective did not complete within ``collective_timeout_s`` —
+    the hang flavor of a dead peer (SIGKILL'd Gloo peers sometimes hang
+    the survivor instead of erroring)."""
+
+
+class ShrinkInfeasibleError(RuntimeError):
+    """The surviving fleet cannot continue live (shard count not
+    divisible, no covering checkpoint, >1 survivor on a Gloo fleet);
+    callers fall back to the bounded-exit path."""
+
+
+class RejoinRefusedError(RuntimeError):
+    """The fleet declined a rejoin request (health gate failed, shape
+    mismatch, no covering step)."""
+
+
+class SimulatedHostLoss(BaseException):
+    """Raised inside a ThreadFleet 'host' to simulate SIGKILL.  Derives
+    from BaseException so no ``except Exception`` recovery path in the
+    driver can accidentally swallow the simulated death."""
+
+
+# --------------------------------------------------------------------------
+# Transient-vs-fatal classification
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Bounded backoff+jitter schedule for fleet collectives.
+
+    ``attempts`` is the number of *retries* after the first failure;
+    ``seed`` makes the jitter deterministic (tests pin the schedule).
+    ``sleep`` is injectable so tests assert delays without waiting."""
+
+    attempts: int = 2
+    base: float = 0.05
+    factor: float = 2.0
+    max_delay: float = 1.0
+    jitter: float = 0.5
+    seed: int | None = None
+    sleep = staticmethod(time.sleep)
+
+    def delays(self):
+        rng = None if self.seed is None else random.Random(self.seed)
+        return backoff_delays(base=self.base, factor=self.factor,
+                              max_delay=self.max_delay, jitter=self.jitter,
+                              rng=rng)
+
+
+class ElasticFleet:
+    """A fleet wrapper that classifies collective failures.
+
+    Transient errors (``TransientFleetError``, ``OSError`` by default)
+    are retried per ``retry``; exhaustion or any other exception declares
+    the peer dead (``PeerDeadError``).  With ``collective_timeout_s``
+    set, a collective is run on a daemon thread and a timeout is treated
+    as a dead peer too — the only way to catch the hang flavor of host
+    loss without a reformable runtime.  Membership operations
+    (``shrink_to``, ``poll_joiners``/``admit``/``refuse_join``,
+    ``join``) delegate to the base fleet when it supports them; for a
+    plain Gloo fleet, ``shrink_to`` supports exactly the 2→1 case by
+    returning ``None`` (the driver drops to single-host mode and never
+    touches the broken runtime again).
+    """
+
+    def __init__(self, base, *, retry: RetryPolicy | None = None,
+                 collective_timeout_s: float | None = None,
+                 metrics=None,
+                 transient_types: tuple = (TransientFleetError, OSError)):
+        self.base = base
+        self.retry = retry or RetryPolicy()
+        self.collective_timeout_s = collective_timeout_s
+        self.metrics = metrics
+        self.transient_types = transient_types
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def num_processes(self) -> int:
+        return self.base.num_processes
+
+    @property
+    def process(self) -> int:
+        return self.base.process
+
+    @property
+    def alive(self) -> tuple:
+        return getattr(self.base, "alive",
+                       tuple(range(self.base.num_processes)))
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.base, "epoch", 0)
+
+    @property
+    def is_joiner(self) -> bool:
+        return getattr(self.base, "is_joiner", False)
+
+    @property
+    def supports_join(self) -> bool:
+        return getattr(self.base, "supports_join", False)
+
+    @property
+    def orig_process(self) -> int:
+        # Original (pre-shrink) pid — stable across membership changes,
+        # unlike ``process`` which is the rank within the current fleet.
+        return getattr(self.base, "orig_process", self.base.process)
+
+    # -- classification core ----------------------------------------------
+
+    def _declare_dead(self, cause: BaseException) -> "PeerDeadError":
+        peers = getattr(cause, "peers", ())
+        if not peers and self.num_processes == 2:
+            # Two-host fleet: the dead peer can only be the other one.
+            peers = tuple(p for p in self.alive if p != self.process)
+        record_event("fault", "fleet_peer_declared_dead",
+                     process=self.process, peers=list(peers),
+                     error=f"{type(cause).__name__}: {cause}")
+        if self.metrics is not None:
+            self.metrics.incr("fleet_peers_declared_dead")
+        err = PeerDeadError(
+            f"fleet peer declared dead after collective failure: "
+            f"{type(cause).__name__}: {cause}", peers=peers)
+        err.__cause__ = cause
+        return err
+
+    def _run_with_timeout(self, fn):
+        box: dict = {}
+        done = threading.Event()
+
+        def _worker():
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 - reported below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_worker, daemon=True,
+                             name="cfk-fleet-collective")
+        t.start()
+        if not done.wait(self.collective_timeout_s):
+            # The thread is abandoned (nothing can cancel a hung Gloo
+            # collective); post-shrink the survivor never runs another
+            # collective, so the zombie thread is harmless.
+            raise CollectiveTimeoutError(
+                f"fleet collective did not complete within "
+                f"{self.collective_timeout_s:.1f}s"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box.get("value")
+
+    def _call(self, fn, describe: str):
+        if isinstance(self.base, ElasticFleet):  # avoid double-wrapping
+            return fn()
+        delays = self.retry.delays()
+        attempt = 0
+        while True:
+            try:
+                if self.collective_timeout_s is not None:
+                    return self._run_with_timeout(fn)
+                return fn()
+            except (PeerDeadError, StaleEpochError):
+                raise  # already classified by the base fleet
+            except self.transient_types as e:
+                attempt += 1
+                if attempt > self.retry.attempts:
+                    raise self._declare_dead(e) from e
+                record_event("retry", "fleet_transient_retry", op=describe,
+                             attempt=attempt,
+                             error=f"{type(e).__name__}: {e}")
+                if self.metrics is not None:
+                    self.metrics.incr("fleet_transient_retries")
+                self.retry.sleep(next(delays))
+            except BaseException as e:
+                if isinstance(e, SimulatedHostLoss):
+                    raise  # this host "died" — never classify our own death
+                raise self._declare_dead(e) from e
+
+    # -- collectives -------------------------------------------------------
+
+    def allgather_bytes(self, payload: np.ndarray) -> np.ndarray:
+        return self._call(lambda: self.base.allgather_bytes(payload),
+                          "allgather_bytes")
+
+    def allgather_i32(self, values) -> np.ndarray:
+        return self._call(lambda: self.base.allgather_i32(values),
+                          "allgather_i32")
+
+    # -- membership --------------------------------------------------------
+
+    def surviving(self, exc: PeerDeadError) -> list[int]:
+        """Original pids still alive after ``exc``.  Prefers the base
+        fleet's own view (a Rendezvous knows), falls back to the error's
+        ``peers``, then to "just me" for a 2-host fleet."""
+        base_fn = getattr(self.base, "surviving", None)
+        if base_fn is not None:
+            return list(base_fn())
+        if exc.peers:
+            return [p for p in self.alive if p not in exc.peers]
+        if self.num_processes == 2:
+            return [self.process]
+        raise ShrinkInfeasibleError(
+            "cannot identify survivors: the transport reported no dead "
+            "peers and the fleet has more than two hosts"
+        )
+
+    def shrink_to(self, alive: list[int]):
+        """Reform the fleet around ``alive``; returns the new fleet
+        handle, or ``None`` when the survivor continues single-host."""
+        base_fn = getattr(self.base, "shrink_to", None)
+        if base_fn is not None:
+            new_base = base_fn(list(alive))
+            if new_base is None:
+                return None
+            if new_base is self.base:
+                # The base reformed in place — keep this wrapper (and its
+                # classification/retry state) bound to it.
+                return self
+            return ElasticFleet(
+                new_base, retry=self.retry,
+                collective_timeout_s=self.collective_timeout_s,
+                metrics=self.metrics,
+                transient_types=self.transient_types,
+            )
+        if len(alive) == 1:
+            # Gloo 2→1: the lone survivor needs no further collectives,
+            # so the dead runtime is simply never touched again.
+            return None
+        raise ShrinkInfeasibleError(
+            f"this fleet transport cannot reform around {len(alive)} "
+            "survivors (jax's Gloo runtime is fixed at init); only the "
+            "2-host → 1-survivor shrink is live, wider fleets restart"
+        )
+
+    def join(self, info: dict) -> dict:
+        return self.base.join(info)
+
+    def poll_joiners(self) -> list:
+        fn = getattr(self.base, "poll_joiners", None)
+        return [] if fn is None else fn()
+
+    def refuse_join(self, pid: int, reason: str) -> None:
+        self.base.refuse_join(pid, reason)
+
+    def admit(self, pid: int, new_epoch: int, new_alive: list[int],
+              step: int) -> None:
+        self.base.admit(self.process, pid, new_epoch, new_alive, step)
+
+
+# --------------------------------------------------------------------------
+# Per-host manifests: agreement + orphan-slice reload
+# --------------------------------------------------------------------------
+
+
+class FleetManifests:
+    """The fleet's shared-storage checkpoint layout:
+    ``<base>/host_<pid>/step_*/...``, one ``CheckpointManager`` per host.
+
+    Every save records the writer's fleet epoch and owned row ranges in
+    the step manifest, which makes two things pure filesystem reads:
+    agreeing on the last *jointly covered* step (no collectives needed —
+    crucial when the runtime that would carry ``agree_min_i32`` is the
+    thing that just died), and reassembling any row range of either
+    factor table from committed bytes (the orphan-slice reload)."""
+
+    def __init__(self, base_dir: str) -> None:
+        import os
+
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self._managers: dict[int, object] = {}
+
+    def host_dir(self, pid: int) -> str:
+        import os
+
+        return os.path.join(self.base_dir, f"host_{pid}")
+
+    def manager_for(self, pid: int):
+        from cfk_tpu.transport.checkpoint import CheckpointManager
+
+        if pid not in self._managers:
+            self._managers[pid] = CheckpointManager(self.host_dir(pid))
+        return self._managers[pid]
+
+    def reachable(self) -> list[int]:
+        """Pids with at least one committed step on shared storage."""
+        import os
+        import re
+
+        pids = []
+        for name in sorted(os.listdir(self.base_dir)):
+            m = re.fullmatch(r"host_(\d+)", name)
+            if m and self.manager_for(int(m.group(1))).iterations():
+                pids.append(int(m.group(1)))
+        return pids
+
+    def latest_coverage_step(self, rows_u: int, rows_m: int) -> int | None:
+        """Newest step whose per-host manifests jointly cover every row
+        of both factor tables — the min-agree over manifests.  A host
+        that died before committing a step simply leaves a hole; the
+        search walks older steps until coverage closes (or returns
+        ``None``: no step is jointly restorable)."""
+        pids = self.reachable()
+        if not pids:
+            return None
+        steps: set[int] = set()
+        for pid in pids:
+            steps.update(self.manager_for(pid).iterations())
+        for step in sorted(steps, reverse=True):
+            if (self._covered(step, pids, "u", rows_u)
+                    and self._covered(step, pids, "m", rows_m)):
+                return step
+        return None
+
+    def _metas(self, step: int, pids) -> list[tuple[int, dict]]:
+        out = []
+        for pid in pids:
+            mgr = self.manager_for(pid)
+            if step not in mgr.iterations():
+                continue
+            try:
+                out.append((pid, mgr.manifest_meta(step)))
+            except Exception:
+                continue  # torn step on one host: treat as a hole
+        return out
+
+    def _covered(self, step: int, pids, side: str, rows: int) -> bool:
+        spans = []
+        for _, meta in self._metas(step, pids):
+            lo, hi = meta.get(f"{side}_row_lo"), meta.get(f"{side}_row_hi")
+            if lo is None or hi is None:
+                # Pre-elastic manifest: the writer held the full table.
+                lo, hi = 0, rows
+            spans.append((int(lo), int(hi)))
+        spans.sort()
+        pos = 0
+        for lo, hi in spans:
+            if lo > pos:
+                return False
+            pos = max(pos, hi)
+        return pos >= rows
+
+    def load_rows(self, step: int, lo: int, hi: int, side: str, *,
+                  rank: int) -> np.ndarray:
+        """Reassemble rows ``[lo, hi)`` of factor table ``side`` ("u" or
+        "m") at ``step`` from committed per-host bytes.  When ranges
+        overlap across hosts (a host's range moved between epochs), the
+        higher ``fleet_epoch`` wins — later lives overwrite earlier
+        ones.  Raises ``ShrinkInfeasibleError`` on any uncovered row."""
+        out = np.zeros((hi - lo, rank), np.float32)
+        covered = np.zeros(hi - lo, bool)
+        metas = self._metas(step, self.reachable())
+        metas.sort(key=lambda pm: int(pm[1].get("fleet_epoch", 0)))
+        for pid, meta in metas:
+            h_lo = meta.get(f"{side}_row_lo")
+            h_hi = meta.get(f"{side}_row_hi")
+            if h_lo is None or h_hi is None:
+                h_lo, h_hi = 0, None  # full table
+            a, b = max(lo, int(h_lo)), hi if h_hi is None else min(hi, int(h_hi))
+            if a >= b:
+                continue
+            state = self.manager_for(pid).restore(step)
+            table = state.user_factors if side == "u" else state.movie_factors
+            if h_hi is None:
+                h_hi = table.shape[0]
+                b = min(hi, h_hi)
+                if a >= b:
+                    continue
+            out[a - lo:b - lo] = np.asarray(
+                table[a - int(h_lo):b - int(h_lo)], np.float32
+            )
+            covered[a - lo:b - lo] = True
+        if not covered.all():
+            holes = int((~covered).sum())
+            raise ShrinkInfeasibleError(
+                f"orphan-slice reload of {side}[{lo}:{hi}) at step {step} "
+                f"has {holes} uncovered rows — no committed manifest holds "
+                "them; the covering-step search should have rejected this "
+                "step"
+            )
+        return out
+
+
+# --------------------------------------------------------------------------
+# In-process rendezvous fabric: membership change + epoch fencing
+# --------------------------------------------------------------------------
+
+
+class Rendezvous:
+    """The in-process fleet fabric: N threads rendezvous per collective,
+    with live membership (``mark_dead``/``begin_epoch``), epoch fencing
+    (stale frames from a dead pid's previous life raise
+    ``StaleEpochError`` at the sender), and a join handshake
+    (``request_join`` blocks until the fleet ``admit``s or refuses).
+
+    This is what lets the REAL ``train_als_host_window`` exercise the
+    general shrink and the rejoin protocol in one process — jax's Gloo
+    runtime can't reform, threads can."""
+
+    def __init__(self, num_processes: int, *, timeout_s: float = 60.0):
+        self.timeout_s = timeout_s
+        self._cv = threading.Condition()
+        self.epoch = 0
+        self.alive: tuple = tuple(range(num_processes))
+        self.dead: set = set()
+        self.stale_rejected = 0
+        self._slots: dict = {}
+        self._join_requests: dict = {}
+        self._admissions: dict = {}
+        self._refusals: dict = {}
+        self._admit_acks: dict = {}
+
+    # -- collectives -------------------------------------------------------
+
+    def contribute(self, pid: int, epoch: int, seq: int,
+                   payload: np.ndarray) -> list:
+        """One host's contribution to collective ``(epoch, seq)``.
+        Blocks until every live member has contributed; returns payloads
+        ordered by sorted pid.  Entry checks fence the three failure
+        shapes: a zombie (declared-dead pid) gets ``StaleEpochError``, a
+        lagging survivor (old epoch but still alive) gets
+        ``PeerDeadError`` so it runs its own shrink, and any other
+        epoch/membership mismatch is stale."""
+        with self._cv:
+            while True:
+                if pid in self.dead:
+                    self.stale_rejected += 1
+                    record_event("fault", "stale_epoch_rejected", pid=pid,
+                                 frame_epoch=epoch, fleet_epoch=self.epoch,
+                                 seq=seq)
+                    raise StaleEpochError(
+                        f"frame from pid {pid} epoch {epoch} rejected: the "
+                        f"fleet is at epoch {self.epoch} and pid {pid} was "
+                        "declared dead — rejoin to continue"
+                    )
+                if epoch < self.epoch and pid in self.alive:
+                    raise PeerDeadError(
+                        f"pid {pid} is at epoch {epoch} but the fleet moved "
+                        f"to {self.epoch}: a peer died while this host was "
+                        "mid-collective", peers=tuple(sorted(self.dead)))
+                if epoch != self.epoch or pid not in self.alive:
+                    self.stale_rejected += 1
+                    record_event("fault", "stale_epoch_rejected", pid=pid,
+                                 frame_epoch=epoch, fleet_epoch=self.epoch,
+                                 seq=seq)
+                    raise StaleEpochError(
+                        f"frame from pid {pid} epoch {epoch} does not match "
+                        f"fleet epoch {self.epoch} alive={self.alive}"
+                    )
+                key = (epoch, seq)
+                slot = self._slots.setdefault(
+                    key, {"got": {}, "served": set()})
+                slot["got"][pid] = np.array(payload, copy=True)
+                self._cv.notify_all()
+                deadline = time.monotonic() + self.timeout_s
+                while True:
+                    if set(slot["got"]) >= set(self.alive):
+                        ordered = [slot["got"][p]
+                                   for p in sorted(self.alive)]
+                        slot["served"].add(pid)
+                        if slot["served"] >= set(self.alive):
+                            self._slots.pop(key, None)
+                        return ordered
+                    if self.dead & set(self.alive):
+                        raise PeerDeadError(
+                            f"peer(s) {sorted(self.dead & set(self.alive))} "
+                            f"died during collective (epoch {epoch}, "
+                            f"seq {seq})",
+                            peers=tuple(sorted(self.dead & set(self.alive))))
+                    if epoch != self.epoch:
+                        # Membership changed under us while waiting.
+                        break  # re-run the entry checks
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        missing = sorted(set(self.alive) - set(slot["got"]))
+                        raise PeerDeadError(
+                            f"collective (epoch {epoch}, seq {seq}) timed "
+                            f"out waiting for {missing}",
+                            peers=tuple(missing))
+                    self._cv.wait(remaining)
+
+    # -- membership --------------------------------------------------------
+
+    def mark_dead(self, pid: int) -> None:
+        with self._cv:
+            self.dead.add(pid)
+            self._cv.notify_all()
+
+    def surviving(self) -> list[int]:
+        with self._cv:
+            return sorted(set(self.alive) - self.dead)
+
+    def begin_epoch(self, new_epoch: int, new_alive: list[int]) -> None:
+        """Flip the fleet to ``new_epoch``/``new_alive``.  Idempotent:
+        the first survivor flips, later survivors validate they agree."""
+        with self._cv:
+            if self.epoch == new_epoch:
+                if tuple(sorted(new_alive)) != tuple(sorted(self.alive)):
+                    raise RuntimeError(
+                        f"epoch {new_epoch} already begun with alive="
+                        f"{self.alive}, got {sorted(new_alive)}"
+                    )
+                return
+            if new_epoch != self.epoch + 1:
+                raise RuntimeError(
+                    f"epoch must advance by 1: {self.epoch} -> {new_epoch}"
+                )
+            self.epoch = new_epoch
+            self.alive = tuple(sorted(new_alive))
+            self._slots.clear()
+            self._cv.notify_all()
+
+    # -- join handshake ----------------------------------------------------
+
+    def request_join(self, pid: int, info: dict) -> dict:
+        """A restarted host asks back in.  Blocks until a survivor
+        ``admit``s (returns ``{"epoch", "alive", "step"}``) or refuses
+        (``RejoinRefusedError``)."""
+        with self._cv:
+            self._join_requests[pid] = dict(info)
+            self._cv.notify_all()
+            deadline = time.monotonic() + self.timeout_s
+            while True:
+                if pid in self._admissions:
+                    adm = self._admissions.pop(pid)
+                    self._join_requests.pop(pid, None)
+                    return adm
+                if pid in self._refusals:
+                    reason = self._refusals.pop(pid)
+                    self._join_requests.pop(pid, None)
+                    raise RejoinRefusedError(
+                        f"fleet refused rejoin of pid {pid}: {reason}"
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._join_requests.pop(pid, None)
+                    raise RejoinRefusedError(
+                        f"rejoin request from pid {pid} timed out after "
+                        f"{self.timeout_s:.1f}s"
+                    )
+                self._cv.wait(remaining)
+
+    def poll_joiners(self) -> list[tuple[int, dict]]:
+        with self._cv:
+            return sorted(self._join_requests.items())
+
+    def refuse_join(self, pid: int, reason: str) -> None:
+        with self._cv:
+            if pid in self._join_requests and pid not in self._refusals:
+                self._refusals[pid] = reason
+                self._cv.notify_all()
+
+    def admit(self, acker: int, pid: int, new_epoch: int,
+              new_alive: list[int], step: int) -> None:
+        """One survivor's vote to admit ``pid``.  Every current member
+        must ack (they all reached the same boundary decision); the last
+        ack flips the epoch, revives the pid, and unblocks the joiner.
+        Earlier ackers block until the flip so everyone leaves admit in
+        the new epoch together."""
+        with self._cv:
+            key = (pid, new_epoch)
+            acks = self._admit_acks.setdefault(key, set())
+            acks.add(acker)
+            need = set(self.alive)
+            if acks >= need and self.epoch < new_epoch:
+                self.epoch = new_epoch
+                self.alive = tuple(sorted(new_alive))
+                self.dead.discard(pid)
+                self._slots.clear()
+                self._admissions[pid] = {
+                    "epoch": new_epoch,
+                    "alive": tuple(sorted(new_alive)),
+                    "step": int(step),
+                }
+                self._admit_acks.pop(key, None)
+                record_event("fleet", "fleet_rejoin_admitted", pid=pid,
+                             epoch=new_epoch, alive=sorted(new_alive),
+                             step=int(step))
+                self._cv.notify_all()
+                return
+            deadline = time.monotonic() + self.timeout_s
+            while self.epoch < new_epoch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"admit of pid {pid} at epoch {new_epoch} timed out "
+                        f"waiting for peer acks ({sorted(acks)} of "
+                        f"{sorted(need)})"
+                    )
+                self._cv.wait(remaining)
+
+
+class ThreadFleet:
+    """One 'host' of a Rendezvous fleet — the fleet handle the driver
+    sees.  Satisfies the ``GlooFleet`` surface (``num_processes``,
+    ``process``, ``allgather_bytes``, ``allgather_i32``) plus the
+    elastic extensions (``shrink_to``, ``surviving``, join handshake).
+
+    ``process`` is the *rank within the current alive set* (what the
+    exchange plans index by); ``orig_process`` is the stable identity
+    used on the wire and in manifests."""
+
+    supports_join = True
+
+    def __init__(self, rdv: Rendezvous, process: int, *,
+                 joiner: bool = False):
+        self.rdv = rdv
+        self.orig_process = process
+        self.is_joiner = joiner
+        self._kill_in: int | None = None
+        if joiner:
+            self.epoch = -1
+            self.alive: tuple = ()
+            self.num_processes = 0
+            self.process = -1
+        else:
+            self._apply(rdv.epoch, rdv.alive)
+        self._seq = 0
+
+    def _apply(self, epoch: int, alive) -> None:
+        self.epoch = epoch
+        self.alive = tuple(sorted(alive))
+        self.num_processes = len(self.alive)
+        self.process = self.alive.index(self.orig_process)
+        self._seq = 0
+
+    def _maybe_kill(self) -> None:
+        if self._kill_in is None:
+            return
+        self._kill_in -= 1
+        if self._kill_in <= 0:
+            self._kill_in = None
+            self.rdv.mark_dead(self.orig_process)
+            raise SimulatedHostLoss(
+                f"simulated SIGKILL of pid {self.orig_process}"
+            )
+
+    def kill_after(self, n: int) -> None:
+        """Die (SimulatedHostLoss + mark_dead) on the ``n``-th collective
+        from now — mid-half when armed at an iteration boundary."""
+        self._kill_in = int(n)
+
+    # -- collectives -------------------------------------------------------
+
+    def _collect(self, payload: np.ndarray) -> np.ndarray:
+        self._maybe_kill()
+        seq = self._seq
+        self._seq += 1
+        parts = self.rdv.contribute(self.orig_process, self.epoch, seq,
+                                    payload)
+        return np.stack(parts, axis=0)
+
+    def allgather_bytes(self, payload: np.ndarray) -> np.ndarray:
+        return self._collect(np.ascontiguousarray(payload, np.uint8))
+
+    def allgather_i32(self, values) -> np.ndarray:
+        arr = np.atleast_1d(np.asarray(values, np.int32))
+        return self._collect(arr)
+
+    # -- membership --------------------------------------------------------
+
+    def surviving(self) -> list[int]:
+        return self.rdv.surviving()
+
+    def shrink_to(self, alive: list[int]) -> "ThreadFleet":
+        self.rdv.begin_epoch(self.epoch + 1, alive)
+        self._apply(self.rdv.epoch, self.rdv.alive)
+        # Keep the handle even at P'=1: a later rejoin re-inflates it.
+        return self
+
+    def join(self, info: dict) -> dict:
+        adm = self.rdv.request_join(self.orig_process, info)
+        self._apply(adm["epoch"], adm["alive"])
+        self.is_joiner = False
+        return adm
+
+    def poll_joiners(self) -> list:
+        return self.rdv.poll_joiners()
+
+    def refuse_join(self, pid: int, reason: str) -> None:
+        self.rdv.refuse_join(pid, reason)
+
+    def admit(self, acker_rank: int, pid: int, new_epoch: int,
+              new_alive: list[int], step: int) -> None:
+        self.rdv.admit(self.orig_process, pid, new_epoch, new_alive, step)
+        self._apply(self.rdv.epoch, self.rdv.alive)
+
+
+# --------------------------------------------------------------------------
+# Threaded-fleet harness (tests + chaos_lab's in-process scenarios)
+# --------------------------------------------------------------------------
+
+
+class _KillAtIteration:
+    """Watchdog stand-in that arms a ThreadFleet's kill switch once the
+    victim completes ``iteration`` iterations.  kill_after(3) dies on
+    the 3rd collective after the boundary: the rejoin poll (1) and the
+    lockstep any_flag (2) pass, the next half's first exchange phase (3)
+    kills — i.e. mid-half, the hard case."""
+
+    def __init__(self, tf: ThreadFleet, iteration: int):
+        self.tf = tf
+        self.iteration = iteration
+        self._armed = False
+
+    def arm(self) -> None:
+        pass
+
+    def disarm(self) -> None:
+        pass
+
+    def tick(self, done: int) -> None:
+        if not self._armed and done >= self.iteration:
+            self._armed = True
+            self.tf.kill_after(3)
+
+
+class _PaceForJoin:
+    """Watchdog stand-in for SURVIVORS in rejoin scenarios: after the
+    kill iteration, hold each boundary until the restarted host has
+    filed its join request (or the rejoin completed, epoch >= 2) so the
+    admission lands deterministically instead of racing the survivor to
+    the end of training.  Timeout keeps a broken joiner from hanging the
+    harness."""
+
+    def __init__(self, rdv: Rendezvous, after_iteration: int,
+                 timeout_s: float):
+        self.rdv = rdv
+        self.after_iteration = after_iteration
+        self.timeout_s = timeout_s
+
+    def arm(self) -> None:
+        pass
+
+    def disarm(self) -> None:
+        pass
+
+    def tick(self, done: int) -> None:
+        if done <= self.after_iteration:
+            return
+        deadline = time.monotonic() + self.timeout_s
+        while (self.rdv.epoch < 2 and not self.rdv.poll_joiners()
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+
+
+def run_threaded_fleet(dataset, config, *, ckdir: str,
+                       num_processes: int = 2, kill_pid: int | None = None,
+                       kill_iteration: int | None = None,
+                       rejoin: bool = False, zombie_probe: bool = False,
+                       thread_timeout_s: float = 300.0) -> dict:
+    """Run the REAL ``train_als_host_window`` as an N-thread fleet over a
+    Rendezvous fabric, optionally killing one 'host' mid-half and
+    optionally restarting it as a joiner.
+
+    Returns ``{"results": {key: model-or-exception}, "rendezvous",
+    "stale_rejected", "stale_error", "epoch"}``.  ``results`` keys are
+    pids (and ``"<pid>:rejoin"`` for the restarted life)."""
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.telemetry.metrics import Metrics
+
+    rdv = Rendezvous(num_processes, timeout_s=thread_timeout_s)
+    manifests = FleetManifests(ckdir)
+    results: dict = {}
+    metrics: dict = {}
+
+    def _run(key, pid, *, joiner=False, watchdog=None):
+        tf = ThreadFleet(rdv, pid, joiner=joiner)
+        met = Metrics()
+        metrics[key] = met
+
+        def _target():
+            try:
+                results[key] = train_als_host_window(
+                    dataset, config, metrics=met,
+                    checkpoint_manager=manifests.manager_for(pid),
+                    fleet=tf, fleet_manifests=manifests,
+                    watchdog=watchdog(tf) if watchdog else None,
+                )
+            except BaseException as e:  # noqa: BLE001 - harness boundary
+                results[key] = e
+
+        t = threading.Thread(target=_target, daemon=True,
+                             name=f"cfk-fleet-host-{key}")
+        t.start()
+        return t
+
+    threads = {}
+    for pid in range(num_processes):
+        wd = None
+        if pid == kill_pid and kill_iteration is not None:
+            wd = lambda tf: _KillAtIteration(tf, kill_iteration)  # noqa: E731
+        elif rejoin and kill_iteration is not None:
+            wd = lambda tf: _PaceForJoin(  # noqa: E731
+                rdv, kill_iteration, min(thread_timeout_s, 60.0))
+        threads[pid] = _run(pid, pid, watchdog=wd)
+
+    stale_error = None
+    if rejoin and kill_pid is not None:
+        threads[kill_pid].join(thread_timeout_s)
+        # Wait for the survivors to finish the shrink (epoch >= 1).
+        deadline = time.monotonic() + thread_timeout_s
+        while rdv.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if zombie_probe:
+            # A frame from the dead host's first life must be fenced.
+            try:
+                rdv.contribute(kill_pid, 0, 10_000,
+                               np.zeros(1, np.int32))
+            except StaleEpochError as e:
+                stale_error = e
+        threads[f"{kill_pid}:rejoin"] = _run(
+            f"{kill_pid}:rejoin", kill_pid, joiner=True)
+
+    for key, t in threads.items():
+        t.join(thread_timeout_s)
+        if t.is_alive():
+            results.setdefault(
+                key, TimeoutError(f"fleet thread {key} did not finish"))
+
+    return {
+        "results": results,
+        "metrics": metrics,
+        "rendezvous": rdv,
+        "stale_rejected": rdv.stale_rejected,
+        "stale_error": stale_error,
+        "epoch": rdv.epoch,
+    }

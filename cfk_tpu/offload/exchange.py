@@ -397,13 +397,21 @@ class GlooFleet:
     ``process_allgather`` as the one collective — at fleet size 2 an
     allgather IS the pairwise exchange, and the equal-shape stacked
     layout is what Gloo's TCP pairs require (ragged shapes crash the
-    transport, measured)."""
+    transport, measured).
+
+    jax 0.4.37's Gloo runtime cannot reform around a changed membership;
+    the elastic layer (``cfk_tpu.offload.elastic``) wraps this transport
+    for transient-vs-fatal classification and supports exactly the
+    2-host → 1-survivor live shrink on it (the survivor needs no further
+    collectives).  ``alive`` names the original pids of the current
+    membership — fixed for the lifetime of a Gloo runtime."""
 
     def __init__(self) -> None:
         import jax
 
         self.num_processes = int(jax.process_count())
         self.process = int(jax.process_index())
+        self.alive = tuple(range(self.num_processes))
 
     def allgather_bytes(self, buf: np.ndarray) -> np.ndarray:
         """[rows, width] uint8, equal shape on every process →
@@ -435,6 +443,7 @@ class LocalFleet:
     def __init__(self, num_processes: int, process: int) -> None:
         self.num_processes = int(num_processes)
         self.process = int(process)
+        self.alive = tuple(range(num_processes))
         self._pending: list | None = None
 
     def preload(self, payloads: list) -> None:

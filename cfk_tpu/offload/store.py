@@ -15,7 +15,19 @@ are bit-identical to the resident run's cast table).
 
 from __future__ import annotations
 
+import zlib
+
 import numpy as np
+
+
+class StoreIntegrityError(RuntimeError):
+    """A sealed shard's bytes no longer match their crc32 — host-RAM
+    bit-rot (or an unsanctioned in-place write).  ``shard`` names the
+    block so the repair path can be surgical."""
+
+    def __init__(self, msg: str, *, shard: int = -1) -> None:
+        super().__init__(msg)
+        self.shard = int(shard)
 
 
 def _np_dtype(name: str):
@@ -94,6 +106,11 @@ class HostFactorStore:
                      dtype=self._np_dtype)
             for s in range(num_shards)
         ]
+        # Per-shard integrity seals: crc32 of the shard bytes as of the
+        # last ``seal()``, or None while the shard is dirty (unsealed).
+        # Writes through the public API invalidate the touched shards;
+        # ``scrub()`` verifies the sealed ones.
+        self._crcs: list = [None] * num_shards
 
     @classmethod
     def from_array(cls, arr, *, dtype: str | None = None,
@@ -176,6 +193,7 @@ class HostFactorStore:
                     self._np_dtype, copy=False
                 )
             )
+            self._crcs[s] = None
             pos = hi
 
     def write_rows(self, rows: np.ndarray, values: np.ndarray) -> None:
@@ -185,6 +203,7 @@ class HostFactorStore:
         values = np.asarray(values)
         if self.num_shards == 1:
             self._shards[0][rows] = values.astype(self._np_dtype, copy=False)
+            self._crcs[0] = None
             return
         sh = np.searchsorted(self.bounds, rows, side="right") - 1
         for s in range(self.num_shards):
@@ -193,6 +212,7 @@ class HostFactorStore:
                 self._shards[s][rows[m] - self.bounds[s]] = (
                     values[m].astype(self._np_dtype, copy=False)
                 )
+                self._crcs[s] = None
 
     def as_array(self) -> np.ndarray:
         """The whole table as one host array (tests / small shapes / the
@@ -202,9 +222,42 @@ class HostFactorStore:
         return np.concatenate(self._shards, axis=0)
 
     def copy(self) -> "HostFactorStore":
-        """Deep copy (the resilient loop's last-good snapshot)."""
+        """Deep copy (the resilient loop's last-good snapshot).  The copy
+        starts unsealed — its seals are its own, not inherited."""
         out = HostFactorStore(self.rows, self.rank, dtype=self.dtype,
                               num_shards=self.num_shards)
         for s in range(self.num_shards):
             out._shards[s][...] = self._shards[s]
         return out
+
+    # --- integrity seals ---------------------------------------------------
+
+    def seal(self) -> None:
+        """Checksum every dirty shard (crc32 of the raw shard bytes).
+        Called at write boundaries — after the solved rows of a half are
+        committed — so any later mutation that is NOT a sanctioned write
+        (cosmic ray, wild pointer, buggy in-place op) is detectable."""
+        for s in range(self.num_shards):
+            if self._crcs[s] is None:
+                self._crcs[s] = zlib.crc32(self._shards[s].tobytes())
+
+    def scrub(self) -> None:
+        """Verify every *sealed* shard against its crc32; dirty shards
+        (written since the last seal) are skipped.  Raises
+        ``StoreIntegrityError`` naming the first corrupt shard — the
+        caller repairs from the last committed checkpoint rather than
+        laundering rotten factors into the exchange."""
+        for s in range(self.num_shards):
+            want = self._crcs[s]
+            if want is None:
+                continue
+            got = zlib.crc32(self._shards[s].tobytes())
+            if got != want:
+                raise StoreIntegrityError(
+                    f"factor store shard {s} fails its integrity seal "
+                    f"(crc32 {got:#010x} != sealed {want:#010x}): host-RAM "
+                    f"bit-rot in rows [{int(self.bounds[s])}, "
+                    f"{int(self.bounds[s + 1])}) — repair from the last "
+                    "committed checkpoint",
+                    shard=s,
+                )

@@ -69,6 +69,7 @@ from cfk_tpu.offload.staging import (
 # anything but float32/bfloat16 — no silent fallthrough).
 from cfk_tpu.offload.store import (
     HostFactorStore,
+    StoreIntegrityError,
     _np_dtype,
     quantize_rows_host,
 )
@@ -1096,6 +1097,8 @@ def train_als_host_window(
     checkpoint_manager=None,
     checkpoint_every: int = 1,
     watchdog=None,
+    fleet=None,
+    fleet_manifests=None,
 ):
     """ALS-WR with host-resident factor tables and windowed half-steps.
 
@@ -1144,6 +1147,21 @@ def train_als_host_window(
     next to the ring accumulator reservation (``budget.max_pool_depth``
     — the staging-arena term).  Both modes are crc-identical to each
     other and to the resident paths.
+
+    ``fleet`` injects the multi-process transport explicitly (the
+    threaded elastic harness and tests; ``None`` auto-detects the jax
+    runtime as before).  ``fleet_manifests`` — a
+    ``cfk_tpu.offload.elastic.FleetManifests`` over the fleet's shared
+    per-host checkpoint tree — arms **elastic membership** (ISSUE 20,
+    overridable via ``config.fleet_elastic``): a dead peer triggers the
+    shrink protocol (min-agree the last jointly covered step from the
+    manifests, repartition ownership over the survivors, reload the
+    orphaned slice from committed bytes, roll back, continue) instead
+    of an exit, and a restarted host passed a ``fleet`` whose
+    ``is_joiner`` is set rejoins at an iteration boundary via the
+    health-gated readmission handshake.  Factors reconverge
+    crc-identical to the uninterrupted run (shard-count-invariant init
+    + committed-byte reload).
     """
     from cfk_tpu.config import enable_compile_cache
     from cfk_tpu.ops.solve import init_factors_stats
@@ -1174,22 +1192,52 @@ def train_als_host_window(
     # phases (offload.exchange).  Everything below that reads or writes
     # a factor table goes through the slice store or its ResidualMirror;
     # the single-process path is byte-for-byte untouched.
-    fleet = None
-    if jax.process_count() > 1:
-        from cfk_tpu.offload import exchange as _exchange
+    from cfk_tpu.offload import elastic as _elastic
+    from cfk_tpu.offload import exchange as _exchange
 
+    metrics = metrics if metrics is not None else Metrics()
+    if fleet is None and jax.process_count() > 1:
         fleet = _exchange.GlooFleet()
+    joiner = fleet is not None and getattr(fleet, "is_joiner", False)
+    if fleet is not None and not joiner:
         if config.num_shards % fleet.num_processes != 0:
             raise ValueError(
                 f"num_shards={config.num_shards} must be divisible by "
                 f"the fleet size ({fleet.num_processes} processes) for "
                 "contiguous shard-block store ownership"
             )
+    # Elastic membership (ISSUE 20): armed when per-host fleet manifests
+    # are available (config.fleet_elastic overrides).  The transport is
+    # wrapped for transient-vs-fatal classification — retried transient
+    # collective failures never shrink the fleet; exhaustion or a fatal
+    # error raises PeerDeadError, which the loop turns into the shrink
+    # protocol instead of an exit.
+    elastic_on = fleet is not None and (
+        config.fleet_elastic if config.fleet_elastic is not None
+        else fleet_manifests is not None
+    )
+    if elastic_on and fleet_manifests is None:
+        raise ValueError(
+            "fleet_elastic=True needs fleet_manifests (the shrink "
+            "protocol agrees on and reloads from the per-host manifest "
+            "tree); pass a cfk_tpu.offload.elastic.FleetManifests"
+        )
+    if elastic_on and not isinstance(fleet, _elastic.ElasticFleet):
+        fleet = _elastic.ElasticFleet(
+            fleet,
+            retry=_elastic.RetryPolicy(
+                attempts=config.fleet_retry_attempts,
+                base=config.fleet_retry_base_s,
+                max_delay=config.fleet_retry_max_delay_s,
+            ),
+            collective_timeout_s=config.fleet_collective_timeout_s,
+            metrics=metrics,
+        )
+    fleet_epoch = 0
     s = config.num_shards
     ring_m, ring_u = _resolve_side_modes(dataset, config)
     any_ring = ring_m or ring_u
     inner = resolve_window_inner(config) if any_ring else max(s, 1)
-    metrics = metrics if metrics is not None else Metrics()
     with metrics.phase("window_plan"):
         mb, ub = _blocks_for(dataset, config, tile_rows, ring_m, ring_u)
         stage_name = _stage_dtype(config.dtype, config.table_dtype)
@@ -1441,101 +1489,42 @@ def train_als_host_window(
         rank=config.rank, num_entities=ub.num_entities,
     ).astype(jax.numpy.dtype(config.dtype))
     u_full_init = np.asarray(u0)
-    if fleet is None:
-        u_store = HostFactorStore.from_array(u_full_init,
-                                             dtype=config.dtype,
-                                             num_shards=s)
-        m_store = HostFactorStore(mb.padded_entities, config.rank,
-                                  dtype=config.dtype, num_shards=s)
-        own_u = own_m = fleet_sides = owned_shards = None
-    else:
-        # Every process draws the SAME full u0 (deterministic init) and
-        # keeps only its owned slice — the one unavoidably global moment;
-        # sharding the init draw itself is the on-TPU follow-up.  Store
-        # bounds coincide with shard solve ranges (padded = S · local),
-        # so solve write-back stays purely local.
-        own_u = _exchange.OwnershipMap(s, fleet.num_processes,
-                                       fleet.process,
-                                       ub.padded_entities // s)
-        own_m = _exchange.OwnershipMap(s, fleet.num_processes,
-                                       fleet.process,
-                                       mb.padded_entities // s)
-        owned_shards = own_u.owned_shards()
-        u_lo, u_hi = own_u.row_bounds()
-        m_lo, m_hi = own_m.row_bounds()
-        u_store = HostFactorStore.from_array(
-            u_full_init[u_lo:u_hi], dtype=config.dtype,
-            num_shards=own_u.shards_per_process,
-        )
-        m_store = HostFactorStore(m_hi - m_lo, config.rank,
-                                  dtype=config.dtype,
-                                  num_shards=own_m.shards_per_process)
-        visits_all = [hier_visit_order(s, inner, d) for d in range(s)]
-        hmaps_m = hmaps_u = rows_hot_u = rows_hot_m = None
-        if hot_ctx is not None:
-            hmaps_m = [hot_ctx["maps"][("m", d)] for d in range(s)]
-            hmaps_u = [hot_ctx["maps"][("u", d)] for d in range(s)]
-            rows_hot_u = hot_ctx["rows_u"]
-            rows_hot_m = hot_ctx["rows_m"]
-        explan_m = _exchange.build_half_exchange(
-            own_u, m_plans, [schedules[("m", d)] for d in range(s)],
-            inner=inner, visits=visits_all if ring_m else None,
-            hmaps=hmaps_m, hot_rows=rows_hot_u, side="m",
-        )
-        explan_u = _exchange.build_half_exchange(
-            own_m, u_plans, [schedules[("u", d)] for d in range(s)],
-            inner=inner, visits=visits_all if ring_u else None,
-            hmaps=hmaps_u, hot_rows=rows_hot_m, side="u",
-        )
-        fleet_sides = {
-            "m": (_exchange.ResidualMirror(u_store, own_u), explan_m),
-            "u": (_exchange.ResidualMirror(m_store, own_m), explan_u),
-        }
-        metrics.gauge("offload_fleet_processes", fleet.num_processes)
-        metrics.gauge("offload_fleet_process", fleet.process)
-        metrics.gauge("offload_exchange_phases",
-                      explan_m.num_phases + explan_u.num_phases)
-        metrics.gauge("offload_exchange_recv_rows_iter",
-                      explan_m.recv_rows_total + explan_u.recv_rows_total)
-        metrics.gauge("offload_exchange_rows_dense_iter",
-                      explan_m.dense_rows_total
-                      + explan_u.dense_rows_total)
-
-    # Resume (ISSUE 17): restore the newest checkpoint step EVERY
-    # process holds intact — the fleet-wide minimum of each host's
-    # latest_valid_iteration, so a host whose shard slice died recovers
-    # from its own manifest while the survivors roll back to the same
-    # step (the PR 5 lockstep contract, per-host stores edition).
-    start_it = 0
-    if checkpoint_manager is not None:
-        latest = checkpoint_manager.latest_valid_iteration()
-        step = -1 if latest is None else int(latest)
-        if fleet is not None:
-            step = _exchange.agree_min_i32(fleet, step)
-        if step >= 0:
-            st = checkpoint_manager.restore(iteration=step)
-            if st.user_factors.shape != (u_store.rows, config.rank):
-                raise ValueError(
-                    f"checkpoint step {step} holds user factors "
-                    f"{st.user_factors.shape} but this process's store "
-                    f"slice is {(u_store.rows, config.rank)} — resuming "
-                    "under a different fleet size or shard count is not "
-                    "a thing the ownership map can reinterpret"
-                )
-            u_store.write_range(0, np.asarray(st.user_factors))
-            m_store.write_range(0, np.asarray(st.movie_factors))
-            start_it = step
-            metrics.gauge("offload_resumed_from", step)
-            record_event("train", "offload_resume", iteration=step)
-
-    # Hot partitions + per-(side, shard) contexts (ISSUE 15): the device
-    # copies gather from the just-initialized masters (the movie side
-    # starts all-zero, exactly like its store), index constants
-    # device_put once — only the cold delta crosses PCIe per window from
-    # here on.
+    rows_u_total = ub.padded_entities
+    rows_m_total = mb.padded_entities
+    visits_all = [hier_visit_order(s, inner, d) for d in range(s)]
+    hmaps_m = hmaps_u = rows_hot_u = rows_hot_m = None
+    if hot_ctx is not None:
+        hmaps_m = [hot_ctx["maps"][("m", d)] for d in range(s)]
+        hmaps_u = [hot_ctx["maps"][("u", d)] for d in range(s)]
+        rows_hot_u = hot_ctx["rows_u"]
+        rows_hot_m = hot_ctx["rows_m"]
+    u_store = m_store = None
+    own_u = own_m = fleet_sides = owned_shards = None
     hot_u_part = hot_m_part = None
     hot_halves: dict = {}
-    if hot_ctx is not None:
+
+    def _load_full(step: int):
+        """Both full tables at committed ``step``, reassembled from
+        every reachable host's manifest bytes (the elastic reload)."""
+        u_full = fleet_manifests.load_rows(step, 0, rows_u_total, "u",
+                                           rank=config.rank)
+        m_full = fleet_manifests.load_rows(step, 0, rows_m_total, "m",
+                                           rank=config.rank)
+        return u_full, m_full
+
+    def _build_hot_halves(step) -> None:
+        """Hot partitions + per-(side, shard) contexts (ISSUE 15): the
+        device copies gather from the masters (the movie side starts
+        all-zero, exactly like its store), index constants device_put
+        once — only the cold delta crosses PCIe per window from here
+        on.  Rebuilt whole on every partition change (init, elastic
+        shrink, rejoin): the rebuild-≡-restage invariant keeps the
+        post-change bits identical to a fresh run's."""
+        nonlocal hot_u_part, hot_m_part, hot_halves
+        hot_halves = {}
+        hot_u_part = hot_m_part = None
+        if hot_ctx is None:
+            return
         hot_u_part = HotPartition(hot_ctx["rows_u"], stage_name)
         hot_m_part = HotPartition(hot_ctx["rows_m"], stage_name)
         if fleet is None:
@@ -1544,18 +1533,26 @@ def train_als_host_window(
         else:
             # Fleet: the masters are slices, so the initial partitions
             # build from transient full-table views (u0 is already fully
-            # materialized on every process; the movie side is zeros).
-            # From here on each half START rebuilds the FIXED side's
-            # partition from the exchange mirror — master bytes, the
-            # same pinned rebuild-≡-restage invariant the rollback path
-            # relies on — replacing the in-half device scatter-back
-            # (disabled below: its update would be process-local, and
-            # the next half's rebuild overwrites it anyway).
+            # materialized on every process; the movie side is zeros —
+            # or, after an elastic reload, the committed bytes of
+            # ``step``).  From here on each half START rebuilds the
+            # FIXED side's partition from the exchange mirror — master
+            # bytes, the same pinned rebuild-≡-restage invariant the
+            # rollback path relies on — replacing the in-half device
+            # scatter-back (disabled below: its update would be
+            # process-local, and the next half's rebuild overwrites it
+            # anyway).
+            if step is None:
+                u_full = u_full_init
+                m_full = np.zeros((rows_m_total, config.rank),
+                                  _np_dtype(config.dtype))
+            else:
+                u_full, m_full = _load_full(int(step))
             hot_u_part.rebuild(HostFactorStore.from_array(
-                u_full_init, dtype=config.dtype))
+                np.asarray(u_full, _np_dtype(config.dtype)),
+                dtype=config.dtype))
             hot_m_part.rebuild(HostFactorStore.from_array(
-                np.zeros((own_m.rows_total, config.rank),
-                         _np_dtype(config.dtype)),
+                np.asarray(m_full, _np_dtype(config.dtype)),
                 dtype=config.dtype))
         from cfk_tpu.offload import hot as _hotmod
         for d in (range(s) if fleet is None else owned_shards):
@@ -1591,6 +1588,157 @@ def train_als_host_window(
         metrics.gauge("offload_hot_resident_mb", round(
             (hot_u_part.nbytes + hot_m_part.nbytes) / 1e6, 3))
 
+    def _setup_partition(new_fleet, step=None) -> None:
+        """THE partition constructor: ownership maps, store slices,
+        exchange plans, mirrors, and hot partitions for the CURRENT
+        fleet (or single-host when ``new_fleet`` is None).  ``step``
+        None seeds from init (every process draws the SAME full u0 —
+        deterministic, shard-count-invariant — and keeps its owned
+        slice; store bounds coincide with shard solve ranges, so solve
+        write-back stays purely local); otherwise the stores reload
+        committed step bytes from the fleet manifests — the elastic
+        shrink/rejoin repartition path."""
+        nonlocal fleet, u_store, m_store, own_u, own_m
+        nonlocal fleet_sides, owned_shards
+        fleet = new_fleet
+        if fleet is None:
+            if step is None:
+                u_store = HostFactorStore.from_array(u_full_init,
+                                                     dtype=config.dtype,
+                                                     num_shards=s)
+                m_store = HostFactorStore(rows_m_total, config.rank,
+                                          dtype=config.dtype,
+                                          num_shards=s)
+            else:
+                u_full, m_full = _load_full(int(step))
+                u_store = HostFactorStore.from_array(
+                    u_full, dtype=config.dtype, num_shards=s)
+                m_store = HostFactorStore.from_array(
+                    m_full, dtype=config.dtype, num_shards=s)
+            own_u = own_m = fleet_sides = owned_shards = None
+        else:
+            own_u = _exchange.OwnershipMap(s, fleet.num_processes,
+                                           fleet.process,
+                                           rows_u_total // s)
+            own_m = _exchange.OwnershipMap(s, fleet.num_processes,
+                                           fleet.process,
+                                           rows_m_total // s)
+            owned_shards = own_u.owned_shards()
+            u_lo, u_hi = own_u.row_bounds()
+            m_lo, m_hi = own_m.row_bounds()
+            if step is None:
+                u_store = HostFactorStore.from_array(
+                    u_full_init[u_lo:u_hi], dtype=config.dtype,
+                    num_shards=own_u.shards_per_process,
+                )
+                m_store = HostFactorStore(m_hi - m_lo, config.rank,
+                                          dtype=config.dtype,
+                                          num_shards=own_m.shards_per_process)
+            else:
+                u_store = HostFactorStore.from_array(
+                    fleet_manifests.load_rows(int(step), u_lo, u_hi, "u",
+                                              rank=config.rank),
+                    dtype=config.dtype,
+                    num_shards=own_u.shards_per_process,
+                )
+                m_store = HostFactorStore.from_array(
+                    fleet_manifests.load_rows(int(step), m_lo, m_hi, "m",
+                                              rank=config.rank),
+                    dtype=config.dtype,
+                    num_shards=own_m.shards_per_process,
+                )
+            explan_m = _exchange.build_half_exchange(
+                own_u, m_plans, [schedules[("m", d)] for d in range(s)],
+                inner=inner, visits=visits_all if ring_m else None,
+                hmaps=hmaps_m, hot_rows=rows_hot_u, side="m",
+            )
+            explan_u = _exchange.build_half_exchange(
+                own_m, u_plans, [schedules[("u", d)] for d in range(s)],
+                inner=inner, visits=visits_all if ring_u else None,
+                hmaps=hmaps_u, hot_rows=rows_hot_m, side="u",
+            )
+            fleet_sides = {
+                "m": (_exchange.ResidualMirror(u_store, own_u), explan_m),
+                "u": (_exchange.ResidualMirror(m_store, own_m), explan_u),
+            }
+            metrics.gauge("offload_fleet_processes", fleet.num_processes)
+            metrics.gauge("offload_fleet_process", fleet.process)
+            metrics.gauge("offload_fleet_epoch", fleet_epoch)
+            metrics.gauge("offload_exchange_phases",
+                          explan_m.num_phases + explan_u.num_phases)
+            metrics.gauge("offload_exchange_recv_rows_iter",
+                          explan_m.recv_rows_total
+                          + explan_u.recv_rows_total)
+            metrics.gauge("offload_exchange_rows_dense_iter",
+                          explan_m.dense_rows_total
+                          + explan_u.dense_rows_total)
+        _build_hot_halves(step)
+
+    # Resume / rejoin.  Non-joiners build their initial partition, then
+    # roll forward to the newest jointly restorable step: with fleet
+    # manifests that is the manifest-coverage agreement (pure filesystem
+    # reads, tightened by the collective min); otherwise the PR 17
+    # per-manager fleet-min path, unchanged.  A restarted host instead
+    # runs the readmission handshake FIRST — its partition is whatever
+    # the surviving fleet admits it back into.
+    start_it = 0
+    if joiner:
+        info = {
+            "healthy": fleet_manifests is not None,
+            "pid": int(getattr(fleet, "orig_process", -1)),
+        }
+        adm = fleet.join(info)
+        fleet_epoch = int(adm["epoch"])
+        start_it = int(adm["step"])
+        _setup_partition(fleet, start_it)
+        metrics.gauge("offload_resumed_from", start_it)
+        metrics.gauge("offload_fleet_epoch", fleet_epoch)
+        metrics.incr("fleet_rejoined")
+        record_event("fleet", "fleet_rejoined", pid=info["pid"],
+                     epoch=fleet_epoch, iteration=start_it)
+    else:
+        _setup_partition(fleet, None)
+        if fleet is not None and fleet_manifests is not None:
+            step = fleet_manifests.latest_coverage_step(rows_u_total,
+                                                        rows_m_total)
+            step = -1 if step is None else int(step)
+            step = int(_exchange.agree_min_i32(fleet, step))
+            if step >= 0:
+                _setup_partition(fleet, step)
+                start_it = step
+                metrics.gauge("offload_resumed_from", step)
+                record_event("train", "offload_resume", iteration=step)
+        elif checkpoint_manager is not None:
+            # Resume (ISSUE 17): restore the newest checkpoint step
+            # EVERY process holds intact — the fleet-wide minimum of
+            # each host's latest_valid_iteration, so a host whose shard
+            # slice died recovers from its own manifest while the
+            # survivors roll back to the same step (the PR 5 lockstep
+            # contract, per-host stores edition).
+            latest = checkpoint_manager.latest_valid_iteration()
+            step = -1 if latest is None else int(latest)
+            if fleet is not None:
+                step = _exchange.agree_min_i32(fleet, step)
+            if step >= 0:
+                st = checkpoint_manager.restore(iteration=step)
+                if st.user_factors.shape != (u_store.rows, config.rank):
+                    raise ValueError(
+                        f"checkpoint step {step} holds user factors "
+                        f"{st.user_factors.shape} but this process's store "
+                        f"slice is {(u_store.rows, config.rank)} — resuming "
+                        "under a different fleet size or shard count is not "
+                        "a thing the ownership map can reinterpret"
+                    )
+                u_store.write_range(0, np.asarray(st.user_factors))
+                m_store.write_range(0, np.asarray(st.movie_factors))
+                start_it = step
+                metrics.gauge("offload_resumed_from", step)
+                record_event("train", "offload_resume", iteration=step)
+                # Re-gather the hot partitions from the RESUMED masters
+                # (single mode reads them directly; fleet partitions are
+                # rebuilt from the mirror at each half start anyway).
+                _build_hot_halves(None)
+
     policy = policy_from_config(config)
     base_ov = Overrides(lam=config.lam, fused_epilogue=config.fused_epilogue)
     ov = base_ov
@@ -1616,7 +1764,6 @@ def train_als_host_window(
         in_kernel_gather=config.in_kernel_gather,
         table_dtype=config.table_dtype, faults=window_faults, stats=stats,
         verify_windows=verify_windows, ici_group=inner,
-        host=0 if fleet is None else fleet.process,
     )
     m_local = mb.local_entities
     u_local = ub.local_entities
@@ -1650,6 +1797,13 @@ def train_als_host_window(
         algo = ov.reg_solve_algo or config.reg_solve_algo
         shards = range(s) if fleet is None else owned_shards
         hot_on = bool(hot_halves)
+        if armed and fleet is None:
+            # Gather-boundary integrity check (ISSUE 20): the fixed
+            # table is about to be staged — verify its sealed shards
+            # before any rotten byte can launder into a window.  Fleet
+            # mode scrubs at the lockstep boundary instead (a raise
+            # here would desync the collective schedule).
+            fixed_store.scrub()
         fixed_read = fixed_store
         if fleet is not None:
             # Distributed window exchange (ISSUE 17): every DCN phase's
@@ -1719,7 +1873,8 @@ def train_als_host_window(
                           fused_epilogue=ov.fused_epilogue,
                           reg_solve_algo=algo, iteration=it, side=side,
                           shard=d, stager=stager,
-                          hot=hot_halves.get((side, d)))
+                          hot=hot_halves.get((side, d)),
+                          host=0 if fleet is None else fleet.process)
                 with span("train/iter/half_step", side=side, shard=d,
                           ring=bool(ring), iteration=it,
                           host=0 if fleet is None else fleet.process):
@@ -1795,10 +1950,17 @@ def train_als_host_window(
             dump_flight("degraded")
             u_store, m_store = snap
             it = snap_iter
+            u_store.seal()
+            m_store.seal()
             _rebuild_hot()
             return False
         u_store, m_store = snap[0].copy(), snap[1].copy()
         it = snap_iter
+        # Snapshot copies start unsealed (HostFactorStore.copy()) —
+        # reseal so the integrity scrub keeps covering the rolled-back
+        # bytes.
+        u_store.seal()
+        m_store.seal()
         _rebuild_hot()
         metrics.incr("rollbacks")
         new_ov = policy.escalate(ov, trips)
@@ -1819,6 +1981,166 @@ def train_als_host_window(
             metrics.note(f"plan_transition_{trips}", str(t))
         return True
 
+    def _shrink_infeasible(why: str) -> bool:
+        record_event("fault", "fleet_shrink_infeasible", iteration=it,
+                     detail=why)
+        metrics.note("fleet_shrink_infeasible", why)
+        dump_flight("fleet_shrink_infeasible")
+        return False
+
+    def _fleet_shrink(err) -> bool:
+        """The shrink protocol (ISSUE 20): a peer is dead for good —
+        min-agree the last jointly covered step from the per-host
+        manifests, reform (or drop) the fleet, repartition ownership
+        over the survivors, reload the orphaned slice from committed
+        bytes, roll back, continue.  Returns False when live shrink is
+        infeasible (the caller re-raises into the bounded-exit path) —
+        ARCHITECTURE.md's "what still requires restart" list."""
+        nonlocal it, snap, snap_iter, fleet_epoch
+        record_event("fault", "fleet_peer_dead", iteration=it,
+                     peers=[int(p) for p in getattr(err, "peers", ())],
+                     detail=str(err))
+        metrics.incr("fleet_peers_lost")
+        if fleet is None or fleet_manifests is None:
+            return False
+        try:
+            alive = [int(p) for p in fleet.surviving(err)]
+        except _elastic.ShrinkInfeasibleError as e2:
+            return _shrink_infeasible(str(e2))
+        me = int(getattr(fleet, "orig_process", fleet.process))
+        if not alive or me not in alive:
+            return _shrink_infeasible(
+                f"this host ({me}) is not in the surviving set {alive}"
+            )
+        if s % len(alive) != 0:
+            return _shrink_infeasible(
+                f"num_shards={s} is not divisible by the surviving "
+                f"fleet size {len(alive)} — contiguous shard-block "
+                "ownership cannot repartition; restart required"
+            )
+        step = fleet_manifests.latest_coverage_step(rows_u_total,
+                                                    rows_m_total)
+        if step is None:
+            return _shrink_infeasible(
+                "no checkpoint step is jointly covered by the reachable "
+                "manifests — nothing to reload the orphaned slice from"
+            )
+        try:
+            new_fleet = fleet.shrink_to(alive)
+        except _elastic.ShrinkInfeasibleError as e2:
+            return _shrink_infeasible(str(e2))
+        if new_fleet is not None and len(alive) > 1:
+            # >1 survivors share a reformed transport: tighten the
+            # filesystem agreement with the collective min (identical
+            # by construction on shared storage; belt and braces on
+            # anything eventually-consistent).
+            step = int(_exchange.agree_min_i32(new_fleet, int(step)))
+        fleet_epoch = (int(getattr(new_fleet, "epoch", fleet_epoch + 1))
+                       if new_fleet is not None else fleet_epoch + 1)
+        _setup_partition(new_fleet, int(step))
+        it = int(step)
+        if armed:
+            snap = (u_store.copy(), m_store.copy())
+            snap_iter = it
+            u_store.seal()
+            m_store.seal()
+        metrics.incr("fleet_shrinks")
+        metrics.gauge("offload_fleet_epoch", fleet_epoch)
+        metrics.note(
+            f"fleet_shrink_{fleet_epoch}",
+            f"peers {[int(p) for p in getattr(err, 'peers', ())]} lost; "
+            f"continuing with {len(alive)} host(s) from step {step} at "
+            f"epoch {fleet_epoch}",
+        )
+        record_event("fleet", "fleet_shrink", epoch=fleet_epoch,
+                     alive=alive, step=int(step))
+        dump_flight("fleet_shrink")
+        return True
+
+    def _poll_rejoin() -> bool:
+        """The readmission handshake's fleet side, run at every
+        iteration boundary: triage pending join requests (health gate +
+        shard divisibility, refused by rank 0), then allgather the
+        candidate so admission is unanimous at ONE boundary — a request
+        visible to only some members postpones to the next boundary.
+        On admission every member acks, the epoch bumps (stale frames
+        from the joiner's previous life are fenced from here on), and
+        everyone — joiner included — rebuilds the partition at the
+        agreed step.  Returns True when membership changed (the caller
+        restarts the boundary)."""
+        nonlocal it, snap, snap_iter, fleet_epoch
+        cand = -1
+        for pid, info in fleet.poll_joiners():
+            if not info.get("healthy", True):
+                if fleet.process == 0:
+                    fleet.refuse_join(int(pid), "health gate failed")
+                continue
+            if s % (fleet.num_processes + 1) != 0:
+                if fleet.process == 0:
+                    fleet.refuse_join(
+                        int(pid),
+                        f"num_shards={s} not divisible by the rejoined "
+                        f"fleet size {fleet.num_processes + 1}",
+                    )
+                continue
+            cand = int(pid)
+            break
+        words = fleet.allgather_i32([cand])
+        cands = [int(w[0]) for w in words]
+        if len(set(cands)) != 1 or cands[0] < 0:
+            return False
+        pid = cands[0]
+        step = fleet_manifests.latest_coverage_step(rows_u_total,
+                                                    rows_m_total)
+        step = -1 if step is None else int(step)
+        step = int(_exchange.agree_min_i32(fleet, step))
+        if step < 0:
+            if fleet.process == 0:
+                fleet.refuse_join(
+                    pid, "no jointly covered checkpoint step to rejoin at"
+                )
+            return False
+        new_alive = sorted(set(int(p) for p in fleet.alive) | {pid})
+        new_epoch = int(getattr(fleet, "epoch", fleet_epoch)) + 1
+        fleet.admit(pid, new_epoch, new_alive, step)
+        fleet_epoch = int(getattr(fleet, "epoch", new_epoch))
+        _setup_partition(fleet, step)
+        it = step
+        if armed:
+            snap = (u_store.copy(), m_store.copy())
+            snap_iter = it
+            u_store.seal()
+            m_store.seal()
+        metrics.incr("fleet_rejoins")
+        metrics.gauge("offload_fleet_epoch", fleet_epoch)
+        record_event("fleet", "fleet_rejoin", pid=pid, epoch=fleet_epoch,
+                     step=step, alive=new_alive)
+        dump_flight("fleet_rejoin")
+        return True
+
+    def _save_meta() -> dict:
+        """Checkpoint manifest meta: the ISSUE 20 schema extension —
+        fleet epoch, membership, and this host's owned row ranges, so
+        the shrink/rejoin protocol can agree on coverage and reload any
+        slice from pure manifest reads."""
+        u_bounds = ((0, rows_u_total) if own_u is None
+                    else own_u.row_bounds())
+        m_bounds = ((0, rows_m_total) if own_m is None
+                    else own_m.row_bounds())
+        return {
+            "tier": "host_window",
+            "processes": (1 if fleet is None
+                          else int(fleet.num_processes)),
+            "process": 0 if fleet is None else int(fleet.process),
+            "fleet_epoch": int(fleet_epoch),
+            "alive": ([0] if fleet is None else
+                      [int(p) for p in
+                       getattr(fleet, "alive",
+                               range(fleet.num_processes))]),
+            "u_row_lo": int(u_bounds[0]), "u_row_hi": int(u_bounds[1]),
+            "m_row_lo": int(m_bounds[0]), "m_row_hi": int(m_bounds[1]),
+        }
+
     if watchdog is not None:
         watchdog.arm()
     try:
@@ -1829,10 +2151,88 @@ def train_als_host_window(
                         m_new = half("m", u_store, m_plans, m_local,
                                      count_m, it, ring_m)
                         m_store.write_range(0, m_new)
+                        if armed:
+                            m_store.seal()
                         u_new = half("u", m_store, u_plans, u_local,
                                      count_u, it, ring_u)
                         u_store.write_range(0, u_new)
+                        if armed:
+                            u_store.seal()
                     record_event("train", "iter", i=it, tier="host_window")
+                    it += 1
+                    metrics.incr("iterations")
+                    if (checkpoint_manager is not None
+                            and should_save(it, checkpoint_every,
+                                            config.num_iterations)):
+                        # Per-process manifest of the OWNED slice, after
+                        # the iteration commit — the recovery unit a
+                        # killed host's replacement restores (fleet-min
+                        # agreement at startup picks the step every host
+                        # holds).
+                        checkpoint_manager.save(
+                            it, u_store.as_array(), m_store.as_array(),
+                            meta=_save_meta(),
+                        )
+                    if (window_faults is not None
+                            and hasattr(window_faults, "apply_store")):
+                        # Master-store chaos seam (ISSUE 20): bit-rot
+                        # lands AFTER the seal and the checkpoint commit
+                        # — the committed bytes stay clean, which is
+                        # exactly what the repair path restores.
+                        window_faults.apply_store(it - 1, "u", u_store)
+                        window_faults.apply_store(it - 1, "m", m_store)
+                    if watchdog is not None:
+                        watchdog.tick(it)
+                    if first_step_s is None:
+                        # Cold-start attribution (ISSUE 13): how long
+                        # until the first full iteration lands — the
+                        # quantity a warm persistent compile cache
+                        # (compile_cache_dir) shrinks.
+                        first_step_s = time.time() - train_t0
+                    if (elastic_on and fleet is not None
+                            and getattr(fleet, "supports_join", False)):
+                        if _poll_rejoin():
+                            continue
+                    if not armed:
+                        continue
+                    if (it % probe_every != 0
+                            and it < config.num_iterations):
+                        continue
+                    reason = _probe(u_new, m_new, norm_limit)
+                    if reason is None:
+                        try:
+                            # Boundary scrub (ISSUE 20): both masters
+                            # verified against their seals once per
+                            # probe cadence.  Fleet mode folds a hit
+                            # into the lockstep trip below (a raise here
+                            # would desync the collective schedule);
+                            # single mode raises into the checkpoint-
+                            # repair handler.
+                            u_store.scrub()
+                            m_store.scrub()
+                        except StoreIntegrityError as e:
+                            if fleet is None:
+                                raise
+                            reason = f"store integrity: {e}"
+                    if fleet is not None:
+                        # Lockstep trip sync (the PR 5 contract): one
+                        # word per process; ANY nonzero rolls every host
+                        # back to the same snapshot step with the same
+                        # ladder rung — the collective schedules stay
+                        # aligned.
+                        flags = _exchange.any_flag(fleet,
+                                                   reason is not None)
+                        if reason is None and flags.any():
+                            peers = [p for p in range(fleet.num_processes)
+                                     if flags[p]]
+                            reason = f"lockstep trip from peer {peers}"
+                    if reason is None:
+                        snap = (u_store.copy(), m_store.copy())
+                        snap_iter = it
+                        continue
+                    if not trip(reason):
+                        degraded = True
+                        break
                 except WindowIntegrityError as e:
                     # The staging checksum caught a torn/corrupt window
                     # BEFORE it reached a kernel; the store is intact, so
@@ -1851,55 +2251,59 @@ def train_als_host_window(
                         degraded = True
                         break
                     continue
-                it += 1
-                metrics.incr("iterations")
-                if (checkpoint_manager is not None
-                        and should_save(it, checkpoint_every,
-                                        config.num_iterations)):
-                    # Per-process manifest of the OWNED slice, after the
-                    # iteration commit — the recovery unit a killed
-                    # host's replacement restores (fleet-min agreement
-                    # at startup picks the step every host holds).
-                    checkpoint_manager.save(
-                        it, u_store.as_array(), m_store.as_array(),
-                        meta={
-                            "tier": "host_window",
-                            "processes": (1 if fleet is None
-                                          else fleet.num_processes),
-                            "process": (0 if fleet is None
-                                        else fleet.process),
-                        },
+                except StoreIntegrityError as e:
+                    # Host-RAM bit-rot in a MASTER table (the seals
+                    # caught it at a gather boundary or the boundary
+                    # scrub): the store itself is wrong, so a snapshot
+                    # rollback only helps if the snapshot predates the
+                    # rot — the committed checkpoint bytes are the
+                    # authoritative repair source.
+                    record_event("fault", "store_integrity", iteration=it,
+                                 shard=getattr(e, "shard", -1),
+                                 detail=str(e))
+                    metrics.incr("store_integrity_detected")
+                    repair_step = (
+                        checkpoint_manager.latest_valid_iteration()
+                        if (fleet is None and checkpoint_manager
+                            is not None) else None
                     )
-                if watchdog is not None:
-                    watchdog.tick(it)
-                if first_step_s is None:
-                    # Cold-start attribution (ISSUE 13): how long until
-                    # the first full iteration lands — the quantity a
-                    # warm persistent compile cache (compile_cache_dir)
-                    # shrinks.
-                    first_step_s = time.time() - train_t0
-                if not armed:
-                    continue
-                if it % probe_every != 0 and it < config.num_iterations:
-                    continue
-                reason = _probe(u_new, m_new, norm_limit)
-                if fleet is not None:
-                    # Lockstep trip sync (the PR 5 contract): one word
-                    # per process; ANY nonzero rolls every host back to
-                    # the same snapshot step with the same ladder rung —
-                    # the collective schedules stay aligned.
-                    flags = _exchange.any_flag(fleet, reason is not None)
-                    if reason is None and flags.any():
-                        peers = [p for p in range(fleet.num_processes)
-                                 if flags[p]]
-                        reason = f"lockstep trip from peer {peers}"
-                if reason is None:
+                    if repair_step is None:
+                        # No committed bytes to repair from: the in-RAM
+                        # last-good snapshot is the only recourse.
+                        dump_flight("store_integrity")
+                        if not trip(f"store integrity: {e}"):
+                            degraded = True
+                            break
+                        continue
+                    st = checkpoint_manager.restore(int(repair_step))
+                    u_store = HostFactorStore.from_array(
+                        np.asarray(st.user_factors), dtype=config.dtype,
+                        num_shards=u_store.num_shards,
+                    )
+                    m_store = HostFactorStore.from_array(
+                        np.asarray(st.movie_factors), dtype=config.dtype,
+                        num_shards=m_store.num_shards,
+                    )
+                    it = int(repair_step)
+                    u_store.seal()
+                    m_store.seal()
                     snap = (u_store.copy(), m_store.copy())
                     snap_iter = it
+                    _rebuild_hot()
+                    metrics.incr("store_repairs")
+                    record_event("fault", "store_repair", iteration=it,
+                                 step=int(repair_step))
+                    dump_flight("store_integrity_repair")
                     continue
-                if not trip(reason):
-                    degraded = True
-                    break
+                except _elastic.PeerDeadError as e:
+                    # A peer is gone for good (retries exhausted / fatal
+                    # transport error / collective timeout).  Elastic
+                    # fleets shrink and continue; anything else keeps
+                    # the PR 16 bounded-exit contract (the caller's
+                    # StallWatchdog/drill harness handles the exit).
+                    if not (elastic_on and _fleet_shrink(e)):
+                        raise
+                    continue
     finally:
         if watchdog is not None:
             watchdog.disarm()

@@ -329,6 +329,70 @@ class StagingCrash:
         raise RuntimeError(self.message)
 
 
+@dataclasses.dataclass
+class StoreBitRot:
+    """Flip one byte of a ``HostFactorStore`` shard after iteration
+    ``iteration`` commits — silent host-RAM bit-rot landing in the
+    MASTER factors (not a staged window: the store itself is now wrong,
+    so a plain rollback replay would re-read the rotten rows).  The
+    per-shard integrity seals (``HostFactorStore.seal``/``scrub``,
+    ISSUE 20) must detect it loudly (``StoreIntegrityError``) and the
+    driver must repair from the last committed checkpoint."""
+
+    iteration: int
+    side: str = "u"  # which store ("u" | "m")
+    shard: int = 0
+    byte: int = 0
+    fired: int = 0
+
+    def apply_store(self, i: int, side: str, store) -> None:
+        if i != self.iteration or side != self.side or self.fired:
+            return
+        self.fired += 1
+        buf = store._shards[self.shard].view(np.uint8).reshape(-1)
+        buf[self.byte % buf.size] ^= 0xFF
+
+
+class FlakyFleet:
+    """A fleet proxy whose first ``fail`` collective calls raise
+    ``error`` (default ``TransientFleetError``) — the slow-GC-pause /
+    dropped-packet fault the transient-vs-fatal classifier must absorb
+    with bounded retries instead of declaring the peer dead.  Set
+    ``fail`` high (or ``error`` to a fatal type) to test the
+    declare-dead path.  ``failed``/``calls`` count firings."""
+
+    def __init__(self, base, *, fail: int = 1, error=None):
+        from cfk_tpu.offload.elastic import TransientFleetError
+
+        self.base = base
+        self.fail = int(fail)
+        self.error = error or TransientFleetError("injected fleet flake")
+        self.failed = 0
+        self.calls = 0
+
+    @property
+    def num_processes(self) -> int:
+        return self.base.num_processes
+
+    @property
+    def process(self) -> int:
+        return self.base.process
+
+    def _flake(self) -> None:
+        self.calls += 1
+        if self.failed < self.fail:
+            self.failed += 1
+            raise self.error
+
+    def allgather_bytes(self, payload):
+        self._flake()
+        return self.base.allgather_bytes(payload)
+
+    def allgather_i32(self, values):
+        self._flake()
+        return self.base.allgather_i32(values)
+
+
 class WindowFaultInjector:
     """The hook ``offload.windowed`` calls while staging: applies every
     armed window corruption and delay plan.  The window-level analog of
@@ -360,6 +424,13 @@ class WindowFaultInjector:
                 if rows is not None:
                     return rows
         return None
+
+    def apply_store(self, i: int, side: str, store) -> None:
+        """Fire master-store faults (``StoreBitRot``) for the just-
+        committed iteration ``i``'s ``side`` table (ISSUE 20)."""
+        for f in self.faults:
+            if hasattr(f, "apply_store"):
+                f.apply_store(i, side, store)
 
     @property
     def fired(self) -> int:

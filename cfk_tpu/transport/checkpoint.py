@@ -630,6 +630,22 @@ class CheckpointManager:
             return it
         return None
 
+    def manifest_meta(self, iteration: int) -> dict:
+        """The caller-supplied ``meta`` of one committed step, without
+        loading the factor payloads — the fleet's covering-step search
+        reads many hosts' manifests and must not page in factor bytes
+        to decide which step is jointly restorable.  Verifies the step
+        first (same contract as ``restore``)."""
+        self.verify(iteration)
+        with open(os.path.join(self._step_dir(iteration), _MANIFEST)) as f:
+            manifest = json.load(f)
+        return {
+            k: v
+            for k, v in manifest.items()
+            if k not in ("iteration", "user_shape", "movie_shape", "dtype",
+                         "crc32")
+        }
+
     def restore(self, iteration: int | None = None) -> CheckpointState:
         if iteration is None:
             iteration = self.latest_valid_iteration()

@@ -570,6 +570,160 @@ def scenario_offload_fleet() -> dict:
     }
 
 
+def scenario_fleet_shrink() -> dict:
+    """Elastic fleet membership (ISSUE 20), the shrink half: SIGKILL one
+    of two offload-fleet processes mid-iteration and the survivor must
+    NOT exit — the elastic layer classifies the dead collective, the
+    survivors min-agree the committed step from the per-host manifests,
+    repartition ownership, reload the orphaned store slice, and finish
+    training; the survivor's final crc32 must bit-match the
+    uninterrupted 2-process run."""
+    import importlib.util
+    import signal
+    import tempfile
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = 29700 + (os.getpid() % 200) + 120
+
+    spec = importlib.util.spec_from_file_location(
+        "multihost_worker",
+        os.path.join(root, "tests", "multihost_worker.py"),
+    )
+    mhw = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mhw)
+
+    def spawn_pair(ckdir, drill, extra=(), port_off=0):
+        procs = mhw.spawn_workers(
+            port + port_off, 2, ckdir, "--drill", drill, *extra
+        )
+        return procs, mhw.communicate_all(procs, timeout=240)
+
+    def drill_rows(outs, tag):
+        return {json.loads(line.split(" ", 1)[1])["pid"]:
+                json.loads(line.split(" ", 1)[1])
+                for out in outs for line in out.splitlines()
+                if line.startswith(tag + " ")}
+
+    kill_iter = 2
+    with tempfile.TemporaryDirectory() as ck:
+        # uninterrupted 2-process reference — the crc the shrunk
+        # survivor must land on bit-exactly
+        uprocs, uouts = spawn_pair(None, "offload", port_off=6)
+        urows = drill_rows(uouts, "DRILL_OFFLOAD")
+        fleet_crc = urows.get(0, {}).get("crc")
+        fleet_agrees = (len(urows) == 2
+                        and urows[0]["crc"] == urows[1]["crc"])
+
+        procs, outs = spawn_pair(
+            ck, "offload-elastic",
+            ("--kill-iteration", str(kill_iter), "--stall-timeout", "10"),
+        )
+        rows = drill_rows(outs, "DRILL_OFFLOAD_ELASTIC")
+    victim_killed = procs[1].returncode == -signal.SIGKILL
+    survivor_row = rows.get(0, {})
+    survivor_completed = (procs[0].returncode == 0
+                          and survivor_row.get("crc") is not None)
+    shrank = (survivor_row.get("shrinks", 0) >= 1
+              and survivor_row.get("peers_lost", 0) >= 1
+              and survivor_row.get("epoch", 0) >= 1)
+    crc_exact = (fleet_crc is not None
+                 and survivor_row.get("crc") == fleet_crc)
+    from cfk_tpu.telemetry import record_event
+
+    record_event("fault", "fleet_shrink_observed",
+                 victim_exit=procs[1].returncode,
+                 survivor_exit=procs[0].returncode,
+                 shrinks=survivor_row.get("shrinks"),
+                 epoch=survivor_row.get("epoch"),
+                 crc_exact=bool(crc_exact))
+    return {
+        "scenario": "fleet_shrink",
+        "fault_fired": bool(victim_killed),
+        "detected": bool(shrank),
+        "recovered": bool(survivor_completed and crc_exact),
+        "survivor_exit": procs[0].returncode,
+        "fleet_crc_agrees": bool(fleet_agrees),
+        "uninterrupted_crc": fleet_crc,
+        "survivor_crc": survivor_row.get("crc"),
+        "shrinks": survivor_row.get("shrinks"),
+        "fleet_epoch": survivor_row.get("epoch"),
+        "ok": bool(victim_killed and survivor_completed and shrank
+                   and fleet_agrees and crc_exact),
+    }
+
+
+def scenario_fleet_rejoin() -> dict:
+    """Elastic fleet membership (ISSUE 20), the rejoin half, over the
+    in-process threaded Rendezvous fabric running the REAL driver: kill
+    one of two 'hosts' mid-half (survivor shrinks and keeps training),
+    restart it as a joiner — it must readmit through the health-gated
+    handshake at an iteration boundary, get its slice back, and finish
+    as a full member; BOTH finals must bit-match the uninterrupted
+    single-process run, and a frame from the dead host's previous life
+    must be provably fenced (StaleEpochError, stale_rejected >= 1)."""
+    import tempfile
+    import zlib
+
+    from cfk_tpu.config import ALSConfig
+    from cfk_tpu.data.blocks import Dataset
+    from cfk_tpu.data.synthetic import synthetic_netflix_coo
+    from cfk_tpu.offload.elastic import run_threaded_fleet
+    from cfk_tpu.offload.windowed import train_als_host_window
+
+    def crc(model):
+        c = zlib.crc32(np.asarray(model.user_factors,
+                                  np.float32).tobytes())
+        return f"{zlib.crc32(np.asarray(model.movie_factors, np.float32).tobytes(), c):08x}"
+
+    ds = Dataset.from_coo(
+        synthetic_netflix_coo(64, 32, 900, seed=0), num_shards=4,
+        layout="tiled", tile_rows=16, chunk_elems=512, ring=True,
+        ring_warn=False,
+    )
+    cfg = ALSConfig(rank=4, lam=0.05, num_iterations=6, seed=3,
+                    num_shards=4, layout="tiled", exchange="hier_ring",
+                    ici_group=2, health_check_every=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = crc(train_als_host_window(ds, cfg))
+        with tempfile.TemporaryDirectory() as ck:
+            out = run_threaded_fleet(
+                ds, cfg, ckdir=ck, num_processes=2, kill_pid=1,
+                kill_iteration=2, rejoin=True, zombie_probe=True,
+                thread_timeout_s=240.0,
+            )
+    res = out["results"]
+    survivor = res.get(0)
+    joiner = res.get("1:rejoin")
+    survivor_crc = None if isinstance(survivor, BaseException) else (
+        crc(survivor) if survivor is not None else None)
+    joiner_crc = None if isinstance(joiner, BaseException) else (
+        crc(joiner) if joiner is not None else None)
+    met0 = out["metrics"].get(0)
+    metj = out["metrics"].get("1:rejoin")
+    shrank = bool(met0 and met0.counters.get("fleet_shrinks", 0) >= 1)
+    rejoined = bool(
+        met0 and met0.counters.get("fleet_rejoins", 0) >= 1
+        and metj and metj.counters.get("fleet_rejoined", 0) >= 1
+    )
+    fenced = (out["stale_rejected"] >= 1
+              and out["stale_error"] is not None)
+    crc_exact = survivor_crc == joiner_crc == ref
+    return {
+        "scenario": "fleet_rejoin",
+        "fault_fired": bool(shrank),
+        "detected": bool(fenced),
+        "recovered": bool(rejoined and crc_exact),
+        "fleet_epoch": out["epoch"],
+        "stale_rejected": out["stale_rejected"],
+        "reference_crc": ref,
+        "survivor_crc": survivor_crc,
+        "joiner_crc": joiner_crc,
+        "ok": bool(shrank and rejoined and fenced and crc_exact
+                   and out["epoch"] >= 2),
+    }
+
+
 def _stream_fixture(parts=2, n=60, new_users=(4242,)):
     """(dataset, config, base model, broker-with-produced-stream)."""
     from cfk_tpu.config import ALSConfig
@@ -1940,6 +2094,8 @@ SCENARIOS = {
     "slow_disk": scenario_slow_disk,
     "worker_kill": scenario_worker_kill,
     "offload_fleet": scenario_offload_fleet,
+    "fleet_shrink": scenario_fleet_shrink,
+    "fleet_rejoin": scenario_fleet_rejoin,
     "stream_duplicates": scenario_stream_duplicates,
     "stream_crash_replay": scenario_stream_crash_replay,
     "stream_poison_batch": scenario_stream_poison_batch,
@@ -1976,6 +2132,8 @@ FLIGHT_EXPECT = {
     "slow_disk": ("checkpoint_committed",),
     "worker_kill": ("worker_kill",),
     "offload_fleet": ("offload_fleet_kill",),
+    "fleet_shrink": ("fleet_shrink",),
+    "fleet_rejoin": ("fleet_rejoin",),
     "stream_duplicates": ("delivery_duplicates",),
     "stream_crash_replay": ("stream_resumed", "corrupt_checkpoint"),
     "stream_poison_batch": ("quarantine",),

@@ -40,6 +40,12 @@ residuals over the hier-ring DCN phases.
   after committing a per-host checkpoint; the survivor exits bounded
   (``STALL_EXIT_CODE``); the restarted fleet min-agrees the resume step
   across per-host manifests and must land on the uninterrupted crc.
+- ``offload-elastic`` — the ISSUE 20 shrink drill: process 1 SIGKILLs
+  itself mid-run, but the survivor does NOT exit — the elastic layer
+  classifies the dead collective, min-agrees the committed step from
+  the per-host manifests, takes over the orphaned store slice, and
+  finishes single-host.  The survivor prints its final crc, which must
+  bit-match the uninterrupted 2-process (and 1-process) run.
 - ``offload-bench`` — a larger power-law shape whose per-host store
   footprint exceeds a simulated single-host RAM budget; process 0
   prints the fleet bench row (DCN residual rows/bytes, dense no-split
@@ -417,6 +423,75 @@ def drill_offload_kill(pid: int, ckdir: str, kill_iteration: int,
     print(f"DRILL_OFFLOAD_KILL_COMPLETED pid={pid}", flush=True)
 
 
+def drill_offload_elastic(pid: int, ckdir: str, kill_iteration: int,
+                          stall_timeout: float) -> None:
+    """ISSUE 20 acceptance drill: SIGKILL one host mid-iteration and the
+    SURVIVOR keeps going — shrink, repartition, reload the orphaned
+    slice, finish, print a crc that bit-matches the uninterrupted run."""
+    import dataclasses
+
+    from cfk_tpu.offload.elastic import FleetManifests
+    from cfk_tpu.offload.windowed import train_als_host_window
+    from cfk_tpu.resilience.preempt import STALL_EXIT_CODE, StallWatchdog
+    from cfk_tpu.utils.metrics import Metrics
+
+    ds, cfg = _offload_setup()
+    # The hang half of dead-peer detection: a SIGKILL'd Gloo peer can
+    # leave the survivor's collective blocked forever instead of raising
+    # — the elastic layer's collective timeout converts that into a
+    # classified PeerDeadError.
+    cfg = dataclasses.replace(cfg,
+                              fleet_collective_timeout_s=stall_timeout)
+    manifests = FleetManifests(ckdir)
+    manager = manifests.manager_for(pid)
+
+    wd = None
+    if pid == 1:
+        class _KillingWatchdog(StallWatchdog):
+            # Fires AFTER the per-host save (windowed.py orders save
+            # before tick): the kill lands on a committed step, so the
+            # survivor's coverage agreement finds it.
+            def tick(self, done=None):
+                super().tick(done)
+                print(f"DRILL_ITER pid={pid} done={done}", flush=True)
+                if done is not None and done >= kill_iteration:
+                    sys.stdout.flush()
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+        wd = _KillingWatchdog(stall_timeout, manager=manager)
+
+    metrics = Metrics()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = train_als_host_window(
+                ds, cfg, metrics=metrics, checkpoint_manager=manager,
+                fleet_manifests=manifests, watchdog=wd,
+            )
+    except Exception as e:
+        try:
+            manager.wait_pending(timeout=30.0)
+        except Exception:
+            pass
+        print(f"DRILL_COLLECTIVE_ERROR pid={pid} "
+              f"error={type(e).__name__}", flush=True)
+        sys.stdout.flush()
+        os._exit(STALL_EXIT_CODE)
+    print("DRILL_OFFLOAD_ELASTIC " + json.dumps({
+        "pid": pid,
+        "crc": _crc(model.user_factors, model.movie_factors),
+        "shrinks": int(metrics.counters.get("fleet_shrinks", 0)),
+        "peers_lost": int(metrics.counters.get("fleet_peers_lost", 0)),
+        "epoch": int(metrics.gauges.get("offload_fleet_epoch", 0)),
+    }, sort_keys=True), flush=True)
+    # os._exit(0), NOT a clean return: the interpreter's atexit runs
+    # jax's distributed shutdown, whose coordination barrier ABORTS
+    # against the SIGKILL'd peer and would clobber the success status.
+    # Everything synchronous is already flushed.
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def drill_offload_bench(pid: int) -> None:
     """The fleet scale-sweep row: a power-law shape whose per-host store
     exceeds a simulated single-host RAM budget completes under 2
@@ -549,7 +624,8 @@ def main() -> None:
     p.add_argument("--drill", default=None,
                    choices=["lockstep", "kill", "resume", "preempt",
                             "init-timeout", "offload", "offload-kill",
-                            "offload-resume", "offload-bench"])
+                            "offload-resume", "offload-elastic",
+                            "offload-bench"])
     p.add_argument("--kill-iteration", type=int, default=4)
     p.add_argument("--preempt-iteration", type=int, default=3)
     p.add_argument("--stall-timeout", type=float, default=10.0)
@@ -587,6 +663,11 @@ def main() -> None:
         drill_offload_kill(args.pid, args.ckdir, args.kill_iteration,
                            args.stall_timeout,
                            resume=args.drill == "offload-resume")
+        return
+    if args.drill == "offload-elastic":
+        assert args.ckdir, "offload elastic drill needs a checkpoint dir"
+        drill_offload_elastic(args.pid, args.ckdir, args.kill_iteration,
+                              args.stall_timeout)
         return
 
     mesh = make_multihost_mesh()
